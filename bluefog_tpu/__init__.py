@@ -1,0 +1,119 @@
+"""bluefog_tpu — TPU-native decentralized training framework.
+
+Brand-new JAX/XLA implementation of the BlueFog capability set (reference:
+``bluefog`` @ /root/reference), built as single-program SPMD over a TPU ICI
+mesh instead of MPI processes.  This top-level module currently exposes:
+weighted neighbor averaging over virtual graph topologies (static and
+dynamic per-step one-peer schedules), global allreduce/broadcast/allgather,
+hierarchical intra/inter-machine averaging, and pairwise gossip; the window
+subsystem (``ops/windows.py``) and optimizer wrappers (``optim/``) extend
+this surface as they land.
+
+Typical use mirrors the reference (``bluefog/torch/__init__.py:35-107``):
+
+    import bluefog_tpu as bf
+    bf.init(bf.topology_util.RingGraph)
+    y = bf.neighbor_allreduce(x)     # x: [bf.size(), ...] global view
+"""
+
+from . import context as _context
+from .context import BlueFogContext, init, shutdown, is_initialized
+
+from .parallel import topology as topology_util
+from .parallel import dynamic as dynamic_topology
+from .parallel.topology import (
+    ExponentialTwoGraph, ExponentialGraph, SymmetricExponentialGraph,
+    MeshGrid2DGraph, StarGraph, RingGraph, FullyConnectedGraph,
+    IsTopologyEquivalent, IsRegularGraph, GetRecvWeights, GetSendWeights,
+)
+from .parallel.dynamic import (
+    GetDynamicOnePeerSendRecvRanks,
+    GetExp2DynamicSendRecvMachineRanks,
+    GetInnerOuterRingDynamicSendRecvRanks,
+    GetInnerOuterExpo2DynamicSendRecvRanks,
+)
+from .parallel.schedule import (
+    CompiledTopology, DynamicSchedule,
+    compile_topology, compile_weight_matrix,
+    compile_dynamic_schedule, compile_dynamic_matrices,
+)
+
+from .ops.api import (
+    allreduce, allreduce_nonblocking, allreduce_, allreduce_nonblocking_,
+    broadcast, broadcast_nonblocking, broadcast_, broadcast_nonblocking_,
+    allgather, allgather_nonblocking,
+    neighbor_allreduce, neighbor_allreduce_nonblocking,
+    neighbor_allgather, neighbor_allgather_nonblocking,
+    hierarchical_neighbor_allreduce, hierarchical_neighbor_allreduce_nonblocking,
+    pair_gossip, pair_gossip_nonblocking,
+    barrier, poll, synchronize, wait,
+    to_global, from_global, rank_sharding,
+)
+
+from .version import __version__
+
+
+# -- context delegation (reference basics.py surface) -----------------------
+
+def _delegate(name):
+    def fn(*args, **kwargs):
+        return getattr(_context.ctx(), name)(*args, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+def size() -> int:
+    return _context.ctx().size
+
+
+def local_size() -> int:
+    return _context.ctx().local_size
+
+
+def machine_size() -> int:
+    return _context.ctx().machine_size
+
+
+rank = _delegate("rank")
+local_rank = _delegate("local_rank")
+machine_rank = _delegate("machine_rank")
+is_homogeneous = _delegate("is_homogeneous")
+set_topology = _delegate("set_topology")
+set_machine_topology = _delegate("set_machine_topology")
+load_topology = _delegate("load_topology")
+load_machine_topology = _delegate("load_machine_topology")
+is_topo_weighted = _delegate("is_topo_weighted")
+is_machine_topo_weighted = _delegate("is_machine_topo_weighted")
+in_neighbor_ranks = _delegate("in_neighbor_ranks")
+out_neighbor_ranks = _delegate("out_neighbor_ranks")
+in_neighbor_machine_ranks = _delegate("in_neighbor_machine_ranks")
+out_neighbor_machine_ranks = _delegate("out_neighbor_machine_ranks")
+suspend = _delegate("suspend")
+resume = _delegate("resume")
+
+
+# Compatibility toggles that are meaningless without a negotiation stage
+# (reference operations.cc:2068-2090) — kept as documented no-ops.
+_skip_negotiate = [False]
+
+
+def set_skip_negotiate_stage(value: bool) -> None:
+    _skip_negotiate[0] = bool(value)
+
+
+def get_skip_negotiate_stage() -> bool:
+    return _skip_negotiate[0]
+
+
+def nccl_built() -> bool:
+    """Reference parity (basics.py:147-169): this build uses XLA collectives
+    over ICI/DCN; there is no NCCL."""
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return True
+
+
+def unified_mpi_window_model_supported() -> bool:
+    return True

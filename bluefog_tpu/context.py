@@ -1,0 +1,253 @@
+"""Runtime context: device mesh, topology state, and rank queries.
+
+TPU-native replacement for the reference's global state + C ``bluefog_*`` API
+(``bluefog/common/global_state.h``, ``operations.cc:1215-1402``,
+``bluefog/common/basics.py``).  There is no background thread or coordinator:
+state is a device mesh plus compiled topology schedules; every op is a jitted
+SPMD program over the mesh.
+
+"Machine" structure (reference local/cross communicators,
+``mpi_context.cc:322-345``) maps to a 2-D ``(machine, local)`` mesh whose
+``local`` axis should align with ICI and ``machine`` with DCN on multi-host
+pods.  On a single host the split can be simulated with
+``BLUEFOG_NODES_PER_MACHINE`` exactly like the reference simulates multi-node
+on localhost (``mpi_context.cc:26,322``).
+"""
+
+import logging
+import os
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+import networkx as nx
+
+from .parallel import topology as topology_util
+from .parallel.schedule import (
+    CompiledTopology,
+    DynamicSchedule,
+    compile_topology,
+)
+
+logger = logging.getLogger("bluefog_tpu")
+
+_RANK_AXIS = "rank"
+_MACHINE_AXIS = "machine"
+_LOCAL_AXIS = "local"
+
+
+class BlueFogContext:
+    """Holds the mesh and the (machine) topology, analogous to
+    ``BluefogGlobalState`` (global_state.h:44-117) minus all the threading."""
+
+    def __init__(self,
+                 devices: Optional[Sequence] = None,
+                 nodes_per_machine: Optional[int] = None):
+        self._devices = list(devices) if devices is not None else list(jax.devices())
+        self._size = len(self._devices)
+
+        if nodes_per_machine is None:
+            env = os.environ.get("BLUEFOG_NODES_PER_MACHINE")
+            if env is not None:
+                nodes_per_machine = int(env)
+            elif jax.process_count() > 1:
+                nodes_per_machine = max(1, self._size // jax.process_count())
+            else:
+                nodes_per_machine = self._size
+        if self._size % nodes_per_machine != 0:
+            raise ValueError(
+                f"size {self._size} not divisible by nodes_per_machine "
+                f"{nodes_per_machine}")
+        self._local_size = nodes_per_machine
+
+        dev_array = np.asarray(self._devices)
+        self.mesh = jax.sharding.Mesh(dev_array, (_RANK_AXIS,))
+        self.mesh_2d = jax.sharding.Mesh(
+            dev_array.reshape(self.machine_size, self._local_size),
+            (_MACHINE_AXIS, _LOCAL_AXIS))
+
+        self._topology: Optional[nx.DiGraph] = None
+        self._compiled: Optional[CompiledTopology] = None
+        self._is_topo_weighted = False
+        self._machine_topology: Optional[nx.DiGraph] = None
+        self._compiled_machine: Optional[CompiledTopology] = None
+        self._is_machine_topo_weighted = False
+        self._suspended = False
+
+    # -- size / rank queries (basics.py:78-145) -----------------------------
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def local_size(self) -> int:
+        return self._local_size
+
+    @property
+    def machine_size(self) -> int:
+        return self._size // self._local_size
+
+    @property
+    def rank_axis(self) -> str:
+        return _RANK_AXIS
+
+    @property
+    def machine_axis(self) -> str:
+        return _MACHINE_AXIS
+
+    @property
+    def local_axis(self) -> str:
+        return _LOCAL_AXIS
+
+    def rank(self) -> int:
+        """Controller rank.  A single-controller SPMD program drives all
+        devices at once, so per-rank API queries take an explicit ``rank``
+        argument; this returns the first device index owned by this process
+        (0 on a single host) for reference-compatible call sites."""
+        if jax.process_count() > 1:
+            for i, d in enumerate(self._devices):
+                if d.process_index == jax.process_index():
+                    return i
+        return 0
+
+    def local_rank(self) -> int:
+        return self.rank() % self._local_size
+
+    def machine_rank(self, rank: Optional[int] = None) -> int:
+        r = self.rank() if rank is None else rank
+        return r // self._local_size
+
+    def is_homogeneous(self) -> bool:
+        return True
+
+    # -- topology (basics.py:311-419) ---------------------------------------
+
+    def set_topology(self, topo: Optional[nx.DiGraph] = None,
+                     is_weighted: bool = False) -> bool:
+        if topo is None:
+            topo = topology_util.ExponentialGraph(self._size)
+        if topo.number_of_nodes() != self._size:
+            raise ValueError(
+                f"topology has {topo.number_of_nodes()} nodes but the mesh "
+                f"has {self._size} devices")
+        self._topology = topo
+        self._is_topo_weighted = is_weighted
+        self._compiled = compile_topology(
+            topo if is_weighted else _uniform_weights(topo))
+        return True
+
+    def set_machine_topology(self, topo: nx.DiGraph,
+                             is_weighted: bool = False) -> bool:
+        if topo.number_of_nodes() != self.machine_size:
+            raise ValueError(
+                f"machine topology has {topo.number_of_nodes()} nodes but "
+                f"there are {self.machine_size} machines")
+        self._machine_topology = topo
+        self._is_machine_topo_weighted = is_weighted
+        self._compiled_machine = compile_topology(
+            topo if is_weighted else _uniform_weights(topo))
+        return True
+
+    def load_topology(self) -> Optional[nx.DiGraph]:
+        return self._topology
+
+    def load_machine_topology(self) -> Optional[nx.DiGraph]:
+        return self._machine_topology
+
+    def is_topo_weighted(self) -> bool:
+        return self._is_topo_weighted
+
+    def is_machine_topo_weighted(self) -> bool:
+        return self._is_machine_topo_weighted
+
+    @property
+    def compiled_topology(self) -> CompiledTopology:
+        if self._compiled is None:
+            raise RuntimeError("BlueFog TPU has not been initialized; call bf.init()")
+        return self._compiled
+
+    @property
+    def compiled_machine_topology(self) -> CompiledTopology:
+        if self._compiled_machine is None:
+            raise RuntimeError("machine topology not set; call bf.set_machine_topology()")
+        return self._compiled_machine
+
+    def in_neighbor_ranks(self, rank: Optional[int] = None) -> List[int]:
+        if self._topology is None:
+            return []
+        r = self.rank() if rank is None else rank
+        return [s for s in self._topology.predecessors(r) if s != r]
+
+    def out_neighbor_ranks(self, rank: Optional[int] = None) -> List[int]:
+        if self._topology is None:
+            return []
+        r = self.rank() if rank is None else rank
+        return [s for s in self._topology.successors(r) if s != r]
+
+    def in_neighbor_machine_ranks(self, rank: Optional[int] = None) -> List[int]:
+        if self._machine_topology is None:
+            return []
+        m = self.machine_rank(rank)
+        return [s for s in self._machine_topology.predecessors(m) if s != m]
+
+    def out_neighbor_machine_ranks(self, rank: Optional[int] = None) -> List[int]:
+        if self._machine_topology is None:
+            return []
+        m = self.machine_rank(rank)
+        return [s for s in self._machine_topology.successors(m) if s != m]
+
+    # -- misc toggles (basics.py:441-454,548-568) ---------------------------
+
+    def suspend(self):
+        self._suspended = True
+
+    def resume(self):
+        self._suspended = False
+
+
+def _uniform_weights(topo: nx.DiGraph) -> nx.DiGraph:
+    """Replace topology weights with the uniform 1/(in_degree+1) rule used
+    when ``is_weighted=False`` (reference torch/mpi_ops.py:506-512)."""
+    n = topo.number_of_nodes()
+    A = (nx.to_numpy_array(topo) != 0).astype(np.float64)
+    np.fill_diagonal(A, 1.0)
+    A /= A.sum(axis=0)[None, :]
+    return nx.from_numpy_array(A, create_using=nx.DiGraph)
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton, mirroring the reference's process-global state
+# ---------------------------------------------------------------------------
+
+_context: Optional[BlueFogContext] = None
+
+
+def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
+         is_weighted: bool = False,
+         devices: Optional[Sequence] = None,
+         nodes_per_machine: Optional[int] = None) -> BlueFogContext:
+    """Initialize the global context (reference ``bf.init``, basics.py:49-70).
+
+    The default topology is an exponential-2 graph over all devices.
+    """
+    global _context
+    _context = BlueFogContext(devices=devices, nodes_per_machine=nodes_per_machine)
+    topo = topology_fn(_context.size) if topology_fn else None
+    _context.set_topology(topo, is_weighted)
+    return _context
+
+
+def shutdown() -> None:
+    global _context
+    _context = None
+
+
+def ctx() -> BlueFogContext:
+    if _context is None:
+        raise RuntimeError("BlueFog TPU has not been initialized; call bf.init()")
+    return _context
+
+
+def is_initialized() -> bool:
+    return _context is not None

@@ -1,0 +1,3 @@
+"""Collective ops: shard_map primitives and the global-view API."""
+
+from . import collectives, api

@@ -1,0 +1,367 @@
+"""Global-view op API (reference parity: ``bluefog/torch/mpi_ops.py``).
+
+BlueFog programs are written per-MPI-process: every rank owns a tensor and
+calls ``bf.neighbor_allreduce(t)``.  The TPU-native equivalent is a *global
+view*: one controller drives all devices, and "rank i's tensor" is slice ``i``
+of a global array of shape ``[size, ...]`` sharded over the mesh's ``rank``
+axis.  Each API call runs one jitted ``shard_map`` program in which rank i's
+shard exchanges data with its neighbors over ICI.
+
+Nonblocking semantics come for free: JAX dispatch is async, so the
+``*_nonblocking`` variants return a handle immediately and
+``synchronize``/``wait``/``poll`` map to ``block_until_ready``/``is_ready``
+(replacing the reference's handle manager + background thread,
+``bluefog/torch/handle_manager.h:30-41``).
+
+In-place variants (``allreduce_`` etc.) exist for signature parity but return
+new arrays — JAX arrays are immutable.
+"""
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import context as _ctx_mod
+from ..context import ctx
+from . import collectives as C
+from ..parallel.schedule import (
+    CompiledTopology,
+    DynamicSchedule,
+    compile_weight_matrix,
+)
+
+__all__ = [
+    "allreduce", "allreduce_nonblocking", "allreduce_", "allreduce_nonblocking_",
+    "broadcast", "broadcast_nonblocking", "broadcast_", "broadcast_nonblocking_",
+    "allgather", "allgather_nonblocking",
+    "neighbor_allreduce", "neighbor_allreduce_nonblocking",
+    "neighbor_allgather", "neighbor_allgather_nonblocking",
+    "hierarchical_neighbor_allreduce", "hierarchical_neighbor_allreduce_nonblocking",
+    "pair_gossip", "pair_gossip_nonblocking",
+    "barrier", "poll", "synchronize", "wait",
+    "rank_sharding", "to_global", "from_global",
+]
+
+
+# ---------------------------------------------------------------------------
+# Handles
+# ---------------------------------------------------------------------------
+
+_handle_lock = threading.Lock()
+_handle_map: Dict[int, jax.Array] = {}
+_next_handle = [0]
+
+
+def _register_handle(output) -> int:
+    with _handle_lock:
+        handle = _next_handle[0]
+        _next_handle[0] += 1
+        _handle_map[handle] = output
+    return handle
+
+
+def poll(handle: int) -> bool:
+    """True when the nonblocking op behind ``handle`` has completed."""
+    with _handle_lock:
+        if handle not in _handle_map:
+            raise ValueError(f"unknown handle {handle}")
+        out = _handle_map[handle]
+    ready = jax.tree_util.tree_all(
+        jax.tree.map(lambda a: a.is_ready() if hasattr(a, "is_ready") else True, out))
+    return bool(ready)
+
+
+def synchronize(handle: int):
+    """Wait for a nonblocking op and return its output."""
+    with _handle_lock:
+        if handle not in _handle_map:
+            raise ValueError("Cannot find handle to synchronize")
+        out = _handle_map.pop(handle)
+    return jax.block_until_ready(out)
+
+
+wait = synchronize
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers
+# ---------------------------------------------------------------------------
+
+def rank_sharding() -> NamedSharding:
+    return NamedSharding(ctx().mesh, P(ctx().rank_axis))
+
+
+def to_global(x) -> jax.Array:
+    """Place a ``[size, ...]`` array with axis 0 sharded over ranks."""
+    x = jnp.asarray(x)
+    if x.shape[0] != ctx().size:
+        raise ValueError(
+            f"global-view arrays carry one slice per rank; expected leading "
+            f"dim {ctx().size}, got {x.shape}")
+    return jax.device_put(x, rank_sharding())
+
+
+def from_global(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _shardmapped(fn, n_outputs: int = 1):
+    """jit(shard_map(fn)) over the 1-D rank mesh; fn sees the per-rank slice
+    (leading axis stripped)."""
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(*args):
+        def shard_fn(*shards):
+            unwrapped = [s[0] for s in shards]
+            out = fn(*unwrapped)
+            if n_outputs == 1:
+                return out[None]
+            return tuple(o[None] for o in out)
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=tuple(spec for _ in args),
+            out_specs=spec if n_outputs == 1 else tuple(spec for _ in range(n_outputs)),
+        )(*args)
+
+    return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=256)
+def _allreduce_fn(axis, average, mesh_id):
+    return _shardmapped(lambda x: C.allreduce(x, axis, average=average))
+
+
+@functools.lru_cache(maxsize=256)
+def _broadcast_fn(axis, root_rank, mesh_id):
+    return _shardmapped(lambda x: C.broadcast(x, axis, root_rank))
+
+
+@functools.lru_cache(maxsize=256)
+def _allgather_fn(axis, mesh_id):
+    return _shardmapped(lambda x: C.allgather(x, axis))
+
+
+@functools.lru_cache(maxsize=256)
+def _neighbor_allreduce_fn(axis, topo: CompiledTopology, mesh_id):
+    return _shardmapped(lambda x: C.neighbor_allreduce(x, axis, topo))
+
+
+@functools.lru_cache(maxsize=256)
+def _neighbor_allgather_fn(axis, topo: CompiledTopology, mesh_id):
+    return _shardmapped(lambda x: C.neighbor_allgather(x, axis, topo))
+
+
+@functools.lru_cache(maxsize=256)
+def _dynamic_nar_fn(axis, sched: DynamicSchedule, mesh_id):
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, step):
+        def shard_fn(xs, step_s):
+            return C.dynamic_neighbor_allreduce(xs[0], axis, sched, step_s)[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh, in_specs=(spec, P()), out_specs=spec,
+        )(x, step)
+    return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=256)
+def _matrix_mix_fn(axis, mesh_id):
+    """Generic traced-matrix mixing: out_j = sum_i W[i, j] x_i.
+
+    All-gather based; used for arbitrary one-step dynamic weight matrices
+    where no precompiled schedule exists.  O(N) bandwidth but always one
+    compilation per shape.
+    """
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, W):
+        def shard_fn(xs, Ws):
+            gathered = C.allgather(xs, axis)       # [N, ...]
+            col = Ws[:, jax.lax.axis_index(axis)]  # [N]; P() spec: W unsliced
+            return jnp.tensordot(col.astype(xs.dtype), gathered, axes=1)[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh, in_specs=(spec, P()), out_specs=spec,
+        )(x, W)
+    return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=256)
+def _pair_gossip_fn(axis, pairs, self_weight, pair_weight, mesh_id):
+    return _shardmapped(
+        lambda x: C.pair_gossip(x, axis, pairs, self_weight, pair_weight))
+
+
+def _mesh_id():
+    return id(ctx().mesh)
+
+
+# ---------------------------------------------------------------------------
+# Collective ops (blocking + nonblocking)
+# ---------------------------------------------------------------------------
+
+def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
+    cx = ctx()
+    out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
+    return _register_handle(out)
+
+
+def allreduce(x, average: bool = True, name: Optional[str] = None):
+    """Global allreduce of the per-rank slices (mpi_ops.py:108-212)."""
+    return synchronize(allreduce_nonblocking(x, average, name))
+
+
+allreduce_ = allreduce
+allreduce_nonblocking_ = allreduce_nonblocking
+
+
+def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> int:
+    cx = ctx()
+    out = _broadcast_fn(cx.rank_axis, int(root_rank), _mesh_id())(to_global(x))
+    return _register_handle(out)
+
+
+def broadcast(x, root_rank: int, name: Optional[str] = None):
+    """Replicate rank ``root_rank``'s slice to all ranks (mpi_ops.py:227-319)."""
+    return synchronize(broadcast_nonblocking(x, root_rank, name))
+
+
+broadcast_ = broadcast
+broadcast_nonblocking_ = broadcast_nonblocking
+
+
+def allgather_nonblocking(x, name: Optional[str] = None) -> int:
+    out = _allgather_fn(ctx().rank_axis, _mesh_id())(to_global(x))
+    return _register_handle(out)
+
+
+def allgather(x, name: Optional[str] = None):
+    """Concatenate all ranks' slices along their first dim: the result's
+    slice for every rank is ``concat_i x[i]`` (mpi_ops.py:334-373)."""
+    return synchronize(allgather_nonblocking(x, name))
+
+
+def neighbor_allreduce_nonblocking(
+        x, *,
+        self_weight: Optional[float] = None,
+        weight_matrix: Optional[np.ndarray] = None,
+        sched: Optional[DynamicSchedule] = None,
+        step: Optional[int] = None,
+        name: Optional[str] = None) -> int:
+    cx = ctx()
+    xg = to_global(x)
+    if sched is not None:
+        if step is None:
+            raise ValueError("dynamic schedule requires a step index")
+        out = _dynamic_nar_fn(cx.rank_axis, sched, _mesh_id())(
+            xg, jnp.asarray(step, jnp.int32))
+    elif weight_matrix is not None:
+        out = _matrix_mix_fn(cx.rank_axis, _mesh_id())(
+            xg, jnp.asarray(weight_matrix))
+    else:
+        topo = cx.compiled_topology
+        out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id())(xg)
+    return _register_handle(out)
+
+
+def neighbor_allreduce(x, **kwargs):
+    """Weighted neighbor average — the hot op (mpi_ops.py:475-645).
+
+    Modes:
+      * default: the context topology's weights (or uniform 1/(deg+1) when
+        ``bf.init(is_weighted=False)``, the reference default).
+      * ``weight_matrix=W``: arbitrary one-step mixing matrix (covers the
+        reference's per-call ``self_weight/src_weights/dst_weights`` — any
+        per-rank weighting is a row/column of W).
+      * ``sched=..., step=i``: precompiled dynamic schedule; the step index
+        is data, so per-step topology hops never recompile.
+    """
+    return synchronize(neighbor_allreduce_nonblocking(x, **kwargs))
+
+
+def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
+    cx = ctx()
+    topo = cx.compiled_topology
+    out = _neighbor_allgather_fn(cx.rank_axis, topo, _mesh_id())(to_global(x))
+    return _register_handle(out)
+
+
+def neighbor_allgather(x, name: Optional[str] = None):
+    """Gather in-neighbor slices, ordered by ascending source rank
+    (mpi_ops.py:397-472).  Global result shape: [size, in_degree, ...]."""
+    return synchronize(neighbor_allgather_nonblocking(x, name))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+        x, name: Optional[str] = None) -> int:
+    cx = ctx()
+    mtopo = cx.compiled_machine_topology
+    xg = jnp.asarray(x)
+    if xg.shape[0] != cx.size:
+        raise ValueError(f"expected leading dim {cx.size}, got {xg.shape}")
+    fn = _hier_fn(cx.machine_axis, cx.local_axis, mtopo, _mesh_id())
+    out = fn(xg)
+    return _register_handle(out)
+
+
+@functools.lru_cache(maxsize=64)
+def _hier_fn(machine_axis, local_axis, mtopo, mesh_id):
+    cx = ctx()
+
+    def wrapper(x):
+        x2 = x.reshape((cx.machine_size, cx.local_size) + x.shape[1:])
+
+        def shard_fn(xs):
+            y = C.hierarchical_neighbor_allreduce(
+                xs[0, 0], machine_axis, local_axis, mtopo)
+            return y[None, None]
+        out = jax.shard_map(
+            shard_fn, mesh=cx.mesh_2d,
+            in_specs=P(machine_axis, local_axis),
+            out_specs=P(machine_axis, local_axis),
+        )(x2)
+        return out.reshape(x.shape)
+    return jax.jit(wrapper)
+
+
+def hierarchical_neighbor_allreduce(x, name: Optional[str] = None):
+    """Machine-level neighbor average: intra-machine mean, then the machine
+    topology's weighted exchange, replicated locally (mpi_ops.py:648-838)."""
+    return synchronize(hierarchical_neighbor_allreduce_nonblocking(x, name))
+
+
+def pair_gossip_nonblocking(x, pairs: Sequence[Tuple[int, int]],
+                            self_weight: Optional[float] = None,
+                            pair_weight: Optional[float] = None,
+                            name: Optional[str] = None) -> int:
+    if (self_weight is None) != (pair_weight is None):
+        raise ValueError("self_weight and pair_weight have to be set at same time.")
+    if self_weight is None:
+        self_weight, pair_weight = 0.5, 0.5
+    out = _pair_gossip_fn(ctx().rank_axis, tuple(map(tuple, pairs)),
+                          float(self_weight), float(pair_weight),
+                          _mesh_id())(to_global(x))
+    return _register_handle(out)
+
+
+def pair_gossip(x, pairs, self_weight=None, pair_weight=None, name=None):
+    """Pairwise (weighted) averaging over a matching of ranks
+    (mpi_ops.py:852-928; ``pairs`` is the global matching instead of the
+    per-process ``target_rank``)."""
+    return synchronize(pair_gossip_nonblocking(x, pairs, self_weight,
+                                               pair_weight, name))
+
+
+def barrier():
+    """Synchronize: all outstanding device work completes (mpi_ops.py:980)."""
+    cx = ctx()
+    fn = _allreduce_fn(cx.rank_axis, False, _mesh_id())
+    jax.block_until_ready(fn(to_global(jnp.ones((cx.size, 1)))))

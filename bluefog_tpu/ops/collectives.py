@@ -1,0 +1,242 @@
+"""SPMD collective primitives (to be called inside ``shard_map``/``pjit``).
+
+This is the TPU-native replacement for the reference's MPI/NCCL controllers
+(``bluefog/common/mpi_controller.cc``, ``nccl_controller.cc``).  There is no
+background thread, negotiation, or tensor fusion here: every rank runs the
+same jitted program, XLA schedules and fuses the collectives, and "nonblocking"
+falls out of JAX's async dispatch (SURVEY.md §1 threading note).
+
+Topologies execute by circulant decomposition (see ``parallel/schedule.py``):
+one ``lax.ppermute`` per ring offset with per-rank weights, so a sparse graph
+costs only its number of distinct offsets.  Dynamic per-step graphs use fixed
+offset supersets with step-indexed weight tables — no recompilation when the
+graph changes (reference parity: dynamic neighbor_allreduce,
+``bluefog/torch/mpi_ops.py:475-645``).
+
+All functions take ``axis_name`` explicitly and operate on the *per-rank
+shard* of data, exactly like ``lax.psum``.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from ..parallel.schedule import CompiledTopology, DynamicSchedule
+
+__all__ = [
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "barrier_value",
+    "neighbor_allreduce",
+    "dynamic_neighbor_allreduce",
+    "neighbor_allgather",
+    "pair_gossip",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_local_allreduce",
+]
+
+
+
+def _require_inexact(x, op_name: str):
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        raise TypeError(
+            f"{op_name} computes fractional weighted averages and requires a "
+            f"float dtype, got {jnp.asarray(x).dtype}; cast the input first")
+
+
+def _rotation_pairs(size: int, offset: int) -> Tuple[Tuple[int, int], ...]:
+    """Full-rotation permutation: every rank sends to (rank + offset) % size."""
+    return tuple((j, (j + offset) % size) for j in range(size))
+
+
+def allreduce(x, axis_name, *, average: bool = True):
+    """Global allreduce (reference: ``MPIController::Allreduce``,
+    mpi_controller.cc:169; default op is average, torch/mpi_ops.py:108)."""
+    return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
+
+
+def broadcast(x, axis_name, root_rank: int):
+    """Every rank ends with ``root_rank``'s value (mpi_controller.cc:193).
+
+    Implemented as a masked psum: contributions from non-root ranks are
+    zeroed, which XLA lowers to an efficient broadcast on ICI.
+    """
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def allgather(x, axis_name):
+    """Concatenate every rank's shard along axis 0 (mpi_controller.cc:136)."""
+    return lax.all_gather(x, axis_name, tiled=True)
+
+
+def barrier_value(axis_name):
+    """A scalar whose computation requires all ranks (barrier semantics;
+    reference barrier is an allreduce of a byte, torch/mpi_ops.py:980)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Neighbor collectives (static topology)
+# ---------------------------------------------------------------------------
+
+def neighbor_allreduce(x, axis_name, topo: CompiledTopology):
+    """Weighted neighbor average: ``out_i = W[i,i] x_i + sum_j W[j,i] x_j``.
+
+    The hot op (reference ``MPIController::NeighborAllreduce``,
+    mpi_controller.cc:419-517 + averaging callback torch/mpi_ops.cc:99-164).
+    One ppermute per circulant offset of the topology; weights are baked into
+    the compiled program as constants.
+    """
+    _require_inexact(x, "neighbor_allreduce")
+    idx = lax.axis_index(axis_name)
+    self_w = jnp.asarray(topo.self_weights, x.dtype)[idx]
+    out = self_w * x
+    for shift in topo.shifts:
+        received = lax.ppermute(x, axis_name, shift.pairs)
+        w = jnp.asarray(shift.recv_weights, x.dtype)[idx]
+        out = out + w * received
+    return out
+
+
+def _allgather_slots(topo: CompiledTopology) -> np.ndarray:
+    """slots[k, i] = position of offset-k's source in rank i's sorted
+    in-neighbor list, or in_degree (=> dropped) when no such edge."""
+    n = topo.size
+    indeg = int(topo.in_degrees()[0])
+    slots = np.full((len(topo.shifts), n), indeg, dtype=np.int32)
+    sorted_sources = [topo.in_neighbor_ranks(i) for i in range(n)]
+    for k, shift in enumerate(topo.shifts):
+        for src, dst in shift.pairs:
+            slots[k, dst] = sorted_sources[dst].index(src)
+    return slots
+
+
+def neighbor_allgather(x, axis_name, topo: CompiledTopology):
+    """Stack in-neighbor tensors: out has shape ``[in_degree, *x.shape]``,
+    ordered by ascending source rank (matching MPI_Dist_graph source order,
+    mpi_controller.cc:282-361; reference concatenates along dim 0).
+
+    Requires a regular topology (uniform in-degree) so that SPMD output
+    shapes agree across ranks.
+    """
+    if not topo.is_regular:
+        raise ValueError(
+            "neighbor_allgather inside SPMD requires a regular topology "
+            "(uniform in-degree); use the global-view API for irregular graphs")
+    indeg = int(topo.in_degrees()[0])
+    idx = lax.axis_index(axis_name)
+    slots = jnp.asarray(_allgather_slots(topo))
+    out = jnp.zeros((indeg,) + x.shape, x.dtype)
+    for k, shift in enumerate(topo.shifts):
+        received = lax.ppermute(x, axis_name, shift.pairs)
+        out = out.at[slots[k, idx]].set(received, mode="drop")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Neighbor collectives (dynamic topology)
+# ---------------------------------------------------------------------------
+
+def dynamic_neighbor_allreduce(x, axis_name, sched: DynamicSchedule, step):
+    """Per-step dynamic neighbor average with a traced ``step`` index.
+
+    The offset superset is fixed at trace time; which edges are live at this
+    step is pure data (weight tables), so topology hops never recompile
+    (SURVEY.md §7 hard part 2).  ``step`` may be a traced int32 scalar.
+    """
+    _require_inexact(x, "dynamic_neighbor_allreduce")
+    t = jnp.asarray(step) % sched.period
+    idx = lax.axis_index(axis_name)
+    self_w = jnp.asarray(sched.self_weights)[t]            # [N]
+    recv_w = jnp.asarray(sched.recv_weights)[t]            # [K, N]
+    out = self_w[idx].astype(x.dtype) * x
+    for k, offset in enumerate(sched.offsets):
+        received = lax.ppermute(
+            x, axis_name, _rotation_pairs(sched.size, offset))
+        out = out + recv_w[k, idx].astype(x.dtype) * received
+    return out
+
+
+def dynamic_neighbor_allreduce_dst_weighted(
+        x, axis_name, sched: DynamicSchedule, step, send_weights):
+    """Dynamic neighbor average with sender-side weighting.
+
+    ``send_weights``: [K, N] array — rank i scales its outgoing value on
+    offset k by ``send_weights[k, i]`` before the permute (reference
+    dst_weights path, mpi_controller.cc:1444-1446).  Receivers add arrivals
+    unscaled; self contribution still uses the schedule's self weights.
+    """
+    _require_inexact(x, "dynamic_neighbor_allreduce_dst_weighted")
+    t = jnp.asarray(step) % sched.period
+    idx = lax.axis_index(axis_name)
+    self_w = jnp.asarray(sched.self_weights)[t]
+    send_w = jnp.asarray(send_weights)
+    out = self_w[idx].astype(x.dtype) * x
+    for k, offset in enumerate(sched.offsets):
+        received = lax.ppermute(
+            send_w[k, idx].astype(x.dtype) * x, axis_name,
+            _rotation_pairs(sched.size, offset))
+        out = out + received
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pair gossip
+# ---------------------------------------------------------------------------
+
+def pair_gossip(x, axis_name, pairs: Sequence[Tuple[int, int]],
+                self_weight: float = 0.5, pair_weight: float = 0.5):
+    """Pairwise exchange + weighted average (mpi_controller.cc:745-771).
+
+    ``pairs`` is a perfect (or partial) matching given as unordered rank
+    pairs; both directions are exchanged in a single ppermute.  Ranks outside
+    the matching keep their value unchanged.
+    """
+    _require_inexact(x, "pair_gossip")
+    perm = []
+    matched = set()
+    for a, b in pairs:
+        if a == b or a in matched or b in matched:
+            raise ValueError(f"pairs must form a matching, got {pairs}")
+        matched.update((a, b))
+        perm.extend([(a, b), (b, a)])
+    received = lax.ppermute(x, axis_name, perm)
+    idx = lax.axis_index(axis_name)
+    size = lax.axis_size(axis_name)
+    in_pair = np.zeros(size, dtype=bool)
+    for a, b in pairs:
+        in_pair[[a, b]] = True
+    mask = jnp.asarray(in_pair)[idx]
+    mixed = self_weight * x + pair_weight * received
+    return jnp.where(mask, mixed.astype(x.dtype), x)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (machine-level) collectives on a 2-D mesh
+# ---------------------------------------------------------------------------
+
+def hierarchical_neighbor_allreduce(x, machine_axis, local_axis,
+                                    machine_topo: CompiledTopology):
+    """Two-level neighbor average (mpi_controller.cc:471-507).
+
+    Reference pipeline: intra-machine allreduce -> inter-machine neighbor
+    exchange by local rank 0 -> intra-machine broadcast.  On a 2-D
+    ``(machine, local)`` mesh the local pmean plus a machine-axis neighbor
+    average produces the same value already replicated on every local rank —
+    the final broadcast disappears (the ``/local_size`` correction of
+    torch/mpi_ops.cc:119-155 is the pmean).
+    """
+    local_avg = lax.pmean(x, local_axis)
+    return neighbor_allreduce(local_avg, machine_axis, machine_topo)
+
+
+def hierarchical_local_allreduce(x, local_axis, *, average: bool = True):
+    """Machine-local allreduce (reference ``is_hierarchical_local`` path,
+    mpi_controller.cc:177-178 over the LOCAL communicator)."""
+    return lax.pmean(x, local_axis) if average else lax.psum(x, local_axis)

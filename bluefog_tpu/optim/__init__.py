@@ -1,0 +1,2 @@
+"""Distributed optimizer wrappers (not yet implemented — this package will
+hold the CTA/ATC/AWC, gradient-allreduce, and window/push-sum strategies)."""

@@ -1,0 +1,3 @@
+"""Parallelism layer: topologies, dynamic schedules, mesh/collective plans."""
+
+from . import topology, dynamic, schedule

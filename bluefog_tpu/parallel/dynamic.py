@@ -1,0 +1,274 @@
+"""Dynamic (per-step) one-peer topology schedules.
+
+The reference exposes per-rank generators that yield ``(send_ranks,
+recv_ranks)`` each iteration (``bluefog/common/topology_util.py:315-554``).
+Those generators are deterministic in ``(rank, size, step)``, which is what
+makes them usable from a single-controller SPMD program: we evaluate the rule
+for *every* rank at once and materialize the step's global mixing matrix (or
+its ppermute offset), instead of each MPI process privately asking "who do I
+talk to now".
+
+Per-rank generator API is kept for parity/tests; the matrix/offset helpers at
+the bottom are what the TPU collectives consume.
+
+Reference parity map:
+  * GetDynamicOnePeerSendRecvRanks          topology_util.py:315
+  * GetExp2DynamicSendRecvMachineRanks      topology_util.py:360
+  * GetInnerOuterRingDynamicSendRecvRanks   topology_util.py:399
+  * GetInnerOuterExpo2DynamicSendRecvRanks  topology_util.py:466
+"""
+
+import math
+from typing import Callable, Iterator, List, Tuple
+
+import numpy as np
+import networkx as nx
+
+__all__ = [
+    "GetDynamicOnePeerSendRecvRanks",
+    "GetExp2DynamicSendRecvMachineRanks",
+    "GetInnerOuterRingDynamicSendRecvRanks",
+    "GetInnerOuterExpo2DynamicSendRecvRanks",
+    "one_peer_send_rank",
+    "dynamic_mixing_matrix",
+    "dynamic_mixing_matrices",
+    "one_peer_offsets",
+]
+
+
+# ---------------------------------------------------------------------------
+# Closed-form per-step rules (shared by the generators and the global helpers)
+# ---------------------------------------------------------------------------
+
+def _sorted_out_neighbors(topo: nx.DiGraph) -> List[List[int]]:
+    """Out-neighbors of each rank sorted clockwise (by positive offset)."""
+    size = topo.number_of_nodes()
+    result = []
+    for rank in range(size):
+        succ = [r for r in topo.successors(rank) if r != rank]
+        succ.sort(key=lambda r: (r - rank) % size)
+        result.append(succ)
+    return result
+
+
+def one_peer_send_rank(topo: nx.DiGraph, rank: int, step: int) -> int:
+    """Destination of ``rank`` at ``step`` under the one-peer rotation rule.
+
+    Rank r cycles clockwise through its non-self out-neighbors; the cycle
+    length is r's own out-degree, so ranks with different degrees rotate at
+    different periods (matching reference topology_util.py:344-357).
+    """
+    ordered = _sorted_out_neighbors(topo)[rank]
+    return ordered[step % len(ordered)]
+
+
+def GetDynamicOnePeerSendRecvRanks(
+        topo: nx.DiGraph, self_rank: int) -> Iterator[Tuple[List[int], List[int]]]:
+    """Yield ([send_rank], recv_ranks) per step: one outgoing peer, cycling
+    clockwise through the base topology's out-neighbors."""
+    size = topo.number_of_nodes()
+    ordered = _sorted_out_neighbors(topo)
+    step = 0
+    while True:
+        send = ordered[self_rank][step % len(ordered[self_rank])]
+        recv = [
+            r for r in range(size)
+            if r != self_rank and ordered[r][step % len(ordered[r])] == self_rank
+        ]
+        yield [send], recv
+        step += 1
+
+
+def _exp2_machine_dist(machine_size: int, step: int) -> int:
+    n_shifts = int(np.log2(machine_size - 1)) + 1 if machine_size > 1 else 1
+    return 2 ** (step % n_shifts)
+
+
+def GetExp2DynamicSendRecvMachineRanks(
+        world_size: int, local_size: int, self_rank: int, local_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Machine-level exponential-2 one-peer schedule (homogeneous clusters)."""
+    if self_rank % local_size != local_rank or world_size % local_size != 0:
+        raise ValueError("requires a homogeneous environment")
+    if world_size <= local_size:
+        raise ValueError("requires at least two machines")
+    machine_id = self_rank // local_size
+    machine_size = world_size // local_size
+    step = 0
+    while True:
+        dist = _exp2_machine_dist(machine_size, step)
+        yield [(machine_id + dist) % machine_size], [(machine_id - dist) % machine_size]
+        step += 1
+
+
+def _inner_outer_pair(world_size: int, local_size: int, rank: int, step: int,
+                      inner_dist_fn: Callable[[int, int], int],
+                      outer_dist_fn: Callable[[int], int]) -> Tuple[int, int]:
+    """Shared structure of the inner/outer schedules.
+
+    Per step, exactly one local rank per machine (``step % local_size``) talks
+    to another machine at ``outer_dist_fn(step)`` machines away (same local
+    slot); everyone else moves data around the machine-internal graph, with
+    ``inner_dist_fn(step, dist_to_outgoing)`` skipping over the outgoing rank.
+    Returns (send_rank, recv_rank).
+    """
+    num_machines = world_size // local_size
+    machine_id, local_id = divmod(rank, local_size)
+    outgoing_local = step % local_size
+
+    if local_id == outgoing_local:
+        dist = outer_dist_fn(step)
+        send = ((machine_id + dist) % num_machines) * local_size + local_id
+        recv = ((machine_id - dist) % num_machines) * local_size + local_id
+        return send, recv
+
+    fwd = inner_dist_fn(step, (outgoing_local - local_id) % local_size)
+    send = machine_id * local_size + (local_id + fwd) % local_size
+    bwd = inner_dist_fn(step, (local_id - outgoing_local) % local_size)
+    recv = machine_id * local_size + (local_id - bwd) % local_size
+    return send, recv
+
+
+def GetInnerOuterRingDynamicSendRecvRanks(
+        world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Inner-ring/outer-ring one-peer schedule."""
+    if world_size % local_size != 0:
+        raise ValueError("requires a homogeneous environment")
+    if local_size <= 2:
+        raise ValueError(
+            "needs more than 2 ranks per machine; use "
+            "hierarchical_neighbor_allreduce or GetDynamicOnePeerSendRecvRanks"
+        )
+
+    def inner(step, dist_to_out):
+        # next rank on the local ring, skipping the outgoing one
+        return 2 if dist_to_out == 1 else 1
+
+    step = 0
+    while True:
+        send, recv = _inner_outer_pair(
+            world_size, local_size, self_rank, step, inner, lambda s: 1)
+        yield [send], [recv]
+        step += 1
+
+
+def GetInnerOuterExpo2DynamicSendRecvRanks(
+        world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Inner-exp2/outer-exp2 one-peer schedule (the flagship dynamic graph)."""
+    if world_size % local_size != 0:
+        raise ValueError("requires a homogeneous environment")
+    if local_size <= 2:
+        raise ValueError(
+            "needs more than 2 ranks per machine; use "
+            "hierarchical_neighbor_allreduce or GetDynamicOnePeerSendRecvRanks"
+        )
+    num_machines = world_size // local_size
+    n_outer = int(np.log2(num_machines - 1)) + 1
+    n_inner = (int(np.log2(local_size - 2)) if local_size > 2 else 0) + 1
+
+    def inner(step, dist_to_out):
+        d = 2 ** (step % n_inner)
+        return d + 1 if d >= dist_to_out else d
+
+    def outer(step):
+        return 2 ** (step % n_outer)
+
+    step = 0
+    while True:
+        send, recv = _inner_outer_pair(
+            world_size, local_size, self_rank, step, inner, outer)
+        yield [send], [recv]
+        step += 1
+
+
+# ---------------------------------------------------------------------------
+# Global (SPMD) views: full per-step mixing matrices / ppermute offsets
+# ---------------------------------------------------------------------------
+
+GeneratorFactory = Callable[[int], Iterator[Tuple[List[int], List[int]]]]
+
+
+def dynamic_mixing_matrix(size: int, send_ranks_per_rank: List[List[int]]) -> np.ndarray:
+    """Mixing matrix for one dynamic step.
+
+    ``send_ranks_per_rank[i]`` lists where rank i pushes this step.  Receive
+    weights follow the reference convention ``1 / (num_sources + 1)`` shared
+    with the self loop (examples/pytorch_resnet.py dynamic_topology_update).
+    ``W[i, j]`` = weight of rank i's value in rank j's average.
+    """
+    W = np.zeros((size, size))
+    for src, dsts in enumerate(send_ranks_per_rank):
+        for dst in dsts:
+            W[src, dst] = 1.0
+    in_count = W.sum(axis=0)  # sources per destination, excl. self
+    W /= (in_count + 1.0)[None, :]
+    np.fill_diagonal(W, 1.0 / (in_count + 1.0))
+    return W
+
+
+def dynamic_mixing_matrices(factory: GeneratorFactory, size: int,
+                            num_steps: int) -> np.ndarray:
+    """Stack of ``[num_steps, size, size]`` mixing matrices for a schedule.
+
+    ``factory(rank)`` must return the per-rank generator (e.g.
+    ``lambda r: GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)``).
+    """
+    gens = [factory(r) for r in range(size)]
+    mats = []
+    for _ in range(num_steps):
+        sends = [next(g)[0] for g in gens]
+        mats.append(dynamic_mixing_matrix(size, sends))
+    return np.stack(mats)
+
+
+def one_peer_offsets(factory: GeneratorFactory, size: int,
+                     num_steps: int) -> np.ndarray:
+    """Per-step ppermute shift for schedules where every rank sends to the
+    same relative offset (exp2 one-peer on a circulant base graph).
+
+    Returns ``offsets[num_steps]`` with ``send_rank = (rank + offset) % size``;
+    raises if any step is not a uniform rotation (then use
+    ``dynamic_mixing_matrices`` instead).
+    """
+    gens = [factory(r) for r in range(size)]
+    offsets = []
+    for step in range(num_steps):
+        sends = [next(g)[0] for g in gens]
+        offs = {(s[0] - r) % size for r, s in enumerate(sends)}
+        if len(offs) != 1:
+            raise ValueError(
+                f"step {step}: schedule is not a uniform rotation {sends}")
+        offsets.append(offs.pop())
+    return np.asarray(offsets, dtype=np.int32)
+
+
+def schedule_period(factory: GeneratorFactory, size: int,
+                    max_period: int = 4096) -> int:
+    """Smallest p such that the schedule's send pattern repeats with period p.
+
+    Verified over a window of ``2 * max_period`` observed steps: candidate p
+    must satisfy ``sends[i] == sends[i % p]`` for every step in the window,
+    so a pattern like 1,2,1,3 cannot be mistaken for period 2 just because
+    step 2 matches step 0.
+    """
+    gens = [factory(r) for r in range(size)]
+    seq = []
+
+    def extend(to_len):
+        while len(seq) < to_len:
+            seq.append(tuple(tuple(next(g)[0]) for g in gens))
+
+    window = 64
+    while True:
+        extend(window)
+        for p in range(1, window // 2 + 1):
+            if all(seq[i] == seq[i % p] for i in range(window)):
+                # confirm over a larger horizon before accepting
+                extend(min(4 * p + 16, 2 * max_period))
+                if all(seq[i] == seq[i % p] for i in range(len(seq))):
+                    return p
+        if window >= 2 * max_period:
+            raise ValueError(f"no period found within {max_period} steps")
+        window = min(2 * window, 2 * max_period)

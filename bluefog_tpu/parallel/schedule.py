@@ -1,0 +1,198 @@
+"""Compile virtual topologies into TPU collective schedules.
+
+A BlueFog topology is a weighted digraph over ranks.  On MPI the reference
+materializes it as an ``MPI_Dist_graph`` communicator and moves every edge
+with point-to-point sends (``bluefog/common/mpi_controller.cc:419-517``).  On
+a TPU mesh the natural execution is by *circulant decomposition*: group the
+edges by ring offset ``d = (dst - src) % size``; each offset becomes one
+``jax.lax.ppermute`` over the mesh axis (riding ICI), and the weighted sum of
+the permuted values reproduces the mixing matrix exactly.  Sparse graphs
+(exp2: log2 N offsets, ring: 2, mesh-grid: 4ish) therefore cost only a few
+permutes, and XLA overlaps them with compute.
+
+Dynamic (per-step) topologies compile to a *fixed* superset of offsets with
+step-indexed weight tables, so the jitted program never changes shape and no
+recompilation happens when the graph hops (SURVEY.md §7 hard part 2).
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import networkx as nx
+
+from . import dynamic as dynamic_mod
+
+__all__ = [
+    "Shift",
+    "CompiledTopology",
+    "compile_topology",
+    "compile_weight_matrix",
+    "DynamicSchedule",
+    "compile_dynamic_schedule",
+]
+
+
+@dataclass(frozen=True, eq=False)
+class Shift:
+    """One circulant component of a topology.
+
+    ``pairs`` lists the real (src, dst) device pairs for this offset — ranks
+    not named as a destination receive zeros from ppermute, and their weight
+    is zero, so partial offsets are safe.
+    ``recv_weights[i]`` is the factor rank i applies to the value arriving
+    over this offset; ``send_weights[i]`` the factor rank i applies before
+    sending (used by dst-weighted mode; 1.0 otherwise).
+    """
+    offset: int
+    pairs: Tuple[Tuple[int, int], ...]
+    recv_weights: np.ndarray
+    send_weights: np.ndarray
+
+
+@dataclass(frozen=True, eq=False)
+class CompiledTopology:
+    """Execution plan for one static topology on a 1-D mesh axis."""
+    size: int
+    self_weights: np.ndarray          # [N]; A[i, i]
+    shifts: Tuple[Shift, ...]
+    weight_matrix: np.ndarray         # [N, N]; W[i, j] = j's weight for i's value
+    digraph: Optional[nx.DiGraph] = field(default=None)
+
+    @property
+    def offsets(self) -> Tuple[int, ...]:
+        return tuple(s.offset for s in self.shifts)
+
+    def in_neighbor_ranks(self, rank: int) -> List[int]:
+        srcs = np.nonzero(self.weight_matrix[:, rank])[0]
+        return [int(s) for s in srcs if s != rank]
+
+    def out_neighbor_ranks(self, rank: int) -> List[int]:
+        dsts = np.nonzero(self.weight_matrix[rank, :])[0]
+        return [int(d) for d in dsts if d != rank]
+
+    def in_degrees(self) -> np.ndarray:
+        off_diag = self.weight_matrix.copy()
+        np.fill_diagonal(off_diag, 0.0)
+        return (off_diag != 0).sum(axis=0)
+
+    @property
+    def is_regular(self) -> bool:
+        degs = self.in_degrees()
+        return bool((degs == degs[0]).all())
+
+
+def compile_weight_matrix(W: np.ndarray,
+                          digraph: Optional[nx.DiGraph] = None) -> CompiledTopology:
+    """Compile a mixing matrix (``W[i, j]`` = weight of i's value at j).
+
+    Every nonzero off-diagonal entry becomes a member of its offset's
+    ppermute; zero entries cost nothing.
+    """
+    W = np.asarray(W, dtype=np.float64)
+    n = W.shape[0]
+    if W.shape != (n, n):
+        raise ValueError(f"weight matrix must be square, got {W.shape}")
+
+    shifts = []
+    srcs, dsts = np.nonzero(W)
+    by_offset = {}
+    for s, d in zip(srcs, dsts):
+        if s == d:
+            continue
+        by_offset.setdefault(int((d - s) % n), []).append((int(s), int(d)))
+    for offset in sorted(by_offset):
+        pairs = tuple(sorted(by_offset[offset]))
+        recv = np.zeros(n)
+        for s, d in pairs:
+            recv[d] = W[s, d]
+        shifts.append(Shift(offset=offset, pairs=pairs,
+                            recv_weights=recv, send_weights=np.ones(n)))
+    return CompiledTopology(
+        size=n,
+        self_weights=np.diag(W).copy(),
+        shifts=tuple(shifts),
+        weight_matrix=W,
+        digraph=digraph,
+    )
+
+
+def compile_topology(topo: nx.DiGraph) -> CompiledTopology:
+    """Compile a weighted ``networkx.DiGraph`` (BlueFog convention)."""
+    return compile_weight_matrix(nx.to_numpy_array(topo), digraph=topo)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic schedules
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, eq=False)
+class DynamicSchedule:
+    """A periodic per-step topology, compiled to fixed shape.
+
+    The jitted collective receives the *step index* as data and gathers that
+    step's weights from the tables below; the offset set never changes, so
+    the XLA program is compiled once.
+
+    Attributes:
+      size: number of ranks.
+      period: schedule period T (tables repeat after T steps).
+      offsets: static tuple of ring offsets used by any step.
+      self_weights: [T, N] self weight per step per rank.
+      recv_weights: [T, n_offsets, N] weight rank i applies to data arriving
+        over offsets[k] at step t (zero when no such edge).
+      matrices: [T, N, N] the per-step mixing matrices (for reference/tests).
+    """
+    size: int
+    period: int
+    offsets: Tuple[int, ...]
+    self_weights: np.ndarray
+    recv_weights: np.ndarray
+    matrices: np.ndarray
+
+
+def compile_dynamic_schedule(
+        factory: Callable[[int], Iterator[Tuple[List[int], List[int]]]],
+        size: int,
+        period: Optional[int] = None,
+        max_period: int = 4096) -> DynamicSchedule:
+    """Compile a per-rank generator family into a :class:`DynamicSchedule`.
+
+    ``factory(rank)`` yields ``(send_ranks, recv_ranks)`` as in the reference
+    generators; weights follow the one-peer convention ``1/(in_degree + 1)``.
+    """
+    if period is None:
+        period = dynamic_mod.schedule_period(factory, size, max_period=max_period)
+    mats = dynamic_mod.dynamic_mixing_matrices(factory, size, period)
+    return compile_dynamic_matrices(mats)
+
+
+def compile_dynamic_matrices(mats: np.ndarray) -> DynamicSchedule:
+    """Compile a [T, N, N] stack of per-step mixing matrices."""
+    mats = np.asarray(mats, dtype=np.float64)
+    T, n, _ = mats.shape
+
+    offsets = sorted({
+        int((d - s) % n)
+        for t in range(T)
+        for s, d in zip(*np.nonzero(mats[t]))
+        if s != d
+    })
+    offset_index = {off: k for k, off in enumerate(offsets)}
+
+    self_w = np.stack([np.diag(mats[t]) for t in range(T)])
+    recv_w = np.zeros((T, len(offsets), n))
+    for t in range(T):
+        srcs, dsts = np.nonzero(mats[t])
+        for s, d in zip(srcs, dsts):
+            if s == d:
+                continue
+            recv_w[t, offset_index[int((d - s) % n)], d] = mats[t][s, d]
+    return DynamicSchedule(
+        size=n,
+        period=T,
+        offsets=tuple(offsets),
+        self_weights=self_w,
+        recv_weights=recv_w,
+        matrices=mats,
+    )

@@ -1,0 +1,229 @@
+"""Virtual graph topologies for decentralized averaging.
+
+Every generator returns a weighted ``networkx.DiGraph`` whose adjacency entry
+``A[i, j]`` is the weight with which rank ``j`` mixes rank ``i``'s value, i.e.
+the mixing step computes ``x_j <- sum_i A[i, j] * x_i`` (column-stochastic in
+the usual decentralized-SGD notation).  Semantics match the reference
+implementation (``bluefog/common/topology_util.py``) so that topology unit
+tests and published weight schemes (Hastings rule, exponential-2, etc.) carry
+over; the construction here is vectorized instead of row-by-row.
+
+Reference parity map (reference file:line):
+  * ExponentialTwoGraph        topology_util.py:66
+  * ExponentialGraph           topology_util.py:99
+  * SymmetricExponentialGraph  topology_util.py:128
+  * MeshGrid2DGraph            topology_util.py:160  (Hastings weights)
+  * StarGraph                  topology_util.py:214
+  * RingGraph                  topology_util.py:240
+  * FullyConnectedGraph        topology_util.py:284
+  * IsTopologyEquivalent       topology_util.py:23
+  * IsRegularGraph             topology_util.py:306
+  * GetRecvWeights/SendWeights topology_util.py:40-63
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import networkx as nx
+
+__all__ = [
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "RingGraph",
+    "FullyConnectedGraph",
+    "IsTopologyEquivalent",
+    "IsRegularGraph",
+    "GetRecvWeights",
+    "GetSendWeights",
+    "mixing_matrix",
+]
+
+
+def _from_circulant_row(row: np.ndarray) -> nx.DiGraph:
+    """Build a circulant digraph: ``A[i, j] = row[(j - i) mod n]``.
+
+    ``row`` holds the weights a rank sends to offsets ``0..n-1`` ahead of it
+    (offset 0 is the self loop).
+    """
+    n = row.shape[0]
+    offsets = (np.arange(n)[None, :] - np.arange(n)[:, None]) % n
+    return nx.from_numpy_array(row[offsets], create_using=nx.DiGraph)
+
+
+def _normalized_indicator(mask: np.ndarray) -> np.ndarray:
+    row = mask.astype(np.float64)
+    return row / row.sum()
+
+
+def _is_power_of(value: int, base: int) -> bool:
+    """Exact integer check that ``value == base ** k`` for some integer k >= 0."""
+    if not isinstance(base, int) or base <= 1:
+        raise ValueError("base must be an integer larger than 1")
+    if value <= 0:
+        return False
+    while value % base == 0:
+        value //= base
+    return value == 1
+
+
+def mixing_matrix(topo: nx.DiGraph) -> np.ndarray:
+    """Adjacency/weight matrix of a topology as a dense float64 array.
+
+    ``W = mixing_matrix(G)`` satisfies ``x_new[j] = sum_i W[i, j] * x_old[i]``
+    (i.e. column j holds rank j's receive weights).
+    """
+    return nx.to_numpy_array(topo)
+
+
+def ExponentialTwoGraph(size: int) -> nx.DiGraph:
+    """Each rank connects to ranks at distance 1, 2, 4, ... (powers of two).
+
+    Uniform weights over the self loop and the log2(size) out-edges.
+    """
+    assert size > 0
+    idx = np.arange(size)
+    # offset 0 (self) or any exact power of two
+    mask = (idx & (idx - 1)) == 0
+    return _from_circulant_row(_normalized_indicator(mask))
+
+
+def ExponentialGraph(size: int, base: int = 2) -> nx.DiGraph:
+    """Each rank connects to ranks at offsets that are exact powers of ``base``."""
+    assert size > 0
+    mask = np.array(
+        [i == 0 or _is_power_of(i, base) for i in range(size)], dtype=bool
+    )
+    return _from_circulant_row(_normalized_indicator(mask))
+
+
+def SymmetricExponentialGraph(size: int, base: int = 4) -> nx.DiGraph:
+    """Exponential graph whose offsets beyond size//2 mirror the first half."""
+    assert size > 0
+    folded = [0] + [i if i <= size // 2 else size - i for i in range(1, size)]
+    mask = np.array(
+        [i == 0 or _is_power_of(f, base) for i, f in enumerate(folded)], dtype=bool
+    )
+    return _from_circulant_row(_normalized_indicator(mask))
+
+
+def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.DiGraph:
+    """2-D mesh-grid graph with Metropolis–Hastings weights.
+
+    When ``shape`` is omitted the grid uses the two closest factors of
+    ``size`` (rows <= cols); a prime size degrades to a line.  Off-diagonal
+    weights follow the Hastings rule ``1 / max(deg_i, deg_j)`` with degrees
+    counted *including* the self loop; the self weight absorbs the remainder
+    so each row sums to one.
+    """
+    assert size > 0
+    if shape is None:
+        nrow = int(np.sqrt(size))
+        while size % nrow != 0:
+            nrow -= 1
+        shape = (nrow, size // nrow)
+    nrow, ncol = shape
+    if nrow * ncol != size:
+        raise ValueError(f"shape {shape} does not match size {size}")
+
+    adj = np.eye(size, dtype=bool)
+    for i in range(size):
+        if (i + 1) % ncol != 0:  # right neighbor within the same row
+            adj[i, i + 1] = adj[i + 1, i] = True
+        if i + ncol < size:  # neighbor in the next row
+            adj[i, i + ncol] = adj[i + ncol, i] = True
+
+    degree = adj.sum(axis=1)  # includes self
+    weights = np.zeros((size, size))
+    pair_deg = np.maximum(degree[:, None], degree[None, :])
+    off = adj & ~np.eye(size, dtype=bool)
+    weights[off] = 1.0 / pair_deg[off]
+    np.fill_diagonal(weights, 1.0 - weights.sum(axis=1))
+    return nx.from_numpy_array(weights, create_using=nx.DiGraph)
+
+
+def StarGraph(size: int, center_rank: int = 0) -> nx.DiGraph:
+    """Bidirectional star: every rank exchanges with ``center_rank``.
+
+    Leaves keep self weight ``1 - 1/size`` and give/get ``1/size`` to/from
+    the center; the center's self weight is ``1/size``.
+    """
+    assert size > 0
+    w = np.zeros((size, size))
+    np.fill_diagonal(w, 1.0 - 1.0 / size)
+    w[center_rank, :] = 1.0 / size
+    w[:, center_rank] = 1.0 / size
+    return nx.from_numpy_array(w, create_using=nx.DiGraph)
+
+
+def RingGraph(size: int, connect_style: int = 0) -> nx.DiGraph:
+    """Ring topology.
+
+    ``connect_style``: 0 = bidirectional (weights 1/3 self/left/right),
+    1 = left-connection only, 2 = right-connection only (weights 1/2 each).
+    """
+    assert size > 0
+    if connect_style not in (0, 1, 2):
+        raise ValueError("connect_style must be 0 (bi), 1 (left) or 2 (right)")
+    if size == 1:
+        return nx.from_numpy_array(np.ones((1, 1)), create_using=nx.DiGraph)
+    if size == 2:
+        return nx.from_numpy_array(np.full((2, 2), 0.5), create_using=nx.DiGraph)
+
+    row = np.zeros(size)
+    if connect_style == 0:
+        row[[0, 1, -1]] = 1.0 / 3.0
+    elif connect_style == 1:
+        row[[0, -1]] = 0.5
+    else:
+        row[[0, 1]] = 0.5
+    return _from_circulant_row(row)
+
+
+def FullyConnectedGraph(size: int) -> nx.DiGraph:
+    """Complete graph with uniform ``1/size`` weights (centralized averaging)."""
+    assert size > 0
+    return _from_circulant_row(np.full(size, 1.0 / size))
+
+
+def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:
+    """Exact equality of the two weighted adjacency matrices (not isomorphism)."""
+    if topo1 is None or topo2 is None:
+        return False
+    if topo1.number_of_nodes() != topo2.number_of_nodes():
+        return False
+    if topo1.number_of_edges() != topo2.number_of_edges():
+        return False
+    return bool(np.array_equal(nx.to_numpy_array(topo1), nx.to_numpy_array(topo2)))
+
+
+def IsRegularGraph(topo: nx.DiGraph) -> bool:
+    """True when every node has the same (in + out) degree."""
+    degrees = {d for _, d in topo.degree()}
+    return len(degrees) <= 1
+
+
+def GetRecvWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {src_rank: weight}) with which ``rank`` averages inputs."""
+    w = nx.to_numpy_array(topo)
+    neighbor_weights = {
+        int(src): float(w[src, rank])
+        for src in topo.predecessors(rank)
+        if src != rank
+    }
+    self_weight = float(w[rank, rank]) if topo.has_edge(rank, rank) else 0.0
+    return self_weight, neighbor_weights
+
+
+def GetSendWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {dst_rank: weight}) describing what ``rank`` sends out."""
+    w = nx.to_numpy_array(topo)
+    neighbor_weights = {
+        int(dst): float(w[rank, dst])
+        for dst in topo.successors(rank)
+        if dst != rank
+    }
+    self_weight = float(w[rank, rank]) if topo.has_edge(rank, rank) else 0.0
+    return self_weight, neighbor_weights

@@ -1,0 +1,1 @@
+"""Utilities: timeline tracing, logging, parameter distribution helpers."""

@@ -1,0 +1,41 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+Mirrors the reference strategy of oversubscribing localhost with
+``mpirun -np 4`` (reference Makefile:14, SURVEY.md §4): we run the *real*
+library over 8 XLA host devices, no mocks, and assert closed-form consensus
+values.  The env vars must be set before JAX initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+
+N_DEVICES = 8
+
+
+@pytest.fixture()
+def bf_ctx():
+    """Fresh default-initialized context (exp2 topology, unweighted)."""
+    context = bf.init()
+    yield context
+    bf.shutdown()
+
+
+@pytest.fixture()
+def bf_ctx_machines():
+    """Context simulating 4 machines x 2 local ranks on the 8 CPU devices."""
+    context = bf.init(nodes_per_machine=2)
+    yield context
+    bf.shutdown()

@@ -1,0 +1,93 @@
+"""Context/init/topology tests (reference parity: test/torch_basics_test.py)."""
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+
+N = 8
+
+
+def test_init_defaults():
+    bf.init()
+    try:
+        assert bf.size() == N
+        assert bf.local_size() == N
+        assert bf.machine_size() == 1
+        assert bf.rank() == 0
+        assert bf.local_rank() == 0
+        assert bf.is_homogeneous()
+        topo = bf.load_topology()
+        assert bf.IsTopologyEquivalent(topo, bf.ExponentialGraph(N))
+        assert not bf.is_topo_weighted()
+    finally:
+        bf.shutdown()
+
+
+def test_uninitialized_raises():
+    bf.shutdown()
+    with pytest.raises(RuntimeError):
+        bf.size()
+    assert not bf.is_initialized()
+
+
+def test_set_topology_roundtrip(bf_ctx):
+    for G in [bf.RingGraph(N), bf.StarGraph(N), bf.MeshGrid2DGraph(N),
+              bf.FullyConnectedGraph(N)]:
+        assert bf.set_topology(G)
+        assert bf.IsTopologyEquivalent(bf.load_topology(), G)
+
+
+def test_set_topology_wrong_size(bf_ctx):
+    with pytest.raises(ValueError):
+        bf.set_topology(bf.RingGraph(N + 1))
+
+
+def test_neighbor_ranks_match_networkx(bf_ctx):
+    bf.set_topology(bf.ExponentialTwoGraph(N))
+    topo = bf.load_topology()
+    for r in range(N):
+        ins = set(bf.in_neighbor_ranks(r))
+        outs = set(bf.out_neighbor_ranks(r))
+        assert ins == {s for s in topo.predecessors(r) if s != r}
+        assert outs == {s for s in topo.successors(r) if s != r}
+
+
+def test_machine_topology(bf_ctx_machines):
+    assert bf.size() == N
+    assert bf.local_size() == 2
+    assert bf.machine_size() == 4
+    G = bf.RingGraph(4)
+    assert bf.set_machine_topology(G)
+    assert bf.IsTopologyEquivalent(bf.load_machine_topology(), G)
+    for r in range(N):
+        m = r // 2
+        assert set(bf.in_neighbor_machine_ranks(r)) == {(m - 1) % 4, (m + 1) % 4}
+
+
+def test_machine_topology_wrong_size(bf_ctx_machines):
+    with pytest.raises(ValueError):
+        bf.set_machine_topology(bf.RingGraph(3))
+
+
+def test_weighted_flag(bf_ctx):
+    bf.set_topology(bf.MeshGrid2DGraph(N), is_weighted=True)
+    assert bf.is_topo_weighted()
+    bf.set_topology(bf.MeshGrid2DGraph(N), is_weighted=False)
+    assert not bf.is_topo_weighted()
+
+
+def test_compat_toggles(bf_ctx):
+    bf.set_skip_negotiate_stage(True)
+    assert bf.get_skip_negotiate_stage()
+    bf.set_skip_negotiate_stage(False)
+    assert not bf.nccl_built()
+    assert bf.mpi_threads_supported()
+    assert bf.unified_mpi_window_model_supported()
+    bf.suspend()
+    bf.resume()
+
+
+def test_nodes_per_machine_divisibility():
+    with pytest.raises(ValueError):
+        bf.init(nodes_per_machine=3)  # 8 % 3 != 0

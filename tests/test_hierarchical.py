@@ -1,0 +1,72 @@
+"""Hierarchical (machine-level) collective tests
+(reference parity: test/torch_hierarchical_test.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import networkx as nx
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import collectives as C
+
+N = 8
+LOCAL = 2
+MACHINES = 4
+
+
+def rank_tensor(shape=(4,)):
+    base = jnp.arange(N, dtype=jnp.float32).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape)
+
+
+def test_hierarchical_neighbor_allreduce_ring(bf_ctx_machines):
+    bf.set_machine_topology(bf.RingGraph(MACHINES), is_weighted=True)
+    x = rank_tensor((4,))
+    out = bf.hierarchical_neighbor_allreduce(x)
+
+    local_means = np.asarray(
+        [np.mean([m * LOCAL + l for l in range(LOCAL)])
+         for m in range(MACHINES)])
+    W = nx.to_numpy_array(bf.RingGraph(MACHINES))
+    machine_out = W.T @ local_means
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]),
+                                   np.full(4, machine_out[r // LOCAL]),
+                                   rtol=1e-6)
+
+
+def test_hierarchical_result_replicated_within_machine(bf_ctx_machines):
+    bf.set_machine_topology(bf.ExponentialTwoGraph(MACHINES))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(N, 6)), jnp.float32)
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+    for m in range(MACHINES):
+        for l in range(1, LOCAL):
+            np.testing.assert_allclose(out[m * LOCAL + l], out[m * LOCAL],
+                                       atol=1e-6)
+
+
+def test_hierarchical_requires_machine_topology(bf_ctx_machines):
+    with pytest.raises(RuntimeError):
+        bf.hierarchical_neighbor_allreduce(rank_tensor())
+
+
+def test_local_allreduce_shard_map(bf_ctx_machines):
+    """hierarchical_local_allreduce averages within each machine only
+    (reference is_hierarchical_local path, mpi_controller.cc:177-178)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    cx = bf_ctx_machines
+    x = rank_tensor((3,)).reshape(MACHINES, LOCAL, 3)
+
+    def shard_fn(xs):
+        return C.hierarchical_local_allreduce(xs[0, 0], cx.local_axis)[None, None]
+
+    out = jax.jit(jax.shard_map(
+        shard_fn, mesh=cx.mesh_2d,
+        in_specs=P(cx.machine_axis, cx.local_axis),
+        out_specs=P(cx.machine_axis, cx.local_axis)))(x)
+    out = np.asarray(out).reshape(N, 3)
+    for r in range(N):
+        m = r // LOCAL
+        expected = np.mean([m * LOCAL + l for l in range(LOCAL)])
+        np.testing.assert_allclose(out[r], np.full(3, expected), rtol=1e-6)

@@ -1,0 +1,215 @@
+"""Collective op tests (reference parity: test/torch_ops_test.py).
+
+Same philosophy as the reference: run the real library over 8 devices and
+assert closed-form results (e.g. neighbor averages of rank-valued tensors).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import networkx as nx
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.parallel import dynamic as dyn
+
+N = 8
+DTYPES = [jnp.float32, jnp.float64, jnp.int32]
+FLOAT_DTYPES = [jnp.float32, jnp.float64, jnp.bfloat16]
+
+
+def rank_tensor(shape=(4,), dtype=jnp.float32):
+    """Global view: rank i's slice is filled with value i."""
+    base = jnp.arange(N, dtype=dtype).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape)
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_allreduce_average(bf_ctx, dtype):
+    x = rank_tensor((3, 2), dtype)
+    out = bf.allreduce(x, average=True)
+    expected = np.full((N, 3, 2), np.mean(range(N)))
+    np.testing.assert_allclose(np.asarray(out, np.float64), expected, rtol=1e-2)
+
+
+def test_allreduce_sum(bf_ctx):
+    x = rank_tensor((5,))
+    out = bf.allreduce(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), np.full((N, 5), sum(range(N))))
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(bf_ctx, root):
+    x = rank_tensor((4,))
+    out = bf.broadcast(x, root_rank=root)
+    np.testing.assert_allclose(np.asarray(out), np.full((N, 4), root))
+
+
+def test_allgather(bf_ctx):
+    x = rank_tensor((2, 3))
+    out = bf.allgather(x)
+    assert out.shape == (N, N * 2, 3)
+    expected_slice = np.repeat(np.arange(N), 2)[:, None] * np.ones((1, 3))
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected_slice)
+
+
+def test_neighbor_allreduce_default_uniform(bf_ctx):
+    """Default topology (exp2), unweighted init => uniform 1/(indeg+1)."""
+    x = rank_tensor((4,))
+    out = bf.neighbor_allreduce(x)
+    for r in range(N):
+        srcs = bf.in_neighbor_ranks(r)
+        expected = (r + sum(srcs)) / (len(srcs) + 1)
+        np.testing.assert_allclose(np.asarray(out[r]), np.full(4, expected),
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("gen", ["ring", "meshgrid", "star", "fully"])
+def test_neighbor_allreduce_weighted_topologies(gen):
+    G = {
+        "ring": bf.RingGraph(N),
+        "meshgrid": bf.MeshGrid2DGraph(N),
+        "star": bf.StarGraph(N),
+        "fully": bf.FullyConnectedGraph(N),
+    }[gen]
+    bf.init(lambda size: G, is_weighted=True)
+    try:
+        x = rank_tensor((4,))
+        out = bf.neighbor_allreduce(x)
+        W = nx.to_numpy_array(G)
+        expected = W.T @ np.arange(N, dtype=np.float64)
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(out[r]),
+                                       np.full(4, expected[r]), rtol=1e-6)
+    finally:
+        bf.shutdown()
+
+
+def test_neighbor_allreduce_weight_matrix(bf_ctx):
+    rng = np.random.default_rng(0)
+    W = rng.uniform(size=(N, N))
+    W /= W.sum(axis=0)[None, :]
+    x = rank_tensor((3,))
+    out = bf.neighbor_allreduce(x, weight_matrix=W)
+    expected = W.T @ np.arange(N, dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-5)
+
+
+def test_neighbor_allreduce_dynamic_schedule(bf_ctx):
+    G = bf.ExponentialTwoGraph(N)
+    sched = bf.compile_dynamic_schedule(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), N)
+    x = rank_tensor((4,))
+    for step in range(2 * sched.period):
+        out = bf.neighbor_allreduce(x, sched=sched, step=step)
+        W = sched.matrices[step % sched.period]
+        expected = W.T @ np.arange(N, dtype=np.float64)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6,
+                                   err_msg=f"step {step}")
+
+
+def test_neighbor_allreduce_dynamic_matches_matrix_path(bf_ctx):
+    G = bf.ExponentialTwoGraph(N)
+    sched = bf.compile_dynamic_schedule(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), N)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(N, 5)), jnp.float32)
+    for step in range(sched.period):
+        a = bf.neighbor_allreduce(x, sched=sched, step=step)
+        b = bf.neighbor_allreduce(x, weight_matrix=sched.matrices[step])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_neighbor_allgather_ring(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    x = rank_tensor((3,))
+    out = bf.neighbor_allgather(x)
+    assert out.shape == (N, 2, 3)
+    for r in range(N):
+        srcs = sorted(bf.in_neighbor_ranks(r))
+        for slot, src in enumerate(srcs):
+            np.testing.assert_allclose(np.asarray(out[r, slot]), np.full(3, src))
+
+
+def test_neighbor_allgather_exp2(bf_ctx):
+    x = rank_tensor((2,))
+    out = bf.neighbor_allgather(x)
+    indeg = len(bf.in_neighbor_ranks(0))
+    assert out.shape == (N, indeg, 2)
+    for r in range(N):
+        srcs = sorted(bf.in_neighbor_ranks(r))
+        np.testing.assert_allclose(np.asarray(out[r, :, 0]), np.asarray(srcs))
+
+
+def test_pair_gossip_default_average(bf_ctx):
+    pairs = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    x = rank_tensor((2,))
+    out = bf.pair_gossip(x, pairs)
+    expected = [0.5, 0.5, 2.5, 2.5, 4.5, 4.5, 6.5, 6.5]
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected)
+
+
+def test_pair_gossip_weighted_and_partial(bf_ctx):
+    pairs = [(1, 6)]
+    x = rank_tensor((2,))
+    out = bf.pair_gossip(x, pairs, self_weight=0.25, pair_weight=0.75)
+    expected = np.arange(N, dtype=np.float64)
+    expected[1] = 0.25 * 1 + 0.75 * 6
+    expected[6] = 0.25 * 6 + 0.75 * 1
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected)
+
+
+def test_pair_gossip_rejects_non_matching(bf_ctx):
+    with pytest.raises(ValueError):
+        bf.pair_gossip(rank_tensor(), [(0, 1), (1, 2)])
+
+
+def test_nonblocking_roundtrip(bf_ctx):
+    x = rank_tensor((4,))
+    handle = bf.neighbor_allreduce_nonblocking(x)
+    assert isinstance(handle, int)
+    out = bf.synchronize(handle)
+    assert out.shape == (N, 4)
+    # handle is consumed
+    with pytest.raises(ValueError):
+        bf.synchronize(handle)
+
+
+def test_poll_then_wait(bf_ctx):
+    handle = bf.allreduce_nonblocking(rank_tensor((4,)))
+    # polling is allowed any number of times before synchronize
+    for _ in range(3):
+        bf.poll(handle)
+    out = bf.wait(handle)
+    assert out is not None
+
+
+def test_barrier(bf_ctx):
+    bf.barrier()  # should not raise
+
+
+def test_multiple_outstanding_handles(bf_ctx):
+    xs = [rank_tensor((3,)) * (i + 1) for i in range(4)]
+    handles = [bf.neighbor_allreduce_nonblocking(x) for x in xs]
+    outs = [bf.synchronize(h) for h in handles]
+    base = np.asarray(outs[0])
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), base * (i + 1), rtol=1e-5)
+
+
+def test_set_topology_switches_compiled_plan(bf_ctx):
+    x = rank_tensor((2,))
+    out_exp2 = bf.neighbor_allreduce(x)
+    bf.set_topology(bf.RingGraph(N))
+    out_ring = bf.neighbor_allreduce(x)
+    assert not np.allclose(np.asarray(out_exp2), np.asarray(out_ring))
+    for r in range(N):
+        expected = (r + (r - 1) % N + (r + 1) % N) / 3.0
+        np.testing.assert_allclose(np.asarray(out_ring[r]),
+                                   np.full(2, expected), rtol=1e-6)
+
+
+def test_int_dtype_allreduce_sum(bf_ctx):
+    x = rank_tensor((4,), jnp.int32)
+    out = bf.allreduce(x, average=False)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), np.full((N, 4), 28))

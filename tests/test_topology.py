@@ -1,0 +1,237 @@
+"""Topology zoo tests (reference parity: test/torch_basics_test.py topology
+cases + closed-form properties of bluefog/common/topology_util.py)."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from bluefog_tpu.parallel import topology as tu
+from bluefog_tpu.parallel import dynamic as dyn
+from bluefog_tpu.parallel.schedule import (
+    compile_topology, compile_dynamic_schedule,
+)
+
+
+ALL_SIZES = [1, 2, 3, 4, 7, 8, 12, 16]
+
+
+def _weight_matrix(G):
+    return nx.to_numpy_array(G)
+
+
+@pytest.mark.parametrize("size", ALL_SIZES)
+@pytest.mark.parametrize("gen", [
+    tu.ExponentialTwoGraph,
+    tu.ExponentialGraph,
+    tu.StarGraph,
+    tu.RingGraph,
+    tu.FullyConnectedGraph,
+    tu.MeshGrid2DGraph,
+])
+def test_rows_sum_to_one(gen, size):
+    W = _weight_matrix(gen(size))
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(size), atol=1e-12)
+
+
+@pytest.mark.parametrize("size", [4, 8, 16])
+def test_exponential_two_graph_edges(size):
+    G = tu.ExponentialTwoGraph(size)
+    for rank in range(size):
+        outs = {(r - rank) % size for r in G.successors(rank) if r != rank}
+        expected = {1 << k for k in range((size - 1).bit_length())
+                    if (1 << k) < size}
+        assert outs == expected
+
+
+def test_exponential_graph_matches_two_for_power_sizes():
+    for size in [2, 4, 8, 16]:
+        assert tu.IsTopologyEquivalent(
+            tu.ExponentialGraph(size), tu.ExponentialTwoGraph(size))
+
+
+def test_ring_graph_styles():
+    size = 8
+    W_bi = _weight_matrix(tu.RingGraph(size, 0))
+    assert W_bi[0, 1] == pytest.approx(1 / 3)
+    assert W_bi[0, size - 1] == pytest.approx(1 / 3)
+    assert W_bi[0, 0] == pytest.approx(1 / 3)
+    W_left = _weight_matrix(tu.RingGraph(size, 1))
+    assert W_left[0, size - 1] == pytest.approx(0.5)
+    assert W_left[0, 1] == 0.0
+    W_right = _weight_matrix(tu.RingGraph(size, 2))
+    assert W_right[0, 1] == pytest.approx(0.5)
+    assert W_right[0, size - 1] == 0.0
+
+
+def test_ring_small_sizes():
+    assert _weight_matrix(tu.RingGraph(1)).tolist() == [[1.0]]
+    np.testing.assert_allclose(_weight_matrix(tu.RingGraph(2)),
+                               np.full((2, 2), 0.5))
+
+
+def test_star_graph():
+    size = 8
+    W = _weight_matrix(tu.StarGraph(size))
+    for i in range(1, size):
+        assert W[i, 0] == pytest.approx(1 / size)
+        assert W[0, i] == pytest.approx(1 / size)
+        assert W[i, i] == pytest.approx(1 - 1 / size)
+    assert W[0, 0] == pytest.approx(1 / size)
+
+
+def test_meshgrid_hastings_weights_doubly_stochastic():
+    # Hastings weights make the matrix symmetric and doubly stochastic
+    for size, shape in [(4, (2, 2)), (6, (2, 3)), (12, None)]:
+        W = _weight_matrix(tu.MeshGrid2DGraph(size, shape))
+        np.testing.assert_allclose(W, W.T, atol=1e-12)
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(size), atol=1e-12)
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(size), atol=1e-12)
+
+
+def test_meshgrid_shape_mismatch():
+    with pytest.raises(ValueError):
+        tu.MeshGrid2DGraph(6, (2, 2))
+
+
+def test_is_regular_graph():
+    assert tu.IsRegularGraph(tu.RingGraph(8))
+    assert tu.IsRegularGraph(tu.ExponentialTwoGraph(8))
+    assert not tu.IsRegularGraph(tu.StarGraph(8))
+
+
+def test_is_topology_equivalent():
+    assert tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(9))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.StarGraph(8))
+    assert not tu.IsTopologyEquivalent(None, tu.RingGraph(8))
+
+
+def test_recv_send_weights():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    for rank in range(size):
+        self_w, recv = tu.GetRecvWeights(G, rank)
+        uniform = 1.0 / (len(recv) + 1)
+        assert self_w == pytest.approx(uniform)
+        for w in recv.values():
+            assert w == pytest.approx(uniform)
+        srcs = {(rank - (1 << k)) % size
+                for k in range((size - 1).bit_length()) if (1 << k) < size}
+        assert set(recv) == srcs
+
+        _, send = tu.GetSendWeights(G, rank)
+        dsts = {(rank + (1 << k)) % size
+                for k in range((size - 1).bit_length()) if (1 << k) < size}
+        assert set(send) == dsts
+
+
+def test_symmetric_exponential_graph():
+    G = tu.SymmetricExponentialGraph(12, base=4)
+    W = _weight_matrix(G)
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(12), atol=1e-12)
+    # offsets are symmetric around size/2
+    row = W[0]
+    for d in range(1, 12):
+        folded = d if d <= 6 else 12 - d
+        expect_edge = folded in (1, 4)
+        assert (row[d] > 0) == expect_edge, d
+
+
+# -- dynamic schedules -------------------------------------------------------
+
+def test_dynamic_one_peer_send_recv_consistency():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    gens = [dyn.GetDynamicOnePeerSendRecvRanks(G, r) for r in range(size)]
+    for _ in range(12):
+        sends, recvs = zip(*[next(g) for g in gens])
+        # every send must appear as the matching recv on the destination
+        for src in range(size):
+            (dst,) = sends[src]
+            assert src in recvs[dst]
+        # and recv lists must only contain actual senders
+        for dst in range(size):
+            for src in recvs[dst]:
+                assert sends[src] == [dst]
+
+
+def test_dynamic_one_peer_exp2_is_rotation():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    offsets = dyn.one_peer_offsets(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), size, 6)
+    assert list(offsets) == [1, 2, 4, 1, 2, 4]
+
+
+def test_exp2_machine_ranks():
+    world, local = 8, 2
+    gen = dyn.GetExp2DynamicSendRecvMachineRanks(world, local, 2, 0)
+    first = [next(gen) for _ in range(4)]
+    # 4 machines -> distances cycle 1, 2, 1, 2
+    assert first[0] == ([2], [0])
+    assert first[1] == ([3], [3])
+    assert first[2] == ([2], [0])
+
+
+def test_inner_outer_ring_valid_pairing():
+    world, local = 12, 3
+    gens = [dyn.GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(9):
+        sends, recvs = zip(*[next(g) for g in gens])
+        for src in range(world):
+            (dst,) = sends[src]
+            assert recvs[dst] == [src], (src, dst, sends, recvs)
+
+
+def test_inner_outer_expo2_valid_pairing():
+    world, local = 16, 4
+    gens = [dyn.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(16):
+        sends, recvs = zip(*[next(g) for g in gens])
+        for src in range(world):
+            (dst,) = sends[src]
+            assert recvs[dst] == [src], (src, dst)
+
+
+def test_dynamic_mixing_matrix_columns():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    mats = dyn.dynamic_mixing_matrices(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), size, 5)
+    for W in mats:
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(size), atol=1e-12)
+
+
+# -- schedule compilation ----------------------------------------------------
+
+def test_compile_topology_reconstructs_matrix():
+    for gen in [tu.RingGraph, tu.ExponentialTwoGraph, tu.StarGraph,
+                tu.MeshGrid2DGraph]:
+        G = gen(8)
+        topo = compile_topology(G)
+        W = np.diag(topo.self_weights).copy()
+        for shift in topo.shifts:
+            for s, d in shift.pairs:
+                W[s, d] = shift.recv_weights[d]
+        np.testing.assert_allclose(W, nx.to_numpy_array(G), atol=1e-15)
+
+
+def test_compile_topology_offsets_sparse():
+    topo = compile_topology(tu.ExponentialTwoGraph(16))
+    assert topo.offsets == (1, 2, 4, 8)
+    topo = compile_topology(tu.RingGraph(16))
+    assert topo.offsets == (1, 15)
+
+
+def test_compile_dynamic_schedule_period():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    sched = compile_dynamic_schedule(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), size)
+    assert sched.period == 3
+    assert sched.offsets == (1, 2, 4)
+    # step 0 sends over offset 1 only
+    assert np.count_nonzero(sched.recv_weights[0][0]) == size
+    assert np.count_nonzero(sched.recv_weights[0][1]) == 0
