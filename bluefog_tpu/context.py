@@ -125,6 +125,11 @@ class BlueFogContext:
 
     def set_topology(self, topo: Optional[nx.DiGraph] = None,
                      is_weighted: bool = False) -> bool:
+        from .ops import windows as _win  # local import; windows imports context
+        if _win.windows_exist():
+            raise RuntimeError(
+                "cannot change the topology while windows exist; free them "
+                "first (reference operations.cc:1286-1311)")
         if topo is None:
             topo = topology_util.ExponentialGraph(self._size)
         if topo.number_of_nodes() != self._size:

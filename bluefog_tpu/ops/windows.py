@@ -1,0 +1,454 @@
+"""One-sided "window" operations (reference parity: bluefog/torch/mpi_win_ops.cc,
+bluefog/torch/mpi_ops.py:998-1475, mpi_controller.cc:793-1390).
+
+The reference exposes MPI RMA windows: each rank owns, per window name, one
+receive buffer per in-neighbor plus its registered tensor; ``win_put/get/
+accumulate`` move data one-sidedly and ``win_update`` folds the buffers into
+the tensor under optional distributed mutexes, with per-neighbor version
+counters and an "associated P" scalar for push-sum bias correction.
+
+TPU-native design — *buffered one-sided semantics* (SURVEY.md §7 hard part
+1a): XLA collectives are bulk-synchronous, so every window op here is one
+SPMD program in which data rides ``ppermute`` into device-resident neighbor
+buffers.  Asynchrony appears as *bounded staleness*: a rank that does not
+put this step simply carries a zero row in the destination-weight matrix and
+its peers keep averaging the last value delivered into their buffers — which
+is exactly the algorithmic behavior the MPI implementation produces, minus
+unbounded delay.  Mutexes become no-ops (program order already serializes
+buffer access); versions and associated-P are real state.
+
+Per-rank ``dst_weights``/``src_weights`` dicts generalize in the global view
+to [N, N] matrices: entry (i, j) is the weight rank i applies when sending
+to / rank j applies when pulling from i.  Matrices are traced data — per-step
+dynamic windows never recompile.
+"""
+
+import functools
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..context import ctx
+from ..parallel.schedule import CompiledTopology
+from . import api as _api
+from .api import _register_handle, synchronize
+
+__all__ = [
+    "win_create", "win_free", "win_update", "win_update_then_collect",
+    "win_put", "win_put_nonblocking", "win_get", "win_get_nonblocking",
+    "win_accumulate", "win_accumulate_nonblocking",
+    "win_poll", "win_wait", "win_mutex", "win_lock",
+    "get_current_created_window_names", "get_win_version",
+    "win_associated_p", "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p", "win_fetch",
+]
+
+
+class _Window:
+    """Device-resident window state for one name."""
+
+    def __init__(self, tensor, topo: CompiledTopology, zero_init: bool):
+        cx = ctx()
+        self.topo = topo
+        self.indeg = int(topo.in_degrees()[0])
+        sharding = _api.rank_sharding()
+        self.tensor = jax.device_put(jnp.asarray(tensor), sharding)
+        shape = self.tensor.shape  # [N, *S]
+        if zero_init:
+            buf = jnp.zeros((shape[0], self.indeg) + shape[1:], self.tensor.dtype)
+        else:
+            # reference initializes neighbor buffers with the local tensor
+            # value (mpi_ops.py:1003-1006)
+            buf = jnp.broadcast_to(
+                self.tensor[:, None], (shape[0], self.indeg) + shape[1:])
+        self.buffers = jax.device_put(buf, sharding)
+        self.versions = jnp.zeros((shape[0], self.indeg), jnp.int32)
+        self.p = jnp.ones((shape[0],), jnp.float32)
+        self.p_buffers = jnp.zeros((shape[0], self.indeg), jnp.float32)
+
+
+_windows: Dict[str, _Window] = {}
+_with_associated_p = [False]
+
+
+def _slot_tables(topo: CompiledTopology) -> np.ndarray:
+    """[n_offsets, N]: receive-buffer slot of each offset at each rank
+    (in-neighbors sorted ascending), or indeg => no such edge (dropped)."""
+    from .collectives import _allgather_slots
+    return _allgather_slots(topo)
+
+
+def windows_exist() -> bool:
+    return bool(_windows)
+
+
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    """Create a window: per-in-neighbor device buffers + versions + P
+    (reference mpi_ops.py:998, mpi_controller.cc:793-866).
+
+    The topology is snapshotted at creation; like the reference
+    (operations.cc:1286-1311), changing the topology while windows exist is
+    refused by ``bf.set_topology``.
+    """
+    cx = ctx()
+    topo = cx.compiled_topology
+    if not topo.is_regular:
+        raise ValueError(
+            "windows require a regular topology (uniform in-degree) in the "
+            "SPMD build; irregular graphs would need ragged buffers")
+    tensor = jnp.asarray(tensor)
+    if tensor.shape[0] != cx.size:
+        raise ValueError(
+            f"window tensors are global-view: expected leading dim "
+            f"{cx.size}, got {tensor.shape}")
+    _windows[name] = _Window(tensor, topo, zero_init)
+    return True
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    if name is None:
+        _windows.clear()
+        return True
+    if name not in _windows:
+        return False
+    del _windows[name]
+    return True
+
+
+def get_current_created_window_names() -> List[str]:
+    return sorted(_windows.keys())
+
+
+def _window(name: str) -> _Window:
+    if name not in _windows:
+        raise ValueError(f"{name} is not found in the registered window object.")
+    return _windows[name]
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels (cached per topology/op)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=128)
+def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
+    """win_put / win_accumulate kernel.
+
+    Sends ``x * D[src, dst]`` into dst's buffer slot for src (replace or
+    add), bumps versions, optionally moves associated P with the same
+    weights, then scales the local tensor/P by self_weight
+    (mpi_controller.cc:950-1031; self scaling per mpi_ops.py:1152-1155).
+    """
+    cx = ctx()
+    size = topo.size
+    slots = _slot_tables(topo)
+    from .collectives import _rotation_pairs
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, buffers, versions, p, p_buffers, D, self_w, with_p):
+        def shard_fn(xs, bufs, vers, ps, pbufs, D_, self_w_, with_p_):
+            x_r, buf, ver, p_r, pbuf = xs[0], bufs[0], vers[0], ps[0], pbufs[0]
+            idx = lax.axis_index(cx.rank_axis)
+            ar = jnp.arange(size)
+            for k, offset in enumerate(topo.offsets):
+                send_w = D_[ar, (ar + offset) % size][idx].astype(x_r.dtype)
+                has_edge = (D_[(ar - offset) % size, ar] != 0)[idx]
+                arrived = lax.ppermute(
+                    send_w * x_r, cx.rank_axis, _rotation_pairs(size, offset))
+                slot = jnp.asarray(slots[k])[idx]
+                old = buf[slot]
+                new = arrived + old if accumulate else arrived
+                buf = buf.at[slot].set(
+                    jnp.where(has_edge, new, old), mode="drop")
+                ver = ver.at[slot].add(
+                    jnp.where(has_edge, 1, 0), mode="drop")
+                # associated P rides the same edges/weights
+                p_send = D_[ar, (ar + offset) % size][idx] * p_r
+                p_arr = lax.ppermute(
+                    p_send, cx.rank_axis, _rotation_pairs(size, offset))
+                p_old = pbuf[slot]
+                p_new = p_arr + p_old if accumulate else p_arr
+                pbuf = pbuf.at[slot].set(
+                    jnp.where(with_p_ & has_edge, p_new, p_old), mode="drop")
+            x_out = x_r * self_w_.astype(x_r.dtype)
+            p_out = jnp.where(with_p_, p_r * self_w_, p_r)
+            return (x_out[None], buf[None], ver[None], p_out[None], pbuf[None])
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, spec, spec, spec, P(), P(), P()),
+            out_specs=(spec, spec, spec, spec, spec),
+        )(x, buffers, versions, p, p_buffers, D, self_w, with_p)
+    return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=128)
+def _update_fn(topo: CompiledTopology, mesh_id: int):
+    """win_update kernel: tensor <- self_w * tensor + sum_slots U[src, i] *
+    buffer[slot]; optional buffer reset; versions of read slots -> 0;
+    associated P mixed with identical weights (torch/mpi_win_ops.cc:345-427).
+    """
+    cx = ctx()
+    size = topo.size
+    slots = _slot_tables(topo)
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, buffers, versions, p, p_buffers, U, self_w, reset, with_p):
+        def shard_fn(xs, bufs, vers, ps, pbufs, U_, self_w_, reset_, with_p_):
+            x_r, buf, ver, p_r, pbuf = xs[0], bufs[0], vers[0], ps[0], pbufs[0]
+            idx = lax.axis_index(cx.rank_axis)
+            ar = jnp.arange(size)
+            sw = self_w_[idx]  # self_w_ is the [N] vector (P() spec: unsliced)
+            out = sw.astype(x_r.dtype) * x_r
+            p_out = sw * p_r
+            for k, offset in enumerate(topo.offsets):
+                w = U_[(ar - offset) % size, ar][idx]
+                has_edge = (topo.weight_matrix[(np.arange(size) - offset) % size,
+                                               np.arange(size)] != 0)
+                edge = jnp.asarray(has_edge)[idx]
+                slot = jnp.asarray(slots[k])[idx]
+                contrib = jnp.where(edge, w, 0.0)
+                out = out + contrib.astype(x_r.dtype) * buf[slot]
+                p_out = p_out + contrib * pbuf[slot]
+                include = edge & (w != 0)
+                buf = buf.at[slot].set(
+                    jnp.where(reset_ & include, jnp.zeros_like(buf[slot]),
+                              buf[slot]), mode="drop")
+                pbuf = pbuf.at[slot].set(
+                    jnp.where(reset_ & include & with_p_, 0.0, pbuf[slot]),
+                    mode="drop")
+                ver = ver.at[slot].set(
+                    jnp.where(include, 0, ver[slot]), mode="drop")
+            p_final = jnp.where(with_p_, p_out, p_r)
+            return (out[None], buf[None], ver[None], p_final[None], pbuf[None])
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, spec, spec, spec, P(), P(), P(), P()),
+            out_specs=(spec, spec, spec, spec, spec),
+        )(x, buffers, versions, p, p_buffers, U, self_w, reset, with_p)
+    return jax.jit(wrapper)
+
+
+# ---------------------------------------------------------------------------
+# Matrices from defaults
+# ---------------------------------------------------------------------------
+
+def _out_matrix(topo: CompiledTopology,
+                weights: Optional[np.ndarray]) -> np.ndarray:
+    """Default dst matrix: 1.0 on every out-edge (mpi_ops.py:1174-1176)."""
+    if weights is not None:
+        W = np.asarray(weights, np.float64)
+        adj = topo.weight_matrix != 0
+        np.fill_diagonal(adj, False)
+        if np.any(W[~adj] != 0):
+            raise ValueError(
+                "dst/src weights may only name edges of the window's "
+                "topology (out-neighbors; self rank is not allowed)")
+        return W
+    A = (topo.weight_matrix != 0).astype(np.float64)
+    np.fill_diagonal(A, 0.0)
+    return A
+
+
+def _update_matrix(topo: CompiledTopology,
+                   self_weight, neighbor_weights):
+    """Resolve win_update weights (mpi_ops.py:1107-1135): explicit matrix, or
+    topology weights when ``is_weighted``, else uniform 1/(indeg+1)."""
+    n = topo.size
+    if (neighbor_weights is None) != (self_weight is None):
+        raise ValueError("Arguments self_weight and neighbor_weights have to "
+                         "be presented at the same time")
+    if neighbor_weights is not None:
+        U = np.asarray(neighbor_weights, np.float64)
+        adj = topo.weight_matrix != 0
+        np.fill_diagonal(adj, False)
+        if np.any(U[~adj] != 0):
+            raise ValueError(
+                "neighbor_weights may only contain ranks that belong to "
+                "in-neighbors of each rank (edges of the window topology)")
+        sw = np.broadcast_to(np.asarray(self_weight, np.float64), (n,)).copy()
+        return U, sw
+    W = topo.weight_matrix.copy()
+    sw = np.diag(W).copy()
+    np.fill_diagonal(W, 0.0)
+    return W, sw
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def win_put_nonblocking(tensor, name: str,
+                        self_weight: Optional[float] = None,
+                        dst_weights: Optional[np.ndarray] = None,
+                        require_mutex: bool = False) -> int:
+    """Put ``tensor * dst_weights[src, dst]`` into each destination's buffer
+    for ``src`` (replace), then scale the local window tensor by
+    ``self_weight`` (mpi_ops.py:1144-1209)."""
+    w = _window(name)
+    cx = ctx()
+    D = _out_matrix(w.topo, dst_weights)
+    sw = np.float32(1.0 if self_weight is None else self_weight)
+    fn = _push_fn(w.topo, False, id(cx.mesh))
+    x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+        x, w.buffers, w.versions, w.p, w.p_buffers,
+        jnp.asarray(D, jnp.float32), jnp.asarray(sw),
+        jnp.asarray(_with_associated_p[0]))
+    return _register_handle(w.tensor)
+
+
+def win_put(tensor, name: str, self_weight=None, dst_weights=None,
+            require_mutex: bool = False) -> bool:
+    win_wait(win_put_nonblocking(tensor, name, self_weight, dst_weights,
+                                 require_mutex))
+    return True
+
+
+def win_accumulate_nonblocking(tensor, name: str,
+                               self_weight: Optional[float] = None,
+                               dst_weights: Optional[np.ndarray] = None,
+                               require_mutex: bool = False) -> int:
+    """Like win_put but adds into the destination buffers (SUM only,
+    mpi_ops.py:1279-1345)."""
+    w = _window(name)
+    cx = ctx()
+    D = _out_matrix(w.topo, dst_weights)
+    sw = np.float32(1.0 if self_weight is None else self_weight)
+    fn = _push_fn(w.topo, True, id(cx.mesh))
+    x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+        x, w.buffers, w.versions, w.p, w.p_buffers,
+        jnp.asarray(D, jnp.float32), jnp.asarray(sw),
+        jnp.asarray(_with_associated_p[0]))
+    return _register_handle(w.tensor)
+
+
+def win_accumulate(tensor, name: str, self_weight=None, dst_weights=None,
+                   require_mutex: bool = False) -> bool:
+    win_wait(win_accumulate_nonblocking(tensor, name, self_weight,
+                                        dst_weights, require_mutex))
+    return True
+
+
+def win_get_nonblocking(name: str,
+                        src_weights: Optional[np.ndarray] = None,
+                        require_mutex: bool = False) -> int:
+    """Pull each in-neighbor's window tensor (scaled by ``src_weights[src,
+    dst]``) into the local buffer for that neighbor (mpi_ops.py:1215-1272).
+    """
+    w = _window(name)
+    cx = ctx()
+    G = _out_matrix(w.topo, src_weights)
+    fn = _push_fn(w.topo, False, id(cx.mesh))
+    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+        w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
+        jnp.asarray(G, jnp.float32), jnp.asarray(np.float32(1.0)),
+        jnp.asarray(_with_associated_p[0]))
+    return _register_handle(w.buffers)
+
+
+def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
+    win_wait(win_get_nonblocking(name, src_weights, require_mutex))
+    return True
+
+
+def win_update(name: str,
+               self_weight: Optional[float] = None,
+               neighbor_weights: Optional[np.ndarray] = None,
+               reset: bool = False, clone: bool = False,
+               require_mutex: bool = False):
+    """Fold the neighbor buffers into the window tensor:
+    ``t <- self_weight * t + sum_src U[src, rank] * buffer[src]``
+    (mpi_ops.py:1066-1137; torch/mpi_win_ops.cc:345-427).
+
+    ``neighbor_weights`` is the global [N, N] weight matrix (entry (src,
+    dst)); defaults to topology weights when ``bf.init(is_weighted=True)``,
+    else the uniform ``1/(in_degree+1)`` average.  Versions of the slots read
+    drop to 0; ``reset`` zeroes those buffers after the computation.
+    """
+    w = _window(name)
+    cx = ctx()
+    U, sw = _update_matrix(w.topo, self_weight, neighbor_weights)
+    fn = _update_fn(w.topo, id(cx.mesh))
+    out = fn(w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
+             jnp.asarray(U, jnp.float32), jnp.asarray(sw, jnp.float32),
+             jnp.asarray(bool(reset)), jnp.asarray(_with_associated_p[0]))
+    tensor_new = out[0]
+    if not clone:
+        w.tensor = tensor_new
+    w.buffers, w.versions, w.p, w.p_buffers = out[1], out[2], out[3], out[4]
+    return tensor_new
+
+
+def win_update_then_collect(name: str, require_mutex: bool = True):
+    """``win_update`` with self/neighbor weights 1.0 and reset=True — the
+    push-sum collect step (mpi_ops.py:1048-1064)."""
+    w = _window(name)
+    U = (w.topo.weight_matrix != 0).astype(np.float64)
+    np.fill_diagonal(U, 0.0)
+    return win_update(name, self_weight=1.0, neighbor_weights=U, reset=True,
+                      require_mutex=require_mutex)
+
+
+def win_fetch(name: str):
+    """Current global-view window tensor (the reference mutates the
+    registered torch tensor in place; JAX arrays are immutable, so read the
+    latest value here)."""
+    return _window(name).tensor
+
+
+def win_poll(handle: int) -> bool:
+    return _api.poll(handle)
+
+
+def win_wait(handle: int) -> bool:
+    synchronize(handle)
+    return True
+
+
+def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
+    """Per-in-neighbor staleness counters (mpi_ops.py:1369-1383): 0 means the
+    buffer was read/synced since the last write."""
+    w = _window(name)
+    cx = ctx()
+    r = cx.rank() if rank is None else rank
+    vers = np.asarray(w.versions)
+    srcs = sorted(w.topo.in_neighbor_ranks(r))
+    return {src: int(vers[r, slot]) for slot, src in enumerate(srcs)}
+
+
+def win_associated_p(name: str, rank: Optional[int] = None) -> float:
+    """Push-sum bias-correction scalar (mpi_ops.py:1447-1456), initialized 1."""
+    w = _window(name)
+    r = ctx().rank() if rank is None else rank
+    return float(np.asarray(w.p)[r])
+
+
+def turn_on_win_ops_with_associated_p():
+    _with_associated_p[0] = True
+
+
+def turn_off_win_ops_with_associated_p():
+    _with_associated_p[0] = False
+
+
+@contextmanager
+def win_mutex(name: str, for_self: bool = False,
+              ranks: Optional[List[int]] = None):
+    """Distributed window mutex (mpi_ops.py:1421-1445).  Bulk-synchronous
+    SPMD execution already serializes every buffer access in program order,
+    so acquisition is trivially satisfied; kept for API parity."""
+    _window(name)  # existence check, like the reference
+    yield
+
+
+@contextmanager
+def win_lock(name: str):
+    """RMA access-epoch lock (mpi_ops.py:1390-1417) — no-op for the same
+    reason as :func:`win_mutex`."""
+    _window(name)
+    yield

@@ -1,0 +1,220 @@
+"""Window-op tests (reference parity: test/torch_win_ops_test.py).
+
+Same closed-form style: rank-valued tensors, assert exact neighbor buffer
+contents, versions, associated-P behavior, and push-sum convergence.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import networkx as nx
+import pytest
+
+import bluefog_tpu as bf
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_windows():
+    yield
+    bf.win_free()
+    bf.turn_off_win_ops_with_associated_p()
+
+
+def rank_tensor(shape=(3,), dtype=jnp.float32):
+    base = jnp.arange(N, dtype=dtype).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape)
+
+
+def uniform_matrix():
+    """1/(indeg+1) update matrix for the current topology."""
+    W = nx.to_numpy_array(bf.load_topology())
+    A = (W != 0).astype(np.float64)
+    np.fill_diagonal(A, 1.0)
+    return A / A.sum(axis=0)[None, :]
+
+
+def test_win_create_free(bf_ctx):
+    x = rank_tensor()
+    assert bf.win_create(x, "w0")
+    assert bf.get_current_created_window_names() == ["w0"]
+    assert bf.win_create(x, "a1")
+    assert bf.get_current_created_window_names() == ["a1", "w0"]
+    assert bf.win_free("w0")
+    assert bf.get_current_created_window_names() == ["a1"]
+    assert not bf.win_free("nope")
+    assert bf.win_free()
+    assert bf.get_current_created_window_names() == []
+
+
+def test_set_topology_refused_while_windows_exist(bf_ctx):
+    bf.win_create(rank_tensor(), "w")
+    with pytest.raises(RuntimeError):
+        bf.set_topology(bf.RingGraph(N))
+    bf.win_free("w")
+    bf.set_topology(bf.RingGraph(N))  # now fine
+
+
+def test_update_without_put_returns_input(bf_ctx):
+    """Buffers initialize to the local tensor (zero_init=False), so a
+    win_update before any put is a weighted average of x with itself."""
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    out = bf.win_update("w")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_put_then_update_equals_neighbor_allreduce(bf_ctx):
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    out = bf.win_update("w")
+    expected = bf.neighbor_allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
+
+
+def test_put_with_dst_weights(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    D = (nx.to_numpy_array(bf.load_topology()) != 0) * 0.5
+    np.fill_diagonal(D, 0.0)
+    bf.win_put(x, "w", dst_weights=D)
+    # uniform update: 1/3 * (x + 0.5*left + 0.5*right)
+    out = np.asarray(bf.win_update("w"))
+    for r in range(N):
+        expected = (r + 0.5 * ((r - 1) % N) + 0.5 * ((r + 1) % N)) / 3.0
+        np.testing.assert_allclose(out[r], np.full(3, expected), rtol=1e-5)
+
+
+def test_put_self_weight_scales_local(bf_ctx):
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w", self_weight=0.25)
+    np.testing.assert_allclose(np.asarray(bf.win_fetch("w")),
+                               0.25 * np.asarray(x), rtol=1e-6)
+
+
+def test_accumulate_sums_into_buffers(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_accumulate(x, "w")
+    bf.win_accumulate(x, "w")
+    # each buffer now holds 2 * src value; collect sums them plus self
+    out = np.asarray(bf.win_update_then_collect("w"))
+    for r in range(N):
+        expected = r + 2 * ((r - 1) % N) + 2 * ((r + 1) % N)
+        np.testing.assert_allclose(out[r], np.full(3, expected), rtol=1e-5)
+
+
+def test_update_then_collect_resets_buffers(bf_ctx):
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    bf.win_update_then_collect("w")
+    # buffers zeroed: a second collect only sees self
+    out2 = np.asarray(bf.win_update_then_collect("w"))
+    first = np.asarray(bf.win_fetch("w"))
+    np.testing.assert_allclose(out2, first, rtol=1e-6)
+
+
+def test_win_get_pulls_neighbor_tensors(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_get("w")
+    out = np.asarray(bf.win_update("w"))
+    expected = np.asarray(bf.neighbor_allreduce(x))
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_versions_lifecycle(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    for r in range(N):
+        assert bf.get_win_version("w", r) == {(r - 1) % N: 0, (r + 1) % N: 0}
+    bf.win_put(x, "w")
+    bf.win_put(x, "w")
+    for r in range(N):
+        assert all(v == 2 for v in bf.get_win_version("w", r).values())
+    bf.win_update("w")
+    for r in range(N):
+        assert all(v == 0 for v in bf.get_win_version("w", r).values())
+
+
+def test_associated_p_initial_and_toggle(bf_ctx):
+    bf.win_create(rank_tensor(), "w")
+    for r in range(N):
+        assert bf.win_associated_p("w", r) == 1.0
+    # with the toggle off, puts do not touch P
+    bf.win_put(rank_tensor(), "w", self_weight=0.5)
+    assert bf.win_associated_p("w", 0) == 1.0
+
+
+def test_associated_p_accumulate_conserves_mass(bf_ctx):
+    """Push-sum invariant: sum of P (self + in-flight buffers) stays N."""
+    bf.set_topology(bf.RingGraph(N))
+    bf.turn_on_win_ops_with_associated_p()
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    outdeg = 2
+    w = 1.0 / (outdeg + 1)
+    D = (nx.to_numpy_array(bf.load_topology()) != 0) * w
+    np.fill_diagonal(D, 0.0)
+    for _ in range(5):
+        bf.win_accumulate(bf.win_fetch("w"), "w", self_weight=w, dst_weights=D)
+        bf.win_update_then_collect("w")
+    total_p = sum(bf.win_associated_p("w", r) for r in range(N))
+    np.testing.assert_allclose(total_p, N, rtol=1e-5)
+
+
+def test_push_sum_converges_to_average(bf_ctx):
+    """Full push-sum: x/p converges to the global mean despite the
+    column-stochastic (not doubly stochastic) mixing."""
+    bf.set_topology(bf.ExponentialTwoGraph(N))
+    bf.turn_on_win_ops_with_associated_p()
+    rng = np.random.default_rng(3)
+    x0 = jnp.asarray(rng.normal(size=(N, 4)), jnp.float32)
+    target = np.asarray(x0).mean(axis=0)
+    bf.win_create(x0, "w", zero_init=True)
+    outdeg = len(bf.out_neighbor_ranks(0))
+    w = 1.0 / (outdeg + 1)
+    D = (nx.to_numpy_array(bf.load_topology()) != 0) * w
+    np.fill_diagonal(D, 0.0)
+    for _ in range(60):
+        bf.win_accumulate(bf.win_fetch("w"), "w", self_weight=w, dst_weights=D)
+        bf.win_update_then_collect("w")
+    x = np.asarray(bf.win_fetch("w"))
+    p = np.asarray([bf.win_associated_p("w", r) for r in range(N)])
+    ratio = x / p[:, None]
+    np.testing.assert_allclose(ratio, np.broadcast_to(target, (N, 4)),
+                               atol=1e-4)
+
+
+def test_win_mutex_and_lock_contexts(bf_ctx):
+    bf.win_create(rank_tensor(), "w")
+    with bf.win_mutex("w"):
+        bf.win_update("w")
+    with bf.win_lock("w"):
+        pass
+    with pytest.raises(ValueError):
+        with bf.win_mutex("nope"):
+            pass
+
+
+def test_invalid_dst_weights_rejected(bf_ctx):
+    bf.set_topology(bf.RingGraph(N))
+    bf.win_create(rank_tensor(), "w")
+    D = np.zeros((N, N))
+    D[0, 4] = 1.0  # not a ring edge
+    with pytest.raises(ValueError):
+        bf.win_put(rank_tensor(), "w", dst_weights=D)
+
+
+def test_win_nonblocking_poll_wait(bf_ctx):
+    bf.win_create(rank_tensor(), "w")
+    h = bf.win_put_nonblocking(rank_tensor(), "w")
+    bf.win_poll(h)
+    assert bf.win_wait(h)
