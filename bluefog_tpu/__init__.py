@@ -60,6 +60,23 @@ from .ops.windows import (
     turn_off_win_ops_with_associated_p,
 )
 
+from .utils.utility import (
+    broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+)
+
+from .optim import (
+    CommunicationType,
+    DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)
+
 from .version import __version__
 
 

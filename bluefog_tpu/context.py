@@ -245,6 +245,8 @@ def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
 
 def shutdown() -> None:
     global _context
+    from .ops import windows as _win
+    _win.win_free()
     _context = None
 
 

@@ -44,8 +44,9 @@ __all__ = [
     "win_accumulate", "win_accumulate_nonblocking",
     "win_poll", "win_wait", "win_mutex", "win_lock",
     "get_current_created_window_names", "get_win_version",
-    "win_associated_p", "turn_on_win_ops_with_associated_p",
-    "turn_off_win_ops_with_associated_p", "win_fetch",
+    "win_associated_p", "win_associated_p_vector",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p", "win_fetch", "win_publish",
 ]
 
 
@@ -106,6 +107,8 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
         raise ValueError(
             f"window tensors are global-view: expected leading dim "
             f"{cx.size}, got {tensor.shape}")
+    if name in _windows:
+        return False  # duplicate name (reference returns False, mpi_ops.py:1021)
     _windows[name] = _Window(tensor, topo, zero_init)
     return True
 
@@ -174,8 +177,9 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
                 p_new = p_arr + p_old if accumulate else p_arr
                 pbuf = pbuf.at[slot].set(
                     jnp.where(with_p_ & has_edge, p_new, p_old), mode="drop")
-            x_out = x_r * self_w_.astype(x_r.dtype)
-            p_out = jnp.where(with_p_, p_r * self_w_, p_r)
+            sw = self_w_[idx]  # [N] vector, P() spec: unsliced
+            x_out = x_r * sw.astype(x_r.dtype)
+            p_out = jnp.where(with_p_, p_r * sw, p_r)
             return (x_out[None], buf[None], ver[None], p_out[None], pbuf[None])
         return jax.shard_map(
             shard_fn, mesh=cx.mesh,
@@ -236,6 +240,14 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
 # Matrices from defaults
 # ---------------------------------------------------------------------------
 
+def _self_weight_vector(size: int, self_weight) -> jnp.ndarray:
+    """Scalar or per-rank self weight -> [N] float32 vector."""
+    if self_weight is None:
+        self_weight = 1.0
+    return jnp.broadcast_to(
+        jnp.asarray(self_weight, jnp.float32), (size,))
+
+
 def _out_matrix(topo: CompiledTopology,
                 weights: Optional[np.ndarray]) -> np.ndarray:
     """Default dst matrix: 1.0 on every out-edge (mpi_ops.py:1174-1176)."""
@@ -291,7 +303,7 @@ def win_put_nonblocking(tensor, name: str,
     w = _window(name)
     cx = ctx()
     D = _out_matrix(w.topo, dst_weights)
-    sw = np.float32(1.0 if self_weight is None else self_weight)
+    sw = _self_weight_vector(w.topo.size, self_weight)
     fn = _push_fn(w.topo, False, id(cx.mesh))
     x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
     (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
@@ -317,7 +329,7 @@ def win_accumulate_nonblocking(tensor, name: str,
     w = _window(name)
     cx = ctx()
     D = _out_matrix(w.topo, dst_weights)
-    sw = np.float32(1.0 if self_weight is None else self_weight)
+    sw = _self_weight_vector(w.topo.size, self_weight)
     fn = _push_fn(w.topo, True, id(cx.mesh))
     x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
     (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
@@ -346,7 +358,7 @@ def win_get_nonblocking(name: str,
     fn = _push_fn(w.topo, False, id(cx.mesh))
     (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
         w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
-        jnp.asarray(G, jnp.float32), jnp.asarray(np.float32(1.0)),
+        jnp.asarray(G, jnp.float32), _self_weight_vector(w.topo.size, None),
         jnp.asarray(_with_associated_p[0]))
     return _register_handle(w.buffers)
 
@@ -378,8 +390,11 @@ def win_update(name: str,
              jnp.asarray(U, jnp.float32), jnp.asarray(sw, jnp.float32),
              jnp.asarray(bool(reset)), jnp.asarray(_with_associated_p[0]))
     tensor_new = out[0]
-    if not clone:
-        w.tensor = tensor_new
+    if clone:
+        # pure peek: no window state (tensor, buffers, versions, P) commits,
+        # keeping x and its associated P consistent
+        return tensor_new
+    w.tensor = tensor_new
     w.buffers, w.versions, w.p, w.p_buffers = out[1], out[2], out[3], out[4]
     return tensor_new
 
@@ -392,6 +407,14 @@ def win_update_then_collect(name: str, require_mutex: bool = True):
     np.fill_diagonal(U, 0.0)
     return win_update(name, self_weight=1.0, neighbor_weights=U, reset=True,
                       require_mutex=require_mutex)
+
+
+def win_publish(name: str, tensor) -> None:
+    """Replace the local window tensor without any communication (the
+    reference's registered tensor aliases the torch parameter, so local
+    mutations are implicit there; JAX needs an explicit write)."""
+    w = _window(name)
+    w.tensor = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
 
 
 def win_fetch(name: str):
@@ -419,6 +442,12 @@ def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
     vers = np.asarray(w.versions)
     srcs = sorted(w.topo.in_neighbor_ranks(r))
     return {src: int(vers[r, slot]) for slot, src in enumerate(srcs)}
+
+
+def win_associated_p_vector(name: str):
+    """The [N] device array of associated-P scalars (on-device fast path for
+    push-sum de-biasing; avoids per-rank host syncs)."""
+    return _window(name).p
 
 
 def win_associated_p(name: str, rank: Optional[int] = None) -> float:

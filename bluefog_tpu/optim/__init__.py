@@ -1,2 +1,16 @@
-"""Distributed optimizer wrappers (not yet implemented — this package will
-hold the CTA/ATC/AWC, gradient-allreduce, and window/push-sum strategies)."""
+"""Distributed optimizer wrappers: the nine reference strategies
+(gradient-allreduce, allreduce/neighbor/hierarchical CTA, ATC, AWC,
+win-put, pull-get, push-sum) over optax base transformations."""
+
+from .strategies import CommunicationType
+from .wrappers import (
+    DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)

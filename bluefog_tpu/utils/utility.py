@@ -1,0 +1,49 @@
+"""Parameter/state distribution helpers (reference parity:
+``bluefog/torch/utility.py``).
+
+The reference walks torch ``state_dict``s parameter-by-parameter and
+broadcasts each through the C layer (utility.py:26-218, including the
+scalar-by-scalar optimizer-state reconstruction).  With pytrees this
+collapses to a tree_map over one collective.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import api as _api
+
+__all__ = [
+    "broadcast_parameters",
+    "allreduce_parameters",
+    "broadcast_optimizer_state",
+]
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0):
+    """Replicate ``root_rank``'s slice of every leaf to all ranks
+    (reference utility.py:26 — run once before training so all ranks start
+    from identical weights)."""
+    return jax.tree.map(lambda p: _api.broadcast(p, root_rank), params)
+
+
+def allreduce_parameters(params: Any):
+    """Replace every leaf with its cross-rank average (utility.py:58 —
+    used to force consensus, e.g. before evaluation)."""
+    return jax.tree.map(lambda p: _api.allreduce(p, average=True), params)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0):
+    """Broadcast optimizer state (utility.py:89-218).  The reference must
+    reconstruct the torch state dict scalar-by-scalar; optax state is a
+    pytree of [N, ...] arrays, so the same tree broadcast applies.  Non-array
+    leaves (None, callables, empty states) pass through unchanged."""
+    def bcast(leaf):
+        if leaf is None:
+            return None
+        arr = jnp.asarray(leaf)
+        if arr.ndim == 0 or arr.shape[0] != _api.ctx().size:
+            return leaf  # replicated/static leaf — nothing to distribute
+        return _api.broadcast(arr, root_rank)
+    return jax.tree.map(bcast, opt_state)

@@ -218,3 +218,27 @@ def test_win_nonblocking_poll_wait(bf_ctx):
     h = bf.win_put_nonblocking(rank_tensor(), "w")
     bf.win_poll(h)
     assert bf.win_wait(h)
+
+
+def test_win_create_duplicate_name_returns_false(bf_ctx):
+    assert bf.win_create(rank_tensor(), "dup")
+    assert not bf.win_create(rank_tensor(), "dup")
+
+
+def test_shutdown_clears_windows(bf_ctx):
+    bf.win_create(rank_tensor(), "w")
+    bf.shutdown()
+    context = bf.init()  # must not raise the windows-exist guard
+    assert bf.get_current_created_window_names() == []
+
+
+def test_win_update_clone_commits_nothing(bf_ctx):
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    before_versions = bf.get_win_version("w", 0)
+    peek = bf.win_update("w", clone=True)
+    assert bf.get_win_version("w", 0) == before_versions
+    np.testing.assert_allclose(np.asarray(bf.win_fetch("w")), np.asarray(x))
+    committed = bf.win_update("w")
+    np.testing.assert_allclose(np.asarray(peek), np.asarray(committed))
