@@ -1,0 +1,94 @@
+"""Headline benchmark: ResNet-50 decentralized training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference's published ResNet-50 number is
+4310.6 img/sec total on 16x V100 with --batch-size 64 and the
+neighbor_allreduce optimizer => 269.4 img/sec per accelerator.  We report
+per-chip throughput of the same workload (ResNet-50, batch 64/rank,
+decentralized neighbor-averaging train step, synthetic data) so the ratio is
+per-accelerator: value / 269.4.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.models.resnet import ResNet50
+
+BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    batches_per_iter = int(os.environ.get("BENCH_BATCHES_PER_ITER", "5"))
+
+    bf.init()
+    n = bf.size()
+
+    sched = None
+    if n > 1:
+        topo = bf.load_topology()
+        sched = bf.compile_dynamic_schedule(
+            lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    base = optax.sgd(0.01, momentum=0.9)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))
+    step_fn = T.make_train_step(model, base,
+                                communication="neighbor_allreduce",
+                                sched=sched)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, batch, image, image, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, size=(n, batch)))
+
+    step = 0
+    loss = None
+    for _ in range(warmup):
+        variables, opt_state, loss = step_fn(
+            variables, opt_state, (x, y), jnp.int32(step))
+        step += 1
+    if loss is not None:
+        # scalar fetch: reliable execution barrier (axon's
+        # block_until_ready can return before remote execution completes)
+        _ = float(loss)
+
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(batches_per_iter):
+            variables, opt_state, loss = step_fn(
+                variables, opt_state, (x, y), jnp.int32(step))
+            step += 1
+        _ = float(loss)  # scalar fetch as execution barrier
+        dt = time.perf_counter() - t0
+        rates.append(batches_per_iter * batch * n / dt)
+
+    total = float(np.mean(rates))
+    per_chip = total / n
+    print(json.dumps({
+        "metric": "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_ACCEL, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

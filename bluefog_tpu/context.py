@@ -247,6 +247,7 @@ def shutdown() -> None:
     global _context
     from .ops import windows as _win
     _win.win_free()
+    _win.turn_off_win_ops_with_associated_p()
     _context = None
 
 

@@ -1,0 +1,27 @@
+"""LeNet-5 for MNIST (reference parity: examples/pytorch_mnist.py's Net)."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["LeNet"]
+
+
+class LeNet(nn.Module):
+    """Conv(20) -> pool -> Conv(50) -> pool -> Dense(500) -> Dense(10),
+    matching the reference example's architecture shape-for-shape (NHWC)."""
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(50, (5, 5), dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(500, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,30 @@
+"""Small MLP / logistic-regression models used by tests and the
+optimization example (reference parity: examples/pytorch_optimization.py)."""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["MLP", "LogisticRegression"]
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (64, 64)
+    num_outputs: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.num_outputs, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+class LogisticRegression(nn.Module):
+    num_outputs: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.Dense(self.num_outputs)(x.reshape((x.shape[0], -1)))

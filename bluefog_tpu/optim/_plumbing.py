@@ -1,0 +1,44 @@
+"""Shared shard_map plumbing for global-view steppers.
+
+Both the optimizer wrappers and the train-step builder run per-rank cores
+inside ``shard_map`` over either the flat ``rank`` mesh or the 2-D
+``(machine, local)`` mesh; this module is the single home for the
+wrap/unwrap and [N] <-> [M, L] reshaping that entails.
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+class MeshPlumbing(NamedTuple):
+    mesh: Any
+    spec: Any
+    unwrap: Callable    # strip the per-shard leading singleton axis/axes
+    rewrap: Callable    # restore them on outputs
+    reshape_in: Callable   # [N, ...] -> mesh-shaped leading dims
+    reshape_out: Callable  # and back
+
+
+def mesh_plumbing(cx, hierarchical: bool) -> MeshPlumbing:
+    if hierarchical:
+        msize, lsize = cx.machine_size, cx.local_size
+        return MeshPlumbing(
+            mesh=cx.mesh_2d,
+            spec=P(cx.machine_axis, cx.local_axis),
+            unwrap=lambda t: jax.tree.map(lambda a: a[0, 0], t),
+            rewrap=lambda t: jax.tree.map(lambda a: a[None, None], t),
+            reshape_in=lambda t: jax.tree.map(
+                lambda a: a.reshape((msize, lsize) + a.shape[1:]), t),
+            reshape_out=lambda t: jax.tree.map(
+                lambda a: a.reshape((msize * lsize,) + a.shape[2:]), t),
+        )
+    return MeshPlumbing(
+        mesh=cx.mesh,
+        spec=P(cx.rank_axis),
+        unwrap=lambda t: jax.tree.map(lambda a: a[0], t),
+        rewrap=lambda t: jax.tree.map(lambda a: a[None], t),
+        reshape_in=lambda t: t,
+        reshape_out=lambda t: t,
+    )

@@ -68,17 +68,53 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     raise ValueError(f"Unsupported CommunicationType {comm_type}")
 
 
-def gradient_allreduce_step(base: optax.GradientTransformation, axis_name):
+def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
+                            accumulate_steps: int = 1):
     """Horovod-style synchronous data parallelism
-    (reference _DistributedOptimizer, optimizers.py:166-294)."""
+    (reference _DistributedOptimizer, optimizers.py:166-294).
+
+    ``accumulate_steps`` implements ``backward_passes_per_step``
+    (optimizers.py:45-48): gradients accumulate locally for k calls and the
+    averaged update applies on every k-th — parameters never see raw local
+    gradients, so ranks stay in lockstep.  With k > 1 the optimizer state is
+    ``{"base": ..., "accum": ...}`` (see ``grad_accum_init``).
+    """
+    if accumulate_steps <= 1:
+        def step_fn(params, grads, opt_state, step=0):
+            g = jax.tree.map(
+                lambda x: C.allreduce(x, axis_name, average=True), grads)
+            updates, opt_state = base.update(g, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+        return step_fn
+
+    k = int(accumulate_steps)
 
     def step_fn(params, grads, opt_state, step=0):
-        g = jax.tree.map(lambda x: C.allreduce(x, axis_name, average=True),
-                         grads)
-        updates, opt_state = base.update(g, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+        accum = jax.tree.map(jnp.add, opt_state["accum"], grads)
+        do_comm = (jnp.asarray(step) % k) == (k - 1)
+
+        def comm_branch(p, acc, bs):
+            g = jax.tree.map(
+                lambda x: C.allreduce(x / k, axis_name, average=True), acc)
+            updates, bs_new = base.update(g, bs, p)
+            p_new = optax.apply_updates(p, updates)
+            return p_new, jax.tree.map(jnp.zeros_like, acc), bs_new
+
+        def local_branch(p, acc, bs):
+            return p, acc, bs
+
+        p_new, accum_new, base_new = jax.lax.cond(
+            do_comm, comm_branch, local_branch, params, accum,
+            opt_state["base"])
+        return p_new, {"base": base_new, "accum": accum_new}
 
     return step_fn
+
+
+def grad_accum_init(base: optax.GradientTransformation, params):
+    """Per-rank init for the accumulating gradient-allreduce state."""
+    return {"base": base.init(params),
+            "accum": jax.tree.map(jnp.zeros_like, params)}
 
 
 def consensus_step(base: optax.GradientTransformation,

@@ -34,6 +34,7 @@ from ..ops import api as _api
 from ..ops import windows as W
 from ..parallel.schedule import DynamicSchedule
 from . import strategies as S
+from ._plumbing import mesh_plumbing
 
 __all__ = [
     "DistributedGradientAllreduceOptimizer",
@@ -49,22 +50,6 @@ __all__ = [
 ]
 
 CommunicationType = S.CommunicationType
-
-
-def _unwrap(tree):
-    return jax.tree.map(lambda a: a[0], tree)
-
-
-def _rewrap(tree):
-    return jax.tree.map(lambda a: a[None], tree)
-
-
-def _unwrap2(tree):
-    return jax.tree.map(lambda a: a[0, 0], tree)
-
-
-def _rewrap2(tree):
-    return jax.tree.map(lambda a: a[None, None], tree)
 
 
 class _JittedStrategyOptimizer:
@@ -88,6 +73,8 @@ class _JittedStrategyOptimizer:
         """Base optimizer state, batched over the rank axis (so scalar state
         like momentum/count exists per rank, matching N independent
         reference processes)."""
+        if self.gradient_allreduce and self.k > 1:
+            return jax.vmap(lambda p: S.grad_accum_init(self.base, p))(params)
         return jax.vmap(self.base.init)(params)
 
     def _build(self, key):
@@ -102,7 +89,8 @@ class _JittedStrategyOptimizer:
             machine_topo = cx.compiled_machine_topology
 
         if self.gradient_allreduce:
-            step_core = S.gradient_allreduce_step(self.base, cx.rank_axis)
+            step_core = S.gradient_allreduce_step(
+                self.base, cx.rank_axis, accumulate_steps=self.k)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
@@ -110,37 +98,25 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo)
-        step_core = S.with_local_steps(
-            step_core, S.local_sgd_like_step(self.base), self.k)
+        if not self.gradient_allreduce:  # grad-allreduce accumulates internally
+            step_core = S.with_local_steps(
+                step_core, S.local_sgd_like_step(self.base), self.k)
 
-        if hierarchical:
-            mesh, spec = cx.mesh_2d, P(cx.machine_axis, cx.local_axis)
-            unwrap, rewrap = _unwrap2, _rewrap2
-            msize, lsize = cx.machine_size, cx.local_size
-
-            def reshape_in(t):
-                return jax.tree.map(
-                    lambda a: a.reshape((msize, lsize) + a.shape[1:]), t)
-
-            def reshape_out(t):
-                return jax.tree.map(
-                    lambda a: a.reshape((msize * lsize,) + a.shape[2:]), t)
-        else:
-            mesh, spec = cx.mesh, P(cx.rank_axis)
-            unwrap, rewrap = _unwrap, _rewrap
-            reshape_in = reshape_out = lambda t: t
+        pl = mesh_plumbing(cx, hierarchical)
 
         def stepper(params, grads, opt_state, step_idx):
             def shard_fn(p, g, st, si):
-                p_new, st_new = step_core(unwrap(p), unwrap(g), unwrap(st), si)
-                return rewrap(p_new), rewrap(st_new)
-            p2, g2, st2 = reshape_in(params), reshape_in(grads), reshape_in(opt_state)
+                p_new, st_new = step_core(
+                    pl.unwrap(p), pl.unwrap(g), pl.unwrap(st), si)
+                return pl.rewrap(p_new), pl.rewrap(st_new)
+            p2, g2, st2 = (pl.reshape_in(params), pl.reshape_in(grads),
+                           pl.reshape_in(opt_state))
             p_out, st_out = jax.shard_map(
-                shard_fn, mesh=mesh,
-                in_specs=(spec, spec, spec, P()),
-                out_specs=(spec, spec),
+                shard_fn, mesh=pl.mesh,
+                in_specs=(pl.spec, pl.spec, pl.spec, P()),
+                out_specs=(pl.spec, pl.spec),
             )(p2, g2, st2, step_idx)
-            return reshape_out(p_out), reshape_out(st_out)
+            return pl.reshape_out(p_out), pl.reshape_out(st_out)
 
         return jax.jit(stepper)
 
@@ -325,10 +301,26 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         self.dst_weights = A * self.alpha[:, None]
         return super().init(params, zero_init=True)
 
+    def _debias(self, tree):
+        leaves = []
+        for name, leaf in zip(self._names, jax.tree.leaves(tree)):
+            p = W.win_associated_p_vector(name)
+            shape = (-1,) + (1,) * (leaf.ndim - 1)
+            leaves.append(leaf / p.reshape(shape).astype(leaf.dtype))
+        return jax.tree.unflatten(jax.tree.structure(tree), leaves)
+
     def step(self, params, grads, opt_state, step: int = 0):
         self._require_init()
         if not self._should_communicate(step):
-            return self._apply_base(params, grads, opt_state, step)
+            # local step: adapt the *biased* window iterate so the update
+            # survives the next collect (gradients are at the de-biased view)
+            biased = jax.tree.unflatten(
+                jax.tree.structure(params),
+                [W.win_fetch(name) for name in self._names])
+            adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
+            for name, leaf in zip(self._names, jax.tree.leaves(adapted)):
+                W.win_publish(name, leaf)
+            return self._debias(adapted), opt_state
         # biased iterates live in the windows; `params` is the de-biased view
         biased = jax.tree.unflatten(
             jax.tree.structure(params),

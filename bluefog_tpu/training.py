@@ -1,0 +1,148 @@
+"""End-to-end SPMD train-step builder.
+
+The reference overlaps communication with compute via torch forward/backward
+hooks inside its optimizers (optimizers.py:354-414).  The TPU-native
+equivalent is structural: build ONE jitted program containing forward,
+backward, the decentralized exchange, and the optimizer update — XLA then
+schedules the ppermute traffic concurrently with the update math, and every
+step is a single dispatch.
+
+Data layout: global view.  Parameters' leaves are [N, *S] (one replica per
+rank, sharded over the mesh); batches are [N, B_local, ...].  BatchNorm
+statistics stay rank-local like the reference's torch buffers (only
+``broadcast_parameters`` ever syncs them).
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .context import ctx
+from .optim import strategies as S
+from .optim._plumbing import mesh_plumbing
+from .parallel.schedule import DynamicSchedule
+
+__all__ = ["create_train_state", "make_train_step", "cross_entropy_loss",
+           "replicate_to_ranks"]
+
+
+def cross_entropy_loss(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def replicate_to_ranks(tree, size: Optional[int] = None):
+    """Tile a single-replica pytree to the global view [N, ...]."""
+    n = size if size is not None else ctx().size
+    return jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n,) + a.shape),
+                        tree)
+
+
+def create_train_state(model, base_opt: optax.GradientTransformation,
+                       rng, sample_input, train: bool = True):
+    """Initialize (variables, opt_state) in global view.
+
+    All ranks start from the same weights, matching the reference's
+    ``bf.broadcast_parameters(model.state_dict(), root_rank=0)`` pattern.
+    """
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    gparams = replicate_to_ranks(params)
+    gextra = replicate_to_ranks(extra)
+    opt_state = jax.vmap(base_opt.init)(gparams)
+    return {"params": gparams, **gextra}, opt_state
+
+
+def make_train_step(model,
+                    base_opt: optax.GradientTransformation,
+                    loss_fn: Callable = cross_entropy_loss,
+                    communication: str = "neighbor_allreduce",
+                    atc: bool = False,
+                    sched: Optional[DynamicSchedule] = None,
+                    num_steps_per_communication: int = 1,
+                    donate: bool = True):
+    """Build the jitted global train step.
+
+    ``communication``: one of ``neighbor_allreduce`` (default, decentralized
+    CTA), ``allreduce`` (CTA on weights), ``gradient_allreduce`` (Horovod
+    style), ``hierarchical_neighbor_allreduce``, ``empty`` (local only).
+
+    Returns ``train_step(variables, opt_state, batch, step) ->
+    (variables, opt_state, loss)`` where ``batch = (x, y)`` with leading
+    [N, B_local] dims and ``loss`` is the cross-rank mean.
+    """
+    cx = ctx()
+    hierarchical = communication == "hierarchical_neighbor_allreduce"
+    grad_ar = communication == "gradient_allreduce"
+    comm_type = {
+        "neighbor_allreduce": S.CommunicationType.neighbor_allreduce,
+        "allreduce": S.CommunicationType.allreduce,
+        "hierarchical_neighbor_allreduce":
+            S.CommunicationType.hierarchical_neighbor_allreduce,
+        "gradient_allreduce": S.CommunicationType.empty,
+        "empty": S.CommunicationType.empty,
+    }[communication]
+
+    topo = cx.compiled_topology if (
+        comm_type == S.CommunicationType.neighbor_allreduce and sched is None
+    ) else None
+    machine_topo = cx.compiled_machine_topology if hierarchical else None
+
+    if grad_ar:
+        if num_steps_per_communication > 1:
+            raise ValueError(
+                "gradient accumulation (num_steps_per_communication > 1 with "
+                "gradient_allreduce) needs the accumulator state — use "
+                "bf.DistributedGradientAllreduceOptimizer instead")
+        core = S.gradient_allreduce_step(base_opt, cx.rank_axis)
+    else:
+        builder = S.atc_step if atc else S.consensus_step
+        core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
+                       sched=sched,
+                       machine_axes=(cx.machine_axis, cx.local_axis),
+                       machine_topo=machine_topo)
+    core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
+                              num_steps_per_communication)
+
+    pl = mesh_plumbing(cx, hierarchical)
+
+    def stepper(variables, opt_state, batch, step_idx):
+        def shard_fn(vars_s, opt_s, batch_s, si):
+            v = pl.unwrap(vars_s)
+            st = pl.unwrap(opt_s)
+            x, y = pl.unwrap(batch_s)
+            params = v["params"]
+            extra = {k: s for k, s in v.items() if k != "params"}
+
+            def local_loss(p):
+                out = model.apply({"params": p, **extra}, x, train=True,
+                                  mutable=list(extra.keys()) or False)
+                if extra:
+                    logits, new_extra = out
+                else:
+                    logits, new_extra = out, {}
+                return loss_fn(logits, y), new_extra
+
+            (loss, new_extra), grads = jax.value_and_grad(
+                local_loss, has_aux=True)(params)
+            params_new, st_new = core(params, grads, st, si)
+            mean_loss = jax.lax.pmean(
+                loss, cx.rank_axis if not hierarchical
+                else (cx.machine_axis, cx.local_axis))
+            v_new = {"params": params_new, **new_extra}
+            return pl.rewrap(v_new), pl.rewrap(st_new), mean_loss
+
+        v2, o2 = pl.reshape_in(variables), pl.reshape_in(opt_state)
+        b2 = pl.reshape_in(batch)
+        v_out, o_out, loss = jax.shard_map(
+            shard_fn, mesh=pl.mesh,
+            in_specs=(pl.spec, pl.spec, pl.spec, P()),
+            out_specs=(pl.spec, pl.spec, P()),
+        )(v2, o2, b2, step_idx)
+        return pl.reshape_out(v_out), pl.reshape_out(o_out), loss
+
+    return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())

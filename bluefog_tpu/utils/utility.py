@@ -42,7 +42,10 @@ def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0):
     def bcast(leaf):
         if leaf is None:
             return None
-        arr = jnp.asarray(leaf)
+        try:
+            arr = jnp.asarray(leaf)
+        except TypeError:
+            return leaf  # callables/strings etc. pass through as documented
         if arr.ndim == 0 or arr.shape[0] != _api.ctx().size:
             return leaf  # replicated/static leaf — nothing to distribute
         return _api.broadcast(arr, root_rank)

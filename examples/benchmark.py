@@ -1,0 +1,110 @@
+"""Synthetic throughput benchmark (reference parity:
+examples/pytorch_benchmark.py — same protocol: synthetic data, warm-up
+batches, timed iterations, img/sec mean +- stdev).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.models import resnet as resnet_mod
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="BlueFog-TPU synthetic benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--model", default="ResNet50")
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="per-rank batch size")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=5)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
+                        choices=["neighbor_allreduce", "gradient_allreduce",
+                                 "allreduce", "hierarchical_neighbor_allreduce",
+                                 "empty"])
+    parser.add_argument("--atc-style", action="store_true")
+    parser.add_argument("--disable-dynamic-topology", action="store_true")
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--profile-dir", default=None,
+                        help="write an XLA profiler trace here")
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    if args.dist_optimizer == "hierarchical_neighbor_allreduce":
+        bf.set_machine_topology(bf.ExponentialTwoGraph(bf.machine_size()))
+
+    sched = None
+    if not args.disable_dynamic_topology and n > 1 \
+            and args.dist_optimizer == "neighbor_allreduce":
+        topo = bf.load_topology()
+        sched = bf.compile_dynamic_schedule(
+            lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model_cls = getattr(resnet_mod, args.model)
+    model = model_cls(num_classes=1000, dtype=dtype)
+
+    base = optax.sgd(0.01, momentum=0.9)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3))
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), sample)
+    step_fn = T.make_train_step(model, base,
+                                communication=args.dist_optimizer,
+                                atc=args.atc_style, sched=sched)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(
+        size=(n, args.batch_size, args.image_size, args.image_size, 3)),
+        jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, size=(n, args.batch_size)))
+
+    print(f"Model: {args.model}  batch/rank: {args.batch_size}  "
+          f"ranks: {n}  dtype: {args.dtype}  opt: {args.dist_optimizer}"
+          f"{' (dynamic)' if sched is not None else ''}")
+
+    step = 0
+    for _ in range(args.num_warmup_batches):
+        variables, opt_state, loss = step_fn(
+            variables, opt_state, (x, y), jnp.int32(step))
+        step += 1
+    jax.block_until_ready(loss)
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
+    rates = []
+    for it in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            variables, opt_state, loss = step_fn(
+                variables, opt_state, (x, y), jnp.int32(step))
+            step += 1
+        _ = float(loss)  # scalar fetch as execution barrier
+        dt = time.perf_counter() - t0
+        rate = args.num_batches_per_iter * args.batch_size * n / dt
+        rates.append(rate)
+        print(f"Iter #{it}: {rate:.1f} img/sec total")
+
+    mean, std = float(np.mean(rates)), float(np.std(rates))
+    print(f"Img/sec per rank: {mean / n:.1f} +- {2 * std / n:.1f}")
+    print(f"Total img/sec on {n} rank(s): {mean:.1f} +- {2 * std:.1f}")
+
+
+if __name__ == "__main__":
+    main()

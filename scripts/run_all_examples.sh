@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Integration gate: smoke-run every example x optimizer combination on the
+# virtual 8-device CPU mesh (reference parity: test/test_all_example.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+run() {
+    local name="$1"; shift
+    echo "=== $name ==="
+    timeout 300 python - "$@" <<PYEOF
+import jax; jax.config.update("jax_platforms", "cpu")
+import runpy, sys
+script = sys.argv[1]
+sys.argv = sys.argv[1:]
+runpy.run_path(script, run_name="__main__")
+PYEOF
+}
+
+run consensus-static   examples/average_consensus.py --max-iters 60 --data-size 1000
+run consensus-dynamic  examples/average_consensus.py --max-iters 80 --data-size 1000 --enable-dynamic-topology
+run opt-nar            examples/optimization.py --max-iters 300
+run opt-atc            examples/optimization.py --max-iters 300 --method atc
+run opt-pushsum        examples/optimization.py --max-iters 300 --method push_sum
+run opt-gradar         examples/optimization.py --max-iters 300 --method gradient_allreduce
+run mnist-nar          examples/mnist.py --epochs 1 --batch-size 128
+run mnist-gradar       examples/mnist.py --epochs 1 --batch-size 128 --dist-optimizer gradient_allreduce --disable-dynamic-topology
+run mnist-atc          examples/mnist.py --epochs 1 --batch-size 128 --atc-style
+run resnet-tiny        examples/resnet.py --model ResNet18 --epochs 1 --steps-per-epoch 4 --batch-size 4 --image-size 32 --dtype float32
+run bench-tiny         examples/benchmark.py --model ResNet18 --batch-size 4 --image-size 64 --num-iters 2 --num-batches-per-iter 2 --num-warmup-batches 2 --dtype float32
+
+echo "ALL EXAMPLES PASSED"

@@ -1,0 +1,122 @@
+"""End-to-end train-step tests: models + strategies in one jitted SPMD
+program (the integration layer examples/bench/graft entry rely on)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.models.lenet import LeNet
+from bluefog_tpu.models.mlp import MLP
+from bluefog_tpu.models.resnet import ResNet18
+
+N = 8
+
+
+def make_batch(rng, n=N, b=4, shape=(28, 28, 1), classes=10):
+    x = jnp.asarray(rng.normal(size=(n, b) + shape), jnp.float32)
+    y = jnp.asarray(rng.integers(0, classes, size=(n, b)))
+    return x, y
+
+
+def train_some(model, communication, steps=6, sched=None, atc=False,
+               sample_shape=(1, 28, 28, 1), batch_shape=(28, 28, 1)):
+    base = optax.sgd(0.05, momentum=0.9)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros(sample_shape))
+    step_fn = T.make_train_step(model, base, communication=communication,
+                                sched=sched, atc=atc, donate=False)
+    rng = np.random.default_rng(0)
+    x, y = make_batch(rng, shape=batch_shape)
+    losses = []
+    for i in range(steps):
+        variables, opt_state, loss = step_fn(
+            variables, opt_state, (x, y), jnp.int32(i))
+        losses.append(float(loss))
+    return variables, losses
+
+
+def test_create_train_state_global_view(bf_ctx):
+    model = MLP()
+    variables, opt_state = T.create_train_state(
+        model, optax.adam(1e-3), jax.random.key(0), jnp.zeros((1, 12)))
+    for leaf in jax.tree.leaves(variables["params"]):
+        assert leaf.shape[0] == N
+    # all ranks start identical
+    w = jax.tree.leaves(variables["params"])[0]
+    np.testing.assert_allclose(np.asarray(w[0]), np.asarray(w[3]))
+
+
+@pytest.mark.parametrize("communication", [
+    "neighbor_allreduce", "allreduce", "gradient_allreduce", "empty"])
+def test_lenet_loss_decreases(bf_ctx, communication):
+    _, losses = train_some(LeNet(), communication)
+    assert losses[-1] < losses[0], losses
+
+
+def test_lenet_dynamic_schedule(bf_ctx):
+    topo = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), N)
+    # one-peer mixing is sparser, so allow more steps before requiring
+    # progress (momentum makes very early losses noisy)
+    _, losses = train_some(LeNet(), "neighbor_allreduce", sched=sched,
+                           steps=16)
+    assert min(losses[-3:]) < losses[0], losses
+
+
+def test_lenet_atc(bf_ctx):
+    _, losses = train_some(LeNet(), "neighbor_allreduce", atc=True)
+    assert losses[-1] < losses[0], losses
+
+
+def test_hierarchical_training(bf_ctx_machines):
+    bf.set_machine_topology(bf.ExponentialTwoGraph(4))
+    _, losses = train_some(LeNet(), "hierarchical_neighbor_allreduce")
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet18_batchnorm_stats_update(bf_ctx):
+    model = ResNet18(num_classes=10)
+    base = optax.sgd(0.01)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    before = jax.tree.leaves(variables["batch_stats"])[0].copy()
+    step_fn = T.make_train_step(model, base, donate=False)
+    rng = np.random.default_rng(0)
+    x, y = make_batch(rng, b=2, shape=(32, 32, 3))
+    variables, opt_state, loss = step_fn(
+        variables, opt_state, (x, y), jnp.int32(0))
+    after = jax.tree.leaves(variables["batch_stats"])[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+    assert np.isfinite(float(loss))
+
+
+def test_neighbor_averaging_contracts_spread(bf_ctx):
+    """With zero-lr updates, the train step must still contract parameter
+    disagreement (pure mixing)."""
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.0)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 6)))
+    # perturb ranks apart
+    rng = np.random.default_rng(0)
+    variables = jax.tree.map(
+        lambda a: a + jnp.asarray(rng.normal(size=a.shape), a.dtype),
+        variables)
+    step_fn = T.make_train_step(model, base, donate=False)
+    x = jnp.asarray(rng.normal(size=(N, 4, 6)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 4)))
+
+    def spread(v):
+        w = jax.tree.leaves(v["params"])[0]
+        return float(jnp.max(jnp.abs(w - jnp.mean(w, axis=0, keepdims=True))))
+
+    s0 = spread(variables)
+    for i in range(10):
+        variables, opt_state, _ = step_fn(
+            variables, opt_state, (x, y), jnp.int32(i))
+    assert spread(variables) < 0.05 * s0
