@@ -64,6 +64,11 @@ from .utils.utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
 )
 
+from .timeline import (
+    timeline_start, timeline_end, timeline_enabled,
+    timeline_start_activity, timeline_end_activity, timeline_context,
+)
+
 from .optim import (
     CommunicationType,
     DistributedGradientAllreduceOptimizer,

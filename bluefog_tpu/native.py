@@ -1,0 +1,108 @@
+"""Loader for the native runtime library (``csrc/`` → ``libbluefog_native.so``).
+
+The reference ships its native core as a compiled extension built by
+``setup.py``'s compile-probing machinery (reference setup.py:155-237).  Here
+the native pieces are host-side runtime services (timeline writer, window
+driver) — the TPU compute path is XLA — so a plain shared library consumed
+over ctypes is the right shape: no Python C-API coupling, trivially
+rebuildable, loadable from any interpreter.
+
+The library is built on demand with ``g++ -O2 -shared -fPIC`` the first time
+it is needed (cached next to the sources, guarded by a lock file so parallel
+test workers don't race).  Everything degrades gracefully: if no toolchain is
+available, callers fall back to pure-Python implementations.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger("bluefog_tpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(_ROOT, "csrc")
+_BUILD_DIR = os.path.join(_CSRC, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libbluefog_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f) for f in os.listdir(_CSRC) if f.endswith(".cc"))
+
+
+def _needs_build(sources):
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+
+
+def build(force: bool = False) -> str:
+    """Compile ``csrc/*.cc`` into the shared library; returns its path."""
+    sources = _sources()
+    if not sources:
+        raise FileNotFoundError(f"no C++ sources under {_CSRC}")
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not force and not _needs_build(sources):
+        return _LIB_PATH
+    lockfile = _LIB_PATH + ".lock"
+    fd = os.open(lockfile, os.O_CREAT | os.O_RDWR)
+    try:
+        import fcntl
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        if force or _needs_build(sources):
+            tmp = _LIB_PATH + ".tmp"
+            cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+                   "-pthread", "-o", tmp] + sources
+            logger.debug("building native lib: %s", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB_PATH)
+    finally:
+        os.close(fd)
+    return _LIB_PATH
+
+
+def load():
+    """Load (building if necessary) the native library, or None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            path = build()
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+        except Exception as e:  # toolchain missing, etc. — fall back to Python
+            logger.warning("native library unavailable (%s); using pure-Python "
+                           "fallbacks", e)
+            _load_failed = True
+    return _lib
+
+
+def _declare(lib):
+    lib.bft_timeline_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bft_timeline_open.restype = ctypes.c_int
+    lib.bft_timeline_close.argtypes = []
+    lib.bft_timeline_close.restype = None
+    lib.bft_timeline_active.argtypes = []
+    lib.bft_timeline_active.restype = ctypes.c_int
+    lib.bft_timeline_record.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char, ctypes.c_int64]
+    lib.bft_timeline_record.restype = None
+    lib.bft_timeline_record_at.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
+        ctypes.c_int64]
+    lib.bft_timeline_record_at.restype = None
+    lib.bft_timeline_now_us.argtypes = []
+    lib.bft_timeline_now_us.restype = ctypes.c_int64
+    lib.bft_timeline_dropped.argtypes = []
+    lib.bft_timeline_dropped.restype = ctypes.c_int64

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import context as _ctx_mod
+from .. import timeline as _tl
 from ..context import ctx
 from . import collectives as C
 from ..parallel.schedule import (
@@ -54,15 +55,23 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 _handle_lock = threading.Lock()
-_handle_map: Dict[int, jax.Array] = {}
+_handle_map: Dict[int, Tuple[jax.Array, str, int]] = {}
 _next_handle = [0]
 
 
-def _register_handle(output) -> int:
+def _register_handle(output, op: str = "", name: Optional[str] = None) -> int:
     with _handle_lock:
         handle = _next_handle[0]
         _next_handle[0] += 1
-        _handle_map[handle] = output
+        opname = name if name else (f"{op}.noname.{handle}" if op else "")
+        start_tok = _tl.op_start_us() if opname else None
+        _handle_map[handle] = (output, opname, start_tok)
+    if opname:
+        # timeline parity (reference timeline activities ENQUEUE_* then
+        # COMMUNICATE around the async op, mpi_controller.cc:333,445,510) —
+        # COMMUNICATE is emitted as one complete span at synchronize time so
+        # polled/abandoned handles never leave an unclosed begin event
+        _tl.record_op_phase(opname, f"ENQUEUE_{op.upper()}", "i")
     return handle
 
 
@@ -71,7 +80,7 @@ def poll(handle: int) -> bool:
     with _handle_lock:
         if handle not in _handle_map:
             raise ValueError(f"unknown handle {handle}")
-        out = _handle_map[handle]
+        out, _, _ = _handle_map[handle]
     ready = jax.tree_util.tree_all(
         jax.tree.map(lambda a: a.is_ready() if hasattr(a, "is_ready") else True, out))
     return bool(ready)
@@ -82,8 +91,11 @@ def synchronize(handle: int):
     with _handle_lock:
         if handle not in _handle_map:
             raise ValueError("Cannot find handle to synchronize")
-        out = _handle_map.pop(handle)
-    return jax.block_until_ready(out)
+        out, opname, start_tok = _handle_map.pop(handle)
+    result = jax.block_until_ready(out)
+    if opname:
+        _tl.record_op_span(opname, "COMMUNICATE", start_tok)
+    return result
 
 
 wait = synchronize
@@ -211,7 +223,7 @@ def _mesh_id():
 def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
-    return _register_handle(out)
+    return _register_handle(out, "allreduce", name)
 
 
 def allreduce(x, average: bool = True, name: Optional[str] = None):
@@ -226,7 +238,7 @@ allreduce_nonblocking_ = allreduce_nonblocking
 def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _broadcast_fn(cx.rank_axis, int(root_rank), _mesh_id())(to_global(x))
-    return _register_handle(out)
+    return _register_handle(out, "broadcast", name)
 
 
 def broadcast(x, root_rank: int, name: Optional[str] = None):
@@ -240,7 +252,7 @@ broadcast_nonblocking_ = broadcast_nonblocking
 
 def allgather_nonblocking(x, name: Optional[str] = None) -> int:
     out = _allgather_fn(ctx().rank_axis, _mesh_id())(to_global(x))
-    return _register_handle(out)
+    return _register_handle(out, "allgather", name)
 
 
 def allgather(x, name: Optional[str] = None):
@@ -269,7 +281,7 @@ def neighbor_allreduce_nonblocking(
     else:
         topo = cx.compiled_topology
         out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id())(xg)
-    return _register_handle(out)
+    return _register_handle(out, "neighbor_allreduce", name)
 
 
 def neighbor_allreduce(x, **kwargs):
@@ -291,7 +303,7 @@ def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
     cx = ctx()
     topo = cx.compiled_topology
     out = _neighbor_allgather_fn(cx.rank_axis, topo, _mesh_id())(to_global(x))
-    return _register_handle(out)
+    return _register_handle(out, "neighbor_allgather", name)
 
 
 def neighbor_allgather(x, name: Optional[str] = None):
@@ -309,7 +321,7 @@ def hierarchical_neighbor_allreduce_nonblocking(
         raise ValueError(f"expected leading dim {cx.size}, got {xg.shape}")
     fn = _hier_fn(cx.machine_axis, cx.local_axis, mtopo, _mesh_id())
     out = fn(xg)
-    return _register_handle(out)
+    return _register_handle(out, "hierarchical_neighbor_allreduce", name)
 
 
 @functools.lru_cache(maxsize=64)
@@ -349,7 +361,7 @@ def pair_gossip_nonblocking(x, pairs: Sequence[Tuple[int, int]],
     out = _pair_gossip_fn(ctx().rank_axis, tuple(map(tuple, pairs)),
                           float(self_weight), float(pair_weight),
                           _mesh_id())(to_global(x))
-    return _register_handle(out)
+    return _register_handle(out, "pair_gossip", name)
 
 
 def pair_gossip(x, pairs, self_weight=None, pair_weight=None, name=None):

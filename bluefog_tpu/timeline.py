@@ -1,0 +1,239 @@
+"""Chrome-tracing timeline (reference parity: ``bluefog/common/timeline.{h,cc}``
+and the Python surface ``basics.py:456-546``).
+
+Activation mirrors the reference: set ``BLUEFOG_TIMELINE=<prefix>`` before
+``bf.init()`` (or call :func:`timeline_start` explicitly) and each process
+writes ``<prefix><rank>.json`` viewable in ``chrome://tracing`` / Perfetto.
+
+Two recording paths:
+
+* **Host activities** — op dispatch/synchronize phases recorded by the op
+  layer (ENQUEUE_*, COMMUNICATE, NEGOTIATION never exists here — SPMD has no
+  coordinator), plus user activities via :func:`timeline_start_activity` /
+  :func:`timeline_context` exactly like the reference.  Records flow through
+  the native C++ writer (``csrc/timeline.cc``: bounded MPMC ring + dedicated
+  writer thread, the same design as the reference's boost SPSC queue at
+  ``timeline.h:46-76``) or a pure-Python fallback when no toolchain exists.
+* **Device activities** — every jitted op also runs under
+  ``jax.profiler.TraceAnnotation``-compatible named scopes, so an XLA profile
+  captured around the run carries matching op names.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from . import native
+
+__all__ = [
+    "timeline_start", "timeline_end", "timeline_enabled",
+    "timeline_start_activity", "timeline_end_activity", "timeline_context",
+    "record_op_phase", "op_phase",
+]
+
+_ENV = "BLUEFOG_TIMELINE"
+
+
+class _PyWriter:
+    """Pure-Python fallback writer: same file format as the native one."""
+
+    def __init__(self, path: str, rank: int):
+        self._f = open(path, "w")
+        self._rank = rank
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._lanes = {}
+        self._f.write("[\n")
+        self._emit({"name": "process_name", "ph": "M", "pid": rank,
+                    "args": {"name": f"rank {rank}"}})
+
+    def _emit(self, ev):
+        self._f.write(json.dumps(ev) + ",\n")
+
+    def _lane(self, tensor: str) -> int:
+        if tensor not in self._lanes:
+            tid = len(self._lanes) + 1
+            self._lanes[tensor] = tid
+            self._emit({"name": "thread_name", "ph": "M", "pid": self._rank,
+                        "tid": tid, "args": {"name": tensor}})
+        return self._lanes[tensor]
+
+    def now_us(self) -> int:
+        return int((time.perf_counter() - self._t0) * 1e6)
+
+    def record(self, tensor: str, activity: str, phase: str, dur_us: int = 0,
+               ts_us: int = -1):
+        ts = self.now_us() if ts_us < 0 else ts_us
+        with self._lock:
+            tid = self._lane(tensor)
+            ev = {"name": activity, "cat": "bluefog", "ph": phase, "ts": ts,
+                  "pid": self._rank, "tid": tid}
+            if phase == "X":
+                ev["dur"] = dur_us
+            if phase == "i":
+                ev["s"] = "t"
+            self._emit(ev)
+
+    def close(self):
+        with self._lock:
+            self._emit({"name": "timeline_closed", "ph": "i", "pid": self._rank,
+                        "tid": 0, "ts": 0, "s": "g"})
+            # strip nothing; chrome tolerates the trailing comma but we close
+            # the array properly by writing a bare null-free final object above
+            self._f.write("{}\n]\n")
+            self._f.close()
+
+
+class _Timeline:
+    def __init__(self):
+        self._native = None
+        self._py: Optional[_PyWriter] = None
+        self._path: Optional[str] = None
+        self._session = 0  # bumps on every start(); stamps span tokens
+
+    @property
+    def enabled(self) -> bool:
+        return self._native is not None or self._py is not None
+
+    def start(self, file_prefix: str, rank: int) -> str:
+        if self.enabled:
+            raise RuntimeError("timeline already started; call timeline_end() first")
+        path = f"{file_prefix}{rank}.json"
+        self._session += 1
+        lib = native.load()
+        if lib is not None and lib.bft_timeline_open(path.encode(), rank) == 0:
+            self._native = lib
+        else:
+            self._py = _PyWriter(path, rank)
+        self._path = path
+        return path
+
+    def end(self):
+        if self._native is not None:
+            self._native.bft_timeline_close()
+            self._native = None
+        if self._py is not None:
+            self._py.close()
+            self._py = None
+        self._path = None
+
+    def record(self, tensor: str, activity: str, phase: str, dur_us: int = 0,
+               ts_us: int = -1):
+        if self._native is not None:
+            self._native.bft_timeline_record_at(
+                tensor.encode(), activity.encode(), phase.encode(), ts_us,
+                dur_us)
+        elif self._py is not None:
+            self._py.record(tensor, activity, phase, dur_us, ts_us)
+
+    def now_us(self) -> int:
+        if self._native is not None:
+            return int(self._native.bft_timeline_now_us())
+        if self._py is not None:
+            return self._py.now_us()
+        return 0
+
+
+_timeline = _Timeline()
+
+
+def timeline_enabled() -> bool:
+    return _timeline.enabled
+
+
+def timeline_start(file_prefix: Optional[str] = None,
+                   rank: Optional[int] = None) -> Optional[str]:
+    """Open the per-rank timeline file (reference basics.py:456-480).
+
+    Called automatically by ``bf.init()`` when ``BLUEFOG_TIMELINE`` is set.
+    """
+    if file_prefix is None:
+        file_prefix = os.environ.get(_ENV)
+    if not file_prefix:
+        return None
+    if rank is None:
+        from . import context as _ctx
+        rank = _ctx.ctx().rank() if _ctx.is_initialized() else 0
+    return _timeline.start(file_prefix, rank)
+
+
+def timeline_end():
+    _timeline.end()
+
+
+atexit.register(timeline_end)
+
+
+def timeline_start_activity(tensor_name: str, activity_name: str) -> bool:
+    """Begin a user activity on the named lane (reference basics.py:482-516)."""
+    if not _timeline.enabled:
+        return False
+    _timeline.record(tensor_name, activity_name, "B")
+    return True
+
+
+def timeline_end_activity(tensor_name: str) -> bool:
+    if not _timeline.enabled:
+        return False
+    _timeline.record(tensor_name, "", "E")
+    return True
+
+
+@contextmanager
+def timeline_context(tensor_name: str, activity_name: str):
+    """``with bf.timeline_context("tensor", "COMPUTE"): ...``
+    (reference basics.py:518-546)."""
+    timeline_start_activity(tensor_name, activity_name)
+    try:
+        import jax
+        with jax.named_scope(activity_name):
+            yield
+    finally:
+        timeline_end_activity(tensor_name)
+
+
+# -- op-layer hooks ---------------------------------------------------------
+
+def record_op_phase(name: str, activity: str, phase: str = "i"):
+    """Lightweight hook used by the op layer; no-op unless enabled."""
+    if _timeline.enabled:
+        _timeline.record(name, activity, phase)
+
+
+def op_start_us():
+    """Opaque token for a later :func:`record_op_span`; None when disabled.
+    The token carries the timeline session id so spans never straddle a
+    timeline restart (which would corrupt timestamps)."""
+    if not _timeline.enabled:
+        return None
+    return (_timeline._session, _timeline.now_us())
+
+
+def record_op_span(name: str, activity: str, token):
+    """Emit a complete ('X') span from the token's timestamp to now.  Used
+    for the async COMMUNICATE window so handles that are polled or abandoned
+    never leave an unclosed begin event in the trace.  Tokens minted while
+    the timeline was disabled or during a previous session are dropped."""
+    if token is None or not _timeline.enabled:
+        return
+    session, start_us = token
+    if session != _timeline._session:
+        return
+    end = _timeline.now_us()
+    _timeline.record(name, activity, "X", max(0, end - start_us), start_us)
+
+
+@contextmanager
+def op_phase(name: str, activity: str):
+    if not _timeline.enabled:
+        yield
+        return
+    _timeline.record(name, activity, "B")
+    try:
+        yield
+    finally:
+        _timeline.record(name, "", "E")

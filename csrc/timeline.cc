@@ -1,0 +1,285 @@
+// bluefog_tpu native timeline writer.
+//
+// TPU-native re-design of the reference's Chrome-tracing timeline
+// (reference: bluefog/common/timeline.{h,cc} — boost SPSC queue at
+// timeline.h:46-76, activity begin/end records at timeline.h:82-120).
+// Same contract: callers enqueue fixed-size records from any thread with
+// negligible latency; a dedicated writer thread serializes them into a
+// chrome://tracing JSON file.  Implementation is a brand-new bounded MPMC
+// ring with a monotonic-ticket scheme (no boost, no external deps).
+//
+// Exposed as a flat C ABI consumed from Python via ctypes
+// (bluefog_tpu/timeline.py); one timeline per process, matching the
+// reference's per-rank file `<prefix><rank>.json`.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+
+namespace {
+
+constexpr int kMaxName = 128;
+constexpr uint32_t kQueueCapacity = 1 << 16;  // 65536 in-flight records
+
+struct Record {
+  char tensor[kMaxName];
+  char activity[kMaxName];
+  char phase;        // 'B' begin, 'E' end, 'X' complete, 'i' instant
+  int64_t ts_us;     // microseconds since timeline open
+  int64_t dur_us;    // only for 'X'
+  uint32_t tid;      // lane id (stable hash of tensor name)
+};
+
+// Bounded MPMC ring buffer.  Each slot carries a sequence number; producers
+// claim tickets with fetch_add and spin only on their own slot, consumers
+// (the single writer thread) likewise.  This is the classic bounded-queue
+// design (Vyukov); records are dropped, not blocked on, when full — a
+// tracing subsystem must never stall the training step.
+class RecordQueue {
+ public:
+  RecordQueue() {
+    for (uint32_t i = 0; i < kQueueCapacity; ++i)
+      slots_[i].seq.store(i, std::memory_order_relaxed);
+  }
+
+  bool push(const Record& r) {
+    uint64_t pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Slot& s = slots_[pos & (kQueueCapacity - 1)];
+      uint64_t seq = s.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          {
+            s.rec = r;
+            s.seq.store(pos + 1, std::memory_order_release);
+            return true;
+          }
+      } else if (dif < 0) {
+        return false;  // full: drop
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  bool pop(Record* out) {
+    uint64_t pos = tail_.load(std::memory_order_relaxed);
+    Slot& s = slots_[pos & (kQueueCapacity - 1)];
+    uint64_t seq = s.seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+    if (dif < 0) return false;  // empty
+    *out = s.rec;
+    s.seq.store(pos + kQueueCapacity, std::memory_order_release);
+    tail_.store(pos + 1, std::memory_order_relaxed);
+    return true;
+  }
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> seq;
+    Record rec;
+  };
+  Slot slots_[kQueueCapacity];
+  // single consumer, so tail_ needs no CAS
+  alignas(64) std::atomic<uint64_t> head_{0};
+  alignas(64) std::atomic<uint64_t> tail_{0};
+};
+
+void json_escape(const char* in, char* out, size_t out_len) {
+  size_t j = 0;
+  for (size_t i = 0; in[i] && j + 2 < out_len; ++i) {
+    char c = in[i];
+    if (c == '"' || c == '\\') out[j++] = '\\';
+    if ((unsigned char)c < 0x20) c = ' ';
+    out[j++] = c;
+  }
+  out[j] = '\0';
+}
+
+class TimelineWriter {
+ public:
+  bool open(const char* path, int rank) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (file_) return false;
+    file_ = std::fopen(path, "w");
+    if (!file_) return false;
+    rank_ = rank;
+    t0_ = std::chrono::steady_clock::now();
+    std::memset(seen_lane_, 0, sizeof seen_lane_);  // fresh session state
+    dropped_.store(0, std::memory_order_relaxed);
+    std::fputs("[\n", file_);
+    // process metadata so chrome://tracing shows "rank N"
+    std::fprintf(file_,
+                 "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
+                 "\"args\":{\"name\":\"rank %d\"}},\n",
+                 rank_, rank_);
+    stop_.store(false, std::memory_order_relaxed);
+    writer_ = std::thread([this] { this->loop(); });
+    return true;
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!file_) return;
+      stop_.store(true, std::memory_order_release);
+    }
+    cv_.notify_all();
+    if (writer_.joinable()) writer_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    // valid JSON even though chrome tolerates a trailing comma: close with
+    // a final metadata event
+    std::fprintf(file_,
+                 "{\"name\":\"timeline_closed\",\"ph\":\"i\",\"pid\":%d,"
+                 "\"tid\":0,\"ts\":%lld,\"s\":\"g\"}\n]\n",
+                 rank_, (long long)now_us());
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+
+  bool active() const { return file_ != nullptr; }
+
+  int64_t now_us() const {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now() - t0_)
+        .count();
+  }
+
+  // ts_us < 0 means "stamp now"; an explicit ts lets callers emit complete
+  // ('X') spans whose start predates the record call (async op windows).
+  void record(const char* tensor, const char* activity, char phase,
+              int64_t ts_us, int64_t dur_us) {
+    if (!active()) return;
+    Record r;
+    std::snprintf(r.tensor, kMaxName, "%s", tensor ? tensor : "");
+    std::snprintf(r.activity, kMaxName, "%s", activity ? activity : "");
+    r.phase = phase;
+    r.ts_us = ts_us < 0 ? now_us() : ts_us;
+    r.dur_us = dur_us;
+    r.tid = lane(r.tensor);
+    if (queue_.push(r)) cv_.notify_one();
+    else dropped_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  int64_t dropped() const {
+    return dropped_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  // Stable lane id per tensor name so chrome renders one row per tensor
+  // (reference maps tensor→tid at timeline.h:103-111).
+  uint32_t lane(const char* name) {
+    uint32_t h = 2166136261u;
+    for (const char* p = name; *p; ++p) h = (h ^ (uint8_t)*p) * 16777619u;
+    return 1 + (h % 4096);
+  }
+
+  void emit(const Record& r) {
+    char tensor[2 * kMaxName], activity[2 * kMaxName];
+    json_escape(r.tensor, tensor, sizeof tensor);
+    json_escape(r.activity, activity, sizeof activity);
+    if (!seen_lane_[r.tid % 4096]) {
+      seen_lane_[r.tid % 4096] = true;
+      std::fprintf(file_,
+                   "{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":%d,"
+                   "\"tid\":%u,\"args\":{\"name\":\"%s\"}},\n",
+                   rank_, r.tid, tensor);
+    }
+    if (r.phase == 'X') {
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"bluefog\",\"ph\":\"X\","
+                   "\"ts\":%lld,\"dur\":%lld,\"pid\":%d,\"tid\":%u},\n",
+                   activity, (long long)r.ts_us, (long long)r.dur_us, rank_,
+                   r.tid);
+    } else if (r.phase == 'i') {
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"bluefog\",\"ph\":\"i\","
+                   "\"ts\":%lld,\"pid\":%d,\"tid\":%u,\"s\":\"t\"},\n",
+                   activity, (long long)r.ts_us, rank_, r.tid);
+    } else {
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"bluefog\",\"ph\":\"%c\","
+                   "\"ts\":%lld,\"pid\":%d,\"tid\":%u},\n",
+                   activity, r.phase, (long long)r.ts_us, rank_, r.tid);
+    }
+  }
+
+  void loop() {
+    Record r;
+    for (;;) {
+      bool any = false;
+      while (queue_.pop(&r)) {
+        any = true;
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!file_) return;
+        emit(r);
+      }
+      if (stop_.load(std::memory_order_acquire)) {
+        while (queue_.pop(&r)) {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (!file_) return;
+          emit(r);
+        }
+        return;
+      }
+      if (!any) {
+        std::unique_lock<std::mutex> lk(wait_mu_);
+        cv_.wait_for(lk, std::chrono::milliseconds(5));
+      }
+    }
+  }
+
+  std::mutex mu_;        // guards file_
+  std::mutex wait_mu_;   // writer sleep
+  std::condition_variable cv_;
+  std::FILE* file_ = nullptr;
+  int rank_ = 0;
+  std::chrono::steady_clock::time_point t0_;
+  std::thread writer_;
+  std::atomic<bool> stop_{false};
+  std::atomic<int64_t> dropped_{0};
+  RecordQueue queue_;
+  bool seen_lane_[4096] = {};
+};
+
+TimelineWriter* writer() {
+  static TimelineWriter w;
+  return &w;
+}
+
+}  // namespace
+
+extern "C" {
+
+int bft_timeline_open(const char* path, int rank) {
+  return writer()->open(path, rank) ? 0 : -1;
+}
+
+void bft_timeline_close() { writer()->close(); }
+
+int bft_timeline_active() { return writer()->active() ? 1 : 0; }
+
+// phase: 'B' begin, 'E' end, 'i' instant; 'X' complete with dur_us
+void bft_timeline_record(const char* tensor, const char* activity, char phase,
+                         int64_t dur_us) {
+  writer()->record(tensor, activity, phase, -1, dur_us);
+}
+
+// as above, with an explicit start timestamp (from bft_timeline_now_us)
+void bft_timeline_record_at(const char* tensor, const char* activity,
+                            char phase, int64_t ts_us, int64_t dur_us) {
+  writer()->record(tensor, activity, phase, ts_us, dur_us);
+}
+
+int64_t bft_timeline_now_us() { return writer()->now_us(); }
+
+int64_t bft_timeline_dropped() { return writer()->dropped(); }
+
+}  // extern "C"
