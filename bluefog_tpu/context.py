@@ -46,6 +46,13 @@ class BlueFogContext:
         self._devices = list(devices) if devices is not None else list(jax.devices())
         self._size = len(self._devices)
 
+        expected = os.environ.get("BLUEFOG_EXPECTED_SIZE")
+        if expected is not None and devices is None and int(expected) != self._size:
+            raise RuntimeError(
+                f"bfrun requested -np {expected} devices but the runtime "
+                f"found {self._size}; fix -np, add --platform cpu for "
+                f"virtual devices, or unset BLUEFOG_EXPECTED_SIZE")
+
         if nodes_per_machine is None:
             env = os.environ.get("BLUEFOG_NODES_PER_MACHINE")
             if env is not None:
@@ -226,6 +233,40 @@ def _uniform_weights(topo: nx.DiGraph) -> nx.DiGraph:
 # ---------------------------------------------------------------------------
 
 _context: Optional[BlueFogContext] = None
+_jax_distributed_started = False
+
+
+def _maybe_init_jax_distributed() -> None:
+    """Join the multi-host job set up by ``bfrun`` (run/run.py wires
+    BLUEFOG_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID per host; the
+    reference reaches the same point through mpirun's rank env).
+
+    Must not touch any backend-initializing JAX API before
+    ``jax.distributed.initialize`` — the guard is env + module flag only,
+    and an already-initialized runtime surfaces as the RuntimeError below.
+    """
+    global _jax_distributed_started
+    coordinator = os.environ.get("BLUEFOG_COORDINATOR")
+    if not coordinator or _jax_distributed_started:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["BLUEFOG_NUM_PROCESSES"]),
+            process_id=int(os.environ["BLUEFOG_PROCESS_ID"]))
+    except RuntimeError as e:
+        # Only "already initialized / called too late" is benign (user or a
+        # previous bf.init did it).  A coordinator connection failure must
+        # abort — proceeding would silently train each host independently.
+        msg = str(e).lower()
+        # covers "distributed.initialize should only be called once." and
+        # older "already initialized" / ordering phrasings
+        if ("only be called once" in msg or "already" in msg
+                or "must be called before" in msg):
+            logger.warning("jax.distributed.initialize skipped: %s", e)
+        else:
+            raise
+    _jax_distributed_started = True
 
 
 def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
@@ -237,17 +278,25 @@ def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
     The default topology is an exponential-2 graph over all devices.
     """
     global _context
+    _maybe_init_jax_distributed()
     _context = BlueFogContext(devices=devices, nodes_per_machine=nodes_per_machine)
     topo = topology_fn(_context.size) if topology_fn else None
     _context.set_topology(topo, is_weighted)
+    # BLUEFOG_TIMELINE=<prefix> starts tracing at init, like the reference
+    # (operations.cc:464-473 reads the env in the background-thread boot)
+    from . import timeline as _tl
+    if os.environ.get("BLUEFOG_TIMELINE") and not _tl.timeline_enabled():
+        _tl.timeline_start(rank=_context.rank())
     return _context
 
 
 def shutdown() -> None:
     global _context
     from .ops import windows as _win
+    from . import timeline as _tl
     _win.win_free()
     _win.turn_off_win_ops_with_associated_p()
+    _tl.timeline_end()
     _context = None
 
 

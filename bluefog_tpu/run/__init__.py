@@ -1,0 +1,9 @@
+"""Launchers: ``bfrun`` (batch) and ``ibfrun`` (interactive).
+
+TPU-native re-design of the reference launcher stack (``bluefog/run/`` —
+``bfrun`` wraps mpirun at run.py:121-203, ``ibfrun`` wraps ipyparallel).
+There is no mpirun here: a JAX program is single-controller SPMD, so
+launching means (a) configuring the device view for one process on a single
+host, or (b) starting one controller process per host wired together with
+``jax.distributed`` over DCN.
+"""

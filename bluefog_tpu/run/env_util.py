@@ -1,0 +1,49 @@
+"""Environment forwarding (reference: ``run/env_util.py`` — exportable-env
+filtering so launcher state reaches every worker)."""
+
+import os
+import re
+from typing import Dict, List
+
+# Never forward these across hosts: they are per-process/host identity.
+_BLOCKLIST = re.compile(
+    r"^(BASH_FUNC.*|HOSTNAME|PWD|OLDPWD|SHLVL|SSH_.*|DISPLAY|TMPDIR|"
+    r"XDG_.*|LS_COLORS|_)$")
+
+
+def is_exportable(name: str) -> bool:
+    return _BLOCKLIST.match(name) is None
+
+
+def exportable_env(env: Dict[str, str] = None) -> Dict[str, str]:
+    env = dict(os.environ if env is None else env)
+    return {k: v for k, v in env.items() if is_exportable(k)}
+
+
+def force_virtual_cpu_devices(env: Dict[str, str], n: int) -> Dict[str, str]:
+    """Configure ``env`` so a fresh JAX process sees ``n`` virtual CPU
+    devices (the TPU analog of the reference's localhost oversubscription,
+    Makefile:5-8).  Must reach the process before any backend initializes.
+    An existing device-count flag is rewritten to ``n``, not kept."""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    env["XLA_FLAGS"] = flags
+    return env
+
+
+def env_assignments(env: Dict[str, str], only_prefixes: List[str]) -> List[str]:
+    """Shell-safe ``K=V`` assignments for the vars worth forwarding over ssh:
+    anything matching the given prefixes (reference forwards -x env vars,
+    run.py:186-198)."""
+    import shlex
+    out = []
+    for k, v in sorted(env.items()):
+        if any(k.startswith(p) for p in only_prefixes) and is_exportable(k):
+            out.append(f"{k}={shlex.quote(v)}")
+    return out

@@ -1,0 +1,69 @@
+"""``ibfrun`` — interactive bluefog_tpu session (reference:
+``run/interactive_run.py``).
+
+The reference spins up an ipyparallel cluster (one engine per MPI rank) so a
+notebook can drive distributed code interactively.  Under single-controller
+SPMD one interpreter already drives every device, so ``ibfrun`` reduces to:
+configure the device view (virtual CPU devices if requested), call
+``bf.init()``, and drop into a REPL (IPython when available) with ``bf``,
+``jax`` and ``jnp`` bound.  ``ibfrun start/stop`` subcommands are accepted
+for reference CLI compatibility and map to entering/exiting the session.
+"""
+
+import argparse
+import os
+import sys
+
+
+def parse_args(argv):
+    parser = argparse.ArgumentParser(
+        prog="ibfrun", description="Interactive BlueFog-TPU session")
+    parser.add_argument("subcommand", nargs="?", default="start",
+                        choices=["start", "stop"],
+                        help="reference-compatible; 'stop' is a no-op (the "
+                             "session dies with the REPL)")
+    parser.add_argument("-np", "--num-proc", type=int, default=None)
+    parser.add_argument("--platform", default=None, choices=["tpu", "cpu"])
+    parser.add_argument("--extra-script", default=None,
+                        help="python file executed in the session namespace "
+                             "before the prompt")
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    if args.subcommand == "stop":
+        print("ibfrun: nothing to stop (sessions end with their REPL)")
+        return 0
+
+    if args.platform == "cpu" and args.num_proc:
+        from .env_util import force_virtual_cpu_devices
+        force_virtual_cpu_devices(os.environ, args.num_proc)
+
+    import jax
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import bluefog_tpu as bf
+
+    bf.init()
+    ns = {"bf": bf, "jax": jax, "jnp": jnp}
+    banner = (f"bluefog_tpu interactive session — {bf.size()} device(s), "
+              f"topology {type(bf.load_topology()).__name__}\n"
+              f"bound names: bf, jax, jnp")
+    if args.extra_script:
+        with open(args.extra_script) as f:
+            exec(compile(f.read(), args.extra_script, "exec"), ns)
+
+    try:
+        from IPython import start_ipython
+        return start_ipython(argv=[], user_ns=ns,
+                             display_banner=banner) or 0
+    except ImportError:
+        import code
+        code.interact(banner=banner, local=ns)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Host parsing and reachability helpers (reference: ``run/network_util.py``)."""
+
+import subprocess
+from typing import List, Optional, Tuple
+
+
+def parse_host_spec(spec: str) -> List[Tuple[str, int]]:
+    """``"h1:8,h2:8"`` → ``[("h1", 8), ("h2", 8)]``; slot defaults to 1
+    (reference -H format, run/run.py:64-70)."""
+    hosts = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            hosts.append((name, int(slots)))
+        else:
+            hosts.append((part, 1))
+    return hosts
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, int]]:
+    """Hostfile lines ``hostname slots=N`` (reference --hostfile,
+    run/run.py:71-77)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            name = fields[0]
+            slots = 1
+            for field in fields[1:]:
+                if field.startswith("slots="):
+                    slots = int(field.split("=", 1)[1])
+            hosts.append((name, slots))
+    return hosts
+
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local_host(name: str) -> bool:
+    if name in _LOCAL_NAMES:
+        return True
+    import socket
+    try:
+        return name in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
+
+
+def check_ssh(host: str, ssh_port: Optional[int] = None,
+              timeout: int = 10) -> bool:
+    """Non-interactive ssh reachability probe (reference run.py:134)."""
+    cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+           "-o", f"ConnectTimeout={timeout}"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [host, "true"]
+    try:
+        return subprocess.run(cmd, capture_output=True,
+                              timeout=timeout + 5).returncode == 0
+    except (subprocess.TimeoutExpired, FileNotFoundError):
+        return False

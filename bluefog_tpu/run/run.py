@@ -1,0 +1,198 @@
+"""``bfrun`` — launch a bluefog_tpu program (reference: ``run/run.py:121-203``).
+
+The reference execve's ``mpirun`` to spawn -np ranks.  A JAX program is
+single-controller SPMD — one process drives every local device — so:
+
+* **Single host**: ``bfrun -np 8 python train.py`` runs the command in-place
+  with the device view configured: on real TPU hardware the 8 chips are
+  discovered by the runtime; with ``--platform cpu`` an 8-device virtual
+  host platform is forced via XLA flags — the TPU analog of the reference's
+  localhost oversubscription (Makefile:5-8).
+* **Multi host**: ``bfrun -np 16 -H host1:8,host2:8 python train.py`` starts
+  one controller per host over ssh, wiring ``jax.distributed`` coordinator
+  env vars (BLUEFOG_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID) that
+  ``bf.init()`` consumes; collectives then ride ICI within a host and DCN
+  across hosts.
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import List, Tuple
+
+from . import env_util, network_util
+
+_FORWARD_PREFIXES = ["BLUEFOG_", "JAX_", "XLA_", "LIBTPU_", "TPU_",
+                     "PYTHONPATH"]
+
+
+def parse_args(argv: List[str]) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="bfrun", description="BlueFog-TPU launcher",
+        usage="bfrun [-np N] [-H hosts | --hostfile F] [options] command ...")
+    parser.add_argument("-v", "--version", action="store_true")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="total number of devices (single host) or "
+                             "must equal the sum of host slots (multi host)")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="comma-separated host:slots list")
+    parser.add_argument("--hostfile", default=None,
+                        help="file with 'hostname slots=N' lines")
+    parser.add_argument("-p", "--ssh-port", type=int, default=None)
+    parser.add_argument("--platform", default=None,
+                        choices=["tpu", "cpu"],
+                        help="force a JAX platform (cpu => -np virtual "
+                             "host devices, like the reference's localhost "
+                             "oversubscription)")
+    parser.add_argument("--coordinator-port", type=int, default=3389,
+                        help="port for the jax.distributed coordinator "
+                             "(multi-host only)")
+    parser.add_argument("--timeline-filename", default=None,
+                        help="per-rank chrome-tracing output prefix "
+                             "(exports BLUEFOG_TIMELINE)")
+    parser.add_argument("--nodes-per-machine", type=int, default=None,
+                        help="simulate multi-machine hierarchy on one host "
+                             "(exports BLUEFOG_NODES_PER_MACHINE)")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def _resolve_hosts(args) -> List[Tuple[str, int]]:
+    if args.hosts and args.hostfile:
+        raise SystemExit("bfrun: use either -H or --hostfile, not both")
+    if args.hostfile:
+        return network_util.parse_hostfile(args.hostfile)
+    if args.hosts:
+        return network_util.parse_host_spec(args.hosts)
+    return []
+
+
+def _apply_common_flags(args, env: dict, local_slots: int) -> dict:
+    """Flag → env translation shared by the single- and multi-host paths
+    (reference composes mpirun's -x list the same way, run.py:186-198)."""
+    if args.timeline_filename:
+        env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    if args.nodes_per_machine:
+        env["BLUEFOG_NODES_PER_MACHINE"] = str(args.nodes_per_machine)
+    if args.platform == "cpu":
+        if local_slots:
+            env_util.force_virtual_cpu_devices(env, local_slots)
+        else:
+            env["JAX_PLATFORMS"] = "cpu"
+    elif args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+    return env
+
+
+def make_single_host_env(args, base_env=None) -> dict:
+    env = dict(os.environ if base_env is None else base_env)
+    _apply_common_flags(args, env, args.num_proc)
+    if args.num_proc:
+        env["BLUEFOG_EXPECTED_SIZE"] = str(args.num_proc)
+    return env
+
+
+def _launch_single_host(args) -> int:
+    env = make_single_host_env(args)
+    cmd = args.command
+    os.execvpe(cmd[0], cmd, env)  # no return
+
+
+def _launch_multi_host(args, hosts) -> int:
+    total = sum(s for _, s in hosts)
+    if args.num_proc and args.num_proc != total:
+        raise SystemExit(
+            f"bfrun: -np {args.num_proc} != sum of host slots {total}")
+    # The coordinator address is dialed by every host: a loopback name for
+    # hosts[0] would point remote workers at themselves, so substitute this
+    # machine's routable hostname.
+    coord_host = hosts[0][0]
+    if network_util.is_local_host(coord_host) and len(hosts) > 1:
+        import socket
+        coord_host = socket.getfqdn()
+    coordinator = f"{coord_host}:{args.coordinator_port}"
+
+    for host, _ in hosts:
+        if not network_util.is_local_host(host):
+            if not network_util.check_ssh(host, args.ssh_port):
+                raise SystemExit(f"bfrun: ssh to {host} failed (reference "
+                                 f"behavior run.py:134: abort early)")
+
+    base_env = env_util.exportable_env()
+
+    procs = []
+    cwd = os.getcwd()
+    for pid, (host, slots) in enumerate(hosts):
+        env = _apply_common_flags(args, dict(base_env), slots)
+        env.update({
+            "BLUEFOG_COORDINATOR": coordinator,
+            "BLUEFOG_NUM_PROCESSES": str(len(hosts)),
+            "BLUEFOG_PROCESS_ID": str(pid),
+        })
+        if network_util.is_local_host(host):
+            procs.append(subprocess.Popen(args.command, env={**os.environ, **env}))
+        else:
+            assigns = env_util.env_assignments(env, _FORWARD_PREFIXES)
+            remote = (f"cd {shlex.quote(cwd)} && "
+                      + " ".join(assigns) + " "
+                      + " ".join(shlex.quote(c) for c in args.command))
+            ssh = ["ssh", "-o", "BatchMode=yes"]
+            if args.ssh_port:
+                ssh += ["-p", str(args.ssh_port)]
+            procs.append(subprocess.Popen(ssh + [host, remote]))
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    # Poll all workers so one crashed host tears the job down immediately —
+    # a sequential wait() would hang on an earlier-listed host stuck in a
+    # collective waiting for the dead one.
+    import time
+    rc = 0
+    pending = set(procs)
+    while pending:
+        for p in list(pending):
+            p_rc = p.poll()
+            if p_rc is None:
+                continue
+            pending.discard(p)
+            if p_rc != 0 and rc == 0:
+                rc = p_rc
+                _terminate()
+        if pending:
+            time.sleep(0.2)
+    return rc
+
+
+def main(argv=None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    if args.version:
+        from ..version import __version__
+        print(f"bfrun (bluefog_tpu) {__version__}")
+        return 0
+    if not args.command:
+        raise SystemExit("bfrun: no command given (try: bfrun -np 8 "
+                         "python train.py)")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    hosts = _resolve_hosts(args)
+    # A single *remote* host still needs the ssh + coordinator path; only a
+    # bare or single-local-host spec runs in place.
+    if len(hosts) > 1 or (
+            hosts and not network_util.is_local_host(hosts[0][0])):
+        return _launch_multi_host(args, hosts)
+    if hosts and args.num_proc is None:
+        args.num_proc = hosts[0][1]  # -H localhost:4 without -np
+    return _launch_single_host(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

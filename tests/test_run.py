@@ -1,0 +1,110 @@
+"""Launcher tests (reference has no unit tests for bfrun; we cover host
+parsing, env composition, and a real single-host launch)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bluefog_tpu.run import env_util, network_util
+from bluefog_tpu.run.run import make_single_host_env, parse_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_host_spec():
+    assert network_util.parse_host_spec("h1:8,h2:4") == [("h1", 8), ("h2", 4)]
+    assert network_util.parse_host_spec("solo") == [("solo", 1)]
+    assert network_util.parse_host_spec(" a:1 , b:2 ") == [("a", 1), ("b", 2)]
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("node1 slots=8\n# comment\nnode2 slots=4 extra=x\nnode3\n")
+    assert network_util.parse_hostfile(str(hf)) == [
+        ("node1", 8), ("node2", 4), ("node3", 1)]
+
+
+def test_is_local_host():
+    assert network_util.is_local_host("localhost")
+    assert network_util.is_local_host("127.0.0.1")
+    assert not network_util.is_local_host("definitely-not-this-host.example")
+
+
+def test_exportable_env_filters_identity_vars():
+    env = {"PATH": "/bin", "HOSTNAME": "h", "SSH_CLIENT": "x",
+           "BLUEFOG_TIMELINE": "/tmp/t", "BASH_FUNC_foo%%": "() { :; }"}
+    out = env_util.exportable_env(env)
+    assert "PATH" in out and "BLUEFOG_TIMELINE" in out
+    assert "HOSTNAME" not in out and "SSH_CLIENT" not in out
+    assert "BASH_FUNC_foo%%" not in out
+
+
+def test_env_assignments_quoting():
+    out = env_util.env_assignments(
+        {"BLUEFOG_X": "a b", "OTHER": "y"}, ["BLUEFOG_"])
+    assert out == ["BLUEFOG_X='a b'"]
+
+
+def test_single_host_env_cpu_platform():
+    args = parse_args(["-np", "4", "--platform", "cpu", "python", "x.py"])
+    env = make_single_host_env(args, base_env={})
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert env["BLUEFOG_EXPECTED_SIZE"] == "4"
+    assert args.command == ["python", "x.py"]
+
+
+def test_single_host_env_timeline_and_machines():
+    args = parse_args(["-np", "8", "--timeline-filename", "/tmp/tl_",
+                       "--nodes-per-machine", "2", "cmd"])
+    env = make_single_host_env(args, base_env={})
+    assert env["BLUEFOG_TIMELINE"] == "/tmp/tl_"
+    assert env["BLUEFOG_NODES_PER_MACHINE"] == "2"
+
+
+def test_bfrun_end_to_end_single_host(tmp_path):
+    """bfrun -np 4 --platform cpu python -c '<prints device count>'."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bluefog_tpu as bf\n"
+        "bf.init()\n"
+        "print('SIZE', bf.size())\n")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.run", "-np", "4",
+         "--platform", "cpu", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "SIZE 4" in out.stdout
+
+
+def test_bfrun_rejects_conflicting_host_args(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("h1 slots=2\n")
+    from bluefog_tpu.run.run import main
+    with pytest.raises(SystemExit):
+        main(["-H", "a:1,b:1", "--hostfile", str(hf), "cmd"])
+
+
+def test_bfrun_requires_command():
+    from bluefog_tpu.run.run import main
+    with pytest.raises(SystemExit):
+        main(["-np", "4"])
+
+
+def test_bfrun_np_must_match_slots():
+    from bluefog_tpu.run.run import _launch_multi_host, parse_args as pa
+    args = pa(["-np", "3", "-H", "a:2,b:2", "cmd"])
+    with pytest.raises(SystemExit):
+        _launch_multi_host(args, [("a", 2), ("b", 2)])
+
+
+def test_ibfrun_stop_noop():
+    from bluefog_tpu.run.interactive_run import main
+    assert main(["stop"]) == 0
