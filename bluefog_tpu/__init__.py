@@ -32,6 +32,10 @@ from .parallel.dynamic import (
     GetInnerOuterRingDynamicSendRecvRanks,
     GetInnerOuterExpo2DynamicSendRecvRanks,
 )
+from .parallel.infer import (
+    InferSourceFromDestinationRanks,
+    InferDestinationFromSourceRanks,
+)
 from .parallel.schedule import (
     CompiledTopology, DynamicSchedule,
     compile_topology, compile_weight_matrix,
@@ -62,6 +66,11 @@ from .ops.windows import (
 
 from .utils.utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+)
+
+from .grad import (
+    distributed_value_and_grad, distributed_grad,
+    DistributedGradientTape, DistributedOptimizer, broadcast_variables,
 )
 
 from .timeline import (

@@ -1,0 +1,132 @@
+"""Collectively infer reverse edges of a (dynamic) topology.
+
+Reference parity: ``bluefog/torch/topology_util.py:22-108``
+(``InferSourceFromDestinationRanks`` / ``InferDestinationFromSourceRanks``).
+There every MPI rank contributes its own neighbor list and an allgatherv
+assembles the global adjacency.  In this framework one controller drives the
+whole mesh (global view), so the caller passes *all* ranks' lists at once and
+receives all ranks' inferred lists back; the cross-rank exchange the reference
+performs over MPI is pure host metadata here.  When the context is live the
+implementation still routes the degree table through the device ``allgather``
+(padded to uniform shape — SPMD needs static shapes) so the collective code
+path is exercised exactly like the reference's.
+
+The adjacency-matrix construction reproduces the reference's normalization
+formula verbatim: ``W_out[i, j] = W[i, j] / sum_k W[j, k]`` with ``W = I +
+adjacency`` (reference topology_util.py:103-108) — column-normalized for
+regular graphs.
+"""
+
+import collections
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "InferSourceFromDestinationRanks",
+    "InferDestinationFromSourceRanks",
+]
+
+
+def _check_rank_lists(rank_lists: Sequence[Sequence[int]], size: int) -> None:
+    if len(rank_lists) != size:
+        raise ValueError(
+            f"global view requires one rank list per rank: expected {size} "
+            f"lists, got {len(rank_lists)}")
+    for self_rank, rank_list in enumerate(rank_lists):
+        for rank in rank_list:
+            if not isinstance(rank, (int, np.integer)):
+                raise ValueError(
+                    f"rank list of rank {self_rank} contains element that is "
+                    f"not integer.")
+            if rank < 0 or rank >= size:
+                raise ValueError(
+                    f"rank list of rank {self_rank} contains element that is "
+                    f"not between 0 and size-1.")
+        if len(set(rank_list)) != len(rank_list):
+            raise ValueError(
+                f"rank list of rank {self_rank} contains duplicated elements.")
+        if self_rank in rank_list:
+            raise ValueError(
+                f"rank list of rank {self_rank} contains self rank.")
+
+
+def _gather_adjacency(rank_lists: Sequence[Sequence[int]],
+                      size: int) -> dict:
+    """Assemble {rank: sorted neighbor list} — over the device allgather when
+    a context is live (mirrors the reference's collective assembly,
+    topology_util.py:83-91), host-side otherwise."""
+    from .. import context as _ctx_mod
+
+    if _ctx_mod.is_initialized() and _ctx_mod.ctx().size == size:
+        from ..ops import api as _api
+        max_deg = max((len(r) for r in rank_lists), default=0)
+        padded = np.full((size, max(max_deg, 1)), -1, dtype=np.int32)
+        for i, r in enumerate(rank_lists):
+            padded[i, :len(r)] = sorted(r)
+        gathered = np.asarray(_api.allgather(padded[:, None, :]))
+        # every rank's slice is the full [size, max_deg] table; decode rank 0's
+        table = gathered.reshape(size, size, -1)[0]
+        return {i: [int(v) for v in row if v >= 0] for i, row in enumerate(table)}
+    return {i: sorted(int(v) for v in r) for i, r in enumerate(rank_lists)}
+
+
+def _infer_topo(rank_lists: Sequence[Sequence[int]], size: int,
+                transpose: bool, construct_adjacency_matrix: bool):
+    adjacency_dict = _gather_adjacency(rank_lists, size)
+
+    inv_adjacency_dict = collections.defaultdict(list)
+    for k, adj in adjacency_dict.items():
+        for v in adj:
+            inv_adjacency_dict[v].append(k)
+    inferred = [inv_adjacency_dict.get(r, []) for r in range(size)]
+
+    if not construct_adjacency_matrix:
+        return inferred
+
+    W = np.eye(size)
+    for k, adj in adjacency_dict.items():
+        W[k, adj] = 1
+    if transpose:
+        W = W.T
+    return inferred, W / W.sum(axis=1)
+
+
+def InferSourceFromDestinationRanks(
+        dst_ranks: Sequence[Sequence[int]],
+        construct_adjacency_matrix: bool = False,
+) -> Union[List[List[int]], Tuple[List[List[int]], np.ndarray]]:
+    """Infer every rank's source ranks from all ranks' destination lists.
+
+    Args:
+      dst_ranks: ``dst_ranks[i]`` is rank i's destination list (global view;
+        the reference's per-process call, topology_util.py:22-47, passes only
+        the local list and allgathers the rest).
+      construct_adjacency_matrix: also return the reference's normalized
+        adjacency matrix, where ``w_ij`` is the weight sending from node i to
+        node j (column-normalized style).
+
+    Returns:
+      ``src_ranks`` — ``src_ranks[i]`` is the sorted-by-construction list of
+      ranks that send to i; with ``construct_adjacency_matrix`` a 2-D numpy
+      array is returned as well.
+    """
+    size = len(dst_ranks)
+    _check_rank_lists(dst_ranks, size)
+    return _infer_topo(dst_ranks, size, transpose=False,
+                       construct_adjacency_matrix=construct_adjacency_matrix)
+
+
+def InferDestinationFromSourceRanks(
+        src_ranks: Sequence[Sequence[int]],
+        construct_adjacency_matrix: bool = False,
+) -> Union[List[List[int]], Tuple[List[List[int]], np.ndarray]]:
+    """Infer every rank's destination ranks from all ranks' source lists.
+
+    Mirror of :func:`InferSourceFromDestinationRanks` (reference
+    topology_util.py:50-77, ``transpose=True`` branch).
+    """
+    size = len(src_ranks)
+    _check_rank_lists(src_ranks, size)
+    return _infer_topo(src_ranks, size, transpose=True,
+                       construct_adjacency_matrix=construct_adjacency_matrix)

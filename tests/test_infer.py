@@ -1,0 +1,88 @@
+"""Topology inference tests (reference parity: torch/topology_util.py:22-108,
+exercised by test/torch_basics_test.py's infer cases)."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.parallel.infer import (
+    InferSourceFromDestinationRanks,
+    InferDestinationFromSourceRanks,
+)
+
+
+def _graph_lists(G, size):
+    dst = [sorted(r for r in G.successors(i) if r != i) for i in range(size)]
+    src = [sorted(r for r in G.predecessors(i) if r != i) for i in range(size)]
+    return dst, src
+
+
+@pytest.mark.parametrize("gen", [
+    bf.ExponentialTwoGraph, bf.RingGraph, bf.StarGraph, bf.MeshGrid2DGraph,
+])
+@pytest.mark.parametrize("size", [4, 8, 11])
+def test_infer_source_matches_graph(gen, size):
+    G = gen(size)
+    dst, src = _graph_lists(G, size)
+    inferred = InferSourceFromDestinationRanks(dst)
+    assert [sorted(r) for r in inferred] == src
+
+
+@pytest.mark.parametrize("gen", [
+    bf.ExponentialTwoGraph, bf.RingGraph, bf.StarGraph,
+])
+@pytest.mark.parametrize("size", [4, 8, 11])
+def test_infer_destination_matches_graph(gen, size):
+    G = gen(size)
+    dst, src = _graph_lists(G, size)
+    inferred = InferDestinationFromSourceRanks(src)
+    assert [sorted(r) for r in inferred] == dst
+
+
+def test_infer_roundtrip_dynamic_one_peer():
+    size = 8
+    topo = bf.ExponentialTwoGraph(size)
+    gens = [bf.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
+    for _ in range(5):
+        step = [next(g) for g in gens]
+        dst = [s for s, _ in step]
+        recv = [r for _, r in step]
+        inferred = InferSourceFromDestinationRanks(dst)
+        assert [sorted(r) for r in inferred] == [sorted(r) for r in recv]
+
+
+def test_adjacency_matrix_formula():
+    # reference normalization (topology_util.py:103-108):
+    # W = I + adjacency; out[i, j] = W[i, j] / sum_k W[j, k]
+    size = 4
+    dst = [[1], [2], [3], [0]]  # directed ring
+    inferred, W = InferSourceFromDestinationRanks(
+        dst, construct_adjacency_matrix=True)
+    assert inferred == [[3], [0], [1], [2]]
+    raw = np.eye(size)
+    for k, adj in enumerate(dst):
+        raw[k, adj] = 1
+    expected = raw / raw.sum(axis=1)
+    np.testing.assert_allclose(W, expected)
+    # each column (receiving weights of j) sums to 1 on this regular graph
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(size))
+
+
+def test_infer_uses_device_collective_when_initialized(bf_ctx):
+    size = bf.size()
+    G = bf.ExponentialTwoGraph(size)
+    dst, src = _graph_lists(G, size)
+    inferred = InferSourceFromDestinationRanks(dst)
+    assert [sorted(r) for r in inferred] == src
+
+
+@pytest.mark.parametrize("bad, msg", [
+    ([[0, 1], [2], [3], [0]], "self rank"),
+    ([[1, 1], [2], [3], [0]], "duplicated"),
+    ([[9], [2], [3], [0]], "between 0 and size-1"),
+    ([[1.5], [2], [3], [0]], "not integer"),
+])
+def test_infer_validation(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        InferSourceFromDestinationRanks(bad)
