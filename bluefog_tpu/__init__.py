@@ -17,7 +17,9 @@ Typical use mirrors the reference (``bluefog/torch/__init__.py:35-107``):
 """
 
 from . import context as _context
+from . import service
 from .context import BlueFogContext, init, shutdown, is_initialized
+from .utils import blog
 
 from .parallel import topology as topology_util
 from .parallel import dynamic as dynamic_topology

@@ -106,3 +106,47 @@ def _declare(lib):
     lib.bft_timeline_now_us.restype = ctypes.c_int64
     lib.bft_timeline_dropped.argtypes = []
     lib.bft_timeline_dropped.restype = ctypes.c_int64
+    # logging.cc
+    lib.bft_log.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+    lib.bft_log.restype = None
+    lib.bft_log_level.argtypes = []
+    lib.bft_log_level.restype = ctypes.c_int
+    lib.bft_log_set_level.argtypes = [ctypes.c_int]
+    lib.bft_log_set_level.restype = None
+    lib.bft_log_enabled.argtypes = [ctypes.c_int]
+    lib.bft_log_enabled.restype = ctypes.c_int
+    # service.cc
+    lib.bft_service_start.argtypes = [ctypes.c_int]
+    lib.bft_service_start.restype = ctypes.c_int
+    lib.bft_service_stop.argtypes = []
+    lib.bft_service_stop.restype = None
+    lib.bft_service_running.argtypes = []
+    lib.bft_service_running.restype = ctypes.c_int
+    lib.bft_service_set_stall_warning_ms.argtypes = [ctypes.c_int64]
+    lib.bft_service_set_stall_warning_ms.restype = None
+    lib.bft_service_submit.argtypes = [SERVICE_CALLBACK, ctypes.c_int64,
+                                       ctypes.c_int]
+    lib.bft_service_submit.restype = ctypes.c_int64
+    lib.bft_handle_alloc.argtypes = []
+    lib.bft_handle_alloc.restype = ctypes.c_int64
+    lib.bft_handle_mark_done.argtypes = [ctypes.c_int64]
+    lib.bft_handle_mark_done.restype = None
+    lib.bft_handle_mark_error.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.bft_handle_mark_error.restype = None
+    lib.bft_handle_poll.argtypes = [ctypes.c_int64]
+    lib.bft_handle_poll.restype = ctypes.c_int
+    lib.bft_handle_wait.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.bft_handle_wait.restype = ctypes.c_int
+    lib.bft_handle_error_msg.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                         ctypes.c_int]
+    lib.bft_handle_error_msg.restype = ctypes.c_int
+    lib.bft_handle_release.argtypes = [ctypes.c_int64]
+    lib.bft_handle_release.restype = None
+    lib.bft_service_pending.argtypes = []
+    lib.bft_service_pending.restype = ctypes.c_int64
+
+
+# worker-side task entry: cb(handle, tag) — ctypes re-acquires the GIL for
+# the Python trampoline, mirroring the reference's C++-thread -> torch
+# callback boundary (torch/mpi_ops.cc:85-97)
+SERVICE_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int64)

@@ -123,9 +123,10 @@ def from_global(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def _shardmapped(fn, n_outputs: int = 1):
+def _shardmapped(fn, n_outputs: int = 1, check_vma: bool = True):
     """jit(shard_map(fn)) over the 1-D rank mesh; fn sees the per-rank slice
-    (leading axis stripped)."""
+    (leading axis stripped).  ``check_vma=False`` for bodies whose
+    varying-axis types JAX cannot track (pallas interpreter scratch)."""
     cx = ctx()
     spec = P(cx.rank_axis)
 
@@ -140,6 +141,7 @@ def _shardmapped(fn, n_outputs: int = 1):
             shard_fn, mesh=cx.mesh,
             in_specs=tuple(spec for _ in args),
             out_specs=spec if n_outputs == 1 else tuple(spec for _ in range(n_outputs)),
+            check_vma=check_vma,
         )(*args)
 
     return jax.jit(wrapper)
@@ -160,8 +162,25 @@ def _allgather_fn(axis, mesh_id):
     return _shardmapped(lambda x: C.allgather(x, axis))
 
 
+def _nar_backend() -> str:
+    """Neighbor-exchange backend: "xla" (default; chained ppermutes) or
+    "pallas" (fused concurrent-RDMA kernel, ops/pallas_kernels.py;
+    "pallas_interpret" runs the same kernel on the interpreter for CPU test
+    meshes).  Env: BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND."""
+    import os
+    return os.environ.get("BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND", "xla")
+
+
 @functools.lru_cache(maxsize=256)
-def _neighbor_allreduce_fn(axis, topo: CompiledTopology, mesh_id):
+def _neighbor_allreduce_fn(axis, topo: CompiledTopology, mesh_id,
+                           backend="xla"):
+    if backend.startswith("pallas"):
+        from . import pallas_kernels as PK
+        interp = backend == "pallas_interpret"
+        return _shardmapped(
+            lambda x: PK.fused_neighbor_allreduce(x, axis, topo,
+                                                  interpret=interp),
+            check_vma=False)
     return _shardmapped(lambda x: C.neighbor_allreduce(x, axis, topo))
 
 
@@ -280,7 +299,8 @@ def neighbor_allreduce_nonblocking(
             xg, jnp.asarray(weight_matrix))
     else:
         topo = cx.compiled_topology
-        out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id())(xg)
+        out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id(),
+                                     _nar_backend())(xg)
     return _register_handle(out, "neighbor_allreduce", name)
 
 

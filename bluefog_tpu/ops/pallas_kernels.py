@@ -1,0 +1,162 @@
+"""Pallas TPU kernels: fused weighted neighbor exchange.
+
+The XLA path (``collectives.neighbor_allreduce``) lowers one ``lax.ppermute``
+per circulant offset; XLA may serialize those transfers.  This kernel issues
+ALL offsets' RDMAs concurrently — each rides a different ICI link — and folds
+the weighted accumulation into the same kernel, so a K-offset exchange costs
+one link time instead of up to K (SURVEY.md §7 build-order step 10; reference
+fuses the analogous buffers on the MPI side, mpi_controller.cc:561-743).
+
+Pattern follows the ring-collective recipe of the Pallas TPU guide
+(async remote copy + per-slot DMA semaphores + neighbor barrier).  Semantics
+are identical to the XLA path: ``out_i = W[i,i]·x_i + Σ_k W[src_k(i), i]·
+recv_k`` with zero weights dropping absent edges, so partial (non-rotation)
+offsets of irregular graphs stay correct — they just ship one redundant
+tile.
+
+Use via ``neighbor_allreduce(..., backend="pallas")`` on real TPU meshes, or
+``interpret=True`` under the CPU test mesh (the Pallas TPU interpreter
+simulates inter-device DMA).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel.schedule import CompiledTopology, DynamicSchedule
+
+__all__ = ["fused_neighbor_allreduce", "fused_dynamic_neighbor_allreduce"]
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _pad_rows(x2d, rows_mult: int):
+    pad = (-x2d.shape[0]) % rows_mult
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    return x2d
+
+
+def _as_tiles(x):
+    """Flatten to [R, 128] with R a multiple of the float32 sublane count."""
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % _LANE
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    x2d = flat.reshape(-1, _LANE)
+    return _pad_rows(x2d, _SUBLANE)
+
+
+def _exchange_kernel(size: int, offsets, axis_name: str):
+    """Kernel body: start K concurrent RDMAs, barrier, weighted accumulate.
+
+    refs: x, self_w [N], recv_w [K, N] -> out;
+    scratch: recv_buf [K, R, 128], send/recv DMA semaphore arrays [K].
+    """
+    K = len(offsets)
+
+    def kernel(x_ref, self_w_ref, recv_w_ref, out_ref,
+               recv_buf, send_sems, recv_sems):
+        my_id = lax.axis_index(axis_name)
+
+        # neighbor barrier (pallas guide: "Local Barrier Between Neighbors"):
+        # every rank signals each destination once, then waits for its K
+        # senders — guarantees all peers' recv_buf scratch exists before any
+        # RDMA lands.
+        barrier_sem = pltpu.get_barrier_semaphore()
+        for k in range(K):
+            dst = lax.rem(my_id + offsets[k], size)
+            pltpu.semaphore_signal(barrier_sem, inc=1, device_id=dst,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier_sem, K)
+
+        # all offsets in flight together — each targets a distinct neighbor
+        copies = []
+        for k in range(K):
+            dst = lax.rem(my_id + offsets[k], size)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref,
+                dst_ref=recv_buf.at[k],
+                send_sem=send_sems.at[k],
+                recv_sem=recv_sems.at[k],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            copies.append(rdma)
+
+        acc = x_ref[...] * self_w_ref[my_id].astype(x_ref.dtype)
+        for k in range(K):
+            copies[k].wait()
+            w = recv_w_ref[k, my_id].astype(x_ref.dtype)
+            acc += w * recv_buf[k]
+        out_ref[...] = acc
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _run_exchange(x2d, self_w, recv_w, size, offsets, axis_name, interpret):
+    kernel = _exchange_kernel(size, offsets, axis_name)
+    K = len(offsets)
+    return pl.pallas_call(
+        kernel,
+        # vma: the output varies across the mesh axis (required when the
+        # enclosing shard_map checks varying-mesh-axes)
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((K,) + x2d.shape, x2d.dtype),
+            pltpu.SemaphoreType.DMA((K,)),
+            pltpu.SemaphoreType.DMA((K,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=7),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(x2d, self_w, recv_w)
+
+
+def _fused_exchange(x, axis_name, size, offsets, self_w, recv_w,
+                    interpret: bool):
+    if not offsets:
+        return x * jnp.asarray(self_w)[lax.axis_index(axis_name)].astype(x.dtype)
+    x2d = _as_tiles(x)
+    out2d = _run_exchange(
+        x2d, jnp.asarray(self_w, jnp.float32), jnp.asarray(recv_w, jnp.float32),
+        size, tuple(int(o) for o in offsets), axis_name, bool(interpret))
+    return out2d.reshape(-1)[: int(np.prod(x.shape))].reshape(x.shape)
+
+
+def fused_neighbor_allreduce(x, axis_name, topo: CompiledTopology,
+                             interpret: bool = False):
+    """Drop-in for ``collectives.neighbor_allreduce`` (call inside
+    shard_map): one fused kernel instead of K chained ppermutes."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        raise TypeError("fused_neighbor_allreduce requires a float dtype")
+    K = len(topo.shifts)
+    recv_w = np.zeros((max(K, 1), topo.size), np.float32)
+    for k, s in enumerate(topo.shifts):
+        recv_w[k] = s.recv_weights
+    return _fused_exchange(x, axis_name, topo.size, topo.offsets,
+                           topo.self_weights, recv_w, interpret)
+
+
+def fused_dynamic_neighbor_allreduce(x, axis_name, sched: DynamicSchedule,
+                                     step, interpret: bool = False):
+    """Dynamic-schedule variant: the step's weight tables are gathered
+    outside the kernel (pure data — no recompilation across steps)."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        raise TypeError("fused_dynamic_neighbor_allreduce requires a float dtype")
+    t = jnp.asarray(step) % sched.period
+    self_w = jnp.asarray(sched.self_weights, jnp.float32)[t]   # [N]
+    recv_w = jnp.asarray(sched.recv_weights, jnp.float32)[t]   # [K, N]
+    return _fused_exchange(x, axis_name, sched.size, sched.offsets,
+                           self_w, recv_w, interpret)

@@ -24,6 +24,7 @@ dynamic windows never recompile.
 """
 
 import functools
+import os
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Union
 
@@ -33,6 +34,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import service as _service
 from ..context import ctx
 from ..parallel.schedule import CompiledTopology
 from . import api as _api
@@ -75,6 +77,33 @@ class _Window:
 
 _windows: Dict[str, _Window] = {}
 _with_associated_p = [False]
+
+# -- true-async dispatch (opt-in) -------------------------------------------
+#
+# By default window nonblocking ops dispatch their jitted program from the
+# caller's thread (JAX async dispatch hides device latency).  With
+# BLUEFOG_WIN_ASYNC=1 the enqueue itself moves onto the native background
+# service (csrc/service.cc) — the caller returns before any tracing/dispatch
+# happens, reproducing the reference's comm-thread model
+# (operations.cc:1619-1623); all window tasks share one service lane, so
+# they retain FIFO order exactly like the single MPI comm thread.  As in the
+# reference, racing an un-waited put against win_update is the caller's
+# responsibility (win_wait first, or take win_mutex).
+_ASYNC_BASE = 1 << 40
+
+
+def _win_async_enabled() -> bool:
+    return os.environ.get("BLUEFOG_WIN_ASYNC", "0") == "1"
+
+
+def _dispatch_win_op(run, result_of=None):
+    """Run ``run()`` inline (default) or on the service lane (async mode).
+
+    Returns an int handle valid for win_wait/win_poll either way."""
+    if _win_async_enabled():
+        return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE)
+    run()
+    return _register_handle(result_of() if result_of else None)
 
 
 def _slot_tables(topo: CompiledTopology) -> np.ndarray:
@@ -305,12 +334,15 @@ def win_put_nonblocking(tensor, name: str,
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
     fn = _push_fn(w.topo, False, id(cx.mesh))
-    x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
-    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-        x, w.buffers, w.versions, w.p, w.p_buffers,
-        jnp.asarray(D, jnp.float32), jnp.asarray(sw),
-        jnp.asarray(_with_associated_p[0]))
-    return _register_handle(w.tensor)
+    with_p = _with_associated_p[0]
+
+    def run():
+        x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+            x, w.buffers, w.versions, w.p, w.p_buffers,
+            jnp.asarray(D, jnp.float32), jnp.asarray(sw),
+            jnp.asarray(with_p))
+    return _dispatch_win_op(run, lambda: w.tensor)
 
 
 def win_put(tensor, name: str, self_weight=None, dst_weights=None,
@@ -331,12 +363,15 @@ def win_accumulate_nonblocking(tensor, name: str,
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
     fn = _push_fn(w.topo, True, id(cx.mesh))
-    x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
-    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-        x, w.buffers, w.versions, w.p, w.p_buffers,
-        jnp.asarray(D, jnp.float32), jnp.asarray(sw),
-        jnp.asarray(_with_associated_p[0]))
-    return _register_handle(w.tensor)
+    with_p = _with_associated_p[0]
+
+    def run():
+        x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+            x, w.buffers, w.versions, w.p, w.p_buffers,
+            jnp.asarray(D, jnp.float32), jnp.asarray(sw),
+            jnp.asarray(with_p))
+    return _dispatch_win_op(run, lambda: w.tensor)
 
 
 def win_accumulate(tensor, name: str, self_weight=None, dst_weights=None,
@@ -356,11 +391,14 @@ def win_get_nonblocking(name: str,
     cx = ctx()
     G = _out_matrix(w.topo, src_weights)
     fn = _push_fn(w.topo, False, id(cx.mesh))
-    (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-        w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
-        jnp.asarray(G, jnp.float32), _self_weight_vector(w.topo.size, None),
-        jnp.asarray(_with_associated_p[0]))
-    return _register_handle(w.buffers)
+    with_p = _with_associated_p[0]
+
+    def run():
+        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+            w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
+            jnp.asarray(G, jnp.float32),
+            _self_weight_vector(w.topo.size, None), jnp.asarray(with_p))
+    return _dispatch_win_op(run, lambda: w.buffers)
 
 
 def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
@@ -425,10 +463,15 @@ def win_fetch(name: str):
 
 
 def win_poll(handle: int) -> bool:
+    if handle >= _ASYNC_BASE // 2:
+        return _service.poll(handle - _ASYNC_BASE)
     return _api.poll(handle)
 
 
 def win_wait(handle: int) -> bool:
+    if handle >= _ASYNC_BASE // 2:
+        _service.wait(handle - _ASYNC_BASE)
+        return True
     synchronize(handle)
     return True
 

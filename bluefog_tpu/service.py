@@ -1,0 +1,197 @@
+"""Background communication service (reference parity: the core-runtime
+background thread + handle manager, operations.cc:453-522 /
+torch/handle_manager.{h,cc}, and the stall watchdog, operations.cc:388-433).
+
+The native side (``csrc/service.cc``) owns the worker pool, the integer
+handle table (pending/done/error + condition-variable waits), and the stall
+watchdog.  Python submits closures; ctypes trampolines them onto the native
+workers.  Two usage modes:
+
+* ``submit(fn)`` — run ``fn`` on a worker, get a handle back immediately.
+  Window ops use one shared lane so they retain the reference's
+  single-comm-thread FIFO ordering (global_state.h:40-43) while staying off
+  the caller's thread (true nonblocking enqueue, SURVEY.md §7 hard part 1b).
+* ``alloc_handle()/mark_done()`` — use the native handle table for work
+  completed elsewhere.
+
+Falls back to synchronous inline execution when no native toolchain exists
+(handles are then born done — semantics identical, latency hidden only by
+JAX async dispatch).
+"""
+
+import atexit
+import ctypes
+import threading
+from typing import Callable, Dict
+
+from . import native
+from .utils import blog
+
+__all__ = ["start", "stop", "running", "submit", "poll", "wait", "release",
+           "pending", "WIN_LANE"]
+
+# all window ops share one lane => FIFO like the reference's comm thread
+WIN_LANE = 0
+
+_lock = threading.Lock()
+_lifecycle_lock = threading.Lock()
+_tasks: Dict[int, Callable[[], None]] = {}
+_results: Dict[int, object] = {}
+_errors: Dict[int, str] = {}
+_next_tag = [1]
+_trampoline_ref = []  # keep the CFUNCTYPE object alive for the process
+
+
+def _trampoline(handle, tag):
+    with _lock:
+        fn = _tasks.pop(tag, None)
+    if fn is None:
+        return
+    lib = native.load()
+    try:
+        result = fn()
+        with _lock:
+            _results[handle] = result
+    except Exception as e:  # surfaced via the handle, like a Status callback
+        with _lock:
+            _errors[handle] = str(e)
+        if lib is not None:
+            lib.bft_handle_mark_error(handle, str(e).encode()[:512])
+        blog.log(blog.ERROR, f"async task failed: {e}")
+
+
+def _lib_or_none(num_threads: int = 0):
+    lib = native.load()
+    if lib is None:
+        return None
+    with _lifecycle_lock:
+        if not _trampoline_ref:
+            _trampoline_ref.append(native.SERVICE_CALLBACK(_trampoline))
+        if not lib.bft_service_running():
+            lib.bft_service_start(num_threads)
+    return lib
+
+
+def start(num_threads: int = 0) -> int:
+    """Start the native worker pool (idempotent; returns the pool size).
+    ``num_threads<=0`` reads ``BLUEFOG_NUM_SERVICE_THREADS`` (default 1)."""
+    lib = _lib_or_none(num_threads)
+    if lib is None:
+        return 0
+    # already-running pools keep their size (the native start reports it)
+    return int(lib.bft_service_start(num_threads))
+
+
+def stop() -> None:
+    lib = native.load()
+    if lib is not None and lib.bft_service_running():
+        lib.bft_service_stop()
+    with _lock:
+        _tasks.clear()
+        _results.clear()
+        _errors.clear()
+
+
+def running() -> bool:
+    lib = native.load()
+    return bool(lib is not None and lib.bft_service_running())
+
+
+def submit(fn: Callable[[], object], lane: int = -1) -> int:
+    """Run ``fn`` on a service worker; returns a handle immediately.
+
+    The return value of ``fn`` is retrievable via :func:`wait`; exceptions
+    mark the handle errored and re-raise at wait time (reference semantics:
+    the status callback carries the error to ``synchronize``,
+    torch/mpi_ops.cc:85-97).
+    """
+    lib = _lib_or_none()
+    if lib is None:
+        # no native runtime: run inline; the handle is born completed
+        with _lock:
+            handle = -_next_tag[0] - 1
+            _next_tag[0] += 1
+        try:
+            result = fn()
+            with _lock:
+                _results[handle] = result
+        except Exception as e:
+            with _lock:
+                _errors[handle] = str(e)
+        return handle
+    with _lock:
+        tag = _next_tag[0]
+        _next_tag[0] += 1
+        _tasks[tag] = fn
+    handle = int(lib.bft_service_submit(_trampoline_ref[0], tag, lane))
+    if handle < 0:
+        with _lock:
+            _tasks.pop(tag, None)
+        raise RuntimeError("service not running")
+    return handle
+
+
+def poll(handle: int) -> bool:
+    if handle < 0:  # inline fallback handle
+        return True
+    lib = native.load()
+    if lib is None:
+        return True
+    return int(lib.bft_handle_poll(handle)) != 0
+
+
+def wait(handle: int, timeout_ms: int = -1):
+    """Block until the task completes; returns its result or raises its
+    exception.  The handle is released."""
+    if handle < 0 or native.load() is None:
+        with _lock:
+            err = _errors.pop(handle, None)
+            if err is None:
+                return _results.pop(handle, None)
+        raise RuntimeError(err)
+    lib = native.load()
+    state = int(lib.bft_handle_wait(handle, timeout_ms))
+    if state == 0:
+        raise TimeoutError(f"handle {handle} still pending after "
+                           f"{timeout_ms}ms")
+    if state == -2:
+        raise RuntimeError(
+            f"handle {handle} is unknown (already waited/released, or the "
+            f"service was stopped before the task ran)")
+    try:
+        if state == 2:
+            with _lock:
+                err = _errors.pop(handle, None)
+            if err is None:
+                cbuf = ctypes.create_string_buffer(512)
+                lib.bft_handle_error_msg(handle, cbuf, 512)
+                err = cbuf.value.decode(errors="replace")
+            raise RuntimeError(err)
+        with _lock:
+            return _results.pop(handle, None)
+    finally:
+        lib.bft_handle_release(handle)
+        with _lock:
+            _errors.pop(handle, None)
+            _results.pop(handle, None)
+
+
+def release(handle: int) -> None:
+    lib = native.load()
+    if lib is not None and handle >= 0:
+        lib.bft_handle_release(handle)
+    with _lock:
+        _results.pop(handle, None)
+        _errors.pop(handle, None)
+
+
+def pending() -> int:
+    lib = native.load()
+    if lib is None:
+        return 0
+    return int(lib.bft_service_pending())
+
+
+# join native workers before interpreter teardown (static-destructor order
+# in the shared library is otherwise undefined across platforms)
+atexit.register(stop)

@@ -1,0 +1,86 @@
+"""Leveled native logging (reference parity: ``BFLOG`` macros,
+bluefog/common/logging.{h,cc}; env surface docs/env_variable.rst:8-22).
+
+Routes through ``csrc/logging.cc`` when the native library is available so
+Python and C++ components share one sink, level filter
+(``BLUEFOG_LOG_LEVEL``), and format; falls back to the stdlib ``logging``
+logger "bluefog" otherwise (reference basics.py:27-34 keeps the same
+Python-side logger name).
+"""
+
+import logging as _pylogging
+import os
+
+from .. import native
+
+__all__ = ["TRACE", "DEBUG", "INFO", "WARN", "ERROR", "FATAL",
+           "log", "set_level", "get_level", "enabled"]
+
+TRACE, DEBUG, INFO, WARN, ERROR, FATAL = range(6)
+
+_LEVEL_NAMES = ["trace", "debug", "info", "warn", "error", "fatal"]
+_PY_LEVELS = [5, _pylogging.DEBUG, _pylogging.INFO, _pylogging.WARNING,
+              _pylogging.ERROR, _pylogging.CRITICAL]
+
+_pylogger = _pylogging.getLogger("bluefog")
+_fallback_level = [None]
+
+
+def _configure_fallback() -> None:
+    """Make the stdlib logger actually emit what blog's filter passes: the
+    'bluefog' logger would otherwise inherit the root WARNING level and drop
+    debug/info exactly where the fallback is needed."""
+    if _fallback_level[0] is None:
+        _fallback_level[0] = _env_level()
+    if not _pylogger.handlers:
+        handler = _pylogging.StreamHandler()
+        handler.setFormatter(_pylogging.Formatter("%(message)s"))
+        _pylogger.addHandler(handler)
+        _pylogger.propagate = False
+    _pylogger.setLevel(_PY_LEVELS[_fallback_level[0]])
+
+
+def _env_level() -> int:
+    name = os.environ.get("BLUEFOG_LOG_LEVEL", "warn")
+    if name in _LEVEL_NAMES:
+        return _LEVEL_NAMES.index(name)
+    try:
+        return max(TRACE, min(FATAL, int(name)))
+    except ValueError:
+        return WARN
+
+
+def log(level: int, msg: str, rank: int = -1) -> None:
+    """Emit one leveled line; ``rank`` tags the message like BFLOG(level,
+    rank).  FATAL aborts the process in the native path (reference parity)."""
+    lib = native.load()
+    if lib is not None:
+        lib.bft_log(int(level), int(rank), str(msg).encode())
+        return
+    _configure_fallback()
+    if level < _fallback_level[0]:
+        return
+    prefix = f"[{rank}]" if rank >= 0 else ""
+    _pylogger.log(_PY_LEVELS[max(TRACE, min(FATAL, level))], "%s%s", prefix, msg)
+
+
+def set_level(level: int) -> None:
+    lib = native.load()
+    if lib is not None:
+        lib.bft_log_set_level(int(level))
+    else:
+        _fallback_level[0] = int(level)
+        _configure_fallback()
+
+
+def get_level() -> int:
+    lib = native.load()
+    if lib is not None:
+        return int(lib.bft_log_level())
+    if _fallback_level[0] is None:
+        _fallback_level[0] = _env_level()
+    return _fallback_level[0]
+
+
+def enabled(level: int) -> bool:
+    return int(level) >= get_level()

@@ -1,0 +1,100 @@
+// bluefog_tpu native logging.
+//
+// TPU-native counterpart of the reference's BFLOG machinery
+// (reference: bluefog/common/logging.{h,cc} — LogMessage levels, env
+// control documented at docs/env_variable.rst:8-22).  Same contract:
+// leveled, rank-tagged, single-write-per-line messages on stderr, with
+//   BLUEFOG_LOG_LEVEL     = trace|debug|info|warn|error|fatal (default warn)
+//   BLUEFOG_LOG_HIDE_TIME = 1 to suppress the timestamp prefix
+// Used by the other native components (service.cc) and exposed to Python
+// over ctypes (bluefog_tpu/utils/blog.py).
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace {
+
+enum Level { TRACE = 0, DEBUG = 1, INFO = 2, WARN = 3, ERROR = 4, FATAL = 5 };
+
+const char* kLevelNames[] = {"trace", "debug", "info", "warn", "error", "fatal"};
+
+int parse_level(const char* s) {
+  if (!s) return WARN;
+  for (int i = 0; i <= FATAL; ++i)
+    if (std::strcmp(s, kLevelNames[i]) == 0) return i;
+  // numeric form also accepted (reference accepts the names only; numbers
+  // make programmatic control over ctypes trivial)
+  if (s[0] >= '0' && s[0] <= '5' && s[1] == '\0') return s[0] - '0';
+  return WARN;
+}
+
+struct Config {
+  std::atomic<int> min_level;
+  bool hide_time;
+  std::mutex write_mu;
+
+  Config() {
+    min_level.store(parse_level(std::getenv("BLUEFOG_LOG_LEVEL")));
+    const char* hide = std::getenv("BLUEFOG_LOG_HIDE_TIME");
+    hide_time = hide && hide[0] == '1';
+  }
+};
+
+Config* config() {
+  static Config c;
+  return &c;
+}
+
+}  // namespace
+
+extern "C" {
+
+int bft_log_level() { return config()->min_level.load(); }
+
+void bft_log_set_level(int level) {
+  if (level < TRACE) level = TRACE;
+  if (level > FATAL) level = FATAL;
+  config()->min_level.store(level);
+}
+
+int bft_log_enabled(int level) {
+  return level >= config()->min_level.load() ? 1 : 0;
+}
+
+// rank < 0 omits the rank tag (reference BFLOG(level) vs BFLOG(level, rank)).
+void bft_log(int level, int rank, const char* msg) {
+  Config* c = config();
+  if (level < c->min_level.load()) return;
+  if (level < TRACE) level = TRACE;
+  if (level > FATAL) level = FATAL;
+  char line[1024];
+  size_t off = 0;
+  if (!c->hide_time) {
+    auto now = std::chrono::system_clock::now();
+    std::time_t t = std::chrono::system_clock::to_time_t(now);
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch())
+                  .count() %
+              1000000;
+    std::tm tm_buf;
+    localtime_r(&t, &tm_buf);
+    off += std::strftime(line + off, sizeof line - off, "%Y-%m-%d %H:%M:%S",
+                         &tm_buf);
+    off += std::snprintf(line + off, sizeof line - off, ".%06lld ",
+                         (long long)us);
+  }
+  if (rank >= 0)
+    off += std::snprintf(line + off, sizeof line - off, "[%d]", rank);
+  std::snprintf(line + off, sizeof line - off, "[%s] %s\n",
+                kLevelNames[level], msg ? msg : "");
+  std::lock_guard<std::mutex> lk(c->write_mu);
+  std::fputs(line, stderr);
+  if (level == FATAL) std::abort();
+}
+
+}  // extern "C"

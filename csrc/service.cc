@@ -1,0 +1,272 @@
+// bluefog_tpu background communication service.
+//
+// TPU-native re-design of the reference's core runtime thread + handle
+// manager (reference: bluefog/common/operations.cc:453-522 background loop,
+// bluefog/torch/handle_manager.{h,cc} integer-handle table,
+// operations.cc:388-433 stall watchdog).  On MPI the background thread IS
+// the data path — every collective funnels through it.  On TPU the data
+// path is XLA async dispatch, so what remains native is exactly what this
+// file implements:
+//
+//   * a handle table: integer handles with pending/done/error state,
+//     condition-variable waits, and error-message transport;
+//   * an asynchronous executor: submitted tasks (Python closures delivered
+//     as C function pointers over ctypes) run on a native worker pool
+//     (thread_pool.h); a `lane` pins related tasks (e.g. all window ops of
+//     one process) to one worker, reproducing the reference's
+//     one-comm-thread FIFO ordering (global_state.h:40-43);
+//   * a stall watchdog: a scanner thread that reports handles pending
+//     longer than BLUEFOG_STALL_WARNING_SEC (default 60, reference
+//     operations.cc:46-47) through the native log.
+//
+// Consumed from Python via ctypes (bluefog_tpu/service.py).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "thread_pool.h"
+
+extern "C" void bft_log(int level, int rank, const char* msg);
+
+namespace {
+
+enum HandleState { PENDING = 0, DONE = 1, ERROR = 2 };
+
+struct HandleInfo {
+  HandleState state = PENDING;
+  std::string error;
+  std::chrono::steady_clock::time_point enqueued;
+  std::chrono::steady_clock::time_point last_warn;
+};
+
+class Service {
+ public:
+  int start(int num_threads) {
+    std::lock_guard<std::mutex> lk(lifecycle_mu_);
+    if (running_) return pool_.size();
+    if (num_threads <= 0) {
+      const char* env = std::getenv("BLUEFOG_NUM_SERVICE_THREADS");
+      num_threads = env ? std::atoi(env) : 1;
+      if (num_threads <= 0) num_threads = 1;
+    }
+    const char* stall = std::getenv("BLUEFOG_STALL_WARNING_SEC");
+    stall_warning_ms_ = stall ? (int64_t)(std::atof(stall) * 1000) : 60000;
+    pool_.start(num_threads);
+    watchdog_stop_ = false;
+    watchdog_ = std::thread([this] { this->watchdog_loop(); });
+    running_ = true;
+    return num_threads;
+  }
+
+  void stop() {
+    std::lock_guard<std::mutex> lk(lifecycle_mu_);
+    if (!running_) return;
+    pool_.stop();
+    {
+      std::lock_guard<std::mutex> hlk(mu_);
+      watchdog_stop_ = true;
+    }
+    cv_.notify_all();
+    if (watchdog_.joinable()) watchdog_.join();
+    {
+      std::lock_guard<std::mutex> hlk(mu_);
+      handles_.clear();
+    }
+    // wake any waiter blocked on a handle whose task was dropped with the
+    // queue: it re-checks, finds the handle gone, and returns "unknown"
+    cv_.notify_all();
+    running_ = false;
+  }
+
+  bool running() const { return running_; }
+
+  void set_stall_warning_ms(int64_t ms) { stall_warning_ms_ = ms; }
+
+  int64_t alloc_handle() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t h = next_handle_++;
+    HandleInfo info;
+    info.enqueued = std::chrono::steady_clock::now();
+    info.last_warn = info.enqueued;
+    handles_[h] = std::move(info);
+    return h;
+  }
+
+  int64_t submit(void (*cb)(int64_t, int64_t), int64_t tag, int lane) {
+    if (!running_) return -1;
+    int64_t h = alloc_handle();
+    pool_.execute(
+        [this, cb, tag, h] {
+          cb(h, tag);
+          // callbacks that hit an error mark it before returning; anything
+          // still pending completed successfully
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = handles_.find(h);
+          if (it != handles_.end() && it->second.state == PENDING)
+            it->second.state = DONE;
+          cv_.notify_all();
+        },
+        lane);
+    return h;
+  }
+
+  void mark_done(int64_t h) { set_state(h, DONE, nullptr); }
+
+  void mark_error(int64_t h, const char* msg) { set_state(h, ERROR, msg); }
+
+  // -2 unknown handle, else HandleState
+  int poll(int64_t h) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = handles_.find(h);
+    if (it == handles_.end()) return -2;
+    return it->second.state;
+  }
+
+  // timeout_ms < 0: wait forever.  Returns like poll(); PENDING on timeout.
+  int wait(int64_t h, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+    for (;;) {
+      auto it = handles_.find(h);
+      if (it == handles_.end()) return -2;
+      if (it->second.state != PENDING) return it->second.state;
+      if (timeout_ms < 0) {
+        cv_.wait(lk);
+      } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        auto it2 = handles_.find(h);
+        if (it2 == handles_.end()) return -2;
+        return it2->second.state;
+      }
+    }
+  }
+
+  int error_msg(int64_t h, char* buf, int len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = handles_.find(h);
+    if (it == handles_.end() || len <= 0) return -1;
+    std::snprintf(buf, len, "%s", it->second.error.c_str());
+    return (int)it->second.error.size();
+  }
+
+  void release(int64_t h) {
+    std::lock_guard<std::mutex> lk(mu_);
+    handles_.erase(h);
+  }
+
+  int64_t pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t n = 0;
+    for (const auto& kv : handles_)
+      if (kv.second.state == PENDING) ++n;
+    return n;
+  }
+
+ private:
+  void set_state(int64_t h, HandleState s, const char* msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = handles_.find(h);
+    if (it == handles_.end()) return;
+    it->second.state = s;
+    if (msg) it->second.error = msg;
+    cv_.notify_all();
+  }
+
+  void watchdog_loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!watchdog_stop_) {
+      cv_.wait_for(lk, std::chrono::milliseconds(1000));
+      if (watchdog_stop_) return;
+      auto now = std::chrono::steady_clock::now();
+      for (auto& kv : handles_) {
+        if (kv.second.state != PENDING) continue;
+        auto since_warn = std::chrono::duration_cast<std::chrono::milliseconds>(
+                              now - kv.second.last_warn)
+                              .count();
+        if (since_warn < stall_warning_ms_) continue;
+        auto age_s = std::chrono::duration_cast<std::chrono::seconds>(
+                         now - kv.second.enqueued)
+                         .count();
+        char msg[256];
+        std::snprintf(msg, sizeof msg,
+                      "operation handle %lld has been pending for %llds -- "
+                      "one or more async ops may be stalled (reference stall "
+                      "watchdog: operations.cc:388-433)",
+                      (long long)kv.first, (long long)age_s);
+        kv.second.last_warn = now;
+        bft_log(/*warn*/ 3, -1, msg);
+      }
+    }
+  }
+
+  std::mutex lifecycle_mu_;
+  std::mutex mu_;  // guards handles_ + watchdog wakeups
+  std::condition_variable cv_;
+  std::unordered_map<int64_t, HandleInfo> handles_;
+  int64_t next_handle_ = 1;
+  bft::ThreadPool pool_;
+  std::thread watchdog_;
+  bool watchdog_stop_ = false;
+  std::atomic<bool> running_{false};
+  std::atomic<int64_t> stall_warning_ms_{60000};
+};
+
+Service* service() {
+  static Service s;
+  return &s;
+}
+
+}  // namespace
+
+extern "C" {
+
+int bft_service_start(int num_threads) { return service()->start(num_threads); }
+
+void bft_service_stop() { service()->stop(); }
+
+int bft_service_running() { return service()->running() ? 1 : 0; }
+
+void bft_service_set_stall_warning_ms(int64_t ms) {
+  service()->set_stall_warning_ms(ms);
+}
+
+// cb runs on a worker thread as cb(handle, tag); lane >= 0 serializes with
+// other tasks on the same lane.  Returns the handle, or -1 if not running.
+int64_t bft_service_submit(void (*cb)(int64_t, int64_t), int64_t tag,
+                           int lane) {
+  return service()->submit(cb, tag, lane);
+}
+
+// handle table also usable without submit(): allocate, complete elsewhere
+int64_t bft_handle_alloc() { return service()->alloc_handle(); }
+
+void bft_handle_mark_done(int64_t h) { service()->mark_done(h); }
+
+void bft_handle_mark_error(int64_t h, const char* msg) {
+  service()->mark_error(h, msg);
+}
+
+int bft_handle_poll(int64_t h) { return service()->poll(h); }
+
+int bft_handle_wait(int64_t h, int64_t timeout_ms) {
+  return service()->wait(h, timeout_ms);
+}
+
+int bft_handle_error_msg(int64_t h, char* buf, int len) {
+  return service()->error_msg(h, buf, len);
+}
+
+void bft_handle_release(int64_t h) { service()->release(h); }
+
+int64_t bft_service_pending() { return service()->pending(); }
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+// bluefog_tpu native work-queue thread pool.
+//
+// TPU-native counterpart of the reference's finalizer pool
+// (reference: bluefog/common/thread_pool.{h,cc} — execute() work queue,
+// sized by BLUEFOG_NUM_FINALIZER_THREADS at nccl_controller.cc:204-209).
+// Header-only; consumed by service.cc.
+
+#ifndef BLUEFOG_TPU_CSRC_THREAD_POOL_H_
+#define BLUEFOG_TPU_CSRC_THREAD_POOL_H_
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace bft {
+
+class ThreadPool {
+ public:
+  ~ThreadPool() { stop(); }
+
+  void start(int num_threads) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!threads_.empty()) return;
+    stop_ = false;
+    for (int i = 0; i < num_threads; ++i)
+      threads_.emplace_back([this, i] { loop(i); });
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+    // drop queued-but-unrun work: after stop() the owner is shutting down
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.clear();
+  }
+
+  int size() const { return (int)threads_.size(); }
+
+  // lane >= 0 pins the task to worker (lane % size): tasks sharing a lane
+  // execute in submission order even with a multi-thread pool — this is how
+  // window ops keep the reference's single-comm-thread FIFO semantics
+  // (reference global_state.h:40-43) while other work fans out.
+  void execute(std::function<void()> fn, int lane = -1) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push_back({std::move(fn), lane});
+    }
+    cv_.notify_all();
+  }
+
+  size_t pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return queue_.size() + running_;
+  }
+
+ private:
+  struct Task {
+    std::function<void()> fn;
+    int lane;
+  };
+
+  void loop(int worker_id) {
+    for (;;) {
+      Task task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this, worker_id] {
+          return stop_ || claimable(worker_id);
+        });
+        if (stop_) return;
+        bool found = false;
+        for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+          if (it->lane < 0 || (it->lane % (int)threads_.size()) == worker_id) {
+            task = std::move(*it);
+            queue_.erase(it);
+            found = true;
+            break;
+          }
+        }
+        if (!found) continue;
+        ++running_;
+      }
+      task.fn();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --running_;
+      }
+    }
+  }
+
+  bool claimable(int worker_id) {
+    for (const auto& t : queue_)
+      if (t.lane < 0 || (t.lane % (int)threads_.size()) == worker_id)
+        return true;
+    return false;
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Task> queue_;
+  std::vector<std::thread> threads_;
+  size_t running_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace bft
+
+#endif  // BLUEFOG_TPU_CSRC_THREAD_POOL_H_

@@ -1,0 +1,174 @@
+"""Native background service + logging tests (reference parity:
+handle_manager semantics, torch/handle_manager.h:30-41; stall watchdog,
+operations.cc:388-433; BFLOG env control, docs/env_variable.rst:8-22)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import native, service
+from bluefog_tpu.utils import blog
+
+
+needs_native = pytest.mark.skipif(
+    native.load() is None, reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def svc():
+    service.start()
+    yield service
+    service.stop()
+
+
+@needs_native
+def test_submit_wait_returns_result(svc):
+    h = service.submit(lambda: 41 + 1)
+    assert service.wait(h) == 42
+
+
+@needs_native
+def test_submit_error_propagates(svc):
+    def boom():
+        raise ValueError("deliberate failure")
+    h = service.submit(boom)
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+        service.wait(h)
+
+
+@needs_native
+def test_poll_transitions(svc):
+    gate = threading.Event()
+
+    def task():
+        gate.wait(5)
+        return "done"
+    h = service.submit(task)
+    assert not service.poll(h)
+    gate.set()
+    assert service.wait(h) == "done"
+    # released handle: poll now reports completed/unknown, not pending
+    assert service.poll(h)
+
+
+@needs_native
+def test_lane_serializes_fifo(svc):
+    order = []
+    gate = threading.Event()
+
+    def first():
+        gate.wait(5)
+        order.append(1)
+
+    def second():
+        order.append(2)
+
+    h1 = service.submit(first, lane=service.WIN_LANE)
+    h2 = service.submit(second, lane=service.WIN_LANE)
+    gate.set()
+    service.wait(h1)
+    service.wait(h2)
+    assert order == [1, 2]
+
+
+@needs_native
+def test_handle_table_direct():
+    lib = native.load()
+    service.start()
+    try:
+        h = lib.bft_handle_alloc()
+        assert lib.bft_handle_poll(h) == 0  # pending
+        lib.bft_handle_mark_done(h)
+        assert lib.bft_handle_wait(h, 1000) == 1
+        lib.bft_handle_release(h)
+        assert lib.bft_handle_poll(h) == -2  # unknown after release
+    finally:
+        service.stop()
+
+
+@needs_native
+def test_wait_timeout():
+    service.start()
+    try:
+        gate = threading.Event()
+        h = service.submit(lambda: gate.wait(10))
+        lib = native.load()
+        assert lib.bft_handle_wait(h, 50) == 0  # still pending
+        gate.set()
+        service.wait(h)
+    finally:
+        service.stop()
+
+
+@needs_native
+def test_stall_watchdog_logs(capfd):
+    service.start()
+    lib = native.load()
+    lib.bft_service_set_stall_warning_ms(100)
+    try:
+        gate = threading.Event()
+        h = service.submit(lambda: gate.wait(30))
+        time.sleep(2.5)  # watchdog scans every 1s
+        gate.set()
+        service.wait(h)
+        err = capfd.readouterr().err
+        assert "pending" in err and "stalled" in err
+    finally:
+        lib.bft_service_set_stall_warning_ms(60000)
+        service.stop()
+
+
+def test_blog_levels():
+    old = blog.get_level()
+    try:
+        blog.set_level(blog.ERROR)
+        assert not blog.enabled(blog.INFO)
+        assert blog.enabled(blog.FATAL)
+        blog.set_level(blog.TRACE)
+        assert blog.enabled(blog.TRACE)
+    finally:
+        blog.set_level(old)
+
+
+@needs_native
+def test_blog_writes_stderr(capfd):
+    old = blog.get_level()
+    try:
+        blog.set_level(blog.INFO)
+        blog.log(blog.INFO, "hello from blog", rank=3)
+        err = capfd.readouterr().err
+        assert "hello from blog" in err
+        assert "[3]" in err
+        assert "[info]" in err
+    finally:
+        blog.set_level(old)
+
+
+@needs_native
+def test_async_window_mode(bf_ctx, monkeypatch):
+    """BLUEFOG_WIN_ASYNC=1: puts dispatch via the native lane; results match
+    the synchronous path exactly."""
+    monkeypatch.setenv("BLUEFOG_WIN_ASYNC", "1")
+    service.start()
+    try:
+        n = bf.size()
+        x = np.arange(n, dtype=np.float32)[:, None] + 1.0
+        assert bf.win_create(x, "svc.win")
+        h = bf.win_put_nonblocking(x, "svc.win")
+        assert h >= (1 << 39)  # service-handle namespace
+        assert bf.win_wait(h)
+        got = np.asarray(bf.win_update("svc.win"))
+        # compare against the synchronous path on a second window
+        monkeypatch.setenv("BLUEFOG_WIN_ASYNC", "0")
+        assert bf.win_create(x, "sync.win")
+        h2 = bf.win_put_nonblocking(x, "sync.win")
+        bf.win_wait(h2)
+        expected = np.asarray(bf.win_update("sync.win"))
+        np.testing.assert_allclose(got, expected)
+    finally:
+        bf.win_free()
+        service.stop()
