@@ -16,6 +16,25 @@ Typical use mirrors the reference (``bluefog/torch/__init__.py:35-107``):
     y = bf.neighbor_allreduce(x)     # x: [bf.size(), ...] global view
 """
 
+import os as _os
+
+import jax as _jax
+
+# Honor an explicit JAX_PLATFORMS=cpu request even when a site customization
+# has pinned the platform config (which silently overrides the env var):
+# re-assert it before any backend exists.  Critical for the virtual CPU mesh
+# workflow (tests/launchers export JAX_PLATFORMS=cpu + xla_force_host_
+# platform_device_count — SURVEY.md §4 TPU translation note).  Only a
+# cpu-containing value is honored: non-cpu values are the site's own default
+# (re-asserting those would undo a test harness's config pin).
+_env_platforms = _os.environ.get("JAX_PLATFORMS", "")
+if "cpu" in _env_platforms.split(","):
+    try:
+        _jax.config.update("jax_platforms", _env_platforms)
+    except Exception:  # backends already initialized — too late, leave as-is
+        pass
+del _os, _jax, _env_platforms
+
 from . import context as _context
 from . import service
 from .context import BlueFogContext, init, shutdown, is_initialized
@@ -54,6 +73,10 @@ from .ops.api import (
     pair_gossip, pair_gossip_nonblocking,
     barrier, poll, synchronize, wait,
     to_global, from_global, rank_sharding,
+)
+
+from .ops.ring_attention import (
+    attention, ring_attention, ulysses_attention,
 )
 
 from .ops.windows import (

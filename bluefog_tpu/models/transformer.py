@@ -1,0 +1,117 @@
+"""Decoder-only Transformer (long-context / sequence-parallel model family).
+
+The reference has no attention model (SURVEY.md §5.7); this family exists to
+exercise the framework's first-class sequence parallelism: the attention
+layer is pluggable, so the same module runs single-device (full attention)
+or inside ``shard_map`` with ``ops.ring_attention`` / ``ops.ulysses_attention``
+over a sequence mesh axis.  TPU-first choices: bfloat16 compute with float32
+params, GELU MLP with 4x expansion (MXU-friendly matmul shapes), rotary
+position embeddings (work on per-shard blocks via a position offset — no
+learned position table to shard).
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.ring_attention import attention as _full_attention
+
+__all__ = ["Transformer", "TransformerConfig", "TransformerLM"]
+
+Dtype = Any
+
+
+def _rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding on [B, T, H, D] with int positions [T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-np.arange(0, half, dtype=np.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class TransformerConfig:
+    """Static hyperparameters (kept out of the Module so jit sees one leaf)."""
+
+    def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
+                 embed_dim=512, mlp_ratio=4, max_len=8192,
+                 dtype=jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.embed_dim = embed_dim
+        self.mlp_ratio = mlp_ratio
+        self.max_len = max_len
+        self.dtype = dtype
+
+
+class Block(nn.Module):
+    """Pre-LN decoder block with a pluggable attention function."""
+    num_heads: int
+    dtype: Dtype
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x, attn_fn: Callable, positions):
+        D = x.shape[-1]
+        head_dim = D // self.num_heads
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
+                              dtype=self.dtype, name="qkv")(h)
+        q, k, v = (qkv[..., i, :, :] for i in range(3))
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        a = attn_fn(q, k, v)
+        a = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
+                            name="proj")(a)
+        x = x + a
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(D, dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM backbone returning logits.
+
+    ``attn_fn(q, k, v)`` defaults to causal full attention.  For sequence
+    parallelism, call inside ``shard_map`` with
+    ``attn_fn=lambda q,k,v: ring_attention(q,k,v,"sp",causal=True)`` and pass
+    ``position_offset = axis_index("sp") * shard_len`` so RoPE sees global
+    positions.
+    """
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, attn_fn: Optional[Callable] = None,
+                 position_offset=0):
+        cfg = self.config
+        if tokens.shape[1] > cfg.max_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_len "
+                f"{cfg.max_len} (under sequence parallelism the per-shard "
+                f"length is checked; size the config for the global context)")
+        if attn_fn is None:
+            attn_fn = lambda q, k, v: _full_attention(q, k, v, causal=True)
+        positions = position_offset + jnp.arange(tokens.shape[1])
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        for i in range(cfg.num_layers):
+            x = Block(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
+                      name=f"block_{i}")(x, attn_fn, positions)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          name="lm_head")(x)
+        return logits
+
+
+def TransformerLM(**kwargs) -> Transformer:
+    """Convenience constructor: ``TransformerLM(num_layers=4, ...)``."""
+    return Transformer(TransformerConfig(**kwargs))

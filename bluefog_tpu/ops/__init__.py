@@ -1,3 +1,4 @@
 """Collective ops: shard_map primitives and the global-view API."""
 
 from . import collectives, api
+from .ring_attention import attention, ring_attention, ulysses_attention

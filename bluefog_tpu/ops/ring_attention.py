@@ -1,0 +1,160 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context scaling is first-class in this framework even though the
+reference never shards a sequence (SURVEY.md §5.7 records the absence and
+notes that the decentralized neighbor exchange — weighted ``lax.ppermute``
+on a ring — is structurally the same collective ring attention uses).  This
+module supplies that missing axis:
+
+* ``ring_attention`` — blockwise softmax attention with the KV shards
+  rotating around the mesh ring via ``lax.ppermute`` while each step's
+  partial attention is folded into a numerically-stable online-softmax
+  accumulator (flash-attention style running max / running sum).  Sequence
+  length per chip stays constant, total context scales linearly with the
+  ring, and every hop rides one ICI link.
+* ``ulysses_attention`` — DeepSpeed-Ulysses-style all-to-all: re-shard from
+  sequence-sharded to head-sharded with ``lax.all_to_all``, run full local
+  attention, and shard back.  Cheaper for moderate contexts when
+  ``num_heads %% ring_size == 0``.
+* ``attention`` — the single-device reference implementation both are
+  tested against.
+
+All SPMD entry points follow the conventions of ``ops/collectives.py``:
+they take ``axis_name`` explicitly and operate on the per-rank shard, to be
+called inside ``shard_map``/``pjit``.  Everything is differentiable (the
+ring loop is a ``lax.scan``; each block is rematerialized under
+``jax.checkpoint`` so the backward pass re-runs blocks instead of storing
+every step's logits).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["attention", "ring_attention", "ulysses_attention"]
+
+_NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
+
+
+def attention(q, k, v, *, causal: bool = False,
+              q_offset: int = 0, k_offset: int = 0, scale: Optional[float] = None):
+    """Plain softmax attention (single-device reference).
+
+    ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  ``q_offset`` /
+    ``k_offset`` are the global positions of the first query/key, used for
+    causal masking of sharded blocks.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])[:, None]
+        kj = k_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(kj <= qi, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def _block_step(q, k, v, m, l, o, *, causal, q_pos0, k_pos0, scale):
+    """Fold one KV block into the online-softmax accumulator.
+
+    Carries: ``m`` [B, H, Tq] running row max, ``l`` [B, H, Tq] running
+    softmax denominator, ``o`` [B, Tq, H, D] unnormalized output.  Fully
+    masked blocks contribute nothing (the ``m_new`` guard keeps them finite).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qi = q_pos0 + jnp.arange(q.shape[1])[:, None]
+        kj = k_pos0 + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((kj <= qi)[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # correction for previously accumulated mass; 0*inf-safe because m only
+    # decreases from 0 (start) or stays _NEG_INF-bounded, never true -inf
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                       # [B, H, Tq, Tk]
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact attention over a ring-sharded sequence (call inside shard_map).
+
+    Each rank holds the [B, T/n, H, D] shard of q/k/v for its sequence
+    block.  The KV pair circulates around the ``axis_name`` ring in ``n-1``
+    ``lax.ppermute`` hops; queries never move.  Online-softmax accumulation
+    makes the result exactly equal to full attention over the whole
+    sequence, independent of ring size.
+
+    Communication: n-1 hops of 2·|KV shard| each over nearest-neighbor ICI
+    links — the same circulant-shift primitive as
+    ``collectives.neighbor_allreduce`` (offset 1 only).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale_ = scale if scale is not None else D ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q32 = q.astype(jnp.float32)
+    block = jax.checkpoint(
+        functools.partial(_block_step, causal=causal, scale=scale_))
+
+    q_pos0 = idx * T
+
+    # local block first, then n-1 permute→accumulate hops: exactly n-1
+    # ppermutes (rotating a final, never-read KV pair would waste one ICI
+    # hop per layer — XLA cannot DCE a collective inside the scan body)
+    _vary = lambda a: lax.pcast(a, axis_name, to="varying")
+    m0 = _vary(jnp.full((B, H, T), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
+    o0 = _vary(jnp.zeros((B, T, H, D), jnp.float32))
+    m, l, o = block(q32, k, v, m0, l0, o0, q_pos0=q_pos0, k_pos0=idx * T)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.rem(idx - s + n, n)       # rank that produced this KV block
+        m, l, o = block(q32, k_blk, v_blk, m, l, o,
+                        q_pos0=q_pos0, k_pos0=src * T)
+        return (k_blk, v_blk, m, l, o), None
+
+    if n > 1:
+        (_, _, m, l, o), _ = lax.scan(
+            step, (k, v, m, l, o), jnp.arange(1, n))
+    # l is never 0 for causal self-attention (the diagonal block always
+    # contributes); guard anyway so padded/degenerate rows yield 0, not NaN
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all (Ulysses) sequence parallelism (call inside shard_map).
+
+    Input: sequence-sharded [B, T/n, H, D].  ``lax.all_to_all`` re-shards to
+    head-sharded [B, T, H/n, D]; full attention runs locally over the whole
+    sequence; a final all-to-all restores sequence sharding.  Requires
+    ``H %% n == 0``.  Four all-to-alls of one activation volume each (q/k/v
+    in, output out) versus the ring's n-1 double-KV hops — usually the
+    better trade below ~32k context.
+    """
+    n = lax.axis_size(axis_name)
+    B, T, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads divisible by the axis size, "
+            f"got H={H}, n={n}; use ring_attention instead")
+    # [B, T/n, H, D] -> [B, T, H/n, D]: split heads, concat sequence
+    qg, kg, vg = (lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                 tiled=True) for x in (q, k, v))
+    out = attention(qg, kg, vg, causal=causal, scale=scale)
+    # back: split sequence, concat heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

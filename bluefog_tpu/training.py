@@ -27,7 +27,7 @@ from .optim._plumbing import mesh_plumbing
 from .parallel.schedule import DynamicSchedule
 
 __all__ = ["create_train_state", "make_train_step", "cross_entropy_loss",
-           "replicate_to_ranks"]
+           "replicate_to_ranks", "make_lm_train_step"]
 
 
 def cross_entropy_loss(logits, labels):
@@ -144,5 +144,63 @@ def make_train_step(model,
             out_specs=(pl.spec, pl.spec, P()),
         )(v2, o2, b2, step_idx)
         return pl.reshape_out(v_out), pl.reshape_out(o_out), loss
+
+    return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
+
+
+def make_lm_train_step(model, base_opt: optax.GradientTransformation,
+                       attn: str = "ring", donate: bool = True):
+    """Sequence-parallel language-model train step (long-context path).
+
+    Tokens/targets [B, T] are sharded along the sequence over the rank mesh
+    axis; parameters are replicated.  Each rank runs the Transformer on its
+    sequence shard with ``attn`` in {"ring", "ulysses"} providing exact
+    global attention (``ops/ring_attention.py``), gradients are psum'd over
+    the axis, and one optimizer step updates the replicated parameters.
+    Context length therefore scales linearly with the mesh while per-chip
+    activation memory stays constant.
+
+    Returns ``step(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)``; requires ``T %% size == 0``.
+    """
+    from .ops.ring_attention import ring_attention, ulysses_attention
+
+    cx = ctx()
+    axis = cx.rank_axis
+    if attn not in ("ring", "ulysses"):
+        raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
+    attn_impl = ring_attention if attn == "ring" else ulysses_attention
+
+    # The global loss is a shard_map whose output is the cross-rank pmean;
+    # differentiating THROUGH it (grad outside, forward inside) lets the
+    # shard_map transpose route KV-hop cotangents between ranks and psum the
+    # replicated-parameter cotangent exactly once.  (Taking jax.grad *inside*
+    # the body instead silently double-counts: grad w.r.t. an unvarying
+    # input is auto-psummed across ranks by the pcast transpose.)
+    def global_loss(p, tokens, targets):
+        if tokens.shape[1] % cx.size:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} must be divisible by "
+                f"the mesh size {cx.size} for sequence parallelism")
+
+        def shard_fn(p_, tok, tgt):
+            shard_len = tok.shape[1]
+            offset = jax.lax.axis_index(axis) * shard_len
+            attn_fn = lambda q, k, v: attn_impl(q, k, v, axis, causal=True)
+            logits = model.apply({"params": p_}, tok, attn_fn=attn_fn,
+                                 position_offset=offset)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt).mean()
+            return jax.lax.pmean(loss, axis)
+
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(P(), P(None, cx.rank_axis), P(None, cx.rank_axis)),
+            out_specs=P())(p, tokens, targets)
+
+    def stepper(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(global_loss)(params, tokens, targets)
+        updates, opt_new = base_opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_new, loss
 
     return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())

@@ -29,5 +29,7 @@ run mnist-gradar       examples/mnist.py --epochs 1 --batch-size 128 --dist-opti
 run mnist-atc          examples/mnist.py --epochs 1 --batch-size 128 --atc-style
 run resnet-tiny        examples/resnet.py --model ResNet18 --epochs 1 --steps-per-epoch 4 --batch-size 4 --image-size 32 --dtype float32
 run bench-tiny         examples/benchmark.py --model ResNet18 --batch-size 4 --image-size 64 --num-iters 2 --num-batches-per-iter 2 --num-warmup-batches 2 --dtype float32
+run lm-ring            examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1
+run lm-ulysses         examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --attn ulysses
 
 echo "ALL EXAMPLES PASSED"

@@ -8,11 +8,13 @@ values.  The env vars must be set before JAX initializes its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # overwrite, not setdefault: the axon
+# site exports JAX_PLATFORMS=axon, and the package honors an explicit cpu
+N_DEVICES = int(os.environ.get("BLUEFOG_TEST_MESH_DEVICES", "8"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+        flags + f" --xla_force_host_platform_device_count={N_DEVICES}").strip()
 
 import jax  # noqa: E402
 
@@ -21,8 +23,6 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import bluefog_tpu as bf  # noqa: E402
-
-N_DEVICES = 8
 
 
 @pytest.fixture()
