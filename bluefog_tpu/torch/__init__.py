@@ -1,0 +1,46 @@
+"""PyTorch frontend (second-framework adapter).
+
+The reference ships two framework frontends over one core: PyTorch
+(``bluefog/torch/``) and TensorFlow (``bluefog/tensorflow/mpi_ops.py:75-212``
+— allreduce/broadcast/allgather + ``DistributedOptimizer`` /
+``DistributedGradientTape`` / ``broadcast_variables``).  This package plays
+the same role for ``bluefog_tpu``: the JAX/XLA mesh is the core, and torch
+tensors ride it through zero-copy numpy bridges.
+
+Global-view convention as everywhere else: "rank i's tensor" is slice ``i``
+of a ``[size, ...]`` torch tensor.  Ops stage through the mesh (TPU when
+available), mirroring the reference's CPU-staging mode for GPU tensors
+(``BLUEFOG_OPS_ON_CPU``, torch/mpi_ops.cc) in reverse.
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.torch as bft
+    bf.init()
+    out = bft.neighbor_allreduce(torch.randn(bf.size(), 128))
+"""
+
+from .mpi_ops import (
+    allreduce, allreduce_nonblocking,
+    broadcast, broadcast_nonblocking,
+    allgather, allgather_nonblocking,
+    neighbor_allreduce, neighbor_allreduce_nonblocking,
+    poll, synchronize, wait,
+    broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+)
+from .optimizers import (
+    DistributedOptimizer,
+    DistributedGradientAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+)
+
+__all__ = [
+    "allreduce", "allreduce_nonblocking",
+    "broadcast", "broadcast_nonblocking",
+    "allgather", "allgather_nonblocking",
+    "neighbor_allreduce", "neighbor_allreduce_nonblocking",
+    "poll", "synchronize", "wait",
+    "broadcast_parameters", "allreduce_parameters",
+    "broadcast_optimizer_state",
+    "DistributedOptimizer",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+]

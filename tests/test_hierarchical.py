@@ -9,9 +9,9 @@ import pytest
 import bluefog_tpu as bf
 from bluefog_tpu.ops import collectives as C
 
-N = 8
+from conftest import N_DEVICES as N
 LOCAL = 2
-MACHINES = 4
+MACHINES = N // LOCAL
 
 
 def rank_tensor(shape=(4,)):

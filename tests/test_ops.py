@@ -12,7 +12,7 @@ import pytest
 import bluefog_tpu as bf
 from bluefog_tpu.parallel import dynamic as dyn
 
-N = 8
+from conftest import N_DEVICES as N
 DTYPES = [jnp.float32, jnp.float64, jnp.int32]
 FLOAT_DTYPES = [jnp.float32, jnp.float64, jnp.bfloat16]
 
@@ -37,7 +37,7 @@ def test_allreduce_sum(bf_ctx):
     np.testing.assert_allclose(np.asarray(out), np.full((N, 5), sum(range(N))))
 
 
-@pytest.mark.parametrize("root", [0, 3, 7])
+@pytest.mark.parametrize("root", [0, 3, N - 1])
 def test_broadcast(bf_ctx, root):
     x = rank_tensor((4,))
     out = bf.broadcast(x, root_rank=root)
@@ -141,20 +141,23 @@ def test_neighbor_allgather_exp2(bf_ctx):
 
 
 def test_pair_gossip_default_average(bf_ctx):
-    pairs = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    pairs = [(i, i + 1) for i in range(0, N - 1, 2)]
     x = rank_tensor((2,))
     out = bf.pair_gossip(x, pairs)
-    expected = [0.5, 0.5, 2.5, 2.5, 4.5, 4.5, 6.5, 6.5]
+    expected = np.arange(N, dtype=np.float64)
+    for a, b in pairs:
+        expected[a] = expected[b] = (a + b) / 2.0
     np.testing.assert_allclose(np.asarray(out)[:, 0], expected)
 
 
 def test_pair_gossip_weighted_and_partial(bf_ctx):
-    pairs = [(1, 6)]
+    a, b = 1, N - 2
+    pairs = [(a, b)]
     x = rank_tensor((2,))
     out = bf.pair_gossip(x, pairs, self_weight=0.25, pair_weight=0.75)
     expected = np.arange(N, dtype=np.float64)
-    expected[1] = 0.25 * 1 + 0.75 * 6
-    expected[6] = 0.25 * 6 + 0.75 * 1
+    expected[a] = 0.25 * a + 0.75 * b
+    expected[b] = 0.25 * b + 0.75 * a
     np.testing.assert_allclose(np.asarray(out)[:, 0], expected)
 
 
@@ -212,4 +215,5 @@ def test_int_dtype_allreduce_sum(bf_ctx):
     x = rank_tensor((4,), jnp.int32)
     out = bf.allreduce(x, average=False)
     assert out.dtype == jnp.int32
-    np.testing.assert_array_equal(np.asarray(out), np.full((N, 4), 28))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.full((N, 4), N * (N - 1) // 2))

@@ -15,7 +15,7 @@ import pytest
 import bluefog_tpu as bf
 from bluefog_tpu.parallel import dynamic as dyn
 
-N = 8
+from conftest import N_DEVICES as N
 DIM = 5
 
 
@@ -134,7 +134,7 @@ def test_adapt_with_combine(bf_ctx):
 
 
 def test_hierarchical_neighbor_allreduce_opt(bf_ctx_machines):
-    bf.set_machine_topology(bf.RingGraph(4))
+    bf.set_machine_topology(bf.RingGraph(N // 2))
     A, b, w_star = make_problem()
     opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(optax.sgd(0.05))
     params = run_training(opt, A, b)

@@ -1,0 +1,130 @@
+"""Torch frontend tests (reference model: test/tensorflow_ops_test.py — the
+second-framework adapter exercised against closed forms on the real mesh).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import bluefog_tpu as bf
+import bluefog_tpu.torch as bft
+
+from conftest import N_DEVICES
+
+
+def _rankval(shape=(4,), dtype=torch.float32):
+    """Global-view tensor whose rank-i slice is filled with i."""
+    t = torch.empty((N_DEVICES,) + shape, dtype=dtype)
+    for r in range(N_DEVICES):
+        t[r] = float(r)
+    return t
+
+
+def test_allreduce_average(bf_ctx):
+    out = bft.allreduce(_rankval())
+    expected = (N_DEVICES - 1) / 2.0
+    assert isinstance(out, torch.Tensor)
+    assert torch.allclose(out, torch.full_like(out, expected))
+
+
+def test_allreduce_bfloat16_stages_through_float32(bf_ctx):
+    out = bft.allreduce(_rankval(dtype=torch.bfloat16))
+    assert out.dtype == torch.bfloat16
+    expected = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(out.float(), torch.full_like(out.float(), expected))
+
+
+def test_broadcast(bf_ctx):
+    out = bft.broadcast(_rankval(), root_rank=3)
+    assert torch.allclose(out, torch.full_like(out, 3.0))
+
+
+def test_allgather(bf_ctx):
+    t = _rankval((2,))
+    out = bft.allgather(t)
+    # every rank's result is the concatenation of all slices
+    assert out.shape == (N_DEVICES, N_DEVICES * 2)
+    for r in range(N_DEVICES):
+        assert torch.allclose(out[r], out[0])
+
+
+def test_neighbor_allreduce_default_topology(bf_ctx):
+    """Closed form: uniform in-neighbor average on the exp2 graph."""
+    t = _rankval((3,))
+    out = bft.neighbor_allreduce(t)
+    topo = bf.load_topology()
+    for r in range(N_DEVICES):
+        self_w, recv_w = bf.GetRecvWeights(topo, r)
+        expected = self_w * r + sum(w * src for src, w in recv_w.items())
+        np.testing.assert_allclose(out[r].numpy(), expected, rtol=1e-5)
+
+
+def test_nonblocking_poll_wait(bf_ctx):
+    h = bft.allreduce_nonblocking(_rankval())
+    out = bft.wait(h)
+    assert isinstance(out, torch.Tensor)
+    assert torch.allclose(out, torch.full_like(out, (N_DEVICES - 1) / 2.0))
+
+
+def test_broadcast_parameters(bf_ctx):
+    sd = {"w": _rankval((2, 2)), "meta": 7}
+    out = bft.broadcast_parameters(sd, root_rank=2)
+    assert out["meta"] == 7
+    assert torch.allclose(out["w"], torch.full_like(out["w"], 2.0))
+
+
+def test_allreduce_parameters(bf_ctx):
+    sd = {"w": _rankval((2,))}
+    out = bft.allreduce_parameters(sd)
+    assert torch.allclose(out["w"],
+                          torch.full_like(out["w"], (N_DEVICES - 1) / 2.0))
+
+
+def test_gradient_allreduce_optimizer(bf_ctx):
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedGradientAllreduceOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+    p.grad = _rankval((2,)).clone()
+    opt.step()
+    gavg = (N_DEVICES - 1) / 2.0
+    expected = _rankval((2,)) - gavg
+    assert torch.allclose(p.data, expected)
+
+
+def test_neighbor_allreduce_optimizer_consensus(bf_ctx):
+    """CTA with zero grads = repeated neighbor averaging -> consensus."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+    for _ in range(30):
+        p.grad = torch.zeros_like(p)
+        opt.step()
+    mean = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(p.data, torch.full_like(p.data, mean), atol=1e-3)
+
+
+def test_optimizer_factory_dispatch(bf_ctx):
+    p = torch.nn.Parameter(torch.zeros(N_DEVICES, 2))
+    opt = bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1),
+                                   "neighbor_allreduce")
+    assert type(opt).__name__ == "DistributedNeighborAllreduceOptimizer"
+    opt2 = bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1),
+                                    "gradient_allreduce")
+    assert type(opt2).__name__ == "DistributedGradientAllreduceOptimizer"
+    with pytest.raises(ValueError):
+        bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1), "nope")
+
+
+def test_optimizer_stays_a_torch_optimizer(bf_ctx):
+    """Re-classing keeps isinstance + LR schedulers working (the reference
+    re-classes for the same reason, torch/optimizers.py)."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+    assert isinstance(opt, torch.optim.Optimizer)
+    assert isinstance(opt, torch.optim.SGD)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=0.5)
+    p.grad = torch.zeros_like(p)
+    opt.step()
+    sched.step()
+    assert opt.param_groups[0]["lr"] == 0.5

@@ -11,7 +11,7 @@ import pytest
 
 import bluefog_tpu as bf
 
-N = 8
+from conftest import N_DEVICES as N
 
 
 @pytest.fixture(autouse=True)
@@ -208,7 +208,7 @@ def test_invalid_dst_weights_rejected(bf_ctx):
     bf.set_topology(bf.RingGraph(N))
     bf.win_create(rank_tensor(), "w")
     D = np.zeros((N, N))
-    D[0, 4] = 1.0  # not a ring edge
+    D[0, N // 2] = 1.0  # not a ring edge
     with pytest.raises(ValueError):
         bf.win_put(rank_tensor(), "w", dst_weights=D)
 
