@@ -41,7 +41,7 @@ class TransformerConfig:
 
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_len=8192,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, num_experts=0, capacity_factor=1.25):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -49,6 +49,53 @@ class TransformerConfig:
         self.mlp_ratio = mlp_ratio
         self.max_len = max_len
         self.dtype = dtype
+        self.num_experts = num_experts          # 0 = dense MLP
+        self.capacity_factor = capacity_factor
+
+
+class MoEMLP(nn.Module):
+    """Switch-style mixture-of-experts MLP (ops/moe.py).
+
+    ``moe_fn(x2d, logits, expert_fn, params) -> (out2d, aux)`` selects the
+    execution strategy: ``None`` runs every expert locally
+    (``local_moe_ffn``); the expert-parallel train step passes a closure
+    over ``expert_parallel_ffn`` that slices this rank's experts and
+    all-to-alls the token slots.
+    """
+    num_experts: int
+    dtype: Dtype
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x, moe_fn: Optional[Callable] = None):
+        from ..ops.moe import local_moe_ffn
+        B, T, D = x.shape
+        H, E = D * self.mlp_ratio, self.num_experts
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)).reshape(B * T, E)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(), (E, D, H))
+        b_up = self.param("b_up", nn.initializers.zeros_init(), (E, H))
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (E, H, D))
+        b_down = self.param("b_down", nn.initializers.zeros_init(), (E, D))
+        dt = self.dtype
+
+        def expert_fn(params, h):
+            wu, bu, wd, bd = params
+            h = jnp.einsum("sd,dh->sh", h, wu.astype(dt)) + bu.astype(dt)
+            h = nn.gelu(h)
+            return jnp.einsum("sh,hd->sd", h, wd.astype(dt)) + bd.astype(dt)
+
+        params = (w_up, b_up, w_down, b_down)
+        x2 = x.reshape(B * T, D).astype(dt)
+        if moe_fn is None:
+            out, aux = local_moe_ffn(x2, logits, expert_fn, params,
+                                     self.capacity_factor)
+        else:
+            out, aux = moe_fn(x2, logits, expert_fn, params)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return out.reshape(B, T, D)
 
 
 class Block(nn.Module):
@@ -56,9 +103,12 @@ class Block(nn.Module):
     num_heads: int
     dtype: Dtype
     mlp_ratio: int = 4
+    num_experts: int = 0
+    capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, attn_fn: Callable, positions):
+    def __call__(self, x, attn_fn: Callable, positions,
+                 moe_fn: Optional[Callable] = None):
         D = x.shape[-1]
         head_dim = D // self.num_heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
@@ -72,9 +122,14 @@ class Block(nn.Module):
                             name="proj")(a)
         x = x + a
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
-        h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype, name="mlp_up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(D, dtype=self.dtype, name="mlp_down")(h)
+        if self.num_experts:
+            h = MoEMLP(self.num_experts, self.dtype, self.mlp_ratio,
+                       self.capacity_factor, name="moe")(h, moe_fn)
+        else:
+            h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype,
+                         name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(D, dtype=self.dtype, name="mlp_down")(h)
         return x + h
 
 
@@ -91,7 +146,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, attn_fn: Optional[Callable] = None,
-                 position_offset=0):
+                 position_offset=0, moe_fn: Optional[Callable] = None):
         cfg = self.config
         if tokens.shape[1] > cfg.max_len:
             raise ValueError(
@@ -105,7 +160,8 @@ class Transformer(nn.Module):
                      name="embed")(tokens)
         for i in range(cfg.num_layers):
             x = Block(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
-                      name=f"block_{i}")(x, attn_fn, positions)
+                      cfg.num_experts, cfg.capacity_factor,
+                      name=f"block_{i}")(x, attn_fn, positions, moe_fn)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
                           name="lm_head")(x)

@@ -2,3 +2,4 @@
 
 from . import collectives, api
 from .ring_attention import attention, ring_attention, ulysses_attention
+from .moe import expert_parallel_ffn, local_moe_ffn, switch_route

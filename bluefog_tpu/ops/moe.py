@@ -1,0 +1,113 @@
+"""Expert parallelism: switch-style MoE dispatch over the mesh.
+
+No reference counterpart (SURVEY.md §2.6 records EP as absent in BlueFog);
+built here because expert parallelism is a first-class scaling axis for a
+TPU framework.  Design is the GShard/Switch static-shape recipe, which XLA
+compiles well: top-1 routing with a fixed per-expert capacity, dispatch and
+combine expressed as dense einsums against a one-hot dispatch tensor (no
+gather/scatter with data-dependent shapes), and two ``lax.all_to_all``s
+moving token slots between ranks so each rank runs only its local experts.
+
+Shapes (per rank, inside shard_map): tokens ``[T, D]``, experts
+``E = n_ranks * E_local``, capacity ``C`` slots per (expert, source rank).
+
+    dispatch:  [T, E, C] one-hot   (token t -> slot c of expert e)
+    a2a in:    [E, C, D] -> [E_local, n*C, D]
+    expert FF: vmap over E_local
+    a2a out:   back, combine with gate probabilities
+
+Tokens beyond an expert's capacity are dropped (standard switch behavior);
+the residual connection around the MoE block carries them through.
+"""
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["switch_route", "expert_parallel_ffn", "local_moe_ffn",
+           "RouterOutput"]
+
+
+class RouterOutput(NamedTuple):
+    dispatch: jax.Array       # [T, E, C] one-hot float
+    combine: jax.Array        # [T, E, C] dispatch * gate prob
+    aux_loss: jax.Array       # load-balancing loss (Switch eq. 4)
+
+
+def switch_route(logits, capacity: int) -> RouterOutput:
+    """Top-1 routing with static capacity (Switch Transformer).
+
+    ``logits``: [T, E].  Token t goes to expert ``argmax`` if it wins one of
+    the expert's ``capacity`` slots (first-come by position); otherwise it is
+    dropped (combine weight 0).  Everything is dense one-hots — no dynamic
+    shapes under jit.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0           # [T, E]
+    kept = (pos >= 0) & (pos < capacity)
+    dispatch = kept[..., None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)
+    gate = (probs * onehot).sum(-1)                           # [T]
+    combine = dispatch * gate[:, None, None]
+    # load balancing: E * sum_e (fraction routed to e) * (mean prob of e)
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return RouterOutput(dispatch, combine, aux)
+
+
+def expert_parallel_ffn(x, router_logits, expert_fn: Callable,
+                        expert_params, axis_name,
+                        capacity_factor: float = 1.25):
+    """Run an expert-sharded FFN over ring-sharded tokens (inside shard_map).
+
+    ``x``: [T, D] local tokens; ``router_logits``: [T, E] with
+    ``E = n * E_local``; ``expert_params``: pytree whose leaves have leading
+    dim ``E_local`` (this rank's experts); ``expert_fn(params, h)`` applies
+    one expert to ``[slots, D]``.
+
+    Two all-to-alls bracket the expert computation, so every rank computes
+    only its ``E_local`` experts over slots collected from all ranks.
+    Returns ``(out [T, D], aux_loss)``.
+    """
+    n = lax.axis_size(axis_name)
+    T, D = x.shape
+    E = router_logits.shape[-1]
+    if E % n:
+        raise ValueError(f"num experts {E} must be divisible by mesh size {n}")
+    e_local = E // n
+    capacity = max(1, int(capacity_factor * T / E))
+
+    route = switch_route(router_logits, capacity)
+    # [T, E, C] x [T, D] -> [E, C, D]
+    slots = jnp.einsum("tec,td->ecd", route.dispatch.astype(x.dtype), x)
+    # exchange: each rank keeps E_local experts, gains all ranks' slots
+    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=1,
+                           tiled=True)                       # [E_local, n*C, D]
+    out = jax.vmap(expert_fn)(expert_params, slots)          # [E_local, n*C, D]
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                         tiled=True)                         # [E, C, D]
+    combined = jnp.einsum("tec,ecd->td", route.combine.astype(x.dtype), out)
+    return combined, route.aux_loss
+
+
+def local_moe_ffn(x, router_logits, expert_fn: Callable, expert_params,
+                  capacity_factor: float = 1.25):
+    """Single-device MoE: same routing/combine math, all experts local
+    (the n=1 degenerate case of ``expert_parallel_ffn`` — used outside
+    shard_map and as the correctness reference in tests)."""
+    T, _ = x.shape
+    E = router_logits.shape[-1]
+    capacity = max(1, int(capacity_factor * T / E))
+    route = switch_route(router_logits, capacity)
+    slots = jnp.einsum("tec,td->ecd", route.dispatch.astype(x.dtype), x)
+    out = jax.vmap(expert_fn)(expert_params, slots)          # [E, C, D]
+    combined = jnp.einsum("tec,ecd->td", route.combine.astype(x.dtype), out)
+    return combined, route.aux_loss

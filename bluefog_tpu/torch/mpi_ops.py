@@ -56,9 +56,16 @@ def _nonblocking(api_fn, t: torch.Tensor, *args, **kwargs) -> int:
 
 
 def synchronize(handle: int) -> torch.Tensor:
-    """Wait for a nonblocking torch op and return its torch output."""
-    dtype = _torch_handles.pop(handle)
-    return _to_torch(_api.synchronize(handle), dtype)
+    """Wait for a nonblocking torch op and return its torch output.
+
+    Unknown / already-synchronized handles raise the core API's descriptive
+    ValueError; a handle created through the JAX-level API still resolves
+    (returned with its natural dtype).
+    """
+    dtype = _torch_handles.pop(handle, None)
+    out = _api.synchronize(handle)   # raises ValueError for unknown handles
+    return _to_torch(out, dtype) if dtype is not None \
+        else torch.from_numpy(np.array(out))
 
 
 wait = synchronize

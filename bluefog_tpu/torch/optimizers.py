@@ -58,6 +58,17 @@ class _GradientAllreduceMixin(_DistributedMixin):
     """Allreduce-average gradients before the local step
     (reference ``_DistributedOptimizer``, torch/optimizers.py:166-294)."""
 
+    def step(self, closure=None):
+        # a closure recomputes gradients inside super().step(), which would
+        # overwrite the allreduced ones — evaluate it once up front instead
+        # (multi-evaluation optimizers like LBFGS are not supported)
+        loss = None
+        if closure is not None:
+            with torch.enable_grad():
+                loss = closure()
+        super().step()
+        return loss
+
     def _bft_communicate(self):
         for p in self._bft_params():
             if p.grad is not None:

@@ -164,12 +164,19 @@ def make_lm_train_step(model, base_opt: optax.GradientTransformation,
     (params, opt_state, loss)``; requires ``T %% size == 0``.
     """
     from .ops.ring_attention import ring_attention, ulysses_attention
+    from .ops.moe import expert_parallel_ffn
 
     cx = ctx()
     axis = cx.rank_axis
     if attn not in ("ring", "ulysses"):
         raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
     attn_impl = ring_attention if attn == "ring" else ulysses_attention
+    cfg = getattr(model, "config", None)
+    num_experts = getattr(cfg, "num_experts", 0)
+    if num_experts and num_experts % cx.size:
+        raise ValueError(
+            f"num_experts {num_experts} must be divisible by the mesh "
+            f"size {cx.size} for expert parallelism")
 
     # The global loss is a shard_map whose output is the cross-rank pmean;
     # differentiating THROUGH it (grad outside, forward inside) lets the
@@ -187,10 +194,34 @@ def make_lm_train_step(model, base_opt: optax.GradientTransformation,
             shard_len = tok.shape[1]
             offset = jax.lax.axis_index(axis) * shard_len
             attn_fn = lambda q, k, v: attn_impl(q, k, v, axis, causal=True)
-            logits = model.apply({"params": p_}, tok, attn_fn=attn_fn,
-                                 position_offset=offset)
+
+            # expert parallelism: each rank computes only its E/n experts;
+            # two all-to-alls move the routed token slots (ops/moe.py).
+            # Expert parameter leaves stay replicated like the rest of the
+            # model (shard them with sharding constraints at larger scale);
+            # the dynamic_slice transpose routes each rank's expert grads
+            # back into the right rows of the replicated tree.
+            def moe_fn(x2, logits2, expert_fn, eparams):
+                e_local = num_experts // cx.size
+                idx = jax.lax.axis_index(axis)
+                local = jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, idx * e_local, e_local, 0), eparams)
+                return expert_parallel_ffn(
+                    x2, logits2, expert_fn, local, axis,
+                    capacity_factor=getattr(cfg, "capacity_factor", 1.25))
+
+            kwargs = dict(attn_fn=attn_fn, position_offset=offset)
+            if num_experts:
+                out, inter = model.apply(
+                    {"params": p_}, tok, moe_fn=moe_fn,
+                    mutable=["intermediates"], **kwargs)
+                aux = sum(jax.tree.leaves(inter))
+            else:
+                out = model.apply({"params": p_}, tok, **kwargs)
+                aux = 0.0
             loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgt).mean()
+                out, tgt).mean() + 0.01 * aux
             return jax.lax.pmean(loss, axis)
 
         return jax.shard_map(

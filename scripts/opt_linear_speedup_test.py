@@ -76,14 +76,17 @@ def main():
     for strategy in args.strategies.split(","):
         times = {}
         for n in sizes:
-            out = subprocess.run(
-                [sys.executable, "-c", worker, str(n), strategy],
-                capture_output=True, text=True, timeout=600)
-            line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
             try:
+                out = subprocess.run(
+                    [sys.executable, "-c", worker, str(n), strategy],
+                    capture_output=True, text=True, timeout=600)
+                line = out.stdout.strip().splitlines()[-1]
                 rec = json.loads(line)
-            except (json.JSONDecodeError, IndexError):
-                print(f"FAIL {strategy} n={n}: {out.stderr[-500:]}")
+            except (json.JSONDecodeError, IndexError,
+                    subprocess.TimeoutExpired) as e:
+                err = getattr(out, "stderr", "") if not isinstance(
+                    e, subprocess.TimeoutExpired) else "timeout"
+                print(f"FAIL {strategy} n={n}: {err[-500:]}")
                 failures += 1
                 continue
             times[n] = rec["per_step_ms"]

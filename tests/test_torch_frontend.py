@@ -103,6 +103,31 @@ def test_neighbor_allreduce_optimizer_consensus(bf_ctx):
     assert torch.allclose(p.data, torch.full_like(p.data, mean), atol=1e-3)
 
 
+def test_gradient_allreduce_optimizer_closure(bf_ctx):
+    """Closure-computed gradients must be allreduced before the update."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedGradientAllreduceOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+
+    def closure():
+        opt.zero_grad()
+        loss = (p * _rankval((2,))).sum()
+        loss.backward()  # dL/dp = rank value per slice
+        return loss
+
+    opt.step(closure)
+    gavg = (N_DEVICES - 1) / 2.0
+    expected = _rankval((2,)) - gavg
+    assert torch.allclose(p.data, expected)
+
+
+def test_synchronize_unknown_handle_raises(bf_ctx):
+    h = bft.allreduce_nonblocking(_rankval())
+    bft.wait(h)
+    with pytest.raises(ValueError):
+        bft.wait(h)  # double-wait: descriptive error, not KeyError
+
+
 def test_optimizer_factory_dispatch(bf_ctx):
     p = torch.nn.Parameter(torch.zeros(N_DEVICES, 2))
     opt = bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1),
