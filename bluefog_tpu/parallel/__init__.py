@@ -1,3 +1,27 @@
 """Parallelism layer: topologies, dynamic schedules, mesh/collective plans."""
 
 from . import topology, dynamic, schedule
+
+# tensor/pipeline pull in flax; defer them (PEP 562) so collective-only
+# users of the package never pay the import
+_LAZY = {
+    "tensor": ("tensor", None),
+    "pipeline": ("pipeline", None),
+    "make_tp_lm_train_step": ("tensor", "make_tp_lm_train_step"),
+    "shard_params": ("tensor", "shard_params"),
+    "tp_mesh": ("tensor", "tp_mesh"),
+    "transformer_tp_rules": ("tensor", "transformer_tp_rules"),
+    "make_pp_lm_train_step": ("pipeline", "make_pp_lm_train_step"),
+    "pp_mesh": ("pipeline", "pp_mesh"),
+    "stack_block_params": ("pipeline", "stack_block_params"),
+    "unstack_block_params": ("pipeline", "unstack_block_params"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        modname, attr = _LAZY[name]
+        mod = importlib.import_module(f".{modname}", __name__)
+        return getattr(mod, attr) if attr else mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

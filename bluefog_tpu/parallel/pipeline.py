@@ -1,0 +1,179 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.6: PP absent in BlueFog); built
+because layer pipelining is the remaining first-class TPU scaling axis.
+Design is the canonical SPMD pipeline: every stage runs the *same* jitted
+program (shard_map over a ``pp`` axis), stage ``s`` owns layers
+``[s*K, (s+1)*K)`` as a stacked parameter tree sharded on its leading axis,
+and activations flow stage-to-stage with one ``lax.ppermute`` per tick
+while ``M`` microbatches stream through (``M + S - 1`` ticks total; the
+pipeline bubble's garbage outputs are masked out of the loss, so autodiff
+sends them zero cotangents and gradients are exact).
+
+Embedding and LM head are computed outside the pipelined stack on every
+rank (they are cheap relative to the blocks and this keeps every stage's
+program identical — the SPMD requirement).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["stack_block_params", "unstack_block_params",
+           "make_pp_lm_train_step", "pp_mesh"]
+
+
+def pp_mesh(stages: int, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None
+                         else jax.devices()[:stages])
+    if devices.size != stages:
+        raise ValueError(f"need {stages} devices, have {devices.size}")
+    return Mesh(devices.reshape(stages), ("pp",))
+
+
+def stack_block_params(params, num_layers: int):
+    """Split a Transformer params tree into (stacked blocks [L, ...], rest).
+
+    ``rest`` keeps embed / final norm / lm_head, which stay replicated.
+    """
+    blocks = [params[f"block_{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return stacked, rest
+
+
+def unstack_block_params(stacked, rest, num_layers: int):
+    """Inverse of :func:`stack_block_params`."""
+    out = dict(rest)
+    for i in range(num_layers):
+        out[f"block_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+    return out
+
+
+def make_pp_lm_train_step(model, base_opt: optax.GradientTransformation,
+                          mesh: Mesh, num_microbatches: int,
+                          donate: bool = True):
+    """Pipeline-parallel LM train step over ``mesh``'s ``pp`` axis.
+
+    ``tokens``/``targets`` ``[B, T]`` with ``B %% num_microbatches == 0``;
+    the stacked block parameters are sharded one layer-group per stage,
+    embed/head replicate.  Returns ``step(stacked, rest, opt_state, tokens,
+    targets) -> (stacked, rest, opt_state, loss)``; build inputs with
+    :func:`stack_block_params`.
+    """
+    from ..models.transformer import Block  # deferred: avoids import cycle
+    from ..ops.ring_attention import attention as _attn
+
+    cfg = model.config
+    S = mesh.devices.size
+    L = cfg.num_layers
+    M = num_microbatches
+    if L % S:
+        raise ValueError(f"num_layers {L} must divide into {S} stages")
+    K = L // S
+    block = Block(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
+                  cfg.num_experts, cfg.capacity_factor)
+
+    def apply_stage(stage_params, h, positions):
+        """Apply this stage's K blocks ([K, ...] leaves) sequentially."""
+        def body(carry, p):
+            out = block.apply(
+                {"params": p}, carry,
+                lambda q, k, v: _attn(q, k, v, causal=True), positions)
+            return out, None
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    def pipe_forward(stacked, rest, tokens):
+        """shard_map body: tokens [B, T] replicated; stacked has [K,...]
+        leaves (this stage's slice); returns logits [B, T, V]."""
+        stage = lax.axis_index("pp")
+        B, T = tokens.shape
+        Bm = B // M
+        positions = jnp.arange(T)
+        micro = _embed(rest, tokens.reshape(M, Bm, T), cfg)  # [M, Bm, T, D]
+
+        D = micro.shape[-1]
+        perm = [(j, (j + 1) % S) for j in range(S)]
+        _vary = lambda a: lax.pcast(a, "pp", to="varying")
+        out_buf = _vary(jnp.zeros((M, Bm, T, D), micro.dtype))
+        state = _vary(jnp.zeros((Bm, T, D), micro.dtype))
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 injects microbatch t (or zeros in the drain phase)
+            feed = micro[jnp.clip(t, 0, M - 1)]
+            h_in = jnp.where(stage == 0,
+                             jnp.where(t < M, feed, jnp.zeros_like(feed)),
+                             state)
+            h_out = apply_stage(stacked, h_in, positions)
+            # last stage banks microbatch t-(S-1) once it emerges
+            emit_idx = t - (S - 1)
+            valid = (stage == S - 1) & (emit_idx >= 0)
+            slot = jnp.clip(emit_idx, 0, M - 1)
+            banked = jnp.where(valid, h_out, out_buf[slot])
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, banked,
+                                                      slot, 0)
+            state = lax.ppermute(h_out, "pp", perm)
+            return (state, out_buf), None
+
+        (_, out_buf), _ = lax.scan(tick, (state, out_buf),
+                                   jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; broadcast them to all
+        # stages so the (replicated) head + loss see the true activations
+        masked = jnp.where(stage == S - 1, out_buf,
+                           jnp.zeros_like(out_buf))
+        out = lax.psum(masked, "pp")
+        return _head(rest, out.reshape(B, T, D), cfg)
+
+    def global_loss(stacked, rest, tokens, targets):
+        def shard_fn(stk, rst, tok, tgt):
+            stk = jax.tree.map(lambda a: a[0], stk)   # [1,K,...] -> [K,...]
+            logits = pipe_forward(stk, rst, tok)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt).mean()
+            return lax.pmean(loss, "pp")
+
+        # stacked leaves are [S*K, ...]; shard the leading axis over pp
+        stacked4 = jax.tree.map(
+            lambda a: a.reshape((S, K) + a.shape[1:]), stacked)
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=P())(stacked4, rest, tokens, targets)
+
+    def stepper(stacked, rest, opt_state, tokens, targets):
+        if tokens.shape[0] % M:
+            raise ValueError(
+                f"batch {tokens.shape[0]} must be divisible by "
+                f"num_microbatches {M}")
+        loss, grads = jax.value_and_grad(global_loss, argnums=(0, 1))(
+            stacked, rest, tokens, targets)
+        params = (stacked, rest)
+        updates, opt_state = base_opt.update(grads, opt_state, params)
+        stacked, rest = optax.apply_updates(params, updates)
+        return stacked, rest, opt_state, loss
+
+    return jax.jit(stepper, donate_argnums=(0, 1, 2) if donate else ())
+
+
+import flax.linen as nn  # noqa: E402  (module helpers below)
+
+
+def _embed(rest, tokens, cfg):
+    """Embedding lookup from the replicated non-block params (every stage
+    computes it; only stage 0's result feeds the pipeline)."""
+    return nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype).apply(
+        {"params": rest["embed"]}, tokens)
+
+
+def _head(rest, x, cfg):
+    """Final norm + LM head from the replicated non-block params."""
+    x = nn.LayerNorm(dtype=cfg.dtype).apply({"params": rest["ln_f"]}, x)
+    return nn.Dense(cfg.vocab_size, dtype=jnp.float32).apply(
+        {"params": rest["lm_head"]}, x)

@@ -1,0 +1,147 @@
+"""Tensor parallelism: Megatron-style sharding rules via GSPMD.
+
+No reference counterpart (SURVEY.md §2.6: TP absent in BlueFog — "no weight
+sharding anywhere"); built because weight sharding is a core TPU scaling
+axis.  The idiomatic TPU implementation is *declarative*: place parameter
+leaves with ``NamedSharding`` over a ``(dp, tp)`` mesh and let XLA's SPMD
+partitioner insert the all-gathers/reduce-scatters — no hand-written
+collectives (the How-to-Scale-Your-Model recipe: pick a mesh, annotate
+shardings, let XLA do the rest).
+
+Rules follow the Megatron pattern for the Transformer family
+(``models/transformer.py``):
+
+  * qkv projection: split the heads dimension (column parallel)
+  * attention output projection: split the heads dimension (row parallel)
+  * MLP up: split the hidden dimension (column), MLP down: row
+  * MoE experts: split the expert dimension
+  * embeddings / norms / router: replicated over tp
+
+Gradients and optimizer states inherit the parameter shardings through
+jit's sharding propagation, so the Adam mirror of a sharded weight is
+sharded identically for free.
+"""
+
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["transformer_tp_rules", "shard_params", "make_tp_lm_train_step",
+           "tp_mesh"]
+
+# (path regex, PartitionSpec factory given tp axis name); first match wins
+_TP_RULES = [
+    (r"qkv/kernel$",      lambda tp: P(None, None, tp, None)),  # [D,3,H,hd]
+    (r"qkv/bias$",        lambda tp: P(None, tp, None)),        # [3,H,hd]
+    (r"proj/kernel$",     lambda tp: P(tp, None, None)),        # [H,hd,D]
+    (r"mlp_up/kernel$",   lambda tp: P(None, tp)),              # [D,Hm]
+    (r"mlp_up/bias$",     lambda tp: P(tp)),                    # [Hm]
+    (r"mlp_down/kernel$", lambda tp: P(tp, None)),              # [Hm,D]
+    (r"moe/w_up$",        lambda tp: P(tp, None, None)),        # [E,D,Hm]
+    (r"moe/b_up$",        lambda tp: P(tp, None)),
+    (r"moe/w_down$",      lambda tp: P(tp, None, None)),
+    (r"moe/b_down$",      lambda tp: P(tp, None)),
+    (r"lm_head/kernel$",  lambda tp: P(None, tp)),              # [D,V]
+    (r"lm_head/bias$",    lambda tp: P(tp)),
+]
+
+
+def transformer_tp_rules(params, tp_axis: str = "tp"):
+    """PartitionSpec pytree for a Transformer params tree (unmatched leaves
+    replicate)."""
+    def spec_for(path, leaf):
+        name = jax.tree_util.keystr(path, simple=True, separator="/")
+        for pat, mk in _TP_RULES:
+            if re.search(pat, name):
+                spec = mk(tp_axis)
+                if len(spec) <= leaf.ndim:
+                    return spec
+        return P()
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    """A ``(dp, tp)`` mesh; tp should map to the fastest (ICI-adjacent)
+    axis, which is the trailing one in the device array."""
+    devices = np.asarray(devices if devices is not None
+                         else jax.devices()[: dp * tp])
+    if devices.size != dp * tp:
+        raise ValueError(f"need {dp * tp} devices, have {devices.size}")
+    return Mesh(devices.reshape(dp, tp), ("dp", "tp"))
+
+
+def shard_params(params, mesh: Mesh, tp_axis: str = "tp"):
+    """Place a replicated params tree according to the TP rules."""
+    specs = transformer_tp_rules(params, tp_axis)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs)
+
+
+def make_tp_lm_train_step(model, base_opt: optax.GradientTransformation,
+                          mesh: Mesh, donate: bool = True):
+    """Data+tensor-parallel LM train step on a ``(dp, tp)`` mesh.
+
+    Tokens/targets ``[B, T]`` are batch-sharded over ``dp``; parameters are
+    sharded by :func:`transformer_tp_rules` over ``tp``.  The step is a
+    plain jitted ``value_and_grad`` — XLA's partitioner derives every
+    collective (all-gather of column-parallel outputs, psum of row-parallel
+    partials, gradient reduce-scatter) from the in/out shardings.
+
+    Returns ``(step_fn, place_fn)``: ``place_fn(params, opt_state)`` puts a
+    freshly initialized state onto the mesh; ``step_fn(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss)``.
+    """
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    def place(params, opt_state):
+        params = shard_params(params, mesh)
+        return params, _shard_like(opt_state, params, mesh)
+
+    def _loss(p, tokens, targets):
+        logits = model.apply({"params": p}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
+        targets = jax.lax.with_sharding_constraint(targets, data_sharding)
+        loss, grads = jax.value_and_grad(_loss)(params, tokens, targets)
+        updates, opt_state = base_opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    if donate:
+        step = jax.jit(step.__wrapped__, donate_argnums=(0, 1))
+    return step, place
+
+
+def _shard_like(opt_state, params, mesh, tp_axis: str = "tp"):
+    """Shard optimizer-state subtrees that mirror the params tree structure
+    (optax mu/nu/trace are exact structural copies) with the parameter
+    specs; everything else replicates.  Structural matching — never by
+    shape, which is ambiguous when two params share one shape."""
+    specs = transformer_tp_rules(params, tp_axis)
+    pstruct = jax.tree.structure(params)
+
+    def is_mirror(node):
+        try:
+            return jax.tree.structure(node) == pstruct
+        except Exception:
+            return False
+
+    def place(node):
+        if is_mirror(node):
+            return jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)), node, specs)
+        return jax.tree.map(
+            lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P())),
+            node)
+
+    return jax.tree_util.tree_map(place, opt_state, is_leaf=is_mirror)

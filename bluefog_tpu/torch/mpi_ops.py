@@ -64,8 +64,12 @@ def synchronize(handle: int) -> torch.Tensor:
     """
     dtype = _torch_handles.pop(handle, None)
     out = _api.synchronize(handle)   # raises ValueError for unknown handles
-    return _to_torch(out, dtype) if dtype is not None \
-        else torch.from_numpy(np.array(out))
+    if dtype is not None:
+        return _to_torch(out, dtype)
+    arr = np.array(out)
+    if arr.dtype.name == "bfloat16":     # ml_dtypes — numpy bridge can't
+        return torch.from_numpy(arr.astype(np.float32)).to(torch.bfloat16)
+    return torch.from_numpy(arr)
 
 
 wait = synchronize

@@ -216,7 +216,12 @@ def make_lm_train_step(model, base_opt: optax.GradientTransformation,
                 out, inter = model.apply(
                     {"params": p_}, tok, moe_fn=moe_fn,
                     mutable=["intermediates"], **kwargs)
-                aux = sum(jax.tree.leaves(inter))
+                # only the router's sown aux losses — a future sow of any
+                # other diagnostic must not leak into the training loss
+                aux = sum(
+                    leaf for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(inter)[0]
+                    if "moe_aux_loss" in jax.tree_util.keystr(path))
             else:
                 out = model.apply({"params": p_}, tok, **kwargs)
                 aux = 0.0
