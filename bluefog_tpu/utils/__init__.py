@@ -1,1 +1,12 @@
-"""Utilities: timeline tracing, logging, parameter distribution helpers."""
+"""Utilities: timeline tracing, logging, parameter distribution helpers,
+checkpoint/resume."""
+
+from . import utility
+
+
+def __getattr__(name):
+    # checkpoint pulls in orbax; defer it (PEP 562) like parallel.tensor
+    if name == "checkpoint":
+        import importlib
+        return importlib.import_module(".checkpoint", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Profiling helper (reference counterpart: scripts/run_profile.sh, which
+# drove nvprof over the benchmark).  TPU-native: captures an XLA profiler
+# trace of the decentralized ResNet train step; open the output directory
+# with TensorBoard (or xprof) to see per-op device timelines, or set
+# BLUEFOG_TIMELINE for the built-in chrome-tracing view.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/bluefog_tpu_profile}"
+echo "Writing profiler trace to $OUT"
+
+python - "$OUT" <<'PYEOF'
+import sys, os
+import jax
+# default to the virtual CPU mesh; PROFILE_ON_TPU=1 profiles real chips.
+# (Querying jax.devices() to auto-detect would hang if the TPU transport
+# is wedged, so the choice is explicit.)
+if os.environ.get("PROFILE_ON_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.models.resnet import ResNet18
+
+out_dir = sys.argv[1]
+bf.init()
+n = bf.size()
+model = ResNet18(num_classes=100, dtype=jnp.float32)
+base = optax.sgd(0.05, momentum=0.9)
+variables, opt_state = T.create_train_state(
+    model, base, jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.normal(size=(n, 8, 64, 64, 3)), jnp.float32)
+y = jnp.asarray(rng.integers(0, 100, size=(n, 8)))
+step = T.make_train_step(model, base, donate=False)
+
+# warmup/compile outside the trace
+variables, opt_state, _ = step(variables, opt_state, (x, y), jnp.int32(0))
+
+with jax.profiler.trace(out_dir):
+    for i in range(1, 6):
+        variables, opt_state, loss = step(variables, opt_state, (x, y),
+                                          jnp.int32(i))
+    jax.block_until_ready(loss)
+print(f"trace written; loss={float(loss):.4f}")
+print(f"view with: tensorboard --logdir {out_dir}")
+PYEOF
