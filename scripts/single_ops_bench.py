@@ -1,0 +1,100 @@
+"""Micro-benchmark every collective (reference: scripts/single_ops_test.py,
+which timed individual MPI/NCCL ops).
+
+Times each op over a range of tensor sizes on the active mesh (real TPU
+slice, or the virtual CPU mesh by default) and prints a table of
+microseconds/op plus achieved algorithmic bandwidth.  Useful for checking
+that neighbor_allreduce stays O(degree) rather than O(N), and for comparing
+the XLA ppermute path against the fused Pallas kernel on real hardware.
+
+Usage:
+    python scripts/single_ops_bench.py [--sizes 4096,262144,4194304]
+    BENCH_ON_TPU=1 python scripts/single_ops_bench.py   # real chips
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if os.environ.get("BENCH_ON_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import bluefog_tpu as bf
+
+
+def timeit(fn, *args, iters=30, warmup=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="4096,262144,4194304",
+                    help="elements per rank, comma separated")
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+
+    bf.init()
+    n = bf.size()
+    topo = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+    pairs = [(i, i + 1) for i in range(0, n - 1, 2)]
+
+    ops = {
+        "allreduce": lambda x: bf.allreduce(x),
+        "broadcast(0)": lambda x: bf.broadcast(x, root_rank=0),
+        "allgather": lambda x: bf.allgather(x),
+        "neighbor_allreduce": lambda x: bf.neighbor_allreduce(x),
+        "nar_dynamic(step=1)": lambda x: bf.neighbor_allreduce(
+            x, sched=sched, step=1),
+        "pair_gossip": lambda x: bf.pair_gossip(x, pairs),
+    }
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    # build + place each input ONCE: to_global pre-shards over the rank
+    # axis so the timed region measures the collective, not a host->device
+    # reshard of the unplaced array on every iteration
+    inputs = {}
+    rng = np.random.default_rng(0)
+    for elems in sizes:
+        inputs[elems] = bf.to_global(jnp.asarray(
+            rng.normal(size=(n, elems)), jnp.float32))
+
+    plat = jax.devices()[0].platform
+    print(f"mesh: {n} x {plat}; per-rank element counts: {args.sizes}")
+    header = f"{'op':22s}" + "".join(f"{s:>17,d}" for s in sizes)
+    print(header)
+    print("-" * len(header))
+    for name, fn in ops.items():
+        row = f"{name:22s}"
+        for elems in sizes:
+            dt = timeit(fn, inputs[elems], iters=args.iters)
+            bw = elems * 4 / dt / 1e9   # GB/s of per-rank payload
+            row += f"{dt * 1e6:>8.0f}us {bw:7.2f}"
+        print(row)
+    print("(second number per column: per-rank payload GB/s)")
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()
