@@ -3,3 +3,4 @@
 from . import collectives, api
 from .ring_attention import attention, ring_attention, ulysses_attention
 from .moe import expert_parallel_ffn, local_moe_ffn, switch_route
+from .flash_attention import flash_attention, flash_attention_trainable

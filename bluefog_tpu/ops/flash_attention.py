@@ -1,0 +1,164 @@
+"""Pallas TPU flash attention: the per-chip hot op of the LM family.
+
+Blockwise online-softmax attention computed in VMEM with the score matrix
+never materialized in HBM — the standard flash recipe mapped to TPU: grid
+over (batch·heads, query blocks), MXU matmuls per (q-block, k-block) tile,
+running max / running sum carried in registers through a ``fori_loop`` over
+key blocks.  With ``causal=True``, key blocks entirely above the diagonal
+are skipped (the loop upper bound is derived from the q-block's last row),
+so causal attention does ~half the work.
+
+``q_offset`` / ``k_offset`` shift the global positions, which makes the
+kernel usable both standalone (full attention) and as the per-hop block
+compute of ring attention (ops/ring_attention.py), where each rank's shard
+starts at a nonzero global position.
+
+Use ``interpret=True`` on CPU test meshes (Pallas interpreter).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_trainable"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+            block_k, seq_k):
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    D = q.shape[-1]
+    q_offset, k_offset = off_ref[0], off_ref[1]
+
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        # last key index this q-block may attend to (global positions)
+        last_q = q_offset + (qi + 1) * bq - 1
+        # number of k blocks with any kj <= last_q
+        nk_live = jnp.clip(
+            (last_q - k_offset) // block_k + 1, 0, nk).astype(jnp.int32)
+    else:
+        nk_live = nk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bq, bk]
+        if causal:
+            rows = q_offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = k_offset + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk_live, body, (m0, l0, a0))
+    l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> 0, not NaN
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
+                              "interpret"))
+def flash_attention(q, k, v, *, causal: bool = False,
+                    q_offset: int = 0, k_offset: int = 0,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Drop-in for ``ops.ring_attention.attention`` computed in one Pallas
+    kernel.  ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D]."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({Tq}, {Tk}) must be divisible by the block "
+            f"sizes ({block_q}, {block_k})")
+
+    # [B, T, H, D] -> [B*H, T, D] so the grid's leading axis is one
+    # (batch, head) pair per program
+    qh = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    kernel = functools.partial(
+        _kernel, scale=scale_, causal=causal, block_k=block_k, seq_k=Tk)
+
+    offsets = jnp.asarray([q_offset, k_offset], jnp.int32)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, off: (b, i, 0)),
+                pl.BlockSpec((1, Tk, D), lambda b, i, off: (b, 0, 0)),
+                pl.BlockSpec((1, Tk, D), lambda b, i, off: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i, off: (b, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(offsets, qh, kh, vh)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_trainable(q, k, v, *, causal: bool = False,
+                              q_offset: int = 0, k_offset: int = 0,
+                              scale: Optional[float] = None,
+                              block_q: int = 128, block_k: int = 128,
+                              interpret: bool = False):
+    """Differentiable flash attention: Pallas forward, reference backward.
+
+    Pallas kernels have no automatic reverse-mode; rather than ship a
+    hand-written (and hard-to-validate) backward kernel, the VJP re-runs
+    the mathematically identical reference ``attention`` under ``jax.vjp``.
+    The forward pass gets the flash kernel's O(T) memory and fused MXU
+    loop; the backward matches the XLA path exactly (and XLA rematerializes
+    it from the same q/k/v residuals).
+    """
+    from .ring_attention import attention as _ref
+
+    kw = dict(causal=causal, q_offset=q_offset, k_offset=k_offset,
+              scale=scale)
+
+    @jax.custom_vjp
+    def _fa(q, k, v):
+        return flash_attention(q, k, v, block_q=block_q, block_k=block_k,
+                               interpret=interpret, **kw)
+
+    def fwd(q, k, v):
+        return _fa(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(lambda q_, k_, v_: _ref(q_, k_, v_, **kw), q, k, v)
+        return vjp(g)
+
+    _fa.defvjp(fwd, bwd)
+    return _fa(q, k, v)
