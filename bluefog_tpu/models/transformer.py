@@ -41,7 +41,8 @@ class TransformerConfig:
 
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_len=8192,
-                 dtype=jnp.bfloat16, num_experts=0, capacity_factor=1.25):
+                 dtype=jnp.bfloat16, num_experts=0, capacity_factor=1.25,
+                 attn_impl="auto"):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -51,6 +52,14 @@ class TransformerConfig:
         self.dtype = dtype
         self.num_experts = num_experts          # 0 = dense MLP
         self.capacity_factor = capacity_factor
+        # default attention when no attn_fn is injected: "auto" picks the
+        # Pallas flash kernel on TPU (ops/flash_attention.py), the XLA
+        # reference path elsewhere; "flash"/"reference" force a choice
+        if attn_impl not in ("auto", "flash", "reference"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'flash' or 'reference', "
+                f"got {attn_impl!r}")
+        self.attn_impl = attn_impl
 
 
 class MoEMLP(nn.Module):
@@ -154,7 +163,13 @@ class Transformer(nn.Module):
                 f"{cfg.max_len} (under sequence parallelism the per-shard "
                 f"length is checked; size the config for the global context)")
         if attn_fn is None:
-            attn_fn = lambda q, k, v: _full_attention(q, k, v, causal=True)
+            from ..ops.flash_attention import best_attention
+            if cfg.attn_impl == "reference":
+                attn_fn = lambda q, k, v: _full_attention(q, k, v, causal=True)
+            else:
+                attn_fn = lambda q, k, v: best_attention(
+                    q, k, v, causal=True,
+                    force_flash=cfg.attn_impl == "flash")
         positions = position_offset + jnp.arange(tokens.shape[1])
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      name="embed")(tokens)

@@ -3,4 +3,6 @@
 from . import collectives, api
 from .ring_attention import attention, ring_attention, ulysses_attention
 from .moe import expert_parallel_ffn, local_moe_ffn, switch_route
-from .flash_attention import flash_attention, flash_attention_trainable
+from .flash_attention import (flash_attention, flash_attention_trainable,
+                              flash_attention_with_lse, best_attention,
+                              merge_attention_partials, flash_supported)

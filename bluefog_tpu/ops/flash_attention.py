@@ -1,19 +1,36 @@
 """Pallas TPU flash attention: the per-chip hot op of the LM family.
 
-Blockwise online-softmax attention computed in VMEM with the score matrix
-never materialized in HBM — the standard flash recipe mapped to TPU: grid
-over (batch·heads, query blocks), MXU matmuls per (q-block, k-block) tile,
-running max / running sum carried in registers through a ``fori_loop`` over
-key blocks.  With ``causal=True``, key blocks entirely above the diagonal
-are skipped (the loop upper bound is derived from the q-block's last row),
-so causal attention does ~half the work.
+Blockwise online-softmax attention with the score matrix never materialized
+in HBM — the standard flash recipe mapped to TPU:
 
-``q_offset`` / ``k_offset`` shift the global positions, which makes the
-kernel usable both standalone (full attention) and as the per-hop block
-compute of ring attention (ops/ring_attention.py), where each rank's shard
-starts at a nonzero global position.
+* **Forward**: grid ``(batch*heads, q_blocks, k_blocks)`` with the K axis
+  innermost (sequential on TPU), so K/V stream through VMEM one
+  ``block_k``-sized tile at a time (long contexts never blow up VMEM).
+  Running max / denominator / accumulator live in VMEM scratch across the
+  K iterations; the normalized output and the log-sum-exp (LSE) row
+  statistics are flushed on the last K step.  Causal key blocks entirely
+  above the diagonal are predicated off with ``pl.when``.
+* **Backward**: two Pallas kernels recompute the probabilities from the
+  saved LSE (no score residuals): a dQ kernel on grid ``(BH, q, k)`` and a
+  dK/dV kernel on grid ``(BH, k, q)``, both streaming the non-resident
+  operand blockwise and accumulating in VMEM scratch — the flash backward
+  recipe, not a fallback to O(T²) reference attention.
+
+``q_offset`` / ``k_offset`` shift the global positions and may be *traced*
+values (they ride in as scalar-prefetch arguments), which makes the kernel
+usable both standalone (full attention) and as the per-hop block compute of
+ring attention (ops/ring_attention.py) where each hop's KV block starts at a
+rank-dependent global position.
+
+The trainable entry point also exposes the LSE and accepts its cotangent
+(``ds += p * g_lse`` folds into the same kernels), which ring attention
+needs to differentiate through its cross-hop merge.
 
 Use ``interpret=True`` on CPU test meshes (Pallas interpreter).
+
+Reference parity note: the reference has no attention op at all (SURVEY.md
+§5.7); this kernel exists because long-context is first-class in the TPU
+build.
 """
 
 import functools
@@ -26,139 +43,447 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attention_trainable"]
+__all__ = ["flash_attention", "flash_attention_trainable",
+           "flash_attention_with_lse", "best_attention",
+           "merge_attention_partials", "flash_supported"]
 
 _NEG_INF = -1e30
+_LANES = 128
 
 
-def _kernel(off_ref, q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-            block_k, seq_k):
+def _interp(flag):
+    return pltpu.InterpretParams() if flag else False
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
+    operands' (required by shard_map's check_vma for pallas outputs)."""
+    vma = set()
+    for x in operands:
+        vma |= set(getattr(jax.typeof(x), "vma", ()) or ())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:      # older JAX without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
     qi = pl.program_id(1)
-    bq = q_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    D = q.shape[-1]
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
     q_offset, k_offset = off_ref[0], off_ref[1]
+    row0 = q_offset + qi * block_q          # global position of first q row
+    col0 = k_offset + kj * block_k          # global position of first k col
 
-    nk = pl.cdiv(seq_k, block_k)
-    if causal:
-        # last key index this q-block may attend to (global positions)
-        last_q = q_offset + (qi + 1) * bq - 1
-        # number of k blocks with any kj <= last_q
-        nk_live = jnp.clip(
-            (last_q - k_offset) // block_k + 1, 0, nk).astype(jnp.int32)
-    else:
-        nk_live = nk
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [bq, bk]
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale             # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                     # [bk, D]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            rows = q_offset + qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            cols = k_offset + j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            rows = row0 + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = col0 + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+        m_prev = m_scr[...]                                  # [bq, LANES]
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1)[:, None]                 # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(
+            m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_new)                       # [bq, LANES]
+        p = jnp.exp(s - m_new[:, :1])                        # [bq, bk]
+        l_new = l_prev * corr + jnp.broadcast_to(
+            p.sum(axis=-1)[:, None], l_prev.shape)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    a0 = jnp.zeros((bq, D), jnp.float32)
-    m, l, acc = lax.fori_loop(0, nk_live, body, (m0, l0, a0))
-    l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> 0, not NaN
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    if causal:
+        # skip key blocks entirely above the diagonal
+        pl.when(col0 <= row0 + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
-                              "interpret"))
-def flash_attention(q, k, v, *, causal: bool = False,
-                    q_offset: int = 0, k_offset: int = 0,
-                    scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False):
-    """Drop-in for ``ops.ring_attention.attention`` computed in one Pallas
-    kernel.  ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D]."""
-    B, Tq, H, D = q.shape
-    Tk = k.shape[1]
-    scale_ = scale if scale is not None else D ** -0.5
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
+def _fwd(qh, kh, vh, offsets, *, scale, causal, block_q, block_k,
+         out_dtype, interpret):
+    """qh/kh/vh: [BH, T, D] heads-major. Returns (o [BH,Tq,D], lse [BH,Tq])."""
+    BH, Tq, D = qh.shape
+    Tk = kh.shape[1]
+    nq, nk = Tq // block_q, Tk // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j, off: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j, off: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j, off: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j, off: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            _out_struct((BH, Tq, D), out_dtype, qh, kh, vh, offsets),
+            _out_struct((BH, Tq), jnp.float32, qh, kh, vh, offsets),
+        ],
+        interpret=_interp(interpret),
+    )(offsets, qh, kh, vh)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _p_block(q_ref, k_ref, lse_ref, *, scale, causal, row0, col0,
+             block_q, block_k):
+    """Recompute the probability block p = exp(s*scale - lse), masked."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)   # [bq, bk]
+    p = jnp.exp(s - lse_ref[0, :][:, None])
+    if causal:
+        rows = row0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = col0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(cols <= rows, p, 0.0)
+    return p
+
+
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_offset, k_offset = off_ref[0], off_ref[1]
+    row0 = q_offset + qi * block_q
+    col0 = k_offset + kj * block_k
+
+    def compute():
+        p = _p_block(q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+                     row0=row0, col0=col0, block_q=block_q, block_k=block_k)
+        do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+        v = v_ref[0].astype(jnp.float32)                      # [bk, D]
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - dl_ref[0, :][:, None]) * scale
+        dq_scr[...] += lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(col0 <= row0 + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_offset, k_offset = off_ref[0], off_ref[1]
+    row0 = q_offset + qi * block_q
+    col0 = k_offset + kj * block_k
+
+    def compute():
+        p = _p_block(q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+                     row0=row0, col0=col0, block_q=block_q, block_k=block_k)
+        do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+        dv_scr[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - dl_ref[0, :][:, None]) * scale         # [bq, bk]
+        dk_scr[...] += lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, D]
+
+    if causal:
+        # this k block receives gradient only from q rows at/below it
+        pl.when(row0 + block_q - 1 >= col0)(compute)
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(qh, kh, vh, doh, lse, dl, offsets, *, scale, causal,
+         block_q, block_k, interpret):
+    """Heads-major backward.  ``dl`` = rowsum(do*o) - g_lse, [BH, Tq]."""
+    BH, Tq, D = qh.shape
+    Tk = kh.shape[1]
+    nq, nk = Tq // block_q, Tk // block_k
+
+    row_specs = dict(
+        q=pl.BlockSpec((1, block_q, D), lambda b, i, j, off: (b, i, 0)),
+        k=pl.BlockSpec((1, block_k, D), lambda b, i, j, off: (b, j, 0)),
+        vec=pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=[row_specs["q"], row_specs["k"], row_specs["k"],
+                      row_specs["q"], row_specs["vec"], row_specs["vec"]],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i, j, off: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
+        out_shape=_out_struct((BH, Tq, D), qh.dtype,
+                              qh, kh, vh, doh, lse, dl, offsets),
+        interpret=_interp(interpret),
+    )(offsets, qh, kh, vh, doh, lse, dl)
+
+    # dK/dV grid: k blocks outer, q blocks inner (swap the index maps)
+    kv_specs = dict(
+        q=pl.BlockSpec((1, block_q, D), lambda b, j, i, off: (b, i, 0)),
+        k=pl.BlockSpec((1, block_k, D), lambda b, j, i, off: (b, j, 0)),
+        vec=pl.BlockSpec((1, block_q), lambda b, j, i, off: (b, i)),
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nk, nq),
+            in_specs=[kv_specs["q"], kv_specs["k"], kv_specs["k"],
+                      kv_specs["q"], kv_specs["vec"], kv_specs["vec"]],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda b, j, i, off: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i, off: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), jnp.float32)],
+        ),
+        out_shape=[_out_struct((BH, Tk, D), kh.dtype,
+                               qh, kh, vh, doh, lse, dl, offsets),
+                   _out_struct((BH, Tk, D), vh.dtype,
+                               qh, kh, vh, doh, lse, dl, offsets)],
+        interpret=_interp(interpret),
+    )(offsets, qh, kh, vh, doh, lse, dl)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _to_heads_major(x):
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_heads_major(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _check_blocks(Tq, Tk, block_q, block_k):
+    block_q, block_k = min(block_q, Tq), min(block_k, Tk)
     if Tq % block_q or Tk % block_k:
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) must be divisible by the block "
             f"sizes ({block_q}, {block_k})")
+    return block_q, block_k
 
-    # [B, T, H, D] -> [B*H, T, D] so the grid's leading axis is one
-    # (batch, head) pair per program
-    qh = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-    kh = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    vh = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
 
-    kernel = functools.partial(
-        _kernel, scale=scale_, causal=causal, block_k=block_k, seq_k=Tk)
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
+                              "interpret", "return_lse"))
+def flash_attention(q, k, v, *, causal: bool = False,
+                    q_offset=0, k_offset=0,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False, return_lse: bool = False):
+    """Flash attention forward.  ``q``: [B, Tq, H, D]; ``k``/``v``:
+    [B, Tk, H, D].  ``q_offset``/``k_offset`` may be traced scalars.
 
-    offsets = jnp.asarray([q_offset, k_offset], jnp.int32)
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(B * H, Tq // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i, off: (b, i, 0)),
-                pl.BlockSpec((1, Tk, D), lambda b, i, off: (b, 0, 0)),
-                pl.BlockSpec((1, Tk, D), lambda b, i, off: (b, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda b, i, off: (b, i, 0)),
-        ),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(offsets, qh, kh, vh)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    With ``return_lse=True`` also returns the per-row log-sum-exp
+    [B, H, Tq] (float32), the statistic ring attention's cross-hop merge
+    needs."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    block_q, block_k = _check_blocks(Tq, Tk, block_q, block_k)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    o, lse = _fwd(_to_heads_major(q), _to_heads_major(k), _to_heads_major(v),
+                  offsets, scale=scale_, causal=causal, block_q=block_q,
+                  block_k=block_k, out_dtype=q.dtype, interpret=interpret)
+    o = _from_heads_major(o, B, H)
+    if return_lse:
+        return o, lse.reshape(B, H, Tq)
+    return o
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _fa_with_lse(q, k, v, offsets, causal, scale, block_q, block_k,
+                 interpret):
+    """Differentiable (o, lse) core; offsets is a traced int32[2]."""
+    B, Tq, H, D = q.shape
+    o, lse = _fwd(_to_heads_major(q), _to_heads_major(k), _to_heads_major(v),
+                  offsets, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, out_dtype=q.dtype, interpret=interpret)
+    return _from_heads_major(o, B, H), lse.reshape(B, H, Tq)
+
+
+def _fa_fwd(q, k, v, offsets, causal, scale, block_q, block_k, interpret):
+    out = _fa_with_lse(q, k, v, offsets, causal, scale, block_q, block_k,
+                       interpret)
+    o, lse = out
+    return out, (q, k, v, o, lse, offsets)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse, offsets = res
+    g_o, g_lse = g
+    B, Tq, H, D = q.shape
+    oh = _to_heads_major(o).astype(jnp.float32)
+    doh = _to_heads_major(g_o)
+    lse_h = lse.reshape(B * H, Tq)
+    # dL/ds = p*(dp - delta) + p*g_lse  ->  fold g_lse into the delta term
+    dl = (oh * doh.astype(jnp.float32)).sum(-1) - g_lse.reshape(B * H, Tq)
+    dq, dk, dv = _bwd(_to_heads_major(q), _to_heads_major(k),
+                      _to_heads_major(v), doh, lse_h, dl, offsets,
+                      scale=scale, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+    d_off = np.zeros((2,), jax.dtypes.float0)  # int operand: zero cotangent
+    return (_from_heads_major(dq, B, H), _from_heads_major(dk, B, H),
+            _from_heads_major(dv, B, H), d_off)
+
+
+_fa_with_lse.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             q_offset=0, k_offset=0,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool = False):
+    """Differentiable flash attention returning ``(o, lse)``; the LSE
+    cotangent is supported (needed under ring attention's merge)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    block_q, block_k = _check_blocks(Tq, Tk, block_q, block_k)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    return _fa_with_lse(q, k, v, offsets, causal, scale_, block_q, block_k,
+                        interpret)
 
 
 def flash_attention_trainable(q, k, v, *, causal: bool = False,
-                              q_offset: int = 0, k_offset: int = 0,
+                              q_offset=0, k_offset=0,
                               scale: Optional[float] = None,
                               block_q: int = 128, block_k: int = 128,
                               interpret: bool = False):
-    """Differentiable flash attention: Pallas forward, reference backward.
+    """Differentiable flash attention: Pallas forward AND Pallas backward
+    (dq/dk/dv recomputed blockwise from the saved LSE — O(T) memory both
+    ways)."""
+    o, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
 
-    Pallas kernels have no automatic reverse-mode; rather than ship a
-    hand-written (and hard-to-validate) backward kernel, the VJP re-runs
-    the mathematically identical reference ``attention`` under ``jax.vjp``.
-    The forward pass gets the flash kernel's O(T) memory and fused MXU
-    loop; the backward matches the XLA path exactly (and XLA rematerializes
-    it from the same q/k/v residuals).
-    """
+
+def merge_attention_partials(o1, lse1, o2, lse2):
+    """Fold two normalized attention partials (over disjoint key sets) into
+    one: ``o = σ w_i/Σw · o_i`` with ``w_i = exp(lse_i - max)``.  Used by
+    ring attention to combine per-hop flash results; differentiable XLA
+    code (elementwise, negligible cost).  ``o``: [B, T, H, D]; ``lse``:
+    [B, H, T]."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    lse = m + jnp.log(denom)
+    c1 = (w1 / denom).transpose(0, 2, 1)[..., None]
+    c2 = (w2 / denom).transpose(0, 2, 1)[..., None]
+    return o1 * c1 + o2 * c2, lse
+
+
+def flash_supported(q, k, block_q: int = 128, block_k: int = 128) -> bool:
+    """True when the shapes tile cleanly and we are on a TPU backend."""
+    Tq, Tk = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    return (jax.default_backend() == "tpu"
+            and Tq % bq == 0 and Tk % bk == 0
+            and bq % 8 == 0 and bk % 8 == 0)
+
+
+def best_attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0,
+                   scale: Optional[float] = None, interpret: bool = False,
+                   force_flash: bool = False):
+    """Attention dispatcher: the trainable flash kernel on TPU when the
+    shapes tile onto it, the XLA reference path otherwise (CPU test meshes,
+    tiny/ragged shapes)."""
     from .ring_attention import attention as _ref
-
-    kw = dict(causal=causal, q_offset=q_offset, k_offset=k_offset,
-              scale=scale)
-
-    @jax.custom_vjp
-    def _fa(q, k, v):
-        return flash_attention(q, k, v, block_q=block_q, block_k=block_k,
-                               interpret=interpret, **kw)
-
-    def fwd(q, k, v):
-        return _fa(q, k, v), (q, k, v)
-
-    def bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(lambda q_, k_, v_: _ref(q_, k_, v_, **kw), q, k, v)
-        return vjp(g)
-
-    _fa.defvjp(fwd, bwd)
-    return _fa(q, k, v)
+    if force_flash and not interpret and jax.default_backend() != "tpu":
+        raise ValueError(
+            "flash attention requires a TPU backend (pass interpret=True "
+            "to run the Pallas interpreter on CPU)")
+    if force_flash or flash_supported(q, k):
+        return flash_attention_trainable(
+            q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset,
+            scale=scale, interpret=interpret)
+    return _ref(q, k, v, causal=causal, q_offset=q_offset,
+                k_offset=k_offset, scale=scale)

@@ -82,7 +82,9 @@ def _block_step(q, k, v, m, l, o, *, causal, q_pos0, k_pos0, scale):
 
 
 def ring_attention(q, k, v, axis_name, *, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, impl: str = "auto",
+                   block_q: int = 128, block_k: int = 128,
+                   interpret: bool = False):
     """Exact attention over a ring-sharded sequence (call inside shard_map).
 
     Each rank holds the [B, T/n, H, D] shard of q/k/v for its sequence
@@ -91,25 +93,72 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
     makes the result exactly equal to full attention over the whole
     sequence, independent of ring size.
 
+    ``impl`` selects the per-hop block compute:
+
+    * ``"flash"`` — the Pallas flash kernel (ops/flash_attention.py): each
+      hop produces a normalized partial + LSE in O(block) memory, folded
+      into the carry with ``merge_attention_partials``.  The hop offsets
+      (this rank's q position, the rotating source's k position) are traced
+      scalars fed to the kernel via scalar prefetch.
+    * ``"xla"`` — the einsum online-softmax block (materializes one
+      [B, H, Tq, Tk] score block per hop; fine for short shards/CPU).
+    * ``"auto"`` (default) — flash on TPU when the shard shapes tile onto
+      the kernel, xla otherwise.
+
     Communication: n-1 hops of 2·|KV shard| each over nearest-neighbor ICI
     links — the same circulant-shift primitive as
     ``collectives.neighbor_allreduce`` (offset 1 only).
     """
+    from .flash_attention import (flash_attention_with_lse, flash_supported,
+                                  merge_attention_partials)
+
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale_ = scale if scale is not None else D ** -0.5
     perm = [(j, (j + 1) % n) for j in range(n)]
+    if impl == "auto":
+        impl = "flash" if flash_supported(q, k, block_q, block_k) else "xla"
+    if impl not in ("flash", "xla"):
+        raise ValueError(f"impl must be 'auto', 'flash' or 'xla', got {impl!r}")
+
+    q_pos0 = idx * T
+    _vary = lambda a: lax.pcast(a, axis_name, to="varying")
+
+    if impl == "flash":
+        def hop(q_, k_blk, v_blk, k_pos0):
+            return flash_attention_with_lse(
+                q_, k_blk, v_blk, causal=causal, q_offset=q_pos0,
+                k_offset=k_pos0, scale=scale_, block_q=block_q,
+                block_k=block_k, interpret=interpret)
+
+        if not interpret:   # interpreter-mode callbacks can't be remat'd
+            hop = jax.checkpoint(hop)
+        o, lse = hop(q, k, v, idx * T)
+        o = o.astype(jnp.float32)
+
+        def step(carry, s):
+            k_blk, v_blk, o, lse = carry
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            src = lax.rem(idx - s + n, n)
+            o_h, lse_h = hop(q, k_blk, v_blk, src * T)
+            o, lse = merge_attention_partials(
+                o, lse, o_h.astype(jnp.float32), lse_h)
+            return (k_blk, v_blk, o, lse), None
+
+        if n > 1:
+            (_, _, o, lse), _ = lax.scan(
+                step, (k, v, o, lse), jnp.arange(1, n))
+        return o.astype(q.dtype)
+
     q32 = q.astype(jnp.float32)
     block = jax.checkpoint(
         functools.partial(_block_step, causal=causal, scale=scale_))
 
-    q_pos0 = idx * T
-
     # local block first, then n-1 permute→accumulate hops: exactly n-1
     # ppermutes (rotating a final, never-read KV pair would waste one ICI
     # hop per layer — XLA cannot DCE a collective inside the scan body)
-    _vary = lambda a: lax.pcast(a, axis_name, to="varying")
     m0 = _vary(jnp.full((B, H, T), _NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
     o0 = _vary(jnp.zeros((B, T, H, D), jnp.float32))

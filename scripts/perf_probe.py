@@ -1,0 +1,110 @@
+"""Single-chip perf probe for the ResNet-50 bench step.
+
+Times the full train step (and optionally forward-only) and reports achieved
+FLOP/s vs the chip's peak (MFU), using XLA's own cost analysis for the FLOP
+count.  Prints incrementally so a partial run still yields data.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.models.resnet import ResNet50
+
+# bf16 peak FLOP/s per chip by device kind (public numbers)
+PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device_kind: str):
+    for k, v in PEAK.items():
+        if k.lower() in device_kind.lower():
+            return v
+    return None
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    dev = jax.devices()[0]
+    peak = peak_flops(dev.device_kind)
+    print(f"device: {dev.device_kind} ({dev.platform}); "
+          f"assumed peak bf16 FLOP/s: {peak}", flush=True)
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    image = 224
+    bf.init()
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    base = optax.sgd(0.01, momentum=0.9)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))
+    step_fn = T.make_train_step(model, base,
+                                communication="neighbor_allreduce",
+                                sched=None, donate=False)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.normal(size=(1, batch, image, image, 3)), jnp.float32))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(1, batch))))
+
+    t0 = time.perf_counter()
+    compiled = step_fn.lower(variables, opt_state, (x, y),
+                             jnp.int32(0)).compile()
+    print(f"step compile: {time.perf_counter()-t0:.1f}s", flush=True)
+    cost = compiled.cost_analysis()
+    flops = cost.get("flops") if cost else None
+    print(f"XLA step flops: {flops}", flush=True)
+
+    t_step = timeit(step_fn, variables, opt_state, (x, y), jnp.int32(0))
+    print(f"full step: {t_step*1e3:.2f} ms  ({batch/t_step:.0f} img/s)",
+          flush=True)
+    if flops and peak:
+        print(f"MFU (full step): {flops/t_step/peak*100:.1f}%", flush=True)
+
+    if os.environ.get("PROBE_FWD", "0") == "1":
+        sq = jax.tree.map(lambda a: a[0], variables)
+
+        @jax.jit
+        def fwd(v, xb):
+            return model.apply(v, xb, train=True, mutable=["batch_stats"])[0]
+
+        t0 = time.perf_counter()
+        fcomp = fwd.lower(sq, x[0]).compile()
+        print(f"fwd compile: {time.perf_counter()-t0:.1f}s", flush=True)
+        fcost = fcomp.cost_analysis()
+        fflops = fcost.get("flops") if fcost else None
+        t_fwd = timeit(fwd, sq, x[0])
+        print(f"fwd: {t_fwd*1e3:.2f} ms  ({batch/t_fwd:.0f} img/s)",
+              flush=True)
+        if fflops and peak:
+            print(f"MFU (fwd): {fflops/t_fwd/peak*100:.1f}%", flush=True)
+
+
+if __name__ == "__main__":
+    main()
