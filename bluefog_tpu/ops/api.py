@@ -190,17 +190,65 @@ def _neighbor_allgather_fn(axis, topo: CompiledTopology, mesh_id):
 
 
 @functools.lru_cache(maxsize=256)
-def _dynamic_nar_fn(axis, sched: DynamicSchedule, mesh_id):
+def _dynamic_nar_fn(axis, sched: DynamicSchedule, mesh_id, backend="xla"):
     cx = ctx()
     spec = P(cx.rank_axis)
+    pallas = backend.startswith("pallas")
+    interp = backend == "pallas_interpret"
 
     def wrapper(x, step):
         def shard_fn(xs, step_s):
+            if pallas:
+                from . import pallas_kernels as PK
+                return PK.fused_dynamic_neighbor_allreduce(
+                    xs[0], axis, sched, step_s, interpret=interp)[None]
             return C.dynamic_neighbor_allreduce(xs[0], axis, sched, step_s)[None]
         return jax.shard_map(
             shard_fn, mesh=cx.mesh, in_specs=(spec, P()), out_specs=spec,
+            check_vma=not pallas,
         )(x, step)
     return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=256)
+def _sparse_matrix_fn(axis, size, offsets: Tuple[int, ...],
+                      sender_side: bool, mesh_id):
+    """Per-call weight matrices with a cached sparsity structure: the
+    offsets are static (K ppermutes), the weight tables are traced data —
+    same-structure calls never recompile and never all-gather."""
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, self_w, weights):
+        def shard_fn(xs, sw, w):
+            return C.offset_weighted_neighbor_allreduce(
+                xs[0], axis, size, offsets, sw, w,
+                sender_side=sender_side)[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh, in_specs=(spec, P(), P()), out_specs=spec,
+        )(x, self_w, weights)
+    return jax.jit(wrapper)
+
+
+def _matrix_structure(W: np.ndarray) -> Tuple[int, ...]:
+    srcs, dsts = np.nonzero(W)
+    n = W.shape[0]
+    return tuple(sorted({int((d - s) % n)
+                         for s, d in zip(srcs, dsts) if s != d}))
+
+
+def _matrix_weight_tables(W: np.ndarray, offsets: Tuple[int, ...],
+                          sender_side: bool):
+    """[K, N] weight table for the circulant execution of matrix W."""
+    n = W.shape[0]
+    ranks = np.arange(n)
+    tables = np.zeros((len(offsets), n))
+    for k, off in enumerate(offsets):
+        if sender_side:
+            tables[k] = W[ranks, (ranks + off) % n]   # i's scale toward i+off
+        else:
+            tables[k] = W[(ranks - off) % n, ranks]   # j's scale for j-off
+    return np.diag(W).copy(), tables
 
 
 @functools.lru_cache(maxsize=256)
@@ -284,19 +332,53 @@ def neighbor_allreduce_nonblocking(
         x, *,
         self_weight: Optional[float] = None,
         weight_matrix: Optional[np.ndarray] = None,
+        dst_weighted: bool = False,
+        dst_weight_matrix: Optional[np.ndarray] = None,
         sched: Optional[DynamicSchedule] = None,
         step: Optional[int] = None,
         name: Optional[str] = None) -> int:
     cx = ctx()
     xg = to_global(x)
     if sched is not None:
-        if step is None:
-            raise ValueError("dynamic schedule requires a step index")
-        out = _dynamic_nar_fn(cx.rank_axis, sched, _mesh_id())(
-            xg, jnp.asarray(step, jnp.int32))
+        if dst_weight_matrix is not None:
+            # per-call sender-side weights over the schedule's fixed offset
+            # superset: structure cached once, this step's weights are data.
+            # D fully determines the mixing, so `step` is not consulted —
+            # the caller derives D from the step's live edges (reference
+            # per-call dst_weights, torch/mpi_ops.py:475-645)
+            D = np.asarray(dst_weight_matrix, np.float64)
+            extra = set(_matrix_structure(D)) - set(sched.offsets)
+            if extra:
+                raise ValueError(
+                    f"dst_weight_matrix uses ring offsets {sorted(extra)} "
+                    f"absent from the schedule's superset {sched.offsets}")
+            self_w, send_w = _matrix_weight_tables(D, sched.offsets,
+                                                   sender_side=True)
+            out = _sparse_matrix_fn(cx.rank_axis, cx.size, sched.offsets,
+                                    True, _mesh_id())(
+                xg, jnp.asarray(self_w), jnp.asarray(send_w))
+        else:
+            if step is None:
+                raise ValueError("dynamic schedule requires a step index")
+            out = _dynamic_nar_fn(cx.rank_axis, sched, _mesh_id(),
+                                  _nar_backend())(
+                xg, jnp.asarray(step, jnp.int32))
     elif weight_matrix is not None:
-        out = _matrix_mix_fn(cx.rank_axis, _mesh_id())(
-            xg, jnp.asarray(weight_matrix))
+        W = np.asarray(weight_matrix, np.float64)
+        if W.shape != (cx.size, cx.size):
+            raise ValueError(
+                f"weight_matrix must be [{cx.size}, {cx.size}], got {W.shape}")
+        offsets = _matrix_structure(W)
+        if len(offsets) < cx.size - 1:
+            # sparse: K cached ppermutes, weights as data (no allgather)
+            self_w, tables = _matrix_weight_tables(W, offsets, dst_weighted)
+            out = _sparse_matrix_fn(cx.rank_axis, cx.size, offsets,
+                                    dst_weighted, _mesh_id())(
+                xg, jnp.asarray(self_w), jnp.asarray(tables))
+        else:
+            # dense: one allgather mix is cheaper than N-1 permutes
+            out = _matrix_mix_fn(cx.rank_axis, _mesh_id())(
+                xg, jnp.asarray(W))
     else:
         topo = cx.compiled_topology
         out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id(),
@@ -312,24 +394,124 @@ def neighbor_allreduce(x, **kwargs):
         ``bf.init(is_weighted=False)``, the reference default).
       * ``weight_matrix=W``: arbitrary one-step mixing matrix (covers the
         reference's per-call ``self_weight/src_weights/dst_weights`` — any
-        per-rank weighting is a row/column of W).
+        per-rank weighting is a row/column of W).  Sparse matrices compile
+        to K cached ppermutes with the weights as data (same-structure calls
+        never recompile); dense matrices fall back to one allgather mix.
+        ``dst_weighted=True`` applies the weights on the sender side (the
+        reference's dst-weighted path, mpi_controller.cc:1444-1446) —
+        numerically identical, exercised as its own program.
       * ``sched=..., step=i``: precompiled dynamic schedule; the step index
-        is data, so per-step topology hops never recompile.
+        is data, so per-step topology hops never recompile.  With
+        ``dst_weight_matrix=D``, senders scale per-destination before the
+        exchange (dynamic dst-weighting, torch/mpi_ops.py:475-645).
+        ``BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas`` routes the schedule
+        through the fused concurrent-RDMA kernel
+        (``ops.pallas_kernels.fused_dynamic_neighbor_allreduce``).
     """
     return synchronize(neighbor_allreduce_nonblocking(x, **kwargs))
 
 
-def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
+@functools.lru_cache(maxsize=256)
+def _dynamic_nag_fn(axis, size, offsets: Tuple[int, ...], out_rows: int,
+                    mesh_id):
     cx = ctx()
-    topo = cx.compiled_topology
-    out = _neighbor_allgather_fn(cx.rank_axis, topo, _mesh_id())(to_global(x))
+    spec = P(cx.rank_axis)
+
+    def wrapper(x, slots):
+        def shard_fn(xs, sl):
+            return C.dynamic_neighbor_allgather(
+                xs[0], axis, size, offsets, sl, out_rows)[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh, in_specs=(spec, P()), out_specs=spec,
+        )(x, slots)
+    return jax.jit(wrapper)
+
+
+def _edge_matrix_from_ranks(size: int, src_ranks, dst_ranks) -> np.ndarray:
+    """Adjacency A[s, d] from per-rank neighbor lists; validates that the
+    two views describe the same edge set when both are given (the
+    reference's CheckNeighborSendRecvPattern, mpi_controller.cc:364-399)."""
+    A_src = A_dst = None
+    if src_ranks is not None:
+        if len(src_ranks) != size:
+            raise ValueError(
+                f"src_ranks is the global view: one in-neighbor list per "
+                f"rank (length {size}), got {len(src_ranks)}")
+        A_src = np.zeros((size, size), dtype=bool)
+        for d, srcs in enumerate(src_ranks):
+            for s in srcs:
+                if s == d:
+                    raise ValueError("self rank cannot be a neighbor")
+                A_src[s, d] = True
+    if dst_ranks is not None:
+        if len(dst_ranks) != size:
+            raise ValueError(
+                f"dst_ranks is the global view: one out-neighbor list per "
+                f"rank (length {size}), got {len(dst_ranks)}")
+        A_dst = np.zeros((size, size), dtype=bool)
+        for s, dsts in enumerate(dst_ranks):
+            for d in dsts:
+                if s == d:
+                    raise ValueError("self rank cannot be a neighbor")
+                A_dst[s, d] = True
+    if A_src is not None and A_dst is not None:
+        if not np.array_equal(A_src, A_dst):
+            raise ValueError(
+                "src_ranks and dst_ranks describe different edge sets "
+                "(reference topo-check parity, mpi_controller.cc:364-399)")
+    A = A_src if A_src is not None else A_dst
+    if A is None:
+        raise ValueError("pass src_ranks and/or dst_ranks")
+    return A
+
+
+def _edge_slots(A: np.ndarray, offsets: Tuple[int, ...], out_rows: int):
+    """[K, N] output-row table for adjacency A (sorted ascending sources;
+    out_rows = drop sentinel for absent edges)."""
+    n = A.shape[0]
+    slots = np.full((len(offsets), n), out_rows, dtype=np.int32)
+    sorted_sources = [list(np.nonzero(A[:, d])[0]) for d in range(n)]
+    for k, off in enumerate(offsets):
+        for d in range(n):
+            s = (d - off) % n
+            if A[s, d]:
+                slots[k, d] = sorted_sources[d].index(s)
+    return slots
+
+
+def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
+                                   src_ranks=None, dst_ranks=None) -> int:
+    cx = ctx()
+    if src_ranks is not None or dst_ranks is not None:
+        A = _edge_matrix_from_ranks(cx.size, src_ranks, dst_ranks)
+        srcs, dsts = np.nonzero(A)
+        offsets = tuple(sorted({int((d - s) % cx.size)
+                                for s, d in zip(srcs, dsts)}))
+        out_rows = int(A.sum(axis=0).max(initial=0))
+        slots = _edge_slots(A, offsets, out_rows)
+        out = _dynamic_nag_fn(cx.rank_axis, cx.size, offsets, out_rows,
+                              _mesh_id())(to_global(x), jnp.asarray(slots))
+    else:
+        topo = cx.compiled_topology
+        out = _neighbor_allgather_fn(cx.rank_axis, topo, _mesh_id())(
+            to_global(x))
     return _register_handle(out, "neighbor_allgather", name)
 
 
-def neighbor_allgather(x, name: Optional[str] = None):
+def neighbor_allgather(x, name: Optional[str] = None, *,
+                       src_ranks=None, dst_ranks=None):
     """Gather in-neighbor slices, ordered by ascending source rank
-    (mpi_ops.py:397-472).  Global result shape: [size, in_degree, ...]."""
-    return synchronize(neighbor_allgather_nonblocking(x, name))
+    (mpi_ops.py:397-472).  Global result shape: [size, max_in_degree, ...];
+    on irregular graphs (allgatherv semantics, mpi_context.cc:622-700) rank
+    i's valid rows are the first ``in_degree(i)`` and padding rows are zero.
+
+    ``src_ranks``/``dst_ranks`` select a per-call edge set (the reference's
+    dynamic neighbor_allgather) as global per-rank neighbor lists; when both
+    are given they are cross-checked like the reference's topology check.
+    Same-structure calls reuse one compiled program.
+    """
+    return synchronize(neighbor_allgather_nonblocking(
+        x, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
 
 
 def hierarchical_neighbor_allreduce_nonblocking(

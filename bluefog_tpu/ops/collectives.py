@@ -33,7 +33,10 @@ __all__ = [
     "barrier_value",
     "neighbor_allreduce",
     "dynamic_neighbor_allreduce",
+    "dynamic_neighbor_allreduce_dst_weighted",
+    "offset_weighted_neighbor_allreduce",
     "neighbor_allgather",
+    "dynamic_neighbor_allgather",
     "pair_gossip",
     "hierarchical_neighbor_allreduce",
     "hierarchical_local_allreduce",
@@ -106,10 +109,10 @@ def neighbor_allreduce(x, axis_name, topo: CompiledTopology):
 
 def _allgather_slots(topo: CompiledTopology) -> np.ndarray:
     """slots[k, i] = position of offset-k's source in rank i's sorted
-    in-neighbor list, or in_degree (=> dropped) when no such edge."""
+    in-neighbor list, or max in_degree (=> dropped) when no such edge."""
     n = topo.size
-    indeg = int(topo.in_degrees()[0])
-    slots = np.full((len(topo.shifts), n), indeg, dtype=np.int32)
+    sentinel = int(topo.in_degrees().max(initial=0))
+    slots = np.full((len(topo.shifts), n), sentinel, dtype=np.int32)
     sorted_sources = [topo.in_neighbor_ranks(i) for i in range(n)]
     for k, shift in enumerate(topo.shifts):
         for src, dst in shift.pairs:
@@ -117,25 +120,93 @@ def _allgather_slots(topo: CompiledTopology) -> np.ndarray:
     return slots
 
 
+def _padded_gather(x, axis_name, permutes, slots, out_rows: int):
+    """Shared padded-gather loop: one ppermute per offset, arrivals written
+    to their per-rank output row (``slots[k, i]``; the out-of-range sentinel
+    drops rows for ranks without that in-edge)."""
+    idx = lax.axis_index(axis_name)
+    slots = jnp.asarray(slots)
+    out = jnp.zeros((out_rows,) + x.shape, x.dtype)
+    for k, perm in enumerate(permutes):
+        received = lax.ppermute(x, axis_name, perm)
+        out = out.at[slots[k, idx]].set(received, mode="drop")
+    return out
+
+
 def neighbor_allgather(x, axis_name, topo: CompiledTopology):
-    """Stack in-neighbor tensors: out has shape ``[in_degree, *x.shape]``,
+    """Stack in-neighbor tensors: out has shape ``[max_in_degree, *x.shape]``,
     ordered by ascending source rank (matching MPI_Dist_graph source order,
     mpi_controller.cc:282-361; reference concatenates along dim 0).
 
-    Requires a regular topology (uniform in-degree) so that SPMD output
-    shapes agree across ranks.
+    Irregular topologies (allgatherv semantics, mpi_context.cc:622-700) use
+    the padded max-in-degree layout: rank i's valid slots are the first
+    ``in_degree(i)``; padding rows stay zero.  SPMD output shapes are uniform
+    by construction, so StarGraph and friends work.  The permutes carry only
+    the topology's real edge pairs (non-destinations receive zeros).
     """
-    if not topo.is_regular:
-        raise ValueError(
-            "neighbor_allgather inside SPMD requires a regular topology "
-            "(uniform in-degree); use the global-view API for irregular graphs")
-    indeg = int(topo.in_degrees()[0])
+    indeg = int(topo.in_degrees().max(initial=0))
+    return _padded_gather(x, axis_name,
+                          [shift.pairs for shift in topo.shifts],
+                          _allgather_slots(topo), indeg)
+
+
+def dynamic_neighbor_allgather(x, axis_name, size: int,
+                               offsets: Tuple[int, ...], slots,
+                               out_rows: int):
+    """Per-call neighbor allgather over a traced edge set.
+
+    ``offsets``: static ring-offset superset (structure; cached).
+    ``slots``: traced [K, N] — output row at rank i for the value arriving
+    over ``offsets[k]`` (in-neighbors sorted ascending by source rank), or
+    ``out_rows`` (the drop sentinel) when rank i has no such in-edge.
+    ``out_rows``: static max in-degree — the padded output row count.
+
+    Same-structure calls reuse one compiled program; the edges themselves
+    are data (full-rotation permutes, since the live pairs are unknown at
+    trace time).  This is the reference's per-call ``src_ranks/dst_ranks``
+    neighbor_allgather (torch/mpi_ops.py:397-472; dynamic exchange
+    mpi_controller.cc:322-361) in allgatherv-padded form.
+    """
+    return _padded_gather(x, axis_name,
+                          [_rotation_pairs(size, off) for off in offsets],
+                          slots, out_rows)
+
+
+def offset_weighted_neighbor_allreduce(x, axis_name, size: int,
+                                       offsets: Tuple[int, ...],
+                                       self_w, weights, *,
+                                       sender_side: bool = False):
+    """Circulant neighbor average with *traced* weight tables.
+
+    The offset set (the communication structure) is static; the weights are
+    data, so per-call mixing matrices with the same sparsity pattern reuse
+    one compiled program — the fast path for the reference's per-call
+    ``self_weight/src_weights/dst_weights`` (torch/mpi_ops.py:475-645)
+    instead of an O(N)-bandwidth allgather mix.
+
+    ``self_w``: [N]. ``weights``: [K, N] —
+    * receiver-side (default): ``weights[k, j]`` is the factor rank j applies
+      to the value arriving over ``offsets[k]``;
+    * ``sender_side=True`` (the reference's dst-weighted mode,
+      mpi_controller.cc:1444-1446): ``weights[k, i]`` is the factor rank i
+      applies to its value *before* sending on ``offsets[k]``; receivers add
+      arrivals unscaled.
+    """
+    _require_inexact(x, "offset_weighted_neighbor_allreduce")
     idx = lax.axis_index(axis_name)
-    slots = jnp.asarray(_allgather_slots(topo))
-    out = jnp.zeros((indeg,) + x.shape, x.dtype)
-    for k, shift in enumerate(topo.shifts):
-        received = lax.ppermute(x, axis_name, shift.pairs)
-        out = out.at[slots[k, idx]].set(received, mode="drop")
+    self_w = jnp.asarray(self_w)
+    weights = jnp.asarray(weights)
+    out = self_w[idx].astype(x.dtype) * x
+    for k, offset in enumerate(offsets):
+        if sender_side:
+            received = lax.ppermute(
+                weights[k, idx].astype(x.dtype) * x, axis_name,
+                _rotation_pairs(size, offset))
+            out = out + received
+        else:
+            received = lax.ppermute(
+                x, axis_name, _rotation_pairs(size, offset))
+            out = out + weights[k, idx].astype(x.dtype) * received
     return out
 
 

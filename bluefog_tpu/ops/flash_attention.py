@@ -481,7 +481,9 @@ def best_attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0,
         raise ValueError(
             "flash attention requires a TPU backend (pass interpret=True "
             "to run the Pallas interpreter on CPU)")
-    if force_flash or flash_supported(q, k):
+    # interpret=True is an explicit request for the Pallas kernel (under
+    # the interpreter) — never silently fall back to the XLA path
+    if force_flash or interpret or flash_supported(q, k):
         return flash_attention_trainable(
             q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset,
             scale=scale, interpret=interpret)

@@ -1,21 +1,37 @@
-"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+"""Pipeline parallelism: synchronous 1F1B microbatch schedule over a mesh axis.
 
 No reference counterpart (SURVEY.md §2.6: PP absent in BlueFog); built
 because layer pipelining is the remaining first-class TPU scaling axis.
-Design is the canonical SPMD pipeline: every stage runs the *same* jitted
-program (shard_map over a ``pp`` axis), stage ``s`` owns layers
-``[s*K, (s+1)*K)`` as a stacked parameter tree sharded on its leading axis,
-and activations flow stage-to-stage with one ``lax.ppermute`` per tick
-while ``M`` microbatches stream through (``M + S - 1`` ticks total; the
-pipeline bubble's garbage outputs are masked out of the loss, so autodiff
-sends them zero cotangents and gradients are exact).
 
-Embedding and LM head are computed outside the pipelined stack on every
-rank (they are cheap relative to the blocks and this keeps every stage's
-program identical — the SPMD requirement).
+Design: every stage runs the *same* jitted program (shard_map over a ``pp``
+axis); stage ``s`` owns layers ``[s*K, (s+1)*K)`` as a stacked parameter
+tree sharded on its leading axis.  The schedule is the classic synchronous
+**1F1B** profile expressed as one ``lax.scan`` over ``M + 2S - 2`` ticks:
+
+* tick ``t``, forward slot: stage ``s`` runs microbatch ``t - s`` (if in
+  range), stashing only the stage *input*;
+* tick ``t``, backward slot: stage ``s`` back-propagates microbatch
+  ``t - (2S - 2 - s)``, recomputing its forward from the stashed input
+  (``jax.vjp``) — activation-recompute 1F1B, so the in-flight stash is
+  bounded by ``min(M, 2S-1)`` microbatch activations per stage instead of
+  GPipe's ``M``;
+* activations ``ppermute`` rightward and cotangents leftward once per tick
+  (nearest-neighbor ICI), and gradients accumulate locally.
+
+Stage-divergent work is a runtime branch (``lax.cond`` on
+``lax.axis_index``): the embedding runs **only on stage 0**, the LM head /
+loss / their gradients **only on the last stage**, and bubble ticks skip
+the block compute entirely — none of the GPipe-era redundancy (every stage
+embedding all microbatches and running the head over the full batch).
+
+Backward is constructed manually (per-tick ``jax.vjp``), not by
+differentiating the scan, which is what lets forward and backward
+interleave in one loop — ``jax.grad`` of a forward-only pipeline would
+serialize all forwards before any backward and stash all ``M`` microbatch
+inputs.
 """
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,8 +41,39 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["stack_block_params", "unstack_block_params",
-           "make_pp_lm_train_step", "pp_mesh"]
+           "make_pp_lm_train_step", "pp_mesh",
+           "fwd_microbatch", "bwd_microbatch", "num_ticks", "stash_bound"]
 
+
+# ---------------------------------------------------------------------------
+# The 1F1B schedule (pure functions — unit-testable)
+# ---------------------------------------------------------------------------
+
+def num_ticks(num_microbatches: int, stages: int) -> int:
+    """Total scan ticks: M + 2(S-1)."""
+    return num_microbatches + 2 * (stages - 1)
+
+
+def fwd_microbatch(stage: int, tick: int) -> int:
+    """Microbatch index stage ``stage`` forwards at ``tick`` (may be out of
+    [0, M) — then the stage's forward slot idles)."""
+    return tick - stage
+
+
+def bwd_microbatch(stage: int, tick: int, stages: int) -> int:
+    """Microbatch index stage ``stage`` back-propagates at ``tick``."""
+    return tick - (2 * stages - 2 - stage)
+
+
+def stash_bound(num_microbatches: int, stages: int) -> int:
+    """Max in-flight stage-input stashes per stage: min(M, 2S-1) —
+    the 1F1B memory bound (GPipe stores M)."""
+    return min(num_microbatches, 2 * stages - 1)
+
+
+# ---------------------------------------------------------------------------
+# Parameter layout helpers
+# ---------------------------------------------------------------------------
 
 def pp_mesh(stages: int, devices=None) -> Mesh:
     devices = np.asarray(devices if devices is not None
@@ -39,7 +86,9 @@ def pp_mesh(stages: int, devices=None) -> Mesh:
 def stack_block_params(params, num_layers: int):
     """Split a Transformer params tree into (stacked blocks [L, ...], rest).
 
-    ``rest`` keeps embed / final norm / lm_head, which stay replicated.
+    ``rest`` keeps embed / final norm / lm_head; embed lives on stage 0 and
+    the head on the last stage at runtime, but the tree stays replicated so
+    every stage's program is identical.
     """
     blocks = [params[f"block_{i}"] for i in range(num_layers)]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
@@ -55,19 +104,23 @@ def unstack_block_params(stacked, rest, num_layers: int):
     return out
 
 
+# ---------------------------------------------------------------------------
+# Train step
+# ---------------------------------------------------------------------------
+
 def make_pp_lm_train_step(model, base_opt: optax.GradientTransformation,
                           mesh: Mesh, num_microbatches: int,
                           donate: bool = True):
-    """Pipeline-parallel LM train step over ``mesh``'s ``pp`` axis.
+    """1F1B pipeline-parallel LM train step over ``mesh``'s ``pp`` axis.
 
     ``tokens``/``targets`` ``[B, T]`` with ``B %% num_microbatches == 0``;
     the stacked block parameters are sharded one layer-group per stage,
-    embed/head replicate.  Returns ``step(stacked, rest, opt_state, tokens,
-    targets) -> (stacked, rest, opt_state, loss)``; build inputs with
-    :func:`stack_block_params`.
+    embed/head replicate (computed only on their owning stage).  Returns
+    ``step(stacked, rest, opt_state, tokens, targets) -> (stacked, rest,
+    opt_state, loss)``; build inputs with :func:`stack_block_params`.
     """
     from ..models.transformer import Block  # deferred: avoids import cycle
-    from ..ops.ring_attention import attention as _attn
+    from ..ops.flash_attention import best_attention
 
     cfg = model.config
     S = mesh.devices.size
@@ -76,86 +129,184 @@ def make_pp_lm_train_step(model, base_opt: optax.GradientTransformation,
     if L % S:
         raise ValueError(f"num_layers {L} must divide into {S} stages")
     K = L // S
+    TT = num_ticks(M, S)
+    C = stash_bound(M, S)
     block = Block(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
                   cfg.num_experts, cfg.capacity_factor)
+    attn = lambda q, k, v: best_attention(q, k, v, causal=True)
 
-    def apply_stage(stage_params, h, positions):
-        """Apply this stage's K blocks ([K, ...] leaves) sequentially."""
+    def apply_blocks(stage_params, h, positions):
+        """This stage's K blocks ([K, ...] leaves), sequentially."""
         def body(carry, p):
-            out = block.apply(
-                {"params": p}, carry,
-                lambda q, k, v: _attn(q, k, v, causal=True), positions)
-            return out, None
+            return block.apply({"params": p}, carry, attn, positions), None
         h, _ = lax.scan(body, h, stage_params)
         return h
 
-    def pipe_forward(stacked, rest, tokens):
-        """shard_map body: tokens [B, T] replicated; stacked has [K,...]
-        leaves (this stage's slice); returns logits [B, T, V]."""
+    def embed_fn(rest, tok):
+        return _embed(rest, tok, cfg)
+
+    def head_loss(rest, h, tgt):
+        logits = _head(rest, h, cfg)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    def pipe_step(stacked, rest, tokens, targets):
+        """shard_map body.  ``stacked``: this stage's [K, ...] leaves;
+        ``rest``/``tokens``/``targets`` replicated.  Returns (g_stacked,
+        g_rest_partial, loss_partial) — caller psums the partials."""
         stage = lax.axis_index("pp")
         B, T = tokens.shape
         Bm = B // M
         positions = jnp.arange(T)
-        micro = _embed(rest, tokens.reshape(M, Bm, T), cfg)  # [M, Bm, T, D]
+        tok_mb = tokens.reshape(M, Bm, T)
+        tgt_mb = targets.reshape(M, Bm, T)
+        D = cfg.embed_dim
+        hshape = (Bm, T, D)
+        hdtype = cfg.dtype
+        perm_r = [(j, (j + 1) % S) for j in range(S)]
+        perm_l = [(j, (j - 1) % S) for j in range(S)]
 
-        D = micro.shape[-1]
-        perm = [(j, (j + 1) % S) for j in range(S)]
-        _vary = lambda a: lax.pcast(a, "pp", to="varying")
-        out_buf = _vary(jnp.zeros((M, Bm, T, D), micro.dtype))
-        state = _vary(jnp.zeros((Bm, T, D), micro.dtype))
+        def _vary(a):
+            # idempotent pcast: leaves already varying over pp pass through
+            return jax.tree.map(
+                lambda x: x if "pp" in getattr(jax.typeof(x), "vma", ())
+                else lax.pcast(x, "pp", to="varying"), a)
+        # Mark the replicated params varying BEFORE any vjp touches them:
+        # the transpose of an invariant->varying broadcast is a psum, and a
+        # psum inside a stage-gated lax.cond would be a collective only some
+        # devices execute (deadlock).  Varying in, varying cotangent out —
+        # the single explicit psum below happens on every device.
+        rest = _vary(rest)
+        # cond/scan branches must agree on varying-mesh-axis types, so every
+        # "zero" alternative is explicitly marked varying over pp
+        zeros_h = lambda: _vary(jnp.zeros(hshape, hdtype))
+        zeros_rest = lambda: _vary(jax.tree.map(jnp.zeros_like, rest))
+        zeros_scal = lambda: _vary(jnp.zeros((), jnp.float32))
+        g_stacked0 = jax.tree.map(jnp.zeros_like, stacked)
+        g_rest0 = jax.tree.map(jnp.zeros_like, rest)
+
+        carry0 = (
+            zeros_h(),                             # h_send (rightward)
+            zeros_h(),                             # g_send (leftward)
+            _vary(jnp.zeros((C,) + hshape, hdtype)),   # stash of stage inputs
+            g_stacked0,             # already varying (zeros of the shard)
+            _vary(g_rest0),
+            zeros_scal(),                          # loss sum (last stage)
+        )
 
         def tick(carry, t):
-            state, out_buf = carry
-            # stage 0 injects microbatch t (or zeros in the drain phase)
-            feed = micro[jnp.clip(t, 0, M - 1)]
-            h_in = jnp.where(stage == 0,
-                             jnp.where(t < M, feed, jnp.zeros_like(feed)),
-                             state)
-            h_out = apply_stage(stacked, h_in, positions)
-            # last stage banks microbatch t-(S-1) once it emerges
-            emit_idx = t - (S - 1)
-            valid = (stage == S - 1) & (emit_idx >= 0)
-            slot = jnp.clip(emit_idx, 0, M - 1)
-            banked = jnp.where(valid, h_out, out_buf[slot])
-            out_buf = lax.dynamic_update_index_in_dim(out_buf, banked,
-                                                      slot, 0)
-            state = lax.ppermute(h_out, "pp", perm)
-            return (state, out_buf), None
+            h_recv, g_recv, stash, g_blocks, g_rest, loss_sum = carry
 
-        (_, out_buf), _ = lax.scan(tick, (state, out_buf),
-                                   jnp.arange(M + S - 1))
-        # only the last stage holds real outputs; broadcast them to all
-        # stages so the (replicated) head + loss see the true activations
-        masked = jnp.where(stage == S - 1, out_buf,
-                           jnp.zeros_like(out_buf))
-        out = lax.psum(masked, "pp")
-        return _head(rest, out.reshape(B, T, D), cfg)
+            # ---- forward slot: microbatch t - stage -----------------------
+            m_f = t - stage
+            valid_f = (m_f >= 0) & (m_f < M)
+            mf_c = jnp.clip(m_f, 0, M - 1)
 
-    def global_loss(stacked, rest, tokens, targets):
-        def shard_fn(stk, rst, tok, tgt):
-            stk = jax.tree.map(lambda a: a[0], stk)   # [1,K,...] -> [K,...]
-            logits = pipe_forward(stk, rst, tok)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgt).mean()
-            return lax.pmean(loss, "pp")
+            def fwd_compute():
+                h_in = lax.cond(
+                    stage == 0,
+                    lambda: _vary(embed_fn(rest, tok_mb[mf_c])
+                                  .astype(hdtype)),
+                    lambda: h_recv)
+                return h_in, apply_blocks(stacked, h_in, positions)
 
-        # stacked leaves are [S*K, ...]; shard the leading axis over pp
+            # bubble ticks skip block AND embed compute entirely
+            h_in, h_out = lax.cond(valid_f, fwd_compute,
+                                   lambda: (zeros_h(), zeros_h()))
+            slot_f = jnp.where(valid_f, m_f % C, 0)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, h_in, stash[slot_f]), slot_f, 0)
+
+            # ---- backward slot: microbatch t - (2S-2-stage) ---------------
+            m_b = t - (2 * S - 2 - stage)
+            valid_b = (m_b >= 0) & (m_b < M)
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            slot_b = jnp.where(valid_b, m_b % C, 0)
+
+            def run_bwd():
+                h_in_b = stash[slot_b]
+                h_out_b, f_vjp = jax.vjp(
+                    lambda p, h: apply_blocks(p, h, positions),
+                    stacked, h_in_b)
+
+                def g_from_loss():
+                    # last stage: head + loss gradients for this microbatch
+                    loss_m, (g_h, g_r) = jax.value_and_grad(
+                        lambda h_, r_: head_loss(r_, h_, tgt_mb[mb_c]),
+                        argnums=(0, 1))(h_out_b, rest)
+                    return (_vary(loss_m), _vary(g_h.astype(hdtype)),
+                            _vary(g_r))
+
+                def g_from_right():
+                    return zeros_scal(), g_recv, zeros_rest()
+
+                loss_m, g_out, g_rest_head = lax.cond(
+                    stage == S - 1, g_from_loss, g_from_right)
+                gb, g_h_in = f_vjp(g_out)
+
+                def g_embed():
+                    # stage 0: continue the chain through the embedding
+                    _, evjp = jax.vjp(lambda r: embed_fn(r, tok_mb[mb_c])
+                                      .astype(hdtype), rest)
+                    return _vary(evjp(g_h_in)[0])
+
+                g_rest_emb = lax.cond(stage == 0, g_embed, zeros_rest)
+                g_rest_m = jax.tree.map(lambda a, b: a + b,
+                                        g_rest_head, g_rest_emb)
+                return gb, g_rest_m, g_h_in, loss_m
+
+            def skip_bwd():
+                return (jax.tree.map(jnp.zeros_like, stacked),
+                        zeros_rest(), zeros_h(), zeros_scal())
+
+            gb, g_rest_m, g_h_in, loss_m = lax.cond(valid_b, run_bwd,
+                                                    skip_bwd)
+            g_blocks = jax.tree.map(lambda a, b: a + b, g_blocks, gb)
+            g_rest = jax.tree.map(lambda a, b: a + b, g_rest, g_rest_m)
+            loss_sum = loss_sum + loss_m
+
+            # ---- exchanges: activations right, cotangents left ------------
+            h_send = lax.ppermute(h_out, "pp", perm_r)
+            g_send = lax.ppermute(g_h_in, "pp", perm_l)
+            return (h_send, g_send, stash, g_blocks, g_rest, loss_sum), None
+
+        (_, _, _, g_blocks, g_rest, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(TT))
+
+        # scale: losses are per-microbatch means; grads accumulated over M
+        inv_m = 1.0 / M
+        g_blocks = jax.tree.map(lambda a: a * inv_m, g_blocks)
+        g_rest = jax.tree.map(lambda a: lax.psum(a * inv_m, "pp"), g_rest)
+        loss = lax.psum(loss_sum * inv_m, "pp")
+        return g_blocks, g_rest, loss
+
+    def compute_grads(stacked, rest, tokens, targets):
         stacked4 = jax.tree.map(
             lambda a: a.reshape((S, K) + a.shape[1:]), stacked)
-        return jax.shard_map(
+
+        def shard_fn(stk, rst, tok, tgt):
+            stk = jax.tree.map(lambda a: a[0], stk)   # [1, K, ...] -> [K, ...]
+            gb, gr, loss = pipe_step(stk, rst, tok, tgt)
+            return jax.tree.map(lambda a: a[None], gb), gr, loss
+
+        g4, g_rest, loss = jax.shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P("pp"), P(), P(), P()),
-            out_specs=P())(stacked4, rest, tokens, targets)
+            out_specs=(P("pp"), P(), P()))(stacked4, rest, tokens, targets)
+        g_stacked = jax.tree.map(
+            lambda a: a.reshape((S * K,) + a.shape[2:]), g4)
+        return g_stacked, g_rest, loss
 
     def stepper(stacked, rest, opt_state, tokens, targets):
         if tokens.shape[0] % M:
             raise ValueError(
                 f"batch {tokens.shape[0]} must be divisible by "
                 f"num_microbatches {M}")
-        loss, grads = jax.value_and_grad(global_loss, argnums=(0, 1))(
-            stacked, rest, tokens, targets)
+        g_stacked, g_rest, loss = compute_grads(stacked, rest, tokens,
+                                                targets)
         params = (stacked, rest)
-        updates, opt_state = base_opt.update(grads, opt_state, params)
+        updates, opt_state = base_opt.update((g_stacked, g_rest), opt_state,
+                                             params)
         stacked, rest = optax.apply_updates(params, updates)
         return stacked, rest, opt_state, loss
 
@@ -166,14 +317,15 @@ import flax.linen as nn  # noqa: E402  (module helpers below)
 
 
 def _embed(rest, tokens, cfg):
-    """Embedding lookup from the replicated non-block params (every stage
-    computes it; only stage 0's result feeds the pipeline)."""
+    """Embedding lookup from the replicated non-block params (runs only on
+    stage 0 at runtime via lax.cond)."""
     return nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype).apply(
         {"params": rest["embed"]}, tokens)
 
 
 def _head(rest, x, cfg):
-    """Final norm + LM head from the replicated non-block params."""
+    """Final norm + LM head from the replicated non-block params (runs only
+    on the last stage at runtime via lax.cond)."""
     x = nn.LayerNorm(dtype=cfg.dtype).apply({"params": rest["ln_f"]}, x)
     return nn.Dense(cfg.vocab_size, dtype=jnp.float32).apply(
         {"params": rest["lm_head"]}, x)

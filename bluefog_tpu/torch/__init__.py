@@ -5,7 +5,10 @@ The reference ships two framework frontends over one core: PyTorch
 — allreduce/broadcast/allgather + ``DistributedOptimizer`` /
 ``DistributedGradientTape`` / ``broadcast_variables``).  This package plays
 the same role for ``bluefog_tpu``: the JAX/XLA mesh is the core, and torch
-tensors ride it through zero-copy numpy bridges.
+tensors ride it through zero-copy numpy bridges.  The surface mirrors the
+reference's *torch* frontend (``bluefog/torch/mpi_ops.py``): all
+collectives including hierarchical/pair-gossip/neighbor-allgather, the
+one-sided window family, and five optimizer factories.
 
 Global-view convention as everywhere else: "rank i's tensor" is slice ``i``
 of a ``[size, ...]`` torch tensor.  Ops stage through the mesh (TPU when
@@ -23,13 +26,26 @@ from .mpi_ops import (
     broadcast, broadcast_nonblocking,
     allgather, allgather_nonblocking,
     neighbor_allreduce, neighbor_allreduce_nonblocking,
+    neighbor_allgather, neighbor_allgather_nonblocking,
+    hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_nonblocking,
+    pair_gossip, pair_gossip_nonblocking,
     poll, synchronize, wait,
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+    win_create, win_free, win_put, win_put_nonblocking,
+    win_accumulate, win_accumulate_nonblocking,
+    win_get, win_get_nonblocking,
+    win_update, win_update_then_collect, win_fetch, win_publish,
+    win_wait, win_poll, win_mutex, get_win_version,
+    win_associated_p, get_current_created_window_names,
+    turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )
 from .optimizers import (
     DistributedOptimizer,
     DistributedGradientAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPushSumOptimizer,
 )
 
 __all__ = [
@@ -37,10 +53,24 @@ __all__ = [
     "broadcast", "broadcast_nonblocking",
     "allgather", "allgather_nonblocking",
     "neighbor_allreduce", "neighbor_allreduce_nonblocking",
+    "neighbor_allgather", "neighbor_allgather_nonblocking",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_nonblocking",
+    "pair_gossip", "pair_gossip_nonblocking",
     "poll", "synchronize", "wait",
     "broadcast_parameters", "allreduce_parameters",
     "broadcast_optimizer_state",
+    "win_create", "win_free", "win_put", "win_put_nonblocking",
+    "win_accumulate", "win_accumulate_nonblocking",
+    "win_get", "win_get_nonblocking",
+    "win_update", "win_update_then_collect", "win_fetch", "win_publish",
+    "win_wait", "win_poll", "win_mutex", "get_win_version",
+    "win_associated_p", "get_current_created_window_names",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
+    "DistributedWinPutOptimizer",
+    "DistributedPushSumOptimizer",
 ]

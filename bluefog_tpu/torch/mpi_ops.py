@@ -1,11 +1,14 @@
 """Torch-tensor collective ops over the JAX mesh.
 
-Parity model: the reference's TF frontend op set
-(``bluefog/tensorflow/mpi_ops.py:95-226`` — allreduce/broadcast/allgather)
-plus ``neighbor_allreduce``, the framework's hot op.  Tensors convert
-torch→numpy→jax on the way in (zero-copy for contiguous CPU float32/64,
-int32/64) and back on the way out; bfloat16/float16 stage through float32
-exactly like the reference's fp16 MPI path converts through a custom dtype
+Parity model: the reference's *primary* torch frontend op surface
+(``bluefog/torch/mpi_ops.py`` — collectives :108-928, windows :998-1475):
+allreduce/broadcast/allgather, neighbor_allreduce (static, per-call
+weighted, dynamic, dst-weighted), neighbor_allgather (static + per-call
+``src_ranks/dst_ranks``), hierarchical_neighbor_allreduce, pair_gossip, and
+the full one-sided window family.  Tensors convert torch→numpy→jax on the
+way in (zero-copy for contiguous CPU float32/64, int32/64) and back on the
+way out; bfloat16/float16 stage through float32 exactly like the
+reference's fp16 MPI path converts through a custom dtype
 (``bluefog/common/half.cc``).
 """
 
@@ -15,15 +18,28 @@ import numpy as np
 import torch
 
 from ..ops import api as _api
+from ..ops import windows as _win
 
 __all__ = [
     "allreduce", "allreduce_nonblocking",
     "broadcast", "broadcast_nonblocking",
     "allgather", "allgather_nonblocking",
     "neighbor_allreduce", "neighbor_allreduce_nonblocking",
+    "neighbor_allgather", "neighbor_allgather_nonblocking",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_nonblocking",
+    "pair_gossip", "pair_gossip_nonblocking",
     "poll", "synchronize", "wait",
     "broadcast_parameters", "allreduce_parameters",
     "broadcast_optimizer_state",
+    "win_create", "win_free", "win_put", "win_put_nonblocking",
+    "win_accumulate", "win_accumulate_nonblocking",
+    "win_get", "win_get_nonblocking",
+    "win_update", "win_update_then_collect", "win_fetch", "win_publish",
+    "win_wait", "win_poll", "win_mutex", "get_win_version",
+    "win_associated_p", "get_current_created_window_names",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
 ]
 
 _STAGED_DTYPES = {torch.bfloat16: torch.float32, torch.float16: torch.float32}
@@ -114,6 +130,151 @@ def neighbor_allreduce(t: torch.Tensor, **kwargs) -> torch.Tensor:
     ``bluefog_tpu.neighbor_allreduce``: default topology weights,
     ``weight_matrix=W``, or ``sched=..., step=i``."""
     return synchronize(neighbor_allreduce_nonblocking(t, **kwargs))
+
+
+def neighbor_allgather_nonblocking(t: torch.Tensor,
+                                   name: Optional[str] = None, *,
+                                   src_ranks=None, dst_ranks=None) -> int:
+    return _nonblocking(_api.neighbor_allgather_nonblocking, t, name,
+                        src_ranks=src_ranks, dst_ranks=dst_ranks)
+
+
+def neighbor_allgather(t: torch.Tensor, name: Optional[str] = None, *,
+                       src_ranks=None, dst_ranks=None) -> torch.Tensor:
+    """Gather in-neighbor slices padded to max in-degree (reference
+    bluefog/torch/mpi_ops.py:397-472, incl. the per-call
+    ``src_ranks/dst_ranks`` dynamic form)."""
+    return synchronize(neighbor_allgather_nonblocking(
+        t, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+        t: torch.Tensor, name: Optional[str] = None) -> int:
+    return _nonblocking(
+        _api.hierarchical_neighbor_allreduce_nonblocking, t, name)
+
+
+def hierarchical_neighbor_allreduce(t: torch.Tensor,
+                                    name: Optional[str] = None):
+    """Machine-level two-step average (reference
+    bluefog/torch/mpi_ops.py:648-838)."""
+    return synchronize(hierarchical_neighbor_allreduce_nonblocking(t, name))
+
+
+def pair_gossip_nonblocking(t: torch.Tensor, pairs, self_weight=None,
+                            pair_weight=None,
+                            name: Optional[str] = None) -> int:
+    return _nonblocking(_api.pair_gossip_nonblocking, t, pairs, self_weight,
+                        pair_weight, name)
+
+
+def pair_gossip(t: torch.Tensor, pairs, self_weight=None, pair_weight=None,
+                name: Optional[str] = None) -> torch.Tensor:
+    """Pairwise weighted averaging over a matching (reference
+    bluefog/torch/mpi_ops.py:852-928; ``pairs`` is the global matching)."""
+    return synchronize(pair_gossip_nonblocking(t, pairs, self_weight,
+                                               pair_weight, name))
+
+
+# ---------------------------------------------------------------------------
+# One-sided window ops (reference: bluefog/torch/mpi_ops.py:998-1475)
+# ---------------------------------------------------------------------------
+
+# window name -> torch dtype for round-tripping results
+_win_dtypes: Dict[str, torch.dtype] = {}
+
+
+def win_create(t: torch.Tensor, name: str, zero_init: bool = False) -> bool:
+    arr, dtype = _to_numpy(t)
+    if _win.win_create(arr, name, zero_init=zero_init):
+        _win_dtypes[name] = dtype
+        return True
+    return False
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    if name is None:
+        _win_dtypes.clear()
+    else:
+        _win_dtypes.pop(name, None)
+    return _win.win_free(name)
+
+
+def win_put_nonblocking(t: torch.Tensor, name: str, self_weight=None,
+                        dst_weights=None, require_mutex: bool = False) -> int:
+    arr, _ = _to_numpy(t)
+    return _win.win_put_nonblocking(arr, name, self_weight, dst_weights,
+                                    require_mutex)
+
+
+def win_put(t: torch.Tensor, name: str, self_weight=None, dst_weights=None,
+            require_mutex: bool = False) -> bool:
+    _win.win_wait(win_put_nonblocking(t, name, self_weight, dst_weights,
+                                      require_mutex))
+    return True
+
+
+def win_accumulate_nonblocking(t: torch.Tensor, name: str, self_weight=None,
+                               dst_weights=None,
+                               require_mutex: bool = False) -> int:
+    arr, _ = _to_numpy(t)
+    return _win.win_accumulate_nonblocking(arr, name, self_weight,
+                                           dst_weights, require_mutex)
+
+
+def win_accumulate(t: torch.Tensor, name: str, self_weight=None,
+                   dst_weights=None, require_mutex: bool = False) -> bool:
+    _win.win_wait(win_accumulate_nonblocking(t, name, self_weight,
+                                             dst_weights, require_mutex))
+    return True
+
+
+def win_get_nonblocking(name: str, src_weights=None,
+                        require_mutex: bool = False) -> int:
+    return _win.win_get_nonblocking(name, src_weights, require_mutex)
+
+
+def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
+    return _win.win_get(name, src_weights, require_mutex)
+
+
+def _win_to_torch(name: str, a) -> torch.Tensor:
+    return _to_torch(a, _win_dtypes.get(name, torch.float32))
+
+
+def win_update(name: str, self_weight=None, neighbor_weights=None,
+               reset: bool = False, clone: bool = False,
+               require_mutex: bool = False) -> torch.Tensor:
+    return _win_to_torch(name, _win.win_update(
+        name, self_weight, neighbor_weights, reset, clone, require_mutex))
+
+
+def win_update_then_collect(name: str,
+                            require_mutex: bool = True) -> torch.Tensor:
+    return _win_to_torch(name, _win.win_update_then_collect(name,
+                                                            require_mutex))
+
+
+def win_fetch(name: str) -> torch.Tensor:
+    return _win_to_torch(name, _win.win_fetch(name))
+
+
+def win_publish(name: str, t: torch.Tensor) -> None:
+    arr, _ = _to_numpy(t)
+    _win.win_publish(name, arr)
+
+
+def win_associated_p(name: str, rank: Optional[int] = None) -> float:
+    return _win.win_associated_p(name, rank)
+
+
+win_wait = _win.win_wait
+win_poll = _win.win_poll
+win_mutex = _win.win_mutex
+get_win_version = _win.get_win_version
+get_current_created_window_names = _win.get_current_created_window_names
+turn_on_win_ops_with_associated_p = _win.turn_on_win_ops_with_associated_p
+turn_off_win_ops_with_associated_p = _win.turn_off_win_ops_with_associated_p
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ the torch optimizer's own math stays untouched.
 
 from typing import Optional
 
+import numpy as np
 import torch
 
 from . import mpi_ops as _ops
@@ -30,6 +31,8 @@ __all__ = [
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
+    "DistributedWinPutOptimizer",
+    "DistributedPushSumOptimizer",
 ]
 
 
@@ -124,6 +127,129 @@ def DistributedNeighborAllreduceOptimizer(
                    num_steps_per_communication)
     opt.sched = sched
     opt.step_index = 0
+    return opt
+
+
+class _WinPutMixin(_DistributedMixin):
+    """One-sided push flavor (reference ``_DistributedWinOptimizer`` push
+    mode, torch/optimizers.py:844-1023): win_put the parameters to the
+    out-neighbors, fold the receive buffers with win_update, then step.
+    Per-call weighting via the mutable ``dst_weights`` attribute (global
+    [N, N] matrix), mirroring the reference's per-iteration knobs."""
+
+    dst_weights = None
+
+    def _bft_register_windows(self, prefix: str):
+        self._bft_names = []
+        for i, p in enumerate(self._bft_params()):
+            name = f"{prefix}.{i}"
+            if not _ops.win_create(p.data, name):
+                raise ValueError(f"Cannot allocate window for {name}")
+            self._bft_names.append(name)
+
+    def _bft_free_windows(self):
+        for name in self._bft_names:
+            _ops.win_free(name)
+        self._bft_names = []
+
+    def _bft_communicate(self):
+        handles = [
+            _ops.win_put_nonblocking(p.data, name,
+                                     dst_weights=self.dst_weights)
+            for name, p in zip(self._bft_names, self._bft_params())]
+        for h in handles:
+            _ops.win_wait(h)
+        for name, p in zip(self._bft_names, self._bft_params()):
+            with torch.no_grad():
+                p.copy_(_ops.win_update(name, require_mutex=True))
+
+
+class _PushSumMixin(_DistributedMixin):
+    """Push-sum / gradient-push (reference ``_DistributedPushSumOptimizer``,
+    torch/optimizers.py:1026-1177): the window holds the biased iterate x
+    with the associated-P scalar riding every accumulate; the visible
+    parameter is the de-biased x/p."""
+
+    def _bft_register_windows(self, prefix: str):
+        from ..context import ctx
+        _ops.turn_on_win_ops_with_associated_p()
+        topo = ctx().compiled_topology
+        A = (topo.weight_matrix != 0).astype(np.float64)
+        np.fill_diagonal(A, 0.0)
+        self._bft_alpha = 1.0 / (A.sum(axis=1) + 1.0)      # [N]
+        self._bft_dst = A * self._bft_alpha[:, None]
+        self._bft_names = []
+        for i, p in enumerate(self._bft_params()):
+            name = f"{prefix}.{i}"
+            if not _ops.win_create(p.data, name, zero_init=True):
+                raise ValueError(f"Cannot allocate window for {name}")
+            self._bft_names.append(name)
+
+    def _bft_free_windows(self):
+        for name in self._bft_names:
+            _ops.win_free(name)
+        self._bft_names = []
+
+    def step(self, closure=None):
+        # local adapt on the *biased* iterate with gradients taken at the
+        # de-biased view, then push-accumulate + collect + de-bias
+        biased = [_ops.win_fetch(name) for name in self._bft_names]
+        with torch.no_grad():
+            for p, b in zip(self._bft_params(), biased):
+                p.copy_(b)
+        # the wrapped optimizer's own step (skip _DistributedMixin.step)
+        loss = super(_DistributedMixin, self).step(closure)
+        self._bft_tick += 1
+        if self._bft_tick % self._bft_period != 0:
+            # local-only step: publish the adapted biased iterate, expose
+            # the de-biased view
+            for name, p in zip(self._bft_names, self._bft_params()):
+                _ops.win_publish(name, p.data)
+                pvec = _win_p_tensor(name)
+                with torch.no_grad():
+                    p.div_(pvec.view((-1,) + (1,) * (p.dim() - 1)))
+            return loss
+        for name, p in zip(self._bft_names, self._bft_params()):
+            _ops.win_accumulate(p.data, name, self_weight=self._bft_alpha,
+                                dst_weights=self._bft_dst,
+                                require_mutex=True)
+            collected = _ops.win_update_then_collect(name)
+            pvec = _win_p_tensor(name)
+            with torch.no_grad():
+                p.copy_(collected /
+                        pvec.view((-1,) + (1,) * (collected.dim() - 1)))
+        return loss
+
+
+def _win_p_tensor(name: str) -> torch.Tensor:
+    """The [N] associated-P vector as a torch tensor."""
+    from ..ops import windows as _w
+    # np.array (copy): zero-copy views of jax buffers are read-only
+    return torch.from_numpy(np.array(_w.win_associated_p_vector(name)))
+
+
+def DistributedWinPutOptimizer(optimizer: torch.optim.Optimizer,
+                               window_prefix: str = "win_put_opt",
+                               num_steps_per_communication: int = 1
+                               ) -> torch.optim.Optimizer:
+    """Re-class ``optimizer`` for the one-sided push strategy (reference
+    factory torch/optimizers.py:1271).  Windows are created immediately;
+    call ``opt._bft_free_windows()`` to release them."""
+    opt = _reclass(optimizer, _WinPutMixin, "DistributedWinPutOptimizer",
+                   num_steps_per_communication)
+    opt._bft_register_windows(window_prefix)
+    return opt
+
+
+def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
+                                window_prefix: str = "push_sum_opt",
+                                num_steps_per_communication: int = 1
+                                ) -> torch.optim.Optimizer:
+    """Re-class ``optimizer`` for push-sum / gradient-push (reference
+    factory torch/optimizers.py:1180)."""
+    opt = _reclass(optimizer, _PushSumMixin, "DistributedPushSumOptimizer",
+                   num_steps_per_communication)
+    opt._bft_register_windows(window_prefix)
     return opt
 
 

@@ -1,7 +1,10 @@
-"""Pipeline-parallelism tests: GPipe microbatch streaming over the pp axis.
+"""Pipeline-parallelism tests: 1F1B microbatch scheduling over the pp axis.
 
 Closed form: pipelined forward/backward must equal the plain single-device
-Transformer exactly — the pipeline only reschedules computation.
+Transformer exactly — the pipeline only reschedules computation.  The
+schedule itself (pure functions) is asserted to have the 1F1B profile:
+bounded activation stash (min(M, 2S-1), not GPipe's M) and the canonical
+M + 2(S-1) tick count.
 """
 
 import jax
@@ -13,8 +16,8 @@ import pytest
 import bluefog_tpu as bf
 from bluefog_tpu.models.transformer import TransformerLM
 from bluefog_tpu.parallel.pipeline import (
-    make_pp_lm_train_step, pp_mesh, stack_block_params,
-    unstack_block_params)
+    bwd_microbatch, fwd_microbatch, make_pp_lm_train_step, num_ticks,
+    pp_mesh, stack_block_params, stash_bound, unstack_block_params)
 
 from conftest import N_DEVICES
 
@@ -81,6 +84,44 @@ def test_pp_training_decreases_loss():
         stacked, rest, st, loss = step(stacked, rest, st, tokens, targets)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+@pytest.mark.parametrize("M,S", [(2, 8), (8, 4), (16, 2), (4, 1), (1, 3)])
+def test_1f1b_schedule_profile(M, S):
+    """The schedule is a valid synchronous 1F1B profile: every microbatch
+    forwarded then back-propagated exactly once per stage, dependencies
+    respected, and the in-flight stash bounded by min(M, 2S-1)."""
+    TT = num_ticks(M, S)
+    assert TT == M + 2 * (S - 1)
+    for s in range(S):
+        fwd_ticks = {}
+        bwd_ticks = {}
+        live = 0
+        peak = 0
+        for t in range(TT):
+            mf = fwd_microbatch(s, t)
+            if 0 <= mf < M:
+                fwd_ticks[mf] = t
+                live += 1
+                peak = max(peak, live)
+            mb = bwd_microbatch(s, t, S)
+            if 0 <= mb < M:
+                bwd_ticks[mb] = t
+                # the stage input must have been stashed at the fwd tick
+                assert fwd_ticks[mb] <= t
+                live -= 1
+        # every microbatch exactly once each way, stash bound respected
+        assert sorted(fwd_ticks) == list(range(M))
+        assert sorted(bwd_ticks) == list(range(M))
+        assert peak <= stash_bound(M, S)
+    # cross-stage deps: stage s+1 forwards mb m one tick after stage s;
+    # stage s back-propagates mb m one tick after stage s+1
+    for s in range(S - 1):
+        for m in range(M):
+            assert (m + s + 1) - (m + s) == 1
+            t_bwd_right = m + (2 * S - 2 - (s + 1))
+            t_bwd_left = m + (2 * S - 2 - s)
+            assert t_bwd_left == t_bwd_right + 1
 
 
 def test_pp_validates_divisibility():

@@ -140,6 +140,137 @@ def test_optimizer_factory_dispatch(bf_ctx):
         bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1), "nope")
 
 
+def test_neighbor_allgather(bf_ctx):
+    t = _rankval((2,))
+    out = bft.neighbor_allgather(t)
+    topo = bf.load_topology()
+    assert isinstance(out, torch.Tensor)
+    for r in range(N_DEVICES):
+        srcs = sorted(int(s) for s, _ in topo.in_edges(r) if s != r)
+        for slot, src in enumerate(srcs):
+            assert torch.allclose(out[r, slot], torch.full((2,), float(src)))
+
+
+def test_neighbor_allgather_dynamic(bf_ctx):
+    src_ranks = [[(r + 2) % N_DEVICES] for r in range(N_DEVICES)]
+    out = bft.neighbor_allgather(_rankval((2,)), src_ranks=src_ranks)
+    for r in range(N_DEVICES):
+        assert torch.allclose(out[r, 0],
+                              torch.full((2,), float((r + 2) % N_DEVICES)))
+
+
+def test_hierarchical_neighbor_allreduce(bf_ctx_machines):
+    bf.set_machine_topology(bf.RingGraph(N_DEVICES // 2), is_weighted=True)
+    out = bft.hierarchical_neighbor_allreduce(_rankval((2,)))
+    assert isinstance(out, torch.Tensor)
+    assert out.shape == (N_DEVICES, 2)
+    # machine means before exchange: machines of 2 ranks -> pairs average
+    machine_means = [(2 * m + 0.5) for m in range(N_DEVICES // 2)]
+    # result: weighted machine-topology average, replicated within machines
+    for m in range(N_DEVICES // 2):
+        assert torch.allclose(out[2 * m], out[2 * m + 1])
+
+
+def test_pair_gossip(bf_ctx):
+    out = bft.pair_gossip(_rankval((2,)), pairs=[(0, 1), (2, 3)])
+    assert torch.allclose(out[0], torch.full((2,), 0.5))
+    assert torch.allclose(out[1], torch.full((2,), 0.5))
+    assert torch.allclose(out[2], torch.full((2,), 2.5))
+    assert torch.allclose(out[4], torch.full((2,), 4.0))  # unmatched
+
+
+def test_window_put_update_roundtrip(bf_ctx):
+    t = _rankval((3,))
+    assert bft.win_create(t, "tw", zero_init=True)
+    try:
+        assert "tw" in bft.get_current_created_window_names()
+        bft.win_put(t, "tw")
+        got = bft.win_update("tw")
+        assert isinstance(got, torch.Tensor)
+        topo = bf.load_topology()
+        for r in range(N_DEVICES):
+            self_w, recv_w = bf.GetRecvWeights(topo, r)
+            expected = self_w * r + sum(w * s for s, w in recv_w.items())
+            np.testing.assert_allclose(got[r].numpy(),
+                                       np.full(3, expected), rtol=1e-5)
+        # versions drop to 0 after the update
+        assert all(v == 0 for v in bft.get_win_version("tw", rank=0).values())
+        with bft.win_mutex("tw"):
+            pass
+    finally:
+        bft.win_free("tw")
+
+
+def test_window_accumulate_and_fetch(bf_ctx):
+    t = _rankval((2,))
+    assert bft.win_create(t, "tacc", zero_init=True)
+    try:
+        bft.win_accumulate(t, "tacc")
+        bft.win_accumulate(t, "tacc")   # buffers now hold 2x neighbor values
+        got = bft.win_update("tacc", self_weight=1.0,
+                             neighbor_weights=np.asarray(
+                                 bf.context.ctx().compiled_topology
+                                 .weight_matrix) * 0 + _offdiag_ones())
+        topo = bf.load_topology()
+        for r in range(N_DEVICES):
+            srcs = [int(s) for s, _ in topo.in_edges(r) if s != r]
+            expected = float(r) + 2.0 * sum(srcs)
+            np.testing.assert_allclose(got[r].numpy(), np.full(2, expected),
+                                       rtol=1e-5)
+    finally:
+        bft.win_free("tacc")
+
+
+def _offdiag_ones():
+    topo = bf.context.ctx().compiled_topology
+    A = (np.asarray(topo.weight_matrix) != 0).astype(np.float64)
+    np.fill_diagonal(A, 0.0)
+    return A
+
+
+def test_win_put_optimizer_consensus(bf_ctx):
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedWinPutOptimizer(torch.optim.SGD([p], lr=1.0))
+    try:
+        for _ in range(40):
+            p.grad = torch.zeros_like(p)
+            opt.step()
+        mean = (N_DEVICES - 1) / 2.0
+        assert torch.allclose(p.data, torch.full_like(p.data, mean),
+                              atol=1e-2)
+    finally:
+        opt._bft_free_windows()
+
+
+def test_push_sum_optimizer_consensus(bf_ctx):
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedPushSumOptimizer(torch.optim.SGD([p], lr=1.0))
+    try:
+        for _ in range(40):
+            p.grad = torch.zeros_like(p)
+            opt.step()
+        mean = (N_DEVICES - 1) / 2.0
+        assert torch.allclose(p.data, torch.full_like(p.data, mean),
+                              atol=1e-2)
+    finally:
+        opt._bft_free_windows()
+        bft.turn_off_win_ops_with_associated_p()
+
+
+def test_torch_dynamic_weight_matrix(bf_ctx):
+    """Per-call weight matrices on torch tensors (reference per-call
+    src_weights, torch/mpi_ops.py:475-645)."""
+    W = np.zeros((N_DEVICES, N_DEVICES))
+    for i in range(N_DEVICES):
+        W[i, i] = 0.5
+        W[(i + 1) % N_DEVICES, i] = 0.5
+    out = bft.neighbor_allreduce(_rankval((2,)), weight_matrix=W)
+    for r in range(N_DEVICES):
+        expected = 0.5 * r + 0.5 * ((r + 1) % N_DEVICES)
+        np.testing.assert_allclose(out[r].numpy(), np.full(2, expected),
+                                   rtol=1e-5)
+
+
 def test_optimizer_stays_a_torch_optimizer(bf_ctx):
     """Re-classing keeps isinstance + LR schedulers working (the reference
     re-classes for the same reason, torch/optimizers.py)."""

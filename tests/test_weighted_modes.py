@@ -1,0 +1,178 @@
+"""Per-call weighting paths of neighbor_allreduce.
+
+VERDICT r1 item 3: sparse per-call weight matrices must compile to K cached
+ppermutes (not an O(N)-bandwidth allgather mix), the dst-weighted
+(sender-side) path must be reachable from the public API, and the fused
+dynamic Pallas kernel must be reachable via the backend env var.  Reference
+semantics: per-call ``self_weight/src_weights/dst_weights``
+(``/root/reference/bluefog/torch/mpi_ops.py:475-645``), dst-weighted sends
+(``/root/reference/bluefog/common/mpi_controller.cc:1444-1446``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import api as api_mod
+
+from conftest import N_DEVICES
+
+N = N_DEVICES
+
+
+def _ring_matrix(seed=0):
+    """Sparse mixing matrix on a bidirectional ring with random weights."""
+    rng = np.random.default_rng(seed)
+    W = np.zeros((N, N))
+    for i in range(N):
+        w1, w2 = rng.uniform(0.1, 0.3, 2)
+        W[(i - 1) % N, i] = w1
+        W[(i + 1) % N, i] = w2
+        W[i, i] = 1.0 - w1 - w2
+    return W
+
+
+def _x(seed=1):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=(N, 4, 8)),
+                       jnp.float32)
+
+
+def _expected(W, x):
+    return jnp.einsum("ij,i...->j...", jnp.asarray(W, jnp.float32), x)
+
+
+def test_sparse_matrix_matches_closed_form(bf_ctx):
+    W, x = _ring_matrix(), _x()
+    out = bf.neighbor_allreduce(x, weight_matrix=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_expected(W, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_matrix_compiles_to_k_ppermutes(bf_ctx):
+    """The jaxpr of the sparse path contains exactly K ppermutes and no
+    all_gather (the dense fallback's signature)."""
+    W = _ring_matrix()
+    offsets = api_mod._matrix_structure(W)
+    assert len(offsets) == 2          # ring: +-1
+    fn = api_mod._sparse_matrix_fn(
+        bf_ctx.rank_axis, N, offsets, False, api_mod._mesh_id())
+    self_w, tables = api_mod._matrix_weight_tables(W, offsets, False)
+    jaxpr = str(jax.make_jaxpr(fn)(
+        _x(), jnp.asarray(self_w), jnp.asarray(tables)))
+    assert jaxpr.count("ppermute") == len(offsets), jaxpr
+    assert "all_gather" not in jaxpr, jaxpr
+
+
+def test_sparse_structure_reuses_compilation(bf_ctx):
+    """Same sparsity pattern, different weights -> one cached callable."""
+    W1, W2 = _ring_matrix(0), _ring_matrix(7)
+    x = _x()
+    out1 = bf.neighbor_allreduce(x, weight_matrix=W1)
+    offsets = api_mod._matrix_structure(W1)
+    fn_a = api_mod._sparse_matrix_fn(
+        bf_ctx.rank_axis, N, offsets, False, api_mod._mesh_id())
+    out2 = bf.neighbor_allreduce(x, weight_matrix=W2)
+    fn_b = api_mod._sparse_matrix_fn(
+        bf_ctx.rank_axis, N, offsets, False, api_mod._mesh_id())
+    assert fn_a is fn_b
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(_expected(W2, x)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out1),
+                               np.asarray(_expected(W1, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dst_weighted_matches_receiver_weighted(bf_ctx):
+    """Sender-side weighting is numerically the same mixing matrix."""
+    W, x = _ring_matrix(3), _x(3)
+    recv = bf.neighbor_allreduce(x, weight_matrix=W)
+    sent = bf.neighbor_allreduce(x, weight_matrix=W, dst_weighted=True)
+    np.testing.assert_allclose(np.asarray(sent), np.asarray(recv),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_matrix_still_works(bf_ctx):
+    rng = np.random.default_rng(5)
+    W = rng.uniform(0.0, 1.0, (N, N))
+    W /= W.sum(axis=0, keepdims=True)
+    x = _x(5)
+    out = bf.neighbor_allreduce(x, weight_matrix=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_expected(W, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _one_peer_sched():
+    topo = bf.topology_util.ExponentialGraph(N)
+    return bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), N)
+
+
+def test_dynamic_dst_weight_matrix(bf_ctx):
+    """Public dynamic dst-weighted path: per-call D over the schedule's
+    offset superset matches the plain mixing of D."""
+    sched = _one_peer_sched()
+    x = _x(6)
+    # build a D for "step 0" live edges with nonuniform weights
+    D = np.asarray(sched.matrices[0])
+    rng = np.random.default_rng(6)
+    scale = rng.uniform(0.5, 1.5)
+    D = D * scale
+    D[np.diag_indices(N)] = np.diag(np.asarray(sched.matrices[0]))  # self
+    out = bf.neighbor_allreduce(x, sched=sched, step=0, dst_weight_matrix=D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_expected(D, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_dst_rejects_offsets_outside_superset(bf_ctx):
+    sched = _one_peer_sched()
+    D = np.eye(N)
+    bad_off = next(o for o in range(1, N) if o not in sched.offsets)
+    D[0, bad_off] = 0.5
+    with pytest.raises(ValueError, match="absent from the schedule"):
+        bf.neighbor_allreduce(_x(), sched=sched, step=0, dst_weight_matrix=D)
+
+
+def test_fused_dynamic_backend_reachable(bf_ctx, monkeypatch):
+    """BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas_interpret routes the
+    dynamic schedule through the fused kernel and matches the XLA path."""
+    sched = _one_peer_sched()
+    x = _x(8)
+    ref = bf.neighbor_allreduce(x, sched=sched, step=2)
+    monkeypatch.setenv("BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND",
+                       "pallas_interpret")
+    out = bf.neighbor_allreduce(x, sched=sched, step=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_collective_dst_weighted_shard_map(bf_ctx):
+    """The shard_map-level dst-weighted dynamic collective."""
+    from jax.sharding import PartitionSpec as P
+    from bluefog_tpu.ops import collectives as C
+    sched = _one_peer_sched()
+    x = _x(9)
+    K = len(sched.offsets)
+    rng = np.random.default_rng(9)
+    send_w = jnp.asarray(rng.uniform(0.0, 0.5, (K, N)), jnp.float32)
+    cx = bf.context.ctx()
+
+    def f(xs, sw):
+        return C.dynamic_neighbor_allreduce_dst_weighted(
+            xs[0], cx.rank_axis, sched, jnp.int32(1), sw)[None]
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=cx.mesh, in_specs=(P(cx.rank_axis), P()),
+        out_specs=P(cx.rank_axis)))(x, send_w)
+
+    # closed form: self weights of step 1 + sender-scaled arrivals
+    t = 1 % sched.period
+    expected = np.asarray(sched.self_weights[t])[:, None, None] * np.asarray(x)
+    for k, off in enumerate(sched.offsets):
+        for i in range(N):
+            j = (i + off) % N
+            expected[j] += float(send_w[k, i]) * np.asarray(x)[i]
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5,
+                               atol=1e-5)
