@@ -58,7 +58,19 @@ def main():
     x = jnp.asarray(rng.normal(size=(n, batch, image, image, 3)), jnp.float32)
     y = jnp.asarray(rng.integers(0, 1000, size=(n, batch)))
 
+    # optional resume (outside the timed region): BENCH_CHECKPOINT_DIR
+    # routes through utils/checkpoint.py (orbax), like examples/resnet.py
+    ckpt = None
     step = 0
+    ckpt_dir = os.environ.get("BENCH_CHECKPOINT_DIR")
+    if ckpt_dir:
+        from bluefog_tpu.utils.checkpoint import Checkpointer
+        ckpt = Checkpointer(ckpt_dir, max_to_keep=1)
+        if ckpt.latest_step() is not None:
+            saved = ckpt.restore(template={"variables": variables,
+                                           "opt_state": opt_state})
+            variables, opt_state = saved["variables"], saved["opt_state"]
+            step = int(ckpt.latest_step())   # resumed runs advance the step
     loss = None
     for _ in range(warmup):
         variables, opt_state, loss = step_fn(
@@ -79,6 +91,11 @@ def main():
         _ = float(loss)  # scalar fetch as execution barrier
         dt = time.perf_counter() - t0
         rates.append(batches_per_iter * batch * n / dt)
+
+    if ckpt is not None:
+        ckpt.save(step, {"variables": variables, "opt_state": opt_state},
+                  force=True)
+        ckpt.close()
 
     total = float(np.mean(rates))
     per_chip = total / n

@@ -87,6 +87,7 @@ from .ops.windows import (
     get_current_created_window_names, get_win_version,
     win_associated_p, turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,
+    win_state_dict, load_win_state_dict,
 )
 
 from .utils.utility import (

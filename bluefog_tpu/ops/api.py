@@ -339,6 +339,11 @@ def neighbor_allreduce_nonblocking(
         name: Optional[str] = None) -> int:
     cx = ctx()
     xg = to_global(x)
+    if dst_weight_matrix is not None and sched is None:
+        raise ValueError(
+            "dst_weight_matrix requires a dynamic schedule (sched=...); "
+            "for a static per-call matrix use weight_matrix=W with "
+            "dst_weighted=True")
     if sched is not None:
         if dst_weight_matrix is not None:
             # per-call sender-side weights over the schedule's fixed offset
@@ -347,6 +352,10 @@ def neighbor_allreduce_nonblocking(
             # the caller derives D from the step's live edges (reference
             # per-call dst_weights, torch/mpi_ops.py:475-645)
             D = np.asarray(dst_weight_matrix, np.float64)
+            if D.shape != (cx.size, cx.size):
+                raise ValueError(
+                    f"dst_weight_matrix must be [{cx.size}, {cx.size}], "
+                    f"got {D.shape}")
             extra = set(_matrix_structure(D)) - set(sched.offsets)
             if extra:
                 raise ValueError(

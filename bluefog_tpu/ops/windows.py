@@ -49,6 +49,7 @@ __all__ = [
     "win_associated_p", "win_associated_p_vector",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p", "win_fetch", "win_publish",
+    "win_state_dict", "load_win_state_dict",
 ]
 
 
@@ -497,6 +498,46 @@ def win_associated_p(name: str, rank: Optional[int] = None) -> float:
     w = _window(name)
     r = ctx().rank() if rank is None else rank
     return float(np.asarray(w.p)[r])
+
+
+def win_state_dict() -> Dict[str, Dict[str, jax.Array]]:
+    """Snapshot every window's device state (tensor, neighbor buffers,
+    versions, associated-P scalar + buffers) as a checkpointable pytree.
+
+    The reference cannot checkpoint async training mid-flight (its window
+    memory lives in MPI RMA buffers, SURVEY.md §5.4); here the window state
+    is ordinary arrays, so push-sum runs resume exactly
+    (``utils/checkpoint.py`` + this pair of functions).
+    """
+    return {name: {"tensor": w.tensor, "buffers": w.buffers,
+                   "versions": w.versions, "p": w.p,
+                   "p_buffers": w.p_buffers}
+            for name, w in _windows.items()}
+
+
+def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
+    """Restore a :func:`win_state_dict` snapshot into the *existing*
+    windows (create them with ``win_create`` under the same topology
+    first — the snapshot carries data, not structure)."""
+    for name, leaves in state.items():
+        if name not in _windows:
+            if strict:
+                raise ValueError(
+                    f"window {name!r} not registered; call win_create "
+                    f"before restoring its state")
+            continue
+        w = _windows[name]
+        if tuple(leaves["buffers"].shape) != tuple(w.buffers.shape):
+            raise ValueError(
+                f"window {name!r}: snapshot buffers {leaves['buffers'].shape}"
+                f" do not match the registered window {w.buffers.shape} "
+                f"(topology changed?)")
+        sharding = _api.rank_sharding()
+        w.tensor = jax.device_put(jnp.asarray(leaves["tensor"]), sharding)
+        w.buffers = jax.device_put(jnp.asarray(leaves["buffers"]), sharding)
+        w.versions = jnp.asarray(leaves["versions"])
+        w.p = jnp.asarray(leaves["p"])
+        w.p_buffers = jnp.asarray(leaves["p_buffers"])
 
 
 def turn_on_win_ops_with_associated_p():

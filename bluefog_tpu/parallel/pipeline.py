@@ -31,8 +31,6 @@ serialize all forwards before any backward and stash all ``M`` microbatch
 inputs.
 """
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

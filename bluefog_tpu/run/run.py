@@ -108,10 +108,13 @@ def _launch_multi_host(args, hosts) -> int:
         raise SystemExit(
             f"bfrun: -np {args.num_proc} != sum of host slots {total}")
     # The coordinator address is dialed by every host: a loopback name for
-    # hosts[0] would point remote workers at themselves, so substitute this
-    # machine's routable hostname.
+    # hosts[0] would point *remote* workers at themselves, so substitute
+    # this machine's routable hostname — but only when remote hosts exist
+    # (an all-local job, e.g. 2 processes oversubscribing localhost, keeps
+    # the loopback address; an unresolvable container fqdn must not break it)
     coord_host = hosts[0][0]
-    if network_util.is_local_host(coord_host) and len(hosts) > 1:
+    any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
+    if network_util.is_local_host(coord_host) and any_remote:
         import socket
         coord_host = socket.getfqdn()
     coordinator = f"{coord_host}:{args.coordinator_port}"

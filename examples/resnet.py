@@ -16,7 +16,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import argparse
 import os
-import pickle
 import time
 
 import jax
@@ -104,15 +103,24 @@ def main():
                                 atc=args.atc_style, sched=sched)
 
     start_step = 0
-    ckpt_path = (os.path.join(args.checkpoint_dir, "checkpoint.pkl")
-                 if args.checkpoint_dir else None)
-    if args.resume and ckpt_path and os.path.exists(ckpt_path):
-        with open(ckpt_path, "rb") as f:
-            saved = pickle.load(f)
-        variables = jax.tree.map(jnp.asarray, saved["variables"])
-        opt_state = jax.tree.map(jnp.asarray, saved["opt_state"])
-        start_step = saved["step"]
-        print(f"resumed from {ckpt_path} at step {start_step}")
+    ckpt = None
+    if args.checkpoint_dir:
+        from bluefog_tpu.utils.checkpoint import Checkpointer
+        ckpt = Checkpointer(args.checkpoint_dir, max_to_keep=3)
+    if args.resume and ckpt is not None and ckpt.latest_step() is not None:
+        saved = ckpt.restore(
+            template={"variables": variables, "opt_state": opt_state,
+                      "windows": bf.win_state_dict()})
+        # global view: every leaf is [size, ...] sharded over the rank axis
+        shard = bf.ops.api.rank_sharding()
+        place = lambda t: jax.tree.map(
+            lambda a: jax.device_put(a, shard)
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] == n else a, t)
+        variables = place(saved["variables"])
+        opt_state = place(saved["opt_state"])
+        bf.load_win_state_dict(saved["windows"])
+        start_step = ckpt.latest_step()
+        print(f"resumed from {args.checkpoint_dir} at step {start_step}")
 
     if args.train_dir:
         x_all = np.load(os.path.join(args.train_dir, "x.npy"))
@@ -149,13 +157,16 @@ def main():
         spread = float(jnp.max(jnp.abs(w0 - jnp.mean(w0, axis=0, keepdims=True))))
         print(f"epoch {epoch}: loss {mean_loss:.4f}  {rate:.0f} img/s  "
               f"param spread {spread:.2e}")
-        if ckpt_path:
-            os.makedirs(args.checkpoint_dir, exist_ok=True)
-            with open(ckpt_path, "wb") as f:
-                pickle.dump({"variables": jax.device_get(variables),
-                             "opt_state": jax.device_get(opt_state),
-                             "step": step}, f)
+        if ckpt is not None:
+            # orbax (utils/checkpoint.py): async, multi-host-safe, shardings
+            # preserved; any push-sum window state rides along
+            # force=True: a fresh (non --resume) run into an existing dir
+            # overwrites stale steps, matching the old pickle behavior
+            ckpt.save(step, {"variables": variables, "opt_state": opt_state,
+                             "windows": bf.win_state_dict()}, force=True)
 
+    if ckpt is not None:
+        ckpt.close()
     print("done; final loss:", mean_loss)
 
 

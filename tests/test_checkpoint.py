@@ -44,6 +44,67 @@ def test_restore_missing_raises(tmp_path):
             ckpt.restore()
 
 
+def test_push_sum_window_state_resumes_identically(bf_ctx, tmp_path):
+    """VERDICT r1 item 10: the async (window/associated-P) state must be
+    checkpointable — save mid-run, restore into fresh windows, and the
+    continued push-sum iterates must match exactly."""
+    from bluefog_tpu.optim.wrappers import DistributedPushSumOptimizer
+
+    base = optax.sgd(0.05)
+    opt = DistributedPushSumOptimizer(base)
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(N_DEVICES, 6)), jnp.float32)}
+    opt_state = opt.init(params)
+    grads = {"w": jnp.asarray(rng.normal(size=(N_DEVICES, 6)) * 0.1,
+                              jnp.float32)}
+    try:
+        for i in range(3):
+            params, opt_state = opt.step(params, grads, opt_state, step=i)
+        save_checkpoint(str(tmp_path / "ck"), 3,
+                        {"params": params, "opt_state": opt_state,
+                         "windows": bf.win_state_dict()})
+
+        cont_params = params
+        cont_state = opt_state
+        for i in range(3, 6):
+            cont_params, cont_state = opt.step(cont_params, grads,
+                                               cont_state, step=i)
+
+        restored = restore_checkpoint(str(tmp_path / "ck"))
+        bf.load_win_state_dict(restored["windows"])
+        r_params, r_state = restored["params"], restored["opt_state"]
+        for i in range(3, 6):
+            r_params, r_state = opt.step(r_params, grads, r_state, step=i)
+
+        np.testing.assert_allclose(np.asarray(r_params["w"]),
+                                   np.asarray(cont_params["w"]),
+                                   rtol=1e-6, atol=1e-7)
+    finally:
+        opt.free()
+        bf.turn_off_win_ops_with_associated_p()
+
+
+def test_resnet_example_orbax_resume(tmp_path):
+    """The flagship example checkpoints through utils/checkpoint.py (no
+    pickle): run 1 epoch with --checkpoint-dir, then resume."""
+    import subprocess, sys, os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    ck = str(tmp_path / "ck")
+    cmd = [sys.executable, "examples/resnet.py", "--model", "ResNet18",
+           "--batch-size", "2", "--epochs", "1", "--steps-per-epoch", "2",
+           "--image-size", "32", "--num-classes", "10",
+           "--dtype", "float32", "--checkpoint-dir", ck]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                         env=env, cwd=env["PYTHONPATH"])
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    out2 = subprocess.run(cmd + ["--resume"], capture_output=True, text=True,
+                          timeout=420, env=env, cwd=env["PYTHONPATH"])
+    assert out2.returncode == 0, (out2.stdout, out2.stderr)
+    assert "resumed from" in out2.stdout
+
+
 def test_training_resumes_identically(bf_ctx, tmp_path):
     """save at step k, keep training; restart from the checkpoint and the
     continued losses must match exactly."""

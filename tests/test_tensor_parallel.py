@@ -105,3 +105,90 @@ def test_tp_moe_model_steps():
     p, st = place(params, opt.init(params))
     p, st, loss = step(p, st, tokens, targets)
     assert np.isfinite(float(loss))
+
+
+def test_decentralized_dp_tp_composition_matches_per_replica():
+    """VERDICT r1 item 7: one (dp, tp) mesh where dp runs decentralized
+    neighbor averaging while tp shards the model.  The composed step must
+    equal the hand-computed per-replica reference: independent grads +
+    local updates per dp replica, then the topology's weighted mixing —
+    with tp present only as a layout, never as math."""
+    from bluefog_tpu.parallel.schedule import compile_topology
+    from bluefog_tpu.parallel.tensor import (
+        make_decentralized_tp_lm_train_step)
+    from bluefog_tpu.parallel import topology as topo_mod
+
+    model, tokens, targets, params = _model_and_data()
+    dp, tp = 4, N_DEVICES // 4
+    topo = compile_topology(topo_mod.RingGraph(dp))
+    opt = optax.sgd(0.05)
+
+    # per-replica batches: replica r sees its own slice
+    toks = jnp.stack([jnp.roll(tokens, r, axis=0) for r in range(dp)])
+    tgts = jnp.stack([jnp.roll(targets, r, axis=0) for r in range(dp)])
+
+    # ---- reference: python loop over replicas, then W-mix ----
+    def one_loss(p, tok, tgt):
+        logits = model.apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    ref_replicas = []
+    losses = []
+    for r in range(dp):
+        loss, g = jax.value_and_grad(one_loss)(params, toks[r], tgts[r])
+        upd, _ = opt.update(g, opt.init(params), params)
+        ref_replicas.append(optax.apply_updates(params, upd))
+        losses.append(float(loss))
+    W = np.asarray(topo.weight_matrix, np.float64)
+    ref_mixed = [
+        jax.tree.map(
+            lambda *leaves: sum(float(W[i, j]) * leaves[i]
+                                for i in range(dp)), *ref_replicas)
+        for j in range(dp)]
+
+    # ---- composed step ----
+    mesh = tp_mesh(dp=dp, tp=tp)
+    step, place = make_decentralized_tp_lm_train_step(
+        model, opt, mesh, topo=topo, donate=False)
+    gparams, gopt = place(params)
+    gparams, gopt, loss = step(gparams, gopt, toks, tgts)
+
+    np.testing.assert_allclose(float(loss), np.mean(losses), rtol=1e-5)
+    for j in range(dp):
+        got = jax.tree.map(lambda a: a[j], gparams)
+        for a, b in zip(jax.tree.leaves(got),
+                        jax.tree.leaves(ref_mixed[j])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+
+def test_decentralized_dp_tp_dynamic_schedule():
+    """The composed step accepts a dynamic schedule on the dp axis; the
+    traced step index selects the edge set without recompiling."""
+    import bluefog_tpu as bf
+    from bluefog_tpu.parallel.schedule import compile_dynamic_schedule
+    from bluefog_tpu.parallel.tensor import (
+        make_decentralized_tp_lm_train_step)
+    from bluefog_tpu.parallel import topology as topo_mod
+    from bluefog_tpu.parallel.dynamic import GetDynamicOnePeerSendRecvRanks
+
+    model, tokens, targets, params = _model_and_data()
+    dp, tp = 4, N_DEVICES // 4
+    G = topo_mod.ExponentialGraph(dp)
+    sched = compile_dynamic_schedule(
+        lambda r: GetDynamicOnePeerSendRecvRanks(G, r), dp)
+    opt = optax.sgd(0.05)
+    toks = jnp.broadcast_to(tokens[None], (dp,) + tokens.shape)
+    tgts = jnp.broadcast_to(targets[None], (dp,) + targets.shape)
+
+    mesh = tp_mesh(dp=dp, tp=tp)
+    step, place = make_decentralized_tp_lm_train_step(
+        model, opt, mesh, sched=sched, donate=False)
+    gparams, gopt = place(params)
+    first = None
+    for i in range(3):
+        gparams, gopt, loss = step(gparams, gopt, toks, tgts, i)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first  # trains
