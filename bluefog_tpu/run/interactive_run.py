@@ -1,41 +1,354 @@
 """``ibfrun`` — interactive bluefog_tpu session (reference:
-``run/interactive_run.py``).
+``run/interactive_run.py:229-329``).
 
-The reference spins up an ipyparallel cluster (one engine per MPI rank) so a
-notebook can drive distributed code interactively.  Under single-controller
-SPMD one interpreter already drives every device, so ``ibfrun`` reduces to:
-configure the device view (virtual CPU devices if requested), call
-``bf.init()``, and drop into a REPL (IPython when available) with ``bf``,
-``jax`` and ``jnp`` bound.  ``ibfrun start/stop`` subcommands are accepted
-for reference CLI compatibility and map to entering/exiting the session.
+The reference spins up an **ipyparallel** cluster (one engine per MPI rank)
+so a notebook can drive distributed code interactively, with hung-engine
+SIGINT interrupts.  The TPU-native equivalent has two modes:
+
+* **Local** (no ``-H``): single-controller SPMD — one interpreter already
+  drives every device, so the session is a REPL with ``bf``/``jax``/``jnp``
+  bound (IPython when available).
+* **Multi-host** (``-H host1:N,host2:N``): a driver process binds a control
+  socket and launches one *engine* per host with the same
+  ``jax.distributed`` coordinator wiring as ``bfrun`` (run/run.py).  Every
+  line typed at the driver is broadcast to ALL engines (multi-controller
+  SPMD requires every process to execute the same program), each engine
+  executes it in a persistent namespace and streams back its stdout, and
+  the driver prints the outputs per engine.  ``Ctrl-C`` while waiting
+  interrupts hung engines with SIGINT — the reference's hung-engine
+  interrupt (interactive_run.py:229-265).  ``ibfrun stop`` tears down a
+  cluster recorded in the pidfile.
 """
 
 import argparse
+import contextlib
+import io
+import json
 import os
+import shlex
+import signal
+import socket
+import subprocess
 import sys
+import traceback
+from typing import List, Optional
+
+_PIDFILE = os.environ.get("BLUEFOG_IBFRUN_PIDFILE",
+                          "/tmp/bluefog_ibfrun.pids")
 
 
 def parse_args(argv):
     parser = argparse.ArgumentParser(
         prog="ibfrun", description="Interactive BlueFog-TPU session")
     parser.add_argument("subcommand", nargs="?", default="start",
-                        choices=["start", "stop"],
-                        help="reference-compatible; 'stop' is a no-op (the "
-                             "session dies with the REPL)")
+                        choices=["start", "stop", "engine"],
+                        help="'start' opens a session; 'stop' tears down a "
+                             "running multi-host cluster; 'engine' is "
+                             "internal (worker loop)")
     parser.add_argument("-np", "--num-proc", type=int, default=None)
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="comma-separated host:slots list — launches a "
+                             "multi-host engine cluster like bfrun")
+    parser.add_argument("-p", "--ssh-port", type=int, default=None)
     parser.add_argument("--platform", default=None, choices=["tpu", "cpu"])
+    parser.add_argument("--coordinator-port", type=int, default=3390)
+    parser.add_argument("--control-port", type=int, default=0,
+                        help="driver control socket port (0 = ephemeral)")
+    parser.add_argument("--control", default=None,
+                        help="internal: engine's driver address host:port")
+    parser.add_argument("--engine-id", type=int, default=None)
     parser.add_argument("--extra-script", default=None,
                         help="python file executed in the session namespace "
                              "before the prompt")
+    parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--nodes-per-machine", type=int, default=None)
     return parser.parse_args(argv)
 
 
-def main(argv=None) -> int:
-    args = parse_args(sys.argv[1:] if argv is None else argv)
-    if args.subcommand == "stop":
-        print("ibfrun: nothing to stop (sessions end with their REPL)")
-        return 0
+# ---------------------------------------------------------------------------
+# wire protocol: newline-delimited JSON over TCP
+# ---------------------------------------------------------------------------
 
+def _send(sock: socket.socket, obj) -> None:
+    sock.sendall((json.dumps(obj) + "\n").encode())
+
+
+class _LineReader:
+    def __init__(self, sock):
+        self._f = sock.makefile("r")
+
+    def recv(self) -> Optional[dict]:
+        line = self._f.readline()
+        return json.loads(line) if line else None
+
+
+# ---------------------------------------------------------------------------
+# engine (worker) side
+# ---------------------------------------------------------------------------
+
+def engine_main(control: str, engine_id: int) -> int:
+    """Persistent exec loop: receive code, run it, stream stdout back.
+
+    ``bf.init()`` runs on startup — the launcher set the jax.distributed
+    coordinator env (BLUEFOG_COORDINATOR etc.), so every engine joins one
+    global device mesh exactly like a bfrun worker."""
+    host, port = control.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)))
+    reader = _LineReader(sock)
+
+    import jax
+    import jax.numpy as jnp
+    import bluefog_tpu as bf
+    bf.init()
+    ns = {"bf": bf, "jax": jax, "jnp": jnp}
+    _send(sock, {"type": "ready", "engine": engine_id,
+                 "size": bf.size(),
+                 "process_index": jax.process_index()})
+
+    while True:
+        try:
+            msg = reader.recv()
+        except KeyboardInterrupt:
+            continue      # hung-engine SIGINT aimed at a peer: stay alive
+        if msg is None or msg.get("type") == "shutdown":
+            break
+        if msg.get("type") != "exec":
+            continue
+        buf = io.StringIO()
+        error = None
+        try:
+            with contextlib.redirect_stdout(buf):
+                try:
+                    # 'single' echoes bare expressions like a REPL...
+                    code_obj = compile(msg["code"], "<ibfrun>", "single")
+                except SyntaxError:
+                    # ...'exec' handles multi-statement blocks/scripts
+                    code_obj = compile(msg["code"], "<ibfrun>", "exec")
+                exec(code_obj, ns)
+        except BaseException:
+            error = traceback.format_exc()
+        try:
+            _send(sock, {"type": "result", "engine": engine_id,
+                         "stdout": buf.getvalue(), "error": error})
+        except KeyboardInterrupt:
+            continue
+    bf.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver side
+# ---------------------------------------------------------------------------
+
+def _launch_engines(args, hosts, control_addr: str):
+    """Spawn one engine per host with bfrun's coordinator wiring.
+
+    Returns ``[(popen, host, is_local)]`` — for remote hosts the Popen is
+    the *ssh client*, so signals must travel over a fresh ssh command (the
+    control address doubles as a unique pkill pattern)."""
+    from . import env_util, network_util
+    from .run import _FORWARD_PREFIXES, _apply_common_flags
+
+    coord_host = hosts[0][0]
+    any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
+    if network_util.is_local_host(coord_host) and any_remote:
+        coord_host = socket.getfqdn()
+    coordinator = f"{coord_host}:{args.coordinator_port}"
+    base_env = env_util.exportable_env()
+
+    procs = []
+    cwd = os.getcwd()
+    for pid, (host, slots) in enumerate(hosts):
+        env = _apply_common_flags(args, dict(base_env), slots)
+        env.update({
+            "BLUEFOG_COORDINATOR": coordinator,
+            "BLUEFOG_NUM_PROCESSES": str(len(hosts)),
+            "BLUEFOG_PROCESS_ID": str(pid),
+        })
+        cmd = [sys.executable, "-m", "bluefog_tpu.run.interactive_run",
+               "engine", "--control", control_addr, "--engine-id", str(pid)]
+        local = network_util.is_local_host(host)
+        if local:
+            procs.append((subprocess.Popen(cmd, env={**os.environ, **env}),
+                          host, True))
+        else:
+            assigns = env_util.env_assignments(env, _FORWARD_PREFIXES)
+            remote = (f"cd {shlex.quote(cwd)} && " + " ".join(assigns) + " "
+                      + " ".join(shlex.quote(c) for c in cmd))
+            ssh = ["ssh", "-o", "BatchMode=yes"]
+            if args.ssh_port:
+                ssh += ["-p", str(args.ssh_port)]
+            procs.append((subprocess.Popen(ssh + [host, remote]),
+                          host, False))
+    return procs
+
+
+def _remote_signal(host: str, control_addr: str, sig: str,
+                   ssh_port=None) -> None:
+    """Signal a remote engine by matching its unique control address (the
+    local Popen is only the ssh client; signals do not ride the tunnel)."""
+    cmd = ["ssh", "-o", "BatchMode=yes"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [host, f"pkill -{sig} -f {shlex.quote(control_addr)}"]
+    subprocess.run(cmd, capture_output=True, timeout=20)
+
+
+def _interrupt_engines(procs, control_addr: str, ssh_port=None) -> None:
+    """SIGINT to hung engines (reference interactive_run.py:229-265)."""
+    for p, host, local in procs:
+        if p.poll() is not None:
+            continue
+        if local:
+            try:
+                p.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        else:
+            _remote_signal(host, control_addr, "INT", ssh_port)
+
+
+def driver_main(args, hosts) -> int:
+    server = socket.socket()
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", args.control_port))
+    server.listen(len(hosts))
+    control_addr = f"{socket.gethostname()}:{server.getsockname()[1]}" \
+        if any(h for h, _ in hosts
+               if h not in ("localhost", "127.0.0.1")) \
+        else f"127.0.0.1:{server.getsockname()[1]}"
+
+    procs = _launch_engines(args, hosts, control_addr)
+    with open(_PIDFILE, "w") as f:
+        # "host pid pattern" per line: ibfrun stop must reach remote
+        # engines over ssh (the local pid is just the ssh client there)
+        for p, host, local in procs:
+            f.write(f"{host} {p.pid} {control_addr}\n")
+
+    conns = []
+    try:
+        server.settimeout(5.0)
+        deadline = 36  # 5s polls: generous for remote jax.distributed boot
+        while len(conns) < len(hosts):
+            try:
+                conn, _ = server.accept()
+                conns.append((conn, _LineReader(conn)))
+            except socket.timeout:
+                dead = [(host, p.poll()) for p, host, _ in procs
+                        if p.poll() is not None]
+                if dead:
+                    raise SystemExit(
+                        f"ibfrun: engine(s) died during startup: {dead} — "
+                        f"check the coordinator port and worker logs")
+                deadline -= 1
+                if deadline <= 0:
+                    raise SystemExit(
+                        "ibfrun: timed out waiting for engines to connect")
+        infos = [r.recv() for _, r in conns]
+        if any(m is None for m in infos):
+            raise SystemExit("ibfrun: an engine disconnected before "
+                             "reporting ready (startup failure)")
+        infos.sort(key=lambda m: m["engine"])
+        n_eng = len(infos)
+        print(f"ibfrun cluster up: {n_eng} engines, "
+              f"{infos[0]['size']} global devices; every input line runs on "
+              f"ALL engines (SPMD); Ctrl-C interrupts hung engines; "
+              f"Ctrl-D exits", flush=True)
+
+        if args.extra_script:
+            with open(args.extra_script) as f:
+                _broadcast_and_print(conns, f.read())
+
+        while True:
+            try:
+                line = input("ibf> " if sys.stdin.isatty() else "")
+            except EOFError:
+                break
+            except KeyboardInterrupt:
+                print("\n(^C at prompt discards the line; ^D exits)",
+                      flush=True)
+                continue
+            if not line.strip():
+                continue
+            try:
+                _broadcast_and_print(conns, line)
+            except KeyboardInterrupt:
+                print("^C — interrupting engines", flush=True)
+                _interrupt_engines(procs, control_addr, args.ssh_port)
+                # engines surface the KeyboardInterrupt as an exec error
+                _drain(conns)
+    finally:
+        for conn, _ in conns:
+            try:
+                _send(conn, {"type": "shutdown"})
+            except OSError:
+                pass
+        for p, _, _ in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+        server.close()
+        if os.path.exists(_PIDFILE):
+            os.unlink(_PIDFILE)
+    return 0
+
+
+def _broadcast_and_print(conns, code: str) -> None:
+    for conn, _ in conns:
+        try:
+            _send(conn, {"type": "exec", "code": code})
+        except OSError:
+            pass      # dead engine: its recv below reports None, not a crash
+    _drain(conns)
+
+
+def _drain(conns) -> None:
+    for _, reader in conns:
+        try:
+            msg = reader.recv()
+        except OSError:
+            msg = None
+        if msg is None:
+            continue
+        tag = f"[engine {msg.get('engine')}] "
+        out = msg.get("stdout") or ""
+        for ln in out.splitlines():
+            print(tag + ln, flush=True)
+        if msg.get("error"):
+            for ln in msg["error"].splitlines():
+                print(tag + ln, flush=True)
+
+
+def stop_main() -> int:
+    if not os.path.exists(_PIDFILE):
+        print("ibfrun: no running cluster (no pidfile)")
+        return 0
+    from . import network_util
+    n = 0
+    with open(_PIDFILE) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            host, pid, pattern = line.split(None, 2)
+            n += 1
+            if network_util.is_local_host(host):
+                try:
+                    os.kill(int(pid), signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            else:
+                _remote_signal(host, pattern.strip(), "TERM")
+    os.unlink(_PIDFILE)
+    print(f"ibfrun: stopped {n} engine(s)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# local single-controller session
+# ---------------------------------------------------------------------------
+
+def local_main(args) -> int:
     if args.platform == "cpu" and args.num_proc:
         from .env_util import force_virtual_cpu_devices
         force_virtual_cpu_devices(os.environ, args.num_proc)
@@ -63,6 +376,22 @@ def main(argv=None) -> int:
         import code
         code.interact(banner=banner, local=ns)
         return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    if args.subcommand == "stop":
+        return stop_main()
+    if args.subcommand == "engine":
+        if not args.control or args.engine_id is None:
+            raise SystemExit("ibfrun engine: --control and --engine-id "
+                             "are internal required flags")
+        return engine_main(args.control, args.engine_id)
+    if args.hosts:
+        from . import network_util
+        hosts = network_util.parse_host_spec(args.hosts)
+        return driver_main(args, hosts)
+    return local_main(args)
 
 
 if __name__ == "__main__":

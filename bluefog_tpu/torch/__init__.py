@@ -41,6 +41,7 @@ from .mpi_ops import (
     turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )
 from .optimizers import (
+    register_timeline_hooks,
     DistributedOptimizer,
     DistributedGradientAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
@@ -68,6 +69,7 @@ __all__ = [
     "win_associated_p", "get_current_created_window_names",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
+    "register_timeline_hooks",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",

@@ -33,7 +33,46 @@ __all__ = [
     "DistributedNeighborAllreduceOptimizer",
     "DistributedWinPutOptimizer",
     "DistributedPushSumOptimizer",
+    "register_timeline_hooks",
 ]
+
+
+def register_timeline_hooks(module: "torch.nn.Module"):
+    """Per-layer timeline spans, the reference's auto-hook feature
+    (torch/optimizers.py:112-163): every leaf submodule records a FORWARD
+    span around its forward and a ``GRADIENT COMPT.`` span around its
+    backward into the active timeline (``BLUEFOG_TIMELINE`` /
+    ``bf.timeline_start``).  Returns the hook handles (call ``.remove()``
+    to detach).  No-ops (cheap flag checks) while the timeline is off.
+
+    The JAX path needs no equivalent: flax module names land in XLA HLO
+    metadata, so the profiler attributes device time per layer natively.
+    """
+    from .. import timeline as _tl
+
+    handles = []
+    for name, mod in module.named_modules():
+        if next(mod.children(), None) is not None:
+            continue                       # leaves only, like the reference
+        label = name or type(mod).__name__
+
+        def fwd_pre(mod_, inp, _label=label):
+            _tl.timeline_start_activity(_label, "FORWARD")
+
+        def fwd_post(mod_, inp, out, _label=label):
+            _tl.timeline_end_activity(_label)
+
+        def bwd_pre(mod_, gout, _label=label):
+            _tl.timeline_start_activity(_label, "GRADIENT COMPT.")
+
+        def bwd_post(mod_, gin, gout, _label=label):
+            _tl.timeline_end_activity(_label)
+
+        handles.append(mod.register_forward_pre_hook(fwd_pre))
+        handles.append(mod.register_forward_hook(fwd_post))
+        handles.append(mod.register_full_backward_pre_hook(bwd_pre))
+        handles.append(mod.register_full_backward_hook(bwd_post))
+    return handles
 
 
 class _DistributedMixin:
@@ -256,13 +295,22 @@ def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          communication: str = "neighbor_allreduce",
                          num_steps_per_communication: int = 1,
-                         sched=None) -> torch.optim.Optimizer:
+                         sched=None,
+                         model: Optional["torch.nn.Module"] = None
+                         ) -> torch.optim.Optimizer:
     """Factory matching the reference TF frontend's single entry point
-    (tensorflow/optimizers.py:135): pick the strategy by name."""
+    (tensorflow/optimizers.py:135): pick the strategy by name.  Passing
+    ``model=`` auto-registers the per-layer timeline hooks, like the
+    reference optimizers do (torch/optimizers.py:112-163)."""
+    handles = register_timeline_hooks(model) if model is not None else []
     if communication == "neighbor_allreduce":
-        return DistributedNeighborAllreduceOptimizer(
+        opt = DistributedNeighborAllreduceOptimizer(
             optimizer, num_steps_per_communication, sched)
-    if communication in ("allreduce", "gradient_allreduce"):
-        return DistributedGradientAllreduceOptimizer(
+    elif communication in ("allreduce", "gradient_allreduce"):
+        opt = DistributedGradientAllreduceOptimizer(
             optimizer, num_steps_per_communication)
-    raise ValueError(f"unknown communication {communication!r}")
+    else:
+        raise ValueError(f"unknown communication {communication!r}")
+    # keep the hook handles removable (opt._bft_timeline_handles[i].remove())
+    opt._bft_timeline_handles = handles
+    return opt

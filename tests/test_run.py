@@ -183,3 +183,40 @@ def test_bfrun_two_process_jax_distributed(tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "MULTIHOST_OK 0" in out.stdout
     assert "MULTIHOST_OK 1" in out.stdout
+
+
+def test_ibfrun_multihost_cluster(tmp_path):
+    """ibfrun's multi-host interactive cluster (reference
+    interactive_run.py:229-329): two engines join one jax.distributed job;
+    every stdin line executes on ALL engines and their stdout streams back
+    tagged per engine."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["BLUEFOG_IBFRUN_PIDFILE"] = str(tmp_path / "pids")
+    script = (
+        "print('size', bf.size(), 'pid', jax.process_index())\n"
+        "import numpy as np\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from bluefog_tpu.ops import collectives as C\n"
+        "sh = NamedSharding(bf.context.ctx().mesh, P('rank'))\n"
+        "local = np.full((2, 2), 1.0 + jax.process_index(), np.float32)\n"
+        "g = jax.make_array_from_process_local_data(sh, local)\n"
+        "out = jax.jit(jax.shard_map(lambda x: C.allreduce(x[0], 'rank')[None], mesh=bf.context.ctx().mesh, in_specs=P('rank'), out_specs=P('rank')))(g)\n"
+        "print('mean', float(np.asarray(out.addressable_shards[0].data)[0, 0]))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.interactive_run", "start",
+         "-H", "localhost:2,localhost:2", "--platform", "cpu",
+         "--coordinator-port", str(coord_port)],
+        input=script, capture_output=True, text=True, timeout=300,
+        env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "[engine 0] size 4 pid 0" in out.stdout, out.stdout
+    assert "[engine 1] size 4 pid 1" in out.stdout, out.stdout
+    assert "[engine 0] mean 1.5" in out.stdout, out.stdout
+    assert "[engine 1] mean 1.5" in out.stdout, out.stdout

@@ -96,3 +96,27 @@ def test_native_library_builds():
     lib = native.load()
     assert lib is not None, "native timeline library failed to build/load"
     assert lib.bft_timeline_active() in (0, 1)
+
+
+def test_per_layer_timeline_hooks(tmp_path):
+    """Reference parity (torch/optimizers.py:112-163): per-layer FORWARD and
+    GRADIENT COMPT. spans recorded by auto-registered module hooks."""
+    import torch
+    import bluefog_tpu.torch as bft
+
+    bf.init()
+    prefix = str(tmp_path / "layers_")
+    bf.timeline_start(prefix, rank=0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+    handles = bft.register_timeline_hooks(model)
+    out = model(torch.randn(3, 4))
+    out.sum().backward()
+    for h in handles:
+        h.remove()
+    bf.shutdown()
+
+    events = _load_events(prefix + "0.json")
+    names = [e for e in events if e.get("name") == "FORWARD"]
+    assert len(names) >= 3, events[:10]          # one per leaf layer
+    assert any(e.get("name") == "GRADIENT COMPT." for e in events)
