@@ -77,17 +77,28 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, moe_fn: Optional[Callable] = None):
+    def __call__(self, x, moe_fn: Optional[Callable] = None,
+                 expert_params=None):
         from ..ops.moe import local_moe_ffn
         B, T, D = x.shape
         H, E = D * self.mlp_ratio, self.num_experts
         logits = nn.Dense(E, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32)).reshape(B * T, E)
-        w_up = self.param("w_up", nn.initializers.lecun_normal(), (E, D, H))
-        b_up = self.param("b_up", nn.initializers.zeros_init(), (E, H))
-        w_down = self.param("w_down", nn.initializers.lecun_normal(),
-                            (E, H, D))
-        b_down = self.param("b_down", nn.initializers.zeros_init(), (E, D))
+        if expert_params is not None:
+            # expert tables injected from outside flax (the SP+EP train
+            # step shards them over the mesh — each rank passes only its
+            # E/n experts, which flax's apply-time shape check would
+            # otherwise reject; training.py:make_lm_train_step)
+            w_up, b_up = expert_params["w_up"], expert_params["b_up"]
+            w_down, b_down = expert_params["w_down"], expert_params["b_down"]
+        else:
+            w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                              (E, D, H))
+            b_up = self.param("b_up", nn.initializers.zeros_init(), (E, H))
+            w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                                (E, H, D))
+            b_down = self.param("b_down", nn.initializers.zeros_init(),
+                                (E, D))
         dt = self.dtype
 
         def expert_fn(params, h):
@@ -117,7 +128,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_fn: Callable, positions,
-                 moe_fn: Optional[Callable] = None):
+                 moe_fn: Optional[Callable] = None, expert_params=None):
         D = x.shape[-1]
         head_dim = D // self.num_heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
@@ -133,7 +144,8 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.num_experts:
             h = MoEMLP(self.num_experts, self.dtype, self.mlp_ratio,
-                       self.capacity_factor, name="moe")(h, moe_fn)
+                       self.capacity_factor, name="moe")(h, moe_fn,
+                                                         expert_params)
         else:
             h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype,
                          name="mlp_up")(h)
@@ -155,7 +167,11 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, attn_fn: Optional[Callable] = None,
-                 position_offset=0, moe_fn: Optional[Callable] = None):
+                 position_offset=0, moe_fn: Optional[Callable] = None,
+                 expert_params=None):
+        """``expert_params``: optional ``{"block_i": {w_up, b_up, w_down,
+        b_down}}`` expert tables injected around flax (possibly sharded to
+        this rank's experts); absent entries fall back to the params tree."""
         cfg = self.config
         if tokens.shape[1] > cfg.max_len:
             raise ValueError(
@@ -174,9 +190,10 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      name="embed")(tokens)
         for i in range(cfg.num_layers):
+            ep = (expert_params or {}).get(f"block_{i}")
             x = Block(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
                       cfg.num_experts, cfg.capacity_factor,
-                      name=f"block_{i}")(x, attn_fn, positions, moe_fn)
+                      name=f"block_{i}")(x, attn_fn, positions, moe_fn, ep)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
                           name="lm_head")(x)

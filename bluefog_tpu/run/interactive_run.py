@@ -96,6 +96,17 @@ def engine_main(control: str, engine_id: int) -> int:
     sock = socket.create_connection((host, int(port)))
     reader = _LineReader(sock)
 
+    # The driver's hung-engine interrupt SIGINTs every engine; only an
+    # engine stuck INSIDE user code should feel it.  Outside the exec
+    # window (idle at recv, mid-send) the signal is swallowed — raising
+    # there would kill a healthy engine or tear a half-written JSON line.
+    in_exec = {"flag": False}
+
+    def _sigint(_sig, _frm):
+        if in_exec["flag"]:
+            raise KeyboardInterrupt
+    signal.signal(signal.SIGINT, _sigint)
+
     import jax
     import jax.numpy as jnp
     import bluefog_tpu as bf
@@ -106,10 +117,7 @@ def engine_main(control: str, engine_id: int) -> int:
                  "process_index": jax.process_index()})
 
     while True:
-        try:
-            msg = reader.recv()
-        except KeyboardInterrupt:
-            continue      # hung-engine SIGINT aimed at a peer: stay alive
+        msg = reader.recv()
         if msg is None or msg.get("type") == "shutdown":
             break
         if msg.get("type") != "exec":
@@ -124,14 +132,14 @@ def engine_main(control: str, engine_id: int) -> int:
                 except SyntaxError:
                     # ...'exec' handles multi-statement blocks/scripts
                     code_obj = compile(msg["code"], "<ibfrun>", "exec")
+                in_exec["flag"] = True
                 exec(code_obj, ns)
         except BaseException:
             error = traceback.format_exc()
-        try:
-            _send(sock, {"type": "result", "engine": engine_id,
-                         "stdout": buf.getvalue(), "error": error})
-        except KeyboardInterrupt:
-            continue
+        finally:
+            in_exec["flag"] = False
+        _send(sock, {"type": "result", "engine": engine_id,
+                     "stdout": buf.getvalue(), "error": error})
     bf.shutdown()
     return 0
 
@@ -213,17 +221,20 @@ def driver_main(args, hosts) -> int:
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind(("0.0.0.0", args.control_port))
     server.listen(len(hosts))
-    control_addr = f"{socket.gethostname()}:{server.getsockname()[1]}" \
-        if any(h for h, _ in hosts
-               if h not in ("localhost", "127.0.0.1")) \
-        else f"127.0.0.1:{server.getsockname()[1]}"
+    from . import network_util
+    port_str = server.getsockname()[1]
+    if any(not network_util.is_local_host(h) for h, _ in hosts):
+        control_addr = f"{socket.gethostname()}:{port_str}"
+    else:
+        control_addr = f"127.0.0.1:{port_str}"
 
     procs = _launch_engines(args, hosts, control_addr)
     with open(_PIDFILE, "w") as f:
-        # "host pid pattern" per line: ibfrun stop must reach remote
-        # engines over ssh (the local pid is just the ssh client there)
+        # "host pid ssh_port pattern" per line: ibfrun stop must reach
+        # remote engines over ssh (the local pid is only the ssh client)
         for p, host, local in procs:
-            f.write(f"{host} {p.pid} {control_addr}\n")
+            f.write(f"{host} {p.pid} {args.ssh_port or '-'} "
+                    f"{control_addr}\n")
 
     conns = []
     try:
@@ -255,9 +266,11 @@ def driver_main(args, hosts) -> int:
               f"ALL engines (SPMD); Ctrl-C interrupts hung engines; "
               f"Ctrl-D exits", flush=True)
 
+        interrupter = lambda: _interrupt_engines(procs, control_addr,
+                                                 args.ssh_port)
         if args.extra_script:
             with open(args.extra_script) as f:
-                _broadcast_and_print(conns, f.read())
+                _broadcast_and_print(conns, f.read(), interrupter)
 
         while True:
             try:
@@ -270,13 +283,7 @@ def driver_main(args, hosts) -> int:
                 continue
             if not line.strip():
                 continue
-            try:
-                _broadcast_and_print(conns, line)
-            except KeyboardInterrupt:
-                print("^C — interrupting engines", flush=True)
-                _interrupt_engines(procs, control_addr, args.ssh_port)
-                # engines surface the KeyboardInterrupt as an exec error
-                _drain(conns)
+            _broadcast_and_print(conns, line, interrupter)
     finally:
         for conn, _ in conns:
             try:
@@ -294,21 +301,37 @@ def driver_main(args, hosts) -> int:
     return 0
 
 
-def _broadcast_and_print(conns, code: str) -> None:
-    for conn, _ in conns:
+def _broadcast_and_print(conns, code: str, interrupter=None) -> None:
+    pending = []
+    for conn, reader in conns:
         try:
             _send(conn, {"type": "exec", "code": code})
+            pending.append(reader)
         except OSError:
-            pass      # dead engine: its recv below reports None, not a crash
-    _drain(conns)
+            pass      # dead engine: skipped rather than crashing the driver
+    _drain(pending, interrupter)
 
 
-def _drain(conns) -> None:
-    for _, reader in conns:
+def _drain(pending, interrupter=None) -> None:
+    """Print each still-unanswered engine's result.  ``pending`` tracks
+    exactly the connections owed a reply, so a Ctrl-C retry never re-reads
+    an engine that already answered (that would block forever); the
+    interrupt only SIGINTs engines and keeps waiting — interrupted execs
+    come back as ordinary error results."""
+    pending = list(pending)
+    while pending:
+        reader = pending[0]
         try:
             msg = reader.recv()
+        except KeyboardInterrupt:
+            if interrupter is None:
+                raise
+            print("^C — interrupting engines", flush=True)
+            interrupter()
+            continue
         except OSError:
             msg = None
+        pending.pop(0)
         if msg is None:
             continue
         tag = f"[engine {msg.get('engine')}] "
@@ -330,7 +353,7 @@ def stop_main() -> int:
         for line in f:
             if not line.strip():
                 continue
-            host, pid, pattern = line.split(None, 2)
+            host, pid, ssh_port, pattern = line.split(None, 3)
             n += 1
             if network_util.is_local_host(host):
                 try:
@@ -338,7 +361,8 @@ def stop_main() -> int:
                 except ProcessLookupError:
                     pass
             else:
-                _remote_signal(host, pattern.strip(), "TERM")
+                _remote_signal(host, pattern.strip(), "TERM",
+                               None if ssh_port == "-" else int(ssh_port))
     os.unlink(_PIDFILE)
     print(f"ibfrun: stopped {n} engine(s)")
     return 0

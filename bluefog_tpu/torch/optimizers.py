@@ -302,7 +302,6 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     (tensorflow/optimizers.py:135): pick the strategy by name.  Passing
     ``model=`` auto-registers the per-layer timeline hooks, like the
     reference optimizers do (torch/optimizers.py:112-163)."""
-    handles = register_timeline_hooks(model) if model is not None else []
     if communication == "neighbor_allreduce":
         opt = DistributedNeighborAllreduceOptimizer(
             optimizer, num_steps_per_communication, sched)
@@ -311,6 +310,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
             optimizer, num_steps_per_communication)
     else:
         raise ValueError(f"unknown communication {communication!r}")
-    # keep the hook handles removable (opt._bft_timeline_handles[i].remove())
-    opt._bft_timeline_handles = handles
+    # hooks attach only after the strategy validates, and stay removable
+    # (opt._bft_timeline_handles[i].remove())
+    opt._bft_timeline_handles = (
+        register_timeline_hooks(model) if model is not None else [])
     return opt
