@@ -1,0 +1,102 @@
+"""LM training throughput benchmark (flash-attention path).
+
+Times the TransformerLM train step — the long-context model family whose
+attention runs the Pallas flash kernel on TPU (``attn_impl="auto"``,
+ops/flash_attention.py) — and reports tokens/sec plus MFU from XLA's
+per-device FLOP count.  Compare ``--attn-impl reference`` vs the default to
+measure the flash kernel's win on real hardware.
+
+    python scripts/lm_bench.py --seq-len 4096 --batch-size 4
+    python scripts/lm_bench.py --attn-impl reference   # XLA einsum path
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu.models.transformer import TransformerLM
+from bench import peak_flops_per_chip  # noqa: E402  (shared peak table)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=4096)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "flash", "reference"])
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+
+    bf.init()
+    model = TransformerLM(vocab_size=args.vocab, num_layers=args.layers,
+                          num_heads=args.heads, embed_dim=args.dim,
+                          max_len=args.seq_len, dtype=jnp.bfloat16,
+                          attn_impl=args.attn_impl)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(
+        0, args.vocab, size=(args.batch_size, args.seq_len)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, tok, tgt):
+        logits = model.apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    @jax.jit
+    def step(p, st, tok, tgt):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tok, tgt)
+        updates, st = opt.update(grads, st, p)
+        return optax.apply_updates(p, updates), st, loss
+
+    t0 = time.perf_counter()
+    compiled = step.lower(params, opt_state, tokens, targets).compile()
+    print(f"compile: {time.perf_counter() - t0:.1f}s "
+          f"(attn_impl={args.attn_impl})", flush=True)
+    cost = compiled.cost_analysis()
+    flops = cost.get("flops") if cost else None
+
+    loss = None
+    for _ in range(args.warmup):
+        params, opt_state, loss = compiled(params, opt_state, tokens,
+                                           targets)
+    if loss is not None:
+        _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, opt_state, loss = compiled(params, opt_state, tokens,
+                                           targets)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / args.iters
+
+    toks = args.batch_size * args.seq_len
+    print(f"step: {dt * 1e3:.1f} ms   {toks / dt:,.0f} tokens/sec   "
+          f"loss {float(loss):.3f}")
+    peak = peak_flops_per_chip()
+    if flops and peak:
+        print(f"MFU: {flops / dt / peak * 100:.1f}%  "
+              f"({flops / 1e9:.1f} GFLOP/step, "
+              f"peak {peak / 1e12:.0f} TFLOP/s)")
+
+
+if __name__ == "__main__":
+    main()
