@@ -79,7 +79,15 @@ class _LineReader:
 
     def recv(self) -> Optional[dict]:
         line = self._f.readline()
-        return json.loads(line) if line else None
+        if not line:
+            return None
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            # a Ctrl-C can interrupt readline mid-line, losing its partial
+            # bytes; surface the torn frame instead of crashing the driver
+            return {"engine": "?", "stdout": "",
+                    "error": "[driver] torn result line (interrupted read)"}
 
 
 # ---------------------------------------------------------------------------
@@ -135,6 +143,9 @@ def engine_main(control: str, engine_id: int) -> int:
                 in_exec["flag"] = True
                 exec(code_obj, ns)
         except BaseException:
+            # drop the flag FIRST: a second Ctrl-C arriving while the
+            # traceback is being formatted must not kill the engine
+            in_exec["flag"] = False
             error = traceback.format_exc()
         finally:
             in_exec["flag"] = False
@@ -283,7 +294,14 @@ def driver_main(args, hosts) -> int:
                 continue
             if not line.strip():
                 continue
-            _broadcast_and_print(conns, line, interrupter)
+            try:
+                _broadcast_and_print(conns, line, interrupter)
+            except KeyboardInterrupt:
+                # ^C outside the recv wait (e.g. while printing output):
+                # interrupt engines and keep the session alive; any
+                # still-pending replies surface before the next command
+                print("^C — interrupting engines", flush=True)
+                interrupter()
     finally:
         for conn, _ in conns:
             try:
@@ -353,7 +371,12 @@ def stop_main() -> int:
         for line in f:
             if not line.strip():
                 continue
-            host, pid, ssh_port, pattern = line.split(None, 3)
+            parts = line.split(None, 3)
+            if len(parts) == 3:          # older 3-field pidfile format
+                host, pid, pattern = parts
+                ssh_port = "-"
+            else:
+                host, pid, ssh_port, pattern = parts
             n += 1
             if network_util.is_local_host(host):
                 try:

@@ -60,15 +60,43 @@ def main():
         lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
     pairs = [(i, i + 1) for i in range(0, n - 1, 2)]
 
+    def _with_backend(backend, fn):
+        """Run fn with BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND pinned."""
+        def wrapped(x):
+            prev = os.environ.get("BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND")
+            os.environ["BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND"] = backend
+            try:
+                return fn(x)
+            finally:
+                if prev is None:
+                    os.environ.pop("BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND",
+                                   None)
+                else:
+                    os.environ["BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND"] = prev
+        return wrapped
+
+    # the Pallas fused exchange only compiles on real TPU hardware; the
+    # interpreter variant is for semantics tests, far too slow to time
+    # (set BENCH_FORCE_PALLAS=1 to include it on a CPU mesh anyway)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    with_pallas = on_tpu or os.environ.get("BENCH_FORCE_PALLAS") == "1"
+    pallas_backend = "pallas" if on_tpu else "pallas_interpret"
     ops = {
         "allreduce": lambda x: bf.allreduce(x),
         "broadcast(0)": lambda x: bf.broadcast(x, root_rank=0),
         "allgather": lambda x: bf.allgather(x),
         "neighbor_allreduce": lambda x: bf.neighbor_allreduce(x),
+        "nar[pallas]": _with_backend(
+            pallas_backend, lambda x: bf.neighbor_allreduce(x)),
         "nar_dynamic(step=1)": lambda x: bf.neighbor_allreduce(
             x, sched=sched, step=1),
+        "nar_dynamic[pallas]": _with_backend(
+            pallas_backend,
+            lambda x: bf.neighbor_allreduce(x, sched=sched, step=1)),
         "pair_gossip": lambda x: bf.pair_gossip(x, pairs),
     }
+    if not with_pallas or os.environ.get("BENCH_SKIP_PALLAS") == "1":
+        ops = {k: v for k, v in ops.items() if "pallas" not in k}
 
     sizes = [int(s) for s in args.sizes.split(",")]
     # build + place each input ONCE: to_global pre-shards over the rank
