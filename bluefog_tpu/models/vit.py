@@ -1,0 +1,74 @@
+"""Vision Transformer (image model family #2, attention-based).
+
+The reference's model zoo is torchvision's (examples/pytorch_resnet.py uses
+``getattr(models, args.model)`` — ResNet and friends); this adds the
+attention-family image model the TPU build favors: patchify with a single
+strided conv (one big MXU matmul), then the same pre-LN decoder blocks as
+the LM family (models/transformer.py) running bidirectionally, mean-pool
+head.  Flash attention dispatches automatically on TPU via
+``ops.flash_attention.best_attention`` (non-causal).
+
+TPU-first choices: NHWC input, bfloat16 compute / float32 params, patch
+and embed sizes that tile onto the 128-lane MXU.
+"""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Block
+
+__all__ = ["ViT", "ViT_S16", "ViT_B16"]
+
+
+class ViT(nn.Module):
+    """Patchified Transformer classifier.
+
+    ``x``: [B, H, W, 3] with H, W divisible by ``patch``.
+    """
+    num_classes: int = 1000
+    patch: int = 16
+    num_layers: int = 12
+    num_heads: int = 6
+    embed_dim: int = 384
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, H, W, _ = x.shape
+        if H % self.patch or W % self.patch:
+            raise ValueError(
+                f"image size {(H, W)} must be divisible by patch "
+                f"{self.patch}")
+        x = x.astype(self.dtype)
+        # patchify: one strided conv == the unfold+project matmul
+        x = nn.Conv(self.embed_dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(x)
+        T = (H // self.patch) * (W // self.patch)
+        x = x.reshape(B, T, self.embed_dim)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, T, self.embed_dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+
+        from ..ops.flash_attention import best_attention
+        attn_fn = lambda q, k, v: best_attention(q, k, v, causal=False)
+        positions = jnp.zeros((T,), jnp.int32)  # RoPE off: learned pos above
+
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.dtype, self.mlp_ratio,
+                      name=f"block_{i}")(x, attn_fn, positions)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = x.mean(axis=1)
+        # float32 head like the LM family: bf16 logits would quantize the
+        # loss before the cast could help
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+ViT_S16 = partial(ViT, patch=16, num_layers=12, num_heads=6, embed_dim=384)
+ViT_B16 = partial(ViT, patch=16, num_layers=12, num_heads=12, embed_dim=768)

@@ -297,10 +297,11 @@ def driver_main(args, hosts) -> int:
             try:
                 _broadcast_and_print(conns, line, interrupter)
             except KeyboardInterrupt:
-                # ^C outside the recv wait (e.g. while printing output):
-                # interrupt engines and keep the session alive; any
-                # still-pending replies surface before the next command
-                print("^C — interrupting engines", flush=True)
+                # last-resort net (the drain handles ^C itself and keeps
+                # the reply stream in sync; reaching here means replies may
+                # be misattributed to the next command)
+                print("^C — interrupting engines (reply stream may be "
+                      "desynced)", flush=True)
                 interrupter()
     finally:
         for conn, _ in conns:
@@ -352,13 +353,21 @@ def _drain(pending, interrupter=None) -> None:
         pending.pop(0)
         if msg is None:
             continue
-        tag = f"[engine {msg.get('engine')}] "
-        out = msg.get("stdout") or ""
-        for ln in out.splitlines():
-            print(tag + ln, flush=True)
-        if msg.get("error"):
-            for ln in msg["error"].splitlines():
+        try:
+            tag = f"[engine {msg.get('engine')}] "
+            out = msg.get("stdout") or ""
+            for ln in out.splitlines():
                 print(tag + ln, flush=True)
+            if msg.get("error"):
+                for ln in msg["error"].splitlines():
+                    print(tag + ln, flush=True)
+        except KeyboardInterrupt:
+            # ^C while printing: the message is already consumed (stream
+            # stays in sync); signal the engines and keep draining the rest
+            if interrupter is None:
+                raise
+            print("^C — interrupting engines", flush=True)
+            interrupter()
 
 
 def stop_main() -> int:
