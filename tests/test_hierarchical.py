@@ -19,14 +19,18 @@ def rank_tensor(shape=(4,)):
     return jnp.broadcast_to(base, (N,) + shape)
 
 
+def machine_local_means():
+    """Per-machine mean of rank values (the local pmean of rank_tensor)."""
+    return np.asarray([np.mean([m * LOCAL + l for l in range(LOCAL)])
+                       for m in range(MACHINES)])
+
+
 def test_hierarchical_neighbor_allreduce_ring(bf_ctx_machines):
     bf.set_machine_topology(bf.RingGraph(MACHINES), is_weighted=True)
     x = rank_tensor((4,))
     out = bf.hierarchical_neighbor_allreduce(x)
 
-    local_means = np.asarray(
-        [np.mean([m * LOCAL + l for l in range(LOCAL)])
-         for m in range(MACHINES)])
+    local_means = machine_local_means()
     W = nx.to_numpy_array(bf.RingGraph(MACHINES))
     machine_out = W.T @ local_means
     for r in range(N):
@@ -70,3 +74,58 @@ def test_local_allreduce_shard_map(bf_ctx_machines):
         m = r // LOCAL
         expected = np.mean([m * LOCAL + l for l in range(LOCAL)])
         np.testing.assert_allclose(out[r], np.full(3, expected), rtol=1e-6)
+
+
+def test_hierarchical_unweighted_machine_topology(bf_ctx_machines):
+    """Unweighted machine topology -> uniform 1/(deg+1) machine mixing
+    (reference default weighting, torch/mpi_ops.py:648-838)."""
+    bf.set_machine_topology(bf.RingGraph(MACHINES), is_weighted=False)
+    x = rank_tensor((4,))
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+    local_means = machine_local_means()
+    # uniform mixing over {self} + machine in-neighbors
+    topo = bf.load_machine_topology()
+    for m in range(MACHINES):
+        srcs = sorted(s for s, _ in topo.in_edges(m) if s != m)
+        expected = np.mean([local_means[m]] + [local_means[s] for s in srcs])
+        for l in range(LOCAL):
+            np.testing.assert_allclose(out[m * LOCAL + l],
+                                       np.full(4, expected), rtol=1e-6)
+
+
+def test_hierarchical_nonblocking_roundtrip(bf_ctx_machines):
+    bf.set_machine_topology(bf.ExponentialTwoGraph(MACHINES))
+    h = bf.hierarchical_neighbor_allreduce_nonblocking(rank_tensor((2,)))
+    out = bf.synchronize(h)
+    assert out.shape == (N, 2)
+
+
+def test_machine_neighbor_queries(bf_ctx_machines):
+    """in/out machine-neighbor queries against the networkx graph
+    (reference basics.py machine-rank surface)."""
+    bf.set_machine_topology(bf.RingGraph(MACHINES))
+    topo = bf.load_machine_topology()
+    for m in range(MACHINES):
+        expected_in = sorted(s for s, _ in topo.in_edges(m) if s != m)
+        expected_out = sorted(d for _, d in topo.out_edges(m) if d != m)
+        # the queries take a *global* rank and map it to its machine
+        assert sorted(bf.in_neighbor_machine_ranks(m * LOCAL)) == expected_in
+        assert sorted(bf.out_neighbor_machine_ranks(m * LOCAL)) == expected_out
+
+
+def test_dynamic_machine_schedule_runs(bf_ctx_machines):
+    """The machine-level exp2 schedule yields one send/recv MACHINE per
+    step, never this rank's own machine, with send/recv symmetric across
+    the cluster (reference GetExp2DynamicSendRecvMachineRanks)."""
+    gens = [bf.GetExp2DynamicSendRecvMachineRanks(N, LOCAL, r, r % LOCAL)
+            for r in range(N)]
+    for _ in range(4):
+        per_rank = [next(g) for g in gens]
+        for r, (dst, src) in enumerate(per_rank):
+            m = r // LOCAL
+            assert len(dst) == 1 and len(src) == 1
+            assert dst[0] != m and src[0] != m
+        # if machine a sends to machine b, b receives from a
+        for r, (dst, _) in enumerate(per_rank):
+            receiver_rank = dst[0] * LOCAL + (r % LOCAL)
+            assert per_rank[receiver_rank][1] == [r // LOCAL]
