@@ -1,1 +1,21 @@
 """Model zoo used by the examples, benchmarks and tests."""
+
+__all__ = ["get_model"]
+
+
+def get_model(name: str):
+    """Look up a model constructor by name across the zoo (the reference
+    examples use ``getattr(torchvision.models, args.model)``; this is the
+    equivalent over `models/`).  Only names each module exports resolve.
+
+    Image classifiers (what `examples/resnet.py` / `examples/benchmark.py`
+    construct with ``num_classes=``/``dtype=``): ResNet18/34/50/101/152,
+    ViT_S16/B16, LeNet.  Other families (TransformerLM, MLP,
+    LogisticRegression) resolve too but take their own constructor
+    arguments — use them from their dedicated examples/tests.
+    """
+    from . import resnet, vit, transformer, mlp, lenet
+    for mod in (resnet, vit, transformer, mlp, lenet):
+        if name in getattr(mod, "__all__", ()):
+            return getattr(mod, name)
+    raise ValueError(f"unknown model {name!r}")

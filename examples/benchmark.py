@@ -18,7 +18,7 @@ import optax
 
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
-from bluefog_tpu.models import resnet as resnet_mod
+from bluefog_tpu.models import get_model
 
 
 def main():
@@ -57,7 +57,7 @@ def main():
             lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model_cls = getattr(resnet_mod, args.model)
+    model_cls = get_model(args.model)
     model = model_cls(num_classes=1000, dtype=dtype)
 
     base = optax.sgd(0.01, momentum=0.9)

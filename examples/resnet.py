@@ -25,7 +25,7 @@ import optax
 
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
-from bluefog_tpu.models import resnet as resnet_mod
+from bluefog_tpu.models import get_model
 
 
 def build_schedule(args, n):
@@ -84,7 +84,7 @@ def main():
         bf.set_machine_topology(bf.ExponentialTwoGraph(bf.machine_size()))
     sched = build_schedule(args, n)
 
-    model = getattr(resnet_mod, args.model)(
+    model = get_model(args.model)(
         num_classes=args.num_classes,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
 
