@@ -79,13 +79,15 @@ def test_dynamic_neighbor_allgather_src_only(bf_ctx):
 def test_dynamic_neighbor_allgather_irregular_edge_set(bf_ctx):
     """Ragged per-call edges: rank 0 receives from 3 peers, rank 1 from
     one, the rest from none — padded output with zero rows."""
-    src_ranks = [[1, 2, 3], [5], [], [], [], [], [], []]
+    src_ranks = [[] for _ in range(N)]
+    src_ranks[0] = [1, 2, 3]
+    src_ranks[1] = [N - 1]
     x = _x(3)
     out = np.asarray(bf.neighbor_allgather(x, src_ranks=src_ranks))
     assert out.shape == (N, 3, 2, 3)
     for slot, src in enumerate([1, 2, 3]):
         np.testing.assert_allclose(out[0, slot], np.asarray(x)[src])
-    np.testing.assert_allclose(out[1, 0], np.asarray(x)[5])
+    np.testing.assert_allclose(out[1, 0], np.asarray(x)[N - 1])
     np.testing.assert_array_equal(out[1, 1:], 0.0)
     np.testing.assert_array_equal(out[2:], 0.0)
 

@@ -172,11 +172,10 @@ def test_hierarchical_neighbor_allreduce(bf_ctx_machines):
 
 
 def test_pair_gossip(bf_ctx):
-    out = bft.pair_gossip(_rankval((2,)), pairs=[(0, 1), (2, 3)])
+    out = bft.pair_gossip(_rankval((2,)), pairs=[(0, 1)])
     assert torch.allclose(out[0], torch.full((2,), 0.5))
     assert torch.allclose(out[1], torch.full((2,), 0.5))
-    assert torch.allclose(out[2], torch.full((2,), 2.5))
-    assert torch.allclose(out[4], torch.full((2,), 4.0))  # unmatched
+    assert torch.allclose(out[2], torch.full((2,), 2.0))  # unmatched
 
 
 def test_window_put_update_roundtrip(bf_ctx):
