@@ -52,8 +52,18 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-    batches_per_iter = int(os.environ.get("BENCH_BATCHES_PER_ITER", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "4"))
+    # Two window sizes: each timed window ends with a scalar fetch whose
+    # transport round-trip is a CONSTANT additive cost (tens of ms through
+    # a tunneled transport — comparable to several train steps).  Timing
+    # windows of K_small and K_large steps and differencing cancels it:
+    # step_time = (t_large - t_small) / (K_large - K_small).
+    k_small = int(os.environ.get("BENCH_WINDOW_SMALL", "5"))
+    k_large = int(os.environ.get("BENCH_WINDOW_LARGE", "25"))
+    if k_large <= k_small:
+        raise ValueError(
+            f"BENCH_WINDOW_LARGE ({k_large}) must exceed "
+            f"BENCH_WINDOW_SMALL ({k_small})")
 
     bf.init()
     n = bf.size()
@@ -114,16 +124,23 @@ def main():
         # block_until_ready can return before remote execution completes)
         _ = float(loss)
 
-    rates = []
-    for _ in range(iters):
+    def timed_window(k):
+        nonlocal variables, opt_state, loss, step
         t0 = time.perf_counter()
-        for _ in range(batches_per_iter):
+        for _ in range(k):
             variables, opt_state, loss = step_fn(
                 variables, opt_state, (x, y), jnp.int32(step))
             step += 1
         _ = float(loss)  # scalar fetch as execution barrier
-        dt = time.perf_counter() - t0
-        rates.append(batches_per_iter * batch * n / dt)
+        return time.perf_counter() - t0
+
+    # alternate small/large windows so drift affects both equally
+    step_times = []
+    for _ in range(iters):
+        t_s = timed_window(k_small)
+        t_l = timed_window(k_large)
+        step_times.append((t_l - t_s) / (k_large - k_small))
+    rates = [batch * n / t for t in step_times]
 
     if ckpt is not None:
         ckpt.save(step, {"variables": variables, "opt_state": opt_state},

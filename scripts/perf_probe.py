@@ -1,8 +1,14 @@
 """Single-chip perf probe for the ResNet-50 bench step.
 
-Times the full train step (and optionally forward-only) and reports achieved
-FLOP/s vs the chip's peak (MFU), using XLA's own cost analysis for the FLOP
-count.  Prints incrementally so a partial run still yields data.
+Ablation ladder: forward, forward+backward, full train step (with the
+optimizer update and the global-view plumbing), at several batch sizes,
+each with XLA's own FLOP count and bytes-accessed so the report includes a
+roofline bound (compute-limited vs HBM-limited) per stage.
+
+Timing uses a scalar device-to-host fetch as the execution barrier —
+``jax.block_until_ready`` can return before remote execution completes on
+tunneled transports (the probe's round-1 numbers were dispatch time, not
+device time), so every timed window ends by fetching one float.
 """
 
 import os
@@ -29,81 +35,117 @@ PEAK = {
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
+# HBM bandwidth GB/s by device kind (public numbers)
+HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
 
 
-def peak_flops(device_kind: str):
-    for k, v in PEAK.items():
+def lookup(table, device_kind: str):
+    for k, v in table.items():
         if k.lower() in device_kind.lower():
             return v
     return None
 
 
-def timeit(fn, *args, n=10, warmup=2):
-    out = None
+def timeit(fn, *args, n=10, warmup=3):
+    """Pipelined timing with a scalar-fetch barrier."""
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
     t0 = time.perf_counter()
     for _ in range(n):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
     return (time.perf_counter() - t0) / n
+
+
+def analyze(compiled):
+    cost = compiled.cost_analysis()
+    if not cost:
+        return None, None
+    flops = cost.get("flops")
+    byt = cost.get("bytes accessed")
+    return flops, byt
+
+
+def report(name, t, flops, byt, peak, gbps, batch):
+    line = f"{name}: {t*1e3:.2f} ms  ({batch/t:.0f} img/s)"
+    if flops and peak:
+        line += f"  MFU {flops/t/peak*100:.1f}%"
+    if byt and gbps:
+        line += f"  HBM {byt/t/1e9:.0f} GB/s ({byt/t/1e9/gbps*100:.0f}% of peak)"
+    if flops and byt and peak and gbps:
+        bound = max(flops / peak, byt / (gbps * 1e9))
+        which = "compute" if flops / peak > byt / (gbps * 1e9) else "HBM"
+        line += f"  [roofline: {bound*1e3:.2f} ms, {which}-bound]"
+    print(line, flush=True)
 
 
 def main():
     dev = jax.devices()[0]
-    peak = peak_flops(dev.device_kind)
-    print(f"device: {dev.device_kind} ({dev.platform}); "
-          f"assumed peak bf16 FLOP/s: {peak}", flush=True)
+    peak = lookup(PEAK, dev.device_kind)
+    gbps = lookup(HBM_GBPS, dev.device_kind)
+    peak_s = f"{peak/1e12:.0f} TFLOP/s" if peak else "unknown"
+    print(f"device: {dev.device_kind} ({dev.platform}); peak bf16 "
+          f"{peak_s}, HBM {gbps} GB/s", flush=True)
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    image = 224
     bf.init()
-
+    image = 224
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     base = optax.sgd(0.01, momentum=0.9)
     variables, opt_state = T.create_train_state(
         model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))
-    step_fn = T.make_train_step(model, base,
-                                communication="neighbor_allreduce",
-                                sched=None, donate=False)
+    sq = jax.tree.map(lambda a: a[0], variables)
 
+    batches = [int(b) for b in
+               os.environ.get("PROBE_BATCHES", "64,128,256").split(",")]
     rng = np.random.default_rng(0)
-    x = jax.device_put(jnp.asarray(
-        rng.normal(size=(1, batch, image, image, 3)), jnp.float32))
-    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(1, batch))))
 
-    t0 = time.perf_counter()
-    compiled = step_fn.lower(variables, opt_state, (x, y),
-                             jnp.int32(0)).compile()
-    print(f"step compile: {time.perf_counter()-t0:.1f}s", flush=True)
-    cost = compiled.cost_analysis()
-    flops = cost.get("flops") if cost else None
-    print(f"XLA step flops: {flops}", flush=True)
-
-    t_step = timeit(step_fn, variables, opt_state, (x, y), jnp.int32(0))
-    print(f"full step: {t_step*1e3:.2f} ms  ({batch/t_step:.0f} img/s)",
-          flush=True)
-    if flops and peak:
-        print(f"MFU (full step): {flops/t_step/peak*100:.1f}%", flush=True)
-
-    if os.environ.get("PROBE_FWD", "0") == "1":
-        sq = jax.tree.map(lambda a: a[0], variables)
+    for batch in batches:
+        x1 = jnp.asarray(rng.normal(size=(batch, image, image, 3)),
+                         jnp.float32)
+        y1 = jnp.asarray(rng.integers(0, 1000, size=(batch,)))
+        print(f"--- batch {batch} ---", flush=True)
 
         @jax.jit
         def fwd(v, xb):
-            return model.apply(v, xb, train=True, mutable=["batch_stats"])[0]
+            out, _ = model.apply(v, xb, train=True, mutable=["batch_stats"])
+            return out.sum()
 
-        t0 = time.perf_counter()
-        fcomp = fwd.lower(sq, x[0]).compile()
-        print(f"fwd compile: {time.perf_counter()-t0:.1f}s", flush=True)
-        fcost = fcomp.cost_analysis()
-        fflops = fcost.get("flops") if fcost else None
-        t_fwd = timeit(fwd, sq, x[0])
-        print(f"fwd: {t_fwd*1e3:.2f} ms  ({batch/t_fwd:.0f} img/s)",
-              flush=True)
-        if fflops and peak:
-            print(f"MFU (fwd): {fflops/t_fwd/peak*100:.1f}%", flush=True)
+        c = fwd.lower(sq, x1).compile()
+        f, b = analyze(c)
+        report("fwd           ", timeit(c, sq, x1), f, b, peak, gbps, batch)
+
+        @jax.jit
+        def fwdbwd(v, xb, yb):
+            def loss_fn(p):
+                out, _ = model.apply({"params": p, **{k: v[k] for k in v
+                                                      if k != "params"}},
+                                     xb, train=True, mutable=["batch_stats"])
+                return T.cross_entropy_loss(out, yb)
+            l, g = jax.value_and_grad(loss_fn)(v["params"])
+            return l, jax.tree.map(lambda a: a.sum(), g)
+
+        c = fwdbwd.lower(sq, x1, y1).compile()
+        f, b = analyze(c)
+        report("fwd+bwd       ", timeit(c, sq, x1, y1), f, b, peak, gbps,
+               batch)
+
+        step_fn = T.make_train_step(model, base,
+                                    communication="neighbor_allreduce",
+                                    sched=None, donate=False)
+        xg, yg = x1[None], y1[None]
+        c = step_fn.lower(variables, opt_state, (xg, yg),
+                          jnp.int32(0)).compile()
+        f, b = analyze(c)
+        t = timeit(lambda: c(variables, opt_state, (xg, yg), jnp.int32(0))[2])
+        report("full train step", t, f, b, peak, gbps, batch)
 
 
 if __name__ == "__main__":
