@@ -29,7 +29,9 @@ from bluefog_tpu.models.resnet import ResNet50
 
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
 
-# bf16 peak FLOP/s per chip by device kind (public numbers), for MFU
+# bf16 peak FLOP/s and HBM GB/s per chip by device kind (public numbers);
+# the single source for every benchmark script (lm_bench/perf_probe/
+# single_ops_bench import from here)
 PEAK_FLOPS = {
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,
@@ -38,14 +40,64 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
+HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
 
 
-def peak_flops_per_chip():
+def lookup_device_table(table):
     kind = jax.devices()[0].device_kind
-    for k, v in PEAK_FLOPS.items():
+    for k, v in table.items():
         if k.lower() in kind.lower():
             return v
     return None
+
+
+def peak_flops_per_chip():
+    return lookup_device_table(PEAK_FLOPS)
+
+
+def scalar_fetch(out):
+    """Fetch ONE element of the first leaf to host.
+
+    The only reliable execution barrier on tunneled transports (where
+    block_until_ready can return before remote execution completes) that
+    does not also transfer the whole array: the device-side slice keeps
+    the host round-trip payload at one scalar."""
+    import jax.numpy as _jnp
+    leaf = jax.tree.leaves(out)[0]
+    return float(_jnp.ravel(leaf)[0])
+
+
+def measure_step_time(window, k_small, k_large, pairs=3):
+    """Two-window-differencing step timing.
+
+    ``window(k)`` runs k steps and ends with a scalar fetch whose
+    transport round-trip is a CONSTANT additive cost (tens of ms through
+    a tunneled transport — comparable to several steps); differencing a
+    large and a small window cancels it.  The median over ``pairs``
+    repetitions rejects one-off stalls (GC, transport jitter).  Returns
+    ``(median_step_time, estimates)``; raises if jitter dominated."""
+    if k_large <= k_small:
+        raise ValueError(f"k_large ({k_large}) must exceed "
+                         f"k_small ({k_small})")
+    est = []
+    for _ in range(pairs):
+        t_l = window(k_large)
+        t_s = window(k_small)
+        est.append((t_l - t_s) / (k_large - k_small))
+    est.sort()
+    dt = est[len(est) // 2]
+    if dt <= 0:
+        raise RuntimeError(
+            f"non-positive step-time estimates {est}: transport jitter "
+            "dominated the timing windows; rerun with larger windows")
+    return dt, est
 
 
 def main():
@@ -64,6 +116,10 @@ def main():
         raise ValueError(
             f"BENCH_WINDOW_LARGE ({k_large}) must exceed "
             f"BENCH_WINDOW_SMALL ({k_small})")
+    if "BENCH_BATCHES_PER_ITER" in os.environ:
+        print("BENCH_BATCHES_PER_ITER is gone: timing now uses "
+              "BENCH_WINDOW_SMALL/BENCH_WINDOW_LARGE window differencing",
+              file=sys.stderr)
 
     bf.init()
     n = bf.size()
@@ -134,13 +190,9 @@ def main():
         _ = float(loss)  # scalar fetch as execution barrier
         return time.perf_counter() - t0
 
-    # alternate small/large windows so drift affects both equally
-    step_times = []
-    for _ in range(iters):
-        t_s = timed_window(k_small)
-        t_l = timed_window(k_large)
-        step_times.append((t_l - t_s) / (k_large - k_small))
-    rates = [batch * n / t for t in step_times]
+    _, step_times = measure_step_time(timed_window, k_small, k_large,
+                                      pairs=iters)
+    rates = [batch * n / t for t in step_times if t > 0]
 
     if ckpt is not None:
         ckpt.save(step, {"variables": variables, "opt_state": opt_state},

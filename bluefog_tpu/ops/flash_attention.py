@@ -49,10 +49,25 @@ __all__ = ["flash_attention", "flash_attention_trainable",
 
 _NEG_INF = -1e30
 _LANES = 128
+# Row statistics (LSE, dl) are stored with a trailing lane dim so their
+# blocks satisfy the TPU tiling rule (a block's last two dims must divide
+# (8, 128) or equal the array's): [BH, Tq] would give blocks (1, block_q)
+# whose second-to-last dim 1 is illegal on hardware.  128 lanes matches
+# the native lane width (narrower arrays degrade into per-row strided
+# DMAs); the value is broadcast across lanes on write, lane 0 read back.
+_STAT_LANES = 128
 
 
 def _interp(flag):
     return pltpu.InterpretParams() if flag else False
+
+
+# batch*heads and the non-accumulating block axis are parallel; the
+# innermost axis accumulates into VMEM scratch and must stay sequential.
+# Without this Mosaic treats the whole grid as sequential and the many
+# small instances become DMA-issue-latency-bound.
+_DIMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _out_struct(shape, dtype, *operands):
@@ -125,7 +140,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, :] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _fwd(qh, kh, vh, offsets, *, scale, causal, block_q, block_k,
@@ -149,7 +165,8 @@ def _fwd(qh, kh, vh, offsets, *, scale, causal, block_q, block_k,
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, D), lambda b, i, j, off: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+                pl.BlockSpec((1, block_q, _STAT_LANES),
+                             lambda b, i, j, off: (b, i, 0)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -159,11 +176,13 @@ def _fwd(qh, kh, vh, offsets, *, scale, causal, block_q, block_k,
         ),
         out_shape=[
             _out_struct((BH, Tq, D), out_dtype, qh, kh, vh, offsets),
-            _out_struct((BH, Tq), jnp.float32, qh, kh, vh, offsets),
+            _out_struct((BH, Tq, _STAT_LANES), jnp.float32,
+                        qh, kh, vh, offsets),
         ],
+        compiler_params=_DIMS,
         interpret=_interp(interpret),
     )(offsets, qh, kh, vh)
-    return o, lse
+    return o, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -177,7 +196,7 @@ def _p_block(q_ref, k_ref, lse_ref, *, scale, causal, row0, col0,
     k = k_ref[0].astype(jnp.float32)
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)   # [bq, bk]
-    p = jnp.exp(s - lse_ref[0, :][:, None])
+    p = jnp.exp(s - lse_ref[0, :, 0][:, None])
     if causal:
         rows = row0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = col0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -206,7 +225,7 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         v = v_ref[0].astype(jnp.float32)                      # [bk, D]
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)  # [bq, bk]
-        ds = p * (dp - dl_ref[0, :][:, None]) * scale
+        ds = p * (dp - dl_ref[0, :, 0][:, None]) * scale
         dq_scr[...] += lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -247,7 +266,7 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         v = v_ref[0].astype(jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)  # [bq, bk]
-        ds = p * (dp - dl_ref[0, :][:, None]) * scale         # [bq, bk]
+        ds = p * (dp - dl_ref[0, :, 0][:, None]) * scale      # [bq, bk]
         dk_scr[...] += lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, D]
@@ -271,10 +290,15 @@ def _bwd(qh, kh, vh, doh, lse, dl, offsets, *, scale, causal,
     Tk = kh.shape[1]
     nq, nk = Tq // block_q, Tk // block_k
 
+    # row stats enter with the trailing lane dim (see _STAT_LANES)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_STAT_LANES,))
+    dl = jnp.broadcast_to(dl[..., None], dl.shape + (_STAT_LANES,))
+
     row_specs = dict(
         q=pl.BlockSpec((1, block_q, D), lambda b, i, j, off: (b, i, 0)),
         k=pl.BlockSpec((1, block_k, D), lambda b, i, j, off: (b, j, 0)),
-        vec=pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+        vec=pl.BlockSpec((1, block_q, _STAT_LANES),
+                         lambda b, i, j, off: (b, i, 0)),
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -290,6 +314,7 @@ def _bwd(qh, kh, vh, doh, lse, dl, offsets, *, scale, causal,
         ),
         out_shape=_out_struct((BH, Tq, D), qh.dtype,
                               qh, kh, vh, doh, lse, dl, offsets),
+        compiler_params=_DIMS,
         interpret=_interp(interpret),
     )(offsets, qh, kh, vh, doh, lse, dl)
 
@@ -297,7 +322,8 @@ def _bwd(qh, kh, vh, doh, lse, dl, offsets, *, scale, causal,
     kv_specs = dict(
         q=pl.BlockSpec((1, block_q, D), lambda b, j, i, off: (b, i, 0)),
         k=pl.BlockSpec((1, block_k, D), lambda b, j, i, off: (b, j, 0)),
-        vec=pl.BlockSpec((1, block_q), lambda b, j, i, off: (b, i)),
+        vec=pl.BlockSpec((1, block_q, _STAT_LANES),
+                         lambda b, j, i, off: (b, i, 0)),
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -318,6 +344,7 @@ def _bwd(qh, kh, vh, doh, lse, dl, offsets, *, scale, causal,
                                qh, kh, vh, doh, lse, dl, offsets),
                    _out_struct((BH, Tk, D), vh.dtype,
                                qh, kh, vh, doh, lse, dl, offsets)],
+        compiler_params=_DIMS,
         interpret=_interp(interpret),
     )(offsets, qh, kh, vh, doh, lse, dl)
     return dq, dk, dv
@@ -337,8 +364,19 @@ def _from_heads_major(x, B, H):
     return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
+def _fit_block(T, block):
+    """Largest power-of-two shrink of ``block`` that divides ``T`` (so the
+    512-default still serves 128-granular sequence lengths like 768).
+    Stops at 8 — the TPU sublane minimum — leaving non-8-granular lengths
+    to the divisibility error below."""
+    block = min(block, T)
+    while block > 8 and T % block:
+        block //= 2
+    return block
+
+
 def _check_blocks(Tq, Tk, block_q, block_k):
-    block_q, block_k = min(block_q, Tq), min(block_k, Tk)
+    block_q, block_k = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
     if Tq % block_q or Tk % block_k:
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) must be divisible by the block "
@@ -352,7 +390,7 @@ def _check_blocks(Tq, Tk, block_q, block_k):
 def flash_attention(q, k, v, *, causal: bool = False,
                     q_offset=0, k_offset=0,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool = False, return_lse: bool = False):
     """Flash attention forward.  ``q``: [B, Tq, H, D]; ``k``/``v``:
     [B, Tk, H, D].  ``q_offset``/``k_offset`` may be traced scalars.
@@ -417,7 +455,7 @@ _fa_with_lse.defvjp(_fa_fwd, _fa_bwd)
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              q_offset=0, k_offset=0,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 512, block_k: int = 512,
                              interpret: bool = False):
     """Differentiable flash attention returning ``(o, lse)``; the LSE
     cotangent is supported (needed under ring attention's merge)."""
@@ -434,7 +472,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
 def flash_attention_trainable(q, k, v, *, causal: bool = False,
                               q_offset=0, k_offset=0,
                               scale: Optional[float] = None,
-                              block_q: int = 128, block_k: int = 128,
+                              block_q: int = 512, block_k: int = 512,
                               interpret: bool = False):
     """Differentiable flash attention: Pallas forward AND Pallas backward
     (dq/dk/dv recomputed blockwise from the saved LSE — O(T) memory both
@@ -461,10 +499,10 @@ def merge_attention_partials(o1, lse1, o2, lse2):
     return o1 * c1 + o2 * c2, lse
 
 
-def flash_supported(q, k, block_q: int = 128, block_k: int = 128) -> bool:
+def flash_supported(q, k, block_q: int = 512, block_k: int = 512) -> bool:
     """True when the shapes tile cleanly and we are on a TPU backend."""
     Tq, Tk = q.shape[1], k.shape[1]
-    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
     return (jax.default_backend() == "tpu"
             and Tq % bq == 0 and Tk % bk == 0
             and bq % 8 == 0 and bk % 8 == 0)

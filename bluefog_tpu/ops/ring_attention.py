@@ -83,7 +83,7 @@ def _block_step(q, k, v, m, l, o, *, causal, q_pos0, k_pos0, scale):
 
 def ring_attention(q, k, v, axis_name, *, causal: bool = False,
                    scale: Optional[float] = None, impl: str = "auto",
-                   block_q: int = 128, block_k: int = 128,
+                   block_q: int = 512, block_k: int = 512,
                    interpret: bool = False):
     """Exact attention over a ring-sharded sequence (call inside shard_map).
 

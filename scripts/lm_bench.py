@@ -25,7 +25,8 @@ import optax
 
 import bluefog_tpu as bf
 from bluefog_tpu.models.transformer import TransformerLM
-from bench import peak_flops_per_chip  # noqa: E402  (shared peak table)
+from bench import (peak_flops_per_chip,  # noqa: E402  (shared peak table)
+                   measure_step_time)
 
 
 def main():
@@ -81,12 +82,20 @@ def main():
                                            targets)
     if loss is not None:
         _ = float(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params, opt_state, loss = compiled(params, opt_state, tokens,
-                                           targets)
-    _ = float(loss)
-    dt = (time.perf_counter() - t0) / args.iters
+
+    # two window sizes; differencing cancels the constant scalar-fetch
+    # round-trip (tens of ms on tunneled transports — see bench.py)
+    def window(k):
+        nonlocal params, opt_state, loss
+        t0 = time.perf_counter()
+        for _ in range(k):
+            params, opt_state, loss = compiled(params, opt_state, tokens,
+                                               targets)
+        _ = float(loss)
+        return time.perf_counter() - t0
+
+    k_small = max(1, args.iters // 5)
+    dt, _ = measure_step_time(window, k_small, args.iters + k_small)
 
     toks = args.batch_size * args.seq_len
     print(f"step: {dt * 1e3:.1f} ms   {toks / dt:,.0f} tokens/sec   "

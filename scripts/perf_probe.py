@@ -25,44 +25,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50
-
-# bf16 peak FLOP/s per chip by device kind (public numbers)
-PEAK = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-# HBM bandwidth GB/s by device kind (public numbers)
-HBM_GBPS = {
-    "TPU v4": 1228.0,
-    "TPU v5 lite": 819.0,
-    "TPU v5e": 819.0,
-    "TPU v5p": 2765.0,
-    "TPU v6 lite": 1640.0,
-    "TPU v6e": 1640.0,
-}
-
-
-def lookup(table, device_kind: str):
-    for k, v in table.items():
-        if k.lower() in device_kind.lower():
-            return v
-    return None
+from bench import (PEAK_FLOPS, HBM_GBPS, lookup_device_table,  # noqa: E402
+                   measure_step_time, scalar_fetch)
 
 
 def timeit(fn, *args, n=10, warmup=3):
-    """Pipelined timing with a scalar-fetch barrier."""
+    """Shared two-window-differencing timer (see bench.measure_step_time)."""
     for _ in range(warmup):
         out = fn(*args)
-    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
-    return (time.perf_counter() - t0) / n
+    scalar_fetch(out)
+
+    def window(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        scalar_fetch(out)
+        return time.perf_counter() - t0
+
+    k_small = max(1, n // 5)
+    dt, _ = measure_step_time(window, k_small, n + k_small)
+    return dt
 
 
 def analyze(compiled):
@@ -89,8 +71,8 @@ def report(name, t, flops, byt, peak, gbps, batch):
 
 def main():
     dev = jax.devices()[0]
-    peak = lookup(PEAK, dev.device_kind)
-    gbps = lookup(HBM_GBPS, dev.device_kind)
+    peak = lookup_device_table(PEAK_FLOPS)
+    gbps = lookup_device_table(HBM_GBPS)
     peak_s = f"{peak/1e12:.0f} TFLOP/s" if peak else "unknown"
     print(f"device: {dev.device_kind} ({dev.platform}); peak bf16 "
           f"{peak_s}, HBM {gbps} GB/s", flush=True)

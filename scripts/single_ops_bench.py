@@ -35,15 +35,27 @@ import numpy as np
 import bluefog_tpu as bf
 
 
+from bench import measure_step_time, scalar_fetch  # noqa: E402
+
+
 def timeit(fn, *args, iters=30, warmup=5):
+    """Shared two-window-differencing timer (see bench.measure_step_time)."""
+    out = None
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    scalar_fetch(out)
+
+    def window(k):
+        o = out
+        t0 = time.perf_counter()
+        for _ in range(k):
+            o = fn(*args)
+        scalar_fetch(o)
+        return time.perf_counter() - t0
+
+    k_small = max(1, iters // 5)
+    dt, _ = measure_step_time(window, k_small, iters + k_small)
+    return dt
 
 
 def main():
