@@ -26,7 +26,7 @@ import optax
 import bluefog_tpu as bf
 from bluefog_tpu.models.transformer import TransformerLM
 from bench import (peak_flops_per_chip,  # noqa: E402  (shared peak table)
-                   measure_step_time)
+                   measure_step_time_amortized)
 
 
 def main():
@@ -95,7 +95,8 @@ def main():
         return time.perf_counter() - t0
 
     k_small = max(1, args.iters // 5)
-    dt, _ = measure_step_time(window, k_small, args.iters + k_small)
+    dt, _, _ = measure_step_time_amortized(window, k_small,
+                                           args.iters + k_small)
 
     toks = args.batch_size * args.seq_len
     print(f"step: {dt * 1e3:.1f} ms   {toks / dt:,.0f} tokens/sec   "

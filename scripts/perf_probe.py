@@ -26,7 +26,7 @@ import bluefog_tpu as bf
 from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50
 from bench import (PEAK_FLOPS, HBM_GBPS, lookup_device_table,  # noqa: E402
-                   measure_step_time, scalar_fetch)
+                   measure_step_time_amortized, scalar_fetch)
 
 
 def timeit(fn, *args, n=10, warmup=3):
@@ -43,7 +43,7 @@ def timeit(fn, *args, n=10, warmup=3):
         return time.perf_counter() - t0
 
     k_small = max(1, n // 5)
-    dt, _ = measure_step_time(window, k_small, n + k_small)
+    dt, _, _ = measure_step_time_amortized(window, k_small, n + k_small)
     return dt
 
 

@@ -35,7 +35,7 @@ import numpy as np
 import bluefog_tpu as bf
 
 
-from bench import measure_step_time, scalar_fetch  # noqa: E402
+from bench import measure_step_time_amortized, scalar_fetch  # noqa: E402
 
 
 def timeit(fn, *args, iters=30, warmup=5):
@@ -54,7 +54,7 @@ def timeit(fn, *args, iters=30, warmup=5):
         return time.perf_counter() - t0
 
     k_small = max(1, iters // 5)
-    dt, _ = measure_step_time(window, k_small, iters + k_small)
+    dt, _, _ = measure_step_time_amortized(window, k_small, iters + k_small)
     return dt
 
 
