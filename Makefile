@@ -1,0 +1,50 @@
+# Test/bench driver (reference counterpart: Makefile, whose targets run
+# `mpirun -np N pytest test/<file>`; here the "cluster" is the virtual
+# 8-device CPU mesh the test conftest builds, overridable like the
+# reference's NUM_PROC).
+#
+#   make test               # full suite on the virtual mesh
+#   make test NUM_DEVICES=4 # smaller mesh (CI matrix leg)
+#   make test_ops           # collectives only
+#   make test_win           # one-sided window ops
+#   make test_optimizer     # optimizer convergence suite
+#   make test_torch         # torch frontend
+#   make examples           # smoke-run every example (run_all_examples.sh)
+#   make bench              # headline benchmark (real TPU if available)
+
+NUM_DEVICES ?= 8
+PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
+
+.PHONY: test test_basics test_ops test_win test_optimizer \
+        test_hierarchical test_torch test_attention examples bench
+
+test:
+	$(PYTEST) tests/
+
+test_basics:
+	$(PYTEST) tests/test_basics.py tests/test_topology.py
+
+test_ops:
+	$(PYTEST) tests/test_ops.py tests/test_weighted_modes.py \
+	          tests/test_irregular.py
+
+test_win:
+	$(PYTEST) tests/test_win_ops.py
+
+test_optimizer:
+	$(PYTEST) tests/test_optimizers.py tests/test_training.py
+
+test_hierarchical:
+	$(PYTEST) tests/test_hierarchical.py
+
+test_torch:
+	$(PYTEST) tests/test_torch_frontend.py
+
+test_attention:
+	$(PYTEST) tests/test_flash_attention.py tests/test_ring_attention.py
+
+examples:
+	bash scripts/run_all_examples.sh
+
+bench:
+	python bench.py

@@ -79,7 +79,14 @@ class TimingJitterError(RuntimeError):
 
     A dedicated type so callers can catch exactly this — jaxlib's
     XlaRuntimeError subclasses RuntimeError, and a bare ``except
-    RuntimeError`` would misclassify real device failures as jitter."""
+    RuntimeError`` would misclassify real device failures as jitter.
+    Carries the raw large-window timings so a fallback can reuse them
+    instead of re-running steps."""
+
+    def __init__(self, msg, large_window_times=(), k_large=0):
+        super().__init__(msg)
+        self.large_window_times = list(large_window_times)
+        self.k_large = k_large
 
 
 def measure_step_time(window, k_small, k_large, pairs=3):
@@ -94,17 +101,19 @@ def measure_step_time(window, k_small, k_large, pairs=3):
     if k_large <= k_small:
         raise ValueError(f"k_large ({k_large}) must exceed "
                          f"k_small ({k_small})")
-    est = []
+    est, larges = [], []
     for _ in range(pairs):
         t_l = window(k_large)
         t_s = window(k_small)
+        larges.append(t_l)
         est.append((t_l - t_s) / (k_large - k_small))
     est.sort()
     dt = est[len(est) // 2]
     if dt <= 0:
         raise TimingJitterError(
             f"non-positive step-time estimates {est}: transport jitter "
-            "dominated the timing windows; rerun with larger windows")
+            "dominated the timing windows; rerun with larger windows",
+            large_window_times=larges, k_large=k_large)
     return dt, est
 
 
@@ -115,10 +124,13 @@ def measure_step_time_amortized(window, k_small, k_large, pairs=3):
     try:
         dt, est = measure_step_time(window, k_small, k_large, pairs)
         return dt, est, False
-    except TimingJitterError:
+    except TimingJitterError as e:
         print("timing jitter dominated the differencing windows; "
               "falling back to the amortized estimate", file=sys.stderr)
-        t = window(k_large) / k_large
+        # reuse the large windows already measured (median rejects the
+        # stalled ones) instead of burning more device time
+        ts = sorted(e.large_window_times)
+        t = ts[len(ts) // 2] / e.k_large
         return t, [t], True
 
 
