@@ -16,7 +16,7 @@ NUM_DEVICES ?= 8
 PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_basics test_ops test_win test_optimizer \
-        test_hierarchical test_torch test_attention examples bench
+        test_hierarchical test_torch test_attention examples bench hwcheck
 
 test:
 	$(PYTEST) tests/
@@ -48,3 +48,8 @@ examples:
 
 bench:
 	python bench.py
+
+# compile+run every Pallas kernel on the real chip (interpret mode does
+# not enforce TPU tiling — see docs/performance.md, round-2 lesson)
+hwcheck:
+	python scripts/hw_kernel_check.py
