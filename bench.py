@@ -117,6 +117,30 @@ def measure_step_time(window, k_small, k_large, pairs=3):
     return dt, est
 
 
+def timeit_amortized(fn, n=10, warmup=3, pairs=3):
+    """Time one call of ``fn`` (thunk returning a device value) with the
+    two-window-differencing protocol; the single shared implementation for
+    the benchmark scripts."""
+    import time as _time
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    scalar_fetch(out)
+
+    def window(k):
+        o = out
+        t0 = _time.perf_counter()
+        for _ in range(k):
+            o = fn()
+        scalar_fetch(o)
+        return _time.perf_counter() - t0
+
+    k_small = max(1, n // 5)
+    dt, _, _ = measure_step_time_amortized(window, k_small, n + k_small,
+                                           pairs=pairs)
+    return dt
+
+
 def measure_step_time_amortized(window, k_small, k_large, pairs=3):
     """measure_step_time, degrading to the amortized large-window estimate
     (which includes one fetch RTT per window — conservative) when jitter

@@ -13,7 +13,6 @@ device time), so every timed window ends by fetching one float.
 
 import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -26,25 +25,11 @@ import bluefog_tpu as bf
 from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50
 from bench import (PEAK_FLOPS, HBM_GBPS, lookup_device_table,  # noqa: E402
-                   measure_step_time_amortized, scalar_fetch)
+                   timeit_amortized)
 
 
 def timeit(fn, *args, n=10, warmup=3):
-    """Shared two-window-differencing timer (see bench.measure_step_time)."""
-    for _ in range(warmup):
-        out = fn(*args)
-    scalar_fetch(out)
-
-    def window(k):
-        t0 = time.perf_counter()
-        for _ in range(k):
-            out = fn(*args)
-        scalar_fetch(out)
-        return time.perf_counter() - t0
-
-    k_small = max(1, n // 5)
-    dt, _, _ = measure_step_time_amortized(window, k_small, n + k_small)
-    return dt
+    return timeit_amortized(lambda: fn(*args), n=n, warmup=warmup)
 
 
 def analyze(compiled):

@@ -15,7 +15,6 @@ Usage:
 import argparse
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -35,27 +34,11 @@ import numpy as np
 import bluefog_tpu as bf
 
 
-from bench import measure_step_time_amortized, scalar_fetch  # noqa: E402
+from bench import timeit_amortized  # noqa: E402
 
 
 def timeit(fn, *args, iters=30, warmup=5):
-    """Shared two-window-differencing timer (see bench.measure_step_time)."""
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    scalar_fetch(out)
-
-    def window(k):
-        o = out
-        t0 = time.perf_counter()
-        for _ in range(k):
-            o = fn(*args)
-        scalar_fetch(o)
-        return time.perf_counter() - t0
-
-    k_small = max(1, iters // 5)
-    dt, _, _ = measure_step_time_amortized(window, k_small, iters + k_small)
-    return dt
+    return timeit_amortized(lambda: fn(*args), n=iters, warmup=warmup)
 
 
 def main():
