@@ -160,3 +160,29 @@ def test_best_attention_dispatches_to_reference_on_cpu():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(attention(q, k, v, causal=True)),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_block_fit_shrinks_oversized_defaults():
+    """_fit_block: 128-granular (not 512-granular) lengths keep working
+    with the 512 defaults by shrinking the block by powers of two (r2
+    hardware finding: defaults were raised for grid-overhead reasons and
+    must not drop coverage)."""
+    from bluefog_tpu.ops.flash_attention import _fit_block
+    assert _fit_block(768, 512) == 256
+    assert _fit_block(4096, 512) == 512
+    assert _fit_block(640, 512) == 128
+    assert _fit_block(64, 512) == 64
+    # whole-length block: legal on hardware (block dim == array dim)
+    assert _fit_block(100, 512) == 100
+    # non-divisible with a smaller cap: bottoms out at the sublane
+    # minimum, and _check_blocks then rejects (see
+    # test_rejects_non_divisible_lengths)
+    assert _fit_block(100, 64) == 8
+
+    ks = jax.random.split(jax.random.key(5), 3)
+    q, k, v = (jax.random.normal(kk, (1, 384, 2, 32), jnp.float32)
+               for kk in ks)
+    ref = attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
