@@ -179,3 +179,27 @@ def test_lm_sequence_parallel_matches_single_device(bf_ctx):
     for a, b in zip(jax.tree.leaves(params_sp), jax.tree.leaves(params_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_lm_remat_matches_non_remat(bf_ctx):
+    """remat=True must change memory, not math: identical logits and
+    gradients (jax.checkpoint recomputes the same forward)."""
+    kwargs = dict(vocab_size=32, num_layers=2, num_heads=4, embed_dim=32,
+                  max_len=64, dtype=jnp.float32)
+    base = TransformerLM(**kwargs)
+    remat = TransformerLM(remat=True, **kwargs)
+    tokens = jax.random.randint(jax.random.key(9), (2, 64), 0, 32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = base.init(jax.random.key(10), tokens)["params"]
+
+    def loss(model, p):
+        logits = model.apply({"params": p}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
