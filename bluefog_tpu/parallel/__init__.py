@@ -15,6 +15,11 @@ _LAZY = {
     "pp_mesh": ("pipeline", "pp_mesh"),
     "stack_block_params": ("pipeline", "stack_block_params"),
     "unstack_block_params": ("pipeline", "unstack_block_params"),
+    "fsdp": ("fsdp", None),
+    "make_fsdp_lm_train_step": ("fsdp", "make_fsdp_lm_train_step"),
+    "fsdp_mesh": ("fsdp", "fsdp_mesh"),
+    "fsdp_specs": ("fsdp", "fsdp_specs"),
+    "shard_params_fsdp": ("fsdp", "shard_params_fsdp"),
 }
 
 

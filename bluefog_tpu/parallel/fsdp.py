@@ -1,0 +1,149 @@
+"""FSDP / ZeRO-3-style fully-sharded data parallelism via GSPMD.
+
+No reference counterpart (SURVEY.md §2.6: "FSDP/ZeRO sharding — NO");
+built because it completes the TPU scaling matrix next to TP/PP/SP/EP:
+parameters, gradients, and optimizer state are **sharded over the data
+axis**, so per-chip state memory scales 1/N while the batch stays
+data-parallel.
+
+The idiomatic TPU implementation is declarative, like ``parallel/tensor``:
+each parameter leaf is placed with a ``NamedSharding`` that splits its
+largest divisible dimension over the ``dp`` axis, and XLA's SPMD
+partitioner derives the ZeRO-3 schedule from the shardings alone — an
+all-gather of each weight right before use (forward and again in the
+backward), a reduce-scatter of its gradient, and a fully sharded optimizer
+update, with no hand-written collectives.  Optimizer-state subtrees that
+mirror the params tree (optax mu/nu/trace) inherit the same specs, which
+is exactly the ZeRO-3 optimizer-state partition.
+
+Composes with the model-side levers: ``TransformerLM(remat=True)`` trades
+the gathered activations back for FLOPs, and the flash kernel keeps
+attention O(T) — together the classic long-context/large-model recipe.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["fsdp_specs", "fsdp_mesh", "shard_params_fsdp",
+           "make_fsdp_lm_train_step"]
+
+
+def fsdp_mesh(dp: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D ``("dp",)`` mesh over ``dp`` devices (default: all)."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if dp is not None:
+        if devices.size < dp:
+            raise ValueError(f"need {dp} devices, have {devices.size}")
+        devices = devices[:dp]
+    return Mesh(devices, ("dp",))
+
+
+def _leaf_spec(leaf, n: int, axis: str) -> P:
+    """Split the largest dimension divisible by ``n`` (ties -> lowest
+    index); replicate leaves with no such dimension (scalars, norms,
+    biases smaller than the mesh)."""
+    dims = [(size, i) for i, size in enumerate(leaf.shape)
+            if size % n == 0 and size >= n]
+    if not dims:
+        return P()
+    _, best = max(dims, key=lambda t: (t[0], -t[1]))
+    spec = [None] * leaf.ndim
+    spec[best] = axis
+    return P(*spec)
+
+
+def fsdp_specs(params, mesh: Mesh, axis: str = "dp"):
+    """PartitionSpec pytree: every leaf sharded over ``axis`` along its
+    largest divisible dimension."""
+    n = mesh.shape[axis]
+    return jax.tree.map(lambda leaf: _leaf_spec(leaf, n, axis), params)
+
+
+def shard_params_fsdp(params, mesh: Mesh, axis: str = "dp"):
+    """Place a replicated params tree fully sharded over the mesh."""
+    specs = fsdp_specs(params, mesh, axis)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs)
+
+
+def _opt_specs(opt_state, params, specs):
+    """PartitionSpec tree for an optimizer state: subtrees that mirror the
+    params tree structure (optax mu/nu/trace are exact structural copies)
+    get the parameter specs — the ZeRO-3 optimizer partition — and
+    everything else replicates.  Structural matching, same policy as
+    parallel/tensor's _shard_like."""
+    pstruct = jax.tree.structure(params)
+
+    def is_mirror(node):
+        try:
+            return jax.tree.structure(node) == pstruct
+        except Exception:
+            return False
+
+    def spec_tree(node):
+        if is_mirror(node):
+            return specs
+        return jax.tree.map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(spec_tree, opt_state, is_leaf=is_mirror)
+
+
+def make_fsdp_lm_train_step(model, base_opt: optax.GradientTransformation,
+                            mesh: Mesh, donate: bool = True):
+    """Fully-sharded data-parallel LM train step on a ``("dp",)`` mesh.
+
+    Tokens/targets ``[B, T]`` are batch-sharded over ``dp``; every
+    parameter / gradient / optimizer-state leaf is sharded by
+    :func:`fsdp_specs`.  The step is a plain jitted ``value_and_grad``
+    whose output shardings pin the updated state to the same specs, so
+    XLA emits the ZeRO-3 schedule (per-weight all-gather at use,
+    gradient reduce-scatter, sharded update) rather than replicating.
+
+    Returns ``(step_fn, place_fn)``: ``place_fn(params, opt_state)``
+    shards a freshly initialized state; ``step_fn(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss)``.
+    """
+    from .tensor import _shard_like
+
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    def place(params, opt_state):
+        specs = fsdp_specs(params, mesh)
+        sharded = jax.tree.map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(mesh, spec)), params, specs)
+        return sharded, _shard_like(opt_state, params, mesh, specs=specs)
+
+    def _loss(p, tokens, targets):
+        logits = model.apply({"params": p}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    def _constrain(tree, specs):
+        return jax.tree.map(
+            lambda leaf, spec: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)), tree, specs)
+
+    def step(params, opt_state, tokens, targets):
+        specs = fsdp_specs(params, mesh)
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
+        targets = jax.lax.with_sharding_constraint(targets, data_sharding)
+        loss, grads = jax.value_and_grad(_loss)(params, tokens, targets)
+        # pin gradients to the parameter shardings: this is the
+        # reduce-scatter — without it XLA may all-reduce to replicated
+        grads = _constrain(grads, specs)
+        updates, opt_state = base_opt.update(grads, opt_state, params)
+        new_params = _constrain(optax.apply_updates(params, updates), specs)
+        # pin the optimizer state too: mu/nu must come out ZeRO-3-sharded,
+        # or the state memory saving is lost and step 2 recompiles
+        opt_state = _constrain(opt_state,
+                               _opt_specs(opt_state, params, specs))
+        return new_params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ()), place

@@ -210,12 +210,15 @@ def make_decentralized_tp_lm_train_step(
     return jitted, place
 
 
-def _shard_like(opt_state, params, mesh, tp_axis: str = "tp"):
+def _shard_like(opt_state, params, mesh, tp_axis: str = "tp", specs=None):
     """Shard optimizer-state subtrees that mirror the params tree structure
     (optax mu/nu/trace are exact structural copies) with the parameter
     specs; everything else replicates.  Structural matching — never by
-    shape, which is ambiguous when two params share one shape."""
-    specs = transformer_tp_rules(params, tp_axis)
+    shape, which is ambiguous when two params share one shape.
+
+    ``specs`` overrides the TP rules (parallel/fsdp passes its own)."""
+    if specs is None:
+        specs = transformer_tp_rules(params, tp_axis)
     pstruct = jax.tree.structure(params)
 
     def is_mirror(node):
