@@ -20,6 +20,9 @@ _LAZY = {
     "fsdp_mesh": ("fsdp", "fsdp_mesh"),
     "fsdp_specs": ("fsdp", "fsdp_specs"),
     "shard_params_fsdp": ("fsdp", "shard_params_fsdp"),
+    "make_decentralized_fsdp_lm_train_step":
+        ("fsdp", "make_decentralized_fsdp_lm_train_step"),
+    "dfsdp_mesh": ("fsdp", "dfsdp_mesh"),
 }
 
 

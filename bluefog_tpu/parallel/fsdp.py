@@ -30,7 +30,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["fsdp_specs", "fsdp_mesh", "shard_params_fsdp",
-           "make_fsdp_lm_train_step"]
+           "make_fsdp_lm_train_step",
+           "make_decentralized_fsdp_lm_train_step", "dfsdp_mesh"]
 
 
 def fsdp_mesh(dp: Optional[int] = None, devices=None) -> Mesh:
@@ -72,28 +73,6 @@ def shard_params_fsdp(params, mesh: Mesh, axis: str = "dp"):
         params, specs)
 
 
-def _opt_specs(opt_state, params, specs):
-    """PartitionSpec tree for an optimizer state: subtrees that mirror the
-    params tree structure (optax mu/nu/trace are exact structural copies)
-    get the parameter specs — the ZeRO-3 optimizer partition — and
-    everything else replicates.  Structural matching, same policy as
-    parallel/tensor's _shard_like."""
-    pstruct = jax.tree.structure(params)
-
-    def is_mirror(node):
-        try:
-            return jax.tree.structure(node) == pstruct
-        except Exception:
-            return False
-
-    def spec_tree(node):
-        if is_mirror(node):
-            return specs
-        return jax.tree.map(lambda _: P(), node)
-
-    return jax.tree_util.tree_map(spec_tree, opt_state, is_leaf=is_mirror)
-
-
 def make_fsdp_lm_train_step(model, base_opt: optax.GradientTransformation,
                             mesh: Mesh, donate: bool = True):
     """Fully-sharded data-parallel LM train step on a ``("dp",)`` mesh.
@@ -109,7 +88,7 @@ def make_fsdp_lm_train_step(model, base_opt: optax.GradientTransformation,
     shards a freshly initialized state; ``step_fn(params, opt_state,
     tokens, targets) -> (params, opt_state, loss)``.
     """
-    from .tensor import _shard_like
+    from .tensor import _mirror_specs, _shard_like
 
     data_sharding = NamedSharding(mesh, P("dp", None))
 
@@ -143,7 +122,44 @@ def make_fsdp_lm_train_step(model, base_opt: optax.GradientTransformation,
         # pin the optimizer state too: mu/nu must come out ZeRO-3-sharded,
         # or the state memory saving is lost and step 2 recompiles
         opt_state = _constrain(opt_state,
-                               _opt_specs(opt_state, params, specs))
+                               _mirror_specs(opt_state, params, specs))
         return new_params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ()), place
+
+
+def dfsdp_mesh(dp: int, fsdp: int, devices=None) -> Mesh:
+    """A ``(dp, fsdp)`` mesh: ``dp`` decentralized replicas, each fully
+    sharded over ``fsdp`` ICI-adjacent chips (the trailing axis)."""
+    devices = np.asarray(devices if devices is not None
+                         else jax.devices()[: dp * fsdp])
+    if devices.size != dp * fsdp:
+        raise ValueError(f"need {dp * fsdp} devices, have {devices.size}")
+    return Mesh(devices.reshape(dp, fsdp), ("dp", "fsdp"))
+
+
+def make_decentralized_fsdp_lm_train_step(
+        model, base_opt: optax.GradientTransformation, mesh: Mesh,
+        topo=None, sched=None, donate: bool = True):
+    """Decentralized DP composed with FSDP on ONE ``(dp, fsdp)`` mesh.
+
+    Sibling of ``tensor.make_decentralized_tp_lm_train_step`` (same
+    [dp, ...] global view, same reference CTA semantics, same shared
+    builder), with ZeRO-3 sharding inside each replica instead of
+    Megatron TP: the ``dp`` axis runs BlueFog-style neighbor averaging of
+    parameters (static ``topo`` or dynamic ``sched``), while every
+    replica's params / grads / optimizer state shard over ``fsdp``.
+    Averaging is elementwise, so each (dp, fsdp) cell exchanges only its
+    own 1/fsdp shard — the decentralized traffic shrinks with the
+    sharding, exactly like the ×tp composition.
+
+    Returns ``(step_fn, place_fn)`` with ``step_fn(params, opt_state,
+    tokens, targets, step) -> (params, opt_state, loss)``;
+    ``tokens``/``targets`` are [dp, B_local, T]; parameter leaves carry a
+    leading replica axis [dp, *shape].
+    """
+    from .tensor import make_decentralized_sharded_lm_train_step
+    return make_decentralized_sharded_lm_train_step(
+        model, base_opt, mesh,
+        lambda p: fsdp_specs(p, mesh, axis="fsdp"),
+        topo=topo, sched=sched, donate=donate)

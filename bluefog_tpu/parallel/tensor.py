@@ -32,7 +32,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["transformer_tp_rules", "shard_params", "make_tp_lm_train_step",
-           "make_decentralized_tp_lm_train_step", "tp_mesh"]
+           "make_decentralized_tp_lm_train_step",
+           "make_decentralized_sharded_lm_train_step", "tp_mesh"]
 
 # (path regex, PartitionSpec factory given tp axis name); first match wins
 _TP_RULES = [
@@ -143,6 +144,23 @@ def make_decentralized_tp_lm_train_step(
     ``step_fn(params, opt_state, tokens, targets, step) -> (params,
     opt_state, loss)``; ``tokens``/``targets`` are [dp, B_local, T].
     """
+    return make_decentralized_sharded_lm_train_step(
+        model, base_opt, mesh, transformer_tp_rules,
+        topo=topo, sched=sched, donate=donate)
+
+
+def make_decentralized_sharded_lm_train_step(
+        model, base_opt: optax.GradientTransformation, mesh: Mesh,
+        inner_specs_fn, topo=None, sched=None, donate: bool = True):
+    """Shared core of the decentralized-dp x {tp, fsdp} compositions.
+
+    ``inner_specs_fn(params_single) -> spec tree`` supplies the
+    within-replica shardings (Megatron rules for x tp, largest-divisible
+    -dim ZeRO specs for x fsdp); the builder adds the leading ``dp``
+    replica axis, places/pins params AND mirror optimizer state, runs the
+    reference CTA step per replica, and neighbor-averages the parameter
+    shards over ``dp`` inside a shard_map.
+    """
     from ..ops import collectives as C
 
     if (topo is None) == (sched is None):
@@ -150,12 +168,14 @@ def make_decentralized_tp_lm_train_step(
     dp = mesh.shape["dp"]
 
     def _dp_specs(params):
-        inner = transformer_tp_rules(jax.tree.map(lambda a: a[0], params))
-        return jax.tree.map(lambda spec: P("dp", *spec), inner)
+        inner = inner_specs_fn(jax.tree.map(lambda a: a[0], params))
+        return jax.tree.map(lambda spec: P("dp", *spec), inner,
+                            is_leaf=lambda x: isinstance(x, P))
 
     def place(params_single):
         """Tile a single-replica params tree to [dp, ...] and shard it;
-        returns freshly initialized per-replica optimizer state."""
+        returns freshly initialized (and identically sharded) per-replica
+        optimizer state."""
         gparams = jax.tree.map(
             lambda a: jnp.broadcast_to(a[None], (dp,) + a.shape),
             params_single)
@@ -164,7 +184,7 @@ def make_decentralized_tp_lm_train_step(
             lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
             gparams, specs)
         gopt = jax.jit(jax.vmap(base_opt.init))(gparams)
-        return gparams, gopt
+        return gparams, _shard_like(gopt, gparams, mesh, specs=specs)
 
     def _loss(p, tokens, targets):
         def one(p_, tok, tgt):
@@ -174,7 +194,7 @@ def make_decentralized_tp_lm_train_step(
         return jax.vmap(one)(p, tokens, targets)     # [dp] per-replica loss
 
     def _mix(params, step):
-        """Decentralized neighbor averaging over the dp axis, per tp cell."""
+        """Decentralized neighbor averaging over the dp axis, per cell."""
         specs = _dp_specs(params)
 
         def body(p_shard, step_s):
@@ -190,8 +210,14 @@ def make_decentralized_tp_lm_train_step(
             body, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
         )(params, step)
 
+    def _constrain(tree, specs):
+        return jax.tree.map(
+            lambda leaf, spec: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)), tree, specs)
+
     def step_fn(params, opt_state, tokens, targets, step=0):
         step = jnp.asarray(step, jnp.int32)
+        specs = _dp_specs(params)
 
         def mean_loss(p):
             return _loss(p, tokens, targets).mean()
@@ -200,8 +226,14 @@ def make_decentralized_tp_lm_train_step(
         # mean over dp scales every replica's grad by 1/dp — undo so each
         # replica applies ITS OWN full gradient (reference CTA semantics)
         grads = jax.tree.map(lambda g: g * dp, grads)
+        grads = _constrain(grads, specs)
         updates, opt_state = jax.vmap(base_opt.update)(grads, opt_state,
                                                        params)
+        # pin the updated optimizer state: mirror subtrees must come out
+        # with the parameter shardings, or the state memory saving is
+        # lost and step 2 recompiles (breaking donation)
+        opt_state = _constrain(opt_state,
+                               _mirror_specs(opt_state, params, specs))
         params = optax.apply_updates(params, updates)
         params = _mix(params, step)
         return params, opt_state, loss
@@ -210,15 +242,12 @@ def make_decentralized_tp_lm_train_step(
     return jitted, place
 
 
-def _shard_like(opt_state, params, mesh, tp_axis: str = "tp", specs=None):
-    """Shard optimizer-state subtrees that mirror the params tree structure
-    (optax mu/nu/trace are exact structural copies) with the parameter
-    specs; everything else replicates.  Structural matching — never by
-    shape, which is ambiguous when two params share one shape.
-
-    ``specs`` overrides the TP rules (parallel/fsdp passes its own)."""
-    if specs is None:
-        specs = transformer_tp_rules(params, tp_axis)
+def _mirror_specs(opt_state, params, specs):
+    """PartitionSpec tree for an optimizer state: subtrees that mirror the
+    params tree structure (optax mu/nu/trace are exact structural copies)
+    get the parameter specs; everything else replicates.  Structural
+    matching — never by shape, which is ambiguous when two params share
+    one shape."""
     pstruct = jax.tree.structure(params)
 
     def is_mirror(node):
@@ -227,13 +256,21 @@ def _shard_like(opt_state, params, mesh, tp_axis: str = "tp", specs=None):
         except Exception:
             return False
 
-    def place(node):
+    def spec_tree(node):
         if is_mirror(node):
-            return jax.tree.map(
-                lambda leaf, spec: jax.device_put(
-                    leaf, NamedSharding(mesh, spec)), node, specs)
-        return jax.tree.map(
-            lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P())),
-            node)
+            return specs
+        return jax.tree.map(lambda _: P(), node)
 
-    return jax.tree_util.tree_map(place, opt_state, is_leaf=is_mirror)
+    return jax.tree_util.tree_map(spec_tree, opt_state, is_leaf=is_mirror)
+
+
+def _shard_like(opt_state, params, mesh, tp_axis: str = "tp", specs=None):
+    """Place an optimizer state with the mirror-matching policy of
+    :func:`_mirror_specs` (``specs`` overrides the TP rules — parallel/fsdp
+    passes its own)."""
+    if specs is None:
+        specs = transformer_tp_rules(params, tp_axis)
+    spec_tree = _mirror_specs(opt_state, params, specs)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        opt_state, spec_tree)
