@@ -53,6 +53,10 @@ def main():
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--attn", choices=["ring", "ulysses"], default="ring")
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks in the backward pass "
+                        "(O(1)-block activation memory — pair with long "
+                        "--seq-len)")
     args = p.parse_args()
 
     bf.init()
@@ -62,7 +66,8 @@ def main():
 
     model = TransformerLM(vocab_size=args.vocab, num_layers=args.layers,
                           num_heads=args.heads, embed_dim=args.dim,
-                          max_len=args.seq_len, dtype=jnp.float32)
+                          max_len=args.seq_len, dtype=jnp.float32,
+                          remat=args.remat)
     corpus = synthetic_corpus(args.vocab,
                               args.batch_size * (args.seq_len + 1) * 4)
 

@@ -31,5 +31,6 @@ run resnet-tiny        examples/resnet.py --model ResNet18 --epochs 1 --steps-pe
 run bench-tiny         examples/benchmark.py --model ResNet18 --batch-size 4 --image-size 64 --num-iters 2 --num-batches-per-iter 2 --num-warmup-batches 2 --dtype float32
 run lm-ring            examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1
 run lm-ulysses         examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --attn ulysses
+run lm-remat           examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --remat
 
 echo "ALL EXAMPLES PASSED"
