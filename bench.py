@@ -28,6 +28,7 @@ from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50
 
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
+METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
 
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public numbers);
 # the single source for every benchmark script (lm_bench/perf_probe/
@@ -158,6 +159,31 @@ def measure_step_time_amortized(window, k_small, k_large, pairs=3):
         return t, [t], True
 
 
+def _init_watchdog(seconds: int):
+    """Fail fast (one readable JSON error line) if the accelerator
+    backend hangs during init — a tunneled transport outage otherwise
+    hangs the whole benchmark run silently inside the first RPC.  A
+    daemon thread + os._exit, because a signal handler cannot interrupt
+    a main thread stuck inside a native blocking call."""
+    import threading
+
+    done = threading.Event()
+    if seconds <= 0:          # conventional 'no timeout' semantics
+        return done.set
+
+    def _watch():
+        if not done.wait(seconds):
+            print(json.dumps({
+                "metric": METRIC,
+                "value": 0.0, "unit": "img/sec/chip", "vs_baseline": 0.0,
+                "error": f"accelerator backend unreachable "
+                         f"(init exceeded {seconds}s)"}), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    return done.set
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
@@ -179,7 +205,9 @@ def main():
               "BENCH_WINDOW_SMALL/BENCH_WINDOW_LARGE window differencing",
               file=sys.stderr)
 
+    cancel = _init_watchdog(int(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
     bf.init()
+    cancel()
     n = bf.size()
 
     sched = None
@@ -261,7 +289,7 @@ def main():
     total = float(np.mean(rates))
     per_chip = total / n
     out = {
-        "metric": "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(per_chip, 1),
         "unit": "img/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_ACCEL, 3),
