@@ -59,6 +59,12 @@ _STAT_LANES = 128
 
 
 def _interp(flag):
+    # The TPU-simulating interpreter (the only one that supports these
+    # kernels under shard_map — the generic HLO interpreter trips
+    # varying-manual-axes checks).  NOTE its shared-memory/DMA simulation
+    # cost explodes when per-shard sequence blocks exceed one sublane
+    # tile on multi-device meshes; keep interpret-mode tests at
+    # 8-row-per-shard shapes (see tests/test_ring_attention.py).
     return pltpu.InterpretParams() if flag else False
 
 
