@@ -126,6 +126,8 @@ def timeit_amortized(fn, n=10, warmup=3, pairs=3):
     out = None
     for _ in range(warmup):
         out = fn()
+    if out is None:          # warmup=0: still need a value for the barrier
+        out = fn()
     scalar_fetch(out)
 
     def window(k):
@@ -276,9 +278,12 @@ def main():
         _ = float(loss)  # scalar fetch as execution barrier
         return time.perf_counter() - t0
 
-    _, step_times, amortized = measure_step_time_amortized(
+    dt, step_times, amortized = measure_step_time_amortized(
         timed_window, k_small, k_large, pairs=iters)
     timing = "amortized-fallback" if amortized else "two-window-differenced"
+    # headline value uses the jitter-robust median step time dt; the
+    # per-pair rates feed only the stdev field (asymmetric filtering of
+    # non-positive pairs would bias a mean upward)
     rates = [batch * n / t for t in step_times if t > 0]
 
     if ckpt is not None:
@@ -286,8 +291,7 @@ def main():
                   force=True)
         ckpt.close()
 
-    total = float(np.mean(rates))
-    per_chip = total / n
+    per_chip = batch / dt
     out = {
         "metric": METRIC,
         "value": round(per_chip, 1),
@@ -300,16 +304,15 @@ def main():
         "timing": timing,
     }
     if len(rates) > 1:
-        # mean +- stdev across timed windows, like the reference harness;
-        # omitted for the single-sample amortized fallback (a 0.0 there
-        # would misread as perfect precision)
+        # spread of the per-window rates around the median-derived
+        # headline; omitted for the single-sample amortized fallback (a
+        # 0.0 there would misread as perfect precision)
         out["stdev"] = round(float(np.std(rates)) / n, 1)
     peak = peak_flops_per_chip()
     if step_flops and peak:
         # achieved fraction of the chip's peak bf16 FLOP/s (MFU);
         # step_flops is per-device (post-SPMD-partitioning HLO)
-        sec_per_step = batch / per_chip
-        out["mfu_pct"] = round(step_flops / sec_per_step / peak * 100, 1)
+        out["mfu_pct"] = round(step_flops / dt / peak * 100, 1)
     print(json.dumps(out))
 
 

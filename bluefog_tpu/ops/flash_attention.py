@@ -387,6 +387,13 @@ def _check_blocks(Tq, Tk, block_q, block_k):
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) must be divisible by the block "
             f"sizes ({block_q}, {block_k})")
+    # a PARTIAL block (block < T) must be sublane-aligned; a whole-length
+    # block rides the 'block dim == array dim' tiling exemption instead
+    for blk, T, name in ((block_q, Tq, "block_q"), (block_k, Tk, "block_k")):
+        if blk < T and blk % 8:
+            raise ValueError(
+                f"{name}={blk} tiles a longer sequence ({T}) and must be a "
+                f"multiple of 8 (TPU sublane)")
     return block_q, block_k
 
 

@@ -113,6 +113,20 @@ def flash_odd_length():
     assert err < 5e-2, f"err {err}"
 
 
+def flash_whole_odd_length():
+    # T=100: not a multiple of 8, so the single whole-length block rides
+    # the 'block dim == array dim' tiling exemption — prove that lowers
+    # (flash_supported keeps auto-dispatch off such shapes; this covers
+    # direct calls)
+    from bluefog_tpu.ops.flash_attention import flash_attention
+    rng = np.random.default_rng(5)
+    qn, kn, vn = (rng.normal(size=(1, 100, 2, 64)) for _ in range(3))
+    q, k, v = (jnp.asarray(a, jnp.float32) for a in (qn, kn, vn))
+    o = np.asarray(flash_attention(q, k, v, causal=False), np.float64)
+    err = np.abs(o - exact_attention(qn, kn, vn, False)).max()
+    assert err < 5e-2, f"err {err}"
+
+
 def fused_exchange_single_device():
     # degenerate 1-device mesh: checks the kernel LOWERS on hardware
     # (exchange semantics need a multi-chip slice, tested on CPU mesh)
@@ -144,6 +158,7 @@ def main():
     check("flash_attention backward vs XLA grad", flash_backward)
     check("flash_attention lse + traced offsets", flash_lse_offsets)
     check("flash_attention 768-length block fit", flash_odd_length)
+    check("flash_attention 100-length whole block", flash_whole_odd_length)
     check("fused_neighbor_allreduce lowering", fused_exchange_single_device)
     if FAILED:
         print(f"\n{len(FAILED)} kernel check(s) FAILED: {FAILED}")

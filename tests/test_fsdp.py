@@ -31,8 +31,9 @@ def _model_and_data(remat=False):
 def test_specs_shard_every_eligible_leaf():
     model, _, _, params = _model_and_data()
     mesh = fsdp_mesh()
-    # PartitionSpec is a tuple subclass, so specs must be flattened with
-    # is_leaf — plain tree.map would descend into them
+    # is_leaf guards against JAX versions where PartitionSpec flattens as
+    # a container (under the pinned JAX it is already a pytree leaf —
+    # harmless belt-and-braces)
     specs = fsdp_specs(params, mesh)
     leaves = jax.tree.leaves(params)
     spec_leaves = jax.tree.leaves(
