@@ -42,10 +42,15 @@ from .mpi_ops import (
 )
 from .optimizers import (
     register_timeline_hooks,
+    CommunicationType,
     DistributedOptimizer,
     DistributedGradientAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
     DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,
 )
 
@@ -70,9 +75,14 @@ __all__ = [
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
     "register_timeline_hooks",
+    "CommunicationType",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedAdaptThenCombineOptimizer",
+    "DistributedAdaptWithCombineOptimizer",
     "DistributedWinPutOptimizer",
+    "DistributedPullGetOptimizer",
     "DistributedPushSumOptimizer",
 ]

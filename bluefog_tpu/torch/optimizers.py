@@ -26,12 +26,18 @@ import numpy as np
 import torch
 
 from . import mpi_ops as _ops
+from ..optim.strategies import CommunicationType
 
 __all__ = [
+    "CommunicationType",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedAdaptThenCombineOptimizer",
+    "DistributedAdaptWithCombineOptimizer",
     "DistributedWinPutOptimizer",
+    "DistributedPullGetOptimizer",
     "DistributedPushSumOptimizer",
     "register_timeline_hooks",
 ]
@@ -117,24 +123,60 @@ class _GradientAllreduceMixin(_DistributedMixin):
                 p.grad.copy_(_ops.allreduce(p.grad, average=True))
 
 
-class _NeighborAllreduceMixin(_DistributedMixin):
-    """Combine-then-adapt: neighbor-average parameters, then step
-    (reference ``_DistributedReduceOptimizer`` with neighbor_allreduce,
-    torch/optimizers.py:297-482).  Per-step dynamic topologies: assign
-    ``opt.sched``/``opt.step_index`` (mirrors the reference's mutable
-    ``dst_weights`` attributes, optimizers.py:107-109)."""
+class _CombineMixin(_DistributedMixin):
+    """Parameter averaging dispatched by ``communication_type`` — the
+    combine half shared by CTA / AWC / ATC / hierarchical (reference
+    ``_DistributedReduceOptimizer``, torch/optimizers.py:297-482, whose
+    re-class also backs the AWC factory at :1497).  Per-step dynamic
+    topologies: assign ``opt.sched``/``opt.step_index`` (mirrors the
+    reference's mutable ``dst_weights`` attributes, optimizers.py:107-109).
+    """
 
     sched = None
     step_index = 0
+    communication_type = CommunicationType.neighbor_allreduce
 
-    def _bft_communicate(self):
+    def _bft_combine(self):
+        ct = self.communication_type
+        if ct == CommunicationType.empty:
+            return
         kwargs = {}
-        if self.sched is not None:
+        if ct == CommunicationType.neighbor_allreduce and self.sched is not None:
             kwargs = {"sched": self.sched, "step": self.step_index}
         for p in self._bft_params():
             with torch.no_grad():
-                p.copy_(_ops.neighbor_allreduce(p.data, **kwargs))
+                if ct == CommunicationType.allreduce:
+                    p.copy_(_ops.allreduce(p.data, average=True))
+                elif ct == CommunicationType.hierarchical_neighbor_allreduce:
+                    p.copy_(_ops.hierarchical_neighbor_allreduce(p.data))
+                else:
+                    p.copy_(_ops.neighbor_allreduce(p.data, **kwargs))
         self.step_index += 1
+
+    def _bft_communicate(self):
+        self._bft_combine()
+
+
+class _NeighborAllreduceMixin(_CombineMixin):
+    """Combine-then-adapt with neighbor averaging — the flagship
+    decentralized strategy (reference factory torch/optimizers.py:1326)."""
+
+    communication_type = CommunicationType.neighbor_allreduce
+
+
+class _AdaptThenCombineMixin(_CombineMixin):
+    """ATC: the wrapped optimizer's update runs FIRST, then the adapted
+    parameters are averaged (reference
+    ``_DistributedAdaptThenCombineOptimizer``, torch/optimizers.py:485-841;
+    factory :1426).  Same knobs as the combine mixin."""
+
+    def step(self, closure=None):
+        # the wrapped optimizer's own step (skip _DistributedMixin.step)
+        loss = super(_DistributedMixin, self).step(closure)
+        self._bft_tick += 1
+        if self._bft_tick % self._bft_period == 0:
+            self._bft_combine()
+        return loss
 
 
 def _reclass(optimizer: torch.optim.Optimizer, mixin, name: str,
@@ -143,6 +185,18 @@ def _reclass(optimizer: torch.optim.Optimizer, mixin, name: str,
     optimizer.__class__ = cls
     optimizer._bft_setup(num_steps_per_communication)
     return optimizer
+
+
+def _check_sched_comm(sched, communication_type):
+    """Dynamic schedules only ride the neighbor-allreduce combine; accepting
+    one silently with another communication_type would train on the wrong
+    topology."""
+    if sched is not None and \
+            communication_type != CommunicationType.neighbor_allreduce:
+        raise ValueError(
+            f"sched= requires "
+            f"communication_type=CommunicationType.neighbor_allreduce, "
+            f"got {communication_type}")
 
 
 def DistributedGradientAllreduceOptimizer(
@@ -169,12 +223,69 @@ def DistributedNeighborAllreduceOptimizer(
     return opt
 
 
+def DistributedHierarchicalNeighborAllreduceOptimizer(
+        optimizer: torch.optim.Optimizer,
+        num_steps_per_communication: int = 1) -> torch.optim.Optimizer:
+    """CTA with machine-level two-step averaging (reference factory
+    torch/optimizers.py:1352).  Requires a machine topology
+    (``bf.set_machine_topology``) like the reference."""
+    opt = _reclass(optimizer, _CombineMixin,
+                   "DistributedHierarchicalNeighborAllreduceOptimizer",
+                   num_steps_per_communication)
+    opt.communication_type = CommunicationType.hierarchical_neighbor_allreduce
+    return opt
+
+
+def DistributedAdaptThenCombineOptimizer(
+        optimizer: torch.optim.Optimizer,
+        communication_type: CommunicationType =
+        CommunicationType.neighbor_allreduce,
+        num_steps_per_communication: int = 1,
+        sched=None) -> torch.optim.Optimizer:
+    """ATC: local update first, then average the adapted weights
+    (reference factory torch/optimizers.py:1426).  Unlike the reference —
+    which overrides per-parameter step math for a whitelist of optimizers
+    (SGD/Adam/...) to overlap communication — any ``torch.optim.Optimizer``
+    works here: the combine runs as one batched mesh program after the
+    step, so there is no per-parameter hook machinery to special-case."""
+    _check_sched_comm(sched, communication_type)
+    opt = _reclass(optimizer, _AdaptThenCombineMixin,
+                   "DistributedAdaptThenCombineOptimizer",
+                   num_steps_per_communication)
+    opt.communication_type = communication_type
+    opt.sched = sched
+    opt.step_index = 0
+    return opt
+
+
+def DistributedAdaptWithCombineOptimizer(
+        optimizer: torch.optim.Optimizer,
+        communication_type: CommunicationType =
+        CommunicationType.neighbor_allreduce,
+        num_steps_per_communication: int = 1,
+        sched=None) -> torch.optim.Optimizer:
+    """AWC: combine computed from the pre-update weights, concurrently
+    with the update (reference factory torch/optimizers.py:1497 — whose
+    re-class body IS the CTA ``_DistributedReduceOptimizer``; the overlap
+    is scheduling, not different math).  Combine-then-adapt semantics
+    with the full ``communication_type`` knob."""
+    _check_sched_comm(sched, communication_type)
+    opt = _reclass(optimizer, _CombineMixin,
+                   "DistributedAdaptWithCombineOptimizer",
+                   num_steps_per_communication)
+    opt.communication_type = communication_type
+    opt.sched = sched
+    opt.step_index = 0
+    return opt
+
+
 class _WinPutMixin(_DistributedMixin):
     """One-sided push flavor (reference ``_DistributedWinOptimizer`` push
     mode, torch/optimizers.py:844-1023): win_put the parameters to the
     out-neighbors, fold the receive buffers with win_update, then step.
     Per-call weighting via the mutable ``dst_weights`` attribute (global
-    [N, N] matrix), mirroring the reference's per-iteration knobs."""
+    [N, N] matrix), mirroring the reference's per-iteration knobs.
+    Window registration here is shared with the pull flavor subclass."""
 
     dst_weights = None
 
@@ -196,6 +307,27 @@ class _WinPutMixin(_DistributedMixin):
             _ops.win_put_nonblocking(p.data, name,
                                      dst_weights=self.dst_weights)
             for name, p in zip(self._bft_names, self._bft_params())]
+        for h in handles:
+            _ops.win_wait(h)
+        for name, p in zip(self._bft_names, self._bft_params()):
+            with torch.no_grad():
+                p.copy_(_ops.win_update(name, require_mutex=True))
+
+
+class _PullGetMixin(_WinPutMixin):
+    """One-sided pull flavor (reference ``_DistributedWinOptimizer`` pull
+    mode, torch/optimizers.py:844-1023; factory :1225): publish the local
+    parameters into the window, win_get from the (dynamic) in-neighbors,
+    fold the receive buffers with win_update, then step.  Per-call
+    weighting via the mutable ``src_weights`` attribute."""
+
+    src_weights = None
+
+    def _bft_communicate(self):
+        for name, p in zip(self._bft_names, self._bft_params()):
+            _ops.win_publish(name, p.data)
+        handles = [_ops.win_get_nonblocking(name, src_weights=self.src_weights)
+                   for name in self._bft_names]
         for h in handles:
             _ops.win_wait(h)
         for name, p in zip(self._bft_names, self._bft_params()):
@@ -280,6 +412,19 @@ def DistributedWinPutOptimizer(optimizer: torch.optim.Optimizer,
     return opt
 
 
+def DistributedPullGetOptimizer(optimizer: torch.optim.Optimizer,
+                                window_prefix: str = "pull_get_opt",
+                                num_steps_per_communication: int = 1
+                                ) -> torch.optim.Optimizer:
+    """Re-class ``optimizer`` for the one-sided pull strategy (reference
+    factory torch/optimizers.py:1225).  Windows are created immediately;
+    call ``opt._bft_free_windows()`` to release them."""
+    opt = _reclass(optimizer, _PullGetMixin, "DistributedPullGetOptimizer",
+                   num_steps_per_communication)
+    opt._bft_register_windows(window_prefix)
+    return opt
+
+
 def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
                                 window_prefix: str = "push_sum_opt",
                                 num_steps_per_communication: int = 1
@@ -307,6 +452,9 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
             optimizer, num_steps_per_communication, sched)
     elif communication in ("allreduce", "gradient_allreduce"):
         opt = DistributedGradientAllreduceOptimizer(
+            optimizer, num_steps_per_communication)
+    elif communication == "hierarchical_neighbor_allreduce":
+        opt = DistributedHierarchicalNeighborAllreduceOptimizer(
             optimizer, num_steps_per_communication)
     else:
         raise ValueError(f"unknown communication {communication!r}")

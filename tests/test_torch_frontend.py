@@ -256,6 +256,104 @@ def test_push_sum_optimizer_consensus(bf_ctx):
         bft.turn_off_win_ops_with_associated_p()
 
 
+def test_atc_optimizer_consensus(bf_ctx):
+    """ATC with zero grads degenerates to neighbor averaging -> consensus."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedAdaptThenCombineOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+    for _ in range(30):
+        p.grad = torch.zeros_like(p)
+        opt.step()
+    mean = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(p.data, torch.full_like(p.data, mean), atol=1e-3)
+
+
+def test_atc_vs_awc_one_step_ordering(bf_ctx):
+    """One step with rank-valued grads separates the two orderings:
+    ATC averages the ADAPTED weights (avg(r - r) = 0 everywhere), AWC
+    adapts the AVERAGED weights (avg(r) - r != 0 in general)."""
+    p_atc = torch.nn.Parameter(_rankval((2,)))
+    opt_atc = bft.DistributedAdaptThenCombineOptimizer(
+        torch.optim.SGD([p_atc], lr=1.0))
+    p_atc.grad = _rankval((2,)).clone()
+    opt_atc.step()
+    assert torch.allclose(p_atc.data, torch.zeros_like(p_atc), atol=1e-6)
+
+    p_awc = torch.nn.Parameter(_rankval((2,)))
+    opt_awc = bft.DistributedAdaptWithCombineOptimizer(
+        torch.optim.SGD([p_awc], lr=1.0))
+    p_awc.grad = _rankval((2,)).clone()
+    opt_awc.step()
+    topo = bf.load_topology()
+    for r in range(N_DEVICES):
+        self_w, recv_w = bf.GetRecvWeights(topo, r)
+        avg = self_w * r + sum(w * s for s, w in recv_w.items())
+        np.testing.assert_allclose(p_awc.data[r].numpy(),
+                                   np.full(2, avg - r), rtol=1e-5)
+
+
+def test_awc_optimizer_allreduce_type(bf_ctx):
+    """communication_type=allreduce: one combine lands exactly on the mean."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedAdaptWithCombineOptimizer(
+        torch.optim.SGD([p], lr=1.0),
+        communication_type=bft.CommunicationType.allreduce)
+    p.grad = torch.zeros_like(p)
+    opt.step()
+    mean = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(p.data, torch.full_like(p.data, mean), atol=1e-5)
+
+
+def test_hierarchical_optimizer_consensus(bf_ctx_machines):
+    """Machine-level CTA: within-machine equality immediately, global
+    consensus after repeated steps on the weighted machine ring."""
+    bf.set_machine_topology(bf.RingGraph(N_DEVICES // 2), is_weighted=True)
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedHierarchicalNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=1.0))
+    p.grad = torch.zeros_like(p)
+    opt.step()
+    for m in range(N_DEVICES // 2):
+        assert torch.allclose(p.data[2 * m], p.data[2 * m + 1])
+    for _ in range(40):
+        p.grad = torch.zeros_like(p)
+        opt.step()
+    mean = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(p.data, torch.full_like(p.data, mean), atol=1e-2)
+
+
+def test_sched_requires_neighbor_allreduce_type(bf_ctx):
+    """sched= with a non-neighbor communication_type is a construction
+    error, not a silently ignored knob."""
+    topo = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), N_DEVICES)
+    p = torch.nn.Parameter(_rankval((2,)))
+    with pytest.raises(ValueError, match="neighbor_allreduce"):
+        bft.DistributedAdaptWithCombineOptimizer(
+            torch.optim.SGD([p], lr=1.0),
+            communication_type=bft.CommunicationType.allreduce, sched=sched)
+    with pytest.raises(ValueError, match="neighbor_allreduce"):
+        bft.DistributedAdaptThenCombineOptimizer(
+            torch.optim.SGD([p], lr=1.0),
+            communication_type=bft.CommunicationType.hierarchical_neighbor_allreduce,
+            sched=sched)
+
+
+def test_pull_get_optimizer_consensus(bf_ctx):
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedPullGetOptimizer(torch.optim.SGD([p], lr=1.0))
+    try:
+        for _ in range(40):
+            p.grad = torch.zeros_like(p)
+            opt.step()
+        mean = (N_DEVICES - 1) / 2.0
+        assert torch.allclose(p.data, torch.full_like(p.data, mean),
+                              atol=1e-2)
+    finally:
+        opt._bft_free_windows()
+
+
 def test_torch_dynamic_weight_matrix(bf_ctx):
     """Per-call weight matrices on torch tensors (reference per-call
     src_weights, torch/mpi_ops.py:475-645)."""
