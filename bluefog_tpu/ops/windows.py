@@ -265,6 +265,65 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
     return jax.jit(wrapper)
 
 
+@functools.lru_cache(maxsize=128)
+def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
+                   self_scale: bool, mesh_id: int):
+    """Dynamic-schedule variant of :func:`_push_fn`: the step's mixing
+    matrix is gathered ON DEVICE from the schedule tables by a traced step
+    index, so per-step dynamic window ops (the push-sum paper's one-peer
+    schedule, reference torch/mpi_ops.py:1144-1209 with per-call
+    dst_weights) never recompile and never build a host matrix per step.
+
+    Convention: off-diagonal entries of ``W_t`` are the transfer weights;
+    ``diag(W_t)`` is the self weight for puts (``self_scale=True``) —
+    exactly what ``compile_dynamic_schedule`` produces.  Gets keep the
+    local tensor unscaled (``self_scale=False``).
+    """
+    inner = _push_fn(topo, accumulate, mesh_id)
+    mats = jnp.asarray(sched.matrices, jnp.float32)        # [T, N, N]
+    eye = jnp.eye(topo.size, dtype=jnp.float32)
+
+    def wrapper(x, buffers, versions, p, p_buffers, step, with_p):
+        W = mats[step % sched.period]
+        sw = jnp.diagonal(W) if self_scale else jnp.ones((topo.size,),
+                                                         jnp.float32)
+        return inner(x, buffers, versions, p, p_buffers,
+                     W * (1.0 - eye), sw, with_p)
+    return jax.jit(wrapper)
+
+
+def _check_sched(w: "_Window", sched, step, weights, kind: str):
+    """Validate a per-call dynamic schedule against the window's snapshot
+    topology: every edge the schedule can use must be an edge of the
+    created topology (the slot layout is fixed at win_create), i.e. compile
+    the schedule from the same graph — or a subgraph — that the window was
+    created with."""
+    if weights is not None:
+        raise ValueError(f"pass either sched= or {kind}=, not both")
+    if step is None:
+        raise ValueError("dynamic window ops need the step index (step=i)")
+    if sched.size != w.topo.size:
+        raise ValueError(
+            f"schedule is over {sched.size} ranks, window over {w.topo.size}")
+    # PER-EDGE check (offset-set membership alone is too weak: on a
+    # non-circulant window graph an offset can exist for some ranks but
+    # not others, and a push over a missing edge would silently drop in
+    # the padded slot layout): every edge any step can use must be an
+    # edge of the creation topology.
+    used = (np.abs(sched.matrices).sum(axis=0) != 0)
+    np.fill_diagonal(used, False)
+    adj = w.topo.weight_matrix != 0
+    np.fill_diagonal(adj, False)
+    bad = np.argwhere(used & ~adj)
+    if len(bad):
+        pairs = [tuple(map(int, e)) for e in bad[:4]]
+        raise ValueError(
+            f"schedule uses edges {pairs}{'...' if len(bad) > 4 else ''} "
+            f"that are not edges of the window's creation topology; create "
+            f"the window with the schedule's superset graph (its buffer "
+            f"slots are fixed at win_create)")
+
+
 # ---------------------------------------------------------------------------
 # Matrices from defaults
 # ---------------------------------------------------------------------------
@@ -322,19 +381,31 @@ def _update_matrix(topo: CompiledTopology,
 # Public API
 # ---------------------------------------------------------------------------
 
-def win_put_nonblocking(tensor, name: str,
-                        self_weight: Optional[float] = None,
-                        dst_weights: Optional[np.ndarray] = None,
-                        require_mutex: bool = False) -> int:
-    """Put ``tensor * dst_weights[src, dst]`` into each destination's buffer
-    for ``src`` (replace), then scale the local window tensor by
-    ``self_weight`` (mpi_ops.py:1144-1209)."""
+def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
+                           sched, step, accumulate: bool) -> int:
+    """Shared body of win_put/win_accumulate (they differ only in whether
+    arriving data replaces or adds into the destination buffers)."""
     w = _window(name)
     cx = ctx()
+    with_p = _with_associated_p[0]
+    if sched is not None:
+        _check_sched(w, sched, step, dst_weights, "dst_weights")
+        if self_weight is not None:
+            raise ValueError(
+                "sched= carries the self weights (diag of the step matrix); "
+                "self_weight= cannot also be given")
+        fn = _push_sched_fn(w.topo, sched, accumulate, True, id(cx.mesh))
+
+        def run():
+            x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+            (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+                x, w.buffers, w.versions, w.p, w.p_buffers,
+                jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
+        return _dispatch_win_op(run, lambda: w.tensor)
+
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
-    fn = _push_fn(w.topo, False, id(cx.mesh))
-    with_p = _with_associated_p[0]
+    fn = _push_fn(w.topo, accumulate, id(cx.mesh))
 
     def run():
         x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
@@ -345,53 +416,77 @@ def win_put_nonblocking(tensor, name: str,
     return _dispatch_win_op(run, lambda: w.tensor)
 
 
+def win_put_nonblocking(tensor, name: str,
+                        self_weight: Optional[float] = None,
+                        dst_weights: Optional[np.ndarray] = None,
+                        require_mutex: bool = False,
+                        sched=None, step: Optional[int] = None) -> int:
+    """Put ``tensor * dst_weights[src, dst]`` into each destination's buffer
+    for ``src`` (replace), then scale the local window tensor by
+    ``self_weight`` (mpi_ops.py:1144-1209).
+
+    Dynamic topologies: pass ``sched=`` (a :class:`DynamicSchedule`
+    compiled from the window's creation graph or a subgraph) plus the step
+    index — the step's edges and weights are selected on device, mirroring
+    the reference's per-call dynamic ``dst_weights`` without a recompile.
+    """
+    return _push_like_nonblocking(tensor, name, self_weight, dst_weights,
+                                  sched, step, accumulate=False)
+
+
 def win_put(tensor, name: str, self_weight=None, dst_weights=None,
-            require_mutex: bool = False) -> bool:
+            require_mutex: bool = False, sched=None,
+            step: Optional[int] = None) -> bool:
     win_wait(win_put_nonblocking(tensor, name, self_weight, dst_weights,
-                                 require_mutex))
+                                 require_mutex, sched, step))
     return True
 
 
 def win_accumulate_nonblocking(tensor, name: str,
                                self_weight: Optional[float] = None,
                                dst_weights: Optional[np.ndarray] = None,
-                               require_mutex: bool = False) -> int:
+                               require_mutex: bool = False,
+                               sched=None,
+                               step: Optional[int] = None) -> int:
     """Like win_put but adds into the destination buffers (SUM only,
-    mpi_ops.py:1279-1345)."""
-    w = _window(name)
-    cx = ctx()
-    D = _out_matrix(w.topo, dst_weights)
-    sw = _self_weight_vector(w.topo.size, self_weight)
-    fn = _push_fn(w.topo, True, id(cx.mesh))
-    with_p = _with_associated_p[0]
-
-    def run():
-        x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
-        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-            x, w.buffers, w.versions, w.p, w.p_buffers,
-            jnp.asarray(D, jnp.float32), jnp.asarray(sw),
-            jnp.asarray(with_p))
-    return _dispatch_win_op(run, lambda: w.tensor)
+    mpi_ops.py:1279-1345).  ``sched=``/``step=`` as in win_put — the
+    push-sum one-peer schedules ride this path."""
+    return _push_like_nonblocking(tensor, name, self_weight, dst_weights,
+                                  sched, step, accumulate=True)
 
 
 def win_accumulate(tensor, name: str, self_weight=None, dst_weights=None,
-                   require_mutex: bool = False) -> bool:
+                   require_mutex: bool = False, sched=None,
+                   step: Optional[int] = None) -> bool:
     win_wait(win_accumulate_nonblocking(tensor, name, self_weight,
-                                        dst_weights, require_mutex))
+                                        dst_weights, require_mutex,
+                                        sched, step))
     return True
 
 
 def win_get_nonblocking(name: str,
                         src_weights: Optional[np.ndarray] = None,
-                        require_mutex: bool = False) -> int:
+                        require_mutex: bool = False,
+                        sched=None, step: Optional[int] = None) -> int:
     """Pull each in-neighbor's window tensor (scaled by ``src_weights[src,
     dst]``) into the local buffer for that neighbor (mpi_ops.py:1215-1272).
+    ``sched=``/``step=`` select a per-step dynamic edge set as in win_put.
     """
     w = _window(name)
     cx = ctx()
+    with_p = _with_associated_p[0]
+    if sched is not None:
+        _check_sched(w, sched, step, src_weights, "src_weights")
+        fn = _push_sched_fn(w.topo, sched, False, False, id(cx.mesh))
+
+        def run():
+            (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
+                w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
+                jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
+        return _dispatch_win_op(run, lambda: w.buffers)
+
     G = _out_matrix(w.topo, src_weights)
     fn = _push_fn(w.topo, False, id(cx.mesh))
-    with_p = _with_associated_p[0]
 
     def run():
         (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
@@ -401,8 +496,10 @@ def win_get_nonblocking(name: str,
     return _dispatch_win_op(run, lambda: w.buffers)
 
 
-def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
-    win_wait(win_get_nonblocking(name, src_weights, require_mutex))
+def win_get(name: str, src_weights=None, require_mutex: bool = False,
+            sched=None, step: Optional[int] = None) -> bool:
+    win_wait(win_get_nonblocking(name, src_weights, require_mutex,
+                                 sched, step))
     return True
 
 

@@ -111,10 +111,14 @@ class _JittedStrategyOptimizer:
                 return pl.rewrap(p_new), pl.rewrap(st_new)
             p2, g2, st2 = (pl.reshape_in(params), pl.reshape_in(grads),
                            pl.reshape_in(opt_state))
+            # check_vma off under the pallas backend (same exemption as
+            # ops/api.py / training.py: the fused kernel's outputs carry
+            # no varying-manual-axes tags)
             p_out, st_out = jax.shard_map(
                 shard_fn, mesh=pl.mesh,
                 in_specs=(pl.spec, pl.spec, pl.spec, P()),
                 out_specs=(pl.spec, pl.spec),
+                check_vma=not _api._nar_backend().startswith("pallas"),
             )(p2, g2, st2, step_idx)
             return pl.reshape_out(p_out), pl.reshape_out(st_out)
 
@@ -125,6 +129,7 @@ class _JittedStrategyOptimizer:
         key = (id(cx.mesh),
                id(cx._compiled),
                id(cx._compiled_machine),
+               _api._nar_backend(),
                jax.tree.structure(params))
         if key not in self._step_cache:
             self._step_cache[key] = self._build(key)
@@ -286,7 +291,18 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
     Windows hold the biased iterate x with the associated-P scalar riding
     every op; the user-visible parameters are the de-biased x/p.  Per step:
     local update on the biased iterate, self-scaled push-accumulate with
-    weight 1/(out_degree+1), collect, de-bias."""
+    weight 1/(out_degree+1), collect, de-bias.
+
+    ``sched=`` runs the accumulate over a per-step dynamic edge set (the
+    push-sum paper's actual one-peer schedule — reference usage
+    torch/mpi_ops.py:1144-1209 with per-iteration dst_weights); the
+    schedule's matrices must be column-stochastic (one-peer schedules
+    from ``compile_dynamic_schedule`` are) so mass is conserved."""
+
+    def __init__(self, base, window_prefix: Optional[str] = None,
+                 num_steps_per_communication: int = 1, sched=None):
+        super().__init__(base, window_prefix, num_steps_per_communication)
+        self.sched = sched
 
     def init(self, params):
         W.turn_on_win_ops_with_associated_p()
@@ -330,8 +346,13 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
         new_leaves = []
         for name, leaf in zip(self._names, jax.tree.leaves(adapted)):
-            W.win_accumulate(leaf, name, self_weight=self.alpha,
-                             dst_weights=self.dst_weights, require_mutex=True)
+            if self.sched is not None:
+                W.win_accumulate(leaf, name, require_mutex=True,
+                                 sched=self.sched, step=step)
+            else:
+                W.win_accumulate(leaf, name, self_weight=self.alpha,
+                                 dst_weights=self.dst_weights,
+                                 require_mutex=True)
             collected = W.win_update_then_collect(name)
             p = W.win_associated_p_vector(name)  # [N] on device, no host sync
             shape = (-1,) + (1,) * (collected.ndim - 1)

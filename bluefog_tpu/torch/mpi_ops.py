@@ -201,41 +201,49 @@ def win_free(name: Optional[str] = None) -> bool:
 
 
 def win_put_nonblocking(t: torch.Tensor, name: str, self_weight=None,
-                        dst_weights=None, require_mutex: bool = False) -> int:
+                        dst_weights=None, require_mutex: bool = False,
+                        sched=None, step=None) -> int:
     arr, _ = _to_numpy(t)
     return _win.win_put_nonblocking(arr, name, self_weight, dst_weights,
-                                    require_mutex)
+                                    require_mutex, sched, step)
 
 
 def win_put(t: torch.Tensor, name: str, self_weight=None, dst_weights=None,
-            require_mutex: bool = False) -> bool:
+            require_mutex: bool = False, sched=None, step=None) -> bool:
     _win.win_wait(win_put_nonblocking(t, name, self_weight, dst_weights,
-                                      require_mutex))
+                                      require_mutex, sched, step))
     return True
 
 
 def win_accumulate_nonblocking(t: torch.Tensor, name: str, self_weight=None,
                                dst_weights=None,
-                               require_mutex: bool = False) -> int:
+                               require_mutex: bool = False,
+                               sched=None, step=None) -> int:
     arr, _ = _to_numpy(t)
     return _win.win_accumulate_nonblocking(arr, name, self_weight,
-                                           dst_weights, require_mutex)
+                                           dst_weights, require_mutex,
+                                           sched, step)
 
 
 def win_accumulate(t: torch.Tensor, name: str, self_weight=None,
-                   dst_weights=None, require_mutex: bool = False) -> bool:
+                   dst_weights=None, require_mutex: bool = False,
+                   sched=None, step=None) -> bool:
     _win.win_wait(win_accumulate_nonblocking(t, name, self_weight,
-                                             dst_weights, require_mutex))
+                                             dst_weights, require_mutex,
+                                             sched, step))
     return True
 
 
 def win_get_nonblocking(name: str, src_weights=None,
-                        require_mutex: bool = False) -> int:
-    return _win.win_get_nonblocking(name, src_weights, require_mutex)
+                        require_mutex: bool = False,
+                        sched=None, step=None) -> int:
+    return _win.win_get_nonblocking(name, src_weights, require_mutex,
+                                    sched, step)
 
 
-def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
-    return _win.win_get(name, src_weights, require_mutex)
+def win_get(name: str, src_weights=None, require_mutex: bool = False,
+            sched=None, step=None) -> bool:
+    return _win.win_get(name, src_weights, require_mutex, sched, step)
 
 
 def _win_to_torch(name: str, a) -> torch.Tensor:
