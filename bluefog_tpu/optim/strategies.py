@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..ops import api as _api
 from ..ops import collectives as C
 from ..parallel.schedule import CompiledTopology, DynamicSchedule
 
@@ -46,14 +47,35 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  sched: Optional[DynamicSchedule],
                  step,
                  machine_axes: Optional[Tuple[str, str]] = None,
-                 machine_topo: Optional[CompiledTopology] = None):
-    """Apply the configured averaging to every leaf of ``params``."""
+                 machine_topo: Optional[CompiledTopology] = None,
+                 nar_backend: Optional[str] = None):
+    """Apply the configured averaging to every leaf of ``params``.
+
+    ``nar_backend``: exchange backend SNAPSHOT.  Builders capture it when
+    the step is constructed (jit traces once and would otherwise freeze
+    whatever the env said at first call — silently stale if the env
+    changes later); ``None`` falls back to reading the env here.
+    """
     if comm_type == CommunicationType.empty:
         return params
     if comm_type == CommunicationType.allreduce:
         return jax.tree.map(lambda p: C.allreduce(p, axis_name, average=True),
                             params)
     if comm_type == CommunicationType.neighbor_allreduce:
+        backend = nar_backend or _api._nar_backend()
+        if backend.startswith("pallas"):
+            # the training step rides the same fused concurrent-RDMA
+            # kernel as the op layer (BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND,
+            # ops/api.py:165-171); float leaves only, like the kernel
+            from ..ops import pallas_kernels as PK
+            interp = backend == "pallas_interpret"
+            if sched is not None:
+                return jax.tree.map(
+                    lambda p: PK.fused_dynamic_neighbor_allreduce(
+                        p, axis_name, sched, step, interpret=interp), params)
+            return jax.tree.map(
+                lambda p: PK.fused_neighbor_allreduce(
+                    p, axis_name, topo, interpret=interp), params)
         if sched is not None:
             return jax.tree.map(
                 lambda p: C.dynamic_neighbor_allreduce(p, axis_name, sched, step),
@@ -120,14 +142,16 @@ def grad_accum_init(base: optax.GradientTransformation, params):
 def consensus_step(base: optax.GradientTransformation,
                    comm_type: CommunicationType, axis_name,
                    topo=None, sched=None, machine_axes=None,
-                   machine_topo=None):
+                   machine_topo=None, nar_backend=None):
     """Consensus/CTA/AWC family (reference _DistributedReduceOptimizer,
     optimizers.py:297-482): average the *weights*, apply the local update
     computed from gradients at the pre-average point."""
+    nar_backend = nar_backend or _api._nar_backend()
 
     def step_fn(params, grads, opt_state, step=0):
         averaged = _communicate(params, comm_type, axis_name, topo, sched,
-                                step, machine_axes, machine_topo)
+                                step, machine_axes, machine_topo,
+                                nar_backend)
         updates, opt_state = base.update(grads, opt_state, averaged)
         return optax.apply_updates(averaged, updates), opt_state
 
@@ -136,18 +160,21 @@ def consensus_step(base: optax.GradientTransformation,
 
 def atc_step(base: optax.GradientTransformation,
              comm_type: CommunicationType, axis_name,
-             topo=None, sched=None, machine_axes=None, machine_topo=None):
+             topo=None, sched=None, machine_axes=None, machine_topo=None,
+             nar_backend=None):
     """Adapt-then-combine (reference _DistributedAdaptThenCombineOptimizer,
     optimizers.py:485-841): local update first, then average the updated
     weights.  The reference re-implements each torch optimizer's math inside
     the gradient hook; with optax the base transformation is already a pure
     function, so ATC is just the other composition order."""
+    nar_backend = nar_backend or _api._nar_backend()
 
     def step_fn(params, grads, opt_state, step=0):
         updates, opt_state = base.update(grads, opt_state, params)
         adapted = optax.apply_updates(params, updates)
         combined = _communicate(adapted, comm_type, axis_name, topo, sched,
-                                step, machine_axes, machine_topo)
+                                step, machine_axes, machine_topo,
+                                nar_backend)
         return combined, opt_state
 
     return step_fn

@@ -22,6 +22,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .context import ctx
+from .ops import api as _api
 from .optim import strategies as S
 from .optim._plumbing import mesh_plumbing
 from .parallel.schedule import DynamicSchedule
@@ -92,6 +93,10 @@ def make_train_step(model,
     ) else None
     machine_topo = cx.compiled_machine_topology if hierarchical else None
 
+    # the exchange backend binds when the step is BUILT (jit traces once;
+    # reading the env at trace time would freeze whatever the first call
+    # saw and silently ignore later env changes)
+    nar_backend = _api._nar_backend()
     if grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
@@ -104,7 +109,7 @@ def make_train_step(model,
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
                        sched=sched,
                        machine_axes=(cx.machine_axis, cx.local_axis),
-                       machine_topo=machine_topo)
+                       machine_topo=machine_topo, nar_backend=nar_backend)
     core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
                               num_steps_per_communication)
 
@@ -138,10 +143,14 @@ def make_train_step(model,
 
         v2, o2 = pl.reshape_in(variables), pl.reshape_in(opt_state)
         b2 = pl.reshape_in(batch)
+        # check_vma off under the pallas backend: the fused-exchange
+        # kernel's outputs carry no varying-manual-axes tags (same
+        # exemption as ops/api.py's _shardmapped pallas path)
         v_out, o_out, loss = jax.shard_map(
             shard_fn, mesh=pl.mesh,
             in_specs=(pl.spec, pl.spec, pl.spec, P()),
             out_specs=(pl.spec, pl.spec, P()),
+            check_vma=not nar_backend.startswith("pallas"),
         )(v2, o2, b2, step_idx)
         return pl.reshape_out(v_out), pl.reshape_out(o_out), loss
 
