@@ -1,0 +1,187 @@
+"""Convergence parity: decentralized vs centralized training quality.
+
+The reference's public claim is that decentralized (neighbor-averaging)
+training reaches the centralized solution (README.rst:48-49 — its accuracy
+tables were left "TO BE ADDED"; VERDICT r2 #8 asks us to actually produce
+them).  This script trains the SAME model/data/seed under
+
+  * gradient_allreduce  — centralized Horovod-style baseline
+  * neighbor_allreduce  — static exp2 topology (CTA)
+  * neighbor_allreduce + dynamic one-peer schedule (the flagship mode)
+
+and prints a markdown table of final loss / held-out accuracy / cross-rank
+consensus spread, plus one JSON line per run.
+
+    python scripts/convergence_parity.py                 # LeNet MNIST leg
+    python scripts/convergence_parity.py --include-resnet  # + ResNet-18 leg
+
+CPU-mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu (the MNIST leg takes ~2 min there; the ResNet leg is
+sized for the hardware window).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+import jax
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+
+
+def synthetic_cifar(n_samples=4096, seed=0, image=32):
+    """Class-conditional blobs on a 3-channel canvas (same recipe as the
+    mnist example's stand-in, examples/mnist.py:48-58)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, size=n_samples).astype(np.int32)
+    x = rng.normal(0.0, 0.3, size=(n_samples, image, image, 3)).astype(
+        np.float32)
+    for c in range(10):
+        r, col = divmod(c, 4)
+        sel = y == c
+        x[sel, 4 + 6 * r: 10 + 6 * r, 4 + 6 * col: 10 + 6 * col, c % 3] += 1.5
+    return x, y
+
+
+def run_one(model, sample_shape, x, y, x_test, y_test, communication,
+            dynamic, lr, momentum, epochs, batch, seed):
+    bf.shutdown()
+    bf.init()
+    n = bf.size()
+    per_rank = len(x) // n
+    xs = x[: per_rank * n].reshape((n, per_rank) + x.shape[1:])
+    ys = y[: per_rank * n].reshape(n, per_rank)
+
+    sched = None
+    if dynamic and n > 1:
+        topo = bf.load_topology()
+        sched = bf.compile_dynamic_schedule(
+            lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+    base = optax.sgd(lr, momentum=momentum)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(seed), jnp.zeros((1,) + sample_shape))
+    step_fn = T.make_train_step(model, base, communication=communication,
+                                sched=sched, donate=False)
+
+    steps_per_epoch = per_rank // batch
+    rng = np.random.default_rng(seed)
+    gstep = 0
+    loss = None
+    for _ in range(epochs):
+        order = rng.permutation(per_rank)
+        for s in range(steps_per_epoch):
+            idx = order[s * batch:(s + 1) * batch]
+            variables, opt_state, loss = step_fn(
+                variables, opt_state,
+                (jnp.asarray(xs[:, idx]), jnp.asarray(ys[:, idx])),
+                jnp.int32(gstep))
+            gstep += 1
+    final_loss = float(loss)
+
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    spread = max((float(jnp.max(jnp.abs(p - p.mean(axis=0, keepdims=True))))
+                  for p in jax.tree.leaves(params)), default=0.0)
+
+    # evaluate the CONSENSUS model (mean over ranks), like deploying the
+    # averaged decentralized solution; batch_stats average the same way
+    mean_params = jax.tree.map(lambda p: p.mean(axis=0), params)
+    mean_extra = jax.tree.map(lambda p: p.mean(axis=0), extra)
+
+    @jax.jit
+    def logits_fn(xb):
+        return model.apply({"params": mean_params, **mean_extra}, xb,
+                           train=False)
+    preds = []
+    for i in range(0, len(x_test), 256):
+        preds.append(np.asarray(
+            jnp.argmax(logits_fn(jnp.asarray(x_test[i:i + 256])), axis=-1)))
+    acc = float((np.concatenate(preds) == y_test).mean())
+    return {"final_loss": round(final_loss, 4),
+            "test_acc_pct": round(100 * acc, 2),
+            "consensus_spread": round(spread, 5)}
+
+
+MODES = [
+    ("gradient_allreduce", False, "gradient allreduce (centralized)"),
+    ("neighbor_allreduce", False, "neighbor allreduce (static exp2)"),
+    ("neighbor_allreduce", True, "neighbor allreduce (dynamic one-peer)"),
+]
+
+
+def run_table(name, model, sample_shape, data, test, lr, momentum, epochs,
+              batch, seed):
+    rows = []
+    for comm, dyn, label in MODES:
+        r = run_one(model, sample_shape, data[0], data[1], test[0], test[1],
+                    comm, dyn, lr, momentum, epochs, batch, seed)
+        r.update({"workload": name, "mode": label})
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    base_acc = rows[0]["test_acc_pct"]
+    print(f"\n### {name}\n")
+    print("| mode | final loss | test acc (%) | acc gap vs centralized "
+          "(pp) | consensus spread |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        gap = round(r["test_acc_pct"] - base_acc, 2)
+        print(f"| {r['mode']} | {r['final_loss']} | {r['test_acc_pct']} "
+              f"| {gap:+.2f} | {r['consensus_spread']} |")
+    print(flush=True)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--include-resnet", action="store_true",
+                    help="also run the ResNet-18 synthetic leg (sized for "
+                         "real hardware; slow on the CPU mesh)")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--noise", type=float, default=1.3,
+                    help="extra pixel noise stddev: de-saturates the "
+                         "synthetic task so accuracy gaps are measurable "
+                         "(0 => every mode hits 100%%)")
+    args = ap.parse_args()
+
+    from mnist import synthetic_mnist          # examples/mnist.py
+    from bluefog_tpu.models.lenet import LeNet
+    x, y = synthetic_mnist(n_samples=9216, seed=0)
+    if args.noise:
+        x = x + np.random.default_rng(9).normal(
+            0, args.noise, size=x.shape).astype(np.float32)
+    split = 8192
+    run_table("LeNet / synthetic MNIST (8-rank)", LeNet(), (28, 28, 1),
+              (x[:split], y[:split]), (x[split:], y[split:]),
+              lr=0.01, momentum=0.5, epochs=args.epochs,
+              batch=args.batch_size, seed=args.seed)
+
+    if args.include_resnet:
+        from bluefog_tpu.models.resnet import ResNet18
+        cx, cy = synthetic_cifar(n_samples=4608, seed=1)
+        csplit = 4096
+        run_table("ResNet-18 / synthetic 32px (8-rank)",
+                  ResNet18(num_classes=10, dtype=jnp.float32), (32, 32, 3),
+                  (cx[:csplit], cy[:csplit]), (cx[csplit:], cy[csplit:]),
+                  lr=0.05, momentum=0.9, epochs=args.epochs,
+                  batch=args.batch_size, seed=args.seed)
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()

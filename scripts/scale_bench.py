@@ -1,0 +1,153 @@
+"""Scaling-efficiency harness: per-chip throughput vs. device count.
+
+One command, pod-ready (VERDICT r2 #3 / BASELINE.md row 2 — the reference
+reports >95 % scaling on 128 V100 for ResNet-50; target >=90 %): runs the
+IDENTICAL decentralized train step bench.py times, over 1, 2, 4, ...,
+len(jax.devices()) chips, and prints one JSON line per point plus a
+summary::
+
+    python scripts/scale_bench.py
+    {"n_chips": 1, "img_per_sec_per_chip": ..., "efficiency_vs_1chip": 1.0}
+    {"n_chips": 8, "img_per_sec_per_chip": ..., "efficiency_vs_1chip": ...}
+    {"metric": "resnet50_scaling_efficiency", "value": ..., ...}
+
+On today's single tunneled chip it degenerates to the 1-chip point
+(efficiency 1.0 by definition); on a pod slice it produces the BASELINE
+scaling figure unmodified.  CPU-mesh plumbing test::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        SCALE_BENCH_TINY=1 python scripts/scale_bench.py
+
+Env knobs: BENCH_BATCH (per-chip batch, default 64), BENCH_IMAGE,
+BENCH_WINDOW_SMALL/LARGE + BENCH_ITERS (timing windows, see bench.py),
+SCALE_BENCH_POINTS (comma list of chip counts, default powers of two),
+SCALE_BENCH_TINY=1 (ResNet-18 @ 32px batch 2 — plumbing only).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bench
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+
+
+def _points(n_total: int):
+    env = os.environ.get("SCALE_BENCH_POINTS")
+    if env:
+        pts = sorted({int(p) for p in env.split(",")})
+    else:
+        pts, k = [], 1
+        while k <= n_total:
+            pts.append(k)
+            k *= 2
+        if pts[-1] != n_total:
+            pts.append(n_total)
+    bad = [p for p in pts if p < 1 or p > n_total]
+    if bad:
+        raise ValueError(f"chip counts {bad} exceed available {n_total}")
+    return pts
+
+
+def measure_point(devices, model_cls, batch, image, num_classes,
+                  k_small, k_large, iters, warmup):
+    """Per-chip img/s of the decentralized step on this device subset."""
+    bf.shutdown()
+    bf.init(devices=devices)
+    n = bf.size()
+    sched = None
+    if n > 1:
+        topo = bf.load_topology()
+        sched = bf.compile_dynamic_schedule(
+            lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+    model = model_cls(num_classes=num_classes, dtype=jnp.bfloat16)
+    base = optax.sgd(0.01, momentum=0.9)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))
+    step_fn = T.make_train_step(model, base,
+                                communication="neighbor_allreduce",
+                                sched=sched)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, batch, image, image, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, num_classes, size=(n, batch)))
+
+    loss = None
+    step = 0
+    for _ in range(warmup):
+        variables, opt_state, loss = step_fn(
+            variables, opt_state, (x, y), jnp.int32(step))
+        step += 1
+    _ = float(loss)  # scalar fetch: the reliable execution barrier
+
+    def window(k):
+        nonlocal variables, opt_state, loss, step
+        import time
+        t0 = time.perf_counter()
+        for _ in range(k):
+            variables, opt_state, loss = step_fn(
+                variables, opt_state, (x, y), jnp.int32(step))
+            step += 1
+        _ = float(loss)
+        return time.perf_counter() - t0
+
+    dt, _, _ = bench.measure_step_time_amortized(window, k_small, k_large,
+                                                 pairs=iters)
+    return batch / dt   # per-chip: batch images per rank per step
+
+
+def main():
+    tiny = os.environ.get("SCALE_BENCH_TINY", "0") == "1"
+    from bluefog_tpu.models.resnet import ResNet18, ResNet50
+    model_cls = ResNet18 if tiny else ResNet50
+    batch = int(os.environ.get("BENCH_BATCH", "2" if tiny else "64"))
+    image = int(os.environ.get("BENCH_IMAGE", "32" if tiny else "224"))
+    num_classes = 10 if tiny else 1000
+    warmup = int(os.environ.get("BENCH_WARMUP", "1" if tiny else "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "2" if tiny else "3"))
+    k_small = int(os.environ.get("BENCH_WINDOW_SMALL", "1" if tiny else "5"))
+    k_large = int(os.environ.get("BENCH_WINDOW_LARGE", "3" if tiny else "25"))
+
+    devices = jax.devices()
+    pts = _points(len(devices))
+    base_rate = None
+    results = []
+    for k in pts:
+        rate = measure_point(devices[:k], model_cls, batch, image,
+                             num_classes, k_small, k_large, iters, warmup)
+        if base_rate is None:
+            base_rate = rate
+        eff = rate / base_rate
+        point = {"n_chips": k,
+                 "img_per_sec_per_chip": round(rate, 1),
+                 "efficiency_vs_1chip": round(eff, 3)}
+        results.append(point)
+        print(json.dumps(point), flush=True)
+    bf.shutdown()
+
+    last = results[-1]
+    print(json.dumps({
+        "metric": ("resnet18_tiny_scaling_efficiency" if tiny
+                   else "resnet50_scaling_efficiency"),
+        "value": last["efficiency_vs_1chip"],
+        "unit": f"per-chip efficiency at {last['n_chips']} chips",
+        # BASELINE.md row 2: reference >95 % at 128 V100; target >=90 %
+        "vs_baseline": round(last["efficiency_vs_1chip"] / 0.95, 3),
+        "points": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()
