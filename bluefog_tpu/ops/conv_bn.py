@@ -1,0 +1,261 @@
+"""Fused pointwise-conv + BatchNorm Pallas kernels (the HBM-ceiling attack,
+VERDICT r2 #2).
+
+``docs/performance.md`` establishes that ResNet-50 training on v5e is
+HBM-bandwidth-bound and names BatchNorm's extra activation passes as the
+fusable traffic.  A 1x1 convolution over NHWC is exactly a matmul
+``[B*H*W, Cin] @ [Cin, Cout]`` — and 1x1 convs are half of ResNet-50's
+convolutions (every bottleneck is 1x1 -> 3x3 -> 1x1, models/resnet.py:52-67)
+— so the two kernels here fuse BN's passes into the matmuls around it:
+
+* :func:`matmul_bn_stats` — the conv, with a **stats epilogue**: per-output-
+  channel sum / sum-of-squares accumulate while the output tile is still in
+  VMEM.  Saves the full re-read of the conv output that the separate BN
+  reduce costs (one of BN-train's three activation passes).
+* :func:`bn_relu_matmul` — the NEXT conv, with a **normalize prologue**:
+  the input tile is normalized (given mean/var), scaled/shifted and ReLU'd
+  in VMEM right before it hits the MXU.  Saves the separate
+  normalize+activation pass (read + write of the full activation).
+
+Chained, the conv1 -> BN -> ReLU -> conv2 sequence touches HBM as
+``write y, read y`` instead of ``write y, read y (reduce), read y + write z
+(normalize), read z (conv2)`` — the experiment
+``scripts/conv_bn_probe.py`` measures both against plain XLA at ResNet-50
+bottleneck shapes.  The reference has no analogue (cuDNN runs these as
+separate kernels); XLA:TPU fuses the scale/shift but cannot move the
+reduction into the producing conv nor the normalize into the consuming one.
+
+Numerics: inputs may be bf16; the matmul accumulates in f32 on the MXU
+(``preferred_element_type``), stats accumulate in f32, outputs cast back.
+Training integration note: these are forward-path kernels; a trainable
+module wraps them in ``jax.custom_vjp`` with the standard BN backward math
+(XLA ops — the backward is not the bandwidth hot spot the forward passes
+are).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["matmul_bn_stats", "bn_relu_matmul", "pointwise_conv_bn_relu",
+           "dense_bn_relu_dense", "fit_tile"]
+
+_DIMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def fit_tile(dim: int, tile: int, minimum: int = 8) -> int:
+    """Largest power-of-two shrink of ``tile`` dividing ``dim`` (same
+    policy as flash_attention._fit_block); whole-length if nothing fits."""
+    tile = min(tile, dim)
+    while tile > minimum and dim % tile:
+        tile //= 2
+    return tile if dim % tile == 0 else dim
+
+
+def _check_2d(x, w):
+    if x.ndim != 2 or w.ndim != 2 or x.shape[1] != w.shape[0]:
+        raise ValueError(f"need [M, K] @ [K, N], got {x.shape} @ {w.shape}")
+
+
+def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, sq_ref, acc_ref, *, nk):
+    """Grid (m, n, k): y tile accumulates over k in f32 scratch; at the
+    last k the tile is written and its per-channel sum/sumsq land in the
+    (m, n)-indexed partial-stats rows."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(x_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _emit():
+        y = acc_ref[...]
+        y_ref[...] = y.astype(y_ref.dtype)
+        # stats epilogue: the tile is still in VMEM — no HBM re-read
+        s_ref[...] = jnp.sum(y, axis=0, keepdims=True)
+        sq_ref[...] = jnp.sum(y * y, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret"))
+def matmul_bn_stats(x, w, *, bm: int = 512, bn: int = 256, bk: int = 256,
+                    interpret: bool = False):
+    """``y = x @ w`` plus per-output-channel batch statistics in one pass.
+
+    Returns ``(y [M, N], mean [N], var [N])`` with mean/var in f32 (biased
+    variance, like ``jnp.var`` / flax BatchNorm).
+    """
+    _check_2d(x, w)
+    M, K = x.shape
+    N = w.shape[1]
+    bm, bn, bk = fit_tile(M, bm), fit_tile(N, bn, 128), fit_tile(K, bk, 128)
+    nm, nn, nk = M // bm, N // bn, K // bk
+
+    y, psum, psumsq = pl.pallas_call(
+        functools.partial(_mm_stats_kernel, nk=nk),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((1, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((1, bn), lambda m, n, k: (m, n)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N), x.dtype),
+            jax.ShapeDtypeStruct((nm, N), jnp.float32),
+            jax.ShapeDtypeStruct((nm, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_DIMS,
+        interpret=interpret,
+    )(x, w)
+    # folding [nm, N] partials is nm*N elements — noise next to M*N
+    s = psum.sum(axis=0)
+    sq = psumsq.sum(axis=0)
+    mean = s / M
+    var = sq / M - mean * mean
+    return y, mean, var
+
+
+def _bn_mm_kernel(x_ref, mu_ref, iv_ref, g_ref, b_ref, w_ref, y_ref,
+                  acc_ref, *, nk, relu):
+    """Grid (m, n, k): normalize+scale+shift+ReLU the x tile in VMEM, then
+    feed the MXU — the standalone normalize pass never exists."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xn = (x_ref[...].astype(jnp.float32) - mu_ref[...]) * iv_ref[...]
+    xn = xn * g_ref[...] + b_ref[...]
+    if relu:
+        xn = jnp.maximum(xn, 0.0)
+    acc_ref[...] += jnp.dot(xn.astype(x_ref.dtype), w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _emit():
+        y_ref[...] = acc_ref[...].astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("relu", "eps", "bm", "bn", "bk",
+                                             "interpret"))
+def bn_relu_matmul(x, mean, var, gamma, beta, w, *, relu: bool = True,
+                   eps: float = 1e-5, bm: int = 512, bn: int = 256,
+                   bk: int = 256, interpret: bool = False):
+    """``relu(norm(x)) @ w`` with the normalize fused into the matmul's
+    input read.  ``mean/var/gamma/beta`` are per-``Cin`` ([K]) vectors."""
+    _check_2d(x, w)
+    M, K = x.shape
+    N = w.shape[1]
+    for name, v in (("mean", mean), ("var", var), ("gamma", gamma),
+                    ("beta", beta)):
+        if v.shape != (K,):
+            raise ValueError(f"{name} must be [{K}], got {v.shape}")
+    bm, bn, bk = fit_tile(M, bm), fit_tile(N, bn, 128), fit_tile(K, bk, 128)
+    nm, nn, nk = M // bm, N // bn, K // bk
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    row = lambda v: v.astype(jnp.float32).reshape(1, K)
+
+    vec_spec = pl.BlockSpec((1, bk), lambda m, n, k: (0, k))
+    return pl.pallas_call(
+        functools.partial(_bn_mm_kernel, nk=nk, relu=relu),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            vec_spec, vec_spec, vec_spec, vec_spec,
+            pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_DIMS,
+        interpret=interpret,
+    )(x, row(mean), row(inv), row(gamma), row(beta), w)
+
+
+# ---------------------------------------------------------------------------
+# Trainable wrapper: fused forward, standard BN backward (XLA ops — the
+# forward passes are the bandwidth hot spot the kernels remove; the
+# backward is the usual matmul-dominated program)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def dense_bn_relu_dense(x, w1, gamma, beta, w2, eps: float = 1e-5,
+                        interpret: bool = False):
+    """Trainable ``relu(BN_train(x @ w1)) @ w2`` with the fused forward
+    kernels.  Returns ``(out, mean, var)`` — stats feed running averages.
+    Gradients match the XLA composition (verified in
+    ``tests/test_conv_bn.py``)."""
+    out, mean, var, _ = _dbrd_fwd(x, w1, gamma, beta, w2, eps, interpret)
+    return out, mean, var
+
+
+def _dbrd_fwd(x, w1, gamma, beta, w2, eps, interpret):
+    y, mean, var = matmul_bn_stats(x, w1, interpret=interpret)
+    out = bn_relu_matmul(y, mean, var, gamma, beta, w2, eps=eps,
+                         interpret=interpret)
+    return out, mean, var, (x, w1, gamma, beta, w2, y, mean, var)
+
+
+def _dbrd_fwd_vjp(x, w1, gamma, beta, w2, eps, interpret):
+    # fwd mirrors the primal signature (nondiff_argnums args are only
+    # PREFIXED for bwd in current JAX)
+    out, mean, var, res = _dbrd_fwd(x, w1, gamma, beta, w2, eps, interpret)
+    return (out, mean, var), res
+
+
+def _dbrd_bwd(eps, interpret, res, cts):
+    g, _, _ = cts                       # no cotangents through the stats
+    x, w1, gamma, beta, w2, y, mean, var = res
+    f32 = jnp.float32
+    yf = y.astype(f32)
+    inv = jax.lax.rsqrt(var.astype(f32) + eps)
+    xhat = (yf - mean) * inv
+    z = xhat * gamma + beta
+    relu_z = jnp.maximum(z, 0.0)
+    gf = g.astype(f32)
+
+    d_w2 = relu_z.T @ gf
+    d_z = (gf @ w2.astype(f32).T) * (z > 0)
+    d_gamma = jnp.sum(d_z * xhat, axis=0)
+    d_beta = jnp.sum(d_z, axis=0)
+    # standard BN-train backward (batch statistics are functions of y)
+    d_xhat = d_z * gamma
+    d_y = inv * (d_xhat - d_xhat.mean(axis=0)
+                 - xhat * (d_xhat * xhat).mean(axis=0))
+    d_w1 = x.astype(f32).T @ d_y
+    d_x = d_y @ w1.astype(f32).T
+    return (d_x.astype(x.dtype), d_w1.astype(w1.dtype),
+            d_gamma.astype(gamma.dtype), d_beta.astype(beta.dtype),
+            d_w2.astype(w2.dtype))
+
+
+dense_bn_relu_dense.defvjp(_dbrd_fwd_vjp, _dbrd_bwd)
+
+
+def pointwise_conv_bn_relu(x, w1, gamma, beta, w2, *, eps: float = 1e-5,
+                           interpret: bool = False):
+    """The fused bottleneck chain ``conv1x1 -> BN -> ReLU -> conv1x1`` on
+    NHWC input ``[B, H, W, C]``: two kernel launches, two HBM passes over
+    the intermediate activation (write + read) instead of XLA's four.
+
+    Returns ``(out [B, H, W, N2], mean, var)`` — the stats feed the running
+    averages exactly like flax BatchNorm's ``batch_stats``."""
+    B, H, W, C = x.shape
+    x2 = x.reshape(B * H * W, C)
+    y, mean, var = matmul_bn_stats(x2, w1, interpret=interpret)
+    out = bn_relu_matmul(y, mean, var, gamma, beta, w2, eps=eps,
+                         interpret=interpret)
+    return out.reshape(B, H, W, w2.shape[1]), mean, var

@@ -1,0 +1,135 @@
+"""The HBM-ceiling experiment (VERDICT r2 #2): fused conv+BN vs XLA.
+
+docs/performance.md pins ResNet-50 training on v5e at the HBM roofline and
+attributes the gap to BatchNorm's extra activation passes.  This probe
+measures that claim's fusable half directly: the bottleneck-block chain
+
+    y = conv1x1(x); z = relu(BN_train(y)); out = conv1x1(z)
+
+as (a) plain XLA (flax-equivalent ops, jitted as one program) and (b) the
+two fused Pallas kernels (``ops/conv_bn.py``: stats epilogue + normalize
+prologue), at ResNet-50 bottleneck shapes.  For each it reports wall time,
+XLA's bytes-accessed, and the implied HBM GB/s; the verdict line states
+whether the fusion beat XLA (moved the roofline) or was bandwidth-neutral.
+
+    BENCH_ON_TPU=1 python scripts/conv_bn_probe.py     # real measurement
+    JAX_PLATFORMS=cpu python scripts/conv_bn_probe.py  # plumbing (interpret)
+
+Timing uses bench.py's two-window differencing (RTT-cancelling on the
+tunneled transport).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from bluefog_tpu.ops.conv_bn import bn_relu_matmul, matmul_bn_stats
+
+# ResNet-50 bottleneck 1x1 chains at batch 64 (rows = B*H*W), NHWC:
+# (rows, Cin, Cmid, Cout) — stage 2..5 shapes, models/resnet.py:52-67
+SHAPES = [
+    ("stage2 56px", 64 * 56 * 56, 256, 64, 256),
+    ("stage3 28px", 64 * 28 * 28, 512, 128, 512),
+    ("stage4 14px", 64 * 14 * 14, 1024, 256, 1024),
+    ("stage5 7px", 64 * 7 * 7, 2048, 512, 2048),
+]
+
+
+def xla_chain(x, w1, gamma, beta, w2):
+    y = x @ w1
+    m = y.mean(axis=0)
+    v = jnp.var(y, axis=0)
+    z = jnp.maximum((y - m) * jax.lax.rsqrt(v + 1e-5) * gamma + beta, 0.0)
+    return z @ w2, m, v
+
+
+def fused_chain(x, w1, gamma, beta, w2, interpret):
+    y, m, v = matmul_bn_stats(x, w1, interpret=interpret)
+    out = bn_relu_matmul(y, m, v, gamma, beta, w2, interpret=interpret)
+    return out, m, v
+
+
+def measure(fn, args, tiny):
+    """(ms, bytes_accessed, flops) via AOT compile + differenced timing."""
+    lowered = jax.jit(fn).lower(*args)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    n = 2 if tiny else 10
+    dt = bench.timeit_amortized(lambda: compiled(*args), n=n,
+                                warmup=1 if tiny else 2,
+                                pairs=2 if tiny else 3)
+    return dt * 1e3, cost.get("bytes accessed"), cost.get("flops")
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+    tiny = not on_tpu or os.environ.get("CONV_BN_PROBE_TINY") == "1"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    shapes = ([("tiny", 2048, 128, 64, 128)] if tiny else SHAPES)
+    hbm = bench.lookup_device_table(bench.HBM_GBPS)
+
+    print(f"backend={jax.default_backend()} dtype={dtype.__name__} "
+          f"interpret={interpret}")
+    rows = []
+    for name, rows_n, cin, cmid, cout in shapes:
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(rows_n, cin)), dtype)
+        w1 = jnp.asarray(rng.normal(size=(cin, cmid)) / np.sqrt(cin), dtype)
+        w2 = jnp.asarray(rng.normal(size=(cmid, cout)) / np.sqrt(cmid), dtype)
+        gamma = jnp.ones((cmid,), jnp.float32)
+        beta = jnp.zeros((cmid,), jnp.float32)
+        args = (x, w1, gamma, beta, w2)
+
+        t_xla, b_xla, f_xla = measure(xla_chain, args, tiny)
+        t_fuse, b_fuse, _ = measure(
+            lambda *a: fused_chain(*a, interpret=interpret), args, tiny)
+
+        # numerics guard: the experiment is void if the fusion is wrong
+        o1 = np.asarray(xla_chain(*args)[0], np.float32)
+        o2 = np.asarray(fused_chain(*args, interpret=interpret)[0],
+                        np.float32)
+        err = float(np.max(np.abs(o1 - o2)) / (np.abs(o1).max() + 1e-9))
+        assert err < 3e-2, f"{name}: fused mismatch rel={err}"
+
+        row = {"shape": name, "xla_ms": round(t_xla, 3),
+               "fused_ms": round(t_fuse, 3),
+               "speedup": round(t_xla / t_fuse, 3), "rel_err": round(err, 5)}
+        if b_xla and b_fuse:
+            row["xla_gb"] = round(b_xla / 1e9, 3)
+            row["fused_gb"] = round(b_fuse / 1e9, 3)
+            if hbm and on_tpu:
+                row["xla_hbm_pct"] = round(
+                    b_xla / 1e9 / (t_xla / 1e3) / hbm * 100, 1)
+                row["fused_hbm_pct"] = round(
+                    b_fuse / 1e9 / (t_fuse / 1e3) / hbm * 100, 1)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if on_tpu and not tiny:
+        sp = [r["speedup"] for r in rows]
+        verdict = ("fusion MOVES the roofline" if min(sp) > 1.05 else
+                   "fusion is bandwidth-neutral" if max(sp) < 1.05 else
+                   "fusion wins on some stages")
+        print(json.dumps({"verdict": verdict,
+                          "geomean_speedup": round(float(
+                              np.exp(np.mean(np.log(sp)))), 3)}))
+    else:
+        print(json.dumps({"verdict": "plumbing run only (no TPU); the "
+                          "committed experiment needs BENCH_ON_TPU=1"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,39 @@ def flash_whole_odd_length():
     assert err < 5e-2, f"err {err}"
 
 
+def conv_bn_stats_epilogue():
+    from bluefog_tpu.ops.conv_bn import matmul_bn_stats
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(2048, 256)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(256, 128)) / 16.0, jnp.bfloat16)
+    y, mean, var = matmul_bn_stats(x, w)
+    ref = (x.astype(jnp.float32) @ w.astype(jnp.float32))
+    err = float(jnp.max(jnp.abs(y.astype(jnp.float32) - ref)) /
+                (jnp.abs(ref).max() + 1e-9))
+    assert err < 3e-2, f"y rel err {err}"
+    m_err = float(jnp.max(jnp.abs(mean - ref.mean(0))))
+    assert m_err < 5e-2, f"mean err {m_err}"
+
+
+def conv_bn_normalize_prologue():
+    from bluefog_tpu.ops.conv_bn import bn_relu_matmul
+    rng = np.random.default_rng(7)
+    K = 128
+    x = jnp.asarray(rng.normal(size=(2048, K)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(K, 128)) / 11.3, jnp.bfloat16)
+    mean = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    var = jnp.asarray(rng.uniform(0.5, 2.0, size=(K,)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    out = bn_relu_matmul(x, mean, var, gamma, beta, w)
+    xn = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + 1e-5)
+    ref = jnp.maximum(xn * gamma + beta, 0.0).astype(
+        jnp.bfloat16).astype(jnp.float32) @ w.astype(jnp.float32)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)) /
+                (jnp.abs(ref).max() + 1e-9))
+    assert err < 3e-2, f"rel err {err}"
+
+
 def fused_exchange_single_device():
     # degenerate 1-device mesh: checks the kernel LOWERS on hardware
     # (exchange semantics need a multi-chip slice, tested on CPU mesh)
@@ -159,6 +192,8 @@ def main():
     check("flash_attention lse + traced offsets", flash_lse_offsets)
     check("flash_attention 768-length block fit", flash_odd_length)
     check("flash_attention 100-length whole block", flash_whole_odd_length)
+    check("conv_bn matmul stats epilogue", conv_bn_stats_epilogue)
+    check("conv_bn normalize prologue matmul", conv_bn_normalize_prologue)
     check("fused_neighbor_allreduce lowering", fused_exchange_single_device)
     if FAILED:
         print(f"\n{len(FAILED)} kernel check(s) FAILED: {FAILED}")

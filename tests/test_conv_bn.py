@@ -1,0 +1,145 @@
+"""Fused pointwise-conv + BatchNorm kernels vs exact XLA references
+(interpret mode; the hardware lowering runs in scripts/hw_kernel_check.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops.conv_bn import (bn_relu_matmul, fit_tile,
+                                     matmul_bn_stats, pointwise_conv_bn_relu)
+
+
+def _data(M, K, N, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(M, K)), dtype)
+    w = jnp.asarray(rng.normal(size=(K, N)) / np.sqrt(K), dtype)
+    return x, w
+
+
+def test_fit_tile():
+    assert fit_tile(1024, 512) == 512
+    assert fit_tile(384, 512) == 384        # whole length
+    assert fit_tile(768, 512) == 256
+    assert fit_tile(100, 512) == 100        # nothing fits -> whole length
+    assert fit_tile(64, 256, 128) == 64
+
+
+def test_matmul_bn_stats_matches_reference():
+    x, w = _data(256, 128, 128)
+    y, mean, var = matmul_bn_stats(x, w, bm=128, bn=128, bk=64,
+                                   interpret=True)
+    ref = x @ w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(ref.mean(0)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(jnp.var(ref, 0)),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_matmul_bn_stats_narrow_channels():
+    # C=64 rides the whole-length tile exemption (ResNet stage-1 width)
+    x, w = _data(512, 64, 64, seed=1)
+    y, mean, var = matmul_bn_stats(x, w, interpret=True)
+    ref = x @ w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(ref.mean(0)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bn_relu_matmul_matches_reference():
+    M, K, N = 256, 128, 128
+    x, w = _data(M, K, N, seed=2)
+    rng = np.random.default_rng(3)
+    mean = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    var = jnp.asarray(rng.uniform(0.5, 2.0, size=(K,)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(K,)), jnp.float32)
+    out = bn_relu_matmul(x, mean, var, gamma, beta, w, bm=128, bn=128,
+                         bk=64, interpret=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + 1e-5) * gamma + beta
+    ref = jnp.maximum(xn, 0.0) @ w
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_bn_matmul_no_relu():
+    M, K, N = 128, 128, 128
+    x, w = _data(M, K, N, seed=4)
+    z = jnp.zeros((K,), jnp.float32)
+    o = jnp.ones((K,), jnp.float32)
+    out = bn_relu_matmul(x, z, o, o, z, w, relu=False, interpret=True)
+    # identity normalization (mean 0, var 1, gamma 1, beta 0, eps shifts
+    # the scale by rsqrt(1+eps))
+    ref = (x * jax.lax.rsqrt(jnp.float32(1 + 1e-5))) @ w
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_pointwise_chain_matches_xla():
+    """conv1x1 -> BN(train stats) -> ReLU -> conv1x1, NHWC."""
+    B, H, W, C, C2, C3 = 2, 8, 8, 64, 128, 64
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(B, H, W, C)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(C, C2)) / 8.0, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(C2, C3)) / 11.3, jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(C2,)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(C2,)), jnp.float32)
+
+    out, mean, var = pointwise_conv_bn_relu(x, w1, gamma, beta, w2,
+                                            interpret=True)
+
+    y = x.reshape(-1, C) @ w1
+    m, v = y.mean(0), jnp.var(y, axis=0)
+    z = jnp.maximum((y - m) * jax.lax.rsqrt(v + 1e-5) * gamma + beta, 0.0)
+    ref = (z @ w2).reshape(B, H, W, C3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5,
+                               atol=3e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(m), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(v), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_dense_bn_relu_dense_gradients_match_xla():
+    """The custom-VJP trainable wrapper must differentiate exactly like
+    the XLA composition it replaces (BN-train backward through batch
+    statistics included)."""
+    from bluefog_tpu.ops.conv_bn import dense_bn_relu_dense
+    M, K, N1, N2 = 128, 64, 128, 64
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(M, K)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(K, N1)) / 8.0, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(N1, N2)) / 11.3, jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(N1,)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(N1,)), jnp.float32)
+
+    def fused_loss(x, w1, gamma, beta, w2):
+        out, _, _ = dense_bn_relu_dense(x, w1, gamma, beta, w2, 1e-5, True)
+        return (out ** 2).sum()
+
+    def xla_loss(x, w1, gamma, beta, w2):
+        y = x @ w1
+        m, v = y.mean(0), jnp.var(y, axis=0)
+        z = jnp.maximum((y - m) * jax.lax.rsqrt(v + 1e-5) * gamma + beta,
+                        0.0)
+        return ((z @ w2) ** 2).sum()
+
+    gf = jax.grad(fused_loss, argnums=(0, 1, 2, 3, 4))(x, w1, gamma, beta,
+                                                       w2)
+    gr = jax.grad(xla_loss, argnums=(0, 1, 2, 3, 4))(x, w1, gamma, beta, w2)
+    for name, a, b in zip(("x", "w1", "gamma", "beta", "w2"), gf, gr):
+        rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+        assert rel < 2e-4, f"d{name} rel err {rel}"
+
+
+def test_shape_validation():
+    x, w = _data(64, 32, 32)
+    with pytest.raises(ValueError, match="need"):
+        matmul_bn_stats(x, w.T[:16], interpret=True)
+    with pytest.raises(ValueError, match="mean must be"):
+        bn_relu_matmul(x, jnp.zeros((8,)), jnp.ones((32,)),
+                       jnp.ones((32,)), jnp.zeros((32,)), w, interpret=True)
