@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
-from bluefog_tpu.models.resnet import ResNet50
+from bluefog_tpu.models.resnet import ResNet50, ResNet50Fused
 
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
 METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
@@ -218,7 +218,11 @@ def main():
         sched = bf.compile_dynamic_schedule(
             lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # BLUEFOG_FUSED_CONV_BN=1 swaps in the fused 1x1-conv+BN bottleneck
+    # (ops/conv_bn.py — the HBM-roofline attack, docs/performance.md)
+    fused = os.environ.get("BLUEFOG_FUSED_CONV_BN", "0") == "1"
+    model_cls = ResNet50Fused if fused else ResNet50
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
     base = optax.sgd(0.01, momentum=0.9)
     variables, opt_state = T.create_train_state(
         model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))

@@ -11,10 +11,11 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
-           "ResNet152"]
+           "ResNet152", "ResNet50Fused", "FusedBottleneckBlock"]
 
 ModuleDef = Any
 
@@ -40,6 +41,142 @@ class BottleneckBlock(nn.Module):
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1),
+                                 self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class FusedBottleneckBlock(nn.Module):
+    """Bottleneck with the 1x1-conv BN passes fused (ops/conv_bn.py — the
+    HBM-roofline attack, docs/performance.md):
+
+    * conv1 (1x1) runs as ``matmul_bn_stats`` — BN1's reduce rides the
+      conv's output write instead of re-reading HBM;
+    * BN2 -> ReLU -> conv3 (1x1) -> BN3-stats runs as
+      ``bn_relu_matmul_stats`` — the standalone normalize pass and BN3's
+      reduce both disappear;
+    * the 3x3 conv, projection shortcut, and elementwise glue stay XLA.
+
+    Per block that removes three full activation passes of the four BN
+    adds.  Gradients are exact (hand-written per-kernel VJPs); running
+    statistics update exactly like ``nn.BatchNorm`` (momentum 0.9,
+    biased batch variance).  Eval mode (``use_running_average``) takes
+    the plain XLA composition with the same parameters.
+    """
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    force_xla: bool = False   # exact XLA twin of the train path (ablation)
+
+    # marker consumed by make_train_step: pallas kernels inside the
+    # shard_map need check_vma off
+    contains_pallas = True
+
+    def _norm_config(self):
+        """use_running_average / momentum / epsilon from the ``norm``
+        ModuleDef.  The fused path re-implements BN around the kernels,
+        so it must SEE the configuration — which lives in the partial's
+        keywords (how ResNet builds it).  Anything else is rejected
+        loudly rather than silently normalizing with the wrong mode."""
+        kw = getattr(self.norm, "keywords", None)
+        if kw is None:
+            raise TypeError(
+                "FusedBottleneckBlock needs `norm` as a functools.partial "
+                "of nn.BatchNorm (its keywords carry use_running_average/"
+                f"momentum/epsilon); got {self.norm!r}")
+        return (bool(kw.get("use_running_average", False)),
+                float(kw.get("momentum", 0.9)),
+                float(kw.get("epsilon", 1e-5)))
+
+    def _bn_params(self, name, C, zero_scale=False):
+        scale = self.param(
+            f"{name}_scale",
+            nn.initializers.zeros_init() if zero_scale
+            else nn.initializers.ones_init(), (C,), jnp.float32)
+        bias = self.param(f"{name}_bias", nn.initializers.zeros_init(),
+                          (C,), jnp.float32)
+        ra_mean = self.variable("batch_stats", f"{name}_mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", f"{name}_var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        return scale, bias, ra_mean, ra_var
+
+    def _update_ra(self, ra_mean, ra_var, mean, var, momentum):
+        if not self.is_initializing():
+            ra_mean.value = momentum * ra_mean.value + (1 - momentum) * mean
+            ra_var.value = momentum * ra_var.value + (1 - momentum) * var
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.conv_bn import bn_relu_matmul_stats_t, matmul_bn_stats_t
+
+        use_ra, momentum, eps = self._norm_config()
+        dtype = x.dtype
+        C_in = x.shape[-1]
+        f, f4 = self.filters, self.filters * 4
+        init = nn.initializers.lecun_normal()
+        w1 = self.param("conv1_kernel", init, (C_in, f), jnp.float32)
+        g1, b1, ra1m, ra1v = self._bn_params("bn1", f)
+        g2, b2, ra2m, ra2v = self._bn_params("bn2", f)
+        w3 = self.param("conv3_kernel", init, (f, f4), jnp.float32)
+        g3, b3, ra3m, ra3v = self._bn_params("bn3", f4, zero_scale=True)
+
+        def norm_act(y, mean, var, g, b, act=True):
+            out = (y.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+            out = out * g + b
+            return (jnp.maximum(out, 0.0) if act else out).astype(dtype)
+
+        residual = x
+        B, H, W, _ = x.shape
+        x2 = x.reshape(B * H * W, C_in).astype(dtype)
+        w1c, w3c = w1.astype(dtype), w3.astype(dtype)
+        # pallas only on the real train path (init and eval take the plain
+        # XLA composition with the very same parameters)
+        fused = not (use_ra or self.is_initializing() or self.force_xla)
+        interpret = jax.default_backend() != "tpu"
+
+        if fused:
+            y1, m1, v1 = matmul_bn_stats_t(x2, w1c, interpret)
+            self._update_ra(ra1m, ra1v, m1, v1, momentum)
+        else:
+            y1 = x2 @ w1c
+            if use_ra:
+                m1, v1 = ra1m.value, ra1v.value
+            else:
+                m1 = jnp.mean(y1.astype(jnp.float32), axis=0)
+                v1 = jnp.var(y1.astype(jnp.float32), axis=0)
+                self._update_ra(ra1m, ra1v, m1, v1, momentum)
+        z1 = norm_act(y1, m1, v1, g1, b1).reshape(B, H, W, f)
+
+        y2 = self.conv(f, (3, 3), self.strides)(z1)
+        B2, H2, W2 = y2.shape[:3]
+        y2f = y2.reshape(B2 * H2 * W2, f)
+        if use_ra:
+            m2, v2 = ra2m.value, ra2v.value
+        else:
+            m2 = jnp.mean(y2f.astype(jnp.float32), axis=0)
+            v2 = jnp.var(y2f.astype(jnp.float32), axis=0)
+            self._update_ra(ra2m, ra2v, m2, v2, momentum)
+
+        if fused:
+            y3, m3, v3 = bn_relu_matmul_stats_t(y2f, m2, v2, g2, b2, w3c,
+                                                eps, interpret)
+            self._update_ra(ra3m, ra3v, m3, v3, momentum)
+        else:
+            y3 = norm_act(y2f, m2, v2, g2, b2) @ w3c
+            if use_ra:
+                m3, v3 = ra3m.value, ra3v.value
+            else:
+                m3 = jnp.mean(y3.astype(jnp.float32), axis=0)
+                v3 = jnp.var(y3.astype(jnp.float32), axis=0)
+                self._update_ra(ra3m, ra3v, m3, v3, momentum)
+        y = norm_act(y3, m3, v3, g3, b3, act=False)
+        y = y.reshape(B2, H2, W2, f4)
+
+        if residual.shape != y.shape:
+            residual = self.conv(f4, (1, 1),
                                  self.strides, name="conv_proj")(residual)
             residual = self.norm(name="norm_proj")(residual)
         return self.act(residual + y)
@@ -108,6 +245,10 @@ class ResNet(nn.Module):
 
 
 ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+# ResNet-50 with the fused 1x1-conv+BN bottleneck (the roofline attack;
+# bench.py selects it via BLUEFOG_FUSED_CONV_BN=1)
+ResNet50Fused = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                        block_cls=FusedBottleneckBlock)
 ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)
 ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock)

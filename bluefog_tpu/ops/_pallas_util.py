@@ -1,0 +1,17 @@
+"""Shared helpers for Pallas kernels."""
+
+import jax
+
+__all__ = ["out_struct"]
+
+
+def out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
+    operands' (required by shard_map's check_vma for pallas outputs)."""
+    vma = set()
+    for x in operands:
+        vma |= set(getattr(jax.typeof(x), "vma", ()) or ())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:      # older JAX without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)

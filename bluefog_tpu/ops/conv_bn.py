@@ -41,8 +41,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["matmul_bn_stats", "bn_relu_matmul", "pointwise_conv_bn_relu",
-           "dense_bn_relu_dense", "fit_tile"]
+__all__ = ["matmul_bn_stats", "bn_relu_matmul", "bn_relu_matmul_stats",
+           "matmul_bn_stats_t", "bn_relu_matmul_stats_t",
+           "pointwise_conv_bn_relu", "dense_bn_relu_dense", "fit_tile"]
 
 _DIMS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
@@ -60,6 +61,18 @@ def fit_tile(dim: int, tile: int, minimum: int = 8) -> int:
 def _check_2d(x, w):
     if x.ndim != 2 or w.ndim != 2 or x.shape[1] != w.shape[0]:
         raise ValueError(f"need [M, K] @ [K, N], got {x.shape} @ {w.shape}")
+
+
+from ._pallas_util import out_struct as _out_struct  # noqa: E402
+
+
+def _pad8(row):
+    """One stats row in an 8-sublane tile (rows 1-7 zero): (1, bn) output
+    blocks are an illegal sublane-1 tile on hardware — the round-1 flash
+    lesson — so partial sums ship as (8, bn) blocks and the zero rows
+    vanish in the host-side sum."""
+    return jnp.concatenate(
+        [row, jnp.zeros((7, row.shape[1]), row.dtype)], axis=0)
 
 
 def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, sq_ref, acc_ref, *, nk):
@@ -80,8 +93,8 @@ def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, sq_ref, acc_ref, *, nk):
         y = acc_ref[...]
         y_ref[...] = y.astype(y_ref.dtype)
         # stats epilogue: the tile is still in VMEM — no HBM re-read
-        s_ref[...] = jnp.sum(y, axis=0, keepdims=True)
-        sq_ref[...] = jnp.sum(y * y, axis=0, keepdims=True)
+        s_ref[...] = _pad8(jnp.sum(y, axis=0, keepdims=True))
+        sq_ref[...] = _pad8(jnp.sum(y * y, axis=0, keepdims=True))
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret"))
@@ -107,19 +120,19 @@ def matmul_bn_stats(x, w, *, bm: int = 512, bn: int = 256, bk: int = 256,
         ],
         out_specs=[
             pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
-            pl.BlockSpec((1, bn), lambda m, n, k: (m, n)),
-            pl.BlockSpec((1, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((8, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((8, bn), lambda m, n, k: (m, n)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((M, N), x.dtype),
-            jax.ShapeDtypeStruct((nm, N), jnp.float32),
-            jax.ShapeDtypeStruct((nm, N), jnp.float32),
+            _out_struct((M, N), x.dtype, x, w),
+            _out_struct((nm * 8, N), jnp.float32, x, w),
+            _out_struct((nm * 8, N), jnp.float32, x, w),
         ],
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=_DIMS,
         interpret=interpret,
     )(x, w)
-    # folding [nm, N] partials is nm*N elements — noise next to M*N
+    # folding [8*nm, N] partials (7/8 zero rows) is noise next to M*N
     s = psum.sum(axis=0)
     sq = psumsq.sum(axis=0)
     mean = s / M
@@ -178,11 +191,169 @@ def bn_relu_matmul(x, mean, var, gamma, beta, w, *, relu: bool = True,
             pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
-        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        out_shape=_out_struct((M, N), x.dtype, x, mean, var, gamma, beta, w),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=_DIMS,
         interpret=interpret,
     )(x, row(mean), row(inv), row(gamma), row(beta), w)
+
+
+def _bn_mm_stats_kernel(x_ref, mu_ref, iv_ref, g_ref, b_ref, w_ref, y_ref,
+                        s_ref, sq_ref, acc_ref, *, nk, relu):
+    """Normalize prologue AND stats epilogue in one kernel: the bottleneck's
+    BN2 -> ReLU -> conv3 -> BN3-stats chain as one pass over the input."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xn = (x_ref[...].astype(jnp.float32) - mu_ref[...]) * iv_ref[...]
+    xn = xn * g_ref[...] + b_ref[...]
+    if relu:
+        xn = jnp.maximum(xn, 0.0)
+    acc_ref[...] += jnp.dot(xn.astype(x_ref.dtype), w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _emit():
+        y = acc_ref[...]
+        y_ref[...] = y.astype(y_ref.dtype)
+        s_ref[...] = _pad8(jnp.sum(y, axis=0, keepdims=True))
+        sq_ref[...] = _pad8(jnp.sum(y * y, axis=0, keepdims=True))
+
+
+@functools.partial(jax.jit, static_argnames=("relu", "eps", "bm", "bn", "bk",
+                                             "interpret"))
+def bn_relu_matmul_stats(x, mean, var, gamma, beta, w, *, relu: bool = True,
+                         eps: float = 1e-5, bm: int = 512, bn: int = 256,
+                         bk: int = 256, interpret: bool = False):
+    """``relu(norm(x)) @ w`` plus batch statistics of the OUTPUT, fused:
+    the normalize rides the matmul's input read (no standalone pass) and
+    the next BN's reduce rides the output write (no re-read).  Returns
+    ``(y, mean_y, var_y)``."""
+    _check_2d(x, w)
+    M, K = x.shape
+    N = w.shape[1]
+    for name, v in (("mean", mean), ("var", var), ("gamma", gamma),
+                    ("beta", beta)):
+        if v.shape != (K,):
+            raise ValueError(f"{name} must be [{K}], got {v.shape}")
+    bm, bn, bk = fit_tile(M, bm), fit_tile(N, bn, 128), fit_tile(K, bk, 128)
+    nm, nn, nk = M // bm, N // bn, K // bk
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    row = lambda v: v.astype(jnp.float32).reshape(1, K)
+
+    vec_spec = pl.BlockSpec((1, bk), lambda m, n, k: (0, k))
+    y, psum, psumsq = pl.pallas_call(
+        functools.partial(_bn_mm_stats_kernel, nk=nk, relu=relu),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            vec_spec, vec_spec, vec_spec, vec_spec,
+            pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((8, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((8, bn), lambda m, n, k: (m, n)),
+        ],
+        out_shape=[
+            _out_struct((M, N), x.dtype, x, mean, var, gamma, beta, w),
+            _out_struct((nm * 8, N), jnp.float32, x, mean, var, gamma,
+                        beta, w),
+            _out_struct((nm * 8, N), jnp.float32, x, mean, var, gamma,
+                        beta, w),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_DIMS,
+        interpret=interpret,
+    )(x, row(mean), row(inv), row(gamma), row(beta), w)
+    s, sq = psum.sum(axis=0), psumsq.sum(axis=0)
+    mean_y = s / M
+    var_y = sq / M - mean_y * mean_y
+    return y, mean_y, var_y
+
+
+# ---------------------------------------------------------------------------
+# Per-kernel trainable wrappers (custom VJPs with hand-written backward
+# math over stored inputs — no forward recompute, so the fused forward's
+# bandwidth win survives training)
+# ---------------------------------------------------------------------------
+
+def _stats_dy(gy, gm, gv, y, mean, M):
+    """Cotangent into y from (y, mean, var) outputs where mean/var are the
+    batch stats of y: m = E[y], v = E[y^2] - m^2."""
+    gy = gy.astype(jnp.float32)
+    d = gy + (gm - 2.0 * mean * gv) / M
+    return d + (2.0 / M) * y.astype(jnp.float32) * gv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def matmul_bn_stats_t(x, w, interpret: bool = False):
+    """Trainable :func:`matmul_bn_stats`."""
+    return matmul_bn_stats(x, w, interpret=interpret)
+
+
+def _mbs_fwd(x, w, interpret):
+    y, mean, var = matmul_bn_stats(x, w, interpret=interpret)
+    return (y, mean, var), (x, w, y, mean)
+
+
+def _mbs_bwd(interpret, res, cts):
+    x, w, y, mean = res
+    gy, gm, gv = cts
+    d_y = _stats_dy(gy, gm, gv, y, mean, y.shape[0])
+    d_x = d_y @ w.astype(jnp.float32).T
+    d_w = x.astype(jnp.float32).T @ d_y
+    return d_x.astype(x.dtype), d_w.astype(w.dtype)
+
+
+matmul_bn_stats_t.defvjp(_mbs_fwd, _mbs_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def bn_relu_matmul_stats_t(x, mean, var, gamma, beta, w,
+                           eps: float = 1e-5, interpret: bool = False):
+    """Trainable :func:`bn_relu_matmul_stats`.  ``mean``/``var`` are
+    ordinary differentiable inputs (their dependence on ``x`` — the outer
+    reduce — backpropagates through the caller's autodiff)."""
+    return bn_relu_matmul_stats(x, mean, var, gamma, beta, w, eps=eps,
+                                interpret=interpret)
+
+
+def _brms_fwd(x, mean, var, gamma, beta, w, eps, interpret):
+    y, my, vy = bn_relu_matmul_stats(x, mean, var, gamma, beta, w, eps=eps,
+                                     interpret=interpret)
+    return (y, my, vy), (x, mean, var, gamma, beta, w, y, my)
+
+
+def _brms_bwd(eps, interpret, res, cts):
+    x, mean, var, gamma, beta, w, y, my = res
+    gy, gmy, gvy = cts
+    f32 = jnp.float32
+    M = x.shape[0]
+    d_y = _stats_dy(gy, gmy, gvy, y, my, M)
+    inv = jax.lax.rsqrt(var.astype(f32) + eps)
+    xhat = (x.astype(f32) - mean) * inv
+    z = xhat * gamma + beta
+    r = jnp.maximum(z, 0.0)
+    d_r = d_y @ w.astype(f32).T
+    d_w = r.T @ d_y
+    d_z = d_r * (z > 0)
+    d_gamma = jnp.sum(d_z * xhat, axis=0)
+    d_beta = jnp.sum(d_z, axis=0)
+    d_xhat = d_z * gamma
+    d_x = d_xhat * inv
+    d_mean = -inv * jnp.sum(d_xhat, axis=0)
+    d_var = -0.5 * inv ** 3 * jnp.sum(d_xhat * (x.astype(f32) - mean),
+                                      axis=0)
+    return (d_x.astype(x.dtype), d_mean.astype(mean.dtype),
+            d_var.astype(var.dtype), d_gamma.astype(gamma.dtype),
+            d_beta.astype(beta.dtype), d_w.astype(w.dtype))
+
+
+bn_relu_matmul_stats_t.defvjp(_brms_fwd, _brms_bwd)
 
 
 # ---------------------------------------------------------------------------

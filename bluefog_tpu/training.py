@@ -15,6 +15,8 @@ statistics stay rank-local like the reference's torch buffers (only
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -65,7 +67,8 @@ def make_train_step(model,
                     atc: bool = False,
                     sched: Optional[DynamicSchedule] = None,
                     num_steps_per_communication: int = 1,
-                    donate: bool = True):
+                    donate: bool = True,
+                    check_vma: Optional[bool] = None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -97,6 +100,20 @@ def make_train_step(model,
     # reading the env at trace time would freeze whatever the first call
     # saw and silently ignore later env changes)
     nar_backend = _api._nar_backend()
+    if check_vma is None:
+        # any pallas kernel inside the shard_map needs vma checking off
+        # (kernel-internal scratch carries no varying-axes tags): the
+        # fused exchange backend, or a model carrying pallas kernels —
+        # detected by the `contains_pallas` marker on the model or its
+        # block class (e.g. FusedBottleneckBlock); the env var remains as
+        # an override for custom models without the marker
+        model_pallas = bool(
+            getattr(model, "contains_pallas", False)
+            or getattr(getattr(model, "block_cls", None),
+                       "contains_pallas", False))
+        check_vma = not (
+            nar_backend.startswith("pallas") or model_pallas
+            or os.environ.get("BLUEFOG_FUSED_CONV_BN", "0") == "1")
     if grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
@@ -150,7 +167,7 @@ def make_train_step(model,
             shard_fn, mesh=pl.mesh,
             in_specs=(pl.spec, pl.spec, pl.spec, P()),
             out_specs=(pl.spec, pl.spec, P()),
-            check_vma=not nar_backend.startswith("pallas"),
+            check_vma=check_vma,
         )(v2, o2, b2, step_idx)
         return pl.reshape_out(v_out), pl.reshape_out(o_out), loss
 
