@@ -81,14 +81,17 @@ class FusedBottleneckBlock(nn.Module):
         keywords (how ResNet builds it).  Anything else is rejected
         loudly rather than silently normalizing with the wrong mode."""
         kw = getattr(self.norm, "keywords", None)
-        if kw is None:
+        if kw is None or "use_running_average" not in kw:
             raise TypeError(
                 "FusedBottleneckBlock needs `norm` as a functools.partial "
-                "of nn.BatchNorm (its keywords carry use_running_average/"
-                f"momentum/epsilon); got {self.norm!r}")
-        return (bool(kw.get("use_running_average", False)),
-                float(kw.get("momentum", 0.9)),
-                float(kw.get("epsilon", 1e-5)))
+                "of nn.BatchNorm carrying use_running_average (plus "
+                f"momentum/epsilon if non-default); got {self.norm!r}")
+        # absent knobs fall back to nn.BatchNorm's own defaults so the
+        # fused BNs and the norm_proj (instantiated from the same
+        # partial) can never diverge
+        return (bool(kw["use_running_average"]),
+                float(kw.get("momentum", nn.BatchNorm.momentum)),
+                float(kw.get("epsilon", nn.BatchNorm.epsilon)))
 
     def _bn_params(self, name, C, zero_scale=False):
         scale = self.param(

@@ -277,8 +277,9 @@ def bn_relu_matmul_stats(x, mean, var, gamma, beta, w, *, relu: bool = True,
 
 # ---------------------------------------------------------------------------
 # Per-kernel trainable wrappers (custom VJPs with hand-written backward
-# math over stored inputs — no forward recompute, so the fused forward's
-# bandwidth win survives training)
+# math over stored inputs — no matmul or stats recompute; the backward
+# does re-derive the cheap elementwise normalize/ReLU intermediates from
+# the stored input)
 # ---------------------------------------------------------------------------
 
 def _stats_dy(gy, gm, gv, y, mean, M):

@@ -15,8 +15,6 @@ statistics stay rank-local like the reference's torch buffers (only
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -105,15 +103,13 @@ def make_train_step(model,
         # (kernel-internal scratch carries no varying-axes tags): the
         # fused exchange backend, or a model carrying pallas kernels —
         # detected by the `contains_pallas` marker on the model or its
-        # block class (e.g. FusedBottleneckBlock); the env var remains as
-        # an override for custom models without the marker
+        # block class (e.g. FusedBottleneckBlock).  Custom pallas-bearing
+        # models without the marker pass check_vma=False explicitly.
         model_pallas = bool(
             getattr(model, "contains_pallas", False)
             or getattr(getattr(model, "block_cls", None),
                        "contains_pallas", False))
-        check_vma = not (
-            nar_backend.startswith("pallas") or model_pallas
-            or os.environ.get("BLUEFOG_FUSED_CONV_BN", "0") == "1")
+        check_vma = not (nar_backend.startswith("pallas") or model_pallas)
     if grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(

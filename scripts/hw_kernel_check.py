@@ -178,6 +178,11 @@ def conv_bn_combined_kernel():
                 (jnp.abs(ref).max() + 1e-9))
     assert err < 3e-2, f"y rel err {err}"
     assert float(jnp.max(jnp.abs(my - ref.mean(0)))) < 5e-2
+    # vy exercises the sumsq/_pad8 tile path — the exact layout class the
+    # round-1 flash lesson is about
+    v_err = float(jnp.max(jnp.abs(vy - jnp.var(ref, axis=0))) /
+                  (float(jnp.var(ref)) + 1e-9))
+    assert v_err < 5e-2, f"vy rel err {v_err}"
 
 
 def fused_bottleneck_train_grad():

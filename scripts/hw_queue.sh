@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# The round-3 hardware re-verification queue (VERDICT r2 #1/#2), one
+# command: run every hardware-blocked measurement in priority order and
+# tee everything to a log the round can cite.  Safe to re-run; each stage
+# is independent.  Requires a live TPU backend.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+LOG=${1:-hw_queue_r3.log}
+run() {
+    echo "=== $* ===" | tee -a "$LOG"
+    timeout "${STAGE_TIMEOUT:-1200}" "$@" 2>&1 | tee -a "$LOG"
+    echo "=== exit $? ===" | tee -a "$LOG"
+}
+echo "hw queue started $(date -u +%FT%TZ)" | tee -a "$LOG"
+run python bench.py
+run python scripts/hw_kernel_check.py
+run env BENCH_ON_TPU=1 python scripts/conv_bn_probe.py
+run env BLUEFOG_FUSED_CONV_BN=1 python bench.py
+run python scripts/perf_probe.py
+run python scripts/flash_tune.py
+run python scripts/lm_bench.py
+run python scripts/lm_bench.py --remat
+run python scripts/scale_bench.py
+run python scripts/convergence_parity.py --include-resnet
+echo "hw queue done $(date -u +%FT%TZ)" | tee -a "$LOG"

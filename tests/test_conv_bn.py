@@ -196,16 +196,16 @@ def test_per_kernel_vjps_match_xla():
         assert rel < 2e-4, f"d{name} rel err {rel}"
 
 
-def _bottleneck_pair(force_xla):
+def _bottleneck_pair(force_xla, strides=(1, 1), dtype=jnp.float32):
     import flax.linen as nn
     from functools import partial
     from bluefog_tpu.models.resnet import FusedBottleneckBlock
-    conv = partial(nn.Conv, use_bias=False, dtype=jnp.float32,
+    conv = partial(nn.Conv, use_bias=False, dtype=dtype,
                    param_dtype=jnp.float32)
     norm = partial(nn.BatchNorm, use_running_average=False, momentum=0.9,
-                   epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32,
+                   epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32,
                    axis_name=None)
-    return FusedBottleneckBlock(filters=16, strides=(1, 1), conv=conv,
+    return FusedBottleneckBlock(filters=16, strides=strides, conv=conv,
                                 norm=norm, act=nn.relu, force_xla=force_xla)
 
 
@@ -245,6 +245,54 @@ def test_fused_bottleneck_matches_xla_twin():
                    key=lambda kv: str(kv[0]))):
         rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
         assert rel < 5e-4, f"{kf}: rel err {rel}"
+
+
+def test_fused_bottleneck_stride2_matches_xla_twin():
+    """Stride-2 block (stage boundary): the 3x3 shrinks the spatial dims
+    and the projection shortcut runs — fused still equals the twin."""
+    fused = _bottleneck_pair(False, strides=(2, 2))
+    twin = _bottleneck_pair(True, strides=(2, 2))
+    x = jnp.asarray(np.random.default_rng(14).normal(size=(2, 8, 8, 32)),
+                    jnp.float32)
+    variables = fused.init(jax.random.key(2), x)
+    out_f, _ = fused.apply(variables, x, mutable=["batch_stats"])
+    out_x, _ = twin.apply(variables, x, mutable=["batch_stats"])
+    assert out_f.shape == (2, 4, 4, 64)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_fused_bottleneck_bf16():
+    """bf16 activations (the bench dtype): fused output tracks the XLA
+    twin within bf16 tolerance and stats stay f32/finite."""
+    fused = _bottleneck_pair(False, dtype=jnp.bfloat16)
+    twin = _bottleneck_pair(True, dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.default_rng(15).normal(size=(2, 8, 8, 32)),
+                    jnp.bfloat16)
+    variables = fused.init(jax.random.key(3), x)
+    out_f, mut = fused.apply(variables, x, mutable=["batch_stats"])
+    out_x, _ = twin.apply(variables, x, mutable=["batch_stats"])
+    assert out_f.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_f, np.float32),
+                               np.asarray(out_x, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    for leaf in jax.tree.leaves(mut):
+        assert leaf.dtype == jnp.float32
+        assert bool(jnp.isfinite(leaf).all())
+
+
+def test_fused_bottleneck_rejects_opaque_norm():
+    """A norm ModuleDef that is not a partial (no readable config) is a
+    loud TypeError, not silent wrong-mode normalization."""
+    import flax.linen as nn
+    from functools import partial
+    from bluefog_tpu.models.resnet import FusedBottleneckBlock
+    conv = partial(nn.Conv, use_bias=False)
+    blk = FusedBottleneckBlock(filters=8, strides=(1, 1), conv=conv,
+                               norm=nn.BatchNorm, act=nn.relu)
+    x = jnp.zeros((1, 4, 4, 8), jnp.float32)
+    with pytest.raises(TypeError, match="functools.partial"):
+        blk.init(jax.random.key(4), x)
 
 
 def test_resnet50_fused_forward_and_eval():
