@@ -59,7 +59,8 @@ class FusedBottleneckBlock(nn.Module):
 
     Per block that removes three full activation passes of the four BN
     adds.  Gradients are exact (hand-written per-kernel VJPs); running
-    statistics update exactly like ``nn.BatchNorm`` (momentum 0.9,
+    statistics update exactly like ``nn.BatchNorm`` (the norm partial's
+    momentum/epsilon, falling back to nn.BatchNorm's own defaults;
     biased batch variance).  Eval mode (``use_running_average``) takes
     the plain XLA composition with the same parameters.
     """

@@ -6,10 +6,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 LOG=${1:-hw_queue_r3.log}
+FAILED=0
 run() {
     echo "=== $* ===" | tee -a "$LOG"
     timeout "${STAGE_TIMEOUT:-1200}" "$@" 2>&1 | tee -a "$LOG"
-    echo "=== exit $? ===" | tee -a "$LOG"
+    local rc=${PIPESTATUS[0]}
+    echo "=== exit $rc ===" | tee -a "$LOG"
+    [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
+    return 0
 }
 echo "hw queue started $(date -u +%FT%TZ)" | tee -a "$LOG"
 run python bench.py
@@ -22,4 +26,5 @@ run python scripts/lm_bench.py
 run python scripts/lm_bench.py --remat
 run python scripts/scale_bench.py
 run python scripts/convergence_parity.py --include-resnet
-echo "hw queue done $(date -u +%FT%TZ)" | tee -a "$LOG"
+echo "hw queue done $(date -u +%FT%TZ), $FAILED stage(s) failed" | tee -a "$LOG"
+exit $((FAILED > 0))

@@ -156,6 +156,34 @@ for shard in out2.addressable_shards:
                                np.full((1, 4), expected[r], np.float32),
                                rtol=1e-5)
 print(f"MULTIHOST_OK {pid}", flush=True)
+
+# hierarchical: the machine axis spans the PROCESS boundary — on real
+# pods that is the DCN seam (SURVEY hard part 5); local pmean rides
+# intra-process ICI, the machine exchange crosses processes
+bf.shutdown()
+cx = bf.init(nodes_per_machine=2)
+assert bf.machine_size() == 2 and bf.local_size() == 2
+bf.set_machine_topology(bf.RingGraph(2), is_weighted=True)
+mt = cx.compiled_machine_topology
+sh2 = NamedSharding(cx.mesh_2d, P(cx.machine_axis, cx.local_axis))
+g2 = jax.make_array_from_process_local_data(sh2, local.reshape(1, 2, 4))
+
+def hier_fn(xs):
+    return C.hierarchical_neighbor_allreduce(
+        xs[0, 0], cx.machine_axis, cx.local_axis, mt)[None, None]
+
+out3 = jax.jit(jax.shard_map(
+    hier_fn, mesh=cx.mesh_2d,
+    in_specs=P(cx.machine_axis, cx.local_axis),
+    out_specs=P(cx.machine_axis, cx.local_axis)))(g2)
+W = np.asarray(mt.weight_matrix)
+expected_m = W.T @ np.array([0.5, 2.5])   # machine means of rank values
+for shard in out3.addressable_shards:
+    m = shard.index[0].start
+    np.testing.assert_allclose(
+        np.asarray(shard.data), np.full((1, 1, 4), expected_m[m],
+                                        np.float32), rtol=1e-5)
+print(f"MULTIHOST_HIER_OK {pid}", flush=True)
 """
 
 
@@ -183,6 +211,8 @@ def test_bfrun_two_process_jax_distributed(tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "MULTIHOST_OK 0" in out.stdout
     assert "MULTIHOST_OK 1" in out.stdout
+    assert "MULTIHOST_HIER_OK 0" in out.stdout
+    assert "MULTIHOST_HIER_OK 1" in out.stdout
 
 
 def test_ibfrun_multihost_cluster(tmp_path):
