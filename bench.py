@@ -261,12 +261,14 @@ def main():
         step_fn = compiled
     except Exception:
         pass
-    if fused:
+    if fused and peak_flops_per_chip():
         # pallas kernels report no FLOPs to XLA's cost analysis, so the
         # fused program's count undercounts; the force_xla twin runs the
         # mathematically identical step through plain XLA — lower IT for
         # the FLOP number only (execution stays on the fused program).
-        # No honest count -> no mfu field.
+        # No honest count -> no mfu field.  Skipped when no peak table
+        # entry exists (mfu can never be emitted — don't pay the second
+        # compile).
         try:
             from functools import partial as _partial
             from bluefog_tpu.models.resnet import FusedBottleneckBlock
