@@ -54,7 +54,17 @@ __all__ = [
 
 
 class _Window:
-    """Device-resident window state for one name."""
+    """Device-resident window state for one name.
+
+    ``tensor`` may be a whole PYTREE: every window op then moves the full
+    tree in ONE jitted SPMD program — the TPU-native equivalent of the
+    reference's fusion buffers (mpi_controller.cc:561-743 packs all
+    tensors into one `[self | n1, n2...]` buffer per transmission; here
+    XLA schedules the per-leaf ppermutes of a single program together).
+    Versions and the associated-P scalar stay per-WINDOW (one counter set,
+    one P per rank — every op touches all leaves together), exactly like
+    the reference's per-window metadata.
+    """
 
     def __init__(self, tensor, topo: CompiledTopology, zero_init: bool):
         cx = ctx()
@@ -64,19 +74,27 @@ class _Window:
         # (irregular graphs — StarGraph etc. — work, VERDICT r1 missing #2)
         self.indeg = int(topo.in_degrees().max(initial=0))
         sharding = _api.rank_sharding()
-        self.tensor = jax.device_put(jnp.asarray(tensor), sharding)
-        shape = self.tensor.shape  # [N, *S]
-        if zero_init:
-            buf = jnp.zeros((shape[0], self.indeg) + shape[1:], self.tensor.dtype)
-        else:
+        self.tensor = jax.tree.map(
+            lambda t: jax.device_put(jnp.asarray(t), sharding), tensor)
+        self.treedef = jax.tree.structure(self.tensor)
+        leaves = jax.tree.leaves(self.tensor)
+        if not leaves:
+            raise ValueError("window tensor pytree has no leaves")
+        n = leaves[0].shape[0]
+
+        def make_buf(t):
+            if zero_init:
+                return jnp.zeros((t.shape[0], self.indeg) + t.shape[1:],
+                                 t.dtype)
             # reference initializes neighbor buffers with the local tensor
             # value (mpi_ops.py:1003-1006)
-            buf = jnp.broadcast_to(
-                self.tensor[:, None], (shape[0], self.indeg) + shape[1:])
-        self.buffers = jax.device_put(buf, sharding)
-        self.versions = jnp.zeros((shape[0], self.indeg), jnp.int32)
-        self.p = jnp.ones((shape[0],), jnp.float32)
-        self.p_buffers = jnp.zeros((shape[0], self.indeg), jnp.float32)
+            return jnp.broadcast_to(
+                t[:, None], (t.shape[0], self.indeg) + t.shape[1:])
+        self.buffers = jax.tree.map(
+            lambda t: jax.device_put(make_buf(t), sharding), self.tensor)
+        self.versions = jnp.zeros((n, self.indeg), jnp.int32)
+        self.p = jnp.ones((n,), jnp.float32)
+        self.p_buffers = jnp.zeros((n, self.indeg), jnp.float32)
 
 
 _windows: Dict[str, _Window] = {}
@@ -125,19 +143,24 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     """Create a window: per-in-neighbor device buffers + versions + P
     (reference mpi_ops.py:998, mpi_controller.cc:793-866).
 
+    ``tensor`` may be a whole PYTREE (e.g. model parameters): every
+    window op then moves the full tree in one jitted program — the
+    fusion-buffer equivalent (see :class:`_Window`).
+
     The topology is snapshotted at creation; like the reference
     (operations.cc:1286-1311), changing the topology while windows exist is
     refused by ``bf.set_topology``.
     """
-    cx = ctx()
-    topo = cx.compiled_topology
-    tensor = jnp.asarray(tensor)
-    if tensor.shape[0] != cx.size:
-        raise ValueError(
-            f"window tensors are global-view: expected leading dim "
-            f"{cx.size}, got {tensor.shape}")
     if name in _windows:
         return False  # duplicate name (reference returns False, mpi_ops.py:1021)
+    cx = ctx()
+    topo = cx.compiled_topology
+    tensor = jax.tree.map(jnp.asarray, tensor)
+    for leaf in jax.tree.leaves(tensor):
+        if leaf.shape[0] != cx.size:
+            raise ValueError(
+                f"window tensors are global-view: expected leading dim "
+                f"{cx.size}, got {leaf.shape}")
     _windows[name] = _Window(tensor, topo, zero_init)
     return True
 
@@ -174,6 +197,10 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
     add), bumps versions, optionally moves associated P with the same
     weights, then scales the local tensor/P by self_weight
     (mpi_controller.cc:950-1031; self scaling per mpi_ops.py:1152-1155).
+
+    ``x``/``buffers`` may be PYTREES — the whole tree moves in this one
+    program (fusion-buffer equivalent; jit's cache keys on the tree
+    structure, so arrays and trees coexist).
     """
     cx = ctx()
     size = topo.size
@@ -183,23 +210,30 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
 
     def wrapper(x, buffers, versions, p, p_buffers, D, self_w, with_p):
         def shard_fn(xs, bufs, vers, ps, pbufs, D_, self_w_, with_p_):
-            x_r, buf, ver, p_r, pbuf = xs[0], bufs[0], vers[0], ps[0], pbufs[0]
+            x_t = jax.tree.map(lambda a: a[0], xs)
+            buf_t = jax.tree.map(lambda a: a[0], bufs)
+            ver, p_r, pbuf = vers[0], ps[0], pbufs[0]
             idx = lax.axis_index(cx.rank_axis)
             ar = jnp.arange(size)
             for k, offset in enumerate(topo.offsets):
-                send_w = D_[ar, (ar + offset) % size][idx].astype(x_r.dtype)
+                send_w = D_[ar, (ar + offset) % size][idx]
                 has_edge = (D_[(ar - offset) % size, ar] != 0)[idx]
-                arrived = lax.ppermute(
-                    send_w * x_r, cx.rank_axis, _rotation_pairs(size, offset))
                 slot = jnp.asarray(slots[k])[idx]
-                old = buf[slot]
-                new = arrived + old if accumulate else arrived
-                buf = buf.at[slot].set(
-                    jnp.where(has_edge, new, old), mode="drop")
+
+                def leaf_exchange(x_r, buf):
+                    arrived = lax.ppermute(
+                        send_w.astype(x_r.dtype) * x_r, cx.rank_axis,
+                        _rotation_pairs(size, offset))
+                    old = buf[slot]
+                    new = arrived + old if accumulate else arrived
+                    return buf.at[slot].set(
+                        jnp.where(has_edge, new, old), mode="drop")
+
+                buf_t = jax.tree.map(leaf_exchange, x_t, buf_t)
                 ver = ver.at[slot].add(
                     jnp.where(has_edge, 1, 0), mode="drop")
-                # associated P rides the same edges/weights
-                p_send = D_[ar, (ar + offset) % size][idx] * p_r
+                # associated P rides the same edges/weights, once per window
+                p_send = send_w * p_r
                 p_arr = lax.ppermute(
                     p_send, cx.rank_axis, _rotation_pairs(size, offset))
                 p_old = pbuf[slot]
@@ -207,9 +241,11 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
                 pbuf = pbuf.at[slot].set(
                     jnp.where(with_p_ & has_edge, p_new, p_old), mode="drop")
             sw = self_w_[idx]  # [N] vector, P() spec: unsliced
-            x_out = x_r * sw.astype(x_r.dtype)
+            x_out = jax.tree.map(lambda x_r: x_r * sw.astype(x_r.dtype), x_t)
             p_out = jnp.where(with_p_, p_r * sw, p_r)
-            return (x_out[None], buf[None], ver[None], p_out[None], pbuf[None])
+            lead = lambda t: jax.tree.map(lambda a: a[None], t)
+            return (lead(x_out), lead(buf_t), ver[None], p_out[None],
+                    pbuf[None])
         return jax.shard_map(
             shard_fn, mesh=cx.mesh,
             in_specs=(spec, spec, spec, spec, spec, P(), P(), P()),
@@ -231,11 +267,13 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
 
     def wrapper(x, buffers, versions, p, p_buffers, U, self_w, reset, with_p):
         def shard_fn(xs, bufs, vers, ps, pbufs, U_, self_w_, reset_, with_p_):
-            x_r, buf, ver, p_r, pbuf = xs[0], bufs[0], vers[0], ps[0], pbufs[0]
+            x_t = jax.tree.map(lambda a: a[0], xs)
+            buf_t = jax.tree.map(lambda a: a[0], bufs)
+            ver, p_r, pbuf = vers[0], ps[0], pbufs[0]
             idx = lax.axis_index(cx.rank_axis)
             ar = jnp.arange(size)
             sw = self_w_[idx]  # self_w_ is the [N] vector (P() spec: unsliced)
-            out = sw.astype(x_r.dtype) * x_r
+            out_t = jax.tree.map(lambda x_r: sw.astype(x_r.dtype) * x_r, x_t)
             p_out = sw * p_r
             for k, offset in enumerate(topo.offsets):
                 w = U_[(ar - offset) % size, ar][idx]
@@ -244,19 +282,25 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
                 edge = jnp.asarray(has_edge)[idx]
                 slot = jnp.asarray(slots[k])[idx]
                 contrib = jnp.where(edge, w, 0.0)
-                out = out + contrib.astype(x_r.dtype) * buf[slot]
-                p_out = p_out + contrib * pbuf[slot]
                 include = edge & (w != 0)
-                buf = buf.at[slot].set(
-                    jnp.where(reset_ & include, jnp.zeros_like(buf[slot]),
-                              buf[slot]), mode="drop")
+                out_t = jax.tree.map(
+                    lambda o, buf: o + contrib.astype(o.dtype) * buf[slot],
+                    out_t, buf_t)
+                p_out = p_out + contrib * pbuf[slot]
+                buf_t = jax.tree.map(
+                    lambda buf: buf.at[slot].set(
+                        jnp.where(reset_ & include,
+                                  jnp.zeros_like(buf[slot]), buf[slot]),
+                        mode="drop"), buf_t)
                 pbuf = pbuf.at[slot].set(
                     jnp.where(reset_ & include & with_p_, 0.0, pbuf[slot]),
                     mode="drop")
                 ver = ver.at[slot].set(
                     jnp.where(include, 0, ver[slot]), mode="drop")
             p_final = jnp.where(with_p_, p_out, p_r)
-            return (out[None], buf[None], ver[None], p_final[None], pbuf[None])
+            lead = lambda t: jax.tree.map(lambda a: a[None], t)
+            return (lead(out_t), lead(buf_t), ver[None], p_final[None],
+                    pbuf[None])
         return jax.shard_map(
             shard_fn, mesh=cx.mesh,
             in_specs=(spec, spec, spec, spec, spec, P(), P(), P(), P()),
@@ -381,6 +425,17 @@ def _update_matrix(topo: CompiledTopology,
 # Public API
 # ---------------------------------------------------------------------------
 
+def _win_input(tensor, w: "_Window"):
+    """Caller data -> global-view tree matching the window's leaf dtypes."""
+    if jax.tree.structure(tensor) != w.treedef:
+        raise ValueError(
+            f"window tensor structure mismatch: window holds "
+            f"{w.treedef}, got {jax.tree.structure(tensor)}")
+    return jax.tree.map(
+        lambda t, wt: _api.to_global(jnp.asarray(t, wt.dtype)),
+        tensor, w.tensor)
+
+
 def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
                            sched, step, accumulate: bool) -> int:
     """Shared body of win_put/win_accumulate (they differ only in whether
@@ -397,7 +452,7 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
         fn = _push_sched_fn(w.topo, sched, accumulate, True, id(cx.mesh))
 
         def run():
-            x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+            x = _win_input(tensor, w)
             (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
                 x, w.buffers, w.versions, w.p, w.p_buffers,
                 jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
@@ -408,7 +463,7 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
     fn = _push_fn(w.topo, accumulate, id(cx.mesh))
 
     def run():
-        x = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+        x = _win_input(tensor, w)
         (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
             x, w.buffers, w.versions, w.p, w.p_buffers,
             jnp.asarray(D, jnp.float32), jnp.asarray(sw),
@@ -549,7 +604,7 @@ def win_publish(name: str, tensor) -> None:
     reference's registered tensor aliases the torch parameter, so local
     mutations are implicit there; JAX needs an explicit write)."""
     w = _window(name)
-    w.tensor = _api.to_global(jnp.asarray(tensor, w.tensor.dtype))
+    w.tensor = _win_input(tensor, w)
 
 
 def win_fetch(name: str):
@@ -624,14 +679,23 @@ def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
                     f"before restoring its state")
             continue
         w = _windows[name]
-        if tuple(leaves["buffers"].shape) != tuple(w.buffers.shape):
+        snap_shapes = [tuple(b.shape)
+                       for b in jax.tree.leaves(leaves["buffers"])]
+        win_shapes = [tuple(b.shape) for b in jax.tree.leaves(w.buffers)]
+        if snap_shapes != win_shapes:
             raise ValueError(
-                f"window {name!r}: snapshot buffers {leaves['buffers'].shape}"
-                f" do not match the registered window {w.buffers.shape} "
+                f"window {name!r}: snapshot buffers {snap_shapes} do not "
+                f"match the registered window {win_shapes} "
                 f"(topology changed?)")
         sharding = _api.rank_sharding()
-        w.tensor = jax.device_put(jnp.asarray(leaves["tensor"]), sharding)
-        w.buffers = jax.device_put(jnp.asarray(leaves["buffers"]), sharding)
+        put = lambda t: jax.device_put(jnp.asarray(t), sharding)
+        # reconcile through the CREATION treedef: checkpoint layers may
+        # hand back a structurally different but leaf-compatible tree
+        # (orbax restores tuples as lists without a template)
+        restore = lambda tree: jax.tree.unflatten(
+            w.treedef, [put(t) for t in jax.tree.leaves(tree)])
+        w.tensor = restore(leaves["tensor"])
+        w.buffers = restore(leaves["buffers"])
         w.versions = jnp.asarray(leaves["versions"])
         w.p = jnp.asarray(leaves["p"])
         w.p_buffers = jnp.asarray(leaves["p_buffers"])

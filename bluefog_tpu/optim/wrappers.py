@@ -197,43 +197,49 @@ def DistributedAdaptWithCombineOptimizer(
 # ---------------------------------------------------------------------------
 
 class _WindowOptimizerBase:
-    """Shared state for the win-put / pull-get / push-sum wrappers: one
-    window per parameter leaf (reference _register_window,
-    optimizers.py:933-944)."""
+    """Shared state for the win-put / pull-get / push-sum wrappers: ONE
+    window holding the whole parameter pytree, so every communication
+    step is one jitted SPMD program over all leaves — the TPU-native
+    fusion-buffer (the reference registers one window per tensor,
+    optimizers.py:933-944, and fuses transmissions into a single buffer
+    in the controller, mpi_controller.cc:561-743; here the fusion is the
+    program itself)."""
+
+    _instance_counter = [0]   # default names stay unique AND deterministic
 
     def __init__(self, base, window_prefix: Optional[str] = None,
                  num_steps_per_communication: int = 1):
         self.base = base
-        self.prefix = (window_prefix + ".") if window_prefix else ""
+        if window_prefix is None:
+            # deterministic per creation order, so same-program checkpoint
+            # restores line up; pass window_prefix for stable custom names
+            window_prefix = f"win_opt{self._instance_counter[0]}"
+            self._instance_counter[0] += 1
+        self._name = window_prefix + ".params"
         self.k = num_steps_per_communication
-        self._names = None
+        self._created = False
         self._local = _JittedStrategyOptimizer(base, CommunicationType.empty)
         # mutable per-iteration weighting knobs (matrices), reference
         # optimizers.py:852-858
         self.dst_weights = None
         self.src_weights = None
 
-    def _leaf_names(self, params):
-        paths = jax.tree_util.tree_leaves_with_path(params)
-        return [self.prefix + jax.tree_util.keystr(path) for path, _ in paths]
-
     def _require_init(self):
-        if self._names is None:
+        if not self._created:
             raise RuntimeError(
                 "window optimizer used before init(); call "
                 "state = opt.init(params) first to create the windows")
 
     def init(self, params, zero_init: bool = False):
-        self._names = self._leaf_names(params)
-        for name, leaf in zip(self._names, jax.tree.leaves(params)):
-            if not W.win_create(leaf, name, zero_init=zero_init):
-                raise ValueError(f"Cannot allocate window for {name}")
+        if not W.win_create(params, self._name, zero_init=zero_init):
+            raise ValueError(f"Cannot allocate window for {self._name}")
+        self._created = True
         return self._local.init(params)
 
     def free(self):
-        for name in self._names or []:
-            if name in W.get_current_created_window_names():
-                W.win_free(name)
+        if self._name in W.get_current_created_window_names():
+            W.win_free(self._name)
+        self._created = False
 
     def _apply_base(self, params, grads, opt_state, step):
         return self._local.step(params, grads, opt_state, step)
@@ -246,21 +252,16 @@ class _WindowOptimizerBase:
 
 class DistributedWinPutOptimizer(_WindowOptimizerBase):
     """Push flavor (optimizers.py:1271): put weights to (dynamic)
-    out-neighbors, fold buffers with win_update, then local update."""
+    out-neighbors, fold buffers with win_update, then local update —
+    the whole parameter tree in one program per phase."""
 
     def step(self, params, grads, opt_state, step: int = 0):
         self._require_init()
         if not self._should_communicate(step):
             return self._apply_base(params, grads, opt_state, step)
-        leaves = jax.tree.leaves(params)
-        handles = [
-            W.win_put_nonblocking(leaf, name, dst_weights=self.dst_weights)
-            for name, leaf in zip(self._names, leaves)]
-        for h in handles:
-            W.win_wait(h)
-        averaged = jax.tree.unflatten(
-            jax.tree.structure(params),
-            [W.win_update(name, require_mutex=True) for name in self._names])
+        W.win_wait(W.win_put_nonblocking(params, self._name,
+                                         dst_weights=self.dst_weights))
+        averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
 
 
@@ -272,16 +273,11 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
         self._require_init()
         if not self._should_communicate(step):
             return self._apply_base(params, grads, opt_state, step)
-        # publish current weights in the windows, then pull neighbors'
-        for name, leaf in zip(self._names, jax.tree.leaves(params)):
-            W.win_publish(name, leaf)
-        handles = [W.win_get_nonblocking(name, src_weights=self.src_weights)
-                   for name in self._names]
-        for h in handles:
-            W.win_wait(h)
-        averaged = jax.tree.unflatten(
-            jax.tree.structure(params),
-            [W.win_update(name, require_mutex=True) for name in self._names])
+        # publish current weights in the window, then pull neighbors'
+        W.win_publish(self._name, params)
+        W.win_wait(W.win_get_nonblocking(self._name,
+                                         src_weights=self.src_weights))
+        averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
 
 
@@ -318,44 +314,30 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         return super().init(params, zero_init=True)
 
     def _debias(self, tree):
-        leaves = []
-        for name, leaf in zip(self._names, jax.tree.leaves(tree)):
-            p = W.win_associated_p_vector(name)
-            shape = (-1,) + (1,) * (leaf.ndim - 1)
-            leaves.append(leaf / p.reshape(shape).astype(leaf.dtype))
-        return jax.tree.unflatten(jax.tree.structure(tree), leaves)
+        p = W.win_associated_p_vector(self._name)  # [N] device, no host sync
+        return jax.tree.map(
+            lambda leaf: leaf / p.reshape(
+                (-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype), tree)
 
     def step(self, params, grads, opt_state, step: int = 0):
         self._require_init()
         if not self._should_communicate(step):
             # local step: adapt the *biased* window iterate so the update
             # survives the next collect (gradients are at the de-biased view)
-            biased = jax.tree.unflatten(
-                jax.tree.structure(params),
-                [W.win_fetch(name) for name in self._names])
+            biased = W.win_fetch(self._name)
             adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
-            for name, leaf in zip(self._names, jax.tree.leaves(adapted)):
-                W.win_publish(name, leaf)
+            W.win_publish(self._name, adapted)
             return self._debias(adapted), opt_state
-        # biased iterates live in the windows; `params` is the de-biased view
-        biased = jax.tree.unflatten(
-            jax.tree.structure(params),
-            [W.win_fetch(name) for name in self._names])
-        # local adapt on the biased variable with gradients at the de-biased
-        # point (stochastic gradient-push)
+        # the biased iterate lives in the window; `params` is the de-biased
+        # view; local adapt on the biased variable with gradients at the
+        # de-biased point (stochastic gradient-push)
+        biased = W.win_fetch(self._name)
         adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
-        new_leaves = []
-        for name, leaf in zip(self._names, jax.tree.leaves(adapted)):
-            if self.sched is not None:
-                W.win_accumulate(leaf, name, require_mutex=True,
-                                 sched=self.sched, step=step)
-            else:
-                W.win_accumulate(leaf, name, self_weight=self.alpha,
-                                 dst_weights=self.dst_weights,
-                                 require_mutex=True)
-            collected = W.win_update_then_collect(name)
-            p = W.win_associated_p_vector(name)  # [N] on device, no host sync
-            shape = (-1,) + (1,) * (collected.ndim - 1)
-            new_leaves.append(collected / p.reshape(shape).astype(collected.dtype))
-        debiased = jax.tree.unflatten(jax.tree.structure(params), new_leaves)
-        return debiased, opt_state
+        if self.sched is not None:
+            W.win_accumulate(adapted, self._name, require_mutex=True,
+                             sched=self.sched, step=step)
+        else:
+            W.win_accumulate(adapted, self._name, self_weight=self.alpha,
+                             dst_weights=self.dst_weights, require_mutex=True)
+        collected = W.win_update_then_collect(self._name)
+        return self._debias(collected), opt_state
