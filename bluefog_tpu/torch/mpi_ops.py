@@ -14,6 +14,7 @@ reference's fp16 MPI path converts through a custom dtype
 
 from typing import Dict, Optional
 
+import jax
 import numpy as np
 import torch
 
@@ -184,8 +185,19 @@ def pair_gossip(t: torch.Tensor, pairs, self_weight=None, pair_weight=None,
 _win_dtypes: Dict[str, torch.dtype] = {}
 
 
-def win_create(t: torch.Tensor, name: str, zero_init: bool = False) -> bool:
-    arr, dtype = _to_numpy(t)
+def _win_to_numpy(t):
+    """Torch tensor OR pytree of torch tensors -> (numpy tree, dtype tree).
+
+    Pytree windows carry whole parameter sets in one window (fusion,
+    ops/windows.py); torch tensors are opaque leaves to jax.tree, so the
+    same code path handles both shapes."""
+    arrs = jax.tree.map(lambda x: _to_numpy(x)[0], t)
+    dtypes = jax.tree.map(lambda x: x.dtype, t)
+    return arrs, dtypes
+
+
+def win_create(t, name: str, zero_init: bool = False) -> bool:
+    arr, dtype = _win_to_numpy(t)
     if _win.win_create(arr, name, zero_init=zero_init):
         _win_dtypes[name] = dtype
         return True
@@ -200,32 +212,32 @@ def win_free(name: Optional[str] = None) -> bool:
     return _win.win_free(name)
 
 
-def win_put_nonblocking(t: torch.Tensor, name: str, self_weight=None,
+def win_put_nonblocking(t, name: str, self_weight=None,
                         dst_weights=None, require_mutex: bool = False,
                         sched=None, step=None) -> int:
-    arr, _ = _to_numpy(t)
+    arr, _ = _win_to_numpy(t)
     return _win.win_put_nonblocking(arr, name, self_weight, dst_weights,
                                     require_mutex, sched, step)
 
 
-def win_put(t: torch.Tensor, name: str, self_weight=None, dst_weights=None,
+def win_put(t, name: str, self_weight=None, dst_weights=None,
             require_mutex: bool = False, sched=None, step=None) -> bool:
     _win.win_wait(win_put_nonblocking(t, name, self_weight, dst_weights,
                                       require_mutex, sched, step))
     return True
 
 
-def win_accumulate_nonblocking(t: torch.Tensor, name: str, self_weight=None,
+def win_accumulate_nonblocking(t, name: str, self_weight=None,
                                dst_weights=None,
                                require_mutex: bool = False,
                                sched=None, step=None) -> int:
-    arr, _ = _to_numpy(t)
+    arr, _ = _win_to_numpy(t)
     return _win.win_accumulate_nonblocking(arr, name, self_weight,
                                            dst_weights, require_mutex,
                                            sched, step)
 
 
-def win_accumulate(t: torch.Tensor, name: str, self_weight=None,
+def win_accumulate(t, name: str, self_weight=None,
                    dst_weights=None, require_mutex: bool = False,
                    sched=None, step=None) -> bool:
     _win.win_wait(win_accumulate_nonblocking(t, name, self_weight,
@@ -246,8 +258,14 @@ def win_get(name: str, src_weights=None, require_mutex: bool = False,
     return _win.win_get(name, src_weights, require_mutex, sched, step)
 
 
-def _win_to_torch(name: str, a) -> torch.Tensor:
-    return _to_torch(a, _win_dtypes.get(name, torch.float32))
+def _win_to_torch(name: str, a):
+    dtypes = _win_dtypes.get(name)
+    # structure check guards against a stale entry (a same-named window
+    # re-created through the JAX layer, which does not touch this map)
+    if dtypes is not None and \
+            jax.tree.structure(a) == jax.tree.structure(dtypes):
+        return jax.tree.map(_to_torch, a, dtypes)
+    return jax.tree.map(lambda leaf: _to_torch(leaf, torch.float32), a)
 
 
 def win_update(name: str, self_weight=None, neighbor_weights=None,
@@ -267,8 +285,8 @@ def win_fetch(name: str) -> torch.Tensor:
     return _win_to_torch(name, _win.win_fetch(name))
 
 
-def win_publish(name: str, t: torch.Tensor) -> None:
-    arr, _ = _to_numpy(t)
+def win_publish(name: str, t) -> None:
+    arr, _ = _win_to_numpy(t)
     _win.win_publish(name, arr)
 
 

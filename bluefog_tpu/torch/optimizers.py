@@ -285,33 +285,36 @@ class _WinPutMixin(_DistributedMixin):
     out-neighbors, fold the receive buffers with win_update, then step.
     Per-call weighting via the mutable ``dst_weights`` attribute (global
     [N, N] matrix), mirroring the reference's per-iteration knobs.
-    Window registration here is shared with the pull flavor subclass."""
+
+    ALL parameters live in ONE pytree window (the fusion-buffer
+    equivalent, ops/windows.py) — each communication phase is a single
+    program, not one per tensor.  Window registration here is shared
+    with the pull flavor subclass."""
 
     dst_weights = None
 
-    def _bft_register_windows(self, prefix: str):
-        self._bft_names = []
-        for i, p in enumerate(self._bft_params()):
-            name = f"{prefix}.{i}"
-            if not _ops.win_create(p.data, name):
-                raise ValueError(f"Cannot allocate window for {name}")
-            self._bft_names.append(name)
+    def _bft_data(self):
+        return [p.data for p in self._bft_params()]
+
+    def _bft_register_windows(self, prefix: str, zero_init: bool = False):
+        self._bft_name = prefix + ".params"
+        if not _ops.win_create(self._bft_data(), self._bft_name,
+                               zero_init=zero_init):
+            raise ValueError(f"Cannot allocate window for {self._bft_name}")
 
     def _bft_free_windows(self):
-        for name in self._bft_names:
-            _ops.win_free(name)
-        self._bft_names = []
+        _ops.win_free(self._bft_name)
+
+    def _bft_copy_in(self, values):
+        with torch.no_grad():
+            for p, v in zip(self._bft_params(), values):
+                p.copy_(v)
 
     def _bft_communicate(self):
-        handles = [
-            _ops.win_put_nonblocking(p.data, name,
-                                     dst_weights=self.dst_weights)
-            for name, p in zip(self._bft_names, self._bft_params())]
-        for h in handles:
-            _ops.win_wait(h)
-        for name, p in zip(self._bft_names, self._bft_params()):
-            with torch.no_grad():
-                p.copy_(_ops.win_update(name, require_mutex=True))
+        _ops.win_wait(_ops.win_put_nonblocking(
+            self._bft_data(), self._bft_name, dst_weights=self.dst_weights))
+        self._bft_copy_in(_ops.win_update(self._bft_name,
+                                          require_mutex=True))
 
 
 class _PullGetMixin(_WinPutMixin):
@@ -324,22 +327,18 @@ class _PullGetMixin(_WinPutMixin):
     src_weights = None
 
     def _bft_communicate(self):
-        for name, p in zip(self._bft_names, self._bft_params()):
-            _ops.win_publish(name, p.data)
-        handles = [_ops.win_get_nonblocking(name, src_weights=self.src_weights)
-                   for name in self._bft_names]
-        for h in handles:
-            _ops.win_wait(h)
-        for name, p in zip(self._bft_names, self._bft_params()):
-            with torch.no_grad():
-                p.copy_(_ops.win_update(name, require_mutex=True))
+        _ops.win_publish(self._bft_name, self._bft_data())
+        _ops.win_wait(_ops.win_get_nonblocking(
+            self._bft_name, src_weights=self.src_weights))
+        self._bft_copy_in(_ops.win_update(self._bft_name,
+                                          require_mutex=True))
 
 
-class _PushSumMixin(_DistributedMixin):
+class _PushSumMixin(_WinPutMixin):
     """Push-sum / gradient-push (reference ``_DistributedPushSumOptimizer``,
-    torch/optimizers.py:1026-1177): the window holds the biased iterate x
-    with the associated-P scalar riding every accumulate; the visible
-    parameter is the de-biased x/p."""
+    torch/optimizers.py:1026-1177): ONE pytree window holds the biased
+    iterates x with the associated-P scalar riding every accumulate; the
+    visible parameters are the de-biased x/p."""
 
     def _bft_register_windows(self, prefix: str):
         from ..context import ctx
@@ -349,46 +348,32 @@ class _PushSumMixin(_DistributedMixin):
         np.fill_diagonal(A, 0.0)
         self._bft_alpha = 1.0 / (A.sum(axis=1) + 1.0)      # [N]
         self._bft_dst = A * self._bft_alpha[:, None]
-        self._bft_names = []
-        for i, p in enumerate(self._bft_params()):
-            name = f"{prefix}.{i}"
-            if not _ops.win_create(p.data, name, zero_init=True):
-                raise ValueError(f"Cannot allocate window for {name}")
-            self._bft_names.append(name)
+        super()._bft_register_windows(prefix, zero_init=True)
 
-    def _bft_free_windows(self):
-        for name in self._bft_names:
-            _ops.win_free(name)
-        self._bft_names = []
+    def _bft_debias_in(self, values):
+        pvec = _win_p_tensor(self._bft_name)
+        with torch.no_grad():
+            for p, v in zip(self._bft_params(), values):
+                p.copy_(v / pvec.view((-1,) + (1,) * (v.dim() - 1)))
 
     def step(self, closure=None):
         # local adapt on the *biased* iterate with gradients taken at the
         # de-biased view, then push-accumulate + collect + de-bias
-        biased = [_ops.win_fetch(name) for name in self._bft_names]
-        with torch.no_grad():
-            for p, b in zip(self._bft_params(), biased):
-                p.copy_(b)
+        self._bft_copy_in(_ops.win_fetch(self._bft_name))
         # the wrapped optimizer's own step (skip _DistributedMixin.step)
         loss = super(_DistributedMixin, self).step(closure)
         self._bft_tick += 1
         if self._bft_tick % self._bft_period != 0:
             # local-only step: publish the adapted biased iterate, expose
             # the de-biased view
-            for name, p in zip(self._bft_names, self._bft_params()):
-                _ops.win_publish(name, p.data)
-                pvec = _win_p_tensor(name)
-                with torch.no_grad():
-                    p.div_(pvec.view((-1,) + (1,) * (p.dim() - 1)))
+            adapted = self._bft_data()
+            _ops.win_publish(self._bft_name, adapted)
+            self._bft_debias_in(adapted)
             return loss
-        for name, p in zip(self._bft_names, self._bft_params()):
-            _ops.win_accumulate(p.data, name, self_weight=self._bft_alpha,
-                                dst_weights=self._bft_dst,
-                                require_mutex=True)
-            collected = _ops.win_update_then_collect(name)
-            pvec = _win_p_tensor(name)
-            with torch.no_grad():
-                p.copy_(collected /
-                        pvec.view((-1,) + (1,) * (collected.dim() - 1)))
+        _ops.win_accumulate(self._bft_data(), self._bft_name,
+                            self_weight=self._bft_alpha,
+                            dst_weights=self._bft_dst, require_mutex=True)
+        self._bft_debias_in(_ops.win_update_then_collect(self._bft_name))
         return loss
 
 
@@ -399,8 +384,20 @@ def _win_p_tensor(name: str) -> torch.Tensor:
     return torch.from_numpy(np.array(_w.win_associated_p_vector(name)))
 
 
+_window_opt_counter = [0]
+
+
+def _default_prefix(window_prefix: Optional[str], base: str) -> str:
+    """Unique deterministic default window names, so default-constructed
+    window optimizers coexist (same fix as the JAX wrappers)."""
+    if window_prefix is not None:
+        return window_prefix
+    _window_opt_counter[0] += 1
+    return f"{base}{_window_opt_counter[0]}"
+
+
 def DistributedWinPutOptimizer(optimizer: torch.optim.Optimizer,
-                               window_prefix: str = "win_put_opt",
+                               window_prefix: Optional[str] = None,
                                num_steps_per_communication: int = 1
                                ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for the one-sided push strategy (reference
@@ -408,12 +405,12 @@ def DistributedWinPutOptimizer(optimizer: torch.optim.Optimizer,
     call ``opt._bft_free_windows()`` to release them."""
     opt = _reclass(optimizer, _WinPutMixin, "DistributedWinPutOptimizer",
                    num_steps_per_communication)
-    opt._bft_register_windows(window_prefix)
+    opt._bft_register_windows(_default_prefix(window_prefix, "win_put_opt"))
     return opt
 
 
 def DistributedPullGetOptimizer(optimizer: torch.optim.Optimizer,
-                                window_prefix: str = "pull_get_opt",
+                                window_prefix: Optional[str] = None,
                                 num_steps_per_communication: int = 1
                                 ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for the one-sided pull strategy (reference
@@ -421,19 +418,19 @@ def DistributedPullGetOptimizer(optimizer: torch.optim.Optimizer,
     call ``opt._bft_free_windows()`` to release them."""
     opt = _reclass(optimizer, _PullGetMixin, "DistributedPullGetOptimizer",
                    num_steps_per_communication)
-    opt._bft_register_windows(window_prefix)
+    opt._bft_register_windows(_default_prefix(window_prefix, "pull_get_opt"))
     return opt
 
 
 def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
-                                window_prefix: str = "push_sum_opt",
+                                window_prefix: Optional[str] = None,
                                 num_steps_per_communication: int = 1
                                 ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for push-sum / gradient-push (reference
     factory torch/optimizers.py:1180)."""
     opt = _reclass(optimizer, _PushSumMixin, "DistributedPushSumOptimizer",
                    num_steps_per_communication)
-    opt._bft_register_windows(window_prefix)
+    opt._bft_register_windows(_default_prefix(window_prefix, "push_sum_opt"))
     return opt
 
 

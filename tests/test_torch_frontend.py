@@ -354,6 +354,23 @@ def test_pull_get_optimizer_consensus(bf_ctx):
         opt._bft_free_windows()
 
 
+def test_two_default_torch_window_optimizers_coexist(bf_ctx):
+    """Default window prefixes are unique: two default-constructed window
+    optimizers must not collide on the window name."""
+    p1 = torch.nn.Parameter(_rankval((2,)))
+    p2 = torch.nn.Parameter(_rankval((3,)))
+    o1 = bft.DistributedWinPutOptimizer(torch.optim.SGD([p1], lr=1.0))
+    o2 = bft.DistributedWinPutOptimizer(torch.optim.SGD([p2], lr=1.0))
+    try:
+        p1.grad = torch.zeros_like(p1)
+        p2.grad = torch.zeros_like(p2)
+        o1.step()
+        o2.step()
+    finally:
+        o1._bft_free_windows()
+        o2._bft_free_windows()
+
+
 def test_torch_dynamic_weight_matrix(bf_ctx):
     """Per-call weight matrices on torch tensors (reference per-call
     src_weights, torch/mpi_ops.py:475-645)."""
