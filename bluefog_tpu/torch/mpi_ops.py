@@ -270,18 +270,19 @@ def _win_to_torch(name: str, a):
 
 def win_update(name: str, self_weight=None, neighbor_weights=None,
                reset: bool = False, clone: bool = False,
-               require_mutex: bool = False) -> torch.Tensor:
+               require_mutex: bool = False):
+    """Returns a torch tensor — or, for pytree windows, the matching
+    pytree of torch tensors."""
     return _win_to_torch(name, _win.win_update(
         name, self_weight, neighbor_weights, reset, clone, require_mutex))
 
 
-def win_update_then_collect(name: str,
-                            require_mutex: bool = True) -> torch.Tensor:
+def win_update_then_collect(name: str, require_mutex: bool = True):
     return _win_to_torch(name, _win.win_update_then_collect(name,
                                                             require_mutex))
 
 
-def win_fetch(name: str) -> torch.Tensor:
+def win_fetch(name: str):
     return _win_to_torch(name, _win.win_fetch(name))
 
 

@@ -338,7 +338,11 @@ class _PushSumMixin(_WinPutMixin):
     """Push-sum / gradient-push (reference ``_DistributedPushSumOptimizer``,
     torch/optimizers.py:1026-1177): ONE pytree window holds the biased
     iterates x with the associated-P scalar riding every accumulate; the
-    visible parameters are the de-biased x/p."""
+    visible parameters are the de-biased x/p.
+
+    The column-stochastic push weights are DERIVED from the topology
+    (mass conservation) — the inherited mutable ``dst_weights`` knob does
+    not apply here and is rejected if set."""
 
     def _bft_register_windows(self, prefix: str):
         from ..context import ctx
@@ -357,6 +361,11 @@ class _PushSumMixin(_WinPutMixin):
                 p.copy_(v / pvec.view((-1,) + (1,) * (v.dim() - 1)))
 
     def step(self, closure=None):
+        if self.dst_weights is not None:
+            raise ValueError(
+                "push-sum derives its column-stochastic weights from the "
+                "topology; the dst_weights knob does not apply (use "
+                "bf.set_topology to change the graph)")
         # local adapt on the *biased* iterate with gradients taken at the
         # de-biased view, then push-accumulate + collect + de-bias
         self._bft_copy_in(_ops.win_fetch(self._bft_name))

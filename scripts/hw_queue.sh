@@ -24,6 +24,7 @@ run python scripts/perf_probe.py
 run python scripts/flash_tune.py
 run python scripts/lm_bench.py
 run python scripts/lm_bench.py --remat
+run env BENCH_ON_TPU=1 python scripts/single_ops_bench.py
 run python scripts/scale_bench.py
 run python scripts/convergence_parity.py --include-resnet
 echo "hw queue done $(date -u +%FT%TZ), $FAILED stage(s) failed" | tee -a "$LOG"

@@ -116,6 +116,39 @@ def main():
             row += f"{dt * 1e6:>8.0f}us {bw:7.2f}"
         print(row)
     print("(second number per column: per-rank payload GB/s)")
+
+    # window fusion: the same total payload as ONE pytree window vs N_WIN
+    # per-leaf windows (ops/windows.py fusion-buffer equivalent) — the
+    # dispatch-count ablation behind the window optimizers' design
+    n_win = int(os.environ.get("BENCH_WIN_LEAVES", "32"))
+    elems = sizes[0]
+    leaf = bf.to_global(jnp.asarray(
+        rng.normal(size=(n, max(1, elems // n_win))), jnp.float32))
+    leaves = [leaf] * n_win
+    for name in list(bf.get_current_created_window_names()):
+        bf.win_free(name)
+    bf.win_create(leaves, "fused_tree", zero_init=True)
+    for i in range(n_win):
+        bf.win_create(leaf, f"leafwin.{i}", zero_init=True)
+
+    def tree_roundtrip(xs):
+        bf.win_put(xs, "fused_tree")
+        return bf.win_update("fused_tree")[0]
+
+    def per_leaf_roundtrip(xs):
+        for i, x in enumerate(xs):
+            bf.win_put(x, f"leafwin.{i}")
+        return [bf.win_update(f"leafwin.{i}") for i in range(n_win)][0]
+
+    dt_tree = timeit(tree_roundtrip, leaves, iters=max(args.iters // 3, 3))
+    dt_leaf = timeit(per_leaf_roundtrip, leaves,
+                     iters=max(args.iters // 3, 3))
+    print(f"\nwindow put+update, {n_win} leaves x "
+          f"{max(1, elems // n_win):,d} elems:")
+    print(f"  one pytree window : {dt_tree * 1e6:>8.0f}us")
+    print(f"  per-leaf windows  : {dt_leaf * 1e6:>8.0f}us "
+          f"({dt_leaf / dt_tree:.1f}x)")
+    bf.win_free()
     bf.shutdown()
 
 

@@ -354,6 +354,21 @@ def test_pull_get_optimizer_consensus(bf_ctx):
         opt._bft_free_windows()
 
 
+def test_push_sum_rejects_dst_weights_knob(bf_ctx):
+    """Push-sum derives column-stochastic weights from the topology; the
+    inherited dst_weights knob must fail loudly, not be silently ignored."""
+    p = torch.nn.Parameter(_rankval((2,)))
+    opt = bft.DistributedPushSumOptimizer(torch.optim.SGD([p], lr=1.0))
+    try:
+        opt.dst_weights = np.zeros((N_DEVICES, N_DEVICES))
+        p.grad = torch.zeros_like(p)
+        with pytest.raises(ValueError, match="column-stochastic"):
+            opt.step()
+    finally:
+        opt._bft_free_windows()
+        bft.turn_off_win_ops_with_associated_p()
+
+
 def test_two_default_torch_window_optimizers_coexist(bf_ctx):
     """Default window prefixes are unique: two default-constructed window
     optimizers must not collide on the window name."""
