@@ -251,7 +251,12 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
             in_specs=(spec, spec, spec, spec, spec, P(), P(), P()),
             out_specs=(spec, spec, spec, spec, spec),
         )(x, buffers, versions, p, p_buffers, D, self_w, with_p)
-    return jax.jit(wrapper)
+    # donate the window STATE (buffers/versions/P — replaced by the
+    # outputs on every call) so XLA updates it in place; x stays the
+    # caller's. TPU only: host platforms ignore donation with a warning
+    # per compile.
+    donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" else ()
+    return jax.jit(wrapper, donate_argnums=donate)
 
 
 @functools.lru_cache(maxsize=128)
@@ -333,7 +338,10 @@ def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
                                                          jnp.float32)
         return inner(x, buffers, versions, p, p_buffers,
                      W * (1.0 - eye), sw, with_p)
-    return jax.jit(wrapper)
+    # window-state donation as in _push_fn (the inner jit's donation is
+    # inlined away under this outer jit, so it must be re-declared here)
+    donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" else ()
+    return jax.jit(wrapper, donate_argnums=donate)
 
 
 def _check_sched(w: "_Window", sched, step, weights, kind: str):
@@ -661,9 +669,13 @@ def win_state_dict() -> Dict[str, Dict[str, jax.Array]]:
     is ordinary arrays, so push-sum runs resume exactly
     (``utils/checkpoint.py`` + this pair of functions).
     """
-    return {name: {"tensor": w.tensor, "buffers": w.buffers,
-                   "versions": w.versions, "p": w.p,
-                   "p_buffers": w.p_buffers}
+    # COPIES, not references: window ops donate the state arrays on TPU
+    # (in-place updates), so a live view would be deleted under an
+    # async/overlapped checkpoint write
+    snap = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
+    return {name: {"tensor": snap(w.tensor), "buffers": snap(w.buffers),
+                   "versions": snap(w.versions), "p": snap(w.p),
+                   "p_buffers": snap(w.p_buffers)}
             for name, w in _windows.items()}
 
 
@@ -688,7 +700,9 @@ def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
                 f"match the registered window {win_shapes} "
                 f"(topology changed?)")
         sharding = _api.rank_sharding()
-        put = lambda t: jax.device_put(jnp.asarray(t), sharding)
+        # copy on load: the window will DONATE these arrays on TPU; the
+        # caller's snapshot dict must stay valid afterwards
+        put = lambda t: jax.device_put(jnp.array(t, copy=True), sharding)
         # reconcile through the CREATION treedef: checkpoint layers may
         # hand back a structurally different but leaf-compatible tree
         # (orbax restores tuples as lists without a template)
@@ -696,9 +710,9 @@ def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
             w.treedef, [put(t) for t in jax.tree.leaves(tree)])
         w.tensor = restore(leaves["tensor"])
         w.buffers = restore(leaves["buffers"])
-        w.versions = jnp.asarray(leaves["versions"])
-        w.p = jnp.asarray(leaves["p"])
-        w.p_buffers = jnp.asarray(leaves["p_buffers"])
+        w.versions = jnp.array(leaves["versions"], copy=True)
+        w.p = jnp.array(leaves["p"], copy=True)
+        w.p_buffers = jnp.array(leaves["p_buffers"], copy=True)
 
 
 def turn_on_win_ops_with_associated_p():
