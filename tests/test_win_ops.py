@@ -193,6 +193,29 @@ def test_push_sum_converges_to_average(bf_ctx):
                                atol=1e-4)
 
 
+def test_win_state_dict_returns_copies(bf_ctx):
+    """Snapshot and restore must COPY: window ops donate (delete) the
+    state arrays in place on TPU, so a live reference in a snapshot —
+    or the window aliasing the caller's restored dict — would be
+    invalidated by the next op (CPU can only check the identity
+    contract; the deletion itself is hardware behavior)."""
+    import jax
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    snap = bf.win_state_dict()
+    for a, b in zip(jax.tree.leaves(snap["w"]["tensor"]),
+                    jax.tree.leaves(bf.win_fetch("w"))):
+        assert a is not b
+    from bluefog_tpu.ops.windows import _windows
+    assert snap["w"]["versions"] is not _windows["w"].versions
+    assert snap["w"]["p"] is not _windows["w"].p
+    bf.load_win_state_dict(snap)
+    for a, b in zip(jax.tree.leaves(snap["w"]["tensor"]),
+                    jax.tree.leaves(bf.win_fetch("w"))):
+        assert a is not b
+    bf.win_free("w")
+
+
 def test_tree_window_fusion(bf_ctx):
     """A whole parameter PYTREE in one window: put + update move every
     leaf in a single jitted program — the TPU-native equivalent of the
