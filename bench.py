@@ -163,27 +163,45 @@ def measure_step_time_amortized(window, k_small, k_large, pairs=3):
 
 def _init_watchdog(seconds: int):
     """Fail fast (one readable JSON error line) if the accelerator
-    backend hangs during init — a tunneled transport outage otherwise
-    hangs the whole benchmark run silently inside the first RPC.  A
-    daemon thread + os._exit, because a signal handler cannot interrupt
-    a main thread stuck inside a native blocking call."""
+    backend hangs before the first step completes — a tunneled transport
+    outage otherwise hangs the whole benchmark run silently inside a
+    native RPC.  A daemon thread + os._exit, because a signal handler
+    cannot interrupt a main thread stuck inside a native blocking call.
+
+    Returns ``(advance, cancel)``: ``advance(phase)`` re-labels the
+    guarded phase and restarts the deadline (a half-alive transport can
+    pass init — device enumeration answers — then hang the first
+    compile/execute RPC, which is exactly what the round-2→3 outage
+    looked like); ``cancel()`` disarms once real steps have completed."""
     import threading
 
     done = threading.Event()
     if seconds <= 0:          # conventional 'no timeout' semantics
-        return done.set
+        return (lambda phase: None), done.set
+
+    state = {"phase": "init", "deadline": time.monotonic() + seconds}
 
     def _watch():
-        if not done.wait(seconds):
-            print(json.dumps({
-                "metric": METRIC,
-                "value": 0.0, "unit": "img/sec/chip", "vs_baseline": 0.0,
-                "error": f"accelerator backend unreachable "
-                         f"(init exceeded {seconds}s)"}), flush=True)
-            os._exit(3)
+        while not done.is_set():
+            remaining = state["deadline"] - time.monotonic()
+            if remaining <= 0:
+                print(json.dumps({
+                    "metric": METRIC,
+                    "value": 0.0, "unit": "img/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"accelerator backend unreachable "
+                             f"({state['phase']} exceeded {seconds}s)"},
+                ), flush=True)
+                os._exit(3)
+            done.wait(min(remaining, 5.0))
 
     threading.Thread(target=_watch, daemon=True).start()
-    return done.set
+
+    def advance(phase):
+        state["phase"] = phase
+        state["deadline"] = time.monotonic() + seconds
+
+    return advance, done.set
 
 
 def main():
@@ -207,9 +225,10 @@ def main():
               "BENCH_WINDOW_SMALL/BENCH_WINDOW_LARGE window differencing",
               file=sys.stderr)
 
-    cancel = _init_watchdog(int(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
+    advance, cancel = _init_watchdog(
+        int(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
     bf.init()
-    cancel()
+    advance("first compile+step")
     n = bf.size()
 
     sched = None
@@ -283,12 +302,23 @@ def main():
             step_flops = tcost.get("flops") if tcost else None
         except Exception:
             step_flops = None
+    if warmup > 0:
+        advance("first step")   # fresh deadline: compile may legitimately
+        #                         have consumed most of the previous one
+    else:
+        cancel()   # warmup=0: a timed window (k_large steps) may honestly
+        #            exceed the deadline — fall back to init-only coverage
 
     loss = None
-    for _ in range(warmup):
+    for i in range(warmup):
         variables, opt_state, loss = step_fn(
             variables, opt_state, (x, y), jnp.int32(step))
         step += 1
+        if i == 0:
+            # first full round-trip proves compile+execute+fetch all
+            # work — only now is the transport known-good
+            _ = float(loss)
+            cancel()
     if loss is not None:
         # scalar fetch: reliable execution barrier (axon's
         # block_until_ready can return before remote execution completes)
