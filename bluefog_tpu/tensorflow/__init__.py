@@ -1,0 +1,48 @@
+"""TensorFlow frontend: tf.Tensor collectives over the JAX mesh.
+
+Reference parity: ``bluefog/tensorflow/__init__.py`` — the second
+framework adapter exposes init/shutdown, the rank/size/topology queries,
+the three gradient-registered collectives (allreduce/broadcast/allgather,
+``bluefog/tensorflow/mpi_ops.py:84-226``), and the optimizer helpers
+(``DistributedOptimizer``, ``DistributedGradientTape``,
+``broadcast_variables`` — ``bluefog/tensorflow/optimizers.py``).
+
+Like the torch frontend (``bluefog_tpu/torch``), tensors are global-view:
+leading dim == ``size()``, rank i's tensor is slice ``i``, and every op
+executes the same SPMD shard_map program the JAX API runs.  The JAX-native
+equivalents of the TF components (functional transforms instead of tapes)
+live in ``bluefog_tpu.grad``; this package is for code that holds actual
+``tf.Tensor``/``tf.Variable`` objects.
+"""
+
+from .. import (
+    init,
+    shutdown,
+    size,
+    local_size,
+    rank,
+    local_rank,
+    load_topology,
+    set_topology,
+    in_neighbor_ranks,
+    out_neighbor_ranks,
+    mpi_threads_supported,
+    unified_mpi_window_model_supported,
+)
+
+from .mpi_ops import allreduce, broadcast, allgather
+
+from .optimizers import (
+    broadcast_variables,
+    DistributedOptimizer,
+    DistributedGradientTape,
+)
+
+__all__ = [
+    "init", "shutdown", "size", "local_size", "rank", "local_rank",
+    "load_topology", "set_topology",
+    "in_neighbor_ranks", "out_neighbor_ranks",
+    "mpi_threads_supported", "unified_mpi_window_model_supported",
+    "allreduce", "broadcast", "allgather",
+    "broadcast_variables", "DistributedOptimizer", "DistributedGradientTape",
+]

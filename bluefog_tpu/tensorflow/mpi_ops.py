@@ -1,0 +1,154 @@
+"""TensorFlow-tensor collectives with registered gradients.
+
+Reference parity: ``bluefog/tensorflow/mpi_ops.py`` — allreduce (:108),
+broadcast (:141), allgather (:180) and their three registered gradients
+(:95, :163, :204).  The reference registers pullbacks on TF custom kernels;
+here each op is a ``tf.custom_gradient`` whose forward runs the real JAX
+SPMD collective (``ops/api.py``) through a ``tf.py_function`` bridge, so
+the ops compose with eager tapes AND inside ``tf.function`` graphs.
+
+Global-view semantics (see package docstring): tensors carry a leading
+``size()`` dim.  The reference's per-rank gradient rules translate row-wise:
+
+- allreduce-sum: ``grad_in[i] = sum_j grad_out[j]``   (= allreduce of grad,
+  reference :95-107)
+- broadcast(root): ``grad_in[root] = sum_j grad_out[j]``, zero elsewhere
+  (reference :163-178)
+- allgather: ``grad_in[i] = (sum_j grad_out[j])[i*k:(i+1)*k]`` — allreduce
+  then take the rank's slice (reference :204-226)
+
+bfloat16/float16 stage through float32 outside the bridge, mirroring the
+torch frontend's staging of the reference fp16 path
+(``bluefog/common/half.cc``).
+"""
+
+from typing import Callable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..ops import api as _api
+
+__all__ = ["allreduce", "broadcast", "allgather"]
+
+_STAGED_DTYPES = {tf.bfloat16: tf.float32, tf.float16: tf.float32}
+
+
+def _bridge(np_fn: Callable[[np.ndarray], np.ndarray], x: tf.Tensor,
+            out_shape) -> tf.Tensor:
+    """Run a numpy→numpy collective on a tf tensor, eager or in-graph.
+
+    ``tf.py_function`` executes immediately under eager and becomes a host
+    op inside ``tf.function`` — one uniform path for both modes (the
+    reference needs separate eager/graph branches, optimizers.py:33-41).
+    ``py_function`` erases static shapes, so the caller supplies them.
+    """
+    def call(a):
+        return np.asarray(np_fn(a.numpy()), dtype=x.dtype.as_numpy_dtype)
+
+    out = tf.py_function(call, [x], Tout=x.dtype)
+    out.set_shape(out_shape)
+    return out
+
+
+def _dispatch(compute: Callable[[tf.Tensor], tf.Tensor], t) -> tf.Tensor:
+    """Common wrapper: convert input, stage sub-float32 dtypes, and restore
+    the input dtype on the way out — like the torch frontend's
+    ``synchronize`` (averaging an int tensor yields its truncated-int
+    average there, not a silent float64 upcast from TF's true division)."""
+    t = tf.convert_to_tensor(t)
+    staged = _STAGED_DTYPES.get(t.dtype)
+    x = tf.cast(t, staged) if staged is not None else t
+    out = compute(x)
+    return tf.cast(out, t.dtype) if out.dtype != t.dtype else out
+
+
+def _allreduce_sum(x: tf.Tensor, name: Optional[str]) -> tf.Tensor:
+    @tf.custom_gradient
+    def fn(v):
+        y = _bridge(lambda a: _api.allreduce(a, False, name), v, v.shape)
+
+        def grad(dy):
+            return _bridge(lambda a: _api.allreduce(a, False, name), dy,
+                           dy.shape)
+
+        return y, grad
+
+    return fn(x)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              device: str = "") -> tf.Tensor:
+    """Allreduce of the per-rank slices (reference mpi_ops.py:108-138).
+
+    ``average=True`` divides the sum by ``size()`` as a separate TF op so
+    autodiff chains through it exactly like the reference's graph
+    (sum-op with registered gradient, then a division).  ``device`` is
+    accepted for signature parity; placement is the mesh's concern here.
+    """
+    del device
+
+    def compute(x):
+        summed = _allreduce_sum(x, name)
+        if not average:
+            return summed
+        return summed / tf.cast(_api.ctx().size, x.dtype)
+
+    return _dispatch(compute, tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None) -> tf.Tensor:
+    """Replicate rank ``root_rank``'s slice to all ranks (reference
+    mpi_ops.py:141-161; gradient :163-178)."""
+    root = int(root_rank)
+
+    def compute(x):
+        @tf.custom_gradient
+        def fn(v):
+            y = _bridge(lambda a: _api.broadcast(a, root, name), v, v.shape)
+
+            def grad(dy):
+                def g_np(a):
+                    s = np.asarray(_api.allreduce(a, False, name))
+                    out = np.zeros_like(s)
+                    out[root] = s[root]
+                    return out
+
+                return _bridge(g_np, dy, dy.shape)
+
+            return y, grad
+
+        return fn(x)
+
+    return _dispatch(compute, tensor)
+
+
+def allgather(tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Concatenate all ranks' slices along dim 0: every rank's result slice
+    is ``concat_i x[i]`` (reference mpi_ops.py:180-201; gradient
+    :204-226)."""
+
+    def compute(x):
+        n = _api.ctx().size
+        out_shape = tf.TensorShape(
+            [x.shape[0], None if x.shape[1] is None else n * x.shape[1]]
+        ).concatenate(x.shape[2:])
+
+        @tf.custom_gradient
+        def fn(v):
+            y = _bridge(lambda a: _api.allgather(a, name), v, out_shape)
+
+            def grad(dy):
+                def g_np(a):
+                    s = np.asarray(_api.allreduce(a, False, name))
+                    k = s.shape[1] // n
+                    return np.stack(
+                        [s[i, i * k:(i + 1) * k] for i in range(n)])
+
+                return _bridge(g_np, dy, v.shape)
+
+            return y, grad
+
+        return fn(x)
+
+    return _dispatch(compute, tensor)

@@ -1,0 +1,12 @@
+# Shared transport probe, sourced by hw_watch.sh and hw_queue.sh so the
+# two agree on what "transport alive" means: a cheap REAL computation —
+# a half-alive transport answers device enumeration but hangs every
+# compile/execute RPC (the r2->r3 outage mode).
+probe() {
+    timeout "${PROBE_TIMEOUT:-180}" python -c '
+import jax, jax.numpy as jnp
+y = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
+assert float(y) == 256.0 * 256
+print("PROBE_OK", jax.devices()[0].platform, flush=True)
+' 2>&1 | grep -q PROBE_OK
+}

@@ -7,7 +7,15 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 LOG=${1:-hw_queue_r3.log}
 FAILED=0
+# Probe before each stage — do not let a dead transport eat each
+# stage's full 1200s timeout.  Exit 9 tells hw_watch.sh to resume
+# watching.
+. scripts/_probe.sh   # cwd is the repo root (cd above)
 run() {
+    if ! probe; then
+        echo "=== transport dead before: $* — aborting queue (exit 9) ===" | tee -a "$LOG"
+        exit 9
+    fi
     echo "=== $* ===" | tee -a "$LOG"
     timeout "${STAGE_TIMEOUT:-1200}" "$@" 2>&1 | tee -a "$LOG"
     local rc=${PIPESTATUS[0]}

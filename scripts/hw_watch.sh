@@ -3,31 +3,33 @@
 # computation (device enumeration alone is NOT proof — the round-2→3
 # outage left enumeration answering while every compile/execute RPC hung
 # forever) and fire the full hardware queue (hw_queue.sh) the moment the
-# compute path works.  Runs until the queue has been launched once.
+# compute path works.  Runs until the queue COMPLETES once: a queue
+# aborted mid-run by a dead transport (exit 9) sends the watcher back to
+# watching, and the queue is re-fired on the next alive window.
 #
 #   bash scripts/hw_watch.sh [probe_interval_seconds] [queue_log]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-300}
 LOG=${2:-hw_queue_r3.log}
-PROBE_TIMEOUT=${PROBE_TIMEOUT:-180}
 
-probe() {
-    timeout "$PROBE_TIMEOUT" python -c '
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256))
-y = jax.jit(lambda a: (a @ a).sum())(x)
-assert float(y) == 256.0 * 256
-print("PROBE_OK", jax.devices()[0].platform, flush=True)
-' 2>&1 | grep -q PROBE_OK
-}
+. scripts/_probe.sh
 
 while true; do
     if probe; then
         echo "$(date -u +%FT%TZ) transport alive — launching hw queue"
         bash scripts/hw_queue.sh "$LOG"
-        exit $?
+        rc=$?
+        if [ "$rc" -eq 9 ]; then
+            # transport died mid-queue; stages are independent and safe
+            # to re-run — go back to watching and re-fire on the next
+            # alive window (the log appends, later runs supersede)
+            echo "$(date -u +%FT%TZ) queue aborted on dead transport; resuming watch"
+        else
+            exit "$rc"
+        fi
+    else
+        echo "$(date -u +%FT%TZ) transport still dead (compute probe failed); retry in ${INTERVAL}s"
     fi
-    echo "$(date -u +%FT%TZ) transport still dead (compute probe failed); retry in ${INTERVAL}s"
     sleep "$INTERVAL"
 done
