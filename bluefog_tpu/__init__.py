@@ -45,7 +45,8 @@ from .parallel import dynamic as dynamic_topology
 from .parallel.topology import (
     ExponentialTwoGraph, ExponentialGraph, SymmetricExponentialGraph,
     MeshGrid2DGraph, StarGraph, RingGraph, FullyConnectedGraph,
-    IsTopologyEquivalent, IsRegularGraph, GetRecvWeights, GetSendWeights,
+    IsTopologyEquivalent, IsRegularGraph, isPowerOf,
+    GetRecvWeights, GetSendWeights,
 )
 from .parallel.dynamic import (
     GetDynamicOnePeerSendRecvRanks,
@@ -92,6 +93,7 @@ from .ops.windows import (
 
 from .utils.utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+    deprecated_function_arg,
 )
 
 from .grad import (

@@ -162,6 +162,23 @@ def _allgather_fn(axis, mesh_id):
     return _shardmapped(lambda x: C.allgather(x, axis))
 
 
+@functools.lru_cache(maxsize=256)
+def _ragged_allgather_fn(axis, counts: Tuple[int, ...], mesh_id):
+    """Variable-size allgather (the reference's MPI_Allgatherv path,
+    mpi_context.cc:622-700): ranks contribute ``counts[r]`` leading rows.
+    One padded exchange + a static row-gather — the ragged structure is
+    data-independent, so XLA sees fixed shapes and a single gather."""
+    max_k = max(counts)
+    idx = np.concatenate([np.arange(c) + r * max_k
+                          for r, c in enumerate(counts)]).astype(np.int32)
+
+    def inner(x):
+        g = C.allgather(x, axis)              # [n * max_k, ...]
+        return jnp.take(g, jnp.asarray(idx), axis=0)
+
+    return _shardmapped(inner)
+
+
 def _nar_backend() -> str:
     """Neighbor-exchange backend: "xla" (default; chained ppermutes) or
     "pallas" (fused concurrent-RDMA kernel, ops/pallas_kernels.py;
@@ -317,14 +334,48 @@ broadcast_ = broadcast
 broadcast_nonblocking_ = broadcast_nonblocking
 
 
+def _stack_ragged(x) -> Tuple[jax.Array, Tuple[int, ...]]:
+    """List of per-rank arrays with differing first dims -> zero-padded
+    global stack [size, max_k, ...] + the static per-rank row counts."""
+    cx = ctx()
+    if len(x) != cx.size:
+        raise ValueError(
+            f"ragged input must list one array per rank ({cx.size}), "
+            f"got {len(x)}")
+    arrs = [jnp.asarray(a) for a in x]
+    trail = arrs[0].shape[1:]
+    dtype = arrs[0].dtype
+    for i, a in enumerate(arrs):
+        if a.shape[1:] != trail or a.dtype != dtype:
+            raise ValueError(
+                f"rank {i} slice has shape {a.shape} / dtype {a.dtype}; all "
+                f"slices must share trailing dims {trail} and dtype {dtype}")
+    counts = tuple(int(a.shape[0]) for a in arrs)
+    max_k = max(counts)
+    padded = jnp.stack([
+        jnp.pad(a, [(0, max_k - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+        for a in arrs])
+    return padded, counts
+
+
 def allgather_nonblocking(x, name: Optional[str] = None) -> int:
-    out = _allgather_fn(ctx().rank_axis, _mesh_id())(to_global(x))
+    if isinstance(x, (list, tuple)):
+        padded, counts = _stack_ragged(x)
+        out = _ragged_allgather_fn(ctx().rank_axis, counts, _mesh_id())(padded)
+    else:
+        out = _allgather_fn(ctx().rank_axis, _mesh_id())(to_global(x))
     return _register_handle(out, "allgather", name)
 
 
 def allgather(x, name: Optional[str] = None):
     """Concatenate all ranks' slices along their first dim: the result's
-    slice for every rank is ``concat_i x[i]`` (mpi_ops.py:334-373)."""
+    slice for every rank is ``concat_i x[i]`` (mpi_ops.py:334-373).
+
+    Variable-size form (the reference's allgatherv,
+    ``test_allgather_variable_size``): pass a LIST of per-rank arrays whose
+    first dims differ; the global result is ``[size, sum(counts), ...]`` —
+    every rank's slice is the exact ragged concatenation, no padding
+    visible to the caller."""
     return synchronize(allgather_nonblocking(x, name))
 
 
@@ -491,6 +542,13 @@ def _edge_slots(A: np.ndarray, offsets: Tuple[int, ...], out_rows: int):
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
                                    src_ranks=None, dst_ranks=None) -> int:
     cx = ctx()
+    if isinstance(x, (list, tuple)):
+        # variable-size form (reference
+        # test_neighbor_allgather_dynamic_variable_size): pad each rank's
+        # slice to the max row count; the slot layout below is already
+        # padded, so ragged sizes compose with irregular graphs.  Rank i's
+        # slot for source s carries s's true rows first, zeros after.
+        x, _ = _stack_ragged(x)
     if src_ranks is not None or dst_ranks is not None:
         A = _edge_matrix_from_ranks(cx.size, src_ranks, dst_ranks)
         srcs, dsts = np.nonzero(A)

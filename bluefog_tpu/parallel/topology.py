@@ -36,6 +36,7 @@ __all__ = [
     "FullyConnectedGraph",
     "IsTopologyEquivalent",
     "IsRegularGraph",
+    "isPowerOf",
     "GetRecvWeights",
     "GetSendWeights",
     "mixing_matrix",
@@ -203,6 +204,21 @@ def IsRegularGraph(topo: nx.DiGraph) -> bool:
     """True when every node has the same (in + out) degree."""
     degrees = {d for _, d in topo.degree()}
     return len(degrees) <= 1
+
+
+def isPowerOf(x, base: int) -> bool:
+    """True when ``x`` is an exact power of ``base`` (reference
+    ``common/topology_util.py:90-96``, incl. its argument contracts)."""
+    if not isinstance(base, int):
+        raise AssertionError("Base has to be a integer.")
+    if base <= 1:
+        raise AssertionError("Base has to a interger larger than 1.")
+    if x <= 0:
+        raise AssertionError("x must be positive")
+    p = 1
+    while p < x:
+        p *= base
+    return p == x
 
 
 def GetRecvWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:

@@ -63,6 +63,44 @@ def _dispatch(compute: Callable[[tf.Tensor], tf.Tensor], t) -> tf.Tensor:
     return tf.cast(out, t.dtype) if out.dtype != t.dtype else out
 
 
+def _group_bridge(xs) -> list:
+    """One ``py_function`` carrying K tensors through K *overlapped*
+    allreduces: dispatch every collective nonblocking, then synchronize —
+    K round-trips become one dispatch wave (the optimizer/tape path calls
+    this with one gradient per variable)."""
+    dts = [x.dtype for x in xs]
+
+    def call(*arrays):
+        handles = [_api.allreduce_nonblocking(a.numpy(), False)
+                   for a in arrays]
+        return [np.asarray(_api.synchronize(h), dtype=d.as_numpy_dtype)
+                for h, d in zip(handles, dts)]
+
+    outs = tf.py_function(call, list(xs), Tout=dts)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for o, x in zip(outs, xs):
+        o.set_shape(x.shape)
+    return list(outs)
+
+
+def _allreduce_group_sum(xs):
+    """Graded group allreduce-sum: gradient of a group sum is the group sum
+    of the gradients (the per-tensor rule, applied in one wave)."""
+
+    @tf.custom_gradient
+    def fn(*vs):
+        ys = _group_bridge(vs)
+
+        def grad(*dys):
+            return tuple(_group_bridge(
+                [tf.convert_to_tensor(d) for d in dys]))
+
+        return tuple(ys), grad
+
+    return list(fn(*xs))
+
+
 def _allreduce_sum(x: tf.Tensor, name: Optional[str]) -> tf.Tensor:
     @tf.custom_gradient
     def fn(v):

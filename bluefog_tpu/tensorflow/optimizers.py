@@ -19,7 +19,8 @@ from typing import Optional
 
 import tensorflow as tf
 
-from .mpi_ops import allreduce, broadcast
+from ..ops import api as _api
+from .mpi_ops import _STAGED_DTYPES, _allreduce_group_sum, broadcast
 
 __all__ = [
     "broadcast_variables", "DistributedOptimizer", "DistributedGradientTape",
@@ -34,8 +35,26 @@ def broadcast_variables(variables, root_rank: int = 0):
 
 
 def _allreduce_grads(grads, device: str = ""):
-    return [allreduce(g, device=device) if g is not None else None
-            for g in grads]
+    """Average each non-None gradient across ranks, overlapped: all K
+    collectives dispatch before any synchronizes (one group op, not K
+    sequential blocking round-trips)."""
+    del device
+    idx = [i for i, g in enumerate(grads) if g is not None]
+    if not idx:
+        return list(grads)
+    xs, dts = [], []
+    for i in idx:
+        g = tf.convert_to_tensor(grads[i])
+        dts.append(g.dtype)
+        staged = _STAGED_DTYPES.get(g.dtype)
+        xs.append(tf.cast(g, staged) if staged is not None else g)
+    ys = _allreduce_group_sum(xs)
+    n = _api.ctx().size
+    out = list(grads)
+    for i, y, dt in zip(idx, ys, dts):
+        r = y / tf.cast(n, y.dtype)
+        out[i] = tf.cast(r, dt) if r.dtype != dt else r
+    return out
 
 
 try:
@@ -110,12 +129,13 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
 
 class _DistributedGradientTape(tf.GradientTape):
-    def gradient(self, target, sources, output_gradients=None):
-        gradients = super().gradient(target, sources, output_gradients)
-        if isinstance(gradients, (list, tuple)):
-            return type(gradients)(_allreduce_grads(gradients,
-                                                    self._bf_device))
-        return _allreduce_grads([gradients], self._bf_device)[0]
+    def gradient(self, target, sources, *args, **kwargs):
+        # forward the full tf.GradientTape.gradient contract
+        # (output_gradients, unconnected_gradients, nested sources) —
+        # tf.nest handles any source structure, None leaves included
+        gradients = super().gradient(target, sources, *args, **kwargs)
+        flat = _allreduce_grads(tf.nest.flatten(gradients), self._bf_device)
+        return tf.nest.pack_sequence_as(gradients, flat)
 
 
 def DistributedGradientTape(gradtape: tf.GradientTape,

@@ -65,8 +65,23 @@ def _to_torch(a, dtype) -> torch.Tensor:
     return out.to(dtype) if out.dtype != dtype else out
 
 
-def _nonblocking(api_fn, t: torch.Tensor, *args, **kwargs) -> int:
-    arr, dtype = _to_numpy(t)
+def _nonblocking(api_fn, t, *args, **kwargs) -> int:
+    if isinstance(t, (list, tuple)):
+        # variable-size allgather family: a list of per-rank tensors with
+        # differing first dims (reference test_allgather_variable_size)
+        pairs = [_to_numpy(e) for e in t]
+        arr = [p[0] for p in pairs]
+        dtypes = {p[1] for p in pairs}
+        if len(dtypes) > 1:
+            # staging maps bf16/fp16 AND fp32 to float32 before the core
+            # uniformity check, so a mixed list would silently coerce —
+            # reject it here instead
+            raise ValueError(
+                f"ragged input mixes torch dtypes "
+                f"{sorted(str(d) for d in dtypes)}; cast to one dtype first")
+        dtype = pairs[0][1]
+    else:
+        arr, dtype = _to_numpy(t)
     handle = api_fn(arr, *args, **kwargs)
     _torch_handles[handle] = dtype
     return handle

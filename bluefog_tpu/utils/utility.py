@@ -18,7 +18,26 @@ __all__ = [
     "broadcast_parameters",
     "allreduce_parameters",
     "broadcast_optimizer_state",
+    "deprecated_function_arg",
 ]
+
+
+def deprecated_function_arg(arg_name: str, fix: str):
+    """Decorator rejecting a deprecated keyword argument with a pointer to
+    the replacement (reference ``torch/utility.py:219-229``)."""
+    from functools import wraps
+
+    def deprecated_decorator(f):
+        @wraps(f)
+        def wrapper(*args, **kwargs):
+            if arg_name in kwargs:
+                raise TypeError(
+                    f"{arg_name} is deprecated in {f.__name__}: {fix}")
+            return f(*args, **kwargs)
+
+        return wrapper
+
+    return deprecated_decorator
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0):

@@ -217,3 +217,42 @@ def test_int_dtype_allreduce_sum(bf_ctx):
     assert out.dtype == jnp.int32
     np.testing.assert_array_equal(
         np.asarray(out), np.full((N, 4), N * (N - 1) // 2))
+
+
+def test_allgather_variable_size(bf_ctx):
+    # reference test_allgather_variable_size: rank r contributes r+1 rows
+    parts = [jnp.full((r + 1, 2), float(r)) for r in range(N)]
+    out = bf.allgather(parts)
+    total = sum(r + 1 for r in range(N))
+    assert out.shape == (N, total, 2)
+    expected = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(N)])
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected)
+
+
+def test_allgather_variable_size_rejects_mismatched_trailing(bf_ctx):
+    parts = [jnp.zeros((r + 1, 2)) for r in range(N - 1)] + [jnp.zeros((1, 3))]
+    with pytest.raises(ValueError, match="trailing dims"):
+        bf.allgather(parts)
+
+
+def test_allgather_variable_size_rejects_wrong_count(bf_ctx):
+    with pytest.raises(ValueError, match="one array per rank"):
+        bf.allgather([jnp.zeros((1, 2))])
+
+
+def test_neighbor_allgather_variable_size(bf_ctx):
+    # reference test_neighbor_allgather_dynamic_variable_size: padded slot
+    # layout — slot j of rank i carries source s's true rows, zeros after
+    parts = [jnp.full((r + 1, 2), float(r)) for r in range(N)]
+    out = bf.neighbor_allgather(parts)
+    max_k = N
+    indeg = len(bf.in_neighbor_ranks(0))
+    assert out.shape == (N, indeg, max_k, 2)
+    for r in range(N):
+        srcs = sorted(bf.in_neighbor_ranks(r))
+        for j, s in enumerate(srcs):
+            slot = np.asarray(out[r, j])
+            np.testing.assert_allclose(slot[: s + 1], float(s))
+            np.testing.assert_allclose(slot[s + 1:], 0.0)

@@ -171,3 +171,29 @@ def test_distributed_legacy_optimizer(bf_ctx):
 def test_distributed_optimizer_rejects_non_optimizer(bf_ctx):
     with pytest.raises(ValueError):
         bftf.DistributedOptimizer(object())
+
+
+def test_distributed_tape_forwards_kwargs_and_nested_sources(bf_ctx):
+    x = tf.Variable(_rankval())
+    y = tf.Variable(_rankval())   # unconnected to the loss
+    tape = bftf.DistributedGradientTape(tf.GradientTape())
+    with tape:
+        loss = tf.reduce_sum(x)
+    g = tape.gradient(loss, {"a": x, "b": y},
+                      unconnected_gradients=tf.UnconnectedGradients.ZERO)
+    assert set(g.keys()) == {"a", "b"}
+    np.testing.assert_allclose(g["a"].numpy(), 1.0)
+    np.testing.assert_allclose(g["b"].numpy(), 0.0)   # ZERO, not None
+
+
+def test_distributed_tape_many_grads_one_wave(bf_ctx):
+    # several variables: the group op must average each independently
+    vs = [tf.Variable(_rankval((k + 1,))) for k in range(4)]
+    weights = tf.constant(
+        np.arange(N_DEVICES, dtype=np.float32).reshape(-1, 1))
+    tape = bftf.DistributedGradientTape(tf.GradientTape())
+    with tape:
+        loss = tf.add_n([tf.reduce_sum(weights * v) for v in vs])
+    gs = tape.gradient(loss, vs)
+    for g in gs:
+        np.testing.assert_allclose(g.numpy(), MEAN_RANK, rtol=1e-6)

@@ -235,3 +235,28 @@ def test_compile_dynamic_schedule_period():
     # step 0 sends over offset 1 only
     assert np.count_nonzero(sched.recv_weights[0][0]) == size
     assert np.count_nonzero(sched.recv_weights[0][1]) == 0
+
+
+def test_is_power_of():
+    # reference common/topology_util.py:90-96
+    assert tu.isPowerOf(8, 2) and tu.isPowerOf(1, 2) and tu.isPowerOf(27, 3)
+    assert not tu.isPowerOf(6, 2)
+    with pytest.raises(AssertionError):
+        tu.isPowerOf(8, 1)
+    with pytest.raises(AssertionError):
+        tu.isPowerOf(8, 2.0)
+    with pytest.raises(AssertionError):
+        tu.isPowerOf(0, 2)
+
+
+def test_deprecated_function_arg():
+    # reference torch/utility.py:219-229
+    import bluefog_tpu as bf
+
+    @bf.deprecated_function_arg("old_knob", "use new_knob instead")
+    def f(a, new_knob=1):
+        return a + new_knob
+
+    assert f(1, new_knob=2) == 3
+    with pytest.raises(TypeError, match="old_knob is deprecated in f"):
+        f(1, old_knob=2)

@@ -413,3 +413,21 @@ def test_optimizer_stays_a_torch_optimizer(bf_ctx):
     opt.step()
     sched.step()
     assert opt.param_groups[0]["lr"] == 0.5
+
+
+def test_allgather_variable_size_list_input(bf_ctx):
+    parts = [torch.full((r + 1, 2), float(r)) for r in range(N_DEVICES)]
+    out = bft.allgather(parts)
+    total = sum(r + 1 for r in range(N_DEVICES))
+    assert isinstance(out, torch.Tensor)
+    assert out.shape == (N_DEVICES, total, 2)
+    expected = torch.cat(
+        [torch.full((r + 1, 2), float(r)) for r in range(N_DEVICES)])
+    assert torch.allclose(out[0], expected)
+
+
+def test_allgather_variable_size_rejects_mixed_dtypes(bf_ctx):
+    parts = [torch.ones(1, 2, dtype=torch.bfloat16)] + [
+        torch.ones(1, 2) for _ in range(N_DEVICES - 1)]
+    with pytest.raises(ValueError, match="mixes torch dtypes"):
+        bft.allgather(parts)
