@@ -161,10 +161,66 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None) -> tf.Tensor:
     return _dispatch(compute, tensor)
 
 
+def _ragged_allgather(parts, name: Optional[str]) -> tf.Tensor:
+    """Variable-size allgather on a list of per-rank tf tensors (the
+    reference op's allgatherv behavior — its gradient allgathers the
+    first dims to split, :204-226; here the counts are static)."""
+    n = _api.ctx().size
+    if not parts:
+        raise ValueError(f"ragged input must list one tensor per rank ({n})")
+    xs = [tf.convert_to_tensor(p) for p in parts]
+    in_dtype = xs[0].dtype
+    if any(x.dtype != in_dtype for x in xs):
+        raise ValueError(
+            f"ragged input mixes tf dtypes "
+            f"{sorted({x.dtype.name for x in xs})}; cast to one dtype first")
+    staged = _STAGED_DTYPES.get(in_dtype)
+    if staged is not None:
+        # same f32 staging contract as every other op here; the tf.cast
+        # pair also keeps the gradient chain in f32
+        out = _ragged_allgather([tf.cast(x, staged) for x in xs], name)
+        return tf.cast(out, in_dtype)
+    if any(x.shape[0] is None for x in xs):
+        raise ValueError(
+            "variable-size allgather needs statically known first dims "
+            "(the ragged layout is compiled into the program); got a None "
+            "leading dim — avoid unknown-shape input_signatures here")
+    counts = [int(x.shape[0]) for x in xs]
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    total = int(offsets[-1])
+    out_shape = tf.TensorShape([n, total]).concatenate(xs[0].shape[1:])
+
+    @tf.custom_gradient
+    def fn(*vs):
+        def call(*arrays):
+            out = _api.allgather([a.numpy() for a in arrays], name)
+            return np.asarray(out, dtype=vs[0].dtype.as_numpy_dtype)
+
+        y = tf.py_function(call, list(vs), Tout=vs[0].dtype)
+        y.set_shape(out_shape)
+
+        def grad(dy):
+            def g_np(a):
+                s = np.asarray(_api.allreduce(a, False, name))
+                return [s[i, offsets[i]:offsets[i + 1]] for i in range(n)]
+
+            gs = tf.py_function(g_np, [dy], Tout=[dy.dtype] * n)
+            for g, v in zip(gs, vs):
+                g.set_shape(v.shape)
+            return tuple(gs)
+
+        return y, grad
+
+    return fn(*xs)
+
+
 def allgather(tensor, name: Optional[str] = None) -> tf.Tensor:
     """Concatenate all ranks' slices along dim 0: every rank's result slice
     is ``concat_i x[i]`` (reference mpi_ops.py:180-201; gradient
-    :204-226)."""
+    :204-226).  A LIST of per-rank tensors with differing first dims runs
+    the variable-size form (exact ragged concat, ``[size, sum(counts), …]``)."""
+    if isinstance(tensor, (list, tuple)):
+        return _ragged_allgather(list(tensor), name)
 
     def compute(x):
         n = _api.ctx().size

@@ -256,3 +256,16 @@ def test_neighbor_allgather_variable_size(bf_ctx):
             slot = np.asarray(out[r, j])
             np.testing.assert_allclose(slot[: s + 1], float(s))
             np.testing.assert_allclose(slot[s + 1:], 0.0)
+
+
+def test_neighbor_allreduce_empty_recv_neighbors(bf_ctx):
+    # reference test_neighbor_allreduce_dynamic_topo_with_empty_send_neighbors:
+    # even ranks receive nothing (self only), odd ranks receive rank-1 with
+    # weight 1.0 on top of self weight 1.0 -> 2*rank - 1
+    W = np.eye(N)
+    for r in range(0, N, 2):
+        W[r, r + 1] = 1.0          # r sends to r+1
+    x = rank_tensor((3,))
+    out = np.asarray(bf.neighbor_allreduce(x, weight_matrix=W))[:, 0]
+    expected = [r if r % 2 == 0 else 2 * r - 1 for r in range(N)]
+    np.testing.assert_allclose(out, expected, rtol=1e-6)

@@ -197,3 +197,44 @@ def test_distributed_tape_many_grads_one_wave(bf_ctx):
     gs = tape.gradient(loss, vs)
     for g in gs:
         np.testing.assert_allclose(g.numpy(), MEAN_RANK, rtol=1e-6)
+
+
+def test_allgather_variable_size_list_input(bf_ctx):
+    parts = [tf.fill((r + 1, 2), float(r)) for r in range(N_DEVICES)]
+    out = bftf.allgather(parts)
+    total = sum(r + 1 for r in range(N_DEVICES))
+    assert out.shape == (N_DEVICES, total, 2)
+    expected = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(N_DEVICES)])
+    np.testing.assert_allclose(out.numpy()[3], expected)
+
+
+def test_allgather_variable_size_gradient(bf_ctx):
+    # grad_in[i] = (sum_j dy[j]) sliced to rank i's rows; with
+    # loss = sum(out), each grad entry = N_DEVICES
+    parts = [tf.Variable(tf.fill((r + 1, 2), float(r)))
+             for r in range(N_DEVICES)]
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(bftf.allgather(parts))
+    gs = tape.gradient(loss, parts)
+    for r, g in enumerate(gs):
+        assert g.shape == (r + 1, 2)
+        np.testing.assert_allclose(tf.convert_to_tensor(g).numpy(),
+                                   float(N_DEVICES))
+
+
+def test_allgather_variable_size_bf16_stages(bf_ctx):
+    parts = [tf.cast(tf.fill((r + 1, 2), float(r)), tf.bfloat16)
+             for r in range(N_DEVICES)]
+    out = bftf.allgather(parts)
+    assert out.dtype == tf.bfloat16
+    total = sum(r + 1 for r in range(N_DEVICES))
+    assert out.shape == (N_DEVICES, total, 2)
+
+
+def test_allgather_variable_size_rejects_mixed_and_empty(bf_ctx):
+    with pytest.raises(ValueError, match="mixes tf dtypes"):
+        bftf.allgather([tf.ones((1, 2), tf.bfloat16)] +
+                       [tf.ones((1, 2)) for _ in range(N_DEVICES - 1)])
+    with pytest.raises(ValueError, match="one tensor per rank"):
+        bftf.allgather([])
