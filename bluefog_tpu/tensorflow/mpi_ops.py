@@ -166,8 +166,9 @@ def _ragged_allgather(parts, name: Optional[str]) -> tf.Tensor:
     reference op's allgatherv behavior — its gradient allgathers the
     first dims to split, :204-226; here the counts are static)."""
     n = _api.ctx().size
-    if not parts:
-        raise ValueError(f"ragged input must list one tensor per rank ({n})")
+    if len(parts) != n:
+        raise ValueError(f"ragged input must list one tensor per rank ({n}), "
+                         f"got {len(parts)}")
     xs = [tf.convert_to_tensor(p) for p in parts]
     in_dtype = xs[0].dtype
     if any(x.dtype != in_dtype for x in xs):

@@ -6,7 +6,7 @@ probe() {
     timeout "${PROBE_TIMEOUT:-180}" python -c '
 import jax, jax.numpy as jnp
 y = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
-assert float(y) == 256.0 * 256
+assert float(y) == 256.0 ** 3  # ones @ ones: each entry 256, summed over 256*256
 print("PROBE_OK", jax.devices()[0].platform, flush=True)
 ' 2>&1 | grep -q PROBE_OK
 }

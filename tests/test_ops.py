@@ -263,7 +263,7 @@ def test_neighbor_allreduce_empty_recv_neighbors(bf_ctx):
     # even ranks receive nothing (self only), odd ranks receive rank-1 with
     # weight 1.0 on top of self weight 1.0 -> 2*rank - 1
     W = np.eye(N)
-    for r in range(0, N, 2):
+    for r in range(0, N - 1, 2):   # complete even/odd pairs only (odd N safe)
         W[r, r + 1] = 1.0          # r sends to r+1
     x = rank_tensor((3,))
     out = np.asarray(bf.neighbor_allreduce(x, weight_matrix=W))[:, 0]
