@@ -23,6 +23,10 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from bluefog_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()   # shared by every script that imports bench
+
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50, ResNet50Fused
@@ -185,12 +189,41 @@ def _init_watchdog(seconds: int):
         while not done.is_set():
             remaining = state["deadline"] - time.monotonic()
             if remaining <= 0:
+                # The transport stalls in windows of minutes (observed r3);
+                # a fresh attempt can land in the next alive window, and the
+                # persistent compile cache makes a healthy retry fast.  The
+                # stuck native RPC can't be interrupted, so re-EXEC the
+                # whole process (replaces the wedged thread too).  Only the
+                # last attempt prints the error JSON — one JSON line total.
+                attempt = int(os.environ.get("BENCH_ATTEMPT", "1"))
+                max_attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "2"))
+                if attempt < max_attempts:
+                    print(f"bench attempt {attempt}: {state['phase']} "
+                          f"exceeded {seconds}s; re-exec for attempt "
+                          f"{attempt + 1}", file=sys.stderr, flush=True)
+                    # The retry keeps the same per-phase leash by default
+                    # (a compile killed mid-flight cached nothing, so
+                    # "warm cache" can't be assumed); BENCH_RETRY_TIMEOUT
+                    # overrides.
+                    env = dict(os.environ,
+                               BENCH_ATTEMPT=str(attempt + 1),
+                               BENCH_INIT_TIMEOUT=str(
+                                   int(os.environ.get(
+                                       "BENCH_RETRY_TIMEOUT", str(seconds)))))
+                    try:
+                        os.execve(sys.executable,
+                                  [sys.executable,
+                                   os.path.abspath(__file__)], env)
+                    except OSError as e:   # exec failed: fall through to
+                        print(f"bench retry exec failed: {e}",   # the error
+                              file=sys.stderr, flush=True)       # JSON line
                 print(json.dumps({
                     "metric": METRIC,
                     "value": 0.0, "unit": "img/sec/chip",
                     "vs_baseline": 0.0,
                     "error": f"accelerator backend unreachable "
-                             f"({state['phase']} exceeded {seconds}s)"},
+                             f"({state['phase']} exceeded {seconds}s, "
+                             f"attempt {attempt}/{max_attempts})"},
                 ), flush=True)
                 os._exit(3)
             done.wait(min(remaining, 5.0))
@@ -225,8 +258,12 @@ def main():
               "BENCH_WINDOW_SMALL/BENCH_WINDOW_LARGE window differencing",
               file=sys.stderr)
 
+    # Default raised 300->600: a HEALTHY tunneled transport compiles the
+    # ResNet-50 train step in ~4-6 min cold (measured r3), so 300 s
+    # false-fired on a live backend.  600 s still fails fast vs the
+    # driver's 1200 s stage timeout.
     advance, cancel = _init_watchdog(
-        int(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
+        int(os.environ.get("BENCH_INIT_TIMEOUT", "600")))
     bf.init()
     advance("first compile+step")
     n = bf.size()

@@ -3,7 +3,7 @@
 # a half-alive transport answers device enumeration but hangs every
 # compile/execute RPC (the r2->r3 outage mode).
 probe() {
-    timeout "${PROBE_TIMEOUT:-180}" python -c '
+    timeout -k 30 "${PROBE_TIMEOUT:-300}" python -c '
 import jax, jax.numpy as jnp
 y = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
 assert float(y) == 256.0 ** 3  # ones @ ones: each entry 256, summed over 256*256

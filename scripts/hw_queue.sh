@@ -17,7 +17,7 @@ run() {
         exit 9
     fi
     echo "=== $* ===" | tee -a "$LOG"
-    timeout "${STAGE_TIMEOUT:-1200}" "$@" 2>&1 | tee -a "$LOG"
+    timeout -k 30 "${STAGE_TIMEOUT:-1200}" "$@" 2>&1 | tee -a "$LOG"
     local rc=${PIPESTATUS[0]}
     echo "=== exit $rc ===" | tee -a "$LOG"
     [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
