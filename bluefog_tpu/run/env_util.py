@@ -37,6 +37,21 @@ def force_virtual_cpu_devices(env: Dict[str, str], n: int) -> Dict[str, str]:
     return env
 
 
+def append_xla_flag(env: Dict[str, str], flag: str) -> Dict[str, str]:
+    """Append ``--name=value`` to ``env['XLA_FLAGS']`` unless a flag with
+    that name is already present (user wins).  Skipped entirely when
+    ``BLUEFOG_NO_XLA_FLAG_INJECT`` is set — the escape hatch for XLA
+    builds that do not know a flag (XLA fatals on unknown XLA_FLAGS).
+    Must run before the first backend use."""
+    if env.get("BLUEFOG_NO_XLA_FLAG_INJECT"):
+        return env
+    name = flag.lstrip("-").split("=", 1)[0]
+    flags = env.get("XLA_FLAGS", "")
+    if name not in flags:
+        env["XLA_FLAGS"] = (flags + " " + flag).strip()
+    return env
+
+
 def env_assignments(env: Dict[str, str], only_prefixes: List[str]) -> List[str]:
     """Shell-safe ``K=V`` assignments for the vars worth forwarding over ssh:
     anything matching the given prefixes (reference forwards -x env vars,

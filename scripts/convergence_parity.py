@@ -17,7 +17,8 @@ consensus spread, plus one JSON line per run.
 
 CPU-mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8
 JAX_PLATFORMS=cpu (the MNIST leg takes ~2 min there; the ResNet leg is
-sized for the hardware window).
+sized for a single-core host via --resnet-batch, see its help).  This is
+8-rank work — it belongs on the CPU mesh, not the single tunneled chip.
 """
 
 import argparse
@@ -28,6 +29,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "examples"))
+
+# On a single-core host the 8 device threads timeshare one CPU and reach
+# each collective staggered by OS scheduling; XLA's CPU rendezvous
+# hard-terminates after 40 s by default (observed fatal: "Expected 8
+# threads to join the rendezvous, but only 4 arrived").  Raise it —
+# slowness is not deadlock here.  Must happen before backend init
+# (opt out: BLUEFOG_NO_XLA_FLAG_INJECT=1, see env_util.append_xla_flag).
+from bluefog_tpu.run.env_util import append_xla_flag  # noqa: E402
+
+append_xla_flag(
+    os.environ, "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
 
 import jax
 
@@ -123,15 +135,88 @@ MODES = [
 ]
 
 
-def run_table(name, model, sample_shape, data, test, lr, momentum, epochs,
-              batch, seed):
+def _build_workload(key, args):
+    """(name, model, sample_shape, (x, y), (x_test, y_test), hyper)."""
+    if key == "lenet":
+        from mnist import synthetic_mnist          # examples/mnist.py
+        from bluefog_tpu.models.lenet import LeNet
+        x, y = synthetic_mnist(n_samples=9216, seed=0)
+        if args.noise:
+            x = x + np.random.default_rng(9).normal(
+                0, args.noise, size=x.shape).astype(np.float32)
+        split = 8192
+        return ("LeNet / synthetic MNIST (8-rank)", LeNet(), (28, 28, 1),
+                (x[:split], y[:split]), (x[split:], y[split:]),
+                dict(lr=0.01, momentum=0.5, epochs=args.epochs,
+                     batch=args.batch_size, seed=args.seed))
+    if key == "resnet":
+        from bluefog_tpu.models.resnet import ResNet18
+        cx, cy = synthetic_cifar(n_samples=4608, seed=1)
+        csplit = 4096
+        return ("ResNet-18 / synthetic 32px (8-rank)",
+                ResNet18(num_classes=10, dtype=jnp.float32), (32, 32, 3),
+                (cx[:csplit], cy[:csplit]), (cx[csplit:], cy[csplit:]),
+                dict(lr=0.05, momentum=0.9, epochs=args.epochs,
+                     batch=args.resnet_batch, seed=args.seed))
+    raise SystemExit(f"unknown workload {key!r}")
+
+
+def _run_single(key, mode_idx, args):
+    """One (workload, mode) in THIS process; prints one JSON line."""
+    name, model, shape, data, test, hp = _build_workload(key, args)
+    comm, dyn, label = MODES[mode_idx]
+    r = run_one(model, shape, data[0], data[1], test[0], test[1],
+                comm, dyn, **hp)
+    r.update({"workload": name, "mode": label})
+    print(json.dumps(r), flush=True)
+    bf.shutdown()
+
+
+def run_table_isolated(key, args):
+    """Run each mode in a FRESH python subprocess and assemble the table.
+
+    In-process back-to-back legs can wedge XLA:CPU's collective rendezvous
+    on heavy graphs (observed: the ResNet static leg deadlocks at an
+    allreduce with 2/8 device threads missing even with a 1200s
+    termination timeout, while the same leg alone completes).  Process
+    isolation sidesteps the wedge and is what a user would do anyway —
+    one training run per process."""
+    import subprocess
     rows = []
-    for comm, dyn, label in MODES:
-        r = run_one(model, sample_shape, data[0], data[1], test[0], test[1],
-                    comm, dyn, lr, momentum, epochs, batch, seed)
-        r.update({"workload": name, "mode": label})
+    for i, (comm, dyn, label) in enumerate(MODES):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--single", key, str(i),
+               "--epochs", str(args.epochs),
+               "--batch-size", str(args.batch_size),
+               "--resnet-batch", str(args.resnet_batch),
+               "--seed", str(args.seed), "--noise", str(args.noise)]
+        leg_timeout = int(os.environ.get("CONVERGENCE_LEG_TIMEOUT", "3600"))
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 env=os.environ.copy(), timeout=leg_timeout)
+        except subprocess.TimeoutExpired as e:
+            tail = (e.stderr or b"")
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            sys.stderr.write(tail[-2000:] + "\n")
+            raise SystemExit(
+                f"mode {label!r} subprocess exceeded {leg_timeout}s "
+                f"(CONVERGENCE_LEG_TIMEOUT)")
+        line = [l for l in out.stdout.splitlines()
+                if l.startswith("{")]
+        if out.returncode != 0 or not line:
+            sys.stderr.write(out.stderr[-2000:] + "\n")
+            raise SystemExit(
+                f"mode {label!r} subprocess failed (rc {out.returncode})")
+        r = json.loads(line[-1])
         rows.append(r)
         print(json.dumps(r), flush=True)
+    name = rows[0]["workload"]
+    _print_table(name, rows)
+    return rows
+
+
+def _print_table(name, rows):
     base_acc = rows[0]["test_acc_pct"]
     print(f"\n### {name}\n")
     print("| mode | final loss | test acc (%) | acc gap vs centralized "
@@ -148,8 +233,17 @@ def run_table(name, model, sample_shape, data, test, lr, momentum, epochs,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--include-resnet", action="store_true",
-                    help="also run the ResNet-18 synthetic leg (sized for "
-                         "real hardware; slow on the CPU mesh)")
+                    help="also run the ResNet-18 synthetic leg")
+    ap.add_argument("--resnet-batch", type=int, default=16,
+                    help="per-rank batch for the ResNet leg.  Default 16: "
+                         "on a single-core host the 8 device threads "
+                         "timeshare one CPU, and at batch 64 a step's "
+                         "compute keeps some threads from reaching the "
+                         "collective rendezvous inside XLA's 40s "
+                         "termination window (observed: 7/8 arrived -> "
+                         "fatal).  Smaller per-rank batches shorten the "
+                         "stragglers; convergence, not throughput, is "
+                         "what this script measures.")
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--seed", type=int, default=42)
@@ -157,30 +251,17 @@ def main():
                     help="extra pixel noise stddev: de-saturates the "
                          "synthetic task so accuracy gaps are measurable "
                          "(0 => every mode hits 100%%)")
+    ap.add_argument("--single", nargs=2, metavar=("WORKLOAD", "MODE_IDX"),
+                    help=argparse.SUPPRESS)   # internal: one leg in-process
     args = ap.parse_args()
 
-    from mnist import synthetic_mnist          # examples/mnist.py
-    from bluefog_tpu.models.lenet import LeNet
-    x, y = synthetic_mnist(n_samples=9216, seed=0)
-    if args.noise:
-        x = x + np.random.default_rng(9).normal(
-            0, args.noise, size=x.shape).astype(np.float32)
-    split = 8192
-    run_table("LeNet / synthetic MNIST (8-rank)", LeNet(), (28, 28, 1),
-              (x[:split], y[:split]), (x[split:], y[split:]),
-              lr=0.01, momentum=0.5, epochs=args.epochs,
-              batch=args.batch_size, seed=args.seed)
+    if args.single:
+        _run_single(args.single[0], int(args.single[1]), args)
+        return
 
+    run_table_isolated("lenet", args)
     if args.include_resnet:
-        from bluefog_tpu.models.resnet import ResNet18
-        cx, cy = synthetic_cifar(n_samples=4608, seed=1)
-        csplit = 4096
-        run_table("ResNet-18 / synthetic 32px (8-rank)",
-                  ResNet18(num_classes=10, dtype=jnp.float32), (32, 32, 3),
-                  (cx[:csplit], cy[:csplit]), (cx[csplit:], cy[csplit:]),
-                  lr=0.05, momentum=0.9, epochs=args.epochs,
-                  batch=args.batch_size, seed=args.seed)
-    bf.shutdown()
+        run_table_isolated("resnet", args)
 
 
 if __name__ == "__main__":

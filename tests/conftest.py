@@ -11,10 +11,19 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"  # overwrite, not setdefault: the axon
 # site exports JAX_PLATFORMS=axon, and the package honors an explicit cpu
 N_DEVICES = int(os.environ.get("BLUEFOG_TEST_MESH_DEVICES", "8"))
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={N_DEVICES}").strip()
+
+# Importing the package does not initialize backends, so flag edits here
+# still precede the first backend use.
+from bluefog_tpu.run.env_util import append_xla_flag  # noqa: E402
+
+append_xla_flag(
+    os.environ, f"--xla_force_host_platform_device_count={N_DEVICES}")
+# Single-core hosts stagger the device threads into each collective;
+# XLA's 40s rendezvous terminator mistakes that for deadlock under heavy
+# tests (it killed the convergence-parity ResNet leg).  Opt out on XLA
+# builds without the flag: BLUEFOG_NO_XLA_FLAG_INJECT=1.
+append_xla_flag(
+    os.environ, "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
 
 import jax  # noqa: E402
 
