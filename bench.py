@@ -211,9 +211,8 @@ def _init_watchdog(seconds: int):
                 attempt = int(os.environ.get("BENCH_ATTEMPT", "1"))
                 max_attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "2"))
                 budget_left = total_deadline_mono - time.monotonic()
-                if budget_left < 120.0:   # retry can't do anything useful
-                    attempt = max_attempts
-                if attempt < max_attempts:
+                no_retry = budget_left < 120.0  # too little budget to help
+                if not no_retry and attempt < max_attempts:
                     print(f"bench attempt {attempt}: {state['phase']} "
                           f"exceeded {seconds}s; re-exec for attempt "
                           f"{attempt + 1}", file=sys.stderr, flush=True)
@@ -237,6 +236,8 @@ def _init_watchdog(seconds: int):
                        if state["deadline"] <= total_deadline_mono else
                        f"total budget {total_budget:.0f}s exhausted during "
                        f"{state['phase']}")
+                if no_retry and attempt < max_attempts:
+                    why += ", retry skipped: budget exhausted"
                 print(json.dumps({
                     "metric": METRIC,
                     "value": 0.0, "unit": "img/sec/chip",
