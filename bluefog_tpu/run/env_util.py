@@ -52,6 +52,25 @@ def append_xla_flag(env: Dict[str, str], flag: str) -> Dict[str, str]:
     return env
 
 
+def arm_low_core_cpu_mitigations(env: Dict[str, str],
+                                 terminate_timeout_s: int = 1200
+                                 ) -> Dict[str, str]:
+    """XLA:CPU mitigations for many-virtual-device runs on low-core hosts.
+
+    (a) Raise the collective-rendezvous terminate timeout: one core
+    staggers the device threads into each collective and the 40 s default
+    mistakes that for deadlock.  (b) On <=2 cores, run Eigen inline: the
+    shared intra-op pool can wedge conv-heavy 8-device programs outright
+    (a device thread blocks in the pool and never reaches the
+    collective).  Call before the first backend use; opt out with
+    ``BLUEFOG_NO_XLA_FLAG_INJECT``."""
+    append_xla_flag(env, "--xla_cpu_collective_call_terminate_timeout_"
+                         f"seconds={terminate_timeout_s}")
+    if (os.cpu_count() or 1) <= 2:
+        append_xla_flag(env, "--xla_cpu_multi_thread_eigen=false")
+    return env
+
+
 def env_assignments(env: Dict[str, str], only_prefixes: List[str]) -> List[str]:
     """Shell-safe ``K=V`` assignments for the vars worth forwarding over ssh:
     anything matching the given prefixes (reference forwards -x env vars,

@@ -30,26 +30,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
-# On a single-core host the 8 device threads timeshare one CPU and reach
-# each collective staggered by OS scheduling; XLA's CPU rendezvous
-# hard-terminates after 40 s by default (observed fatal: "Expected 8
-# threads to join the rendezvous, but only 4 arrived").  Raise it —
-# slowness is not deadlock here.  Must happen before backend init
-# (opt out: BLUEFOG_NO_XLA_FLAG_INJECT=1, see env_util.append_xla_flag).
-from bluefog_tpu.run.env_util import append_xla_flag  # noqa: E402
-
-# 180 s: with inline Eigen (below) the straggler spread into a collective
-# is ~15 s on one core, while the flaky XLA:CPU pool wedge (a device
+# Low-core XLA:CPU hazards (rendezvous terminator, Eigen pool wedge —
+# see env_util.arm_low_core_cpu_mitigations).  180 s terminator, not the
+# 1200 s default: with inline Eigen the straggler spread into a
+# collective is ~15 s on one core, while the flaky pool wedge (a device
 # thread that NEVER arrives) is only detectable by timeout — a short
 # terminator makes wedged legs cheap to retry (run_table_isolated).
-append_xla_flag(
-    os.environ, "--xla_cpu_collective_call_terminate_timeout_seconds=180")
-if (os.cpu_count() or 1) <= 2:
-    # On a 1-core host the conv-heavy 8-device legs DEADLOCK with the
-    # multi-threaded Eigen path (2/8 device threads block in the shared
-    # intra-op pool and never reach the collective, even in a fresh
-    # process); inline Eigen execution completes the same leg in ~9 min.
-    append_xla_flag(os.environ, "--xla_cpu_multi_thread_eigen=false")
+# Must run before backend init; opt out: BLUEFOG_NO_XLA_FLAG_INJECT=1.
+from bluefog_tpu.run.env_util import arm_low_core_cpu_mitigations  # noqa: E402
+
+arm_low_core_cpu_mitigations(os.environ, terminate_timeout_s=180)
 
 import jax
 

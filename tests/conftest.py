@@ -14,7 +14,7 @@ N_DEVICES = int(os.environ.get("BLUEFOG_TEST_MESH_DEVICES", "8"))
 
 # Importing the package does not initialize backends, so flag edits here
 # still precede the first backend use.
-from bluefog_tpu.run.env_util import append_xla_flag  # noqa: E402
+from bluefog_tpu.run.env_util import arm_low_core_cpu_mitigations  # noqa: E402
 
 # Unconditional (NOT subject to the BLUEFOG_NO_XLA_FLAG_INJECT opt-out):
 # every XLA build knows this flag and the mesh is meaningless without it.
@@ -22,17 +22,7 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + f" --xla_force_host_platform_device_count={N_DEVICES}").strip()
-# Single-core hosts stagger the device threads into each collective;
-# XLA's 40s rendezvous terminator mistakes that for deadlock under heavy
-# tests (it killed the convergence-parity ResNet leg).  Opt out on XLA
-# builds without the flag: BLUEFOG_NO_XLA_FLAG_INJECT=1.
-append_xla_flag(
-    os.environ, "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
-if (os.cpu_count() or 1) <= 2:
-    # Conv-heavy 8-device programs can deadlock in the shared Eigen
-    # intra-op pool on a 1-core host (threads never reach the collective);
-    # inline execution avoids it (see scripts/convergence_parity.py).
-    append_xla_flag(os.environ, "--xla_cpu_multi_thread_eigen=false")
+arm_low_core_cpu_mitigations(os.environ)
 
 import jax  # noqa: E402
 
