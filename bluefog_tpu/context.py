@@ -16,6 +16,7 @@ on localhost (``mpi_context.cc:26,322``).
 
 import logging
 import os
+import threading
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -79,7 +80,29 @@ class BlueFogContext:
         self._machine_topology: Optional[nx.DiGraph] = None
         self._compiled_machine: Optional[CompiledTopology] = None
         self._is_machine_topo_weighted = False
-        self._suspended = False
+        # suspend/resume gate: ops wait on this event before dispatching
+        # (set = running).  Reference parity: bluefog_suspend/resume pause
+        # the background op loop (operations.cc:1392-1400) so a notebook
+        # can halt traffic mid-run; here the dispatch points block instead.
+        self._resume_event = threading.Event()
+        self._resume_event.set()
+
+    @property
+    def suspended(self) -> bool:
+        return not self._resume_event.is_set()
+
+    def wait_if_suspended(self) -> None:
+        """Block the calling thread while suspended (no-op when running).
+
+        Called at every op-dispatch boundary BEFORE any tracing/dispatch
+        (collectives via the ``_suspend_gated`` decorator in ``ops/api.py``,
+        windows via ``_dispatch_win_op``).  ``resume()`` from another thread
+        (the notebook/driver) releases all waiters, like the reference's
+        condition-variable wakeup."""
+        if self._resume_event.is_set():
+            return
+        logger.debug("bluefog op dispatch paused by suspend(); waiting")
+        self._resume_event.wait()
 
     # -- size / rank queries (basics.py:78-145) -----------------------------
 
@@ -212,10 +235,15 @@ class BlueFogContext:
     # -- misc toggles (basics.py:441-454,548-568) ---------------------------
 
     def suspend(self):
-        self._suspended = True
+        """Pause op dispatch: subsequent collective/window calls block at
+        their dispatch point until :meth:`resume` (reference
+        ``bluefog_suspend``, operations.cc:1392-1396)."""
+        self._resume_event.clear()
 
     def resume(self):
-        self._suspended = False
+        """Release all threads blocked by :meth:`suspend` (reference
+        ``bluefog_resume``, operations.cc:1397-1400)."""
+        self._resume_event.set()
 
 
 def _uniform_weights(topo: nx.DiGraph) -> nx.DiGraph:
@@ -249,11 +277,24 @@ def _maybe_init_jax_distributed() -> None:
     coordinator = os.environ.get("BLUEFOG_COORDINATOR")
     if not coordinator or _jax_distributed_started:
         return
+    process_id = int(os.environ["BLUEFOG_PROCESS_ID"])
+    kwargs = {}
+    iface = os.environ.get("BLUEFOG_NETWORK_INTERFACE")
+    if iface and process_id == 0:
+        # Pin the coordinator's LISTENING socket to the chosen NIC
+        # (bfrun --network-interface; reference run.py:84-118 pins
+        # NCCL/gloo ifaces the same way).  Resolved here, on the
+        # coordinator's own machine — the launcher cannot know a remote
+        # host's addresses.
+        from .run.network_util import interface_address
+        port = coordinator.rsplit(":", 1)[1]
+        kwargs["coordinator_bind_address"] = (
+            f"{interface_address(iface)}:{port}")
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=int(os.environ["BLUEFOG_NUM_PROCESSES"]),
-            process_id=int(os.environ["BLUEFOG_PROCESS_ID"]))
+            process_id=process_id, **kwargs)
     except RuntimeError as e:
         # Only "already initialized / called too late" is benign (user or a
         # previous bf.init did it).  A coordinator connection failure must

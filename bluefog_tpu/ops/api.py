@@ -59,6 +59,20 @@ _handle_map: Dict[int, Tuple[jax.Array, str, int]] = {}
 _next_handle = [0]
 
 
+def _suspend_gated(fn):
+    """suspend() gate at the dispatch boundary: block BEFORE any
+    tracing/dispatch so a suspended context issues no collective traffic
+    at all — the SPMD equivalent of the reference pausing its background
+    op loop (operations.cc:1392-1400).  Blocking ops synchronize their
+    decorated nonblocking twin, so every public op is covered; resume()
+    from another thread releases the waiters."""
+    @functools.wraps(fn)
+    def gated(*args, **kwargs):
+        _ctx_mod.ctx().wait_if_suspended()
+        return fn(*args, **kwargs)
+    return gated
+
+
 def _register_handle(output, op: str = "", name: Optional[str] = None) -> int:
     with _handle_lock:
         handle = _next_handle[0]
@@ -304,6 +318,7 @@ def _mesh_id():
 # Collective ops (blocking + nonblocking)
 # ---------------------------------------------------------------------------
 
+@_suspend_gated
 def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
@@ -319,6 +334,7 @@ allreduce_ = allreduce
 allreduce_nonblocking_ = allreduce_nonblocking
 
 
+@_suspend_gated
 def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _broadcast_fn(cx.rank_axis, int(root_rank), _mesh_id())(to_global(x))
@@ -358,6 +374,7 @@ def _stack_ragged(x) -> Tuple[jax.Array, Tuple[int, ...]]:
     return padded, counts
 
 
+@_suspend_gated
 def allgather_nonblocking(x, name: Optional[str] = None) -> int:
     if isinstance(x, (list, tuple)):
         padded, counts = _stack_ragged(x)
@@ -379,6 +396,7 @@ def allgather(x, name: Optional[str] = None):
     return synchronize(allgather_nonblocking(x, name))
 
 
+@_suspend_gated
 def neighbor_allreduce_nonblocking(
         x, *,
         self_weight: Optional[float] = None,
@@ -539,6 +557,7 @@ def _edge_slots(A: np.ndarray, offsets: Tuple[int, ...], out_rows: int):
     return slots
 
 
+@_suspend_gated
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
                                    src_ranks=None, dst_ranks=None) -> int:
     cx = ctx()
@@ -581,6 +600,7 @@ def neighbor_allgather(x, name: Optional[str] = None, *,
         x, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
 
 
+@_suspend_gated
 def hierarchical_neighbor_allreduce_nonblocking(
         x, name: Optional[str] = None) -> int:
     cx = ctx()
@@ -619,6 +639,7 @@ def hierarchical_neighbor_allreduce(x, name: Optional[str] = None):
     return synchronize(hierarchical_neighbor_allreduce_nonblocking(x, name))
 
 
+@_suspend_gated
 def pair_gossip_nonblocking(x, pairs: Sequence[Tuple[int, int]],
                             self_weight: Optional[float] = None,
                             pair_weight: Optional[float] = None,
@@ -641,6 +662,7 @@ def pair_gossip(x, pairs, self_weight=None, pair_weight=None, name=None):
                                                pair_weight, name))
 
 
+@_suspend_gated
 def barrier():
     """Synchronize: all outstanding device work completes (mpi_ops.py:980)."""
     cx = ctx()

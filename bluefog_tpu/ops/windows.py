@@ -122,6 +122,10 @@ def _dispatch_win_op(run, result_of=None):
     """Run ``run()`` inline (default) or on the service lane (async mode).
 
     Returns an int handle valid for win_wait/win_poll either way."""
+    # suspend() gate (reference operations.cc:1392-1400): block before any
+    # tracing/dispatch/enqueue so a suspended context issues no window
+    # traffic at all; resume() from another thread releases us.
+    ctx().wait_if_suspended()
     if _win_async_enabled():
         return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE)
     run()

@@ -47,7 +47,12 @@ def append_xla_flag(env: Dict[str, str], flag: str) -> Dict[str, str]:
         return env
     name = flag.lstrip("-").split("=", 1)[0]
     flags = env.get("XLA_FLAGS", "")
-    if name not in flags:
+    # Compare against each existing token's extracted --name, not a raw
+    # substring: a name that prefixes another flag's name (or appears in
+    # a value) must not suppress injection.
+    present = {tok.lstrip("-").split("=", 1)[0]
+               for tok in flags.split() if tok.startswith("-")}
+    if name not in present:
         env["XLA_FLAGS"] = (flags + " " + flag).strip()
     return env
 

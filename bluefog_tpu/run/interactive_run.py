@@ -52,6 +52,9 @@ def parse_args(argv):
     parser.add_argument("-p", "--ssh-port", type=int, default=None)
     parser.add_argument("--platform", default=None, choices=["tpu", "cpu"])
     parser.add_argument("--coordinator-port", type=int, default=3390)
+    parser.add_argument("--network-interface", default=None,
+                        help="NIC for coordinator/DCN traffic (same "
+                             "semantics as bfrun --network-interface)")
     parser.add_argument("--control-port", type=int, default=0,
                         help="driver control socket port (0 = ephemeral)")
     parser.add_argument("--control", default=None,
@@ -170,8 +173,11 @@ def _launch_engines(args, hosts, control_addr: str):
 
     coord_host = hosts[0][0]
     any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
-    if network_util.is_local_host(coord_host) and any_remote:
-        coord_host = socket.getfqdn()
+    if network_util.is_local_host(coord_host):
+        if getattr(args, "network_interface", None):
+            coord_host = network_util.interface_address(args.network_interface)
+        elif any_remote:
+            coord_host = socket.getfqdn()
     coordinator = f"{coord_host}:{args.coordinator_port}"
     base_env = env_util.exportable_env()
 

@@ -39,6 +39,35 @@ def parse_hostfile(path: str) -> List[Tuple[str, int]]:
     return hosts
 
 
+def interface_address(iface: str) -> str:
+    """IPv4 address bound to ``iface`` (Linux SIOCGIFADDR).
+
+    The TPU-native analog of the reference pinning NCCL/gloo sockets to a
+    NIC (``run/run.py:84-118``, ``--network-interface`` → iface env pins):
+    DCN-facing multi-host jobs choose which interface the jax.distributed
+    coordinator binds and advertises instead of trusting hostname
+    resolution to pick the right network."""
+    import fcntl
+    import socket
+    import struct
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        packed = struct.pack("256s", iface.encode()[:255])
+        try:
+            addr = fcntl.ioctl(s.fileno(), 0x8915, packed)[20:24]  # SIOCGIFADDR
+        except OSError as e:
+            # ValueError, not SystemExit: this also runs inside bf.init()
+            # on the coordinator host (context._maybe_init_jax_distributed),
+            # where a launcher-style exit would bury the diagnostic; bfrun
+            # converts it at its own call site
+            raise ValueError(
+                f"cannot resolve an IPv4 address on interface "
+                f"{iface!r}: {e}")
+        return socket.inet_ntoa(addr)
+    finally:
+        s.close()
+
+
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
 

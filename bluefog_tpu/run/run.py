@@ -50,6 +50,13 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
     parser.add_argument("--coordinator-port", type=int, default=3389,
                         help="port for the jax.distributed coordinator "
                              "(multi-host only)")
+    parser.add_argument("--network-interface", default=None,
+                        help="NIC for coordinator/DCN traffic (reference "
+                             "--network-interface, run.py:84-118): the "
+                             "coordinator advertises this interface's IPv4 "
+                             "when it launches here, process 0 binds to it "
+                             "(BLUEFOG_NETWORK_INTERFACE is exported to "
+                             "every worker and consumed by bf.init)")
     parser.add_argument("--timeline-filename", default=None,
                         help="per-rank chrome-tracing output prefix "
                              "(exports BLUEFOG_TIMELINE)")
@@ -78,6 +85,11 @@ def _apply_common_flags(args, env: dict, local_slots: int) -> dict:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
     if args.nodes_per_machine:
         env["BLUEFOG_NODES_PER_MACHINE"] = str(args.nodes_per_machine)
+    if getattr(args, "network_interface", None):
+        # each worker resolves the iface on ITS OWN machine at bf.init()
+        # time (context._maybe_init_jax_distributed) — the launcher cannot
+        # know a remote coordinator's addresses
+        env["BLUEFOG_NETWORK_INTERFACE"] = args.network_interface
     if args.platform == "cpu":
         if local_slots:
             env_util.force_virtual_cpu_devices(env, local_slots)
@@ -114,9 +126,19 @@ def _launch_multi_host(args, hosts) -> int:
     # the loopback address; an unresolvable container fqdn must not break it)
     coord_host = hosts[0][0]
     any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
-    if network_util.is_local_host(coord_host) and any_remote:
-        import socket
-        coord_host = socket.getfqdn()
+    if network_util.is_local_host(coord_host):
+        if args.network_interface:
+            # pin the ADVERTISED address to the chosen NIC (reference
+            # --network-interface semantics); remote coordinators resolve
+            # their own iface at bf.init() time instead
+            try:
+                coord_host = network_util.interface_address(
+                    args.network_interface)
+            except ValueError as e:
+                raise SystemExit(f"bfrun: {e}")
+        elif any_remote:
+            import socket
+            coord_host = socket.getfqdn()
     coordinator = f"{coord_host}:{args.coordinator_port}"
 
     for host, _ in hosts:

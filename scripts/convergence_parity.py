@@ -204,13 +204,21 @@ def run_table_isolated(key, args):
                                      env=os.environ.copy(),
                                      timeout=leg_timeout)
             except subprocess.TimeoutExpired as e:
+                # A wedged leg (e.g. an XLA build that ignores the
+                # injected rendezvous terminator, or
+                # BLUEFOG_NO_XLA_FLAG_INJECT) counts as a failed attempt
+                # like any nonzero exit — subprocess.run already killed
+                # the child; retry instead of aborting the whole table.
                 tail = (e.stderr or b"")
                 if isinstance(tail, bytes):
                     tail = tail.decode(errors="replace")
                 sys.stderr.write(tail[-2000:] + "\n")
-                raise SystemExit(
-                    f"mode {label!r} subprocess exceeded {leg_timeout}s "
-                    f"(CONVERGENCE_LEG_TIMEOUT)")
+                more = "; retrying" if t < tries else ""
+                sys.stderr.write(
+                    f"mode {label!r} attempt {t}/{tries} exceeded "
+                    f"{leg_timeout}s (CONVERGENCE_LEG_TIMEOUT){more}\n")
+                line = None
+                continue
             line = [l for l in out.stdout.splitlines() if l.startswith("{")]
             if out.returncode == 0 and line:
                 break

@@ -89,6 +89,35 @@ def test_compat_toggles(bf_ctx):
     bf.resume()
 
 
+def test_suspend_blocks_dispatch_until_resume(bf_ctx):
+    """suspend() must actually pause op dispatch, not set an inert flag
+    (reference operations.cc:1392-1400 pauses the background loop): an op
+    issued while suspended blocks until resume() from another thread."""
+    import threading
+    import time
+
+    x = np.arange(N, dtype=np.float32)
+    done = threading.Event()
+    result = {}
+
+    bf.suspend()
+
+    def worker():
+        result["out"] = bf.allreduce(x, average=False)
+        done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    # The op must NOT complete while suspended.  Generous margin: the
+    # single-core CI host can take a while just to reach the gate.
+    assert not done.wait(1.0), "op completed while context was suspended"
+    bf.resume()
+    assert done.wait(60.0), "op never completed after resume()"
+    t.join(10.0)
+    np.testing.assert_allclose(np.asarray(result["out"]),
+                               np.full(N, x.sum(), np.float32))
+
+
 def test_nodes_per_machine_divisibility():
     with pytest.raises(ValueError):
         bf.init(nodes_per_machine=3)  # 8 % 3 != 0

@@ -98,6 +98,39 @@ def test_bfrun_requires_command():
         main(["-np", "4"])
 
 
+def test_append_xla_flag_exact_name_match():
+    """Presence detection compares extracted --name= tokens exactly: a
+    name that is a substring of another flag's name (or of a value) must
+    not suppress injection, and a real duplicate must (user wins)."""
+    env = {"XLA_FLAGS": "--xla_cpu_collective_call_terminate_timeout_seconds=9"}
+    env_util.append_xla_flag(env, "--xla_cpu_collective_call_terminate=1")
+    assert "--xla_cpu_collective_call_terminate=1" in env["XLA_FLAGS"].split()
+    # value mentioning the name must not count as presence
+    env2 = {"XLA_FLAGS": "--xla_dump_to=/tmp/xla_cpu_multi_thread_eigen"}
+    env_util.append_xla_flag(env2, "--xla_cpu_multi_thread_eigen=false")
+    assert "--xla_cpu_multi_thread_eigen=false" in env2["XLA_FLAGS"].split()
+    # genuine duplicate: existing setting wins
+    env3 = {"XLA_FLAGS": "--xla_cpu_multi_thread_eigen=true"}
+    env_util.append_xla_flag(env3, "--xla_cpu_multi_thread_eigen=false")
+    assert env3["XLA_FLAGS"] == "--xla_cpu_multi_thread_eigen=true"
+
+
+def test_interface_address_loopback():
+    """SIOCGIFADDR resolution on the one NIC every Linux host has."""
+    assert network_util.interface_address("lo") == "127.0.0.1"
+    with pytest.raises(ValueError):
+        network_util.interface_address("definitely-no-such-iface0")
+
+
+def test_network_interface_env_plumbing():
+    """--network-interface reaches workers as BLUEFOG_NETWORK_INTERFACE
+    (each host resolves its OWN iface at bf.init; reference pins NCCL/gloo
+    ifaces through env the same way, run.py:84-118,180-198)."""
+    args = parse_args(["-np", "4", "--network-interface", "eth0", "cmd"])
+    env = make_single_host_env(args, base_env={})
+    assert env["BLUEFOG_NETWORK_INTERFACE"] == "eth0"
+
+
 def test_bfrun_np_must_match_slots():
     from bluefog_tpu.run.run import _launch_multi_host, parse_args as pa
     args = pa(["-np", "3", "-H", "a:2,b:2", "cmd"])
@@ -192,7 +225,12 @@ def test_bfrun_two_process_jax_distributed(tmp_path):
     local processes oversubscribing localhost (the reference tests multi-node
     the same way, Makefile:5-8); each joins jax.distributed via the
     coordinator env wired by run/run.py:105-172 + context.py:239-269 and
-    runs real cross-process collectives on the 4-device global mesh."""
+    runs real cross-process collectives on the 4-device global mesh.
+
+    ``--network-interface lo`` exercises the full NIC-pinning path live:
+    the advertised coordinator address resolves through SIOCGIFADDR and
+    process 0 passes a coordinator_bind_address pinned to the loopback
+    NIC (context._maybe_init_jax_distributed)."""
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -205,7 +243,7 @@ def test_bfrun_two_process_jax_distributed(tmp_path):
     out = subprocess.run(
         [sys.executable, "-m", "bluefog_tpu.run.run",
          "-H", "localhost:2,localhost:2", "--platform", "cpu",
-         "--coordinator-port", str(port),
+         "--coordinator-port", str(port), "--network-interface", "lo",
          sys.executable, str(worker)],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert out.returncode == 0, (out.stdout, out.stderr)
