@@ -4,6 +4,7 @@
 # reference's NUM_PROC).
 #
 #   make test               # full suite on the virtual mesh
+#   make test_fast          # <10-min quick gate, every subsystem covered
 #   make test NUM_DEVICES=4 # smaller mesh (CI matrix leg)
 #   make test_ops           # collectives only
 #   make test_win           # one-sided window ops
@@ -15,11 +16,17 @@
 NUM_DEVICES ?= 8
 PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
-.PHONY: test test_basics test_ops test_win test_optimizer \
+.PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench hwcheck
 
 test:
 	$(PYTEST) tests/
+
+# Quick verification gate: curated subset (tests/fast_suite.txt) covering
+# every subsystem in <10 min on one core — what the driver/CI should run
+# when the full ~3h cold suite does not fit the window.
+test_fast:
+	$(PYTEST) $$(grep -v '^#' tests/fast_suite.txt | grep -v '^$$')
 
 test_basics:
 	$(PYTEST) tests/test_basics.py tests/test_topology.py

@@ -138,17 +138,46 @@ MODES = [
 def _build_workload(key, args):
     """(name, model, sample_shape, (x, y), (x_test, y_test), hyper)."""
     if key == "lenet":
-        from mnist import synthetic_mnist          # examples/mnist.py
+        from mnist import load_mnist, synthetic_mnist   # examples/mnist.py
         from bluefog_tpu.models.lenet import LeNet
-        x, y = synthetic_mnist(n_samples=9216, seed=0)
-        if args.noise:
-            x = x + np.random.default_rng(9).normal(
-                0, args.noise, size=x.shape).astype(np.float32)
+        if args.data_dir:
+            # REAL MNIST (IDX files, examples/mnist.py loader) — the
+            # real-dataset column VERDICT r3 #5 asks for; no extra noise:
+            # the task's own difficulty de-saturates the table
+            x, y = load_mnist(args.data_dir)
+            perm = np.random.default_rng(0).permutation(len(x))[:9216]
+            x, y = x[perm], y[perm]
+            name = "LeNet / real MNIST (8-rank)"
+        else:
+            x, y = synthetic_mnist(n_samples=9216, seed=0)
+            if args.noise:
+                x = x + np.random.default_rng(9).normal(
+                    0, args.noise, size=x.shape).astype(np.float32)
+            name = "LeNet / synthetic MNIST (8-rank)"
         split = 8192
-        return ("LeNet / synthetic MNIST (8-rank)", LeNet(), (28, 28, 1),
+        return (name, LeNet(), (28, 28, 1),
                 (x[:split], y[:split]), (x[split:], y[split:]),
                 dict(lr=0.01, momentum=0.5, epochs=args.epochs,
                      batch=args.batch_size, seed=args.seed))
+    if key == "digits":
+        # REAL handwritten-digit images that ship with this machine
+        # (sklearn's bundled UCI optical-digits set, 1797 genuine 8x8
+        # scans): the real-data leg that needs no download.  Bilinear
+        # upscale to LeNet's 28x28 input; deterministic shuffle/split.
+        from sklearn.datasets import load_digits
+        from bluefog_tpu.models.lenet import LeNet
+        d = load_digits()
+        x8 = d.images.astype(np.float32) / 16.0
+        x = np.asarray(jax.image.resize(
+            jnp.asarray(x8)[..., None], (len(x8), 28, 28, 1), "bilinear"))
+        y = d.target.astype(np.int32)
+        perm = np.random.default_rng(0).permutation(len(x))
+        x, y = x[perm], y[perm]
+        split = 1536                      # 192 per rank; 261 held out
+        return ("LeNet / real digits [sklearn] (8-rank)", LeNet(),
+                (28, 28, 1), (x[:split], y[:split]), (x[split:], y[split:]),
+                dict(lr=0.01, momentum=0.5, epochs=args.digits_epochs,
+                     batch=16, seed=args.seed))
     if key == "resnet":
         from bluefog_tpu.models.resnet import ResNet18
         cx, cy = synthetic_cifar(n_samples=4608, seed=1)
@@ -194,7 +223,10 @@ def run_table_isolated(key, args):
                "--epochs", str(args.epochs),
                "--batch-size", str(args.batch_size),
                "--resnet-batch", str(args.resnet_batch),
+               "--digits-epochs", str(args.digits_epochs),
                "--seed", str(args.seed), "--noise", str(args.noise)]
+        if args.data_dir:
+            cmd += ["--data-dir", args.data_dir]
         leg_timeout = int(os.environ.get("CONVERGENCE_LEG_TIMEOUT", "3600"))
         tries = int(os.environ.get("CONVERGENCE_LEG_RETRIES", "3"))
         line = None
@@ -271,6 +303,18 @@ def main():
                          "what this script measures.")
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with MNIST IDX files: the LeNet leg "
+                         "then trains on REAL MNIST (examples/mnist.py "
+                         "loader) instead of the synthetic stand-in")
+    ap.add_argument("--skip-digits", action="store_true",
+                    help="skip the bundled real-digits leg (sklearn's "
+                         "1797 genuine UCI scans; runs by default as the "
+                         "no-download real-data column)")
+    ap.add_argument("--digits-epochs", type=int, default=12,
+                    help="epochs for the digits leg (192 samples/rank -> "
+                         "12 steps/epoch at batch 16; the small real set "
+                         "needs more passes to close the mixing transient)")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--noise", type=float, default=1.3,
                     help="extra pixel noise stddev: de-saturates the "
@@ -285,6 +329,15 @@ def main():
         return
 
     run_table_isolated("lenet", args)
+    if not args.skip_digits:
+        try:
+            import sklearn  # noqa: F401 — not a declared dependency
+        except ImportError:
+            sys.stderr.write(
+                "skipping the real-digits leg: scikit-learn (which bundles "
+                "the real UCI digit scans) is not installed\n")
+        else:
+            run_table_isolated("digits", args)
     if args.include_resnet:
         run_table_isolated("resnet", args)
 
