@@ -34,6 +34,25 @@ from bluefog_tpu.models.resnet import ResNet50, ResNet50Fused
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
 METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
 
+# Every invocation appends UTC-stamped provenance lines (start, phases,
+# result/error JSON) here, so any number this benchmark ever prints has a
+# contemporaneous raw log — the r3 headline was disqualified precisely
+# for lacking one (see BENCH_r03_session.json "status").
+RUN_LOG = os.environ.get(
+    "BENCH_RUN_LOG",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_runs.log"))
+
+
+def runlog(msg: str) -> None:
+    """Append one stamped line to RUN_LOG; never raises, never buffers."""
+    try:
+        with open(RUN_LOG, "a") as f:
+            f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+                    f"[pid {os.getpid()}] {msg}\n")
+    except OSError:
+        pass
+
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public numbers);
 # the single source for every benchmark script (lm_bench/perf_probe/
 # single_ops_bench import from here)
@@ -213,6 +232,8 @@ def _init_watchdog(seconds: int):
                 budget_left = total_deadline_mono - time.monotonic()
                 no_retry = budget_left < 120.0  # too little budget to help
                 if not no_retry and attempt < max_attempts:
+                    runlog(f"attempt {attempt}: {state['phase']} exceeded "
+                           f"{seconds}s; re-exec for attempt {attempt + 1}")
                     print(f"bench attempt {attempt}: {state['phase']} "
                           f"exceeded {seconds}s; re-exec for attempt "
                           f"{attempt + 1}", file=sys.stderr, flush=True)
@@ -238,19 +259,21 @@ def _init_watchdog(seconds: int):
                        f"{state['phase']}")
                 if no_retry and attempt < max_attempts:
                     why += ", retry skipped: budget exhausted"
-                print(json.dumps({
+                err = {
                     "metric": METRIC,
                     "value": 0.0, "unit": "img/sec/chip",
                     "vs_baseline": 0.0,
                     "error": f"accelerator backend unreachable "
-                             f"({why}, attempt {attempt}/{max_attempts})"},
-                ), flush=True)
+                             f"({why}, attempt {attempt}/{max_attempts})"}
+                runlog(f"FAIL {json.dumps(err)}")
+                print(json.dumps(err), flush=True)
                 os._exit(3)
             done.wait(min(remaining, 5.0))
 
     threading.Thread(target=_watch, daemon=True).start()
 
     def advance(phase):
+        runlog(f"phase: {state['phase']} -> {phase}")
         state["phase"] = phase
         state["deadline"] = time.monotonic() + seconds
 
@@ -283,9 +306,16 @@ def main():
     # false-fired on a live backend.  The TOTAL budget across phases and
     # re-exec attempts (BENCH_TOTAL_BUDGET, default 1140 s) guarantees the
     # error JSON prints before a 1200 s harness stage timeout kills us.
+    runlog(f"start attempt {os.environ.get('BENCH_ATTEMPT', '1')}: "
+           f"batch={batch} image={image} windows={k_small}/{k_large} "
+           f"iters={iters} fused={os.environ.get('BLUEFOG_FUSED_CONV_BN', '0')} "
+           f"init_timeout={os.environ.get('BENCH_INIT_TIMEOUT', '600')} "
+           f"total_budget={os.environ.get('BENCH_TOTAL_BUDGET', '1140')}")
     advance, cancel = _init_watchdog(
         int(os.environ.get("BENCH_INIT_TIMEOUT", "600")))
     bf.init()
+    runlog(f"init ok: {len(jax.devices())} x {jax.devices()[0].device_kind} "
+           f"({jax.default_backend()})")
     advance("first compile+step")
     n = bf.size()
 
@@ -427,6 +457,8 @@ def main():
         # achieved fraction of the chip's peak bf16 FLOP/s (MFU);
         # step_flops is per-device (post-SPMD-partitioning HLO)
         out["mfu_pct"] = round(step_flops / dt / peak * 100, 1)
+    runlog(f"RESULT {json.dumps(out)} (per-pair step times: "
+           f"{[round(t, 4) for t in step_times]})")
     print(json.dumps(out))
 
 
