@@ -44,14 +44,23 @@ RUN_LOG = os.environ.get(
                  "bench_runs.log"))
 
 
+_RUNLOG_BROKEN = [False]
+
+
 def runlog(msg: str) -> None:
-    """Append one stamped line to RUN_LOG; never raises, never buffers."""
+    """Append one stamped line to RUN_LOG; never raises, never buffers.
+    An unwritable log warns ONCE on stderr — silence would retroactively
+    strip a genuine measurement of its provenance (the r3 failure mode)."""
     try:
         with open(RUN_LOG, "a") as f:
             f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
                     f"[pid {os.getpid()}] {msg}\n")
-    except OSError:
-        pass
+    except OSError as e:
+        if not _RUNLOG_BROKEN[0]:
+            _RUNLOG_BROKEN[0] = True
+            print(f"bench: provenance log {RUN_LOG} unwritable ({e}); "
+                  f"this run's numbers will lack a raw log",
+                  file=sys.stderr, flush=True)
 
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public numbers);
 # the single source for every benchmark script (lm_bench/perf_probe/

@@ -47,6 +47,39 @@ def test_win_create_free(bf_ctx):
     assert bf.get_current_created_window_names() == []
 
 
+def test_suspend_blocks_window_dispatch(bf_ctx):
+    """suspend() gates window ops at _dispatch_win_op BEFORE any
+    tracing/dispatch (reference pauses its op loop, operations.cc:
+    1392-1400); resume() from another thread releases the caller."""
+    import threading
+    x = rank_tensor()
+    assert bf.win_create(x, "susp")
+    try:
+        bf.suspend()
+        done = threading.Event()
+        errors = []
+
+        def worker():
+            try:
+                bf.win_put(x, "susp")
+            except BaseException as e:   # a gate that RAISES instead of
+                errors.append(e)         # blocking must fail fast below
+            finally:
+                done.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert not done.wait(1.0), (
+            f"win_put returned/raised while suspended (errors={errors})")
+        bf.resume()
+        assert done.wait(60.0), "win_put never completed after resume()"
+        t.join(10.0)
+        assert not errors, f"win_put raised after resume: {errors}"
+    finally:
+        bf.resume()
+        bf.win_free("susp")
+
+
 def test_set_topology_refused_while_windows_exist(bf_ctx):
     bf.win_create(rank_tensor(), "w")
     with pytest.raises(RuntimeError):
