@@ -1,0 +1,110 @@
+"""Fused-vs-plain conv+BN verdict from bench provenance logs.
+
+The r3 verdict's item #2: ``ResNet50Fused`` (the HBM-roofline attack,
+ops/conv_bn.py) is code without a hardware measurement.  The r4 queue
+runs ``python bench.py`` (plain) then ``BLUEFOG_FUSED_CONV_BN=1 python
+bench.py``; this stage pairs each run's start line (which records the
+fused flag) with its RESULT line by pid in ``bench_runs.log`` and writes
+``FUSED_VERDICT.json``:
+
+  speedup > 1.03  -> "fused wins — flip the bench default"
+  0.97..1.03      -> "bandwidth-neutral — XLA was already optimal"
+  < 0.97          -> "fused loses — keep the XLA path"
+
+Runs as the queue stage right after the two bench runs so the verdict
+lands in the committed log even when no session is live to read it.
+
+``--since <ISO-UTC>`` (the queue passes its own start stamp) ignores
+older RESULT lines, so a bench stage that died this window can never be
+silently paired against a stale measurement from a previous session;
+the pair must also share the bench config (batch/windows/iters) and
+timing mode, or the script refuses to rule.
+"""
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.environ.get("BENCH_RUN_LOG", os.path.join(REPO, "bench_runs.log"))
+OUT = os.path.join(REPO, "FUSED_VERDICT.json")
+
+STAMP = re.compile(r"^(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z) ")
+START = re.compile(
+    r"\[pid (\d+)\] start attempt \d+: (batch=\S+ image=\S+ windows=\S+ "
+    r"iters=\S+) fused=(\d)")
+RESULT = re.compile(r"\[pid (\d+)\] RESULT (\{.*\}) \(")
+
+
+def latest_results(path, since):
+    """{fused_flag: (result_dict, config_str)} from the newest RESULT per
+    flag stamped at/after ``since`` (lexicographic works: fixed ISO-UTC)."""
+    started, out = {}, {}
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        raise SystemExit(f"fused_verdict: cannot read {path}: {e}")
+    for line in lines:
+        ts = STAMP.match(line)
+        if not ts or (since and ts.group(1) < since):
+            continue
+        m = START.search(line)
+        if m:
+            started[m.group(1)] = (m.group(3) == "1", m.group(2))
+            continue
+        m = RESULT.search(line)
+        if m and m.group(1) in started:
+            try:
+                r = json.loads(m.group(2))
+            except ValueError:
+                continue
+            if r.get("value", 0) > 0:
+                flag, config = started[m.group(1)]
+                out[flag] = (r, config)   # newest wins
+    return out
+
+
+def main():
+    since = None
+    if len(sys.argv) > 2 and sys.argv[1] == "--since":
+        since = sys.argv[2]
+    res = latest_results(LOG, since)
+    if False not in res or True not in res:
+        have = sorted("fused" if k else "plain" for k in res)
+        raise SystemExit(
+            f"fused_verdict: need one plain and one fused RESULT in {LOG}"
+            + (f" since {since}" if since else "")
+            + f"; have {have or 'none'} — run the two bench stages first")
+    (plain_r, plain_cfg), (fused_r, fused_cfg) = res[False], res[True]
+    if plain_cfg != fused_cfg:
+        raise SystemExit(
+            f"fused_verdict: non-comparable runs — plain [{plain_cfg}] vs "
+            f"fused [{fused_cfg}]; rerun both stages with one config")
+    if plain_r.get("timing") != fused_r.get("timing"):
+        raise SystemExit(
+            f"fused_verdict: timing modes differ ({plain_r.get('timing')} "
+            f"vs {fused_r.get('timing')}); rerun — a differenced number "
+            f"must not be compared against an amortized fallback")
+    plain, fused = plain_r["value"], fused_r["value"]
+    speedup = fused / plain
+    if speedup > 1.03:
+        verdict = ("fused wins - flip the bench default "
+                   "(BLUEFOG_FUSED_CONV_BN=1)")
+    elif speedup >= 0.97:
+        verdict = ("bandwidth-neutral - XLA already ran the chain at the "
+                   "bytes roofline; keep the XLA default and close the item")
+    else:
+        verdict = "fused loses - keep the XLA path as default"
+    out = {"plain_img_s": plain, "fused_img_s": fused,
+           "speedup": round(speedup, 3), "verdict": verdict,
+           "config": plain_cfg, "since": since,
+           "plain_result": plain_r, "fused_result": fused_r,
+           "provenance": os.path.basename(LOG)}
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

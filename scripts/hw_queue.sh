@@ -24,7 +24,8 @@ run() {
     [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
     return 0
 }
-echo "hw queue started $(date -u +%FT%TZ)" | tee -a "$LOG"
+QSTART=$(date -u +%FT%TZ)
+echo "hw queue started $QSTART" | tee -a "$LOG"
 # Tier 1 — minutes: the chip-lowering validations that have never run
 # on silicon (VERDICT r3 missing #2).  These alone make a window count.
 run 600  python scripts/hw_kernel_check.py
@@ -34,6 +35,11 @@ run 900  env BENCH_ON_TPU=1 python scripts/conv_bn_probe.py
 # `python bench.py` run is warm), then the fused-vs-plain verdict run.
 run 1200 python bench.py
 run 1200 env BLUEFOG_FUSED_CONV_BN=1 python bench.py
+# Pair THIS window's two runs into FUSED_VERDICT.json (no device work —
+# the r3 item-#2 deliverable lands even with no session live to read the
+# log; --since refuses stale cross-session pairings).
+python scripts/fused_verdict.py --since "$QSTART" 2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -ne 0 ] && FAILED=$((FAILED + 1))
 # Tier 3 — ablations and tuning sweeps.
 run 1200 python scripts/perf_probe.py
 run 1200 python scripts/flash_tune.py
