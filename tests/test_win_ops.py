@@ -390,6 +390,38 @@ def test_win_sched_validation(bf_ctx):
         bf.win_put(rank_tensor(), "ws", sched=sched_exp, step=0)
 
 
+def test_suspend_blocks_async_lane_enqueue(bf_ctx, monkeypatch):
+    """On the async service lane the suspend gate sits BEFORE the enqueue
+    (_dispatch_win_op): a suspended context hands the native service
+    nothing at all — the exact analog of the reference's paused comm
+    thread seeing no new work (operations.cc:1392-1400)."""
+    import threading
+    monkeypatch.setenv("BLUEFOG_WIN_ASYNC", "1")
+    x = rank_tensor()
+    assert bf.win_create(x, "asusp")
+    try:
+        bf.suspend()
+        done = threading.Event()
+        handles = []
+
+        def worker():
+            try:
+                handles.append(bf.win_put_nonblocking(x, "asusp"))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert not done.wait(1.0), "async win_put enqueued while suspended"
+        bf.resume()
+        assert done.wait(60.0), "async win_put never enqueued after resume()"
+        t.join(10.0)
+        assert handles and bf.win_wait(handles[0])
+    finally:
+        bf.resume()
+        bf.win_free("asusp")
+
+
 def test_async_lane_preserves_program_order(bf_ctx, monkeypatch):
     """The guarantee win_mutex documents — program-order serialization of
     window-buffer access — asserted, not just claimed (VERDICT r2 weak #6):
