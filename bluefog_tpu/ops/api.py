@@ -54,23 +54,86 @@ __all__ = [
 # Handles
 # ---------------------------------------------------------------------------
 
-_handle_lock = threading.Lock()
+# RLock: materializing a deferred op dispatches the real op under the
+# lock, and that dispatch re-enters _register_handle on the same thread
+_handle_lock = threading.RLock()
 _handle_map: Dict[int, Tuple[jax.Array, str, int]] = {}
 _next_handle = [0]
 
 
+class _Deferred:
+    """A nonblocking op enqueued while the context is suspended.
+
+    Reference parity: ``EnqueueTensorAllreduce`` et al. return a handle
+    immediately even while ``bluefog_suspend`` has paused the background
+    loop (operations.cc:1392-1400) — only *execution* waits for resume.
+    The thunk dispatches the real op on the first ``poll()`` after
+    resume or inside ``synchronize()``, so the reference-legal
+    single-threaded pattern ``suspend(); h = op_nonblocking(x);
+    resume(); wait(h)`` completes here too instead of self-deadlocking
+    at the dispatch gate."""
+
+    __slots__ = ("thunk",)
+
+    def __init__(self, thunk):
+        self.thunk = thunk
+
+
 def _suspend_gated(fn):
-    """suspend() gate at the dispatch boundary: block BEFORE any
-    tracing/dispatch so a suspended context issues no collective traffic
-    at all — the SPMD equivalent of the reference pausing its background
-    op loop (operations.cc:1392-1400).  Blocking ops synchronize their
-    decorated nonblocking twin, so every public op is covered; resume()
-    from another thread releases the waiters."""
+    """suspend() gate for BLOCKING entry points (barrier, window ops via
+    ``_dispatch_win_op``): block BEFORE any tracing/dispatch so a
+    suspended context issues no collective traffic at all — the SPMD
+    equivalent of the reference pausing its background op loop
+    (operations.cc:1392-1400); resume() from another thread releases the
+    waiters.  Nonblocking collectives use ``_suspend_deferred`` instead,
+    which returns a handle without blocking."""
     @functools.wraps(fn)
     def gated(*args, **kwargs):
         _ctx_mod.ctx().wait_if_suspended()
         return fn(*args, **kwargs)
     return gated
+
+
+def _suspend_deferred(fn):
+    """suspend() gate for ``*_nonblocking`` ops: enqueue-then-defer.
+
+    While suspended, no tracing/dispatch happens — the call is recorded
+    as a :class:`_Deferred` and a handle returns immediately (reference
+    enqueue semantics).  ``synchronize``/``poll`` perform the dispatch
+    once the context is running again."""
+    @functools.wraps(fn)
+    def gated(*args, **kwargs):
+        if not _ctx_mod.ctx().suspended:
+            return fn(*args, **kwargs)
+
+        def thunk():
+            inner = fn(*args, **kwargs)
+            with _handle_lock:
+                return _handle_map.pop(inner)
+
+        # silent placeholder (no op/name): the timeline ENQUEUE fires
+        # exactly once, at materialize time, from the real registration
+        # inside fn — carrying the caller's name however it was passed
+        # (positionally or by keyword), so the trace keeps one ENQUEUE +
+        # one COMMUNICATE per logical op
+        return _register_handle(_Deferred(thunk))
+    return gated
+
+
+def _materialize(handle: int):
+    """Dispatch a deferred op exactly once (first waiter wins) and return
+    its output.  The dispatch runs under the handle lock — serialized,
+    like the reference's single comm thread."""
+    with _handle_lock:
+        if handle not in _handle_map:
+            raise ValueError(f"unknown handle {handle}")
+        out, opname, start_tok = _handle_map[handle]
+        if isinstance(out, _Deferred):
+            # adopt the inner registration's name/start token: its clock
+            # starts at dispatch, which is when COMMUNICATE really begins
+            out, opname, start_tok = out.thunk()
+            _handle_map[handle] = (out, opname, start_tok)
+    return out
 
 
 def _register_handle(output, op: str = "", name: Optional[str] = None) -> int:
@@ -90,18 +153,38 @@ def _register_handle(output, op: str = "", name: Optional[str] = None) -> int:
 
 
 def poll(handle: int) -> bool:
-    """True when the nonblocking op behind ``handle`` has completed."""
+    """True when the nonblocking op behind ``handle`` has completed.
+
+    A handle enqueued under ``suspend()`` polls False until ``resume()``
+    (the reference's paused loop hasn't run it); the first poll after
+    resume dispatches it."""
     with _handle_lock:
         if handle not in _handle_map:
             raise ValueError(f"unknown handle {handle}")
         out, _, _ = _handle_map[handle]
+    if isinstance(out, _Deferred):
+        if _ctx_mod.ctx().suspended:
+            return False
+        out = _materialize(handle)
     ready = jax.tree_util.tree_all(
         jax.tree.map(lambda a: a.is_ready() if hasattr(a, "is_ready") else True, out))
     return bool(ready)
 
 
 def synchronize(handle: int):
-    """Wait for a nonblocking op and return its output."""
+    """Wait for a nonblocking op and return its output.
+
+    A handle enqueued under ``suspend()`` blocks here until ``resume()``
+    from another thread, then dispatches — exactly the reference's
+    behavior (the paused background loop runs the enqueued op only after
+    ``bluefog_resume``)."""
+    with _handle_lock:
+        if handle not in _handle_map:
+            raise ValueError("Cannot find handle to synchronize")
+        out = _handle_map[handle][0]
+    if isinstance(out, _Deferred):
+        _ctx_mod.ctx().wait_if_suspended()
+        _materialize(handle)
     with _handle_lock:
         if handle not in _handle_map:
             raise ValueError("Cannot find handle to synchronize")
@@ -318,7 +401,7 @@ def _mesh_id():
 # Collective ops (blocking + nonblocking)
 # ---------------------------------------------------------------------------
 
-@_suspend_gated
+@_suspend_deferred
 def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
@@ -334,7 +417,7 @@ allreduce_ = allreduce
 allreduce_nonblocking_ = allreduce_nonblocking
 
 
-@_suspend_gated
+@_suspend_deferred
 def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> int:
     cx = ctx()
     out = _broadcast_fn(cx.rank_axis, int(root_rank), _mesh_id())(to_global(x))
@@ -374,7 +457,7 @@ def _stack_ragged(x) -> Tuple[jax.Array, Tuple[int, ...]]:
     return padded, counts
 
 
-@_suspend_gated
+@_suspend_deferred
 def allgather_nonblocking(x, name: Optional[str] = None) -> int:
     if isinstance(x, (list, tuple)):
         padded, counts = _stack_ragged(x)
@@ -396,7 +479,7 @@ def allgather(x, name: Optional[str] = None):
     return synchronize(allgather_nonblocking(x, name))
 
 
-@_suspend_gated
+@_suspend_deferred
 def neighbor_allreduce_nonblocking(
         x, *,
         self_weight: Optional[float] = None,
@@ -557,7 +640,7 @@ def _edge_slots(A: np.ndarray, offsets: Tuple[int, ...], out_rows: int):
     return slots
 
 
-@_suspend_gated
+@_suspend_deferred
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
                                    src_ranks=None, dst_ranks=None) -> int:
     cx = ctx()
@@ -600,7 +683,7 @@ def neighbor_allgather(x, name: Optional[str] = None, *,
         x, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
 
 
-@_suspend_gated
+@_suspend_deferred
 def hierarchical_neighbor_allreduce_nonblocking(
         x, name: Optional[str] = None) -> int:
     cx = ctx()
@@ -639,7 +722,7 @@ def hierarchical_neighbor_allreduce(x, name: Optional[str] = None):
     return synchronize(hierarchical_neighbor_allreduce_nonblocking(x, name))
 
 
-@_suspend_gated
+@_suspend_deferred
 def pair_gossip_nonblocking(x, pairs: Sequence[Tuple[int, int]],
                             self_weight: Optional[float] = None,
                             pair_weight: Optional[float] = None,

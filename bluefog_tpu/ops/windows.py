@@ -123,8 +123,17 @@ def _dispatch_win_op(run, result_of=None):
 
     Returns an int handle valid for win_wait/win_poll either way."""
     # suspend() gate (reference operations.cc:1392-1400): block before any
-    # tracing/dispatch/enqueue so a suspended context issues no window
-    # traffic at all; resume() from another thread releases us.
+    # tracing/dispatch/enqueue, so a suspended context issues no put/get/
+    # accumulate traffic.  This covers exactly the one-sided *transfer*
+    # ops routed through here; win_update/win_update_then_collect/
+    # win_publish/win_fetch stay ungated — they are local buffer math that
+    # the reference also runs on the caller thread while suspended
+    # (DoWinSync, torch/mpi_win_ops.cc:345-427).  Unlike the collectives'
+    # deferred nonblocking path (ops/api.py::_suspend_deferred), window
+    # ops BLOCK the calling thread here even for *_nonblocking variants:
+    # deferring a window mutation would reorder it against win_update
+    # reads.  Hard constraint: resume() must come from a different thread
+    # than a window-op caller (docs/faq.md).
     ctx().wait_if_suspended()
     if _win_async_enabled():
         return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE)

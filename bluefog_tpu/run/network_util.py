@@ -68,6 +68,54 @@ def interface_address(iface: str) -> str:
         s.close()
 
 
+def remote_interface_address(host: str, iface: str,
+                             ssh_port: Optional[int] = None,
+                             timeout: int = 15) -> str:
+    """Resolve ``iface``'s IPv4 on a REMOTE host over ssh.
+
+    Used by bfrun when the coordinator host is not the launch host: the
+    advertised BLUEFOG_COORDINATOR must carry the address process 0 will
+    actually bind (context.py pins ``coordinator_bind_address`` to this
+    same iface on that machine), not whatever the hostfile name happens
+    to resolve to — hostname misresolution onto the wrong NIC is exactly
+    what ``--network-interface`` exists to fix, and with a remote
+    coordinator the launcher cannot resolve the iface locally.  Runs the
+    same SIOCGIFADDR lookup as :func:`interface_address` via a
+    stdlib-only snippet.  Raises ValueError with the remote diagnostic on
+    failure (bfrun converts to SystemExit at its call site)."""
+    import re
+    if not re.fullmatch(r"[\w.:-]+", iface):
+        raise ValueError(f"invalid interface name {iface!r}")
+    snippet = ("import socket,struct,fcntl;"
+               "s=socket.socket(socket.AF_INET,socket.SOCK_DGRAM);"
+               "print(socket.inet_ntoa(fcntl.ioctl(s.fileno(),0x8915,"
+               f"struct.pack('256s',{iface.encode()!r}))[20:24]))")
+    cmd = ["ssh", "-o", "BatchMode=yes"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [host, f'python3 -c "{snippet}"']
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        raise ValueError(
+            f"ssh to {host} timed out resolving interface {iface!r}")
+    except FileNotFoundError:
+        raise ValueError("ssh not found on this machine")
+    if out.returncode != 0 or not out.stdout.strip():
+        raise ValueError(
+            f"cannot resolve interface {iface!r} on {host}: "
+            f"{(out.stderr or out.stdout).strip() or 'no output'}")
+    addr = out.stdout.strip().splitlines()[-1].strip()
+    import socket
+    try:
+        socket.inet_aton(addr)
+    except OSError:
+        raise ValueError(
+            f"unexpected address {addr!r} from {host} for {iface!r}")
+    return addr
+
+
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
 

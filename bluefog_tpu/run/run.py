@@ -129,8 +129,7 @@ def _launch_multi_host(args, hosts) -> int:
     if network_util.is_local_host(coord_host):
         if args.network_interface:
             # pin the ADVERTISED address to the chosen NIC (reference
-            # --network-interface semantics); remote coordinators resolve
-            # their own iface at bf.init() time instead
+            # --network-interface semantics)
             try:
                 coord_host = network_util.interface_address(
                     args.network_interface)
@@ -139,6 +138,18 @@ def _launch_multi_host(args, hosts) -> int:
         elif any_remote:
             import socket
             coord_host = socket.getfqdn()
+    elif args.network_interface:
+        # REMOTE coordinator host: resolve the pinned iface's IPv4 over
+        # ssh ON THAT HOST and advertise it.  Advertising the hostfile
+        # name while process 0 binds the iface IP (context.py's
+        # coordinator_bind_address) would point every worker at whatever
+        # address the name resolves to — possibly a NIC nothing listens
+        # on, the exact misresolution --network-interface fixes.
+        try:
+            coord_host = network_util.remote_interface_address(
+                coord_host, args.network_interface, args.ssh_port)
+        except ValueError as e:
+            raise SystemExit(f"bfrun: {e}")
     coordinator = f"{coord_host}:{args.coordinator_port}"
 
     for host, _ in hosts:

@@ -13,6 +13,11 @@ FAILED=0
 . scripts/_probe.sh   # cwd is the repo root (cd above)
 run() {
     local budget=$1; shift
+    # test hook (tests/test_hw_queue.py): HW_QUEUE_BUDGET_DIV shrinks the
+    # per-stage wall budgets so the fake-transport integration test can
+    # exercise a real budget overrun in seconds (ceil: never 0)
+    local div=${HW_QUEUE_BUDGET_DIV:-1}
+    budget=$(( (budget + div - 1) / div ))
     if ! probe; then
         echo "=== transport dead before: $* — aborting queue (exit 9) ===" | tee -a "$LOG"
         exit 9

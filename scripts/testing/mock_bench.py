@@ -1,0 +1,57 @@
+"""Mock bench.py for the fake-transport hw_queue integration test.
+
+Writes the same provenance-log lines the real bench writes (start line
+with the fused flag + config, RESULT / partial RESULT / FAIL) so the
+REAL scripts/fused_verdict.py downstream of the two bench stages pairs
+or refuses exactly as it would on hardware.  Behavior comes from argv
+(the PATH shim forwards the `.behavior` spec): ``ok <img_s>``,
+``partial <img_s>``, or ``fail``.
+"""
+
+import json
+import os
+import sys
+import time
+
+METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
+CFG = ("batch=64 image=224 windows=5/25 iters=4 "
+       f"fused={os.environ.get('BLUEFOG_FUSED_CONV_BN', '0')} "
+       "init_timeout=600 total_budget=1140")
+
+
+def line(msg):
+    with open(os.environ["BENCH_RUN_LOG"], "a") as f:
+        f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+                f"[pid {os.getpid()}] {msg}\n")
+
+
+def main():
+    behavior = sys.argv[1] if len(sys.argv) > 1 else "ok"
+    value = float(sys.argv[2]) if len(sys.argv) > 2 else 2500.0
+    fused = os.environ.get("BLUEFOG_FUSED_CONV_BN", "0") == "1"
+    if behavior == "fail-fused":
+        # plain stage banks a number, fused stage dies: the refusal case
+        behavior = "fail" if fused else "ok"
+    if fused and behavior in ("ok", "partial"):
+        value = round(value * 1.04, 1)   # distinct sides -> a real speedup
+    line(f"start attempt 1: {CFG}")
+    if behavior == "fail":
+        err = {"metric": METRIC, "value": 0.0, "unit": "img/sec/chip",
+               "vs_baseline": 0.0,
+               "error": "accelerator backend unreachable (mock)"}
+        line(f"FAIL {json.dumps(err)}")
+        print(json.dumps(err))
+        sys.exit(3)
+    out = {"metric": METRIC, "value": value, "unit": "img/sec/chip",
+           "vs_baseline": round(value / 269.4, 3), "communication": "none",
+           "timing": "two-window-differenced"}
+    if behavior == "partial":
+        out.update(partial=True, pairs_done=1, pairs_total=4)
+        line(f"RESULT {json.dumps(out)} (partial, est so far: [0.02])")
+    else:
+        line(f"RESULT {json.dumps(out)} (per-pair step times: [0.02])")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,40 @@ def test_suspend_blocks_dispatch_until_resume(bf_ctx):
                                np.full(N, x.sum(), np.float32))
 
 
+def test_suspended_nonblocking_defers_single_thread(bf_ctx):
+    """The reference-legal SINGLE-THREADED pattern (ADVICE r4): enqueue
+    returns a handle even while suspended (operations.cc enqueue is not
+    paused, only the loop), so suspend -> nonblocking -> resume -> wait
+    must complete on one thread instead of deadlocking at the gate."""
+    x = np.arange(N, dtype=np.float32)
+    bf.suspend()
+    h = bf.allreduce_nonblocking(x, average=False)
+    assert isinstance(h, int)
+    # not dispatched yet: the paused "loop" hasn't run it
+    assert not bf.poll(h)
+    bf.resume()
+    out = bf.wait(h)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full(N, x.sum(), np.float32))
+
+
+def test_suspended_nonblocking_poll_dispatches_after_resume(bf_ctx):
+    x = np.arange(N, dtype=np.float32)
+    bf.suspend()
+    h = bf.neighbor_allreduce_nonblocking(x)
+    assert not bf.poll(h)       # suspended: enqueued, not run
+    assert not bf.poll(h)       # idempotent while suspended
+    bf.resume()
+    # first poll after resume dispatches; completion follows
+    import time
+    deadline = time.monotonic() + 120.0
+    while not bf.poll(h):
+        assert time.monotonic() < deadline, "deferred op never completed"
+        time.sleep(0.05)
+    out = bf.synchronize(h)
+    assert np.asarray(out).shape == x.shape
+
+
 def test_nodes_per_machine_divisibility():
     with pytest.raises(ValueError):
         bf.init(nodes_per_machine=3)  # 8 % 3 != 0

@@ -66,3 +66,34 @@ def test_amortized_fallback_not_engaged_on_clean_run():
 def test_scalar_fetch_returns_first_element():
     out = {"a": jnp.arange(6.0).reshape(2, 3) + 7.0}
     assert scalar_fetch(out) == 7.0
+
+
+def test_on_pair_fires_after_every_pair_with_running_estimates():
+    seen = []
+    dt, _ = measure_step_time(lambda k: 0.01 * k + 5.0, 2, 10,
+                              on_pair=lambda i, est: seen.append((i, est)))
+    assert [i for i, _ in seen] == [1, 2, 3]
+    # running estimate lists grow by one per pair and are the raw
+    # (unsorted) per-pair estimates
+    assert [len(est) for _, est in seen] == [1, 2, 3]
+    assert seen[-1][1] == pytest.approx([0.01, 0.01, 0.01])
+
+
+def test_on_pair_fires_even_when_jitter_raises():
+    # the whole point of per-pair banking: evidence from finished pairs
+    # survives a run whose overall verdict is "jitter dominated"
+    times = iter([0.1, 5.0] * 3)
+    seen = []
+    with pytest.raises(TimingJitterError):
+        measure_step_time(lambda k: next(times), 1, 3,
+                          on_pair=lambda i, est: seen.append(i))
+    assert seen == [1, 2, 3]
+
+
+def test_on_pair_threads_through_amortized_wrapper():
+    seen = []
+    dt, est, amortized = measure_step_time_amortized(
+        lambda k: 0.01 * k + 0.5, 1, 3,
+        on_pair=lambda i, e: seen.append(i))
+    assert not amortized
+    assert seen == [1, 2, 3]

@@ -1,0 +1,178 @@
+"""fused_verdict.py pairs the plain and fused bench runs from the
+provenance log into FUSED_VERDICT.json.  The refusal logic (stale
+pairings, mismatched configs/timing modes) and the new partial-pair
+acceptance path (bench.py banks a RESULT line after every timing pair so
+a mid-run transport death still leaves a citable number — the failure
+mode that zeroed rounds 2-4) run here without any device work.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "fused_verdict",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "fused_verdict.py"))
+fv = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(fv)
+
+CFG = "batch=64 image=224 windows=5/25 iters=4"
+METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
+
+
+def start_line(ts, pid, fused, cfg=CFG):
+    return (f"{ts} [pid {pid}] start attempt 1: {cfg} fused={int(fused)} "
+            f"init_timeout=600 total_budget=1140")
+
+
+def result_line(ts, pid, value, timing="two-window-differenced",
+                partial=None, pairs_done=None):
+    r = {"metric": METRIC, "value": value, "unit": "img/sec/chip",
+         "vs_baseline": round(value / 269.4, 3), "communication": "none",
+         "timing": timing}
+    if partial:
+        r["partial"] = True
+        r["pairs_done"] = pairs_done
+        r["pairs_total"] = 4
+        tail = "(partial, est so far: [0.02])"
+    else:
+        tail = "(per-pair step times: [0.02, 0.02, 0.02, 0.02])"
+    return f"{ts} [pid {pid}] RESULT {json.dumps(r)} {tail}"
+
+
+@pytest.fixture()
+def verdict_env(tmp_path, monkeypatch):
+    log = tmp_path / "bench_runs.log"
+    out = tmp_path / "FUSED_VERDICT.json"
+    monkeypatch.setattr(fv, "LOG", str(log))
+    monkeypatch.setattr(fv, "OUT", str(out))
+    return log, out
+
+
+def run_main(monkeypatch, since=None):
+    argv = ["fused_verdict.py"]
+    if since:
+        argv += ["--since", since]
+    monkeypatch.setattr(fv.sys, "argv", argv)
+    fv.main()
+
+
+def test_full_pair_produces_unmarked_verdict(verdict_env, monkeypatch,
+                                             capsys):
+    log, out = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:11:00Z", 11, 2600.0),
+    ]) + "\n")
+    run_main(monkeypatch)
+    v = json.loads(out.read_text())
+    assert v["plain_img_s"] == 2500.0 and v["fused_img_s"] == 2600.0
+    assert v["speedup"] == pytest.approx(1.04)
+    assert "fused wins" in v["verdict"]
+    assert "partial" not in v
+
+
+def test_partial_pair_accepted_and_marked(verdict_env, monkeypatch):
+    # fused run died after 2 of 4 pairs: its last banked partial pairs
+    # against the full plain run, and the verdict says so
+    log, out = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:08:00Z", 11, 2480.0, partial=True,
+                    pairs_done=1),
+        result_line("2026-08-01T05:09:00Z", 11, 2490.0, partial=True,
+                    pairs_done=2),
+    ]) + "\n")
+    run_main(monkeypatch)
+    v = json.loads(out.read_text())
+    assert v["partial"] is True
+    assert v["pairs_done"] == {"plain": "full", "fused": 2}
+    assert v["fused_img_s"] == 2490.0     # newest partial wins
+    assert "bandwidth-neutral" in v["verdict"]
+
+
+def test_full_result_supersedes_earlier_partials(verdict_env, monkeypatch):
+    log, out = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:02:00Z", 10, 2100.0, partial=True,
+                    pairs_done=1),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:08:00Z", 11, 2550.0, partial=True,
+                    pairs_done=1),
+        result_line("2026-08-01T05:11:00Z", 11, 2600.0),
+    ]) + "\n")
+    run_main(monkeypatch)
+    v = json.loads(out.read_text())
+    assert "partial" not in v
+    assert v["plain_img_s"] == 2500.0 and v["fused_img_s"] == 2600.0
+
+
+def test_refuses_without_both_sides(verdict_env, monkeypatch):
+    log, _ = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+    ]) + "\n")
+    with pytest.raises(SystemExit, match="need one plain and one fused"):
+        run_main(monkeypatch)
+
+
+def test_since_refuses_stale_cross_session_pairing(verdict_env, monkeypatch):
+    # yesterday's fused result must not pair against today's plain run
+    log, _ = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-07-31T05:06:00Z", 9, fused=True),
+        result_line("2026-07-31T05:11:00Z", 9, 2600.0),
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+    ]) + "\n")
+    with pytest.raises(SystemExit, match="need one plain and one fused"):
+        run_main(monkeypatch, since="2026-08-01T00:00:00Z")
+
+
+def test_refuses_mismatched_configs(verdict_env, monkeypatch):
+    log, _ = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True,
+                   cfg="batch=32 image=224 windows=5/25 iters=4"),
+        result_line("2026-08-01T05:11:00Z", 11, 2600.0),
+    ]) + "\n")
+    with pytest.raises(SystemExit, match="non-comparable"):
+        run_main(monkeypatch)
+
+
+def test_refuses_mismatched_timing_modes(verdict_env, monkeypatch):
+    log, _ = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:11:00Z", 11, 2600.0,
+                    timing="amortized-fallback"),
+    ]) + "\n")
+    with pytest.raises(SystemExit, match="timing modes differ"):
+        run_main(monkeypatch)
+
+
+def test_zero_value_results_ignored(verdict_env, monkeypatch):
+    # a FAIL json (value 0.0) must never count as a measurement
+    log, _ = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 0.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:11:00Z", 11, 2600.0),
+    ]) + "\n")
+    with pytest.raises(SystemExit, match="need one plain and one fused"):
+        run_main(monkeypatch)

@@ -1,0 +1,136 @@
+"""End-to-end dry-run of the hardware queue WITHOUT a TPU (VERDICT r4
+item 5: the queue's first live window must not be its first integration
+test).  A `python` PATH shim (scripts/testing/python) fakes the
+transport probe and every stage; scripts/fused_verdict.py runs REAL.
+Covered: all-green, mid-queue transport death (exit 9) + watcher
+handoff/refire, a stage exceeding its wall budget, and the fused/plain
+pairing refusal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUEUE = os.path.join(REPO, "scripts", "hw_queue.sh")
+WATCH = os.path.join(REPO, "scripts", "hw_watch.sh")
+SHIM_DIR = os.path.join(REPO, "scripts", "testing")
+
+
+@pytest.fixture()
+def fake(tmp_path):
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "alive").touch()
+    env = dict(
+        os.environ,
+        PATH=f"{SHIM_DIR}:{os.environ['PATH']}",
+        FAKE_TPU_STATE=str(state),
+        FAKE_TPU_REAL_PYTHON=sys.executable,
+        PROBE_TIMEOUT="30",
+        BENCH_RUN_LOG=str(tmp_path / "bench_runs.log"),
+        FUSED_VERDICT_OUT=str(tmp_path / "FUSED_VERDICT.json"),
+        HW_QUEUE_BUDGET_DIV="600",   # 600s/900s/1200s -> 1s/2s/2s
+    )
+    (state / "bench.py.behavior").write_text("bench ok 2500")
+    return state, env, tmp_path
+
+
+def run_queue(env, log):
+    return subprocess.run(["bash", QUEUE, str(log)], env=env,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_queue_all_green(fake):
+    state, env, tmp = fake
+    r = run_queue(env, tmp / "q.log")
+    log = (tmp / "q.log").read_text()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 stage(s) failed" in log
+    # every tier ran, cheapest first
+    for stage in ("hw_kernel_check.py", "conv_bn_probe.py", "bench.py",
+                  "perf_probe.py", "flash_tune.py", "lm_bench.py",
+                  "single_ops_bench.py", "scale_bench.py"):
+        assert stage in log, f"stage {stage} missing from queue log"
+    assert log.index("hw_kernel_check.py") < log.index("bench.py")
+    # the REAL fused_verdict paired this window's two mock runs
+    v = json.loads((tmp / "FUSED_VERDICT.json").read_text())
+    assert v["plain_img_s"] == 2500.0 and v["fused_img_s"] == 2600.0
+    assert v["speedup"] == pytest.approx(1.04)
+    assert "fused wins" in v["verdict"]
+
+
+def test_queue_mid_run_transport_death_exits_9(fake):
+    state, env, tmp = fake
+    (state / "conv_bn_probe.py.behavior").write_text("kill-transport")
+    r = run_queue(env, tmp / "q.log")
+    log = (tmp / "q.log").read_text()
+    assert r.returncode == 9, r.stdout + r.stderr
+    assert "transport dead before" in log and "aborting queue" in log
+    # the death was discovered BEFORE the next stage burned device time
+    assert "perf_probe.py ok" not in log
+    assert not (tmp / "FUSED_VERDICT.json").exists()
+
+
+def test_queue_stage_budget_overrun_kills_and_continues(fake):
+    state, env, tmp = fake
+    (state / "hw_kernel_check.py.behavior").write_text("hang")
+    r = run_queue(env, tmp / "q.log")
+    log = (tmp / "q.log").read_text()
+    # timeout(1) TERMs the hung stage at its (scaled) budget -> exit 124;
+    # the queue counts the failure and keeps going
+    assert "=== exit 124" in log
+    assert "conv_bn_probe.py" in log and "scale_bench.py" in log
+    assert r.returncode == 1
+    assert "1 stage(s) failed" in log
+    # the rest of the window still banked the verdict
+    assert (tmp / "FUSED_VERDICT.json").exists()
+
+
+def test_queue_fused_plain_pairing_refusal(fake):
+    state, env, tmp = fake
+    (state / "bench.py.behavior").write_text("bench fail-fused 2500")
+    r = run_queue(env, tmp / "q.log")
+    log = (tmp / "q.log").read_text()
+    assert r.returncode == 1
+    assert "need one plain and one fused" in log
+    assert not (tmp / "FUSED_VERDICT.json").exists()
+
+
+def test_watcher_refires_after_mid_queue_death(fake):
+    """hw_watch.sh handoff: a queue aborted by a dead transport (exit 9)
+    sends the watcher back to probing, and the queue re-fires green on
+    the next alive window."""
+    state, env, tmp = fake
+    (state / "conv_bn_probe.py.behavior").write_text("kill-transport")
+    watch_log = tmp / "watch.log"
+    with open(watch_log, "w") as out:
+        proc = subprocess.Popen(
+            ["bash", WATCH, "1", str(tmp / "q.log")],
+            env=env, stdout=out, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 120
+        while "resuming watch" not in watch_log.read_text():
+            assert time.monotonic() < deadline, (
+                f"no handoff: {watch_log.read_text()}")
+            assert proc.poll() is None, (
+                f"watcher died early rc={proc.returncode}: "
+                f"{watch_log.read_text()}")
+            time.sleep(0.2)
+        # transport comes back healthy: next probe must re-fire the queue
+        (state / "conv_bn_probe.py.behavior").write_text("ok")
+        (state / "alive").touch()
+        assert proc.wait(timeout=120) == 0, watch_log.read_text()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    qlog = (tmp / "q.log").read_text()
+    assert qlog.count("hw queue started") == 2      # aborted + completed
+    assert "0 stage(s) failed" in qlog
+    v = json.loads((tmp / "FUSED_VERDICT.json").read_text())
+    assert v["speedup"] == pytest.approx(1.04)

@@ -138,6 +138,98 @@ def test_bfrun_np_must_match_slots():
         _launch_multi_host(args, [("a", 2), ("b", 2)])
 
 
+def test_remote_interface_address_parses_ssh_output(monkeypatch):
+    import subprocess as sp
+
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["cmd"] = cmd
+        return sp.CompletedProcess(cmd, 0, stdout="10.0.0.7\n", stderr="")
+
+    monkeypatch.setattr(network_util.subprocess, "run", fake_run)
+    addr = network_util.remote_interface_address("nodeA", "eth1",
+                                                 ssh_port=2222)
+    assert addr == "10.0.0.7"
+    assert seen["cmd"][:3] == ["ssh", "-o", "BatchMode=yes"]
+    assert "-p" in seen["cmd"] and "2222" in seen["cmd"]
+    assert "nodeA" in seen["cmd"]
+    assert "eth1" in seen["cmd"][-1]          # snippet embeds the iface
+
+
+def test_remote_interface_address_failure_modes(monkeypatch):
+    import subprocess as sp
+
+    monkeypatch.setattr(
+        network_util.subprocess, "run",
+        lambda cmd, **kw: sp.CompletedProcess(cmd, 1, stdout="",
+                                              stderr="no such iface"))
+    with pytest.raises(ValueError, match="no such iface"):
+        network_util.remote_interface_address("nodeA", "eth1")
+
+    monkeypatch.setattr(
+        network_util.subprocess, "run",
+        lambda cmd, **kw: sp.CompletedProcess(cmd, 0, stdout="garbage\n",
+                                              stderr=""))
+    with pytest.raises(ValueError, match="unexpected address"):
+        network_util.remote_interface_address("nodeA", "eth1")
+
+    # shell-metacharacter iface names are rejected before any ssh runs
+    with pytest.raises(ValueError, match="invalid interface"):
+        network_util.remote_interface_address("nodeA", "eth1; rm -rf /")
+
+
+def test_remote_coordinator_advertises_resolved_iface_ip(monkeypatch):
+    """ADVICE r4: with a REMOTE coordinator host and --network-interface,
+    the advertised BLUEFOG_COORDINATOR must be the iface IP resolved ON
+    that host (where process 0 binds), not the hostfile hostname."""
+    import subprocess as sp
+    from bluefog_tpu.run import run as run_mod
+
+    monkeypatch.setattr(run_mod.network_util, "check_ssh",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(run_mod.network_util, "remote_interface_address",
+                        lambda host, iface, port=None: "10.1.2.3")
+
+    launched = []
+
+    class FakeProc:
+        def __init__(self, cmd, **kw):
+            launched.append((cmd, kw))
+
+        def poll(self):
+            return 0
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(sp, "Popen", FakeProc)
+    args = run_mod.parse_args(
+        ["-H", "nodeA:2,nodeB:2", "--network-interface", "eth1", "cmd"])
+    rc = run_mod._launch_multi_host(args, [("nodeA", 2), ("nodeB", 2)])
+    assert rc == 0
+    assert len(launched) == 2
+    for cmd, _ in launched:
+        # both are remote → ssh command strings carrying env assignments
+        joined = " ".join(cmd)
+        assert "BLUEFOG_COORDINATOR=10.1.2.3:3389" in joined
+        assert "nodeA" not in joined.split("BLUEFOG_COORDINATOR", 1)[1][:40]
+
+
+def test_remote_coordinator_resolution_failure_exits_cleanly(monkeypatch):
+    from bluefog_tpu.run import run as run_mod
+
+    def boom(host, iface, port=None):
+        raise ValueError(f"cannot resolve interface {iface!r} on {host}")
+
+    monkeypatch.setattr(run_mod.network_util, "remote_interface_address",
+                        boom)
+    args = run_mod.parse_args(
+        ["-H", "nodeA:2,nodeB:2", "--network-interface", "eth9", "cmd"])
+    with pytest.raises(SystemExit, match="bfrun: cannot resolve"):
+        run_mod._launch_multi_host(args, [("nodeA", 2), ("nodeB", 2)])
+
+
 def test_ibfrun_stop_noop():
     from bluefog_tpu.run.interactive_run import main
     assert main(["stop"]) == 0
