@@ -46,6 +46,12 @@ RUN_LOG = os.environ.get(
 
 _RUNLOG_BROKEN = [False]
 
+# Best banked per-pair partial RESULT of THIS run (set by main's
+# bank_partial): if the transport dies mid-timing, the watchdog prints
+# this instead of a value-0.0 error — a short window must never again
+# end a round with nothing (BENCH_r02..r04 were all 0.0)
+_BEST_PARTIAL = [None]
+
 
 def runlog(msg: str) -> None:
     """Append one stamped line to RUN_LOG; never raises, never buffers.
@@ -240,6 +246,18 @@ def _init_watchdog(seconds: int):
             remaining = min(state["deadline"],
                             total_deadline_mono) - time.monotonic()
             if remaining <= 0:
+                if _BEST_PARTIAL[0] is not None:
+                    # the run already banked a real differenced number:
+                    # print THAT (marked partial) instead of a 0.0 error,
+                    # and do not burn the window on a re-exec retry
+                    pout = dict(_BEST_PARTIAL[0])
+                    pout["note"] = (f"transport stalled during "
+                                    f"{state['phase']}; value from "
+                                    f"{pout.get('pairs_done')}/"
+                                    f"{pout.get('pairs_total')} pairs")
+                    runlog(f"WATCHDOG-PARTIAL {json.dumps(pout)}")
+                    print(json.dumps(pout), flush=True)
+                    os._exit(0)
                 # The transport stalls in windows of minutes (observed r3);
                 # a fresh attempt can land in the next alive window, and the
                 # persistent compile cache makes a healthy retry fast.  The
@@ -423,9 +441,12 @@ def main():
         step += 1
         if i == 0:
             # first full round-trip proves compile+execute+fetch all
-            # work — only now is the transport known-good
+            # work — but the watchdog STAYS armed through the timed
+            # windows (re-advanced per window below): a transport that
+            # dies mid-timing must print the best banked partial, not
+            # hang until the harness kills us with nothing on stdout
             _ = float(loss)
-            cancel()
+            advance("timed windows")
     if loss is not None:
         # scalar fetch: reliable execution barrier (axon's
         # block_until_ready can return before remote execution completes)
@@ -433,6 +454,10 @@ def main():
 
     def timed_window(k):
         nonlocal variables, opt_state, loss, step
+        if warmup > 0:
+            # fresh per-window watchdog deadline (warmup=0 runs disarmed:
+            # their first window legitimately includes the first compile)
+            advance(f"timed window k={k}")
         t0 = time.perf_counter()
         for _ in range(k):
             variables, opt_state, loss = step_fn(
@@ -469,11 +494,13 @@ def main():
         }
         if step_flops and peak:
             pout["mfu_pct"] = round(step_flops / pdt / peak * 100, 1)
+        _BEST_PARTIAL[0] = pout   # the watchdog prints this on a stall
         runlog(f"RESULT {json.dumps(pout)} (partial, est so far: "
                f"{[round(t, 4) for t in est_so_far]})")
 
     dt, step_times, amortized = measure_step_time_amortized(
         timed_window, k_small, k_large, pairs=iters, on_pair=bank_partial)
+    cancel()   # timing done: everything from here is host-side bookkeeping
     timing = "amortized-fallback" if amortized else "two-window-differenced"
     # headline value uses the jitter-robust median step time dt; the
     # per-pair rates feed only the stdev field (asymmetric filtering of

@@ -171,21 +171,11 @@ def _launch_engines(args, hosts, control_addr: str):
     from . import env_util, network_util
     from .run import _FORWARD_PREFIXES, _apply_common_flags
 
-    coord_host = hosts[0][0]
     any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
     try:
-        if network_util.is_local_host(coord_host):
-            if getattr(args, "network_interface", None):
-                coord_host = network_util.interface_address(
-                    args.network_interface)
-            elif any_remote:
-                coord_host = socket.getfqdn()
-        elif getattr(args, "network_interface", None):
-            # remote coordinator host: advertise the iface IP resolved on
-            # that host (same rationale as bfrun, run.py)
-            coord_host = network_util.remote_interface_address(
-                coord_host, args.network_interface,
-                getattr(args, "ssh_port", None))
+        coord_host = network_util.resolve_coordinator_host(
+            hosts[0][0], getattr(args, "network_interface", None),
+            getattr(args, "ssh_port", None), any_remote)
     except ValueError as e:
         # a typo'd --network-interface must exit cleanly, like bfrun
         raise SystemExit(f"ibfrun: {e}")

@@ -116,6 +116,38 @@ def remote_interface_address(host: str, iface: str,
     return addr
 
 
+def resolve_coordinator_host(coord_host: str, iface: Optional[str],
+                             ssh_port: Optional[int],
+                             any_remote: bool) -> str:
+    """The address every worker should dial for the jax.distributed
+    coordinator (shared by bfrun and ibfrun).
+
+    * local coordinator + pinned iface → that iface's IPv4 (process 0
+      binds it);
+    * local coordinator + remote workers → this machine's routable fqdn
+      (a loopback name would point remote workers at themselves);
+    * REMOTE coordinator + pinned iface → the iface's IPv4 resolved over
+      ssh ON that host — advertising the hostfile name while process 0
+      binds the iface IP (context.py's ``coordinator_bind_address``)
+      would send workers to whatever the name resolves to, possibly a
+      NIC nothing listens on, the exact misresolution
+      ``--network-interface`` exists to fix;
+    * otherwise the hostfile name unchanged.
+
+    Raises ValueError on iface-resolution failure; launchers convert it
+    to SystemExit under their own prog prefix."""
+    if is_local_host(coord_host):
+        if iface:
+            return interface_address(iface)
+        if any_remote:
+            import socket
+            return socket.getfqdn()
+        return coord_host
+    if iface:
+        return remote_interface_address(coord_host, iface, ssh_port)
+    return coord_host
+
+
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
 

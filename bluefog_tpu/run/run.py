@@ -119,37 +119,15 @@ def _launch_multi_host(args, hosts) -> int:
     if args.num_proc and args.num_proc != total:
         raise SystemExit(
             f"bfrun: -np {args.num_proc} != sum of host slots {total}")
-    # The coordinator address is dialed by every host: a loopback name for
-    # hosts[0] would point *remote* workers at themselves, so substitute
-    # this machine's routable hostname — but only when remote hosts exist
-    # (an all-local job, e.g. 2 processes oversubscribing localhost, keeps
-    # the loopback address; an unresolvable container fqdn must not break it)
-    coord_host = hosts[0][0]
+    # The coordinator address is dialed by every host — local-vs-remote
+    # and NIC-pinning cases live in network_util.resolve_coordinator_host
+    # (shared with ibfrun; reference --network-interface semantics)
     any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
-    if network_util.is_local_host(coord_host):
-        if args.network_interface:
-            # pin the ADVERTISED address to the chosen NIC (reference
-            # --network-interface semantics)
-            try:
-                coord_host = network_util.interface_address(
-                    args.network_interface)
-            except ValueError as e:
-                raise SystemExit(f"bfrun: {e}")
-        elif any_remote:
-            import socket
-            coord_host = socket.getfqdn()
-    elif args.network_interface:
-        # REMOTE coordinator host: resolve the pinned iface's IPv4 over
-        # ssh ON THAT HOST and advertise it.  Advertising the hostfile
-        # name while process 0 binds the iface IP (context.py's
-        # coordinator_bind_address) would point every worker at whatever
-        # address the name resolves to — possibly a NIC nothing listens
-        # on, the exact misresolution --network-interface fixes.
-        try:
-            coord_host = network_util.remote_interface_address(
-                coord_host, args.network_interface, args.ssh_port)
-        except ValueError as e:
-            raise SystemExit(f"bfrun: {e}")
+    try:
+        coord_host = network_util.resolve_coordinator_host(
+            hosts[0][0], args.network_interface, args.ssh_port, any_remote)
+    except ValueError as e:
+        raise SystemExit(f"bfrun: {e}")
     coordinator = f"{coord_host}:{args.coordinator_port}"
 
     for host, _ in hosts:

@@ -33,4 +33,24 @@ run lm-ring            examples/long_context_lm.py --seq-len 256 --steps 3 --dim
 run lm-ulysses         examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --attn ulysses
 run lm-remat           examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --remat
 
+# The two notebooks execute for real (reference parity: the notebooks are
+# its interactive-mode showcase, examples/interactive_bluefog.ipynb).
+# nbconvert runs each kernel in the notebook's own directory, which the
+# notebooks' `sys.path.insert(0, abspath(".."))` bootstrap expects; they
+# pin the 8-device CPU mesh themselves in their first cell.
+run_nb() {
+    local name="$1"; shift
+    echo "=== $name ==="
+    if ! python -c "import nbconvert, ipykernel" 2>/dev/null; then
+        echo "run_all_examples: nbconvert/ipykernel missing — install the" \
+             "'test' extra (pip install -e .[test]) to run the notebook legs" >&2
+        exit 1
+    fi
+    timeout 900 python -m nbconvert --to notebook --execute --stdout \
+        --ExecutePreprocessor.timeout=600 "$1" > /dev/null
+}
+
+run_nb nb-helloworld   examples/interactive_helloworld.ipynb
+run_nb nb-resource     examples/resource_allocation.ipynb
+
 echo "ALL EXAMPLES PASSED"

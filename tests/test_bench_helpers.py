@@ -97,3 +97,54 @@ def test_on_pair_threads_through_amortized_wrapper():
         on_pair=lambda i, e: seen.append(i))
     assert not amortized
     assert seen == [1, 2, 3]
+
+
+_WATCHDOG_PROG = """
+import json, time
+import bench
+{setup}
+adv, cancel = bench._init_watchdog(1)
+adv("timed window k=25")
+time.sleep(30)   # the watchdog must fire long before this returns
+"""
+
+
+def _run_watchdog_prog(tmp_path, setup, extra_env=()):
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_RUN_LOG=str(tmp_path / "log"),
+               BENCH_MAX_ATTEMPTS="1",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    env.pop("BENCH_T0", None)
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-c", _WATCHDOG_PROG.format(setup=setup)],
+        capture_output=True, text=True, timeout=120, env=env)
+
+
+def test_watchdog_prints_banked_partial_not_zero(tmp_path):
+    """A transport stall mid-timing must surface the best banked partial
+    on stdout (exit 0) — not the value-0.0 error that zeroed rounds 2-4."""
+    r = _run_watchdog_prog(tmp_path, setup=(
+        'bench._BEST_PARTIAL[0] = {"metric": bench.METRIC, "value": 123.4,'
+        ' "unit": "img/sec/chip", "partial": True,'
+        ' "pairs_done": 2, "pairs_total": 4}'))
+    assert r.returncode == 0, r.stderr
+    import json
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] == 123.4 and out["partial"] is True
+    assert "transport stalled" in out["note"]
+    assert "WATCHDOG-PARTIAL" in (tmp_path / "log").read_text()
+
+
+def test_watchdog_zero_error_when_nothing_banked(tmp_path):
+    r = _run_watchdog_prog(tmp_path, setup="pass")
+    assert r.returncode == 3, r.stderr
+    import json
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert "unreachable" in out["error"]

@@ -14,15 +14,25 @@ import gzip
 import importlib.util
 import os
 import struct
-import sys
 
 import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO, "examples"))
 
-from mnist import load_mnist  # noqa: E402  (examples/mnist.py)
+
+def _load_module(name, path):
+    # importlib, not a sys.path insert: examples/ is full of generically
+    # named modules (mnist, resnet, benchmark) that must not shadow
+    # top-level imports for the rest of the pytest session
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+load_mnist = _load_module(
+    "example_mnist", os.path.join(REPO, "examples", "mnist.py")).load_mnist
 
 N = 64
 
@@ -78,12 +88,9 @@ def test_load_mnist_missing_dir_raises(tmp_path):
 
 
 def _load_parity_module():
-    spec = importlib.util.spec_from_file_location(
+    return _load_module(
         "convergence_parity",
         os.path.join(REPO, "scripts", "convergence_parity.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def test_convergence_parity_data_dir_branch(tmp_path):
