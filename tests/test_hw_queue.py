@@ -34,7 +34,11 @@ def fake(tmp_path):
         PROBE_TIMEOUT="30",
         BENCH_RUN_LOG=str(tmp_path / "bench_runs.log"),
         FUSED_VERDICT_OUT=str(tmp_path / "FUSED_VERDICT.json"),
-        HW_QUEUE_BUDGET_DIV="600",   # 600s/900s/1200s -> 1s/2s/2s
+        # 600s/900s/1200s -> 20s/30s/40s: small enough that the overrun
+        # test completes in seconds, large enough that a saturated
+        # single-core host (the full suite runs 8-device JAX tests
+        # concurrently) can't push an instant mock stage past its budget
+        HW_QUEUE_BUDGET_DIV="30",
     )
     (state / "bench.py.behavior").write_text("bench ok 2500")
     return state, env, tmp_path

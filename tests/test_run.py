@@ -179,6 +179,25 @@ def test_remote_interface_address_failure_modes(monkeypatch):
         network_util.remote_interface_address("nodeA", "eth1; rm -rf /")
 
 
+def test_resolve_coordinator_host_cases(monkeypatch):
+    """The four addressing cases both launchers share
+    (network_util.resolve_coordinator_host)."""
+    rc = network_util.resolve_coordinator_host
+    # local coordinator, no iface, all-local job: loopback name unchanged
+    assert rc("localhost", None, None, any_remote=False) == "localhost"
+    # local coordinator + pinned iface: that iface's IPv4
+    assert rc("localhost", "lo", None, any_remote=True) == "127.0.0.1"
+    # local coordinator + remote workers, no iface: routable fqdn
+    import socket
+    assert rc("localhost", None, None, any_remote=True) == socket.getfqdn()
+    # remote coordinator + iface: resolved over ssh ON that host
+    monkeypatch.setattr(network_util, "remote_interface_address",
+                        lambda h, i, p: ("resolved", h, i, p)[0])
+    assert rc("nodeA", "eth1", 22, any_remote=True) == "resolved"
+    # remote coordinator, no iface: hostfile name unchanged
+    assert rc("nodeA", None, None, any_remote=True) == "nodeA"
+
+
 def test_remote_coordinator_advertises_resolved_iface_ip(monkeypatch):
     """ADVICE r4: with a REMOTE coordinator host and --network-interface,
     the advertised BLUEFOG_COORDINATOR must be the iface IP resolved ON
