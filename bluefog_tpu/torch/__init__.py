@@ -22,8 +22,8 @@ available), mirroring the reference's CPU-staging mode for GPU tensors
 """
 
 from .mpi_ops import (
-    allreduce, allreduce_nonblocking,
-    broadcast, broadcast_nonblocking,
+    allreduce, allreduce_nonblocking, allreduce_, allreduce_nonblocking_,
+    broadcast, broadcast_nonblocking, broadcast_, broadcast_nonblocking_,
     allgather, allgather_nonblocking,
     neighbor_allreduce, neighbor_allreduce_nonblocking,
     neighbor_allgather, neighbor_allgather_nonblocking,
@@ -45,6 +45,7 @@ from .optimizers import (
     CommunicationType,
     DistributedOptimizer,
     DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedAdaptThenCombineOptimizer,
@@ -56,7 +57,9 @@ from .optimizers import (
 
 __all__ = [
     "allreduce", "allreduce_nonblocking",
+    "allreduce_", "allreduce_nonblocking_",
     "broadcast", "broadcast_nonblocking",
+    "broadcast_", "broadcast_nonblocking_",
     "allgather", "allgather_nonblocking",
     "neighbor_allreduce", "neighbor_allreduce_nonblocking",
     "neighbor_allgather", "neighbor_allgather_nonblocking",
@@ -78,6 +81,7 @@ __all__ = [
     "CommunicationType",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
+    "DistributedAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
     "DistributedHierarchicalNeighborAllreduceOptimizer",
     "DistributedAdaptThenCombineOptimizer",

@@ -12,6 +12,7 @@ reference's fp16 MPI path converts through a custom dtype
 (``bluefog/common/half.cc``).
 """
 
+import weakref
 from typing import Dict, Optional
 
 import jax
@@ -23,7 +24,9 @@ from ..ops import windows as _win
 
 __all__ = [
     "allreduce", "allreduce_nonblocking",
+    "allreduce_", "allreduce_nonblocking_",
     "broadcast", "broadcast_nonblocking",
+    "broadcast_", "broadcast_nonblocking_",
     "allgather", "allgather_nonblocking",
     "neighbor_allreduce", "neighbor_allreduce_nonblocking",
     "neighbor_allgather", "neighbor_allgather_nonblocking",
@@ -47,6 +50,13 @@ _STAGED_DTYPES = {torch.bfloat16: torch.float32, torch.float16: torch.float32}
 
 # handle -> original torch dtype (restored at synchronize time)
 _torch_handles: Dict[int, torch.dtype] = {}
+
+# handle -> in-place destination: the reference's ``allreduce_`` /
+# ``broadcast_`` mutate their input tensor (torch/mpi_ops.py:108-319);
+# synchronize copies the result back into it and returns it.  Weakrefs:
+# an abandoned handle (never waited) must not pin a multi-GB tensor in
+# this module dict for the process lifetime.
+_inplace_targets: Dict[int, weakref.ref] = {}
 
 
 def _to_numpy(t: torch.Tensor):
@@ -95,13 +105,22 @@ def synchronize(handle: int) -> torch.Tensor:
     (returned with its natural dtype).
     """
     dtype = _torch_handles.pop(handle, None)
+    target_ref = _inplace_targets.pop(handle, None)
+    target = target_ref() if target_ref is not None else None
     out = _api.synchronize(handle)   # raises ValueError for unknown handles
     if dtype is not None:
-        return _to_torch(out, dtype)
-    arr = np.array(out)
-    if arr.dtype.name == "bfloat16":     # ml_dtypes — numpy bridge can't
-        return torch.from_numpy(arr.astype(np.float32)).to(torch.bfloat16)
-    return torch.from_numpy(arr)
+        res = _to_torch(out, dtype)
+    else:
+        arr = np.array(out)
+        if arr.dtype.name == "bfloat16":     # ml_dtypes — numpy bridge can't
+            res = torch.from_numpy(arr.astype(np.float32)).to(torch.bfloat16)
+        else:
+            res = torch.from_numpy(arr)
+    if target is not None:
+        with torch.no_grad():
+            target.copy_(res)
+        return target
+    return res
 
 
 wait = synchronize
@@ -118,6 +137,20 @@ def allreduce(t: torch.Tensor, average: bool = True,
     return synchronize(allreduce_nonblocking(t, average, name))
 
 
+def allreduce_nonblocking_(t: torch.Tensor, average: bool = True,
+                           name: Optional[str] = None) -> int:
+    """In-place nonblocking allreduce: synchronize writes the result back
+    into ``t`` and returns it (reference ``allreduce_nonblocking_``)."""
+    h = allreduce_nonblocking(t, average, name)
+    _inplace_targets[h] = weakref.ref(t)
+    return h
+
+
+def allreduce_(t: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_nonblocking_(t, average, name))
+
+
 def broadcast_nonblocking(t: torch.Tensor, root_rank: int,
                           name: Optional[str] = None) -> int:
     return _nonblocking(_api.broadcast_nonblocking, t, root_rank, name)
@@ -126,6 +159,19 @@ def broadcast_nonblocking(t: torch.Tensor, root_rank: int,
 def broadcast(t: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
     return synchronize(broadcast_nonblocking(t, root_rank, name))
+
+
+def broadcast_nonblocking_(t: torch.Tensor, root_rank: int,
+                           name: Optional[str] = None) -> int:
+    """In-place nonblocking broadcast (reference ``broadcast_nonblocking_``)."""
+    h = broadcast_nonblocking(t, root_rank, name)
+    _inplace_targets[h] = weakref.ref(t)
+    return h
+
+
+def broadcast_(t: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_nonblocking_(t, root_rank, name))
 
 
 def allgather_nonblocking(t: torch.Tensor, name: Optional[str] = None) -> int:

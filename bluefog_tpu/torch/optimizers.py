@@ -32,6 +32,7 @@ __all__ = [
     "CommunicationType",
     "DistributedOptimizer",
     "DistributedGradientAllreduceOptimizer",
+    "DistributedAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
     "DistributedHierarchicalNeighborAllreduceOptimizer",
     "DistributedAdaptThenCombineOptimizer",
@@ -120,7 +121,7 @@ class _GradientAllreduceMixin(_DistributedMixin):
     def _bft_communicate(self):
         for p in self._bft_params():
             if p.grad is not None:
-                p.grad.copy_(_ops.allreduce(p.grad, average=True))
+                _ops.allreduce_(p.grad, average=True)
 
 
 class _CombineMixin(_DistributedMixin):
@@ -146,7 +147,7 @@ class _CombineMixin(_DistributedMixin):
         for p in self._bft_params():
             with torch.no_grad():
                 if ct == CommunicationType.allreduce:
-                    p.copy_(_ops.allreduce(p.data, average=True))
+                    _ops.allreduce_(p.data, average=True)
                 elif ct == CommunicationType.hierarchical_neighbor_allreduce:
                     p.copy_(_ops.hierarchical_neighbor_allreduce(p.data))
                 else:
@@ -207,6 +208,18 @@ def DistributedGradientAllreduceOptimizer(
     return _reclass(optimizer, _GradientAllreduceMixin,
                     "DistributedGradientAllreduceOptimizer",
                     num_steps_per_communication)
+
+
+def DistributedAllreduceOptimizer(
+        optimizer: torch.optim.Optimizer,
+        num_steps_per_communication: int = 1) -> torch.optim.Optimizer:
+    """CTA with a GLOBAL allreduce of the weights (reference factory
+    torch/optimizers.py:1301): combine = full average, then local step."""
+    opt = _reclass(optimizer, _CombineMixin,
+                   "DistributedAllreduceOptimizer",
+                   num_steps_per_communication)
+    opt.communication_type = CommunicationType.allreduce
+    return opt
 
 
 def DistributedNeighborAllreduceOptimizer(
@@ -456,8 +469,15 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     if communication == "neighbor_allreduce":
         opt = DistributedNeighborAllreduceOptimizer(
             optimizer, num_steps_per_communication, sched)
-    elif communication in ("allreduce", "gradient_allreduce"):
+    elif communication == "gradient_allreduce":
         opt = DistributedGradientAllreduceOptimizer(
+            optimizer, num_steps_per_communication)
+    elif communication == "allreduce":
+        # weight-average CTA, matching DistributedAllreduceOptimizer (the
+        # reference's factory of that name averages WEIGHTS,
+        # torch/optimizers.py:1301); use "gradient_allreduce" for the
+        # Horovod-style gradient averaging
+        opt = DistributedAllreduceOptimizer(
             optimizer, num_steps_per_communication)
     elif communication == "hierarchical_neighbor_allreduce":
         opt = DistributedHierarchicalNeighborAllreduceOptimizer(

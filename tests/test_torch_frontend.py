@@ -66,6 +66,46 @@ def test_nonblocking_poll_wait(bf_ctx):
     assert torch.allclose(out, torch.full_like(out, (N_DEVICES - 1) / 2.0))
 
 
+def test_allreduce_inplace_mutates_input(bf_ctx):
+    """Reference parity: allreduce_ writes the result INTO its argument
+    (torch/mpi_ops.py:108-212) — the returned tensor IS the input."""
+    t = _rankval()
+    out = bft.allreduce_(t)
+    assert out is t
+    assert torch.allclose(t, torch.full_like(t, (N_DEVICES - 1) / 2.0))
+
+
+def test_allreduce_inplace_nonblocking(bf_ctx):
+    t = _rankval()
+    h = bft.allreduce_nonblocking_(t, average=False)
+    out = bft.wait(h)
+    assert out is t
+    expected = sum(range(N_DEVICES))
+    assert torch.allclose(t, torch.full_like(t, float(expected)))
+
+
+def test_broadcast_inplace_mutates_input(bf_ctx):
+    t = _rankval()
+    out = bft.broadcast_(t, root_rank=2)
+    assert out is t
+    assert torch.allclose(t, torch.full_like(t, 2.0))
+
+
+def test_distributed_allreduce_optimizer_global_cta(bf_ctx):
+    """DistributedAllreduceOptimizer (reference torch/optimizers.py:1301):
+    combine = GLOBAL weight average before the local step, so after one
+    step from rank-distinct weights every rank holds the same values."""
+    torch.manual_seed(0)
+    w = torch.nn.Parameter(_rankval((3,)).clone())
+    opt = bft.DistributedAllreduceOptimizer(
+        torch.optim.SGD([w], lr=0.0))   # lr=0: isolate the combine
+    w.grad = torch.zeros_like(w)
+    opt.step()
+    expected = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(w.data, torch.full_like(w.data, expected))
+    assert type(opt).__name__ == "DistributedAllreduceOptimizer"
+
+
 def test_broadcast_parameters(bf_ctx):
     sd = {"w": _rankval((2, 2)), "meta": 7}
     out = bft.broadcast_parameters(sd, root_rank=2)
