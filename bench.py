@@ -230,7 +230,7 @@ def _init_watchdog(seconds: int):
     # TOTAL wall-clock budget across ALL phases and ALL re-exec attempts,
     # anchored at attempt 1's start (epoch time survives the exec).  The
     # harness running this benchmark kills the process at some stage
-    # timeout (hw_queue.sh: 1200 s); the error JSON must print BEFORE
+    # timeout (hw_queue.sh: 3300 s); the error JSON must print BEFORE
     # that, so the watchdog fires at whichever comes first — the phase
     # deadline or the total budget — and never retries into a window too
     # short to matter.
@@ -296,11 +296,19 @@ def _init_watchdog(seconds: int):
                        f"{state['phase']}")
                 if no_retry and attempt < max_attempts:
                     why += ", retry skipped: budget exhausted"
+                # Post-init the diagnosis is genuinely ambiguous: the r5
+                # window showed a transport that answered init then died
+                # mid-compile (RPCs hang forever), which is WALL-identical
+                # to a slow compile — name both instead of guessing
+                cause = ("accelerator backend unreachable"
+                         if state["phase"] == "init" else
+                         "backend unreachable mid-run or compile/step "
+                         "outran the budget")
                 err = {
                     "metric": METRIC,
                     "value": 0.0, "unit": "img/sec/chip",
                     "vs_baseline": 0.0,
-                    "error": f"accelerator backend unreachable "
+                    "error": f"{cause} "
                              f"({why}, attempt {attempt}/{max_attempts})"}
                 runlog(f"FAIL {json.dumps(err)}")
                 print(json.dumps(err), flush=True)

@@ -12,7 +12,6 @@ reference's fp16 MPI path converts through a custom dtype
 (``bluefog/common/half.cc``).
 """
 
-import weakref
 from typing import Dict, Optional
 
 import jax
@@ -53,10 +52,14 @@ _torch_handles: Dict[int, torch.dtype] = {}
 
 # handle -> in-place destination: the reference's ``allreduce_`` /
 # ``broadcast_`` mutate their input tensor (torch/mpi_ops.py:108-319);
-# synchronize copies the result back into it and returns it.  Weakrefs:
-# an abandoned handle (never waited) must not pin a multi-GB tensor in
-# this module dict for the process lifetime.
-_inplace_targets: Dict[int, weakref.ref] = {}
+# synchronize copies the result back into it and returns it.  STRONG
+# references: ``allreduce_nonblocking_(p.data)`` passes a temporary
+# alias whose only reference dies at the call boundary — a weakref here
+# made that canonical pattern silently degrade to out-of-place (the
+# result never reached the parameter).  The core handle table already
+# pins the same-sized output array for abandoned handles, so a strong
+# reference adds no new leak class.
+_inplace_targets: Dict[int, torch.Tensor] = {}
 
 
 def _to_numpy(t: torch.Tensor):
@@ -104,10 +107,16 @@ def synchronize(handle: int) -> torch.Tensor:
     ValueError; a handle created through the JAX-level API still resolves
     (returned with its natural dtype).
     """
-    dtype = _torch_handles.pop(handle, None)
-    target_ref = _inplace_targets.pop(handle, None)
-    target = target_ref() if target_ref is not None else None
+    # Look up BEFORE, pop only AFTER the core synchronize succeeds: a
+    # deferred handle whose dispatch raises stays retryable in the core
+    # table, and a retried wait must still find the in-place target and
+    # dtype here (popping eagerly silently degraded the retry to an
+    # out-of-place float32 result).
+    dtype = _torch_handles.get(handle)
+    target = _inplace_targets.get(handle)
     out = _api.synchronize(handle)   # raises ValueError for unknown handles
+    _torch_handles.pop(handle, None)
+    _inplace_targets.pop(handle, None)
     if dtype is not None:
         res = _to_torch(out, dtype)
     else:
@@ -142,7 +151,7 @@ def allreduce_nonblocking_(t: torch.Tensor, average: bool = True,
     """In-place nonblocking allreduce: synchronize writes the result back
     into ``t`` and returns it (reference ``allreduce_nonblocking_``)."""
     h = allreduce_nonblocking(t, average, name)
-    _inplace_targets[h] = weakref.ref(t)
+    _inplace_targets[h] = t
     return h
 
 
@@ -165,7 +174,7 @@ def broadcast_nonblocking_(t: torch.Tensor, root_rank: int,
                            name: Optional[str] = None) -> int:
     """In-place nonblocking broadcast (reference ``broadcast_nonblocking_``)."""
     h = broadcast_nonblocking(t, root_rank, name)
-    _inplace_targets[h] = weakref.ref(t)
+    _inplace_targets[h] = t
     return h
 
 

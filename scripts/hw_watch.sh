@@ -11,7 +11,7 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-300}
-LOG=${2:-hw_queue_r4.log}
+LOG=${2:-hw_queue_r5.log}
 
 . scripts/_probe.sh
 

@@ -84,6 +84,23 @@ def test_allreduce_inplace_nonblocking(bf_ctx):
     assert torch.allclose(t, torch.full_like(t, float(expected)))
 
 
+def test_allreduce_inplace_nonblocking_param_data_alias(bf_ctx):
+    """The canonical reference pattern ``wait(allreduce_nonblocking_(p.data))``:
+    ``p.data`` is a temporary alias whose only Python reference dies at the
+    call boundary.  A weakref-held target silently degraded this to
+    out-of-place (result never reached the parameter) — the handle table
+    must hold the target strongly until synchronize."""
+    import gc
+    p = torch.nn.Parameter(_rankval((4,)).clone())
+    before = p.data.data_ptr()
+    h = bft.allreduce_nonblocking_(p.data, average=False)
+    gc.collect()   # kill any dead temporary alias before the write-back
+    out = bft.wait(h)
+    assert out.data_ptr() == before
+    expected = float(sum(range(N_DEVICES)))
+    assert torch.allclose(p.data, torch.full_like(p.data, expected))
+
+
 def test_broadcast_inplace_mutates_input(bf_ctx):
     t = _rankval()
     out = bft.broadcast_(t, root_rank=2)
