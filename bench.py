@@ -354,6 +354,7 @@ def main():
     runlog(f"start attempt {os.environ.get('BENCH_ATTEMPT', '1')}: "
            f"batch={batch} image={image} windows={k_small}/{k_large} "
            f"iters={iters} fused={os.environ.get('BLUEFOG_FUSED_CONV_BN', '0')} "
+           f"fused_stages={os.environ.get('BLUEFOG_FUSED_STAGES', 'all') or 'all'} "
            f"init_timeout={os.environ.get('BENCH_INIT_TIMEOUT', '600')} "
            f"total_budget={os.environ.get('BENCH_TOTAL_BUDGET', '1140')}")
     advance, cancel = _init_watchdog(
@@ -371,10 +372,18 @@ def main():
             lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
 
     # BLUEFOG_FUSED_CONV_BN=1 swaps in the fused 1x1-conv+BN bottleneck
-    # (ops/conv_bn.py — the HBM-roofline attack, docs/performance.md)
+    # (ops/conv_bn.py — the HBM-roofline attack, docs/performance.md).
+    # BLUEFOG_FUSED_STAGES="2,4" additionally gates fusion to those
+    # conv{N}_x stages (the r5 silicon probe found per-stage wins, not a
+    # uniform one); unset/empty = fuse all stages.
     fused = os.environ.get("BLUEFOG_FUSED_CONV_BN", "0") == "1"
+    stages_env = os.environ.get("BLUEFOG_FUSED_STAGES", "").strip()
+    model_kw = {}
+    if fused and stages_env:
+        model_kw["fused_stages"] = tuple(
+            int(s) for s in stages_env.split(",") if s.strip())
     model_cls = ResNet50Fused if fused else ResNet50
-    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16, **model_kw)
     base = optax.sgd(0.01, momentum=0.9)
     variables, opt_state = T.create_train_state(
         model, base, jax.random.key(0), jnp.zeros((1, image, image, 3)))

@@ -8,7 +8,7 @@ onto the MXU.
 """
 
 from functools import partial
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -221,6 +221,15 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.float32
     act: Callable = nn.relu
+    # Per-stage fusion gate for pallas-fused block classes, in the
+    # conventional ResNet stage naming (2..5 = conv2_x..conv5_x, the
+    # names scripts/conv_bn_probe.py reports).  None = fuse every stage
+    # (legacy behavior); e.g. (2, 4) fuses only conv2_x/conv4_x and runs
+    # the rest through the plain XLA composition (force_xla=True) —
+    # silicon r5: fusion wins 4.79x at 56px and 6.99x at 14px but is
+    # neutral at 7px, so the optimum is a mix, not all-or-nothing.
+    # Ignored for block classes without a pallas path.
+    fused_stages: Optional[Tuple[int, ...]] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -236,12 +245,31 @@ class ResNet(nn.Module):
         x = norm(name="norm_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_base = (self.block_cls.func
+                      if isinstance(self.block_cls, partial) else
+                      self.block_cls)
+        gateable = getattr(block_base, "contains_pallas", False)
+        if gateable and self.fused_stages is not None:
+            valid = range(2, len(self.stage_sizes) + 2)
+            bad = [s for s in self.fused_stages if s not in valid]
+            if bad:
+                # a typo'd gate (0-indexed, or out of range) would silently
+                # run everything on the XLA path while logging fused=1 —
+                # poisoning ablation evidence; fail loudly instead
+                raise ValueError(
+                    f"fused_stages {bad} outside this model's stage range "
+                    f"{list(valid)} (conv2_x..conv{valid[-1]}_x)")
         for i, block_count in enumerate(self.stage_sizes):
+            stage_gate = {}
+            if (gateable and self.fused_stages is not None
+                    and (i + 2) not in self.fused_stages):
+                stage_gate = {"force_xla": True}
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block_cls(
                     filters=self.num_filters * 2 ** i,
-                    strides=strides, conv=conv, norm=norm, act=self.act)(x)
+                    strides=strides, conv=conv, norm=norm, act=self.act,
+                    **stage_gate)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)

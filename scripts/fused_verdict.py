@@ -43,7 +43,7 @@ OUT = os.environ.get("FUSED_VERDICT_OUT",
 STAMP = re.compile(r"^(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z) ")
 START = re.compile(
     r"\[pid (\d+)\] start attempt \d+: (batch=\S+ image=\S+ windows=\S+ "
-    r"iters=\S+) fused=(\d)")
+    r"iters=\S+) fused=(\d)(?: fused_stages=(\S+))?")
 RESULT = re.compile(r"\[pid (\d+)\] RESULT (\{.*\}) \(")
 
 
@@ -61,7 +61,8 @@ def latest_results(path, since):
             continue
         m = START.search(line)
         if m:
-            started[m.group(1)] = (m.group(3) == "1", m.group(2))
+            started[m.group(1)] = (m.group(3) == "1", m.group(2),
+                                   m.group(4) or "all")
             continue
         m = RESULT.search(line)
         if m and m.group(1) in started:
@@ -70,8 +71,8 @@ def latest_results(path, since):
             except ValueError:
                 continue
             if r.get("value", 0) > 0:
-                flag, config = started[m.group(1)]
-                out[flag] = (r, config)   # newest wins
+                flag, config, stages = started[m.group(1)]
+                out[flag] = (r, config, stages)   # newest wins
     return out
 
 
@@ -86,7 +87,8 @@ def main():
             f"fused_verdict: need one plain and one fused RESULT in {LOG}"
             + (f" since {since}" if since else "")
             + f"; have {have or 'none'} — run the two bench stages first")
-    (plain_r, plain_cfg), (fused_r, fused_cfg) = res[False], res[True]
+    (plain_r, plain_cfg, _), (fused_r, fused_cfg, fused_stages) = (
+        res[False], res[True])
     if plain_cfg != fused_cfg:
         raise SystemExit(
             f"fused_verdict: non-comparable runs — plain [{plain_cfg}] vs "
@@ -98,17 +100,23 @@ def main():
             f"must not be compared against an amortized fallback")
     plain, fused = plain_r["value"], fused_r["value"]
     speedup = fused / plain
+    # The verdict names the exact fused config it judged: a stage-gated
+    # run (tier-3 ablation) must not masquerade as a judgment on the
+    # all-stage default if it is the newest fused RESULT in the window.
+    fused_env = ("BLUEFOG_FUSED_CONV_BN=1" if fused_stages == "all" else
+                 f"BLUEFOG_FUSED_CONV_BN=1 BLUEFOG_FUSED_STAGES={fused_stages}")
     if speedup > 1.03:
-        verdict = ("fused wins - flip the bench default "
-                   "(BLUEFOG_FUSED_CONV_BN=1)")
+        verdict = f"fused wins - flip the bench default ({fused_env})"
     elif speedup >= 0.97:
-        verdict = ("bandwidth-neutral - XLA already ran the chain at the "
-                   "bytes roofline; keep the XLA default and close the item")
+        verdict = (f"bandwidth-neutral ({fused_env}) - XLA already ran the "
+                   "chain at the bytes roofline; keep the XLA default and "
+                   "close the item")
     else:
-        verdict = "fused loses - keep the XLA path as default"
+        verdict = f"fused ({fused_env}) loses - keep the XLA path as default"
     out = {"plain_img_s": plain, "fused_img_s": fused,
            "speedup": round(speedup, 3), "verdict": verdict,
-           "config": plain_cfg, "since": since,
+           "config": plain_cfg, "fused_stages": fused_stages,
+           "since": since,
            "plain_result": plain_r, "fused_result": fused_r,
            "provenance": os.path.basename(LOG)}
     if plain_r.get("partial") or fused_r.get("partial"):

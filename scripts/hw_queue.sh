@@ -55,6 +55,12 @@ run 3300 env BENCH_INIT_TIMEOUT=2400 BENCH_TOTAL_BUDGET=3120 \
 python scripts/fused_verdict.py --since "$QSTART" 2>&1 | tee -a "$LOG"
 [ "${PIPESTATUS[0]}" -ne 0 ] && FAILED=$((FAILED + 1))
 # Tier 3 — ablations and tuning sweeps.
+# Stage-gated fusion ablation (r5 silicon: conv2_x 4.79x, conv4_x 6.99x,
+# conv5_x ~1.0 — fuse only where the probe proved a win); runs AFTER the
+# all-stage fused_verdict pairing above so it can't displace it.
+run 3300 env BENCH_INIT_TIMEOUT=2400 BENCH_TOTAL_BUDGET=3120 \
+    BENCH_MAX_ATTEMPTS=1 BLUEFOG_FUSED_CONV_BN=1 BLUEFOG_FUSED_STAGES=2,4 \
+    python bench.py
 run 2400 python scripts/perf_probe.py
 run 2400 python scripts/flash_tune.py
 run 1800 python scripts/lm_bench.py

@@ -311,6 +311,54 @@ def test_resnet50_fused_forward_and_eval():
     assert ev.shape == (2, 10) and bool(jnp.isfinite(ev).all())
 
 
+def test_resnet50_fused_stage_gate():
+    """fused_stages gates the pallas path per conv{N}_x stage: () must be
+    bit-identical to block-level force_xla everywhere, a partial gate
+    ((2,) = pallas only in conv2_x) still matches within kernel tolerance,
+    and the knob is inert on a plain (non-pallas) block class."""
+    from functools import partial as _p
+    from bluefog_tpu.models.resnet import (FusedBottleneckBlock, ResNet,
+                                           ResNet50, ResNet50Fused)
+    kw = dict(num_classes=7, num_filters=8, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 16, 16, 3)),
+                    jnp.float32)
+    def mk(**extra):
+        return ResNet(stage_sizes=[1, 1], block_cls=FusedBottleneckBlock,
+                      **kw, **extra)
+
+    base = mk()
+    variables = base.init(jax.random.key(5), x, train=False)
+
+    def run(model):
+        out, mut = model.apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+        return np.asarray(out)
+
+    all_fused = run(base)
+    gated_off = run(mk(fused_stages=()))
+    twin = run(ResNet(stage_sizes=[1, 1],
+                      block_cls=_p(FusedBottleneckBlock, force_xla=True),
+                      **kw))
+    partial_gate = run(mk(fused_stages=(2,)))
+    assert np.array_equal(gated_off, twin)          # () == force_xla twin
+    np.testing.assert_allclose(all_fused, gated_off, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(partial_gate, gated_off, rtol=2e-5,
+                               atol=2e-5)
+    # plain blocks never see the knob (no force_xla field to reject it)
+    plain = ResNet50(num_classes=7, dtype=jnp.float32, fused_stages=(2,))
+    pv = plain.init(jax.random.key(5), jnp.zeros((1, 32, 32, 3)),
+                    train=False)
+    out = plain.apply(pv, jnp.zeros((1, 32, 32, 3)), train=True,
+                      mutable=["batch_stats"])[0]
+    assert out.shape == (1, 7)
+    # ResNet50Fused accepts the knob end to end
+    assert ResNet50Fused(fused_stages=(2, 4), **{"num_classes": 7,
+                         "dtype": jnp.float32}) is not None
+    # out-of-range stage numbers (0-indexed typo) fail loudly, not silently
+    with pytest.raises(ValueError, match="stage range"):
+        mk(fused_stages=(0, 1)).init(jax.random.key(5), x, train=False)
+
+
 def test_shape_validation():
     x, w = _data(64, 32, 32)
     with pytest.raises(ValueError, match="need"):
