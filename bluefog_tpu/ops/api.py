@@ -152,6 +152,14 @@ def _register_handle(output, op: str = "", name: Optional[str] = None) -> int:
     return handle
 
 
+def has_handle(handle: int) -> bool:
+    """True while ``handle`` is live in the core table (frontends keep
+    their per-handle metadata exactly as long as the core keeps the
+    handle — e.g. the torch in-place target map)."""
+    with _handle_lock:
+        return handle in _handle_map
+
+
 def poll(handle: int) -> bool:
     """True when the nonblocking op behind ``handle`` has completed.
 

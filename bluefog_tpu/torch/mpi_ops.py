@@ -111,10 +111,18 @@ def synchronize(handle: int) -> torch.Tensor:
     # deferred handle whose dispatch raises stays retryable in the core
     # table, and a retried wait must still find the in-place target and
     # dtype here (popping eagerly silently degraded the retry to an
-    # out-of-place float32 result).
+    # out-of-place float32 result).  On failure, our entries live exactly
+    # as long as the core's handle does — if the core dropped it (not
+    # retryable), holding a strong tensor ref here would be a leak.
     dtype = _torch_handles.get(handle)
     target = _inplace_targets.get(handle)
-    out = _api.synchronize(handle)   # raises ValueError for unknown handles
+    try:
+        out = _api.synchronize(handle)   # ValueError for unknown handles
+    except Exception:
+        if not _api.has_handle(handle):
+            _torch_handles.pop(handle, None)
+            _inplace_targets.pop(handle, None)
+        raise
     _torch_handles.pop(handle, None)
     _inplace_targets.pop(handle, None)
     if dtype is not None:

@@ -23,9 +23,10 @@ CFG = "batch=64 image=224 windows=5/25 iters=4"
 METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
 
 
-def start_line(ts, pid, fused, cfg=CFG):
-    return (f"{ts} [pid {pid}] start attempt 1: {cfg} fused={int(fused)} "
-            f"init_timeout=600 total_budget=1140")
+def start_line(ts, pid, fused, cfg=CFG, stages=None):
+    gate = f" fused_stages={stages}" if stages else ""
+    return (f"{ts} [pid {pid}] start attempt 1: {cfg} fused={int(fused)}"
+            f"{gate} init_timeout=600 total_budget=1140")
 
 
 def result_line(ts, pid, value, timing="two-window-differenced",
@@ -75,6 +76,35 @@ def test_full_pair_produces_unmarked_verdict(verdict_env, monkeypatch,
     assert v["speedup"] == pytest.approx(1.04)
     assert "fused wins" in v["verdict"]
     assert "partial" not in v
+
+
+def test_stage_gated_run_names_its_config(verdict_env, monkeypatch):
+    """A BLUEFOG_FUSED_STAGES run must not masquerade as a judgment on the
+    all-stage default: the artifact records the gate and the verdict names
+    the exact env that won."""
+    log, out = verdict_env
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False,
+                   stages="all"),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True, stages="2,4"),
+        result_line("2026-08-01T05:11:00Z", 11, 2700.0),
+    ]) + "\n")
+    run_main(monkeypatch)
+    v = json.loads(out.read_text())
+    assert v["fused_stages"] == "2,4"
+    assert "BLUEFOG_FUSED_STAGES=2,4" in v["verdict"]
+    # old-format logs (no fused_stages token) report "all"
+    log.write_text("\n".join([
+        start_line("2026-08-01T05:00:00Z", 10, fused=False),
+        result_line("2026-08-01T05:05:00Z", 10, 2500.0),
+        start_line("2026-08-01T05:06:00Z", 11, fused=True),
+        result_line("2026-08-01T05:11:00Z", 11, 2700.0),
+    ]) + "\n")
+    run_main(monkeypatch)
+    v = json.loads(out.read_text())
+    assert v["fused_stages"] == "all"
+    assert "BLUEFOG_FUSED_STAGES" not in v["verdict"]
 
 
 def test_partial_pair_accepted_and_marked(verdict_env, monkeypatch):
