@@ -32,6 +32,11 @@ from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50, ResNet50Fused
 
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
+# Single source for the watchdog defaults: the provenance start line and
+# the actual watchdog leashes must never disagree (the committed log is
+# treated as ground truth for banked evidence).
+DEFAULT_INIT_TIMEOUT = "1080"
+DEFAULT_TOTAL_BUDGET = "1140"
 METRIC = "resnet50_bs64_neighbor_allreduce_images_per_sec_per_chip"
 
 # Every invocation appends UTC-stamped provenance lines (start, phases,
@@ -235,7 +240,8 @@ def _init_watchdog(seconds: int):
     # deadline or the total budget — and never retries into a window too
     # short to matter.
     t0 = float(os.environ.setdefault("BENCH_T0", repr(time.time())))
-    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1140"))
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET",
+                                        DEFAULT_TOTAL_BUDGET))
     total_deadline_mono = time.monotonic() + max(
         30.0, t0 + total_budget - time.time())
 
@@ -364,6 +370,14 @@ def main():
             raise SystemExit(
                 f"bench: BLUEFOG_FUSED_STAGES={stages_env!r} is not a "
                 f"comma-separated list of conv-stage numbers (e.g. '2,4')")
+        if not fused_stages:
+            # "," or whitespace-only: the operator clearly meant to gate
+            # but named no stage — running all-stage fusion here would
+            # bank a mislabeled ablation; fail fast instead
+            raise SystemExit(
+                f"bench: BLUEFOG_FUSED_STAGES={stages_env!r} names no "
+                f"stages; unset it for all-stage fusion or list stages "
+                f"like '2,4'")
         bad = [s for s in fused_stages if s not in range(2, 6)]
         if bad:
             raise SystemExit(
@@ -383,14 +397,15 @@ def main():
     # default 1140 s) still guarantees the error JSON prints before any
     # harness stage timeout kills us; the retry path survives for runs
     # that override the leash (hw_queue.sh sets 2400/3120/1 attempt).
+    init_timeout = int(os.environ.get("BENCH_INIT_TIMEOUT",
+                                      DEFAULT_INIT_TIMEOUT))
     runlog(f"start attempt {os.environ.get('BENCH_ATTEMPT', '1')}: "
            f"batch={batch} image={image} windows={k_small}/{k_large} "
            f"iters={iters} fused={os.environ.get('BLUEFOG_FUSED_CONV_BN', '0')} "
            f"fused_stages={stages_log} "
-           f"init_timeout={os.environ.get('BENCH_INIT_TIMEOUT', '1080')} "
-           f"total_budget={os.environ.get('BENCH_TOTAL_BUDGET', '1140')}")
-    advance, cancel = _init_watchdog(
-        int(os.environ.get("BENCH_INIT_TIMEOUT", "1080")))
+           f"init_timeout={init_timeout} "
+           f"total_budget={os.environ.get('BENCH_TOTAL_BUDGET', DEFAULT_TOTAL_BUDGET)}")
+    advance, cancel = _init_watchdog(init_timeout)
     bf.init()
     runlog(f"init ok: {len(jax.devices())} x {jax.devices()[0].device_kind} "
            f"({jax.default_backend()})")

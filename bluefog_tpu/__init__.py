@@ -93,7 +93,7 @@ from .ops.windows import (
 
 from .utils.utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
-    deprecated_function_arg,
+    deprecated_function_arg, check_extension,
 )
 
 from .grad import (

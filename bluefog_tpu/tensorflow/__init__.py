@@ -28,6 +28,7 @@ from .. import (
     out_neighbor_ranks,
     mpi_threads_supported,
     unified_mpi_window_model_supported,
+    check_extension,
 )
 
 from .mpi_ops import allreduce, broadcast, allgather
@@ -43,6 +44,7 @@ __all__ = [
     "load_topology", "set_topology",
     "in_neighbor_ranks", "out_neighbor_ranks",
     "mpi_threads_supported", "unified_mpi_window_model_supported",
+    "check_extension",
     "allreduce", "broadcast", "allgather",
     "broadcast_variables", "DistributedOptimizer", "DistributedGradientTape",
 ]

@@ -21,6 +21,24 @@ available), mirroring the reference's CPU-staging mode for GPU tensors
     out = bft.neighbor_allreduce(torch.randn(bf.size(), 128))
 """
 
+# Context/topology/timeline surface re-exported from the core so the
+# frontend is a drop-in for the reference's single-module habit
+# (``import bluefog.torch as bf; bf.init(); bf.rank()`` — the reference
+# re-exports these from bluefog/torch/__init__.py:34-72); the functions
+# are the very same objects as the top-level ``bluefog_tpu`` ones.
+from .. import (
+    init, shutdown, size, local_size, rank, local_rank,
+    machine_size, machine_rank,
+    load_topology, set_topology, load_machine_topology,
+    set_machine_topology,
+    in_neighbor_ranks, out_neighbor_ranks,
+    in_neighbor_machine_ranks, out_neighbor_machine_ranks,
+    mpi_threads_supported, unified_mpi_window_model_supported,
+    nccl_built, is_homogeneous,
+    suspend, resume, barrier,
+    set_skip_negotiate_stage, get_skip_negotiate_stage,
+    timeline_start_activity, timeline_end_activity, timeline_context,
+)
 from .mpi_ops import (
     allreduce, allreduce_nonblocking, allreduce_, allreduce_nonblocking_,
     broadcast, broadcast_nonblocking, broadcast_, broadcast_nonblocking_,
@@ -56,6 +74,18 @@ from .optimizers import (
 )
 
 __all__ = [
+    "init", "shutdown", "size", "local_size", "rank", "local_rank",
+    "machine_size", "machine_rank",
+    "load_topology", "set_topology", "load_machine_topology",
+    "set_machine_topology",
+    "in_neighbor_ranks", "out_neighbor_ranks",
+    "in_neighbor_machine_ranks", "out_neighbor_machine_ranks",
+    "mpi_threads_supported", "unified_mpi_window_model_supported",
+    "nccl_built", "is_homogeneous",
+    "suspend", "resume", "barrier",
+    "set_skip_negotiate_stage", "get_skip_negotiate_stage",
+    "timeline_start_activity", "timeline_end_activity",
+    "timeline_context",
     "allreduce", "allreduce_nonblocking",
     "allreduce_", "allreduce_nonblocking_",
     "broadcast", "broadcast_nonblocking",

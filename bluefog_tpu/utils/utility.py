@@ -19,7 +19,38 @@ __all__ = [
     "allreduce_parameters",
     "broadcast_optimizer_state",
     "deprecated_function_arg",
+    "check_extension",
 ]
+
+
+def check_extension(ext_name: str = "bluefog_tpu.native", *args) -> None:
+    """Verify the named native component is buildable/loadable.
+
+    Reference parity: ``bluefog.common.util.check_extension`` raises
+    ``ImportError`` when the compiled framework extension is absent
+    (the reference checks for the built ``mpi_lib`` shared object).
+    Here the compute path is pure JAX/XLA — nothing to check — but the
+    native runtime (``csrc/`` service/timeline/logging via
+    ``bluefog_tpu.native``) is a real shared object; this builds it on
+    demand and raises ``ImportError`` if that fails.  Extra positional
+    args (the reference's env-var/path hints) are accepted and ignored.
+    """
+    base = ext_name.rsplit(".", 1)[-1].lower()
+    if base in ("jax", "xla", "tensorflow", "torch", "bluefog_tpu"):
+        return   # pure-JAX compute paths: always available, nothing compiled
+    if base in ("native", "mpi_lib", "mpi"):
+        try:
+            from .. import native
+            native.build()
+            return
+        except Exception as e:
+            raise ImportError(
+                f"Extension {ext_name} has not been built "
+                f"(native build failed: {e}). Run `python -m "
+                f"bluefog_tpu.native` or check the g++ toolchain.") from e
+    # unknown component: raise at check time, like the reference does for
+    # an extension whose shared object cannot be found
+    raise ImportError(f"Extension {ext_name} has not been built.")
 
 
 def deprecated_function_arg(arg_name: str, fix: str):
