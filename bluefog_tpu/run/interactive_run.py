@@ -65,6 +65,28 @@ def parse_args(argv):
                              "before the prompt")
     parser.add_argument("--timeline-filename", default=None)
     parser.add_argument("--nodes-per-machine", type=int, default=None)
+    parser.add_argument("--hostfile", default=None,
+                        help="file with 'hostname slots=N' lines "
+                             "(reference ibfrun -hostfile)")
+    # Reference-compat flags (reference interactive_run.py:56-88) with
+    # honest TPU-native semantics — same policy as bfrun's:
+    parser.add_argument("--use-infiniband", action="store_true",
+                        help="no-op on TPU (ICI/DCN transport is XLA's); "
+                             "a note is printed")
+    parser.add_argument("--extra-mpi-flags", default=None,
+                        help="KEY=VAL entries exported to every engine's "
+                             "environment (no mpirun underneath; raw "
+                             "switches are rejected)")
+    parser.add_argument("--ipython-profile", default=None,
+                        help="accepted for reference compatibility; this "
+                             "cluster is not ipyparallel-based, so the "
+                             "profile name is unused (a note is printed)")
+    parser.add_argument("--enable-heartbeat", action="store_true",
+                        help="accepted for reference compatibility; hung-"
+                             "engine detection is built in (the driver "
+                             "SIGINT-interrupts engines stuck in user "
+                             "code), so this is always on")
+    parser.add_argument("--verbose", action="store_true")
     return parser.parse_args(argv)
 
 
@@ -450,9 +472,20 @@ def main(argv=None) -> int:
             raise SystemExit("ibfrun engine: --control and --engine-id "
                              "are internal required flags")
         return engine_main(args.control, args.engine_id)
-    if args.hosts:
+    # Compat-flag notes/validation once for every path — including the
+    # local (no -H) session, which never builds per-engine envs: KEY=VAL
+    # entries land in this process's environment so the in-process
+    # session sees them exactly like a remote engine would.
+    from .run import compat_flag_env
+    args._prog = "ibfrun"
+    os.environ.update(compat_flag_env(args))
+    if args.hosts and args.hostfile:
+        raise SystemExit("ibfrun: use either -H or --hostfile, not both")
+    if args.hosts or args.hostfile:
         from . import network_util
-        hosts = network_util.parse_host_spec(args.hosts)
+        hosts = (network_util.parse_hostfile(args.hostfile)
+                 if args.hostfile else
+                 network_util.parse_host_spec(args.hosts))
         return driver_main(args, hosts)
     return local_main(args)
 

@@ -63,6 +63,24 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
     parser.add_argument("--nodes-per-machine", type=int, default=None,
                         help="simulate multi-machine hierarchy on one host "
                              "(exports BLUEFOG_NODES_PER_MACHINE)")
+    # MPI-era flags the reference launcher accepts (run.py:88-97) — taken
+    # for drop-in compatibility with existing bfrun scripts, with honest
+    # TPU-native semantics instead of silent drops:
+    parser.add_argument("--use-infiniband", action="store_true",
+                        help="accepted for reference compatibility; the "
+                             "TPU transport (ICI/DCN) is selected by "
+                             "XLA/jax.distributed, so this is a no-op "
+                             "(a note is printed)")
+    parser.add_argument("--extra-mpi-flags", default=None,
+                        help="accepted for reference compatibility; there "
+                             "is no mpirun underneath — use KEY=VAL "
+                             "entries and they are exported to every "
+                             "worker's environment instead (anything else "
+                             "is rejected)")
+    parser.add_argument("--prefix", default=None,
+                        help="accepted for reference compatibility (MPI "
+                             "install prefix); unused here (a note is "
+                             "printed)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -78,9 +96,48 @@ def _resolve_hosts(args) -> List[Tuple[str, int]]:
     return []
 
 
+def compat_flag_env(args, prog: str = None) -> dict:
+    """Handle the MPI-era compat flags ONCE per invocation: print each
+    no-op note a single time, validate --extra-mpi-flags before any
+    per-host work, and return the KEY=VAL env additions (the `mpirun -x`
+    role).  Memoized on the args namespace — multi-host paths call the
+    per-host env builder N times and must not repeat the notes."""
+    cached = getattr(args, "_compat_env", None)
+    if cached is not None:
+        return cached
+    prog = prog or getattr(args, "_prog", "bfrun")
+    extra = {}
+    if getattr(args, "use_infiniband", False):
+        print(f"{prog}: --use-infiniband is a no-op on TPU (ICI/DCN "
+              f"transport is selected by XLA/jax.distributed)",
+              file=sys.stderr)
+    if getattr(args, "prefix", None):
+        print(f"{prog}: --prefix {args.prefix} is unused on TPU (no MPI "
+              f"installation underneath)", file=sys.stderr)
+    if getattr(args, "ipython_profile", None):
+        print(f"{prog}: --ipython-profile {args.ipython_profile} is "
+              f"unused (this cluster is not ipyparallel-based)",
+              file=sys.stderr)
+    if getattr(args, "extra_mpi_flags", None):
+        # the one honest mapping: env assignments ride to every worker
+        # exactly like mpirun -x; raw mpirun switches have no target
+        for tok in args.extra_mpi_flags.split():
+            if "=" in tok and not tok.startswith("-"):
+                key, _, val = tok.partition("=")
+                extra[key] = val
+            else:
+                raise SystemExit(
+                    f"{prog}: --extra-mpi-flags entry {tok!r} has no "
+                    f"TPU-side meaning (no mpirun underneath); only "
+                    f"KEY=VAL env entries are supported")
+    args._compat_env = extra
+    return extra
+
+
 def _apply_common_flags(args, env: dict, local_slots: int) -> dict:
     """Flag → env translation shared by the single- and multi-host paths
     (reference composes mpirun's -x list the same way, run.py:186-198)."""
+    env.update(compat_flag_env(args))
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
     if args.nodes_per_machine:

@@ -12,9 +12,13 @@ import pytest
 import bluefog_tpu as bf
 import bluefog_tpu.torch as bft
 
-# bluefog/torch/__init__.py — the complete import block
+# bluefog/torch/__init__.py — the complete import block (73 names), plus
+# DistributedOptimizer / DistributedPullGetOptimizer /
+# DistributedPushSumOptimizer, which the reference defines in
+# torch/optimizers.py (lines 1180/1225 and the DistributedOptimizer
+# factory) but forgets to re-export — 76 pinned here
 TORCH_SURFACE = [
-    # optimizers (lines 21-33)
+    # optimizers (lines 21-33 + the three unexported factories)
     "CommunicationType", "DistributedAdaptThenCombineOptimizer",
     "DistributedAdaptWithCombineOptimizer", "DistributedAllreduceOptimizer",
     "DistributedGradientAllreduceOptimizer",

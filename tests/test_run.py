@@ -56,6 +56,23 @@ def test_single_host_env_cpu_platform():
     assert args.command == ["python", "x.py"]
 
 
+def test_mpi_era_compat_flags(capsys):
+    """Reference bfrun scripts pass --use-infiniband / --prefix /
+    --extra-mpi-flags (reference run.py:88-97); they must parse, warn
+    where they map to nothing, and env-forward where they can."""
+    args = parse_args(["-np", "2", "--use-infiniband", "--prefix", "/opt/x",
+                       "--extra-mpi-flags", "FOO=bar BAZ=1", "cmd"])
+    env = make_single_host_env(args, base_env={})
+    err = capsys.readouterr().err
+    assert "no-op on TPU" in err and "--prefix" in err
+    assert env["FOO"] == "bar" and env["BAZ"] == "1"
+    # raw mpirun switches have no TPU-side meaning: reject loudly
+    args = parse_args(["-np", "2", "--extra-mpi-flags",
+                       "--mca btl_tcp_if_include eth0", "cmd"])
+    with pytest.raises(SystemExit, match="no.*TPU-side meaning|KEY=VAL"):
+        make_single_host_env(args, base_env={})
+
+
 def test_single_host_env_timeline_and_machines():
     args = parse_args(["-np", "8", "--timeline-filename", "/tmp/tl_",
                        "--nodes-per-machine", "2", "cmd"])
@@ -252,6 +269,24 @@ def test_remote_coordinator_resolution_failure_exits_cleanly(monkeypatch):
 def test_ibfrun_stop_noop():
     from bluefog_tpu.run.interactive_run import main
     assert main(["stop"]) == 0
+
+
+def test_ibfrun_reference_compat_flags(tmp_path):
+    """Reference ibfrun invocations (-hostfile, --use-infiniband,
+    --ipython-profile, --enable-heartbeat, --extra-mpi-flags, --verbose;
+    reference interactive_run.py:50-88) must parse; hostfile resolves
+    like bfrun's; -H plus --hostfile conflicts loudly."""
+    from bluefog_tpu.run import interactive_run as ir
+    args = ir.parse_args(["start", "-np", "2", "--use-infiniband",
+                          "--ipython-profile", "bf", "--enable-heartbeat",
+                          "--extra-mpi-flags", "FOO=1", "--verbose"])
+    assert args.use_infiniband and args.enable_heartbeat
+    assert args.ipython_profile == "bf" and args.extra_mpi_flags == "FOO=1"
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost slots=2\n")
+    args = ir.parse_args(["start", "--hostfile", str(hf), "-H", "a:1"])
+    with pytest.raises(SystemExit, match="not both"):
+        ir.main(["start", "--hostfile", str(hf), "-H", "a:1"])
 
 
 _MULTIHOST_WORKER = """
