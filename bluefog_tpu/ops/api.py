@@ -410,15 +410,46 @@ def _mesh_id():
 # ---------------------------------------------------------------------------
 
 @_suspend_deferred
-def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
+def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None,
+                          is_hierarchical_local: bool = False) -> int:
     cx = ctx()
-    out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
+    if is_hierarchical_local:
+        out = _local_allreduce_fn(cx.machine_axis, cx.local_axis, average,
+                                  _mesh_id())(to_global(x))
+    else:
+        out = _allreduce_fn(cx.rank_axis, average, _mesh_id())(to_global(x))
     return _register_handle(out, "allreduce", name)
 
 
-def allreduce(x, average: bool = True, name: Optional[str] = None):
-    """Global allreduce of the per-rank slices (mpi_ops.py:108-212)."""
-    return synchronize(allreduce_nonblocking(x, average, name))
+@functools.lru_cache(maxsize=64)
+def _local_allreduce_fn(machine_axis, local_axis, average, mesh_id):
+    cx = ctx()
+
+    def wrapper(x):
+        x2 = x.reshape((cx.machine_size, cx.local_size) + x.shape[1:])
+
+        def shard_fn(xs):
+            return C.hierarchical_local_allreduce(
+                xs[0, 0], local_axis, average=average)[None, None]
+        out = jax.shard_map(
+            shard_fn, mesh=cx.mesh_2d,
+            in_specs=P(machine_axis, local_axis),
+            out_specs=P(machine_axis, local_axis),
+        )(x2)
+        return out.reshape(x.shape)
+    return jax.jit(wrapper)
+
+
+def allreduce(x, average: bool = True, name: Optional[str] = None,
+              is_hierarchical_local: bool = False):
+    """Global allreduce of the per-rank slices (mpi_ops.py:108-212).
+
+    ``is_hierarchical_local=True`` reduces within each machine's local
+    ranks only (reference allreduce's hierarchical-local mode,
+    torch/mpi_ops.py:94-109): rank slices become their machine-local
+    mean/sum, machines stay independent."""
+    return synchronize(allreduce_nonblocking(x, average, name,
+                                             is_hierarchical_local))
 
 
 allreduce_ = allreduce
@@ -499,6 +530,32 @@ def neighbor_allreduce_nonblocking(
         name: Optional[str] = None) -> int:
     cx = ctx()
     xg = to_global(x)
+    if self_weight is not None:
+        # Reference per-call self_weight (torch/mpi_ops.py:475-645): each
+        # rank keeps `s` of its own value and distributes 1-s across its
+        # in-neighbors proportionally to their topology weights.  Ranks
+        # with no in-neighbors keep weight 1 (nowhere to hand mass to).
+        # Realized as a weight matrix so it rides the cached sparse-
+        # ppermute path.  (Declared-but-ignored before r5 — a silent
+        # default-topology fallback.)
+        if (weight_matrix is not None or sched is not None
+                or dst_weight_matrix is not None or dst_weighted):
+            raise ValueError(
+                "self_weight composes with the context topology only; for "
+                "full per-edge control (including sender-side dst "
+                "weighting) encode it in weight_matrix / dst_weight_matrix "
+                "directly")
+        s = float(self_weight)
+        if not 0.0 <= s <= 1.0:
+            raise ValueError(f"self_weight must be in [0, 1], got {s}")
+        W = np.asarray(cx.compiled_topology.weight_matrix, np.float64).copy()
+        np.fill_diagonal(W, 0.0)
+        col_off = W.sum(axis=0)              # mass each receiver takes in
+        scale = np.divide(1.0 - s, col_off, where=col_off > 0,
+                          out=np.zeros_like(col_off))
+        W *= scale[None, :]                  # column j = receiver j's weights
+        np.fill_diagonal(W, np.where(col_off > 0, s, 1.0))
+        weight_matrix = W
     if dst_weight_matrix is not None and sched is None:
         raise ValueError(
             "dst_weight_matrix requires a dynamic schedule (sched=...); "
@@ -650,7 +707,8 @@ def _edge_slots(A: np.ndarray, offsets: Tuple[int, ...], out_rows: int):
 
 @_suspend_deferred
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
-                                   src_ranks=None, dst_ranks=None) -> int:
+                                   src_ranks=None, dst_ranks=None,
+                                   enable_topo_check: bool = True) -> int:
     cx = ctx()
     if isinstance(x, (list, tuple)):
         # variable-size form (reference
@@ -661,6 +719,18 @@ def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
         x, _ = _stack_ragged(x)
     if src_ranks is not None or dst_ranks is not None:
         A = _edge_matrix_from_ranks(cx.size, src_ranks, dst_ranks)
+        if enable_topo_check:
+            # reference enable_topo_check (torch/mpi_ops.py:397-472):
+            # requested edges must exist in the registered topology —
+            # catches a rank list built for a different/updated graph
+            T = np.asarray(cx.compiled_topology.weight_matrix) != 0
+            bad = [(int(s), int(d)) for s, d in zip(*np.nonzero(A))
+                   if not T[s, d]]
+            if bad:
+                raise ValueError(
+                    f"neighbor_allgather: requested edges {bad[:8]} are "
+                    f"not in the registered topology (pass "
+                    f"enable_topo_check=False for off-topology exchanges)")
         srcs, dsts = np.nonzero(A)
         offsets = tuple(sorted({int((d - s) % cx.size)
                                 for s, d in zip(srcs, dsts)}))
@@ -676,7 +746,8 @@ def neighbor_allgather_nonblocking(x, name: Optional[str] = None, *,
 
 
 def neighbor_allgather(x, name: Optional[str] = None, *,
-                       src_ranks=None, dst_ranks=None):
+                       src_ranks=None, dst_ranks=None,
+                       enable_topo_check: bool = True):
     """Gather in-neighbor slices, ordered by ascending source rank
     (mpi_ops.py:397-472).  Global result shape: [size, max_in_degree, ...];
     on irregular graphs (allgatherv semantics, mpi_context.cc:622-700) rank
@@ -688,7 +759,8 @@ def neighbor_allgather(x, name: Optional[str] = None, *,
     Same-structure calls reuse one compiled program.
     """
     return synchronize(neighbor_allgather_nonblocking(
-        x, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
+        x, name, src_ranks=src_ranks, dst_ranks=dst_ranks,
+        enable_topo_check=enable_topo_check))
 
 
 @_suspend_deferred

@@ -3,7 +3,7 @@ filtering so launcher state reaches every worker)."""
 
 import os
 import re
-from typing import Dict, List
+from typing import Dict, Iterable, List
 
 # Never forward these across hosts: they are per-process/host identity.
 _BLOCKLIST = re.compile(
@@ -76,13 +76,18 @@ def arm_low_core_cpu_mitigations(env: Dict[str, str],
     return env
 
 
-def env_assignments(env: Dict[str, str], only_prefixes: List[str]) -> List[str]:
+def env_assignments(env: Dict[str, str], only_prefixes: List[str],
+                    extra_keys: Iterable[str] = ()) -> List[str]:
     """Shell-safe ``K=V`` assignments for the vars worth forwarding over ssh:
     anything matching the given prefixes (reference forwards -x env vars,
-    run.py:186-198)."""
+    run.py:186-198), plus ``extra_keys`` exactly (the --extra-mpi-flags
+    KEY=VAL entries must reach remote workers too — prefix filtering
+    would silently drop them)."""
     import shlex
+    extra = set(extra_keys)
     out = []
     for k, v in sorted(env.items()):
-        if any(k.startswith(p) for p in only_prefixes) and is_exportable(k):
+        if ((any(k.startswith(p) for p in only_prefixes) or k in extra)
+                and is_exportable(k)):
             out.append(f"{k}={shlex.quote(v)}")
     return out

@@ -191,7 +191,7 @@ def _launch_engines(args, hosts, control_addr: str):
     the *ssh client*, so signals must travel over a fresh ssh command (the
     control address doubles as a unique pkill pattern)."""
     from . import env_util, network_util
-    from .run import _FORWARD_PREFIXES, _apply_common_flags
+    from .run import _FORWARD_PREFIXES, _apply_common_flags, compat_flag_env
 
     any_remote = any(not network_util.is_local_host(h) for h, _ in hosts)
     try:
@@ -220,7 +220,8 @@ def _launch_engines(args, hosts, control_addr: str):
             procs.append((subprocess.Popen(cmd, env={**os.environ, **env}),
                           host, True))
         else:
-            assigns = env_util.env_assignments(env, _FORWARD_PREFIXES)
+            assigns = env_util.env_assignments(
+                env, _FORWARD_PREFIXES, extra_keys=compat_flag_env(args))
             remote = (f"cd {shlex.quote(cwd)} && " + " ".join(assigns) + " "
                       + " ".join(shlex.quote(c) for c in cmd))
             ssh = ["ssh", "-o", "BatchMode=yes"]

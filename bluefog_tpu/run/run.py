@@ -207,7 +207,8 @@ def _launch_multi_host(args, hosts) -> int:
         if network_util.is_local_host(host):
             procs.append(subprocess.Popen(args.command, env={**os.environ, **env}))
         else:
-            assigns = env_util.env_assignments(env, _FORWARD_PREFIXES)
+            assigns = env_util.env_assignments(
+                env, _FORWARD_PREFIXES, extra_keys=compat_flag_env(args))
             remote = (f"cd {shlex.quote(cwd)} && "
                       + " ".join(assigns) + " "
                       + " ".join(shlex.quote(c) for c in args.command))

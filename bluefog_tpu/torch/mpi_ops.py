@@ -144,114 +144,137 @@ wait = synchronize
 poll = _api.poll
 
 
-def allreduce_nonblocking(t: torch.Tensor, average: bool = True,
-                          name: Optional[str] = None) -> int:
-    return _nonblocking(_api.allreduce_nonblocking, t, average, name)
+# First parameter is named ``tensor`` exactly like the reference's torch
+# ops (bluefog/torch/mpi_ops.py:108-928) so keyword call sites —
+# ``bf.allreduce(tensor=x)`` — port unchanged.
+
+def allreduce_nonblocking(tensor: torch.Tensor, average: bool = True,
+                          name: Optional[str] = None,
+                          is_hierarchical_local: bool = False) -> int:
+    return _nonblocking(_api.allreduce_nonblocking, tensor, average, name,
+                        is_hierarchical_local)
 
 
-def allreduce(t: torch.Tensor, average: bool = True,
-              name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allreduce_nonblocking(t, average, name))
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None,
+              is_hierarchical_local: bool = False) -> torch.Tensor:
+    """Allreduce of the per-rank slices; ``is_hierarchical_local=True``
+    reduces within each machine only (reference torch/mpi_ops.py:108-212)."""
+    return synchronize(allreduce_nonblocking(tensor, average, name,
+                                             is_hierarchical_local))
 
 
-def allreduce_nonblocking_(t: torch.Tensor, average: bool = True,
-                           name: Optional[str] = None) -> int:
+def allreduce_nonblocking_(tensor: torch.Tensor, average: bool = True,
+                           name: Optional[str] = None,
+                           is_hierarchical_local: bool = False) -> int:
     """In-place nonblocking allreduce: synchronize writes the result back
-    into ``t`` and returns it (reference ``allreduce_nonblocking_``)."""
-    h = allreduce_nonblocking(t, average, name)
-    _inplace_targets[h] = t
+    into ``tensor`` and returns it (reference ``allreduce_nonblocking_``)."""
+    h = allreduce_nonblocking(tensor, average, name, is_hierarchical_local)
+    _inplace_targets[h] = tensor
     return h
 
 
-def allreduce_(t: torch.Tensor, average: bool = True,
-               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allreduce_nonblocking_(t, average, name))
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None,
+               is_hierarchical_local: bool = False) -> torch.Tensor:
+    return synchronize(allreduce_nonblocking_(tensor, average, name,
+                                              is_hierarchical_local))
 
 
-def broadcast_nonblocking(t: torch.Tensor, root_rank: int,
+def broadcast_nonblocking(tensor: torch.Tensor, root_rank: int,
                           name: Optional[str] = None) -> int:
-    return _nonblocking(_api.broadcast_nonblocking, t, root_rank, name)
+    return _nonblocking(_api.broadcast_nonblocking, tensor, root_rank, name)
 
 
-def broadcast(t: torch.Tensor, root_rank: int,
+def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_nonblocking(t, root_rank, name))
+    return synchronize(broadcast_nonblocking(tensor, root_rank, name))
 
 
-def broadcast_nonblocking_(t: torch.Tensor, root_rank: int,
+def broadcast_nonblocking_(tensor: torch.Tensor, root_rank: int,
                            name: Optional[str] = None) -> int:
     """In-place nonblocking broadcast (reference ``broadcast_nonblocking_``)."""
-    h = broadcast_nonblocking(t, root_rank, name)
-    _inplace_targets[h] = t
+    h = broadcast_nonblocking(tensor, root_rank, name)
+    _inplace_targets[h] = tensor
     return h
 
 
-def broadcast_(t: torch.Tensor, root_rank: int,
+def broadcast_(tensor: torch.Tensor, root_rank: int,
                name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_nonblocking_(t, root_rank, name))
+    return synchronize(broadcast_nonblocking_(tensor, root_rank, name))
 
 
-def allgather_nonblocking(t: torch.Tensor, name: Optional[str] = None) -> int:
-    return _nonblocking(_api.allgather_nonblocking, t, name)
+def allgather_nonblocking(tensor: torch.Tensor,
+                          name: Optional[str] = None) -> int:
+    return _nonblocking(_api.allgather_nonblocking, tensor, name)
 
 
-def allgather(t: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allgather_nonblocking(t, name))
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allgather_nonblocking(tensor, name))
 
 
-def neighbor_allreduce_nonblocking(t: torch.Tensor, **kwargs) -> int:
-    return _nonblocking(_api.neighbor_allreduce_nonblocking, t, **kwargs)
+def neighbor_allreduce_nonblocking(tensor: torch.Tensor, **kwargs) -> int:
+    return _nonblocking(_api.neighbor_allreduce_nonblocking, tensor,
+                        **kwargs)
 
 
-def neighbor_allreduce(t: torch.Tensor, **kwargs) -> torch.Tensor:
+def neighbor_allreduce(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
     """Weighted neighbor average of the per-rank slices (the reference's
     flagship op, bluefog/torch/mpi_ops.py:475-645).  Keyword modes as in
     ``bluefog_tpu.neighbor_allreduce``: default topology weights,
     ``weight_matrix=W``, or ``sched=..., step=i``."""
-    return synchronize(neighbor_allreduce_nonblocking(t, **kwargs))
+    return synchronize(neighbor_allreduce_nonblocking(tensor, **kwargs))
 
 
-def neighbor_allgather_nonblocking(t: torch.Tensor,
+def neighbor_allgather_nonblocking(tensor: torch.Tensor,
                                    name: Optional[str] = None, *,
-                                   src_ranks=None, dst_ranks=None) -> int:
-    return _nonblocking(_api.neighbor_allgather_nonblocking, t, name,
-                        src_ranks=src_ranks, dst_ranks=dst_ranks)
+                                   src_ranks=None, dst_ranks=None,
+                                   enable_topo_check: bool = True) -> int:
+    return _nonblocking(_api.neighbor_allgather_nonblocking, tensor, name,
+                        src_ranks=src_ranks, dst_ranks=dst_ranks,
+                        enable_topo_check=enable_topo_check)
 
 
-def neighbor_allgather(t: torch.Tensor, name: Optional[str] = None, *,
-                       src_ranks=None, dst_ranks=None) -> torch.Tensor:
+def neighbor_allgather(tensor: torch.Tensor, name: Optional[str] = None, *,
+                       src_ranks=None, dst_ranks=None,
+                       enable_topo_check: bool = True) -> torch.Tensor:
     """Gather in-neighbor slices padded to max in-degree (reference
     bluefog/torch/mpi_ops.py:397-472, incl. the per-call
     ``src_ranks/dst_ranks`` dynamic form)."""
     return synchronize(neighbor_allgather_nonblocking(
-        t, name, src_ranks=src_ranks, dst_ranks=dst_ranks))
+        tensor, name, src_ranks=src_ranks, dst_ranks=dst_ranks,
+        enable_topo_check=enable_topo_check))
 
 
 def hierarchical_neighbor_allreduce_nonblocking(
-        t: torch.Tensor, name: Optional[str] = None) -> int:
+        tensor: torch.Tensor, name: Optional[str] = None) -> int:
     return _nonblocking(
-        _api.hierarchical_neighbor_allreduce_nonblocking, t, name)
+        _api.hierarchical_neighbor_allreduce_nonblocking, tensor, name)
 
 
-def hierarchical_neighbor_allreduce(t: torch.Tensor,
+def hierarchical_neighbor_allreduce(tensor: torch.Tensor,
                                     name: Optional[str] = None):
     """Machine-level two-step average (reference
     bluefog/torch/mpi_ops.py:648-838)."""
-    return synchronize(hierarchical_neighbor_allreduce_nonblocking(t, name))
+    return synchronize(
+        hierarchical_neighbor_allreduce_nonblocking(tensor, name))
 
 
-def pair_gossip_nonblocking(t: torch.Tensor, pairs, self_weight=None,
+def pair_gossip_nonblocking(tensor: torch.Tensor, pairs, self_weight=None,
                             pair_weight=None,
                             name: Optional[str] = None) -> int:
-    return _nonblocking(_api.pair_gossip_nonblocking, t, pairs, self_weight,
-                        pair_weight, name)
+    return _nonblocking(_api.pair_gossip_nonblocking, tensor, pairs,
+                        self_weight, pair_weight, name)
 
 
-def pair_gossip(t: torch.Tensor, pairs, self_weight=None, pair_weight=None,
+def pair_gossip(tensor: torch.Tensor, pairs, self_weight=None,
+                pair_weight=None,
                 name: Optional[str] = None) -> torch.Tensor:
     """Pairwise weighted averaging over a matching (reference
-    bluefog/torch/mpi_ops.py:852-928; ``pairs`` is the global matching)."""
-    return synchronize(pair_gossip_nonblocking(t, pairs, self_weight,
+    bluefog/torch/mpi_ops.py:852-928; ``pairs`` is the global matching —
+    the SPMD form of the reference's per-rank ``target_rank``)."""
+    return synchronize(pair_gossip_nonblocking(tensor, pairs, self_weight,
                                                pair_weight, name))
 
 
@@ -274,8 +297,8 @@ def _win_to_numpy(t):
     return arrs, dtypes
 
 
-def win_create(t, name: str, zero_init: bool = False) -> bool:
-    arr, dtype = _win_to_numpy(t)
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    arr, dtype = _win_to_numpy(tensor)
     if _win.win_create(arr, name, zero_init=zero_init):
         _win_dtypes[name] = dtype
         return True
@@ -290,35 +313,35 @@ def win_free(name: Optional[str] = None) -> bool:
     return _win.win_free(name)
 
 
-def win_put_nonblocking(t, name: str, self_weight=None,
+def win_put_nonblocking(tensor, name: str, self_weight=None,
                         dst_weights=None, require_mutex: bool = False,
                         sched=None, step=None) -> int:
-    arr, _ = _win_to_numpy(t)
+    arr, _ = _win_to_numpy(tensor)
     return _win.win_put_nonblocking(arr, name, self_weight, dst_weights,
                                     require_mutex, sched, step)
 
 
-def win_put(t, name: str, self_weight=None, dst_weights=None,
+def win_put(tensor, name: str, self_weight=None, dst_weights=None,
             require_mutex: bool = False, sched=None, step=None) -> bool:
-    _win.win_wait(win_put_nonblocking(t, name, self_weight, dst_weights,
+    _win.win_wait(win_put_nonblocking(tensor, name, self_weight, dst_weights,
                                       require_mutex, sched, step))
     return True
 
 
-def win_accumulate_nonblocking(t, name: str, self_weight=None,
+def win_accumulate_nonblocking(tensor, name: str, self_weight=None,
                                dst_weights=None,
                                require_mutex: bool = False,
                                sched=None, step=None) -> int:
-    arr, _ = _win_to_numpy(t)
+    arr, _ = _win_to_numpy(tensor)
     return _win.win_accumulate_nonblocking(arr, name, self_weight,
                                            dst_weights, require_mutex,
                                            sched, step)
 
 
-def win_accumulate(t, name: str, self_weight=None,
+def win_accumulate(tensor, name: str, self_weight=None,
                    dst_weights=None, require_mutex: bool = False,
                    sched=None, step=None) -> bool:
-    _win.win_wait(win_accumulate_nonblocking(t, name, self_weight,
+    _win.win_wait(win_accumulate_nonblocking(tensor, name, self_weight,
                                              dst_weights, require_mutex,
                                              sched, step))
     return True

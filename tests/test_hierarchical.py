@@ -25,6 +25,38 @@ def machine_local_means():
                        for m in range(MACHINES)])
 
 
+def test_allreduce_is_hierarchical_local(bf_ctx_machines):
+    """Reference allreduce(..., is_hierarchical_local=True)
+    (torch/mpi_ops.py:94-109): reduce within each machine's local ranks
+    only; machines stay independent."""
+    x = rank_tensor((3,))
+    out = bf.allreduce(x, average=True, is_hierarchical_local=True)
+    local_means = machine_local_means()
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]),
+                                   np.full(3, local_means[r // LOCAL]),
+                                   rtol=1e-6)
+    # sum mode
+    out = bf.allreduce(x, average=False, is_hierarchical_local=True)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), np.full(3, local_means[r // LOCAL] * LOCAL),
+            rtol=1e-6)
+
+
+def test_torch_allreduce_hierarchical_local_and_tensor_kw(bf_ctx_machines):
+    """Torch frontend: the reference keyword spelling
+    ``allreduce(tensor=..., is_hierarchical_local=True)`` works."""
+    import torch
+    import bluefog_tpu.torch as bft
+    t = torch.arange(N, dtype=torch.float32)[:, None].expand(N, 3).clone()
+    out = bft.allreduce(tensor=t, average=True, is_hierarchical_local=True)
+    local_means = machine_local_means()
+    for r in range(N):
+        assert torch.allclose(out[r],
+                              torch.full((3,), float(local_means[r // LOCAL])))
+
+
 def test_hierarchical_neighbor_allreduce_ring(bf_ctx_machines):
     bf.set_machine_topology(bf.RingGraph(MACHINES), is_weighted=True)
     x = rank_tensor((4,))

@@ -71,7 +71,8 @@ def test_dynamic_neighbor_allgather_src_only(bf_ctx):
     """dst_ranks may be omitted (derived from src_ranks)."""
     src_ranks = [[(r + 1) % N] for r in range(N)]
     x = _x(2)
-    out = np.asarray(bf.neighbor_allgather(x, src_ranks=src_ranks))
+    out = np.asarray(bf.neighbor_allgather(x, src_ranks=src_ranks,
+                                           enable_topo_check=False))
     for r in range(N):
         np.testing.assert_allclose(out[r, 0], np.asarray(x)[(r + 1) % N])
 
@@ -83,13 +84,27 @@ def test_dynamic_neighbor_allgather_irregular_edge_set(bf_ctx):
     src_ranks[0] = [1, 2, 3]
     src_ranks[1] = [N - 1]
     x = _x(3)
-    out = np.asarray(bf.neighbor_allgather(x, src_ranks=src_ranks))
+    out = np.asarray(bf.neighbor_allgather(x, src_ranks=src_ranks,
+                                           enable_topo_check=False))
     assert out.shape == (N, 3, 2, 3)
     for slot, src in enumerate([1, 2, 3]):
         np.testing.assert_allclose(out[0, slot], np.asarray(x)[src])
     np.testing.assert_allclose(out[1, 0], np.asarray(x)[N - 1])
     np.testing.assert_array_equal(out[1, 1:], 0.0)
     np.testing.assert_array_equal(out[2:], 0.0)
+
+
+def test_dynamic_neighbor_allgather_topo_check(bf_ctx):
+    """Reference enable_topo_check (default True, torch/mpi_ops.py:397-472):
+    off-topology edges are rejected unless explicitly waived; edges drawn
+    from the registered topology pass."""
+    off_topo = [[(r + 3) % N] for r in range(N)]   # offset -3: not exp2
+    with pytest.raises(ValueError, match="not in the registered topology"):
+        bf.neighbor_allgather(_x(), src_ranks=off_topo)
+    on_topo = [[(r - 1) % N] for r in range(N)]    # exp2 receives from r-1
+    out = np.asarray(bf.neighbor_allgather(_x(), src_ranks=on_topo))
+    for r in range(N):
+        np.testing.assert_allclose(out[r, 0], np.asarray(_x())[(r - 1) % N])
 
 
 def test_dynamic_neighbor_allgather_mismatch_rejected(bf_ctx):

@@ -252,6 +252,40 @@ def test_remote_coordinator_advertises_resolved_iface_ip(monkeypatch):
         assert "nodeA" not in joined.split("BLUEFOG_COORDINATOR", 1)[1][:40]
 
 
+def test_extra_mpi_flags_reach_remote_workers(monkeypatch):
+    """--extra-mpi-flags KEY=VAL must ride the ssh env assignments (the
+    mpirun -x role) — prefix filtering alone would silently drop them on
+    remote hosts while local workers got them."""
+    import subprocess as sp
+    from bluefog_tpu.run import run as run_mod
+
+    monkeypatch.setattr(run_mod.network_util, "check_ssh",
+                        lambda *a, **k: True)
+
+    launched = []
+
+    class FakeProc:
+        def __init__(self, cmd, **kw):
+            launched.append((cmd, kw))
+
+        def poll(self):
+            return 0
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(sp, "Popen", FakeProc)
+    args = run_mod.parse_args(["-H", "nodeA:2,nodeB:2",
+                               "--extra-mpi-flags", "FOO=bar", "cmd"])
+    assert run_mod._launch_multi_host(
+        args, [("nodeA", 2), ("nodeB", 2)]) == 0
+    remote = [" ".join(cmd) for cmd, _ in launched
+              if "ssh" in " ".join(cmd)]
+    assert remote, "expected at least one ssh launch"
+    for joined in remote:
+        assert "FOO=bar" in joined
+
+
 def test_remote_coordinator_resolution_failure_exits_cleanly(monkeypatch):
     from bluefog_tpu.run import run as run_mod
 

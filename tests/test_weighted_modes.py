@@ -43,6 +43,32 @@ def _expected(W, x):
     return jnp.einsum("ij,i...->j...", jnp.asarray(W, jnp.float32), x)
 
 
+def test_self_weight_scales_topology_mixing(bf_ctx):
+    """Reference per-call ``self_weight`` (torch/mpi_ops.py:475-645): each
+    rank keeps s of itself and spreads 1-s over its in-neighbors
+    proportionally to the topology weights.  (Silently ignored before r5.)"""
+    s = 0.7
+    x = _x()
+    out = bf.neighbor_allreduce(x, self_weight=s)
+    T = np.asarray(
+        bf.context.ctx().compiled_topology.weight_matrix, np.float64).copy()
+    np.fill_diagonal(T, 0.0)
+    col = T.sum(axis=0)
+    W = T * np.divide(1.0 - s, col, where=col > 0,
+                      out=np.zeros_like(col))[None, :]
+    np.fill_diagonal(W, np.where(col > 0, s, 1.0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_expected(W, x)),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="composes with the context"):
+        bf.neighbor_allreduce(x, self_weight=s, weight_matrix=W)
+    with pytest.raises(ValueError, match="composes with the context"):
+        # dst_weighted would silently re-read the receiver-normalized
+        # matrix sender-side — must be rejected, not reinterpreted
+        bf.neighbor_allreduce(x, self_weight=s, dst_weighted=True)
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        bf.neighbor_allreduce(x, self_weight=1.5)
+
+
 def test_sparse_matrix_matches_closed_form(bf_ctx):
     W, x = _ring_matrix(), _x()
     out = bf.neighbor_allreduce(x, weight_matrix=W)
