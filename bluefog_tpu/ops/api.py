@@ -421,16 +421,17 @@ def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None,
     return _register_handle(out, "allreduce", name)
 
 
-@functools.lru_cache(maxsize=64)
-def _local_allreduce_fn(machine_axis, local_axis, average, mesh_id):
+def _shardmapped_2d(machine_axis, local_axis, inner):
+    """jitted global wrapper over the 2-D (machine, local) mesh: reshape
+    the flat [size, ...] global view to [machines, locals, ...], run
+    ``inner`` per shard, reshape back.  Shared by the hierarchical ops."""
     cx = ctx()
 
     def wrapper(x):
         x2 = x.reshape((cx.machine_size, cx.local_size) + x.shape[1:])
 
         def shard_fn(xs):
-            return C.hierarchical_local_allreduce(
-                xs[0, 0], local_axis, average=average)[None, None]
+            return inner(xs[0, 0])[None, None]
         out = jax.shard_map(
             shard_fn, mesh=cx.mesh_2d,
             in_specs=P(machine_axis, local_axis),
@@ -438,6 +439,14 @@ def _local_allreduce_fn(machine_axis, local_axis, average, mesh_id):
         )(x2)
         return out.reshape(x.shape)
     return jax.jit(wrapper)
+
+
+@functools.lru_cache(maxsize=64)
+def _local_allreduce_fn(machine_axis, local_axis, average, mesh_id):
+    return _shardmapped_2d(
+        machine_axis, local_axis,
+        lambda xs: C.hierarchical_local_allreduce(xs, local_axis,
+                                                  average=average))
 
 
 def allreduce(x, average: bool = True, name: Optional[str] = None,
@@ -778,22 +787,10 @@ def hierarchical_neighbor_allreduce_nonblocking(
 
 @functools.lru_cache(maxsize=64)
 def _hier_fn(machine_axis, local_axis, mtopo, mesh_id):
-    cx = ctx()
-
-    def wrapper(x):
-        x2 = x.reshape((cx.machine_size, cx.local_size) + x.shape[1:])
-
-        def shard_fn(xs):
-            y = C.hierarchical_neighbor_allreduce(
-                xs[0, 0], machine_axis, local_axis, mtopo)
-            return y[None, None]
-        out = jax.shard_map(
-            shard_fn, mesh=cx.mesh_2d,
-            in_specs=P(machine_axis, local_axis),
-            out_specs=P(machine_axis, local_axis),
-        )(x2)
-        return out.reshape(x.shape)
-    return jax.jit(wrapper)
+    return _shardmapped_2d(
+        machine_axis, local_axis,
+        lambda xs: C.hierarchical_neighbor_allreduce(
+            xs, machine_axis, local_axis, mtopo))
 
 
 def hierarchical_neighbor_allreduce(x, name: Optional[str] = None):

@@ -87,7 +87,10 @@ def env_assignments(env: Dict[str, str], only_prefixes: List[str],
     extra = set(extra_keys)
     out = []
     for k, v in sorted(env.items()):
-        if ((any(k.startswith(p) for p in only_prefixes) or k in extra)
-                and is_exportable(k)):
+        # extra keys bypass is_exportable: the operator explicitly asked
+        # for them, and silently dropping a blocklisted name would
+        # recreate the local/remote asymmetry this parameter exists to fix
+        if (k in extra or (any(k.startswith(p) for p in only_prefixes)
+                           and is_exportable(k))):
             out.append(f"{k}={shlex.quote(v)}")
     return out

@@ -121,9 +121,16 @@ def compat_flag_env(args, prog: str = None) -> dict:
     if getattr(args, "extra_mpi_flags", None):
         # the one honest mapping: env assignments ride to every worker
         # exactly like mpirun -x; raw mpirun switches have no target
+        import re as _re
         for tok in args.extra_mpi_flags.split():
             if "=" in tok and not tok.startswith("-"):
                 key, _, val = tok.partition("=")
+                if not _re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", key):
+                    # emitted unquoted as KEY=... in the remote ssh line:
+                    # a non-identifier would be parsed as shell syntax
+                    raise SystemExit(
+                        f"{prog}: --extra-mpi-flags key {key!r} is not a "
+                        f"valid environment variable name")
                 extra[key] = val
             else:
                 raise SystemExit(

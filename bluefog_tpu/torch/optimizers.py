@@ -200,57 +200,89 @@ def _check_sched_comm(sched, communication_type):
             f"got {communication_type}")
 
 
+def _check_model(model):
+    """Reference factories take ``model`` as the second positional
+    argument (torch/optimizers.py:1180-1497).  Parameters are discovered
+    from the optimizer's param_groups here (the reference walks the model
+    instead), so the model's only runtime role is per-layer timeline
+    hooks; it is validated FIRST — before any re-classing or window
+    allocation — so a legacy positional num_steps/communication/prefix
+    value cannot silently land in its slot or leave half-built state."""
+    if model is not None and not isinstance(model, torch.nn.Module):
+        raise TypeError(
+            f"second positional argument is `model` (reference factory "
+            f"signature); got {type(model).__name__} — pass "
+            f"num_steps_per_communication / communication_type / "
+            f"window_prefix by keyword")
+
+
+def _attach_model(opt, model):
+    opt._bft_timeline_handles = (
+        register_timeline_hooks(model) if model is not None else [])
+    return opt
+
+
 def DistributedGradientAllreduceOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         num_steps_per_communication: int = 1) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` so each step allreduce-averages gradients
     first (reference factory torch/optimizers.py:1376)."""
-    return _reclass(optimizer, _GradientAllreduceMixin,
-                    "DistributedGradientAllreduceOptimizer",
-                    num_steps_per_communication)
+    _check_model(model)
+    return _attach_model(
+        _reclass(optimizer, _GradientAllreduceMixin,
+                 "DistributedGradientAllreduceOptimizer",
+                 num_steps_per_communication), model)
 
 
 def DistributedAllreduceOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         num_steps_per_communication: int = 1) -> torch.optim.Optimizer:
     """CTA with a GLOBAL allreduce of the weights (reference factory
     torch/optimizers.py:1301): combine = full average, then local step."""
+    _check_model(model)
     opt = _reclass(optimizer, _CombineMixin,
                    "DistributedAllreduceOptimizer",
                    num_steps_per_communication)
     opt.communication_type = CommunicationType.allreduce
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedNeighborAllreduceOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         num_steps_per_communication: int = 1,
         sched=None) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` so each step neighbor-averages parameters
     first (reference factory torch/optimizers.py:1326)."""
+    _check_model(model)
     opt = _reclass(optimizer, _NeighborAllreduceMixin,
                    "DistributedNeighborAllreduceOptimizer",
                    num_steps_per_communication)
     opt.sched = sched
     opt.step_index = 0
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         num_steps_per_communication: int = 1) -> torch.optim.Optimizer:
     """CTA with machine-level two-step averaging (reference factory
     torch/optimizers.py:1352).  Requires a machine topology
     (``bf.set_machine_topology``) like the reference."""
+    _check_model(model)
     opt = _reclass(optimizer, _CombineMixin,
                    "DistributedHierarchicalNeighborAllreduceOptimizer",
                    num_steps_per_communication)
     opt.communication_type = CommunicationType.hierarchical_neighbor_allreduce
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedAdaptThenCombineOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         communication_type: CommunicationType =
         CommunicationType.neighbor_allreduce,
         num_steps_per_communication: int = 1,
@@ -261,6 +293,7 @@ def DistributedAdaptThenCombineOptimizer(
     (SGD/Adam/...) to overlap communication — any ``torch.optim.Optimizer``
     works here: the combine runs as one batched mesh program after the
     step, so there is no per-parameter hook machinery to special-case."""
+    _check_model(model)
     _check_sched_comm(sched, communication_type)
     opt = _reclass(optimizer, _AdaptThenCombineMixin,
                    "DistributedAdaptThenCombineOptimizer",
@@ -268,11 +301,12 @@ def DistributedAdaptThenCombineOptimizer(
     opt.communication_type = communication_type
     opt.sched = sched
     opt.step_index = 0
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedAdaptWithCombineOptimizer(
         optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None,
         communication_type: CommunicationType =
         CommunicationType.neighbor_allreduce,
         num_steps_per_communication: int = 1,
@@ -282,6 +316,7 @@ def DistributedAdaptWithCombineOptimizer(
     re-class body IS the CTA ``_DistributedReduceOptimizer``; the overlap
     is scheduling, not different math).  Combine-then-adapt semantics
     with the full ``communication_type`` knob."""
+    _check_model(model)
     _check_sched_comm(sched, communication_type)
     opt = _reclass(optimizer, _CombineMixin,
                    "DistributedAdaptWithCombineOptimizer",
@@ -289,7 +324,7 @@ def DistributedAdaptWithCombineOptimizer(
     opt.communication_type = communication_type
     opt.sched = sched
     opt.step_index = 0
-    return opt
+    return _attach_model(opt, model)
 
 
 class _WinPutMixin(_DistributedMixin):
@@ -419,41 +454,47 @@ def _default_prefix(window_prefix: Optional[str], base: str) -> str:
 
 
 def DistributedWinPutOptimizer(optimizer: torch.optim.Optimizer,
-                               window_prefix: Optional[str] = None,
-                               num_steps_per_communication: int = 1
+                               model: Optional["torch.nn.Module"] = None,
+                               num_steps_per_communication: int = 1,
+                               window_prefix: Optional[str] = None
                                ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for the one-sided push strategy (reference
     factory torch/optimizers.py:1271).  Windows are created immediately;
     call ``opt._bft_free_windows()`` to release them."""
+    _check_model(model)
     opt = _reclass(optimizer, _WinPutMixin, "DistributedWinPutOptimizer",
                    num_steps_per_communication)
     opt._bft_register_windows(_default_prefix(window_prefix, "win_put_opt"))
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedPullGetOptimizer(optimizer: torch.optim.Optimizer,
-                                window_prefix: Optional[str] = None,
-                                num_steps_per_communication: int = 1
+                                model: Optional["torch.nn.Module"] = None,
+                                num_steps_per_communication: int = 1,
+                                window_prefix: Optional[str] = None
                                 ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for the one-sided pull strategy (reference
     factory torch/optimizers.py:1225).  Windows are created immediately;
     call ``opt._bft_free_windows()`` to release them."""
+    _check_model(model)
     opt = _reclass(optimizer, _PullGetMixin, "DistributedPullGetOptimizer",
                    num_steps_per_communication)
     opt._bft_register_windows(_default_prefix(window_prefix, "pull_get_opt"))
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
-                                window_prefix: Optional[str] = None,
-                                num_steps_per_communication: int = 1
+                                model: Optional["torch.nn.Module"] = None,
+                                num_steps_per_communication: int = 1,
+                                window_prefix: Optional[str] = None
                                 ) -> torch.optim.Optimizer:
     """Re-class ``optimizer`` for push-sum / gradient-push (reference
     factory torch/optimizers.py:1180)."""
+    _check_model(model)
     opt = _reclass(optimizer, _PushSumMixin, "DistributedPushSumOptimizer",
                    num_steps_per_communication)
     opt._bft_register_windows(_default_prefix(window_prefix, "push_sum_opt"))
-    return opt
+    return _attach_model(opt, model)
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -468,20 +509,25 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     reference optimizers do (torch/optimizers.py:112-163)."""
     if communication == "neighbor_allreduce":
         opt = DistributedNeighborAllreduceOptimizer(
-            optimizer, num_steps_per_communication, sched)
+            optimizer,
+            num_steps_per_communication=num_steps_per_communication,
+            sched=sched)
     elif communication == "gradient_allreduce":
         opt = DistributedGradientAllreduceOptimizer(
-            optimizer, num_steps_per_communication)
+            optimizer,
+            num_steps_per_communication=num_steps_per_communication)
     elif communication == "allreduce":
         # weight-average CTA, matching DistributedAllreduceOptimizer (the
         # reference's factory of that name averages WEIGHTS,
         # torch/optimizers.py:1301); use "gradient_allreduce" for the
         # Horovod-style gradient averaging
         opt = DistributedAllreduceOptimizer(
-            optimizer, num_steps_per_communication)
+            optimizer,
+            num_steps_per_communication=num_steps_per_communication)
     elif communication == "hierarchical_neighbor_allreduce":
         opt = DistributedHierarchicalNeighborAllreduceOptimizer(
-            optimizer, num_steps_per_communication)
+            optimizer,
+            num_steps_per_communication=num_steps_per_communication)
     else:
         raise ValueError(f"unknown communication {communication!r}")
     # hooks attach only after the strategy validates, and stay removable

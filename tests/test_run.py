@@ -71,6 +71,23 @@ def test_mpi_era_compat_flags(capsys):
                        "--mca btl_tcp_if_include eth0", "cmd"])
     with pytest.raises(SystemExit, match="no.*TPU-side meaning|KEY=VAL"):
         make_single_host_env(args, base_env={})
+    # a key that is not a shell identifier would be parsed as shell
+    # syntax in the remote ssh line: reject at parse time
+    args = parse_args(["-np", "2", "--extra-mpi-flags", "A;true=1", "cmd"])
+    with pytest.raises(SystemExit, match="not a valid environment"):
+        make_single_host_env(args, base_env={})
+
+
+def test_extra_keys_bypass_exportability_blocklist():
+    """Explicitly-requested --extra-mpi-flags keys must reach the ssh
+    assignment line even when is_exportable would drop them."""
+    from bluefog_tpu.run import env_util
+    env = {"SSH_AUTH_SOCK": "/tmp/x", "BLUEFOG_FOO": "1"}
+    base = env_util.env_assignments(env, ["BLUEFOG_"])
+    assert base == ["BLUEFOG_FOO=1"]
+    extra = env_util.env_assignments(env, ["BLUEFOG_"],
+                                     extra_keys={"SSH_AUTH_SOCK"})
+    assert "SSH_AUTH_SOCK=/tmp/x" in extra and "BLUEFOG_FOO=1" in extra
 
 
 def test_single_host_env_timeline_and_machines():

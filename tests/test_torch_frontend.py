@@ -185,6 +185,31 @@ def test_synchronize_unknown_handle_raises(bf_ctx):
         bft.wait(h)  # double-wait: descriptive error, not KeyError
 
 
+def test_factories_take_model_second_like_reference(bf_ctx):
+    """Reference factory signature: Distributed*(optimizer, model, ...)
+    (reference torch/optimizers.py:1180-1497).  The ported two-positional
+    call must work, register per-layer timeline hooks, and a legacy value
+    in the model slot must fail loudly."""
+    model = torch.nn.Linear(3, 2)
+    p = torch.nn.Parameter(torch.zeros(N_DEVICES, 2))
+    opt = bft.DistributedNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=0.1), model)
+    assert type(opt).__name__ == "DistributedNeighborAllreduceOptimizer"
+    assert opt._bft_timeline_handles    # hooks registered from the model
+    for h in opt._bft_timeline_handles:
+        h.remove()
+    opt2 = bft.DistributedWinPutOptimizer(
+        torch.optim.SGD([torch.nn.Parameter(torch.zeros(N_DEVICES, 2))],
+                        lr=0.1), model)
+    assert opt2._bft_timeline_handles
+    for h in opt2._bft_timeline_handles:
+        h.remove()
+    opt2._bft_free_windows()
+    with pytest.raises(TypeError, match="second positional argument"):
+        bft.DistributedGradientAllreduceOptimizer(
+            torch.optim.SGD([p], lr=0.1), 4)   # old num_steps position
+
+
 def test_optimizer_factory_dispatch(bf_ctx):
     p = torch.nn.Parameter(torch.zeros(N_DEVICES, 2))
     opt = bft.DistributedOptimizer(torch.optim.SGD([p], lr=0.1),
