@@ -414,18 +414,23 @@ def _map_state(state_dict, fn):
             for k, v in state_dict.items()}
 
 
-def broadcast_parameters(state_dict, root_rank: int = 0):
+def broadcast_parameters(params, root_rank: int = 0):
     """Overwrite every rank's slice with ``root_rank``'s (utility.py:26).
 
-    ``state_dict``: name -> [size, ...] torch tensor (global view).
+    ``params``: a state_dict (name -> [size, ...] torch tensor, global
+    view) or named-parameter iterable, like the reference's.
     Returns a new dict; non-tensor entries pass through.
     """
-    return _map_state(state_dict, lambda t: broadcast(t, root_rank))
+    if not isinstance(params, dict):
+        params = dict(params)   # reference accepts named_parameters() too
+    return _map_state(params, lambda t: broadcast(t, root_rank))
 
 
-def allreduce_parameters(state_dict, average: bool = True):
+def allreduce_parameters(params, average: bool = True):
     """Average every rank's slice globally (utility.py:58)."""
-    return _map_state(state_dict, lambda t: allreduce(t, average))
+    if not isinstance(params, dict):
+        params = dict(params)
+    return _map_state(params, lambda t: allreduce(t, average))
 
 
 def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer",
