@@ -42,10 +42,11 @@ class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_len=8192,
                  dtype=jnp.bfloat16, num_experts=0, capacity_factor=1.25,
-                 attn_impl="auto", remat=False):
+                 attn_impl="auto", remat=False, num_kv_heads=None):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads        # None = MHA; < num_heads = GQA
         self.embed_dim = embed_dim
         self.mlp_ratio = mlp_ratio
         self.max_len = max_len
@@ -123,24 +124,49 @@ class MoEMLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-LN decoder block with a pluggable attention function."""
+    """Pre-LN decoder block with a pluggable attention function.
+
+    ``num_kv_heads`` < ``num_heads`` gives grouped-query attention (the
+    modern KV-cache-lean layout; 1 = multi-query): q keeps every head,
+    k/v project to the smaller count and the attention fn broadcasts
+    (ops/flash_attention.py::_expand_kv_groups)."""
     num_heads: int
     dtype: Dtype
     mlp_ratio: int = 4
     num_experts: int = 0
     capacity_factor: float = 1.25
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, attn_fn: Callable, positions,
                  moe_fn: Optional[Callable] = None, expert_params=None):
         D = x.shape[-1]
         head_dim = D // self.num_heads
+        kv_heads = (self.num_kv_heads if self.num_kv_heads is not None
+                    else self.num_heads)
+        if kv_heads < 1 or self.num_heads % kv_heads:
+            raise ValueError(f"num_kv_heads ({kv_heads}) must be a "
+                             f"positive divisor of num_heads "
+                             f"({self.num_heads})")
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
-        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
-                              dtype=self.dtype, name="qkv")(h)
-        q, k, v = (qkv[..., i, :, :] for i in range(3))
+        if kv_heads == self.num_heads:
+            qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
+                                  dtype=self.dtype, name="qkv")(h)
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+        else:
+            q = nn.DenseGeneral((self.num_heads, head_dim), axis=-1,
+                                dtype=self.dtype, name="q")(h)
+            kv = nn.DenseGeneral((2, kv_heads, head_dim), axis=-1,
+                                 dtype=self.dtype, name="kv")(h)
+            k, v = kv[..., 0, :, :], kv[..., 1, :, :]
         q = _rope(q, positions)
         k = _rope(k, positions)
+        if kv_heads != self.num_heads:
+            # expand here so every pluggable attn_fn (flash, ring,
+            # ulysses, custom) keeps its equal-heads contract; the
+            # repeated views are consumed immediately
+            from ..ops.flash_attention import _expand_kv_groups
+            k, v = _expand_kv_groups(q, k, v)
         a = attn_fn(q, k, v)
         a = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
                             name="proj")(a)
@@ -201,6 +227,7 @@ class Transformer(nn.Module):
             ep = (expert_params or {}).get(f"block_{i}")
             x = block_cls(cfg.num_heads, cfg.dtype, cfg.mlp_ratio,
                           cfg.num_experts, cfg.capacity_factor,
+                          num_kv_heads=getattr(cfg, "num_kv_heads", None),
                           name=f"block_{i}")(x, attn_fn, positions, moe_fn,
                                              ep)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

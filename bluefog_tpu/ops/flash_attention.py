@@ -388,6 +388,23 @@ def _check_blocks(Tq, Tk, block_q, block_k):
     return block_q, block_k
 
 
+def _expand_kv_groups(q, k, v):
+    """Grouped/multi-query attention at the wrapper level: ``k``/``v`` may
+    carry fewer heads than ``q`` (H_kv dividing H; H_kv=1 = MQA).  The
+    kv heads are repeated to H before the kernel — the silicon-validated
+    MHA kernel is untouched (a kv-head-deduplicating index map is a
+    future kernel optimization; the repeat costs HBM only for the
+    expanded K/V reads, the score matrix still never materializes)."""
+    H, H_kv = q.shape[2], k.shape[2]
+    if H_kv == H:
+        return k, v
+    if H % H_kv != 0:
+        raise ValueError(
+            f"q heads ({H}) must be a multiple of kv heads ({H_kv})")
+    g = H // H_kv
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
                               "interpret", "return_lse"))
@@ -401,7 +418,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     With ``return_lse=True`` also returns the per-row log-sum-exp
     [B, H, Tq] (float32), the statistic ring attention's cross-hop merge
-    needs."""
+    needs.  ``k``/``v`` may carry fewer heads (GQA/MQA; any divisor of
+    H)."""
+    k, v = _expand_kv_groups(q, k, v)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
@@ -462,7 +481,11 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              block_q: int = 512, block_k: int = 512,
                              interpret: bool = False):
     """Differentiable flash attention returning ``(o, lse)``; the LSE
-    cotangent is supported (needed under ring attention's merge)."""
+    cotangent is supported (needed under ring attention's merge).
+    ``k``/``v`` may carry fewer heads (GQA/MQA); their gradients come
+    back group-summed to the original kv-head count (autodiff of the
+    head repeat)."""
+    k, v = _expand_kv_groups(q, k, v)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
@@ -519,6 +542,7 @@ def best_attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0,
     shapes tile onto it, the XLA reference path otherwise (CPU test meshes,
     tiny/ragged shapes)."""
     from .ring_attention import attention as _ref
+    k, v = _expand_kv_groups(q, k, v)   # GQA/MQA on either path
     if force_flash and not interpret and jax.default_backend() != "tpu":
         raise ValueError(
             "flash attention requires a TPU backend (pass interpret=True "

@@ -418,19 +418,23 @@ def broadcast_parameters(params, root_rank: int = 0):
     """Overwrite every rank's slice with ``root_rank``'s (utility.py:26).
 
     ``params``: a state_dict (name -> [size, ...] torch tensor, global
-    view) or named-parameter iterable, like the reference's.
-    Returns a new dict; non-tensor entries pass through.
+    view) or named-parameter iterable, like the reference's.  IN-PLACE
+    like the reference: the given tensors are overwritten (reference
+    callers discard the return value — ``bf.broadcast_parameters(
+    model.named_parameters(), 0)`` must actually synchronize the model).
+    Returns the same dict (non-tensor entries pass through) for
+    convenience.
     """
     if not isinstance(params, dict):
         params = dict(params)   # reference accepts named_parameters() too
-    return _map_state(params, lambda t: broadcast(t, root_rank))
+    return _map_state(params, lambda t: broadcast_(t, root_rank))
 
 
 def allreduce_parameters(params, average: bool = True):
-    """Average every rank's slice globally (utility.py:58)."""
+    """Average every rank's slice globally, IN PLACE (utility.py:58)."""
     if not isinstance(params, dict):
         params = dict(params)
-    return _map_state(params, lambda t: allreduce(t, average))
+    return _map_state(params, lambda t: allreduce_(t, average))
 
 
 def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer",

@@ -128,6 +128,28 @@ def test_broadcast_parameters(bf_ctx):
     out = bft.broadcast_parameters(sd, root_rank=2)
     assert out["meta"] == 7
     assert torch.allclose(out["w"], torch.full_like(out["w"], 2.0))
+    # IN-PLACE like the reference: the input tensor itself was overwritten
+    assert out["w"] is sd["w"]
+    assert torch.allclose(sd["w"], torch.full_like(sd["w"], 2.0))
+
+
+def test_broadcast_parameters_named_iterable_mutates_model(bf_ctx):
+    """The canonical reference call — return value discarded — must
+    synchronize the model (reference utility.py broadcasts in place)."""
+    m = torch.nn.Linear(3, N_DEVICES, bias=False)
+    with torch.no_grad():
+        for r in range(N_DEVICES):
+            m.weight[r] = float(r)
+    bft.broadcast_parameters(m.named_parameters(), root_rank=1)
+    assert torch.allclose(m.weight.data,
+                          torch.full_like(m.weight.data, 1.0))
+    with torch.no_grad():
+        for r in range(N_DEVICES):
+            m.weight[r] = float(r)
+    bft.allreduce_parameters(m.named_parameters())
+    mean = (N_DEVICES - 1) / 2.0
+    assert torch.allclose(m.weight.data,
+                          torch.full_like(m.weight.data, mean))
 
 
 def test_allreduce_parameters(bf_ctx):
