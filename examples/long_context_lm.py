@@ -50,6 +50,9 @@ def main():
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="kv heads for grouped-query attention (divisor of "
+                        "--heads; 1 = multi-query); default = --heads (MHA)")
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--attn", choices=["ring", "ulysses"], default="ring")
     p.add_argument("--lr", type=float, default=3e-3)
@@ -65,7 +68,8 @@ def main():
         raise SystemExit(f"--seq-len must be divisible by mesh size {n}")
 
     model = TransformerLM(vocab_size=args.vocab, num_layers=args.layers,
-                          num_heads=args.heads, embed_dim=args.dim,
+                          num_heads=args.heads, num_kv_heads=args.kv_heads,
+                          embed_dim=args.dim,
                           max_len=args.seq_len, dtype=jnp.float32,
                           remat=args.remat)
     corpus = synthetic_corpus(args.vocab,

@@ -32,6 +32,7 @@ run bench-tiny         examples/benchmark.py --model ResNet18 --batch-size 4 --i
 run lm-ring            examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1
 run lm-ulysses         examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --attn ulysses
 run lm-remat           examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --remat
+run lm-gqa             examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --heads 4 --kv-heads 2
 
 # The two notebooks execute for real (reference parity: the notebooks are
 # its interactive-mode showcase, examples/interactive_bluefog.ipynb).
