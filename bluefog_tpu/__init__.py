@@ -114,6 +114,7 @@ from .optim import (
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedAdaptThenCombineOptimizer,
     DistributedAdaptWithCombineOptimizer,
+    DistributedExactDiffusionOptimizer,
     DistributedWinPutOptimizer,
     DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,

@@ -10,6 +10,7 @@ from .wrappers import (
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedAdaptThenCombineOptimizer,
     DistributedAdaptWithCombineOptimizer,
+    DistributedExactDiffusionOptimizer,
     DistributedWinPutOptimizer,
     DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,

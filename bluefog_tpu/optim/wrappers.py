@@ -43,6 +43,7 @@ __all__ = [
     "DistributedHierarchicalNeighborAllreduceOptimizer",
     "DistributedAdaptThenCombineOptimizer",
     "DistributedAdaptWithCombineOptimizer",
+    "DistributedExactDiffusionOptimizer",
     "DistributedWinPutOptimizer",
     "DistributedPullGetOptimizer",
     "DistributedPushSumOptimizer",
@@ -59,12 +60,18 @@ class _JittedStrategyOptimizer:
                  comm_type: CommunicationType,
                  atc: bool = False,
                  gradient_allreduce: bool = False,
+                 exact_diffusion: bool = False,
                  num_steps_per_communication: int = 1,
                  sched: Optional[DynamicSchedule] = None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
         self.gradient_allreduce = gradient_allreduce
+        self.exact_diffusion = exact_diffusion
+        if exact_diffusion and num_steps_per_communication != 1:
+            raise ValueError(
+                "exact-diffusion's correction assumes one exchange per "
+                "adapt step (num_steps_per_communication=1)")
         self.k = num_steps_per_communication
         self.sched = sched
         self._step_cache = {}
@@ -75,6 +82,10 @@ class _JittedStrategyOptimizer:
         reference processes)."""
         if self.gradient_allreduce and self.k > 1:
             return jax.vmap(lambda p: S.grad_accum_init(self.base, p))(params)
+        if self.exact_diffusion:
+            # psi_prev carries the rank axis already (it IS the params)
+            return jax.vmap(
+                lambda p: S.exact_diffusion_init(self.base, p))(params)
         return jax.vmap(self.base.init)(params)
 
     def _build(self, key):
@@ -91,6 +102,12 @@ class _JittedStrategyOptimizer:
         if self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k)
+        elif self.exact_diffusion:
+            step_core = S.exact_diffusion_step(
+                self.base, self.comm_type, cx.rank_axis, topo=topo,
+                sched=self.sched,
+                machine_axes=(cx.machine_axis, cx.local_axis),
+                machine_topo=machine_topo)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
@@ -98,7 +115,9 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo)
-        if not self.gradient_allreduce:  # grad-allreduce accumulates internally
+        if not (self.gradient_allreduce or self.exact_diffusion):
+            # grad-allreduce accumulates internally; exact-diffusion is
+            # one-exchange-per-step by construction
             step_core = S.with_local_steps(
                 step_core, S.local_sgd_like_step(self.base), self.k)
 
@@ -190,6 +209,25 @@ def DistributedAdaptWithCombineOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched)
+
+
+def DistributedExactDiffusionOptimizer(
+        base, communication_type=CommunicationType.neighbor_allreduce):
+    """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
+    diffusion from the BlueFog authors' research line): ATC with the
+    psi-correction, so constant-step-size decentralized training reaches
+    the EXACT global optimum under heterogeneous per-rank objectives
+    instead of an O(alpha*zeta) neighborhood.  See
+    optim/strategies.py::exact_diffusion_step.
+
+    STATIC mixing only: the correction's convergence theory assumes a
+    fixed doubly-stochastic W, and empirically the recursion DIVERGES
+    under a dynamic one-peer schedule (measured blow-up to ~1e34 at
+    lr 0.2 on the quadratic benchmark) — so ``sched=`` is deliberately
+    not accepted; use the neighbor-CTA/ATC families for time-varying
+    graphs."""
+    return _JittedStrategyOptimizer(
+        base, communication_type, exact_diffusion=True)
 
 
 # ---------------------------------------------------------------------------
