@@ -216,8 +216,11 @@ def exact_diffusion_step(base: optax.GradientTransformation,
 
 
 def exact_diffusion_init(base: optax.GradientTransformation, params):
-    """Per-rank init for exact-diffusion: psi_prev = x_0."""
-    return {"base": base.init(params), "psi_prev": params}
+    """Per-rank init for exact-diffusion: psi_prev = x_0 as a COPY —
+    aliasing the live parameter buffers would double-donate them on the
+    first step under ``jax.jit(..., donate_argnums=...)``."""
+    return {"base": base.init(params),
+            "psi_prev": jax.tree.map(jnp.array, params)}
 
 
 def with_local_steps(step_fn: Callable, local_step_fn: Callable,

@@ -43,18 +43,28 @@ def replicate_to_ranks(tree, size: Optional[int] = None):
 
 
 def create_train_state(model, base_opt: optax.GradientTransformation,
-                       rng, sample_input, train: bool = True):
+                       rng, sample_input, train: bool = True,
+                       communication: str = None):
     """Initialize (variables, opt_state) in global view.
 
     All ranks start from the same weights, matching the reference's
     ``bf.broadcast_parameters(model.state_dict(), root_rank=0)`` pattern.
+    Pass the SAME ``communication`` you will give ``make_train_step`` when
+    the strategy carries extra state (``exact_diffusion`` adds the
+    psi_prev tree); for every other mode the argument is ignored.
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     extra = {k: v for k, v in variables.items() if k != "params"}
     gparams = replicate_to_ranks(params)
     gextra = replicate_to_ranks(extra)
-    opt_state = jax.vmap(base_opt.init)(gparams)
+    if communication == "exact_diffusion":
+        # the ONE definition of the ED state layout lives in strategies.py
+        # (psi_prev copied there: params+opt_state donation stays legal)
+        opt_state = jax.vmap(
+            lambda p: S.exact_diffusion_init(base_opt, p))(gparams)
+    else:
+        opt_state = jax.vmap(base_opt.init)(gparams)
     return {"params": gparams, **gextra}, opt_state
 
 
@@ -71,7 +81,10 @@ def make_train_step(model,
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
     CTA), ``allreduce`` (CTA on weights), ``gradient_allreduce`` (Horovod
-    style), ``hierarchical_neighbor_allreduce``, ``empty`` (local only).
+    style), ``hierarchical_neighbor_allreduce``, ``exact_diffusion``
+    (bias-corrected ATC, static topology only — create the opt_state with
+    ``create_train_state(..., communication="exact_diffusion")``),
+    ``empty`` (local only).
 
     Returns ``train_step(variables, opt_state, batch, step) ->
     (variables, opt_state, loss)`` where ``batch = (x, y)`` with leading
@@ -80,15 +93,22 @@ def make_train_step(model,
     cx = ctx()
     hierarchical = communication == "hierarchical_neighbor_allreduce"
     grad_ar = communication == "gradient_allreduce"
+    exact_diffusion = communication == "exact_diffusion"
     comm_type = {
         "neighbor_allreduce": S.CommunicationType.neighbor_allreduce,
         "allreduce": S.CommunicationType.allreduce,
         "hierarchical_neighbor_allreduce":
             S.CommunicationType.hierarchical_neighbor_allreduce,
         "gradient_allreduce": S.CommunicationType.empty,
+        "exact_diffusion": S.CommunicationType.neighbor_allreduce,
         "empty": S.CommunicationType.empty,
     }[communication]
 
+    if exact_diffusion and sched is not None:
+        raise ValueError(
+            "exact_diffusion requires a static topology: the correction "
+            "diverges under dynamic schedules (see "
+            "DistributedExactDiffusionOptimizer)")
     topo = cx.compiled_topology if (
         comm_type == S.CommunicationType.neighbor_allreduce and sched is None
     ) else None
@@ -117,14 +137,23 @@ def make_train_step(model,
                 "gradient_allreduce) needs the accumulator state — use "
                 "bf.DistributedGradientAllreduceOptimizer instead")
         core = S.gradient_allreduce_step(base_opt, cx.rank_axis)
+    elif exact_diffusion:
+        if num_steps_per_communication > 1:
+            raise ValueError("exact_diffusion assumes one exchange per "
+                             "adapt step (num_steps_per_communication=1)")
+        core = S.exact_diffusion_step(
+            base_opt, comm_type, cx.rank_axis, topo=topo,
+            machine_axes=(cx.machine_axis, cx.local_axis),
+            machine_topo=machine_topo, nar_backend=nar_backend)
     else:
         builder = S.atc_step if atc else S.consensus_step
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
                        sched=sched,
                        machine_axes=(cx.machine_axis, cx.local_axis),
                        machine_topo=machine_topo, nar_backend=nar_backend)
-    core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
-                              num_steps_per_communication)
+    if not exact_diffusion:
+        core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
+                                  num_steps_per_communication)
 
     pl = mesh_plumbing(cx, hierarchical)
 

@@ -158,11 +158,18 @@ def test_exact_diffusion_removes_diffusion_bias(bf_ctx):
         _JittedStrategyOptimizer(
             optax.sgd(lr), bf.CommunicationType.neighbor_allreduce,
             exact_diffusion=True, num_steps_per_communication=2)
-    # dynamic schedules are rejected by the factory: the correction's
-    # theory assumes fixed mixing, and the recursion measurably diverges
-    # under one-peer dynamic schedules (~1e34 at lr 0.2)
+    # dynamic schedules are rejected everywhere: the correction's theory
+    # assumes fixed mixing, and the recursion measurably diverges under
+    # one-peer dynamic schedules (~1e34 at lr 0.2)
     with pytest.raises(TypeError):
         bf.DistributedExactDiffusionOptimizer(optax.sgd(lr), sched=None)
+    G = bf.ExponentialTwoGraph(N)
+    sched = bf.compile_dynamic_schedule(
+        lambda r: dyn.GetDynamicOnePeerSendRecvRanks(G, r), N)
+    with pytest.raises(ValueError, match="static topology"):
+        _JittedStrategyOptimizer(
+            optax.sgd(lr), bf.CommunicationType.neighbor_allreduce,
+            exact_diffusion=True, sched=sched)
 
 
 def test_adapt_with_combine(bf_ctx):

@@ -26,7 +26,8 @@ def train_some(model, communication, steps=6, sched=None, atc=False,
                sample_shape=(1, 28, 28, 1), batch_shape=(28, 28, 1)):
     base = optax.sgd(0.05, momentum=0.9)
     variables, opt_state = T.create_train_state(
-        model, base, jax.random.key(0), jnp.zeros(sample_shape))
+        model, base, jax.random.key(0), jnp.zeros(sample_shape),
+        communication=communication)
     step_fn = T.make_train_step(model, base, communication=communication,
                                 sched=sched, atc=atc, donate=False)
     rng = np.random.default_rng(0)
@@ -51,7 +52,8 @@ def test_create_train_state_global_view(bf_ctx):
 
 
 @pytest.mark.parametrize("communication", [
-    "neighbor_allreduce", "allreduce", "gradient_allreduce", "empty"])
+    "neighbor_allreduce", "allreduce", "gradient_allreduce",
+    "exact_diffusion", "empty"])
 def test_lenet_loss_decreases(bf_ctx, communication):
     # momentum makes the first few losses noisy (especially for the
     # local-only "empty" mode on small meshes) — require progress by the
