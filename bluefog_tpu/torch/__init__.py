@@ -68,6 +68,7 @@ from .optimizers import (
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedAdaptThenCombineOptimizer,
     DistributedAdaptWithCombineOptimizer,
+    DistributedExactDiffusionOptimizer,
     DistributedWinPutOptimizer,
     DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,
@@ -116,6 +117,7 @@ __all__ = [
     "DistributedHierarchicalNeighborAllreduceOptimizer",
     "DistributedAdaptThenCombineOptimizer",
     "DistributedAdaptWithCombineOptimizer",
+    "DistributedExactDiffusionOptimizer",
     "DistributedWinPutOptimizer",
     "DistributedPullGetOptimizer",
     "DistributedPushSumOptimizer",

@@ -180,6 +180,53 @@ class _AdaptThenCombineMixin(_CombineMixin):
         return loss
 
 
+class _ExactDiffusionMixin(_DistributedMixin):
+    """Exact-Diffusion / D2 on torch tensors (beyond-reference; JAX twin:
+    optim/strategies.py::exact_diffusion_step):
+
+        psi_k   = adapt(x_k)                 # the wrapped optimizer's step
+        phi_k   = psi_k + x_k - psi_{k-1}    # bias correction
+        x_{k+1} = neighbor_allreduce(phi_k)  # static-topology average
+
+    psi_prev lives in ``self.state[p]["bft_psi_prev"]`` so it (a)
+    round-trips through ``state_dict()``/``load_state_dict()`` like any
+    optimizer algorithm state and (b) initializes lazily per parameter —
+    params added via ``add_param_group`` after the first step still get
+    the correction and the exchange.  A param without saved psi_prev
+    uses its own pre-step value (phi_0 = psi_0, plain ATC first step).
+    Static mixing only, one exchange per step."""
+
+    @property
+    def sched(self):
+        return None
+
+    @sched.setter
+    def sched(self, value):
+        # other combine optimizers take this knob; silently ignoring it
+        # here would train on the wrong topology belief — match the JAX
+        # factory's loud rejection (optim/wrappers.py)
+        if value is not None:
+            raise ValueError(
+                "exact-diffusion requires a static topology: the "
+                "correction diverges under dynamic schedules")
+
+    def step(self, closure=None):
+        params = list(self._bft_params())
+        x_prev = {id(p): p.data.clone() for p in params}
+        # the wrapped optimizer's own step (skip _DistributedMixin.step)
+        loss = super(_DistributedMixin, self).step(closure)
+        with torch.no_grad():
+            for p in params:
+                st = self.state[p]
+                xp = x_prev[id(p)]
+                sp = st.get("bft_psi_prev", xp)      # first step: psi_prev=x_0
+                psi = p.data.clone()                 # adapted weights
+                p.data.add_(xp - sp)                 # phi = psi + x - psi_prev
+                p.data.copy_(_ops.neighbor_allreduce(p.data))
+                st["bft_psi_prev"] = psi
+        return loss
+
+
 def _reclass(optimizer: torch.optim.Optimizer, mixin, name: str,
              num_steps_per_communication: int):
     cls = type(name, (mixin, optimizer.__class__), {})
@@ -494,6 +541,18 @@ def DistributedPushSumOptimizer(optimizer: torch.optim.Optimizer,
     opt = _reclass(optimizer, _PushSumMixin, "DistributedPushSumOptimizer",
                    num_steps_per_communication)
     opt._bft_register_windows(_default_prefix(window_prefix, "push_sum_opt"))
+    return _attach_model(opt, model)
+
+
+def DistributedExactDiffusionOptimizer(
+        optimizer: torch.optim.Optimizer,
+        model: Optional["torch.nn.Module"] = None) -> torch.optim.Optimizer:
+    """Exact-Diffusion on torch tensors (beyond-reference; see the JAX
+    factory in optim/wrappers.py for the algorithm and its static-mixing
+    restriction).  One exchange per step by construction."""
+    _check_model(model)
+    opt = _reclass(optimizer, _ExactDiffusionMixin,
+                   "DistributedExactDiffusionOptimizer", 1)
     return _attach_model(opt, model)
 
 

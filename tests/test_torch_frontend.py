@@ -207,6 +207,64 @@ def test_synchronize_unknown_handle_raises(bf_ctx):
         bft.wait(h)  # double-wait: descriptive error, not KeyError
 
 
+def test_exact_diffusion_torch_removes_diffusion_bias(bf_ctx):
+    """Torch twin of the JAX exact-diffusion test: heterogeneous
+    quadratics at a constant lr — ED lands every rank on mean(c), plain
+    ATC stalls at a visibly biased fixed point."""
+    c = _rankval((4,)) * 1.5
+
+    def run(factory):
+        w = torch.nn.Parameter(torch.zeros(N_DEVICES, 4))
+        opt = factory(torch.optim.SGD([w], lr=0.4))
+        for _ in range(400):
+            opt.zero_grad()
+            (0.5 * ((w - c) ** 2).sum()).backward()
+            opt.step()
+        return w.data
+
+    cbar = c.mean(0)
+    w_ed = run(bft.DistributedExactDiffusionOptimizer)
+    assert (w_ed - cbar).abs().max().item() < 1e-4
+    w_atc = run(bft.DistributedAdaptThenCombineOptimizer)
+    assert (w_atc - w_atc.mean(0)).abs().max().item() > 0.1
+
+
+def test_exact_diffusion_torch_state_and_late_params(bf_ctx):
+    """psi_prev rides state_dict (checkpoint resume continues the exact
+    trajectory), params added after the first step still communicate, and
+    setting the dynamic-schedule knob is rejected loudly."""
+    c = _rankval((3,)) * 1.2
+    w = torch.nn.Parameter(torch.zeros(N_DEVICES, 3))
+    opt = bft.DistributedExactDiffusionOptimizer(torch.optim.SGD([w], lr=0.3))
+    for _ in range(5):
+        opt.zero_grad()
+        (0.5 * ((w - c) ** 2).sum()).backward()
+        opt.step()
+    # checkpoint mid-run, keep training both copies: identical trajectories
+    sd = opt.state_dict()
+    w2 = torch.nn.Parameter(w.data.clone())
+    opt2 = bft.DistributedExactDiffusionOptimizer(
+        torch.optim.SGD([w2], lr=0.3))
+    opt2.load_state_dict(sd)
+    for o, p in ((opt, w), (opt2, w2)):
+        for _ in range(20):
+            o.zero_grad()
+            (0.5 * ((p - c) ** 2).sum()).backward()
+            o.step()
+    assert torch.allclose(w.data, w2.data, atol=1e-6)
+    # a param group added after the first step still gets the exchange
+    q = torch.nn.Parameter(_rankval((2,)).clone())
+    opt.add_param_group({"params": [q]})
+    for _ in range(60):
+        opt.zero_grad()
+        ((0.5 * ((w - c) ** 2)).sum() + (0.5 * q ** 2).sum()).backward()
+        opt.step()
+    spread_q = (q.data - q.data.mean(0)).abs().max().item()
+    assert spread_q < 1e-3, f"late param never communicated: {spread_q}"
+    with pytest.raises(ValueError, match="static topology"):
+        opt.sched = object()
+
+
 def test_factories_take_model_second_like_reference(bf_ctx):
     """Reference factory signature: Distributed*(optimizer, model, ...)
     (reference torch/optimizers.py:1180-1497).  The ported two-positional
