@@ -108,6 +108,14 @@ class _JittedStrategyOptimizer:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k)
         elif self.exact_diffusion:
+            if self.comm_type not in (
+                    CommunicationType.neighbor_allreduce,
+                    CommunicationType.allreduce):
+                raise ValueError(
+                    "exact-diffusion supports neighbor_allreduce (symmetric "
+                    "topology) or allreduce mixing only")
+            if self.comm_type == CommunicationType.neighbor_allreduce:
+                topo = S.exact_diffusion_topology(cx.compiled_topology)
             step_core = S.exact_diffusion_step(
                 self.base, self.comm_type, cx.rank_axis, topo=topo,
                 sched=self.sched,

@@ -194,7 +194,21 @@ class _ExactDiffusionMixin(_DistributedMixin):
     params added via ``add_param_group`` after the first step still get
     the correction and the exchange.  A param without saved psi_prev
     uses its own pre-step value (phi_0 = psi_0, plain ATC first step).
-    Static mixing only, one exchange per step."""
+    Static SYMMETRIC mixing only (validated per step against the live
+    topology; exchanged through the damped (I+W)/2 matrix — see
+    optim/strategies.py::exact_diffusion_topology), one exchange per
+    step."""
+
+    def _bft_ed_matrix(self):
+        import numpy as np
+        from .. import context as _ctx
+        from ..optim import strategies as _S
+        topo = _ctx.ctx().compiled_topology
+        cached = getattr(self, "_bft_ed_cache", None)
+        if cached is None or cached[0] is not topo:
+            damped = _S.exact_diffusion_topology(topo)   # validates symmetry
+            self._bft_ed_cache = (topo, np.asarray(damped.weight_matrix))
+        return self._bft_ed_cache[1]
 
     @property
     def sched(self):
@@ -222,7 +236,8 @@ class _ExactDiffusionMixin(_DistributedMixin):
                 sp = st.get("bft_psi_prev", xp)      # first step: psi_prev=x_0
                 psi = p.data.clone()                 # adapted weights
                 p.data.add_(xp - sp)                 # phi = psi + x - psi_prev
-                p.data.copy_(_ops.neighbor_allreduce(p.data))
+                p.data.copy_(_ops.neighbor_allreduce(
+                    p.data, weight_matrix=self._bft_ed_matrix()))
                 st["bft_psi_prev"] = psi
         return loss
 

@@ -141,8 +141,12 @@ def make_train_step(model,
         if num_steps_per_communication > 1:
             raise ValueError("exact_diffusion assumes one exchange per "
                              "adapt step (num_steps_per_communication=1)")
+        # symmetric-topology validation + (I+W)/2 damping (see
+        # S.exact_diffusion_topology: the undamped directed recursion
+        # measurably diverges)
         core = S.exact_diffusion_step(
-            base_opt, comm_type, cx.rank_axis, topo=topo,
+            base_opt, comm_type, cx.rank_axis,
+            topo=S.exact_diffusion_topology(cx.compiled_topology),
             machine_axes=(cx.machine_axis, cx.local_axis),
             machine_topo=machine_topo, nar_backend=nar_backend)
     else:

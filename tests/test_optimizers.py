@@ -135,6 +135,9 @@ def test_exact_diffusion_removes_diffusion_bias(bf_ctx):
     rng = np.random.default_rng(0)
     c = jnp.asarray(rng.normal(size=(N, 4)) * 3.0, jnp.float32)
     lr = 0.4
+    # ED requires symmetric doubly-stochastic mixing (validated; the
+    # directed exp2 default is rejected and measurably diverges)
+    bf.set_topology(bf.SymmetricExponentialGraph(N), is_weighted=True)
 
     def run(opt, steps=400):
         p = {"w": jnp.zeros((N, 4), jnp.float32)}
@@ -170,6 +173,13 @@ def test_exact_diffusion_removes_diffusion_bias(bf_ctx):
         _JittedStrategyOptimizer(
             optax.sgd(lr), bf.CommunicationType.neighbor_allreduce,
             exact_diffusion=True, sched=sched)
+    # the directed exp2 default is rejected at build time (ED diverged on
+    # it in the logistic example before this validation existed)
+    bf.set_topology(bf.ExponentialTwoGraph(N))
+    opt = bf.DistributedExactDiffusionOptimizer(optax.sgd(lr))
+    p = {"w": jnp.zeros((N, 4), jnp.float32)}
+    with pytest.raises(ValueError, match="symmetric doubly-stochastic"):
+        opt.step(p, {"w": p["w"] - c}, opt.init(p), step=0)
 
 
 def test_adapt_with_combine(bf_ctx):

@@ -212,6 +212,8 @@ def test_exact_diffusion_torch_removes_diffusion_bias(bf_ctx):
     quadratics at a constant lr — ED lands every rank on mean(c), plain
     ATC stalls at a visibly biased fixed point."""
     c = _rankval((4,)) * 1.5
+    bf.set_topology(bf.SymmetricExponentialGraph(N_DEVICES),
+                    is_weighted=True)
 
     def run(factory):
         w = torch.nn.Parameter(torch.zeros(N_DEVICES, 4))
@@ -234,6 +236,8 @@ def test_exact_diffusion_torch_state_and_late_params(bf_ctx):
     trajectory), params added after the first step still communicate, and
     setting the dynamic-schedule knob is rejected loudly."""
     c = _rankval((3,)) * 1.2
+    bf.set_topology(bf.SymmetricExponentialGraph(N_DEVICES),
+                    is_weighted=True)
     w = torch.nn.Parameter(torch.zeros(N_DEVICES, 3))
     opt = bft.DistributedExactDiffusionOptimizer(torch.optim.SGD([w], lr=0.3))
     for _ in range(5):

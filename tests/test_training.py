@@ -58,6 +58,9 @@ def test_lenet_loss_decreases(bf_ctx, communication):
     # momentum makes the first few losses noisy (especially for the
     # local-only "empty" mode on small meshes) — require progress by the
     # tail rather than strict monotonicity
+    if communication == "exact_diffusion":
+        # ED validates for symmetric doubly-stochastic mixing
+        bf.set_topology(bf.SymmetricExponentialGraph(N), is_weighted=True)
     _, losses = train_some(LeNet(), communication, steps=10)
     assert min(losses[-3:]) < losses[0], losses
 
