@@ -24,6 +24,7 @@ run opt-nar            examples/optimization.py --max-iters 300
 run opt-atc            examples/optimization.py --max-iters 300 --method atc
 run opt-pushsum        examples/optimization.py --max-iters 300 --method push_sum
 run opt-gradar         examples/optimization.py --max-iters 300 --method gradient_allreduce
+run opt-exactdiff      examples/optimization.py --max-iters 500 --method exact_diffusion
 run mnist-nar          examples/mnist.py --epochs 1 --batch-size 128
 run mnist-gradar       examples/mnist.py --epochs 1 --batch-size 128 --dist-optimizer gradient_allreduce --disable-dynamic-topology
 run mnist-atc          examples/mnist.py --epochs 1 --batch-size 128 --atc-style
