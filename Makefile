@@ -17,7 +17,8 @@ NUM_DEVICES ?= 8
 PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
-        test_hierarchical test_torch test_attention examples bench hwcheck
+        test_hierarchical test_torch test_attention examples bench hwcheck \
+        chaos
 
 test:
 	$(PYTEST) tests/
@@ -46,6 +47,13 @@ test_hierarchical:
 
 test_torch:
 	$(PYTEST) tests/test_torch_frontend.py
+
+# Fast chaos smoke (<=60s): fault injection, liveness gossip, matrix repair,
+# and the kill-1-of-8 harness demo on the 8-device CPU mesh.  Gated by the
+# `chaos` pytest marker (registered in tests/conftest.py) so tier-1 timing
+# is unaffected.
+chaos:
+	$(PYTEST) -m 'chaos and not slow' tests/test_resilience.py
 
 test_attention:
 	$(PYTEST) tests/test_flash_attention.py tests/test_ring_attention.py

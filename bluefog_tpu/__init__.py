@@ -20,6 +20,10 @@ import os as _os
 
 import jax as _jax
 
+from . import _compat as _compat_mod
+
+_compat_mod.install()
+
 # Honor an explicit JAX_PLATFORMS=cpu request even when a site customization
 # has pinned the platform config (which silently overrides the env var):
 # re-assert it before any backend exists.  Critical for the virtual CPU mesh
@@ -74,7 +78,10 @@ from .ops.api import (
     pair_gossip, pair_gossip_nonblocking,
     barrier, poll, synchronize, wait,
     to_global, from_global, rank_sharding,
+    set_weights_override, clear_weights_override, weights_override,
 )
+
+from . import resilience
 
 from .ops.ring_attention import (
     attention, ring_attention, ulysses_attention,

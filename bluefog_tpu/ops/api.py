@@ -17,6 +17,7 @@ In-place variants (``allreduce_`` etc.) exist for signature parity but return
 new arrays — JAX arrays are immutable.
 """
 
+import contextlib
 import functools
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -47,6 +48,7 @@ __all__ = [
     "pair_gossip", "pair_gossip_nonblocking",
     "barrier", "poll", "synchronize", "wait",
     "rank_sharding", "to_global", "from_global",
+    "set_weights_override", "clear_weights_override", "weights_override",
 ]
 
 
@@ -406,6 +408,52 @@ def _mesh_id():
 
 
 # ---------------------------------------------------------------------------
+# Weights override (resilience hook)
+# ---------------------------------------------------------------------------
+
+# When set, default-topology neighbor_allreduce calls mix with this [N, N]
+# matrix instead of the registered topology's weights.  The matrix rides the
+# generic traced-matrix program (_matrix_mix_fn) as DATA, so a resilience
+# layer can swap in a freshly repaired matrix every step — arbitrary
+# sparsity changes included — without a single recompilation and without
+# touching any call site.  Explicit weight_matrix=/sched= arguments beat the
+# override (the caller asked for something specific).
+_weights_override = [None]
+
+
+def set_weights_override(W) -> Optional[jax.Array]:
+    """Install an override mixing matrix (or ``None`` to clear); returns
+    the previous override.  ``W``: [size, size], BlueFog column convention
+    (``W[i, j]`` = weight receiver j applies to i's value)."""
+    prev = _weights_override[0]
+    if W is None:
+        _weights_override[0] = None
+        return prev
+    W = jnp.asarray(W)
+    n = ctx().size
+    if W.shape != (n, n):
+        raise ValueError(f"weights override must be [{n}, {n}], "
+                         f"got {W.shape}")
+    _weights_override[0] = W
+    return prev
+
+
+def clear_weights_override() -> None:
+    set_weights_override(None)
+
+
+@contextlib.contextmanager
+def weights_override(W):
+    """``with bf.weights_override(W_repaired): ...`` — scoped override for
+    liveness-aware loops (see ``bluefog_tpu.resilience``)."""
+    prev = set_weights_override(W)
+    try:
+        yield
+    finally:
+        _weights_override[0] = prev
+
+
+# ---------------------------------------------------------------------------
 # Collective ops (blocking + nonblocking)
 # ---------------------------------------------------------------------------
 
@@ -614,6 +662,12 @@ def neighbor_allreduce_nonblocking(
             # dense: one allgather mix is cheaper than N-1 permutes
             out = _matrix_mix_fn(cx.rank_axis, _mesh_id())(
                 xg, jnp.asarray(W))
+    elif _weights_override[0] is not None:
+        # resilience hook: mix with the override matrix as traced data —
+        # per-step repaired matrices never recompile (sparsity changes
+        # included; the dense-mix program is structure-independent)
+        out = _matrix_mix_fn(cx.rank_axis, _mesh_id())(
+            xg, _weights_override[0])
     else:
         topo = cx.compiled_topology
         out = _neighbor_allreduce_fn(cx.rank_axis, topo, _mesh_id(),
@@ -642,6 +696,10 @@ def neighbor_allreduce(x, **kwargs):
         ``BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas`` routes the schedule
         through the fused concurrent-RDMA kernel
         (``ops.pallas_kernels.fused_dynamic_neighbor_allreduce``).
+      * under ``set_weights_override(W)`` / ``weights_override(W)`` the
+        default mode mixes with the override matrix instead (traced data:
+        per-step repaired matrices from ``bluefog_tpu.resilience`` swap in
+        with zero recompilation); explicit arguments beat the override.
     """
     return synchronize(neighbor_allreduce_nonblocking(x, **kwargs))
 

@@ -37,6 +37,13 @@ _LANE = 128
 _SUBLANE = 8
 
 
+def _struct_vma(shape, dtype, axis_name):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset({axis_name}))
+    except TypeError:  # older JAX without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_rows(x2d, rows_mult: int):
     pad = (-x2d.shape[0]) % rows_mult
     if pad:
@@ -109,9 +116,9 @@ def _run_exchange(x2d, self_w, recv_w, size, offsets, axis_name, interpret):
     return pl.pallas_call(
         kernel,
         # vma: the output varies across the mesh axis (required when the
-        # enclosing shard_map checks varying-mesh-axes)
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype,
-                                       vma=frozenset({axis_name})),
+        # enclosing shard_map checks varying-mesh-axes); older JAX has no
+        # vma kwarg and no such check
+        out_shape=_struct_vma(x2d.shape, x2d.dtype, axis_name),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[

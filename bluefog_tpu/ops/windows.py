@@ -118,10 +118,12 @@ def _win_async_enabled() -> bool:
     return os.environ.get("BLUEFOG_WIN_ASYNC", "0") == "1"
 
 
-def _dispatch_win_op(run, result_of=None):
+def _dispatch_win_op(run, result_of=None, op_name: str = "win_op"):
     """Run ``run()`` inline (default) or on the service lane (async mode).
 
-    Returns an int handle valid for win_wait/win_poll either way."""
+    Returns an int handle valid for win_wait/win_poll either way.
+    ``op_name`` labels the service task: a failing async window op then
+    raises a ``ServiceTaskError`` carrying it (service.py)."""
     # suspend() gate (reference operations.cc:1392-1400): block before any
     # tracing/dispatch/enqueue, so a suspended context issues no put/get/
     # accumulate traffic.  This covers exactly the one-sided *transfer*
@@ -136,7 +138,8 @@ def _dispatch_win_op(run, result_of=None):
     # than a window-op caller (docs/faq.md).
     ctx().wait_if_suspended()
     if _win_async_enabled():
-        return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE)
+        return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE,
+                                             op_name=op_name)
     run()
     return _register_handle(result_of() if result_of else None)
 
@@ -477,7 +480,9 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
             (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
                 x, w.buffers, w.versions, w.p, w.p_buffers,
                 jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
-        return _dispatch_win_op(run, lambda: w.tensor)
+        return _dispatch_win_op(
+            run, lambda: w.tensor,
+            op_name="win_accumulate" if accumulate else "win_put")
 
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
@@ -489,7 +494,9 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
             x, w.buffers, w.versions, w.p, w.p_buffers,
             jnp.asarray(D, jnp.float32), jnp.asarray(sw),
             jnp.asarray(with_p))
-    return _dispatch_win_op(run, lambda: w.tensor)
+    return _dispatch_win_op(
+        run, lambda: w.tensor,
+        op_name="win_accumulate" if accumulate else "win_put")
 
 
 def win_put_nonblocking(tensor, name: str,
@@ -559,7 +566,8 @@ def win_get_nonblocking(name: str,
             (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
                 w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
                 jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
-        return _dispatch_win_op(run, lambda: w.buffers)
+        return _dispatch_win_op(run, lambda: w.buffers,
+                                op_name="win_get")
 
     G = _out_matrix(w.topo, src_weights)
     fn = _push_fn(w.topo, False, id(cx.mesh))
@@ -569,7 +577,7 @@ def win_get_nonblocking(name: str,
             w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
             jnp.asarray(G, jnp.float32),
             _self_weight_vector(w.topo.size, None), jnp.asarray(with_p))
-    return _dispatch_win_op(run, lambda: w.buffers)
+    return _dispatch_win_op(run, lambda: w.buffers, op_name="win_get")
 
 
 def win_get(name: str, src_weights=None, require_mutex: bool = False,
@@ -579,11 +587,28 @@ def win_get(name: str, src_weights=None, require_mutex: bool = False,
     return True
 
 
+def _liveness_masked_update(U, sw, alive):
+    """Zero the update rows of dead in-neighbors and move their mass to the
+    self weight (all jnp: ``alive`` may be a device-resident liveness mask
+    from ``resilience.membership`` — swapping masks never recompiles).
+
+    Window semantics under a death: a dead neighbor's buffer holds its LAST
+    delivered value forever; without masking, every ``win_update`` keeps
+    averaging that stale garbage with full weight.  Masking degrades the
+    edge to *bounded staleness*: the dead row's weight drops to zero, the
+    receiver keeps the mass itself, and total weight is preserved."""
+    a = jnp.asarray(alive, jnp.float32).reshape(-1)
+    U = jnp.asarray(U, jnp.float32)
+    sw = jnp.asarray(sw, jnp.float32)
+    lost = (U * (1.0 - a)[:, None]).sum(axis=0)
+    return U * a[:, None], sw + lost
+
+
 def win_update(name: str,
                self_weight: Optional[float] = None,
                neighbor_weights: Optional[np.ndarray] = None,
                reset: bool = False, clone: bool = False,
-               require_mutex: bool = False):
+               require_mutex: bool = False, alive=None):
     """Fold the neighbor buffers into the window tensor:
     ``t <- self_weight * t + sum_src U[src, rank] * buffer[src]``
     (mpi_ops.py:1066-1137; torch/mpi_win_ops.cc:345-427).
@@ -592,13 +617,22 @@ def win_update(name: str,
     dst)); defaults to topology weights when ``bf.init(is_weighted=True)``,
     else the uniform ``1/(in_degree+1)`` average.  Versions of the slots read
     drop to 0; ``reset`` zeroes those buffers after the computation.
+
+    ``alive`` (optional [N] mask, e.g. from ``resilience.membership``):
+    dead in-neighbors degrade to zero-weight rows with their mass absorbed
+    into the self weight — bounded staleness instead of averaging a dead
+    rank's frozen buffer forever.  The mask is traced data.
     """
     w = _window(name)
     cx = ctx()
     U, sw = _update_matrix(w.topo, self_weight, neighbor_weights)
+    U = jnp.asarray(U, jnp.float32)
+    sw = jnp.asarray(sw, jnp.float32)
+    if alive is not None:
+        U, sw = _liveness_masked_update(U, sw, alive)
     fn = _update_fn(w.topo, id(cx.mesh))
     out = fn(w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
-             jnp.asarray(U, jnp.float32), jnp.asarray(sw, jnp.float32),
+             U, sw,
              jnp.asarray(bool(reset)), jnp.asarray(_with_associated_p[0]))
     tensor_new = out[0]
     if clone:

@@ -2,7 +2,7 @@
 (gradient-allreduce, allreduce/neighbor/hierarchical CTA, ATC, AWC,
 win-put, pull-get, push-sum) over optax base transformations."""
 
-from .strategies import CommunicationType
+from .strategies import CommunicationType, with_degraded_guard
 from .wrappers import (
     DistributedGradientAllreduceOptimizer,
     DistributedAllreduceOptimizer,

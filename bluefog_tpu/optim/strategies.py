@@ -283,3 +283,31 @@ def local_sgd_like_step(base: optax.GradientTransformation):
         return optax.apply_updates(params, updates), opt_state
 
     return step_fn
+
+
+def with_degraded_guard(step_fn: Callable, local_step_fn: Callable):
+    """Skip-comm branch for degraded steps (resilience integration).
+
+    Returns ``guarded(params, grads, opt_state, step, degraded)``: when the
+    traced boolean ``degraded`` is set, the step takes the local-only
+    branch — no exchange is issued at all — instead of averaging through a
+    topology that membership currently distrusts (suspected stall, link
+    storm, watchdog-flagged stragglers; see ``resilience.membership``).
+
+    ``degraded`` is DATA: flipping it between steps reuses one compiled
+    program (both branches trace).  It must also be mesh-uniform — every
+    rank must take the same branch, or the live ranks' collectives deadlock
+    waiting on peers that skipped; derive it from replicated state (the
+    fault plan, a majority vote, the service watchdog), never from
+    rank-local values.  Per-EDGE degradation belongs in the mixing matrix
+    (``repair.repair_matrix_traced``), not here.
+    """
+
+    def guarded(params, grads, opt_state, step=0, degraded=False):
+        return jax.lax.cond(
+            jnp.asarray(degraded, bool),
+            lambda p, g, s: local_step_fn(p, g, s, step),
+            lambda p, g, s: step_fn(p, g, s, step),
+            params, grads, opt_state)
+
+    return guarded

@@ -32,6 +32,7 @@ __all__ = [
     "one_peer_send_rank",
     "dynamic_mixing_matrix",
     "dynamic_mixing_matrices",
+    "dynamic_mixing_matrices_with_liveness",
     "one_peer_offsets",
 ]
 
@@ -221,6 +222,20 @@ def dynamic_mixing_matrices(factory: GeneratorFactory, size: int,
         sends = [next(g)[0] for g in gens]
         mats.append(dynamic_mixing_matrix(size, sends))
     return np.stack(mats)
+
+
+def dynamic_mixing_matrices_with_liveness(factory: GeneratorFactory,
+                                          size: int, num_steps: int,
+                                          alive) -> np.ndarray:
+    """Liveness-masked variant of :func:`dynamic_mixing_matrices`: the
+    one-peer rule still rotates over the FULL rank set (so the schedule's
+    period and offset superset never change and compiled programs stay
+    valid), but steps touching dead ranks are repaired — the dead edge's
+    weight moves to the survivor's self loop (column-stochasticity
+    preserved; see ``resilience.repair.liveness_masked_matrices``)."""
+    from ..resilience.repair import liveness_masked_matrices
+    return liveness_masked_matrices(
+        dynamic_mixing_matrices(factory, size, num_steps), alive)
 
 
 def one_peer_offsets(factory: GeneratorFactory, size: int,

@@ -1,0 +1,46 @@
+"""Resilience subsystem: fault injection, liveness, matrix repair, chaos.
+
+The reference framework assumes a fixed healthy MPI world — one dead or slow
+rank stalls the job.  Here rank loss is a *matrix repair* problem: mixing
+matrices are traced data, so a repaired topology is just different numbers
+flowing through the same compiled program.  Four layers:
+
+* :mod:`~bluefog_tpu.resilience.faults` — deterministic, seeded fault plans
+  compiled to fixed-shape per-step tables (rank death, stragglers, flaky
+  links, value corruption); injectable into any step with zero recompiles.
+* :mod:`~bluefog_tpu.resilience.membership` — per-rank liveness beliefs as
+  device-resident state, maintained by heartbeat gossip over the topology's
+  own edges, with suspect/confirm staleness thresholds.
+* :mod:`~bluefog_tpu.resilience.repair` — mixing-matrix surgery: masking +
+  diagonal absorption (column-stochastic families), Hastings re-weighting
+  (doubly-stochastic families), disconnection fallback rings, and
+  liveness-masked dynamic one-peer schedules.
+* :mod:`~bluefog_tpu.resilience.harness` — a chaos harness that runs a
+  consensus training loop under a fault plan and reports loss/consensus
+  trajectories plus the per-step effective (repaired) mixing matrices.
+
+See ``docs/resilience.md`` and ``examples/chaos_training.py``.
+"""
+
+from .faults import (FaultEvent, FaultPlan, CompiledFaultPlan, empty_plan,
+                     random_plan)
+from .membership import (LivenessConfig, init_state, gossip_step,
+                         gossip_last_heard, belief_alive, belief_suspect,
+                         confirmed_dead_votes)
+from .repair import (repair_matrix, repair_matrix_traced, repair_topology,
+                     hastings_matrix, fallback_ring_matrix, spectral_gap,
+                     liveness_masked_matrices, liveness_masked_schedule,
+                     survivors_connected)
+from .harness import ChaosHarness, ChaosReport
+
+__all__ = [
+    "FaultEvent", "FaultPlan", "CompiledFaultPlan", "empty_plan",
+    "random_plan",
+    "LivenessConfig", "init_state", "gossip_step", "gossip_last_heard",
+    "belief_alive", "belief_suspect", "confirmed_dead_votes",
+    "repair_matrix", "repair_matrix_traced", "repair_topology",
+    "hastings_matrix", "fallback_ring_matrix", "spectral_gap",
+    "liveness_masked_matrices", "liveness_masked_schedule",
+    "survivors_connected",
+    "ChaosHarness", "ChaosReport",
+]

@@ -1,0 +1,226 @@
+"""Deterministic, seeded fault plans — injectable into any step as traced data.
+
+A :class:`FaultPlan` is a host-side description of what goes wrong and when:
+rank death at step k, straggler slow-down, flaky-link drops, value
+corruption.  :meth:`FaultPlan.compile` lowers it to fixed-shape per-step
+tables ([T, N] / [T, N, N]); jitted programs index the tables with the
+*traced* step, so injecting, editing, or clearing a fault between steps never
+changes program shape and never recompiles (asserted in
+``tests/test_resilience.py::test_fault_plans_do_not_recompile``).
+
+Conventions:
+
+* ``alive[t, i]``     1.0 while rank i is up at step t, 0.0 once down.
+* ``active[t, i]``    1.0 when rank i participates at step t.  Stragglers
+                      are alive but *intermittently* active: a factor-k
+                      straggler only joins every k-th step, so its peers see
+                      stale, late contributions — the SPMD analog of a slow
+                      MPI rank (a dead rank is never active).
+* ``link_ok[t, i, j]`` 1.0 when the i->j edge delivers at step t.
+* ``corrupt[t, i]``   multiplicative scale on rank i's *outgoing* value at
+                      step t (1.0 = clean; ``nan`` models bit-rot — the
+                      harness's finite-guard must catch it).
+
+Beyond the horizon T the plan holds its LAST state (tables are indexed with
+``min(step, T-1)``): a rank that dies stays dead, transient faults end.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FaultEvent", "FaultPlan", "CompiledFaultPlan", "empty_plan",
+           "random_plan"]
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One fault.  ``until`` is exclusive; ``None`` = rest of the run."""
+    kind: str                      # rank_down | straggler | flaky_link | corrupt
+    rank: int
+    step: int
+    until: Optional[int] = None
+    peer: Optional[int] = None     # flaky_link destination
+    factor: float = 1.0            # straggler period / corruption scale
+
+
+@dataclass(frozen=True)
+class CompiledFaultPlan:
+    """Fixed-shape per-step fault tables (see module docstring)."""
+    size: int
+    horizon: int
+    alive: np.ndarray        # [T, N] float32
+    active: np.ndarray       # [T, N] float32
+    link_ok: np.ndarray      # [T, N, N] float32
+    corrupt: np.ndarray      # [T, N] float32
+    events: Tuple[FaultEvent, ...] = ()
+
+    def tables(self) -> Dict[str, "np.ndarray"]:
+        """The tables as device arrays, ready to pass into a jitted step.
+
+        Every plan of the same ``(size, horizon)`` produces identically
+        shaped tables — swap plans freely between calls of one compiled
+        program."""
+        import jax.numpy as jnp
+        return {"alive": jnp.asarray(self.alive),
+                "active": jnp.asarray(self.active),
+                "link_ok": jnp.asarray(self.link_ok),
+                "corrupt": jnp.asarray(self.corrupt)}
+
+    def num_dead_at(self, step: int) -> int:
+        t = min(step, self.horizon - 1)
+        return int((self.alive[t] == 0).sum())
+
+
+def at_step(tables: Dict, step):
+    """Index the device tables with a traced step (clamped to the horizon).
+
+    Returns ``(alive[N], active[N], link_ok[N, N], corrupt[N])`` for the
+    step — all traced values; use inside jit."""
+    import jax.numpy as jnp
+    t = jnp.minimum(jnp.asarray(step, jnp.int32),
+                    tables["alive"].shape[0] - 1)
+    return (tables["alive"][t], tables["active"][t],
+            tables["link_ok"][t], tables["corrupt"][t])
+
+
+class FaultPlan:
+    """Builder for deterministic fault scenarios.
+
+    >>> plan = FaultPlan(size=8, horizon=40)
+    >>> plan.rank_down(3, at=10)                 # rank 3 dies at step 10
+    >>> plan.straggler(5, at=4, factor=3)        # rank 5 joins every 3rd step
+    >>> plan.flaky_link(0, 1, at=6, until=9)     # edge 0->1 drops for 3 steps
+    >>> plan.corrupt(2, at=7, scale=1e3)         # rank 2 emits garbage once
+    >>> tables = plan.compile().tables()
+    """
+
+    def __init__(self, size: int, horizon: int, seed: int = 0):
+        if size <= 0 or horizon <= 0:
+            raise ValueError(f"need size > 0 and horizon > 0, got "
+                             f"{size}, {horizon}")
+        self.size = size
+        self.horizon = horizon
+        self.seed = seed
+        self.events: List[FaultEvent] = []
+
+    # -- builders (all return self for chaining) ----------------------------
+
+    def _check(self, rank: int, step: int):
+        if not 0 <= rank < self.size:
+            raise ValueError(f"rank {rank} outside [0, {self.size})")
+        if step < 0:
+            raise ValueError(f"step {step} must be >= 0")
+
+    def rank_down(self, rank: int, at: int,
+                  until: Optional[int] = None) -> "FaultPlan":
+        """Rank stops participating at step ``at`` (forever unless
+        ``until`` — a bounce models checkpoint-rejoin scenarios)."""
+        self._check(rank, at)
+        self.events.append(FaultEvent("rank_down", rank, at, until))
+        return self
+
+    def straggler(self, rank: int, at: int, factor: int = 2,
+                  until: Optional[int] = None) -> "FaultPlan":
+        """Rank slows by ``factor``: it participates only every
+        ``factor``-th step while the fault is live."""
+        self._check(rank, at)
+        if factor < 1:
+            raise ValueError(f"straggler factor must be >= 1, got {factor}")
+        self.events.append(
+            FaultEvent("straggler", rank, at, until, factor=float(factor)))
+        return self
+
+    def flaky_link(self, src: int, dst: int, at: int,
+                   until: Optional[int] = None) -> "FaultPlan":
+        """The src->dst edge drops every step in [at, until)."""
+        self._check(src, at)
+        self._check(dst, at)
+        self.events.append(FaultEvent("flaky_link", src, at, until, peer=dst))
+        return self
+
+    def corrupt(self, rank: int, at: int, scale: float = float("nan"),
+                until: Optional[int] = None) -> "FaultPlan":
+        """Rank's outgoing values are scaled by ``scale`` (default NaN:
+        pure bit-rot) while the fault is live."""
+        self._check(rank, at)
+        self.events.append(
+            FaultEvent("corrupt", rank, at, until, factor=float(scale)))
+        return self
+
+    # -- lowering -----------------------------------------------------------
+
+    def _window(self, ev: FaultEvent) -> Tuple[int, int]:
+        lo = min(ev.step, self.horizon)
+        hi = self.horizon if ev.until is None else min(ev.until, self.horizon)
+        return lo, max(hi, lo)
+
+    def compile(self) -> CompiledFaultPlan:
+        T, N = self.horizon, self.size
+        alive = np.ones((T, N), np.float32)
+        active = np.ones((T, N), np.float32)
+        link_ok = np.ones((T, N, N), np.float32)
+        corrupt = np.ones((T, N), np.float32)
+        for ev in self.events:
+            lo, hi = self._window(ev)
+            if ev.kind == "rank_down":
+                alive[lo:hi, ev.rank] = 0.0
+            elif ev.kind == "straggler":
+                k = int(ev.factor)
+                for t in range(lo, hi):
+                    if (t - lo) % k != 0:
+                        active[t, ev.rank] = 0.0
+            elif ev.kind == "flaky_link":
+                link_ok[lo:hi, ev.rank, ev.peer] = 0.0
+            elif ev.kind == "corrupt":
+                corrupt[lo:hi, ev.rank] = ev.factor
+            else:  # pragma: no cover — builders gate the kinds
+                raise ValueError(f"unknown fault kind {ev.kind!r}")
+        active *= alive  # dead ranks are never active
+        return CompiledFaultPlan(size=N, horizon=T, alive=alive,
+                                 active=active, link_ok=link_ok,
+                                 corrupt=corrupt, events=tuple(self.events))
+
+
+def empty_plan(size: int, horizon: int) -> CompiledFaultPlan:
+    """A fault-free plan (same table shapes: swap in for a clean run
+    without recompiling)."""
+    return FaultPlan(size, horizon).compile()
+
+
+def random_plan(size: int, horizon: int, seed: int = 0,
+                p_down: float = 0.1, p_straggler: float = 0.1,
+                p_flaky: float = 0.05, p_corrupt: float = 0.05,
+                max_dead: Optional[int] = None) -> FaultPlan:
+    """A seeded random scenario — same seed, same faults, every run.
+
+    Per-rank Bernoulli draws decide which faults appear; onset steps,
+    durations, and factors are drawn uniformly.  ``max_dead`` caps the
+    number of permanently-dead ranks (default: minority, ``(size-1)//2``),
+    so survivors always hold a quorum."""
+    rng = np.random.default_rng(seed)
+    plan = FaultPlan(size, horizon, seed=seed)
+    cap = (size - 1) // 2 if max_dead is None else max_dead
+    dead = 0
+    for r in range(size):
+        if dead < cap and rng.random() < p_down:
+            plan.rank_down(r, at=int(rng.integers(1, max(2, horizon // 2))))
+            dead += 1
+            continue
+        if rng.random() < p_straggler:
+            plan.straggler(r, at=int(rng.integers(0, horizon)),
+                           factor=int(rng.integers(2, 5)))
+        if rng.random() < p_corrupt:
+            at = int(rng.integers(0, horizon))
+            plan.corrupt(r, at=at, until=at + 1,
+                         scale=float(rng.choice([np.nan, 1e3, -1e2])))
+    n_links = int(p_flaky * size * size)
+    for _ in range(n_links):
+        s, d = rng.integers(0, size, 2)
+        if s == d:
+            continue
+        at = int(rng.integers(0, horizon))
+        plan.flaky_link(int(s), int(d), at=at,
+                        until=at + int(rng.integers(1, 4)))
+    return plan

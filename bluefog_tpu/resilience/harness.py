@@ -1,0 +1,307 @@
+"""Chaos-test harness: run a training loop under a fault plan and check
+that the decentralized algorithm degrades gracefully.
+
+The harness compiles ONE jitted SPMD chaos step containing the full
+resilience loop — fault injection, heartbeat gossip, per-rank liveness
+beliefs, traced matrix repair, the consensus update (``optim.strategies``
+CTA semantics: mix the weights, adapt from local gradients), and survivor
+freezing — with every per-step quantity (step index, fault tables) as
+traced data.  Injecting, moving, or clearing faults between steps therefore
+never recompiles (``tests/test_resilience.py`` asserts the compile count).
+
+What a step does, per rank j:
+
+1. gossip heartbeats over the topology's edges (``membership``), masked by
+   this step's liveness/link tables (``faults``);
+2. build j's receive column from its OWN beliefs: in-weights of peers it
+   has confirmed dead (or that dropped out / sent non-finite values this
+   step) go to zero and the lost mass moves to j's self weight
+   (``repair.repair_matrix_traced`` semantics, computed per column);
+3. mix the gathered neighbor values with that column, then apply the local
+   optimizer update at the mixed point (consensus/CTA);
+4. freeze: inactive ranks keep their old parameters and optimizer state.
+
+The per-rank columns are also emitted as the step's effective global mixing
+matrix so the report can assert stochasticity invariants.
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import timeline as _tl
+from ..context import ctx
+from ..ops import collectives as C
+from ..parallel.schedule import CompiledTopology
+from . import faults as _faults
+from . import membership as _mem
+
+__all__ = ["ChaosHarness", "ChaosReport"]
+
+
+@dataclass
+class ChaosReport:
+    """Trajectories and final state of one chaos run."""
+    losses: np.ndarray            # [T] survivor-mean loss per step
+    consensus_errors: np.ndarray  # [T] survivor RMS distance to survivor mean
+    dead_votes: np.ndarray        # [T, N] confirmed-dead votes per step
+    mixing_matrices: np.ndarray   # [T, N, N] effective repaired W per step
+    alive_steps: np.ndarray       # [T, N] plan liveness at each run step
+    params_final: object          # global-view parameter tree
+    events: List[str] = field(default_factory=list)
+
+    @property
+    def alive_final(self) -> np.ndarray:
+        return self.alive_steps[-1]
+
+    @property
+    def confirmed_dead(self) -> np.ndarray:
+        """Ranks a survivor majority had confirmed dead by the end."""
+        n = self.dead_votes.shape[1]
+        n_alive = int(self.alive_final.sum())
+        return np.nonzero(self.dead_votes[-1] > n_alive // 2)[0]
+
+    def check_matrix_invariants(self, step: int = -1, atol: float = 1e-5):
+        """Assert the step's effective matrix is column-stochastic,
+        non-negative, and carries zero weight to/from ranks dead AT THAT
+        STEP (a rank that dies mid-run legitimately mixes before its
+        death)."""
+        W = self.mixing_matrices[step]
+        np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=atol,
+                                   err_msg="columns must sum to 1")
+        assert (W >= -atol).all(), "negative mixing weight after repair"
+        dead = np.nonzero(self.alive_steps[step] == 0)[0]
+        for r in dead:
+            off_col = np.delete(W[:, r], r)
+            assert np.allclose(off_col, 0.0, atol=atol), \
+                f"dead rank {r} still receives weight"
+            off_row = np.delete(W[r, :], r)
+            assert np.allclose(off_row, 0.0, atol=atol), \
+                f"dead rank {r} still contributes weight"
+
+    def assert_bounded(self, max_consensus_error: float,
+                       settle_frac: float = 0.5):
+        """Assert the survivor consensus error stays bounded over the run
+        and is still bounded at the end (no divergence after faults)."""
+        tail = self.consensus_errors[int(len(self.consensus_errors)
+                                         * settle_frac):]
+        assert np.isfinite(self.losses).all(), "loss went non-finite"
+        assert np.isfinite(self.consensus_errors).all(), \
+            "consensus error went non-finite"
+        assert float(tail.max()) <= max_consensus_error, (
+            f"consensus error {tail.max():.4g} exceeded bound "
+            f"{max_consensus_error:.4g} after faults")
+
+
+def _default_quadratic(params, target):
+    """Per-rank quadratic: minimizing the survivor mean drives consensus
+    toward the mean target — heterogeneous per-rank objectives, the
+    standard decentralized-SGD testbed."""
+    return 0.5 * jnp.sum((params - target) ** 2)
+
+
+class ChaosHarness:
+    """Wraps a ``training.py``-style consensus loop with a fault plan.
+
+    ``plan`` is a :class:`~bluefog_tpu.resilience.faults.FaultPlan` (or an
+    already-compiled one).  ``loss_fn(params_local, batch_local)`` defaults
+    to a per-rank quadratic toward seeded targets.  ``base_opt`` defaults
+    to SGD(0.1).  Thresholds come from ``cfg``
+    (:class:`~bluefog_tpu.resilience.membership.LivenessConfig`).
+    """
+
+    def __init__(self, plan, *, base_opt=None,
+                 topo: Optional[CompiledTopology] = None,
+                 cfg: Optional[_mem.LivenessConfig] = None,
+                 loss_fn: Optional[Callable] = None):
+        if isinstance(plan, _faults.FaultPlan):
+            plan = plan.compile()
+        self.plan: _faults.CompiledFaultPlan = plan
+        self.cx = ctx()
+        if plan.size != self.cx.size:
+            raise ValueError(
+                f"fault plan is over {plan.size} ranks, mesh has "
+                f"{self.cx.size}")
+        self.topo = topo or self.cx.compiled_topology
+        self.cfg = cfg or _mem.LivenessConfig()
+        self.base_opt = base_opt or optax.sgd(0.1)
+        self.loss_fn = loss_fn or _default_quadratic
+        self._step_fn = None
+
+    # -- the one jitted chaos step ------------------------------------------
+
+    def _build_step(self):
+        cx, topo, cfg = self.cx, self.topo, self.cfg
+        base_opt, loss_fn = self.base_opt, self.loss_fn
+        axis = cx.rank_axis
+        n = topo.size
+        W0 = topo.weight_matrix
+        spec = P(axis)
+
+        def shard_fn(p_s, opt_s, lh_s, batch_s, step, alive, active,
+                     link_ok, corrupt):
+            x = jax.tree.map(lambda a: a[0], p_s)
+            st = jax.tree.map(lambda a: a[0], opt_s)
+            b = jax.tree.map(lambda a: a[0], batch_s)
+            row = lh_s[0]
+            idx = lax.axis_index(axis)
+
+            # 1. membership gossip over the live edges
+            row = _mem.gossip_last_heard(row, axis, topo, step, active,
+                                         link_ok)
+            stale = jnp.asarray(step, jnp.int32) - row
+            trusted = (stale <= cfg.suspect_after)     # fresh enough to mix
+            confirmed_dead = (stale > cfg.confirm_after)
+
+            # 2. local loss/grads at the pre-mix point (consensus/CTA)
+            loss, grads = jax.value_and_grad(loss_fn)(x, b)
+
+            # 3. outgoing values: corruption rides the wire; receivers
+            #    drop non-finite contributions (finite-guard)
+            out_x = jax.tree.map(
+                lambda l: l * corrupt[idx].astype(l.dtype), x)
+            finite_own = jnp.asarray(True)
+            for leaf in jax.tree.leaves(out_x):
+                finite_own &= jnp.isfinite(leaf).all()
+            gathered = jax.tree.map(lambda l: C.allgather(l[None], axis),
+                                    out_x)
+            finite = C.allgather(finite_own[None], axis)      # [N]
+
+            # 4. this rank's repaired receive column (traced surgery):
+            #    zero anything dead/suspect/inactive/dropped/non-finite,
+            #    self weight absorbs the lost mass
+            col = jnp.asarray(W0)[:, idx]
+            # trusted already excludes confirmed-dead peers (suspect_after
+            # <= confirm_after by LivenessConfig)
+            keep = trusted & (active > 0) & (link_ok[:, idx] > 0) & finite
+            col = jnp.where(keep, col, 0.0).at[idx].set(0.0)
+            self_w = 1.0 - col.sum()
+            col = col.at[idx].set(self_w)
+
+            # 5. mix, then adapt at the mixed point.  The self term uses
+            #    the LOCAL clean value, not the (possibly corrupted)
+            #    outgoing one — corruption rides the wire, it does not
+            #    poison the sender's own state
+            neigh_col = col.at[idx].set(0.0)
+            # zero-weight is not enough against NaN (0 * NaN = NaN): scrub
+            # non-finite contributions out of the gathered values too
+            mixed = jax.tree.map(
+                lambda g, l: (jnp.tensordot(
+                    neigh_col.astype(l.dtype),
+                    jnp.where(jnp.isfinite(g), g, 0), axes=1)
+                              + self_w.astype(l.dtype) * l),
+                gathered, x)
+            updates, st_new = base_opt.update(grads, st, mixed)
+            x_new = optax.apply_updates(mixed, updates)
+
+            # 6. freeze inactive ranks (dead or straggling this step); their
+            #    effective receive column is identity — they keep their value
+            me_active = active[idx] > 0
+            x_new = jax.tree.map(
+                lambda new, old: jnp.where(me_active, new, old), x_new, x)
+            st_new = jax.tree.map(
+                lambda new, old: jnp.where(me_active, new, old), st_new, st)
+            col = jnp.where(me_active, col,
+                            jnp.zeros_like(col).at[idx].set(1.0))
+
+            votes = confirmed_dead.astype(jnp.int32)          # my view
+            lead = lambda t: jax.tree.map(lambda a: a[None], t)
+            return (lead(x_new), lead(st_new), row[None], loss[None],
+                    col[None], votes[None])
+
+        def stepper(params, opt_state, last_heard, batch, step, tables):
+            alive, active, link_ok, corrupt = _faults.at_step(tables, step)
+            p2, o2, lh2, loss_r, cols, votes = jax.shard_map(
+                shard_fn, mesh=cx.mesh,
+                in_specs=(spec, spec, spec, spec, P(), P(), P(), P(), P()),
+                out_specs=(spec, spec, spec, spec, spec, spec),
+            )(params, opt_state, last_heard, batch,
+              jnp.asarray(step, jnp.int32), alive, active, link_ok, corrupt)
+            # survivor metrics (active-weighted)
+            wsum = jnp.maximum(active.sum(), 1.0)
+            loss_mean = (loss_r * active).sum() / wsum
+            flat = jnp.concatenate(
+                [l.reshape(n, -1) for l in jax.tree.leaves(p2)], axis=1)
+            mean = (flat * active[:, None]).sum(0) / wsum
+            dist2 = ((flat - mean[None]) ** 2).sum(1)
+            cons = jnp.sqrt((dist2 * active).sum() / wsum)
+            W_eff = cols.T                       # cols[j] is column j
+            dead_votes = votes.sum(axis=0)
+            return p2, o2, lh2, loss_mean, cons, W_eff, dead_votes
+
+        return jax.jit(stepper)
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self, params0, *, steps: int, batches=None,
+            opt_state=None) -> ChaosReport:
+        """Run ``steps`` chaos steps from global-view ``params0`` [N, ...].
+
+        ``batches``: optional callable ``step -> global batch`` (defaults
+        to seeded per-rank quadratic targets held constant).  Returns a
+        :class:`ChaosReport`; fault onsets and majority-confirmed deaths
+        are recorded on the timeline as host activities."""
+        from ..ops import api as _api
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        n = self.plan.size
+        params = jax.tree.map(lambda a: _api.to_global(jnp.asarray(a)),
+                              params0)
+        if opt_state is None:
+            opt_state = jax.vmap(self.base_opt.init)(params)
+        if batches is None:
+            lead = jax.tree.leaves(params)[0]
+            rng = np.random.default_rng(self.plan.horizon + 17 * n)
+            targets = jnp.asarray(
+                rng.normal(size=lead.shape).astype(np.float32) * 2.0)
+            batch_of = lambda _t: targets
+        else:
+            batch_of = batches
+        tables = self.plan.tables()
+        state = _mem.init_state(n)["last_heard"]
+        state = _api.to_global(state)
+
+        events = [f"plan: {ev.kind} rank={ev.rank} step={ev.step}"
+                  for ev in getattr(self.plan, "events", [])]
+        _tl.record_resilience_event("chaos_run_start",
+                                    f"{steps} steps, {n} ranks")
+        losses, cons, votes_t, mats = [], [], [], []
+        announced = set()
+        for t in range(steps):
+            (params, opt_state, state, loss, ce, W_eff,
+             votes) = self._step_fn(params, opt_state, state,
+                                    batch_of(t), t, tables)
+            losses.append(float(loss))
+            cons.append(float(ce))
+            votes_np = np.asarray(votes)
+            votes_t.append(votes_np)
+            mats.append(np.asarray(W_eff))
+            n_alive = int(self.plan.alive[min(t, self.plan.horizon - 1)]
+                          .sum())
+            for r in np.nonzero(votes_np > n_alive // 2)[0]:
+                if r not in announced:
+                    announced.add(int(r))
+                    msg = f"rank {r} confirmed dead at step {t}; " \
+                          f"mixing matrix repaired"
+                    events.append(msg)
+                    _tl.record_resilience_event("repair", msg)
+        _tl.record_resilience_event("chaos_run_end",
+                                    f"final consensus error {cons[-1]:.3g}")
+        return ChaosReport(
+            losses=np.asarray(losses),
+            consensus_errors=np.asarray(cons),
+            dead_votes=np.stack(votes_t),
+            mixing_matrices=np.stack(mats),
+            alive_steps=np.stack(
+                [self.plan.alive[min(t, self.plan.horizon - 1)]
+                 for t in range(steps)]),
+            params_final=params,
+            events=events,
+        )

@@ -1,0 +1,172 @@
+"""Per-rank liveness/health masks as device-resident state.
+
+There is no failure detector oracle in a decentralized system: each rank can
+only *infer* peer health from what arrives over its in-edges.  The state here
+is a global-view ``last_heard[N, N]`` table (row j = rank j's most recent
+heartbeat step observed for every peer), maintained gossip-style with the
+same circulant ``ppermute`` exchanges the neighbor collectives use: every
+step each active rank stamps its own entry with the current step and
+max-merges the tables arriving from its in-neighbors, so heartbeat knowledge
+spreads along graph edges at one hop per step (SWIM-style dissemination,
+bulk-synchronous flavor).
+
+Two configurable thresholds grade staleness (suspect/confirm, the classic
+accrual-detector split):
+
+* ``suspect_after``  — peers this stale are *suspected*: keep their last
+  value out of fresh averages (skip-comm / degraded branch,
+  ``optim.strategies.with_degraded_guard``) but don't rewire yet.
+* ``confirm_after``  — peers this stale are *confirmed dead*: mixing-matrix
+  surgery (``resilience.repair``) removes them and renormalizes.
+
+Everything is traced data — the tables ride inside jitted programs, so
+liveness transitions never recompile.
+"""
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.schedule import CompiledTopology
+
+__all__ = ["LivenessConfig", "init_state", "gossip_last_heard",
+           "gossip_step", "belief_alive", "belief_suspect",
+           "confirmed_dead_votes"]
+
+
+class LivenessConfig:
+    """Staleness thresholds, in steps."""
+
+    def __init__(self, suspect_after: int = 2, confirm_after: int = 4):
+        if not 0 < suspect_after <= confirm_after:
+            raise ValueError(
+                f"need 0 < suspect_after <= confirm_after, got "
+                f"{suspect_after}, {confirm_after}")
+        self.suspect_after = suspect_after
+        self.confirm_after = confirm_after
+
+
+def init_state(size: int) -> Dict[str, jnp.ndarray]:
+    """Fresh liveness state: everyone heard from everyone at step 0."""
+    return {"last_heard": jnp.zeros((size, size), jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Axis-level gossip (call inside shard_map, like ops.collectives)
+# ---------------------------------------------------------------------------
+
+def gossip_last_heard(row, axis_name, topo: CompiledTopology, step,
+                      active, link_ok):
+    """One gossip round for this rank's ``last_heard`` row ([N] int32).
+
+    ``active`` ([N], traced) marks ranks participating this step;
+    ``link_ok`` ([N, N], traced) marks edges delivering this step.  Dead or
+    inactive senders and dropped links contribute nothing — their entries
+    simply stop advancing, which is exactly how the staleness thresholds
+    see them."""
+    from ..ops.collectives import _rotation_pairs
+    size = topo.size
+    idx = lax.axis_index(axis_name)
+    step = jnp.asarray(step, jnp.int32)
+    # own heartbeat: stamp only while participating (a straggler's entry
+    # advances on its active steps, a dead rank's never does)
+    row = row.at[idx].set(
+        jnp.where(active[idx] > 0, jnp.maximum(row[idx], step), row[idx]))
+    ar = jnp.arange(size)
+    for shift in topo.shifts:
+        received = lax.ppermute(row, axis_name,
+                                _rotation_pairs(size, shift.offset))
+        src = (idx - shift.offset) % size
+        # static edge mask: ppermute rotates ALL ranks; only real edges of
+        # this offset may merge (non-destinations receive zeros)
+        has_edge = jnp.asarray(shift.recv_weights != 0)[idx]
+        valid = has_edge & (active[src] > 0) & (link_ok[src, idx] > 0)
+        row = jnp.where(valid, jnp.maximum(row, received), row)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Global-view convenience wrapper (one jitted SPMD program per topology)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _gossip_fn(axis, topo: CompiledTopology, mesh_id):
+    from ..context import ctx
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(last_heard, step, active, link_ok):
+        def shard_fn(rows, step_s, active_s, link_s):
+            return gossip_last_heard(rows[0], axis, topo, step_s,
+                                     active_s, link_s)[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh, in_specs=(spec, P(), P(), P()),
+            out_specs=spec,
+        )(last_heard, step, active, link_ok)
+    return jax.jit(wrapper)
+
+
+def gossip_step(state: Dict[str, jnp.ndarray], step,
+                active=None, link_ok=None,
+                topo: Optional[CompiledTopology] = None
+                ) -> Dict[str, jnp.ndarray]:
+    """Run one gossip round over the context topology (or ``topo``).
+
+    ``step``/``active``/``link_ok`` are data — calling this every step with
+    changing faults reuses one compiled program."""
+    from ..context import ctx
+    from ..ops import api as _api
+    cx = ctx()
+    topo = topo or cx.compiled_topology
+    n = topo.size
+    if active is None:
+        active = jnp.ones((n,), jnp.float32)
+    if link_ok is None:
+        link_ok = jnp.ones((n, n), jnp.float32)
+    fn = _gossip_fn(cx.rank_axis, topo, id(cx.mesh))
+    last = jax.device_put(jnp.asarray(state["last_heard"], jnp.int32),
+                          _api.rank_sharding())
+    new = fn(last, jnp.asarray(step, jnp.int32),
+             jnp.asarray(active, jnp.float32),
+             jnp.asarray(link_ok, jnp.float32))
+    return {"last_heard": new}
+
+
+# ---------------------------------------------------------------------------
+# Belief masks (traced; usable on host or inside jit)
+# ---------------------------------------------------------------------------
+
+def _staleness(last_heard, step):
+    return jnp.asarray(step, jnp.int32) - jnp.asarray(last_heard, jnp.int32)
+
+def belief_alive(last_heard, step, cfg: LivenessConfig):
+    """``B[i, j] = 1`` iff rank j believes rank i is alive (not yet
+    *confirmed* dead).  Column j is j's receive mask — feed it to
+    ``repair.repair_matrix_traced``."""
+    return (_staleness(last_heard, step).T
+            <= cfg.confirm_after).astype(jnp.float32)
+
+
+def belief_suspect(last_heard, step, cfg: LivenessConfig):
+    """``S[i, j] = 1`` iff rank j *suspects* rank i (stale beyond
+    ``suspect_after`` but not yet confirmed dead)."""
+    st = _staleness(last_heard, step).T
+    return ((st > cfg.suspect_after)
+            & (st <= cfg.confirm_after)).astype(jnp.float32)
+
+
+def confirmed_dead_votes(last_heard, step, cfg: LivenessConfig):
+    """Per-rank vote count: how many ranks have confirmed each peer dead.
+
+    ``votes[i] > alive_majority`` is the aggregation a coordinator (or the
+    chaos harness's report) uses to declare a single global death — the
+    mixing itself never needs this, each column repairs from its own
+    belief."""
+    st = _staleness(last_heard, step)
+    dead_view = (st > cfg.confirm_after)          # [viewer, peer]
+    return dead_view.sum(axis=0).astype(jnp.int32)

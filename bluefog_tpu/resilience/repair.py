@@ -1,0 +1,241 @@
+"""Mixing-matrix surgery: repair topologies around dead ranks.
+
+Decentralized averaging converges at a rate governed by the mixing matrix's
+spectral gap (exponential-graph analysis, arXiv:2110.13363) — so surviving
+rank loss is a *matrix repair* problem: zero the dead rows/columns, give the
+lost mass somewhere principled to keep the stochasticity invariant of the
+topology family, and keep the survivor subgraph connected so the gap stays
+positive.  Because topologies here are virtual graphs over a physical mesh,
+repair may also *rewire*: when deaths disconnect the survivors (e.g. a star
+losing its center), any replacement edge set is physically available, and
+the fallback ring restores connectivity.
+
+Two implementations:
+
+* **Host (numpy)** — :func:`repair_matrix` / :func:`repair_topology`, full
+  policy surface (column vs doubly-stochastic families, Hastings
+  re-weighting, disconnection fallback).  Use when membership *confirms* a
+  death and the run re-plans its compiled topology.
+* **Traced (jnp)** — :func:`repair_matrix_traced`, the jit-safe subset
+  (masking + diagonal absorption).  Use inside a step program with liveness
+  beliefs as data: per-step repair with zero recompilation.
+
+Column convention throughout (``parallel/topology.py``): ``W[i, j]`` is the
+weight receiver j applies to i's value; columns sum to 1.
+"""
+
+from typing import Optional
+
+import numpy as np
+import networkx as nx
+
+from ..parallel.schedule import (CompiledTopology, DynamicSchedule,
+                                 compile_dynamic_matrices,
+                                 compile_weight_matrix)
+
+__all__ = ["repair_matrix", "repair_matrix_traced", "repair_topology",
+           "hastings_matrix", "fallback_ring_matrix", "spectral_gap",
+           "liveness_masked_matrices", "liveness_masked_schedule",
+           "survivors_connected"]
+
+
+def _alive_bool(alive, n: int) -> np.ndarray:
+    a = np.asarray(alive).astype(bool).reshape(-1)
+    if a.shape != (n,):
+        raise ValueError(f"alive mask must be [{n}], got {a.shape}")
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Traced path (jit-safe; masking + diagonal absorption)
+# ---------------------------------------------------------------------------
+
+def repair_matrix_traced(W0, belief=None, alive=None, link_ok=None):
+    """Column-stochastic repair with everything as traced data.
+
+    ``W0`` [N, N] is the healthy mixing matrix.  Optional masks (all
+    multiplicative on the off-diagonal):
+
+    * ``belief`` [N, N] — ``membership.belief_alive``: entry (i, j) keeps
+      i's weight in j's column only while j believes i alive (each column
+      repairs from its OWN belief — no global agreement required).
+    * ``alive`` [N] — ground-truth/plan mask; drops every edge touching a
+      dead rank on both sides (rows *and* columns), so the reported matrix
+      carries zero weight to and from the dead.
+    * ``link_ok`` [N, N] — per-step link drops.
+
+    The mass removed from a column is absorbed into its diagonal, keeping
+    every column summing to exactly 1 (a fully-masked column degrades to
+    identity: the rank keeps its value — bounded-staleness behavior, not
+    stale-garbage averaging).
+    """
+    import jax.numpy as jnp
+    W0 = jnp.asarray(W0)
+    n = W0.shape[0]
+    eye = jnp.eye(n, dtype=W0.dtype)
+    mask = jnp.ones_like(W0)
+    if belief is not None:
+        mask = mask * jnp.asarray(belief, W0.dtype)
+    if link_ok is not None:
+        mask = mask * jnp.asarray(link_ok, W0.dtype)
+    if alive is not None:
+        a = jnp.asarray(alive, W0.dtype)
+        mask = mask * (a[:, None] * a[None, :])
+    off = W0 * mask * (1 - eye)
+    return off + jnp.diag(1.0 - off.sum(axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Host path (full policy)
+# ---------------------------------------------------------------------------
+
+def survivors_connected(W: np.ndarray, alive) -> bool:
+    """True when the surviving off-diagonal edge set is strongly connected
+    (single survivor counts as connected)."""
+    W = np.asarray(W)
+    alive = _alive_bool(alive, W.shape[0])
+    idx = np.nonzero(alive)[0]
+    if len(idx) <= 1:
+        return True
+    sub = (W[np.ix_(idx, idx)] != 0)
+    np.fill_diagonal(sub, False)
+    return nx.is_strongly_connected(
+        nx.from_numpy_array(sub, create_using=nx.DiGraph))
+
+
+def hastings_matrix(adj: np.ndarray) -> np.ndarray:
+    """Metropolis–Hastings weights for a symmetric adjacency: ``W[i, j] =
+    1 / max(deg_i, deg_j)`` on edges (degrees counted including self, the
+    ``MeshGrid2DGraph`` convention), diagonal absorbs the remainder.
+    Symmetric input gives a symmetric doubly-stochastic output — the
+    re-weighting rule for irregular survivor graphs."""
+    A = np.asarray(adj).astype(bool).copy()
+    if not np.array_equal(A, A.T):
+        raise ValueError("Hastings re-weighting needs a symmetric adjacency")
+    np.fill_diagonal(A, False)
+    n = A.shape[0]
+    deg = A.sum(axis=1) + 1
+    W = np.zeros((n, n))
+    pair = np.maximum(deg[:, None], deg[None, :])
+    W[A] = 1.0 / pair[A]
+    np.fill_diagonal(W, 1.0 - W.sum(axis=1))
+    return W
+
+
+def fallback_ring_matrix(size: int, alive) -> np.ndarray:
+    """Bidirectional ring over the survivors (in rank order), identity for
+    the dead — the last-resort rewiring when deaths disconnect the virtual
+    topology (every edge is physically available on the mesh)."""
+    alive = _alive_bool(alive, size)
+    idx = np.nonzero(alive)[0]
+    W = np.eye(size)
+    k = len(idx)
+    if k <= 1:
+        return W
+    if k == 2:
+        i, j = idx
+        W[np.ix_(idx, idx)] = 0.5
+        return W
+    for pos, j in enumerate(idx):
+        left, right = idx[(pos - 1) % k], idx[(pos + 1) % k]
+        W[j, j] = 1.0 / 3.0
+        W[left, j] = 1.0 / 3.0
+        W[right, j] = 1.0 / 3.0
+    return W
+
+
+def repair_matrix(W: np.ndarray, alive, family: str = "auto") -> np.ndarray:
+    """Repair a mixing matrix around dead ranks (host path).
+
+    Families:
+
+    * ``"column"`` — zero dead rows/columns, absorb each column's lost mass
+      into its diagonal.  Preserves column-stochasticity for any topology.
+    * ``"doubly"`` — Hastings re-weighting over the surviving symmetric
+      adjacency: preserves *double* stochasticity (symmetric families:
+      MeshGrid2D, symmetric rings) even when survivors end up with
+      irregular degrees.
+    * ``"auto"`` — ``"doubly"`` when W is symmetric, else ``"column"``.
+
+    Whatever the family, if the deaths disconnect the survivors the repair
+    falls back to a ring over them (see :func:`fallback_ring_matrix`) —
+    a disconnected mixing matrix has spectral gap zero and consensus never
+    contracts.  Dead ranks keep identity columns; every returned matrix is
+    column-stochastic with zero weight to and from the dead.
+    """
+    W = np.asarray(W, np.float64)
+    n = W.shape[0]
+    alive = _alive_bool(alive, n)
+    if alive.all():
+        return W.copy()
+    if not survivors_connected(W, alive):
+        return fallback_ring_matrix(n, alive)
+    if family == "auto":
+        family = "doubly" if np.allclose(W, W.T, atol=1e-12) else "column"
+    if family == "doubly":
+        A = (W != 0) & (W.T != 0)         # surviving undirected edges
+        A &= alive[:, None] & alive[None, :]
+        if not survivors_connected(A.astype(float), alive):
+            return fallback_ring_matrix(n, alive)
+        R = hastings_matrix(A | np.eye(n, dtype=bool))
+        # dead ranks: identity column/row (Hastings gave them diag 1 already
+        # since they have no surviving edges)
+        return R
+    if family != "column":
+        raise ValueError(f"unknown repair family {family!r}")
+    mask = (alive[:, None] & alive[None, :]).astype(np.float64)
+    off = W * mask
+    np.fill_diagonal(off, 0.0)
+    out = off + np.diag(1.0 - off.sum(axis=0))
+    return out
+
+
+def repair_topology(topo: CompiledTopology, alive,
+                    family: str = "auto") -> CompiledTopology:
+    """Compile the repaired matrix of a topology — the host-side re-plan
+    once membership *confirms* a death (one recompilation per membership
+    change; per-step suspicion uses the traced path instead)."""
+    return compile_weight_matrix(repair_matrix(topo.weight_matrix, alive,
+                                               family))
+
+
+def spectral_gap(W: np.ndarray, alive=None) -> float:
+    """``1 - |lambda_2|`` of the survivor submatrix (1.0 for a single
+    survivor).  Positive gap <=> consensus contracts among survivors."""
+    W = np.asarray(W, np.float64)
+    if alive is not None:
+        idx = np.nonzero(_alive_bool(alive, W.shape[0]))[0]
+        W = W[np.ix_(idx, idx)]
+    if W.shape[0] <= 1:
+        return 1.0
+    lam = np.sort(np.abs(np.linalg.eigvals(W)))[::-1]
+    return float(1.0 - lam[1])
+
+
+# ---------------------------------------------------------------------------
+# Liveness-aware dynamic schedules
+# ---------------------------------------------------------------------------
+
+def liveness_masked_matrices(mats: np.ndarray, alive) -> np.ndarray:
+    """Apply column repair to every step of a ``[T, N, N]`` matrix stack:
+    dead ranks drop out of each step's exchange, each column's lost mass
+    goes to its diagonal.  A step whose only in-peer died degrades to a
+    local step for that rank — bounded staleness, never garbage."""
+    mats = np.asarray(mats, np.float64)
+    alive = _alive_bool(alive, mats.shape[1])
+    mask = (alive[:, None] & alive[None, :]).astype(np.float64)
+    out = mats * mask[None]
+    for t in range(out.shape[0]):
+        np.fill_diagonal(out[t], 0.0)
+        out[t] += np.diag(1.0 - out[t].sum(axis=0))
+    return out
+
+
+def liveness_masked_schedule(sched: DynamicSchedule,
+                             alive) -> DynamicSchedule:
+    """Liveness-aware variant of a compiled dynamic one-peer schedule: the
+    repaired schedule keeps the period and an offset subset, so it drops
+    into every ``sched=`` consumer (``neighbor_allreduce``, window ops,
+    ``make_train_step``)."""
+    return compile_dynamic_matrices(
+        liveness_masked_matrices(sched.matrices, alive))

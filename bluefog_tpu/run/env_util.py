@@ -57,6 +57,89 @@ def append_xla_flag(env: Dict[str, str], flag: str) -> Dict[str, str]:
     return env
 
 
+_FLAG_PROBE_CACHE: Dict[str, bool] = {}
+
+
+def _probe_cache_path() -> str:
+    """On-disk probe verdicts, keyed by jaxlib version (flag support only
+    changes with the XLA build): one process pays the probe, every later
+    pytest session / launcher / example reads the file."""
+    import jaxlib
+    import tempfile
+    ver = getattr(jaxlib, "__version__", "unknown").replace("/", "_")
+    return os.path.join(tempfile.gettempdir(),
+                        f"bluefog_xla_flag_probe_{ver}.json")
+
+
+def _load_probe_cache() -> None:
+    if _FLAG_PROBE_CACHE:
+        return
+    import json
+    try:
+        with open(_probe_cache_path()) as f:
+            _FLAG_PROBE_CACHE.update({k: bool(v)
+                                      for k, v in json.load(f).items()})
+    except Exception:
+        pass
+
+
+def _store_probe_cache() -> None:
+    import json
+    try:
+        tmp = _probe_cache_path() + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_FLAG_PROBE_CACHE, f)
+        os.replace(tmp, _probe_cache_path())
+    except Exception:
+        pass
+
+
+def _probe_subprocess(flags: str, timeout: int = 120) -> bool:
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BLUEFOG_EXPECTED_SIZE", None)
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=timeout).returncode == 0
+    except Exception:
+        return False
+
+
+def xla_flags_supported(flags: List[str]) -> Dict[str, bool]:
+    """Which of ``flags`` the installed XLA build knows.
+
+    XLA *fatals the whole process* on an unknown name in ``XLA_FLAGS``
+    (parse_flags_from_env.cc), so probing must run in a throwaway
+    subprocess: initialize a 1-device CPU backend under the candidate
+    flags and see whether it survives.  All un-cached flags are probed in
+    ONE subprocess first (the common all-supported case costs a single
+    cold import); only a combined failure falls back to per-flag probes.
+    Verdicts persist on disk keyed by the jaxlib version.  Probe failures
+    of any kind (abort, timeout) count as unsupported — skipping a tuning
+    flag is always safe, injecting an unknown one never is."""
+    _load_probe_cache()
+    names = {flag: flag.lstrip("-").split("=", 1)[0] for flag in flags}
+    todo = [f for f in flags if names[f] not in _FLAG_PROBE_CACHE]
+    if todo:
+        if _probe_subprocess(" ".join(todo)):
+            for f in todo:
+                _FLAG_PROBE_CACHE[names[f]] = True
+        else:
+            for f in todo:
+                _FLAG_PROBE_CACHE[names[f]] = _probe_subprocess(f)
+        _store_probe_cache()
+    return {names[f]: _FLAG_PROBE_CACHE[names[f]] for f in flags}
+
+
+def xla_flag_supported(flag: str) -> bool:
+    """Single-flag convenience over :func:`xla_flags_supported`."""
+    return next(iter(xla_flags_supported([flag]).values()))
+
+
 def arm_low_core_cpu_mitigations(env: Dict[str, str],
                                  terminate_timeout_s: int = 1200
                                  ) -> Dict[str, str]:
@@ -68,11 +151,30 @@ def arm_low_core_cpu_mitigations(env: Dict[str, str],
     shared intra-op pool can wedge conv-heavy 8-device programs outright
     (a device thread blocks in the pool and never reaches the
     collective).  Call before the first backend use; opt out with
-    ``BLUEFOG_NO_XLA_FLAG_INJECT``."""
-    append_xla_flag(env, "--xla_cpu_collective_call_terminate_timeout_"
-                         f"seconds={terminate_timeout_s}")
-    if (os.cpu_count() or 1) <= 2:
-        append_xla_flag(env, "--xla_cpu_multi_thread_eigen=false")
+    ``BLUEFOG_NO_XLA_FLAG_INJECT``.
+
+    The flags are probed against the installed XLA build first
+    (:func:`xla_flags_supported`; one subprocess, disk-cached per jaxlib
+    version): older jaxlibs do not know these names and would abort the
+    process at first backend use.  A dropped mitigation is announced on
+    stderr — silently losing the anti-wedge timeout would be worse than
+    the noise."""
+    if env.get("BLUEFOG_NO_XLA_FLAG_INJECT"):
+        return env
+    flags = ([f"--xla_cpu_collective_call_terminate_timeout_seconds="
+              f"{terminate_timeout_s}"]
+             + (["--xla_cpu_multi_thread_eigen=false"]
+                if (os.cpu_count() or 1) <= 2 else []))
+    support = xla_flags_supported(flags)
+    for flag in flags:
+        if support[flag.lstrip("-").split("=", 1)[0]]:
+            append_xla_flag(env, flag)
+        else:
+            import sys
+            print(f"bluefog_tpu: XLA:CPU mitigation flag {flag} not "
+                  f"supported by this XLA build (or probe failed) — "
+                  f"skipped; low-core collective runs may hit the 40s "
+                  f"rendezvous timeout", file=sys.stderr)
     return env
 
 

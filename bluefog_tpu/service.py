@@ -22,13 +22,15 @@ JAX async dispatch).
 import atexit
 import ctypes
 import threading
-from typing import Callable, Dict
+from typing import Callable, Dict, List, Optional, Tuple
 
 from . import native
 from .utils import blog
 
 __all__ = ["start", "stop", "running", "submit", "poll", "wait", "release",
-           "pending", "WIN_LANE"]
+           "pending", "WIN_LANE", "ServiceTaskError",
+           "mark_rank_degraded", "degraded_ranks", "clear_degraded_ranks",
+           "on_rank_degraded"]
 
 # all window ops share one lane => FIFO like the reference's comm thread
 WIN_LANE = 0
@@ -38,8 +40,84 @@ _lifecycle_lock = threading.Lock()
 _tasks: Dict[int, Callable[[], None]] = {}
 _results: Dict[int, object] = {}
 _errors: Dict[int, str] = {}
+_meta: Dict[int, Tuple[Optional[str], Optional[int]]] = {}  # handle -> (op, rank)
 _next_tag = [1]
 _trampoline_ref = []  # keep the CFUNCTYPE object alive for the process
+
+
+class ServiceTaskError(RuntimeError):
+    """A service-lane task failed.  Carries the submitting context — which
+    op and which rank's work — so callers (and the chaos harness) can react
+    per-rank instead of parsing strings out of the ``_errors`` dict.
+    Subclasses RuntimeError: existing ``except RuntimeError`` paths and the
+    reference's synchronize-raises semantics keep working."""
+
+    def __init__(self, message: str, *, op_name: Optional[str] = None,
+                 rank: Optional[int] = None, handle: Optional[int] = None):
+        ctx_parts = []
+        if op_name:
+            ctx_parts.append(f"op={op_name}")
+        if rank is not None:
+            ctx_parts.append(f"rank={rank}")
+        if handle is not None:
+            ctx_parts.append(f"handle={handle}")
+        suffix = f" [{', '.join(ctx_parts)}]" if ctx_parts else ""
+        super().__init__(f"{message}{suffix}")
+        self.message = message
+        self.op_name = op_name
+        self.rank = rank
+        self.handle = handle
+
+
+# -- degraded-rank registry (resilience integration) -------------------------
+#
+# The stall watchdog used to only LOG; now stalls and task errors that carry
+# a rank mark that rank degraded here, and the resilience layer (membership /
+# chaos harness) subscribes to feed it into liveness state.
+_degraded: Dict[int, str] = {}
+_degraded_callbacks: List[Callable[[int, str], None]] = []
+
+
+def mark_rank_degraded(rank: int, reason: str) -> None:
+    """Record a rank as degraded (stalled or erroring).  Idempotent per
+    rank; fires registered callbacks and a timeline resilience event."""
+    with _lock:
+        first = rank not in _degraded
+        _degraded[rank] = reason
+        callbacks = list(_degraded_callbacks)
+    if first:
+        blog.log(blog.WARN, f"rank {rank} marked degraded: {reason}")
+        from . import timeline as _tl
+        _tl.record_resilience_event("degraded", f"rank {rank}: {reason}")
+        for cb in callbacks:
+            try:
+                cb(rank, reason)
+            except Exception as e:  # a bad subscriber must not mask the op
+                blog.log(blog.ERROR, f"degraded-rank callback failed: {e}")
+
+
+def degraded_ranks() -> Dict[int, str]:
+    """Ranks currently marked degraded, with the reason."""
+    with _lock:
+        return dict(_degraded)
+
+
+def clear_degraded_ranks() -> None:
+    with _lock:
+        _degraded.clear()
+
+
+def on_rank_degraded(callback: Callable[[int, str], None]) -> None:
+    """Subscribe to degraded-rank transitions (e.g. the chaos harness
+    folding watchdog verdicts into the liveness mask)."""
+    with _lock:
+        _degraded_callbacks.append(callback)
+
+
+def _note_failure(handle: int) -> None:
+    meta = _meta.get(handle)
+    if meta and meta[1] is not None:
+        mark_rank_degraded(meta[1], f"task error in {meta[0] or 'task'}")
 
 
 def _trampoline(handle, tag):
@@ -90,6 +168,7 @@ def stop() -> None:
         _tasks.clear()
         _results.clear()
         _errors.clear()
+        _meta.clear()
 
 
 def running() -> bool:
@@ -97,13 +176,19 @@ def running() -> bool:
     return bool(lib is not None and lib.bft_service_running())
 
 
-def submit(fn: Callable[[], object], lane: int = -1) -> int:
+def submit(fn: Callable[[], object], lane: int = -1, *,
+           op_name: Optional[str] = None,
+           rank: Optional[int] = None) -> int:
     """Run ``fn`` on a service worker; returns a handle immediately.
 
     The return value of ``fn`` is retrievable via :func:`wait`; exceptions
     mark the handle errored and re-raise at wait time (reference semantics:
     the status callback carries the error to ``synchronize``,
     torch/mpi_ops.cc:85-97).
+
+    ``op_name``/``rank`` attach submitting context to the handle: a failing
+    or stalling task then surfaces as a :class:`ServiceTaskError` carrying
+    both, and the rank is marked degraded (:func:`degraded_ranks`).
     """
     lib = _lib_or_none()
     if lib is None:
@@ -111,6 +196,7 @@ def submit(fn: Callable[[], object], lane: int = -1) -> int:
         with _lock:
             handle = -_next_tag[0] - 1
             _next_tag[0] += 1
+            _meta[handle] = (op_name, rank)
         try:
             result = fn()
             with _lock:
@@ -118,6 +204,7 @@ def submit(fn: Callable[[], object], lane: int = -1) -> int:
         except Exception as e:
             with _lock:
                 _errors[handle] = str(e)
+            _note_failure(handle)
         return handle
     with _lock:
         tag = _next_tag[0]
@@ -128,30 +215,63 @@ def submit(fn: Callable[[], object], lane: int = -1) -> int:
         with _lock:
             _tasks.pop(tag, None)
         raise RuntimeError("service not running")
+    with _lock:
+        _meta[handle] = (op_name, rank)
     return handle
 
 
-def poll(handle: int) -> bool:
-    if handle < 0:  # inline fallback handle
-        return True
+def _task_error(handle: int, message: str) -> ServiceTaskError:
+    op_name, rank = _meta.get(handle, (None, None))
+    return ServiceTaskError(message, op_name=op_name, rank=rank,
+                            handle=handle)
+
+
+def poll(handle: int, raise_error: bool = True) -> bool:
+    """True when the task behind ``handle`` has completed.
+
+    A completed-with-error handle raises its :class:`ServiceTaskError`
+    immediately (structured raise path — errors no longer sit silently in
+    the handle table until someone waits); pass ``raise_error=False`` for
+    the bare done/pending answer."""
     lib = native.load()
-    if lib is None:
-        return True
-    return int(lib.bft_handle_poll(handle)) != 0
+    if handle < 0 or lib is None:  # inline fallback handle: born done
+        done = True
+    else:
+        done = int(lib.bft_handle_poll(handle)) != 0
+    if done and raise_error:
+        with _lock:
+            err = _errors.get(handle)
+        if err is not None:
+            exc = _task_error(handle, err)
+            _note_failure(handle)
+            raise exc
+    return done
 
 
 def wait(handle: int, timeout_ms: int = -1):
     """Block until the task completes; returns its result or raises its
-    exception.  The handle is released."""
+    :class:`ServiceTaskError` (with op/rank context).  The handle is
+    released.  A timeout marks the handle's rank degraded — the watchdog
+    acts on the stall instead of only logging it."""
     if handle < 0 or native.load() is None:
         with _lock:
             err = _errors.pop(handle, None)
             if err is None:
+                _meta.pop(handle, None)
                 return _results.pop(handle, None)
-        raise RuntimeError(err)
+        exc = _task_error(handle, err)
+        _note_failure(handle)
+        with _lock:
+            _meta.pop(handle, None)
+        raise exc
     lib = native.load()
     state = int(lib.bft_handle_wait(handle, timeout_ms))
     if state == 0:
+        op_name, rank = _meta.get(handle, (None, None))
+        if rank is not None:
+            mark_rank_degraded(
+                rank, f"{op_name or 'task'} still pending after "
+                      f"{timeout_ms}ms")
         raise TimeoutError(f"handle {handle} still pending after "
                            f"{timeout_ms}ms")
     if state == -2:
@@ -166,7 +286,9 @@ def wait(handle: int, timeout_ms: int = -1):
                 cbuf = ctypes.create_string_buffer(512)
                 lib.bft_handle_error_msg(handle, cbuf, 512)
                 err = cbuf.value.decode(errors="replace")
-            raise RuntimeError(err)
+            exc = _task_error(handle, err)
+            _note_failure(handle)
+            raise exc
         with _lock:
             return _results.pop(handle, None)
     finally:
@@ -174,6 +296,7 @@ def wait(handle: int, timeout_ms: int = -1):
         with _lock:
             _errors.pop(handle, None)
             _results.pop(handle, None)
+            _meta.pop(handle, None)
 
 
 def release(handle: int) -> None:
@@ -183,6 +306,7 @@ def release(handle: int) -> None:
     with _lock:
         _results.pop(handle, None)
         _errors.pop(handle, None)
+        _meta.pop(handle, None)
 
 
 def pending() -> int:

@@ -32,7 +32,7 @@ from . import native
 __all__ = [
     "timeline_start", "timeline_end", "timeline_enabled",
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
-    "record_op_phase", "op_phase",
+    "record_op_phase", "op_phase", "record_resilience_event",
 ]
 
 _ENV = "BLUEFOG_TIMELINE"
@@ -225,6 +225,15 @@ def record_op_span(name: str, activity: str, token):
         return
     end = _timeline.now_us()
     _timeline.record(name, activity, "X", max(0, end - start_us), start_us)
+
+
+def record_resilience_event(kind: str, detail: str = ""):
+    """Fault/repair instant on the dedicated ``resilience`` lane: chaos-run
+    boundaries, fault onsets, membership confirmations, matrix repairs.
+    No-op unless the timeline is enabled (like every host activity)."""
+    if _timeline.enabled:
+        name = f"{kind}: {detail}" if detail else kind
+        _timeline.record("resilience", name, "i")
 
 
 @contextmanager

@@ -63,7 +63,14 @@ class Checkpointer:
         if template is not None:
             args = self._ocp.args.StandardRestore(template)
             return self._mgr.restore(step, args=args)
-        return self._mgr.restore(step)
+        try:
+            return self._mgr.restore(step)
+        except KeyError:
+            # older orbax (<0.9) cannot infer the handler for an argless
+            # restore of a StandardSave item; an explicit template-less
+            # StandardRestore names the handler and restores as numpy
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore())
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -1,0 +1,344 @@
+"""Resilience subsystem tests: fault plans, liveness gossip, matrix repair
+invariants, and the chaos harness acceptance demo (kill 1 of 8 ranks
+mid-run; training continues, the repaired matrix stays stochastic, survivor
+consensus error stays bounded, and fault injection never recompiles)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bluefog_tpu as bf
+from bluefog_tpu import service
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.parallel import topology as T
+from bluefog_tpu.parallel.schedule import compile_dynamic_schedule
+from bluefog_tpu.resilience import (
+    ChaosHarness, FaultPlan, LivenessConfig, empty_plan, random_plan,
+    belief_alive, confirmed_dead_votes, fallback_ring_matrix, gossip_step,
+    init_state, liveness_masked_schedule, repair_matrix,
+    repair_matrix_traced, repair_topology, spectral_gap,
+    survivors_connected,
+)
+
+N = 8
+
+
+# ---------------------------------------------------------------------------
+# Fault plans
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_tables_shapes_and_semantics():
+    plan = (FaultPlan(N, 20)
+            .rank_down(3, at=5)
+            .straggler(1, at=2, factor=3, until=14)
+            .flaky_link(0, 4, at=6, until=8)
+            .corrupt(2, at=7, until=9, scale=100.0))
+    c = plan.compile()
+    assert c.alive.shape == (20, N) and c.link_ok.shape == (20, N, N)
+    assert c.alive[4, 3] == 1 and c.alive[5, 3] == 0 and c.alive[-1, 3] == 0
+    assert c.active[5:, 3].sum() == 0          # dead => never active
+    assert c.active[2, 1] == 1 and c.active[3, 1] == 0  # every 3rd step
+    assert c.active[15, 1] == 1                # fault expired
+    assert c.link_ok[6, 0, 4] == 0 and c.link_ok[8, 0, 4] == 1
+    assert c.corrupt[7, 2] == 100.0 and c.corrupt[9, 2] == 1.0
+    assert c.num_dead_at(19) == 1
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError):
+        FaultPlan(0, 10)
+    plan = FaultPlan(N, 10)
+    with pytest.raises(ValueError):
+        plan.rank_down(N, at=0)
+    with pytest.raises(ValueError):
+        plan.straggler(0, at=0, factor=0)
+
+
+def test_random_plan_is_deterministic_and_caps_deaths():
+    a = random_plan(N, 30, seed=7, p_down=0.9).compile()
+    b = random_plan(N, 30, seed=7, p_down=0.9).compile()
+    np.testing.assert_array_equal(a.alive, b.alive)
+    np.testing.assert_array_equal(a.link_ok, b.link_ok)
+    # survivors always hold a strict majority
+    assert (a.alive[-1] == 0).sum() <= (N - 1) // 2
+
+
+# ---------------------------------------------------------------------------
+# Matrix repair invariants (satellite: every topology generator, every
+# single-rank kill)
+# ---------------------------------------------------------------------------
+
+TOPOLOGIES = {
+    "exp2": lambda: T.ExponentialTwoGraph(N),
+    "exp": lambda: T.ExponentialGraph(N),
+    "symexp": lambda: T.SymmetricExponentialGraph(N),
+    "mesh2d": lambda: T.MeshGrid2DGraph(N),
+    "star": lambda: T.StarGraph(N),
+    "ring_bi": lambda: T.RingGraph(N, connect_style=0),
+    "ring_left": lambda: T.RingGraph(N, connect_style=1),
+    "ring_right": lambda: T.RingGraph(N, connect_style=2),
+    "full": lambda: T.FullyConnectedGraph(N),
+}
+
+
+@pytest.mark.parametrize("name", sorted(TOPOLOGIES))
+@pytest.mark.parametrize("dead", range(N))
+def test_single_kill_repair_invariants(name, dead):
+    W = T.mixing_matrix(TOPOLOGIES[name]())
+    alive = np.ones(N, bool)
+    alive[dead] = False
+    R = repair_matrix(W, alive)
+    # column-stochastic, non-negative
+    np.testing.assert_allclose(R.sum(axis=0), 1.0, atol=1e-12)
+    assert (R >= -1e-12).all()
+    # zero weight to and from the dead rank
+    assert np.allclose(np.delete(R[:, dead], dead), 0.0)
+    assert np.allclose(np.delete(R[dead, :], dead), 0.0)
+    assert R[dead, dead] == 1.0
+    # consensus still contracts among survivors
+    assert spectral_gap(R, alive) > 1e-6
+
+
+def test_symmetric_family_repair_stays_doubly_stochastic():
+    W = T.mixing_matrix(T.MeshGrid2DGraph(N))
+    alive = np.ones(N, bool)
+    alive[5] = False
+    R = repair_matrix(W, alive)          # auto => Hastings re-weighting
+    np.testing.assert_allclose(R.sum(axis=0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(R.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(R, R.T, atol=1e-12)
+
+
+def test_star_center_kill_falls_back_to_ring():
+    W = T.mixing_matrix(T.StarGraph(N, center_rank=0))
+    alive = np.asarray([0] + [1] * (N - 1), bool)
+    assert not survivors_connected(W, alive)
+    R = repair_matrix(W, alive)
+    np.testing.assert_array_equal(R, fallback_ring_matrix(N, alive))
+    assert spectral_gap(R, alive) > 1e-6
+
+
+def test_repair_traced_matches_host_column_family():
+    W = T.mixing_matrix(T.ExponentialGraph(N))
+    alive = np.asarray([1, 1, 0, 1, 1, 1, 0, 1], np.float32)
+    host = repair_matrix(W, alive, family="column")
+    traced = np.asarray(jax.jit(repair_matrix_traced)(
+        jnp.asarray(W, jnp.float32), alive=jnp.asarray(alive)))
+    np.testing.assert_allclose(traced, host, atol=1e-6)
+
+
+def test_repair_topology_compiles_repaired_matrix():
+    topo = bf.compile_topology(T.ExponentialGraph(N))
+    alive = np.ones(N, bool)
+    alive[4] = False
+    rt = repair_topology(topo, alive)
+    np.testing.assert_allclose(rt.weight_matrix,
+                               repair_matrix(topo.weight_matrix, alive))
+    assert all(4 not in (s, d) for sh in rt.shifts for s, d in sh.pairs)
+
+
+def test_liveness_masked_schedule_invariants():
+    g = T.ExponentialGraph(N)
+    sched = compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(g, r), N)
+    alive = np.asarray([1, 1, 0, 1, 1, 1, 1, 1], bool)
+    ms = liveness_masked_schedule(sched, alive)
+    assert ms.period == sched.period and ms.size == sched.size
+    assert set(ms.offsets) <= set(sched.offsets)
+    for t in range(ms.period):
+        Wt = ms.matrices[t]
+        np.testing.assert_allclose(Wt.sum(axis=0), 1.0, atol=1e-12)
+        assert np.allclose(np.delete(Wt[:, 2], 2), 0.0)
+        assert np.allclose(np.delete(Wt[2, :], 2), 0.0)
+
+
+def test_dynamic_liveness_helper_in_dynamic_module():
+    g = T.ExponentialGraph(N)
+    mats = bf.dynamic_topology.dynamic_mixing_matrices_with_liveness(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(g, r), N, 6,
+        alive=[1, 1, 1, 0, 1, 1, 1, 1])
+    np.testing.assert_allclose(mats.sum(axis=1), 1.0, atol=1e-12)
+    assert (np.delete(mats[:, 3, :], 3, axis=1) == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# Membership gossip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_gossip_confirms_dead_rank(bf_ctx):
+    cfg = LivenessConfig(suspect_after=2, confirm_after=4)
+    plan = FaultPlan(N, 20).rank_down(3, at=5).compile()
+    state = init_state(N)
+    for t in range(12):
+        i = min(t, 19)
+        state = gossip_step(state, t, active=plan.active[i],
+                            link_ok=plan.link_ok[i])
+    votes = np.asarray(confirmed_dead_votes(state["last_heard"], 11, cfg))
+    assert votes[3] >= (N - 1) // 2 + 1      # survivor majority confirmed
+    assert (np.delete(votes, 3) == 0).all()  # nobody else suspected
+    B = np.asarray(belief_alive(state["last_heard"], 11, cfg))
+    assert (B[3, np.arange(N) != 3] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# Chaos harness — acceptance demo
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_kill_one_of_eight_mid_run(bf_ctx):
+    """Kill 1 of 8 ranks mid-run under consensus-step training: training
+    continues, the repaired matrix passes stochasticity checks, survivor
+    consensus error stays bounded."""
+    plan = FaultPlan(N, 40).rank_down(3, at=12)
+    h = ChaosHarness(plan, cfg=LivenessConfig(suspect_after=2,
+                                              confirm_after=4))
+    rep = h.run(np.zeros((N, 4), np.float32), steps=40)
+    assert np.isfinite(rep.losses).all()
+    assert list(rep.confirmed_dead) == [3]
+    rep.check_matrix_invariants(step=-1)
+    rep.assert_bounded(max_consensus_error=2.0)
+    # loss trajectory keeps improving for the survivors after the kill
+    assert rep.losses[-1] < rep.losses[12]
+
+
+@pytest.mark.chaos
+def test_chaos_mixed_faults_bounded(bf_ctx):
+    plan = (FaultPlan(N, 30)
+            .straggler(5, at=0, factor=3)
+            .flaky_link(0, 1, at=5, until=9)
+            .corrupt(2, at=7, until=8))          # NaN corruption
+    rep = ChaosHarness(plan).run(np.zeros((N, 4), np.float32), steps=30)
+    rep.assert_bounded(max_consensus_error=2.0)
+    assert len(rep.confirmed_dead) == 0          # transients never confirmed
+
+
+@pytest.mark.chaos
+def test_fault_plans_do_not_recompile(bf_ctx):
+    """Acceptance: fault plans are traced data — injecting or clearing a
+    fault between steps triggers zero recompilations."""
+    h = ChaosHarness(empty_plan(N, 10))
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    assert h._step_fn._cache_size() == 1
+    h.plan = FaultPlan(N, 10).rank_down(2, at=1).compile()   # inject
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    h.plan = empty_plan(N, 10)                               # clear
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    assert h._step_fn._cache_size() == 1
+
+
+@pytest.mark.chaos
+def test_weights_override_hook(bf_ctx):
+    x = jnp.arange(float(N)).reshape(N, 1)
+    alive = np.asarray([1, 1, 1, 0, 1, 1, 1, 1], bool)
+    W = repair_matrix(
+        T.mixing_matrix(T.ExponentialGraph(N)), alive)
+    with bf.weights_override(W):
+        y = np.asarray(bf.neighbor_allreduce(x))
+    assert y[3, 0] == 3.0                         # dead rank frozen
+    expected = (np.asarray(W).T @ np.arange(float(N)))
+    np.testing.assert_allclose(y.ravel(), expected, rtol=1e-5)
+    # cleared on exit
+    y2 = np.asarray(bf.neighbor_allreduce(x))
+    assert not np.allclose(y.ravel(), y2.ravel())
+    with pytest.raises(ValueError):
+        bf.set_weights_override(np.eye(N + 1))
+
+
+@pytest.mark.chaos
+def test_win_update_alive_mask(bf_ctx):
+    x = jnp.arange(float(N)).reshape(N, 1) + 1.0
+    assert bf.win_create(x, "resil.win")
+    try:
+        bf.win_put(x, "resil.win")
+        alive = jnp.asarray([1., 1., 1., 0., 1., 1., 1., 1.])
+        out = np.asarray(bf.win_update("resil.win", alive=alive))
+        # rank 4's in-neighbors under exp2 include rank 3 (offset 1): with
+        # rank 3 masked, its weight folds into rank 4's self weight
+        base = np.asarray(bf.win_update("resil.win"))
+        assert not np.allclose(out, base)
+        assert np.isfinite(out).all()
+    finally:
+        bf.win_free()
+
+
+def test_with_degraded_guard_skips_comm():
+    import optax
+    calls = {"comm": 0, "local": 0}
+
+    def comm_step(p, g, s, step=0):
+        calls["comm"] += 1
+        return p - 0.5 * g, s
+
+    def local_step(p, g, s, step=0):
+        calls["local"] += 1
+        return p - 0.1 * g, s
+
+    guarded = S.with_degraded_guard(comm_step, local_step)
+    fn = jax.jit(guarded)
+    p = jnp.ones(3)
+    g = jnp.ones(3)
+    out_comm, _ = fn(p, g, {}, 0, False)
+    out_local, _ = fn(p, g, {}, 0, True)       # same compiled program
+    np.testing.assert_allclose(np.asarray(out_comm), 0.5)
+    np.testing.assert_allclose(np.asarray(out_local), 0.9)
+    assert fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# Service structured errors + degraded marking (satellite)
+# ---------------------------------------------------------------------------
+
+def test_service_task_error_carries_context():
+    service.start()
+    try:
+        def boom():
+            raise ValueError("deliberate chaos")
+        h = service.submit(boom, op_name="win_put", rank=5)
+        with pytest.raises(service.ServiceTaskError) as ei:
+            service.wait(h)
+        assert ei.value.rank == 5
+        assert ei.value.op_name == "win_put"
+        assert "deliberate chaos" in str(ei.value)
+        assert "rank=5" in str(ei.value)
+        assert isinstance(ei.value, RuntimeError)   # back-compat
+        assert 5 in service.degraded_ranks()
+    finally:
+        service.clear_degraded_ranks()
+        service.stop()
+
+
+def test_service_poll_raises_structured_error():
+    service.start()
+    try:
+        h = service.submit(lambda: 1 / 0, op_name="win_get", rank=2)
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                if service.poll(h):
+                    pytest.fail("errored handle polled as clean success")
+            except service.ServiceTaskError as e:
+                assert e.rank == 2 and e.op_name == "win_get"
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("error never surfaced via poll")
+        assert service.poll(h, raise_error=False) is True  # opt-out intact
+        assert 2 in service.degraded_ranks()
+    finally:
+        service.clear_degraded_ranks()
+        service.stop()
+
+
+def test_degraded_rank_callback():
+    seen = []
+    service.on_rank_degraded(lambda r, why: seen.append((r, why)))
+    try:
+        service.mark_rank_degraded(7, "unit test")
+        assert seen and seen[0][0] == 7
+        assert 7 in service.degraded_ranks()
+    finally:
+        service.clear_degraded_ranks()

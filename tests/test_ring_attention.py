@@ -18,7 +18,7 @@ from bluefog_tpu.models.transformer import TransformerLM
 from bluefog_tpu.ops.ring_attention import (
     attention, ring_attention, ulysses_attention)
 
-from conftest import N_DEVICES
+from conftest import N_DEVICES, JAX_PRE_05
 
 B, H, D = 2, 8, 16
 # Per-shard sequence length stays at 8 rows (one sublane tile) on EVERY
@@ -93,6 +93,9 @@ def test_ring_attention_gradients_match(bf_ctx):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_flash_blocks_match_full(bf_ctx, causal):
+    if not causal and JAX_PRE_05:
+        pytest.skip("non-causal flash-block lowering emits partition-id, "
+                    "which the SPMD partitioner of jaxlib<0.5 rejects")
     """Per-hop Pallas flash blocks (interpreted) == full attention."""
     q, k, v = _qkv(5)
     expected = attention(q, k, v, causal=causal)

@@ -417,6 +417,13 @@ print(f"MULTIHOST_HIER_OK {pid}", flush=True)
 """
 
 
+from conftest import JAX_PRE_05
+
+
+@pytest.mark.skipif(
+    JAX_PRE_05,
+    reason="multiprocess computations are unimplemented on the CPU backend "
+           "of jaxlib<0.5 (cross-process collectives need the gloo path)")
 def test_bfrun_two_process_jax_distributed(tmp_path):
     """End-to-end multi-controller job: bfrun's multi-host path spawns two
     local processes oversubscribing localhost (the reference tests multi-node
@@ -450,6 +457,10 @@ def test_bfrun_two_process_jax_distributed(tmp_path):
     assert "MULTIHOST_HIER_OK 1" in out.stdout
 
 
+@pytest.mark.skipif(
+    JAX_PRE_05,
+    reason="multiprocess computations are unimplemented on the CPU backend "
+           "of jaxlib<0.5 (cross-process collectives need the gloo path)")
 def test_ibfrun_multihost_cluster(tmp_path):
     """ibfrun's multi-host interactive cluster (reference
     interactive_run.py:229-329): two engines join one jax.distributed job;

@@ -161,6 +161,10 @@ def test_dynamic_dst_rejects_offsets_outside_superset(bf_ctx):
         bf.neighbor_allreduce(_x(), sched=sched, step=0, dst_weight_matrix=D)
 
 
+@pytest.mark.skipif(
+    __import__("conftest").JAX_PRE_05,
+    reason="pallas_interpret backend needs the Mosaic TPU-simulating "
+           "interpreter (no CPU lowering for its semaphores on jaxlib<0.5)")
 def test_fused_dynamic_backend_reachable(bf_ctx, monkeypatch):
     """BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas_interpret routes the
     dynamic schedule through the fused kernel and matches the XLA path."""
