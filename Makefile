@@ -17,8 +17,8 @@ NUM_DEVICES ?= 8
 PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
-        test_hierarchical test_torch test_attention examples bench hwcheck \
-        chaos
+        test_hierarchical test_torch test_attention examples bench \
+        bench-trace hwcheck chaos
 
 test:
 	$(PYTEST) tests/
@@ -63,6 +63,12 @@ examples:
 
 bench:
 	python bench.py
+
+# CPU trace-metrics bench: compiled collective counts + trace time for the
+# fused (flat-buffer) vs per-leaf communication path — one JSON line, no
+# accelerator needed (docs/performance.md "Communication fusion")
+bench-trace:
+	python bench.py --trace-only
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

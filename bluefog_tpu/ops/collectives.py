@@ -17,6 +17,7 @@ All functions take ``axis_name`` explicitly and operate on the *per-rank
 shard* of data, exactly like ``lax.psum``.
 """
 
+import functools
 from typing import Sequence, Tuple
 
 import jax
@@ -45,14 +46,21 @@ __all__ = [
 
 
 def _require_inexact(x, op_name: str):
-    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+    dtype = jnp.asarray(x).dtype
+    if not jnp.issubdtype(dtype, jnp.inexact):
         raise TypeError(
             f"{op_name} computes fractional weighted averages and requires a "
-            f"float dtype, got {jnp.asarray(x).dtype}; cast the input first")
+            f"float dtype, got {dtype}; cast the input first")
 
 
+@functools.lru_cache(maxsize=4096)
 def _rotation_pairs(size: int, offset: int) -> Tuple[Tuple[int, int], ...]:
-    """Full-rotation permutation: every rank sends to (rank + offset) % size."""
+    """Full-rotation permutation: every rank sends to (rank + offset) % size.
+
+    Cached: every dynamic/offset-weighted collective rebuilds the same
+    O(N) tuples per offset on every trace, and the window kernels loop
+    over them per offset per leaf — pure-Python retrace overhead that the
+    cache removes (the result is immutable)."""
     return tuple((j, (j + offset) % size) for j in range(size))
 
 
@@ -107,9 +115,15 @@ def neighbor_allreduce(x, axis_name, topo: CompiledTopology):
     return out
 
 
+@functools.lru_cache(maxsize=512)
 def _allgather_slots(topo: CompiledTopology) -> np.ndarray:
     """slots[k, i] = position of offset-k's source in rank i's sorted
-    in-neighbor list, or max in_degree (=> dropped) when no such edge."""
+    in-neighbor list, or max in_degree (=> dropped) when no such edge.
+
+    Cached per compiled topology (``CompiledTopology`` hashes by identity
+    — it is frozen and ``eq=False``): the table is O(N*K) pure-Python
+    work re-done on every trace of every gather/window program otherwise.
+    Callers treat the returned array as read-only."""
     n = topo.size
     sentinel = int(topo.in_degrees().max(initial=0))
     slots = np.full((len(topo.shifts), n), sentinel, dtype=np.int32)

@@ -1,0 +1,284 @@
+"""Comm-fusion layer: flat-buffer execution of pytree collectives.
+
+The reference core fuses many small tensors into one communication buffer
+before hitting MPI/NCCL (Horovod-style tensor fusion; ``mpi_controller.cc:
+561-743`` packs every negotiated tensor into a single ``[self | n1, n2...]``
+buffer per transmission) because per-tensor collectives are latency-bound.
+The SPMD port's strategy layer used to do the opposite — ``jax.tree.map(
+neighbor_allreduce)`` over the parameter pytree issues ``leaves x offsets``
+``lax.ppermute``s per step, bloating the HLO, trace/compile time, and per-op
+launch latency; the exponential-graph economics (one cheap transfer per
+O(log N) offset) only hold when the model IS one transfer per offset.
+
+This module is the TPU-native fusion buffer:
+
+1. :func:`plan_for` groups the tree's leaves into **dtype-bucketed** flat
+   buffers (a weighted average must not silently cast, so dtypes never
+   share a buffer), chunked at leaf granularity by ``max_bucket_bytes``
+   (several buckets per dtype lets XLA overlap one bucket's transfer with
+   another's accumulate) and padded to a configurable element multiple
+   (the Mosaic kernel wants ``8 x 128`` tiles).
+2. :func:`flatten` / :func:`unflatten` move a concrete tree into / out of
+   the plan's buffers with reshape+concatenate only — no copies beyond the
+   one gather XLA fuses into the collective.
+3. :func:`fused_tree_map` runs an elementwise-linear collective once per
+   BUCKET instead of once per leaf and restores the original tree.
+
+Exactness: every exchange this layer fuses (neighbor/dynamic/hierarchical
+averaging, allreduce) is elementwise-linear with per-rank scalar weights,
+and buckets never mix dtypes — so the fused arithmetic is the SAME scalar
+ops on the same values, bit-exact versus the per-leaf path (asserted across
+all strategies in ``tests/test_fusion.py``).  Padding tail elements are
+zeros; linear ops map zeros to zeros and the tail is sliced away.
+
+Trees are planned at trace time from static shape/dtype structure only
+(plans are lru-cached on the abstract signature), so fusion adds zero
+retracing and the step's compiled program count is unchanged.
+
+Env knobs (read when a step is BUILT, like the exchange backend snapshot):
+``BLUEFOG_COMM_FUSION`` (default ``1``) gates the layer; the
+``BLUEFOG_FUSION_BUCKET_BYTES`` cap (default 64 MiB, the reference
+controller's fusion-buffer scale) splits oversized dtype groups.
+"""
+
+import functools
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DEFAULT_MAX_BUCKET_BYTES",
+    "FusionPlan",
+    "fusion_enabled",
+    "resolve_max_bucket_bytes",
+    "plan_for",
+    "flatten",
+    "unflatten",
+    "fused_tree_map",
+]
+
+# Reference scale: the MPI controller's fusion buffer is tens of MB
+# (BLUEFOG_FUSION_THRESHOLD, operations.cc); 64 MiB keeps a ResNet-50
+# (~100 MB f32) in two buckets — large enough to amortize launch latency,
+# small enough that bucket 0's exchange can overlap bucket 1's pack.
+DEFAULT_MAX_BUCKET_BYTES = 64 << 20
+
+
+def fusion_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the fusion gate: explicit argument wins, else the
+    ``BLUEFOG_COMM_FUSION`` env var (default on).  Builders resolve this
+    when the step is constructed — same snapshot discipline as the
+    exchange backend (``training.py``): jit traces once, so reading the
+    env inside the traced function would freeze the first call's value."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("BLUEFOG_COMM_FUSION", "1") == "1"
+
+
+def resolve_max_bucket_bytes(value: Optional[int] = None) -> int:
+    if value is not None:
+        v = int(value)
+    else:
+        v = int(os.environ.get("BLUEFOG_FUSION_BUCKET_BYTES",
+                               str(DEFAULT_MAX_BUCKET_BYTES)))
+    if v <= 0:
+        raise ValueError(f"fusion bucket size must be positive, got {v}")
+    return v
+
+
+@dataclass(frozen=True)
+class _Slot:
+    """Where one original leaf lives: ``bucket < 0`` marks a zero-size
+    passthrough leaf (it carries no data, so it rides no buffer and is
+    re-fabricated empty at unflatten)."""
+    index: int                  # leaf position in tree-flatten order
+    bucket: int
+    start: int                  # element offset within the bucket
+    size: int                   # elements (excluding leading dims)
+    shape: Tuple[int, ...]      # full original shape
+    dtype: Any
+
+
+@dataclass(frozen=True)
+class _Bucket:
+    dtype: Any
+    nelems: int                 # payload elements (excluding leading dims)
+    padded: int                 # nelems rounded up to the pad multiple
+
+
+@dataclass(frozen=True)
+class FusionPlan:
+    """Static flatten/unflatten recipe for one tree signature.
+
+    ``leading_dims`` leading axes of every leaf are preserved un-flattened
+    (0 for per-rank trees inside ``shard_map``; 1 for the window
+    subsystem's global-view ``[N, ...]`` state)."""
+    treedef: Any
+    slots: Tuple[_Slot, ...]
+    buckets: Tuple[_Bucket, ...]
+    leading_dims: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def _abstract_signature(tree, leading_dims: int):
+    leaves, treedef = jax.tree.flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shape = tuple(int(d) for d in leaf.shape)
+        if len(shape) < leading_dims:
+            raise ValueError(
+                f"fusion with leading_dims={leading_dims} needs every leaf "
+                f"to carry those axes; got shape {shape}")
+        sig.append((shape, jnp.asarray(leaf).dtype
+                    if not hasattr(leaf, "dtype") else leaf.dtype))
+    return treedef, tuple(sig)
+
+
+@functools.lru_cache(maxsize=512)
+def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
+                leading_dims: int) -> FusionPlan:
+    # stable dtype grouping in first-appearance order (determinism matters:
+    # the window subsystem persists fused state across checkpoints)
+    order: List[Any] = []
+    groups = {}
+    for i, (shape, dtype) in enumerate(sig):
+        size = int(np.prod(shape[leading_dims:], dtype=np.int64)) \
+            if len(shape) > leading_dims else 1
+        # a leaf that is all leading dims (e.g. scalar per rank) still
+        # carries one element per leading slice
+        if len(shape) == leading_dims:
+            size = 1
+        if size == 0 or int(np.prod(shape, dtype=np.int64)) == 0:
+            groups.setdefault(None, []).append((i, shape, dtype, 0))
+            continue
+        key = jnp.dtype(dtype)
+        if key not in groups:
+            order.append(key)
+        groups.setdefault(key, []).append((i, shape, dtype, size))
+
+    slots: List[Optional[_Slot]] = [None] * len(sig)
+    buckets: List[_Bucket] = []
+    itemsize = {k: jnp.dtype(k).itemsize for k in order}
+    for key in order:
+        current: List[Tuple[int, Tuple[int, ...], Any, int]] = []
+        cur_elems = 0
+
+        def flush(members, elems, key=key):
+            if not members:
+                return
+            b = len(buckets)
+            start = 0
+            for i, shape, dtype, size in members:
+                slots[i] = _Slot(index=i, bucket=b, start=start, size=size,
+                                 shape=shape, dtype=jnp.dtype(dtype))
+                start += size
+            padded = elems + ((-elems) % pad_to)
+            buckets.append(_Bucket(dtype=key, nelems=elems, padded=padded))
+
+        cap_elems = max(1, max_bytes // itemsize[key])
+        for member in groups[key]:
+            size = member[3]
+            if current and cur_elems + size > cap_elems:
+                flush(current, cur_elems)
+                current, cur_elems = [], 0
+            current.append(member)
+            cur_elems += size
+            if cur_elems >= cap_elems:
+                flush(current, cur_elems)
+                current, cur_elems = [], 0
+        flush(current, cur_elems)
+
+    for i, shape, dtype, _ in groups.get(None, []):
+        slots[i] = _Slot(index=i, bucket=-1, start=0, size=0,
+                         shape=shape, dtype=jnp.dtype(dtype))
+    return FusionPlan(treedef=treedef, slots=tuple(slots),
+                      buckets=tuple(buckets), leading_dims=leading_dims)
+
+
+def plan_for(tree, *, max_bucket_bytes: Optional[int] = None,
+             pad_to: int = 1, leading_dims: int = 0) -> FusionPlan:
+    """Build (or fetch the cached) :class:`FusionPlan` for ``tree``'s
+    abstract signature.  Safe to call inside a traced function — the plan
+    depends only on static shapes/dtypes/structure."""
+    treedef, sig = _abstract_signature(tree, leading_dims)
+    return _build_plan(treedef, sig, resolve_max_bucket_bytes(max_bucket_bytes),
+                       int(pad_to), int(leading_dims))
+
+
+def flatten(plan: FusionPlan, tree) -> List[jax.Array]:
+    """Tree -> list of flat buffers, one per bucket (shape
+    ``leading + [padded]``)."""
+    leaves = jax.tree.leaves(tree)
+    if len(leaves) != len(plan.slots):
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, plan describes "
+            f"{len(plan.slots)}")
+    lead = plan.leading_dims
+    parts: List[List[jax.Array]] = [[] for _ in plan.buckets]
+    for slot in plan.slots:
+        if slot.bucket < 0:
+            continue
+        leaf = leaves[slot.index]
+        parts[slot.bucket].append(
+            leaf.reshape(tuple(leaf.shape[:lead]) + (-1,)))
+    bufs = []
+    for spec, ps in zip(plan.buckets, parts):
+        buf = ps[0] if len(ps) == 1 else jnp.concatenate(ps, axis=lead)
+        if spec.padded > spec.nelems:
+            pad = [(0, 0)] * lead + [(0, spec.padded - spec.nelems)]
+            buf = jnp.pad(buf, pad)
+        bufs.append(buf)
+    return bufs
+
+
+def unflatten(plan: FusionPlan, bufs: Sequence[jax.Array]):
+    """Inverse of :func:`flatten`.  Zero-size passthrough leaves are
+    re-fabricated empty (a 0-element array has no content to preserve)."""
+    if len(bufs) != len(plan.buckets):
+        raise ValueError(
+            f"{len(bufs)} buffers for a {len(plan.buckets)}-bucket plan")
+    lead = plan.leading_dims
+    leaves: List[Optional[jax.Array]] = [None] * len(plan.slots)
+    for slot in plan.slots:
+        if slot.bucket < 0:
+            leaves[slot.index] = jnp.zeros(slot.shape, slot.dtype)
+            continue
+        buf = bufs[slot.bucket]
+        seg = jax.lax.slice_in_dim(buf, slot.start, slot.start + slot.size,
+                                   axis=lead)
+        leaves[slot.index] = seg.reshape(slot.shape)
+    return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def fused_tree_map(fn: Callable, tree, *,
+                   max_bucket_bytes: Optional[int] = None,
+                   pad_to: int = 1):
+    """Apply an elementwise-linear, shape/dtype-preserving collective once
+    per fusion bucket instead of once per leaf.
+
+    The workhorse of the fused communication path: ``strategies.
+    _communicate`` routes every averaging mode through here, dropping the
+    per-step collective count from ``leaves x offsets`` to
+    ``buckets x offsets``.  ``fn`` must preserve shape and dtype (every
+    collective this layer fuses does); violations raise at trace time
+    rather than silently corrupting the unflatten."""
+    plan = plan_for(tree, max_bucket_bytes=max_bucket_bytes, pad_to=pad_to,
+                    leading_dims=0)
+    bufs = flatten(plan, tree)
+    out = []
+    for spec, buf in zip(plan.buckets, bufs):
+        o = fn(buf)
+        if tuple(o.shape) != tuple(buf.shape) or o.dtype != buf.dtype:
+            raise ValueError(
+                f"fused collective changed the buffer signature "
+                f"({buf.shape}/{buf.dtype} -> {o.shape}/{o.dtype}); "
+                f"fusion requires shape- and dtype-preserving ops")
+        out.append(o)
+    return unflatten(plan, out)

@@ -31,10 +31,20 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..parallel.schedule import CompiledTopology, DynamicSchedule
 
-__all__ = ["fused_neighbor_allreduce", "fused_dynamic_neighbor_allreduce"]
+__all__ = [
+    "fused_neighbor_allreduce", "fused_dynamic_neighbor_allreduce",
+    "fused_neighbor_allreduce_flat", "fused_dynamic_neighbor_allreduce_flat",
+    "FLAT_TILE",
+]
 
 _LANE = 128
 _SUBLANE = 8
+
+# One full float32 VMEM tile.  The comm-fusion layer (ops/fusion.py) pads
+# its flat buckets to this element multiple so the kernel's [R, 128]
+# reshape is exact — the whole model pays ONE sub-tile padding per bucket
+# instead of one per leaf (`_as_tiles` waste).
+FLAT_TILE = _SUBLANE * _LANE
 
 
 def _struct_vma(shape, dtype, axis_name):
@@ -142,18 +152,74 @@ def _fused_exchange(x, axis_name, size, offsets, self_w, recv_w,
     return out2d.reshape(-1)[: int(np.prod(x.shape))].reshape(x.shape)
 
 
+def _static_recv_tables(topo: CompiledTopology) -> np.ndarray:
+    """[K, N] receive-weight table of a static topology (the kernel's
+    ``recv_w`` operand)."""
+    K = len(topo.shifts)
+    recv_w = np.zeros((max(K, 1), topo.size), np.float32)
+    for k, s in enumerate(topo.shifts):
+        recv_w[k] = s.recv_weights
+    return recv_w
+
+
 def fused_neighbor_allreduce(x, axis_name, topo: CompiledTopology,
                              interpret: bool = False):
     """Drop-in for ``collectives.neighbor_allreduce`` (call inside
     shard_map): one fused kernel instead of K chained ppermutes."""
     if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
         raise TypeError("fused_neighbor_allreduce requires a float dtype")
-    K = len(topo.shifts)
-    recv_w = np.zeros((max(K, 1), topo.size), np.float32)
-    for k, s in enumerate(topo.shifts):
-        recv_w[k] = s.recv_weights
     return _fused_exchange(x, axis_name, topo.size, topo.offsets,
-                           topo.self_weights, recv_w, interpret)
+                           topo.self_weights, _static_recv_tables(topo),
+                           interpret)
+
+
+def _fused_exchange_flat(x, axis_name, size, offsets, self_w, recv_w,
+                         interpret: bool):
+    """Pre-tiled fast path for the comm-fusion layer: ``x`` is a 1-D flat
+    bucket whose length is a multiple of :data:`FLAT_TILE`, so the [R, 128]
+    kernel layout is a pure reshape — no per-leaf ``_as_tiles`` padding."""
+    if x.ndim != 1 or x.shape[0] % FLAT_TILE:
+        raise ValueError(
+            f"flat fused exchange expects a 1-D buffer with a multiple of "
+            f"{FLAT_TILE} elements (fusion pad_to=FLAT_TILE), got shape "
+            f"{tuple(x.shape)}")
+    if not offsets:
+        return x * jnp.asarray(self_w)[lax.axis_index(axis_name)].astype(x.dtype)
+    out2d = _run_exchange(
+        x.reshape(-1, _LANE), jnp.asarray(self_w, jnp.float32),
+        jnp.asarray(recv_w, jnp.float32), size,
+        tuple(int(o) for o in offsets), axis_name, bool(interpret))
+    return out2d.reshape(x.shape)
+
+
+def fused_neighbor_allreduce_flat(x, axis_name, topo: CompiledTopology,
+                                  interpret: bool = False):
+    """Static-topology fused exchange over one pre-tiled flat bucket."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        raise TypeError("fused_neighbor_allreduce_flat requires a float dtype")
+    return _fused_exchange_flat(x, axis_name, topo.size, topo.offsets,
+                                topo.self_weights,
+                                _static_recv_tables(topo), interpret)
+
+
+def fused_dynamic_neighbor_allreduce_flat(x, axis_name,
+                                          sched: DynamicSchedule, step,
+                                          interpret: bool = False):
+    """Dynamic-schedule fused exchange over one pre-tiled flat bucket."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        raise TypeError(
+            "fused_dynamic_neighbor_allreduce_flat requires a float dtype")
+    self_w, recv_w = _sched_tables(sched, step)
+    return _fused_exchange_flat(x, axis_name, sched.size, sched.offsets,
+                                self_w, recv_w, interpret)
+
+
+def _sched_tables(sched: DynamicSchedule, step):
+    """This step's (self_w [N], recv_w [K, N]) weight tables, gathered on
+    device by the traced step index — pure data, no recompilation."""
+    t = jnp.asarray(step) % sched.period
+    return (jnp.asarray(sched.self_weights, jnp.float32)[t],
+            jnp.asarray(sched.recv_weights, jnp.float32)[t])
 
 
 def fused_dynamic_neighbor_allreduce(x, axis_name, sched: DynamicSchedule,
@@ -162,8 +228,6 @@ def fused_dynamic_neighbor_allreduce(x, axis_name, sched: DynamicSchedule,
     outside the kernel (pure data — no recompilation across steps)."""
     if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
         raise TypeError("fused_dynamic_neighbor_allreduce requires a float dtype")
-    t = jnp.asarray(step) % sched.period
-    self_w = jnp.asarray(sched.self_weights, jnp.float32)[t]   # [N]
-    recv_w = jnp.asarray(sched.recv_weights, jnp.float32)[t]   # [K, N]
+    self_w, recv_w = _sched_tables(sched, step)
     return _fused_exchange(x, axis_name, sched.size, sched.offsets,
                            self_w, recv_w, interpret)

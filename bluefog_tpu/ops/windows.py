@@ -38,6 +38,7 @@ from .. import service as _service
 from ..context import ctx
 from ..parallel.schedule import CompiledTopology
 from . import api as _api
+from . import fusion as _fusion
 from .api import _register_handle, synchronize
 
 __all__ = [
@@ -64,9 +65,20 @@ class _Window:
     Versions and the associated-P scalar stay per-WINDOW (one counter set,
     one P per rank — every op touches all leaves together), exactly like
     the reference's per-window metadata.
+
+    Flat-buffer storage (comm fusion, ``ops/fusion.py``): a multi-leaf
+    window additionally FUSES its internal state into one ``[N, L]``
+    buffer per dtype, so every put/get/accumulate/update issues one
+    ppermute per OFFSET per dtype bucket instead of one per leaf — this
+    completes the reference parity above (one program AND one buffer).
+    The caller-facing surface (``win_put`` inputs, ``win_fetch``/
+    ``win_update`` outputs, the ``_win_input`` structure check) stays in
+    the creation tree's shape; only the device-resident state is flat.
+    Gate: ``win_create(fuse=)`` / ``BLUEFOG_COMM_FUSION`` (default on).
     """
 
-    def __init__(self, tensor, topo: CompiledTopology, zero_init: bool):
+    def __init__(self, tensor, topo: CompiledTopology, zero_init: bool,
+                 fuse: Optional[bool] = None):
         cx = ctx()
         self.topo = topo
         # padded layout: every rank carries max-in-degree buffer rows so the
@@ -74,13 +86,24 @@ class _Window:
         # (irregular graphs — StarGraph etc. — work, VERDICT r1 missing #2)
         self.indeg = int(topo.in_degrees().max(initial=0))
         sharding = _api.rank_sharding()
-        self.tensor = jax.tree.map(
-            lambda t: jax.device_put(jnp.asarray(t), sharding), tensor)
-        self.treedef = jax.tree.structure(self.tensor)
-        leaves = jax.tree.leaves(self.tensor)
-        if not leaves:
+        tensor = jax.tree.map(jnp.asarray, tensor)
+        # the EXTERNAL contract: structure check for _win_input, dtype
+        # casting template, and the shape win_fetch/win_update restore
+        self.treedef = jax.tree.structure(tensor)
+        ext_leaves = jax.tree.leaves(tensor)
+        if not ext_leaves:
             raise ValueError("window tensor pytree has no leaves")
-        n = leaves[0].shape[0]
+        n = ext_leaves[0].shape[0]
+        self.template = jax.tree.map(
+            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), tensor)
+        self.plan = None
+        if _fusion.fusion_enabled(fuse) and len(ext_leaves) > 1:
+            # leading_dims=1 keeps the global-view rank axis unflattened:
+            # buckets are [N, L] with axis 0 sharded like any leaf
+            self.plan = _fusion.plan_for(tensor, leading_dims=1)
+            tensor = tuple(_fusion.flatten(self.plan, tensor))
+        self.tensor = jax.tree.map(
+            lambda t: jax.device_put(t, sharding), tensor)
 
         def make_buf(t):
             if zero_init:
@@ -95,6 +118,12 @@ class _Window:
         self.versions = jnp.zeros((n, self.indeg), jnp.int32)
         self.p = jnp.ones((n,), jnp.float32)
         self.p_buffers = jnp.zeros((n, self.indeg), jnp.float32)
+
+    def external(self, internal):
+        """Device-resident (possibly fused) state -> the creation tree."""
+        if self.plan is None:
+            return internal
+        return _fusion.unflatten(self.plan, list(internal))
 
 
 _windows: Dict[str, _Window] = {}
@@ -155,13 +184,16 @@ def windows_exist() -> bool:
     return bool(_windows)
 
 
-def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+def win_create(tensor, name: str, zero_init: bool = False,
+               fuse: Optional[bool] = None) -> bool:
     """Create a window: per-in-neighbor device buffers + versions + P
     (reference mpi_ops.py:998, mpi_controller.cc:793-866).
 
     ``tensor`` may be a whole PYTREE (e.g. model parameters): every
-    window op then moves the full tree in one jitted program — the
-    fusion-buffer equivalent (see :class:`_Window`).
+    window op then moves the full tree in one jitted program, and — with
+    ``fuse`` (default ``BLUEFOG_COMM_FUSION``, on) — over ONE flat buffer
+    per dtype instead of per-leaf buffers (see :class:`_Window`): the
+    full reference fusion-buffer equivalent.
 
     The topology is snapshotted at creation; like the reference
     (operations.cc:1286-1311), changing the topology while windows exist is
@@ -177,7 +209,7 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
             raise ValueError(
                 f"window tensors are global-view: expected leading dim "
                 f"{cx.size}, got {leaf.shape}")
-    _windows[name] = _Window(tensor, topo, zero_init)
+    _windows[name] = _Window(tensor, topo, zero_init, fuse=fuse)
     return True
 
 
@@ -450,14 +482,18 @@ def _update_matrix(topo: CompiledTopology,
 # ---------------------------------------------------------------------------
 
 def _win_input(tensor, w: "_Window"):
-    """Caller data -> global-view tree matching the window's leaf dtypes."""
+    """Caller data -> the window's INTERNAL global-view state: structure-
+    checked against the creation tree, leaves cast to the creation dtypes,
+    then packed into the fused flat buffers when the window is fused."""
     if jax.tree.structure(tensor) != w.treedef:
         raise ValueError(
             f"window tensor structure mismatch: window holds "
             f"{w.treedef}, got {jax.tree.structure(tensor)}")
-    return jax.tree.map(
-        lambda t, wt: _api.to_global(jnp.asarray(t, wt.dtype)),
-        tensor, w.tensor)
+    g = jax.tree.map(lambda t, wt: jnp.asarray(t, wt.dtype),
+                     tensor, w.template)
+    if w.plan is not None:
+        g = tuple(_fusion.flatten(w.plan, g))
+    return jax.tree.map(_api.to_global, g)
 
 
 def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
@@ -638,10 +674,10 @@ def win_update(name: str,
     if clone:
         # pure peek: no window state (tensor, buffers, versions, P) commits,
         # keeping x and its associated P consistent
-        return tensor_new
+        return w.external(tensor_new)
     w.tensor = tensor_new
     w.buffers, w.versions, w.p, w.p_buffers = out[1], out[2], out[3], out[4]
-    return tensor_new
+    return w.external(tensor_new)
 
 
 def win_update_then_collect(name: str, require_mutex: bool = True):
@@ -665,8 +701,9 @@ def win_publish(name: str, tensor) -> None:
 def win_fetch(name: str):
     """Current global-view window tensor (the reference mutates the
     registered torch tensor in place; JAX arrays are immutable, so read the
-    latest value here)."""
-    return _window(name).tensor
+    latest value here).  Fused windows unpack to the creation tree."""
+    w = _window(name)
+    return w.external(w.tensor)
 
 
 def win_poll(handle: int) -> bool:
@@ -745,16 +782,22 @@ def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
             raise ValueError(
                 f"window {name!r}: snapshot buffers {snap_shapes} do not "
                 f"match the registered window {win_shapes} "
-                f"(topology changed?)")
+                f"(topology or fusion layout changed? recreate the window "
+                f"with the same win_create(fuse=) setting the snapshot "
+                f"ran with)")
         sharding = _api.rank_sharding()
         # copy on load: the window will DONATE these arrays on TPU; the
         # caller's snapshot dict must stay valid afterwards
         put = lambda t: jax.device_put(jnp.array(t, copy=True), sharding)
-        # reconcile through the CREATION treedef: checkpoint layers may
-        # hand back a structurally different but leaf-compatible tree
-        # (orbax restores tuples as lists without a template)
+        # reconcile through the INTERNAL treedef (the creation tree for
+        # unfused windows, the flat dtype buckets for fused ones — the
+        # snapshot carries whatever layout the window ran with):
+        # checkpoint layers may hand back a structurally different but
+        # leaf-compatible tree (orbax restores tuples as lists without a
+        # template)
+        internal_def = jax.tree.structure(w.tensor)
         restore = lambda tree: jax.tree.unflatten(
-            w.treedef, [put(t) for t in jax.tree.leaves(tree)])
+            internal_def, [put(t) for t in jax.tree.leaves(tree)])
         w.tensor = restore(leaves["tensor"])
         w.buffers = restore(leaves["buffers"])
         w.versions = jnp.array(leaves["versions"], copy=True)

@@ -31,6 +31,7 @@ import optax
 
 from ..ops import api as _api
 from ..ops import collectives as C
+from ..ops import fusion as F
 from ..parallel.schedule import CompiledTopology, DynamicSchedule
 
 
@@ -48,20 +49,30 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  step,
                  machine_axes: Optional[Tuple[str, str]] = None,
                  machine_topo: Optional[CompiledTopology] = None,
-                 nar_backend: Optional[str] = None):
-    """Apply the configured averaging to every leaf of ``params``.
+                 nar_backend: Optional[str] = None,
+                 fuse: Optional[bool] = None,
+                 fusion_bucket_bytes: Optional[int] = None):
+    """Apply the configured averaging to ``params``.
 
     ``nar_backend``: exchange backend SNAPSHOT.  Builders capture it when
     the step is constructed (jit traces once and would otherwise freeze
     whatever the env said at first call — silently stale if the env
     changes later); ``None`` falls back to reading the env here.
+
+    ``fuse`` (default: ``BLUEFOG_COMM_FUSION``, on): run the exchange over
+    dtype-bucketed flat buffers (``ops/fusion.py``) — one collective per
+    bucket per offset instead of one per LEAF per offset.  Bit-exact
+    versus the per-leaf path (the averaging is elementwise-linear and
+    buckets never mix dtypes); ``fusion_bucket_bytes`` caps bucket size
+    for chunking/overlap.  Builders snapshot both like ``nar_backend``.
     """
     if comm_type == CommunicationType.empty:
         return params
+    do_fuse = F.fusion_enabled(fuse)
+    pad_to = 1
     if comm_type == CommunicationType.allreduce:
-        return jax.tree.map(lambda p: C.allreduce(p, axis_name, average=True),
-                            params)
-    if comm_type == CommunicationType.neighbor_allreduce:
+        fn = lambda p: C.allreduce(p, axis_name, average=True)
+    elif comm_type == CommunicationType.neighbor_allreduce:
         backend = nar_backend or _api._nar_backend()
         if backend.startswith("pallas"):
             # the training step rides the same fused concurrent-RDMA
@@ -69,29 +80,44 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
             # ops/api.py:165-171); float leaves only, like the kernel
             from ..ops import pallas_kernels as PK
             interp = backend == "pallas_interpret"
-            if sched is not None:
-                return jax.tree.map(
-                    lambda p: PK.fused_dynamic_neighbor_allreduce(
-                        p, axis_name, sched, step, interpret=interp), params)
-            return jax.tree.map(
-                lambda p: PK.fused_neighbor_allreduce(
-                    p, axis_name, topo, interpret=interp), params)
-        if sched is not None:
-            return jax.tree.map(
-                lambda p: C.dynamic_neighbor_allreduce(p, axis_name, sched, step),
-                params)
-        return jax.tree.map(
-            lambda p: C.neighbor_allreduce(p, axis_name, topo), params)
-    if comm_type == CommunicationType.hierarchical_neighbor_allreduce:
+            if do_fuse:
+                # flat buckets pre-padded to whole VMEM tiles: the kernel
+                # reshapes, it never pads (per-leaf `_as_tiles` waste gone)
+                pad_to = PK.FLAT_TILE
+                if sched is not None:
+                    fn = lambda p: PK.fused_dynamic_neighbor_allreduce_flat(
+                        p, axis_name, sched, step, interpret=interp)
+                else:
+                    fn = lambda p: PK.fused_neighbor_allreduce_flat(
+                        p, axis_name, topo, interpret=interp)
+            elif sched is not None:
+                fn = lambda p: PK.fused_dynamic_neighbor_allreduce(
+                    p, axis_name, sched, step, interpret=interp)
+            else:
+                fn = lambda p: PK.fused_neighbor_allreduce(
+                    p, axis_name, topo, interpret=interp)
+        elif sched is not None:
+            fn = lambda p: C.dynamic_neighbor_allreduce(
+                p, axis_name, sched, step)
+        else:
+            fn = lambda p: C.neighbor_allreduce(p, axis_name, topo)
+    elif comm_type == CommunicationType.hierarchical_neighbor_allreduce:
         machine_axis, local_axis = machine_axes
-        return jax.tree.map(
-            lambda p: C.hierarchical_neighbor_allreduce(
-                p, machine_axis, local_axis, machine_topo), params)
-    raise ValueError(f"Unsupported CommunicationType {comm_type}")
+        fn = lambda p: C.hierarchical_neighbor_allreduce(
+            p, machine_axis, local_axis, machine_topo)
+    else:
+        raise ValueError(f"Unsupported CommunicationType {comm_type}")
+    if do_fuse:
+        return F.fused_tree_map(fn, params,
+                                max_bucket_bytes=fusion_bucket_bytes,
+                                pad_to=pad_to)
+    return jax.tree.map(fn, params)
 
 
 def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
-                            accumulate_steps: int = 1):
+                            accumulate_steps: int = 1,
+                            fuse: Optional[bool] = None,
+                            fusion_bucket_bytes: Optional[int] = None):
     """Horovod-style synchronous data parallelism
     (reference _DistributedOptimizer, optimizers.py:166-294).
 
@@ -100,11 +126,23 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
     averaged update applies on every k-th — parameters never see raw local
     gradients, so ranks stay in lockstep.  With k > 1 the optimizer state is
     ``{"base": ..., "accum": ...}`` (see ``grad_accum_init``).
+
+    The gradient average rides the comm-fusion layer when ``fuse`` resolves
+    on (this is exactly the reference's Horovod-style fusion buffer): one
+    allreduce per dtype bucket instead of one per gradient leaf.
     """
+    do_fuse = F.fusion_enabled(fuse)
+
+    def _avg(tree):
+        f = lambda x: C.allreduce(x, axis_name, average=True)
+        if do_fuse:
+            return F.fused_tree_map(f, tree,
+                                    max_bucket_bytes=fusion_bucket_bytes)
+        return jax.tree.map(f, tree)
+
     if accumulate_steps <= 1:
         def step_fn(params, grads, opt_state, step=0):
-            g = jax.tree.map(
-                lambda x: C.allreduce(x, axis_name, average=True), grads)
+            g = _avg(grads)
             updates, opt_state = base.update(g, opt_state, params)
             return optax.apply_updates(params, updates), opt_state
         return step_fn
@@ -116,8 +154,7 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
         do_comm = (jnp.asarray(step) % k) == (k - 1)
 
         def comm_branch(p, acc, bs):
-            g = jax.tree.map(
-                lambda x: C.allreduce(x / k, axis_name, average=True), acc)
+            g = _avg(jax.tree.map(lambda x: x / k, acc))
             updates, bs_new = base.update(g, bs, p)
             p_new = optax.apply_updates(p, updates)
             return p_new, jax.tree.map(jnp.zeros_like, acc), bs_new
@@ -142,16 +179,19 @@ def grad_accum_init(base: optax.GradientTransformation, params):
 def consensus_step(base: optax.GradientTransformation,
                    comm_type: CommunicationType, axis_name,
                    topo=None, sched=None, machine_axes=None,
-                   machine_topo=None, nar_backend=None):
+                   machine_topo=None, nar_backend=None, fuse=None,
+                   fusion_bucket_bytes=None):
     """Consensus/CTA/AWC family (reference _DistributedReduceOptimizer,
     optimizers.py:297-482): average the *weights*, apply the local update
-    computed from gradients at the pre-average point."""
+    computed from gradients at the pre-average point.  Only the exchange
+    is fused (``fuse``); the optimizer state stays per-leaf."""
     nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
 
     def step_fn(params, grads, opt_state, step=0):
         averaged = _communicate(params, comm_type, axis_name, topo, sched,
                                 step, machine_axes, machine_topo,
-                                nar_backend)
+                                nar_backend, fuse, fusion_bucket_bytes)
         updates, opt_state = base.update(grads, opt_state, averaged)
         return optax.apply_updates(averaged, updates), opt_state
 
@@ -161,20 +201,22 @@ def consensus_step(base: optax.GradientTransformation,
 def atc_step(base: optax.GradientTransformation,
              comm_type: CommunicationType, axis_name,
              topo=None, sched=None, machine_axes=None, machine_topo=None,
-             nar_backend=None):
+             nar_backend=None, fuse=None, fusion_bucket_bytes=None):
     """Adapt-then-combine (reference _DistributedAdaptThenCombineOptimizer,
     optimizers.py:485-841): local update first, then average the updated
     weights.  The reference re-implements each torch optimizer's math inside
     the gradient hook; with optax the base transformation is already a pure
-    function, so ATC is just the other composition order."""
+    function, so ATC is just the other composition order.  Only the
+    exchange is fused (``fuse``); the optimizer state stays per-leaf."""
     nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, opt_state = base.update(grads, opt_state, params)
         adapted = optax.apply_updates(params, updates)
         combined = _communicate(adapted, comm_type, axis_name, topo, sched,
                                 step, machine_axes, machine_topo,
-                                nar_backend)
+                                nar_backend, fuse, fusion_bucket_bytes)
         return combined, opt_state
 
     return step_fn
@@ -183,7 +225,8 @@ def atc_step(base: optax.GradientTransformation,
 def exact_diffusion_step(base: optax.GradientTransformation,
                          comm_type: CommunicationType, axis_name,
                          topo=None, sched=None, machine_axes=None,
-                         machine_topo=None, nar_backend=None):
+                         machine_topo=None, nar_backend=None, fuse=None,
+                         fusion_bucket_bytes=None):
     """Exact-Diffusion (a.k.a. D2): the bias-corrected diffusion recursion
     from the reference authors' own line of work (Yuan/Ying et al.; no
     reference-code counterpart — a beyond-parity strategy):
@@ -199,8 +242,10 @@ def exact_diffusion_step(base: optax.GradientTransformation,
     the true global optimum (asserted against closed form in
     tests/test_optimizers.py::test_exact_diffusion_removes_diffusion_bias).
     State: ``{"base": ..., "psi_prev": ...}`` (psi_prev starts at x_0, so
-    the first step reduces to plain ATC — the standard initialization)."""
+    the first step reduces to plain ATC — the standard initialization).
+    Only the phi exchange is fused (``fuse``); psi_prev stays per-leaf."""
     nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, base_new = base.update(grads, opt_state["base"], params)
@@ -209,7 +254,7 @@ def exact_diffusion_step(base: optax.GradientTransformation,
                            psi, params, opt_state["psi_prev"])
         combined = _communicate(phi, comm_type, axis_name, topo, sched,
                                 step, machine_axes, machine_topo,
-                                nar_backend)
+                                nar_backend, fuse, fusion_bucket_bytes)
         return combined, {"base": base_new, "psi_prev": psi}
 
     return step_fn

@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..context import ctx
 from ..ops import api as _api
+from ..ops import fusion as _fusion
 from ..ops import windows as W
 from ..parallel.schedule import DynamicSchedule
 from . import strategies as S
@@ -62,12 +63,21 @@ class _JittedStrategyOptimizer:
                  gradient_allreduce: bool = False,
                  exact_diffusion: bool = False,
                  num_steps_per_communication: int = 1,
-                 sched: Optional[DynamicSchedule] = None):
+                 sched: Optional[DynamicSchedule] = None,
+                 fuse: Optional[bool] = None,
+                 fusion_bucket_bytes: Optional[int] = None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
         self.gradient_allreduce = gradient_allreduce
         self.exact_diffusion = exact_diffusion
+        # comm-fusion knobs (ops/fusion.py): only the EXCHANGE fuses into
+        # flat dtype buckets; optimizer state (momentum, psi_prev, accum)
+        # stays per-leaf.  None = resolve from BLUEFOG_COMM_FUSION /
+        # BLUEFOG_FUSION_BUCKET_BYTES at step-build time (the resolved
+        # values join the step-cache key, like the exchange backend).
+        self.fuse = fuse
+        self.fusion_bucket_bytes = fusion_bucket_bytes
         if exact_diffusion and num_steps_per_communication != 1:
             raise ValueError(
                 "exact-diffusion's correction assumes one exchange per "
@@ -104,9 +114,13 @@ class _JittedStrategyOptimizer:
         if hierarchical:
             machine_topo = cx.compiled_machine_topology
 
+        fuse = _fusion.fusion_enabled(self.fuse)
+        bucket_bytes = _fusion.resolve_max_bucket_bytes(
+            self.fusion_bucket_bytes)
         if self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
-                self.base, cx.rank_axis, accumulate_steps=self.k)
+                self.base, cx.rank_axis, accumulate_steps=self.k,
+                fuse=fuse, fusion_bucket_bytes=bucket_bytes)
         elif self.exact_diffusion:
             if self.comm_type not in (
                     CommunicationType.neighbor_allreduce,
@@ -120,14 +134,16 @@ class _JittedStrategyOptimizer:
                 self.base, self.comm_type, cx.rank_axis, topo=topo,
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
-                machine_topo=machine_topo)
+                machine_topo=machine_topo, fuse=fuse,
+                fusion_bucket_bytes=bucket_bytes)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
                 self.base, self.comm_type, cx.rank_axis, topo=topo,
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
-                machine_topo=machine_topo)
+                machine_topo=machine_topo, fuse=fuse,
+                fusion_bucket_bytes=bucket_bytes)
         if not (self.gradient_allreduce or self.exact_diffusion):
             # grad-allreduce accumulates internally; exact-diffusion is
             # one-exchange-per-step by construction
@@ -162,6 +178,8 @@ class _JittedStrategyOptimizer:
                id(cx._compiled),
                id(cx._compiled_machine),
                _api._nar_backend(),
+               _fusion.fusion_enabled(self.fuse),
+               _fusion.resolve_max_bucket_bytes(self.fusion_bucket_bytes),
                jax.tree.structure(params))
         if key not in self._step_cache:
             self._step_cache[key] = self._build(key)
@@ -169,63 +187,76 @@ class _JittedStrategyOptimizer:
                                      jnp.asarray(step, jnp.int32))
 
 
-def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1):
+def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
+                                          fuse=None, fusion_bucket_bytes=None):
     """Synchronous Horovod-style gradient averaging
     (optimizers.py:1376; internal _DistributedOptimizer:166-294)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.empty, gradient_allreduce=True,
-        num_steps_per_communication=num_steps_per_communication)
+        num_steps_per_communication=num_steps_per_communication,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
-def DistributedAllreduceOptimizer(base, num_steps_per_communication=1):
+def DistributedAllreduceOptimizer(base, num_steps_per_communication=1,
+                                  fuse=None, fusion_bucket_bytes=None):
     """CTA with global weight averaging (optimizers.py:1301)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.allreduce,
-        num_steps_per_communication=num_steps_per_communication)
+        num_steps_per_communication=num_steps_per_communication,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
-                                          sched: Optional[DynamicSchedule] = None):
+                                          sched: Optional[DynamicSchedule] = None,
+                                          fuse=None, fusion_bucket_bytes=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
     decentralized optimizer (optimizers.py:1326)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.neighbor_allreduce,
-        num_steps_per_communication=num_steps_per_communication, sched=sched)
+        num_steps_per_communication=num_steps_per_communication, sched=sched,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
-        base, num_steps_per_communication=1):
+        base, num_steps_per_communication=1, fuse=None,
+        fusion_bucket_bytes=None):
     """CTA with machine-level neighbor averaging (optimizers.py:1352)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.hierarchical_neighbor_allreduce,
-        num_steps_per_communication=num_steps_per_communication)
+        num_steps_per_communication=num_steps_per_communication,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 def DistributedAdaptThenCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
-        sched: Optional[DynamicSchedule] = None):
+        sched: Optional[DynamicSchedule] = None,
+        fuse=None, fusion_bucket_bytes=None):
     """ATC: local update inside the step, then communicate the adapted
     weights (optimizers.py:1426; internal :485-841)."""
     return _JittedStrategyOptimizer(
         base, communication_type, atc=True,
-        num_steps_per_communication=num_steps_per_communication, sched=sched)
+        num_steps_per_communication=num_steps_per_communication, sched=sched,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 def DistributedAdaptWithCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
-        sched: Optional[DynamicSchedule] = None):
+        sched: Optional[DynamicSchedule] = None,
+        fuse=None, fusion_bucket_bytes=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
     runs the collective and the update math in parallel."""
     return _JittedStrategyOptimizer(
         base, communication_type, atc=False,
-        num_steps_per_communication=num_steps_per_communication, sched=sched)
+        num_steps_per_communication=num_steps_per_communication, sched=sched,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 def DistributedExactDiffusionOptimizer(
-        base, communication_type=CommunicationType.neighbor_allreduce):
+        base, communication_type=CommunicationType.neighbor_allreduce,
+        fuse=None, fusion_bucket_bytes=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -240,7 +271,8 @@ def DistributedExactDiffusionOptimizer(
     not accepted; use the neighbor-CTA/ATC families for time-varying
     graphs."""
     return _JittedStrategyOptimizer(
-        base, communication_type, exact_diffusion=True)
+        base, communication_type, exact_diffusion=True,
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 from .. import timeline as _tl
 from ..context import ctx
 from ..ops import collectives as C
+from ..ops import fusion as _fusion
 from ..parallel.schedule import CompiledTopology
 from . import faults as _faults
 from . import membership as _mem
@@ -115,12 +116,18 @@ class ChaosHarness:
     to a per-rank quadratic toward seeded targets.  ``base_opt`` defaults
     to SGD(0.1).  Thresholds come from ``cfg``
     (:class:`~bluefog_tpu.resilience.membership.LivenessConfig`).
+
+    ``fuse`` (default ``BLUEFOG_COMM_FUSION``, on): the per-step parameter
+    gather + consensus mix run over dtype-bucketed flat buffers
+    (``ops/fusion.py``) — one allgather per bucket instead of one per
+    parameter leaf, bit-exact (the mix is elementwise-linear).
     """
 
     def __init__(self, plan, *, base_opt=None,
                  topo: Optional[CompiledTopology] = None,
                  cfg: Optional[_mem.LivenessConfig] = None,
-                 loss_fn: Optional[Callable] = None):
+                 loss_fn: Optional[Callable] = None,
+                 fuse: Optional[bool] = None):
         if isinstance(plan, _faults.FaultPlan):
             plan = plan.compile()
         self.plan: _faults.CompiledFaultPlan = plan
@@ -133,6 +140,8 @@ class ChaosHarness:
         self.cfg = cfg or _mem.LivenessConfig()
         self.base_opt = base_opt or optax.sgd(0.1)
         self.loss_fn = loss_fn or _default_quadratic
+        # snapshot at construction (the chaos step compiles once)
+        self.fuse = _fusion.fusion_enabled(fuse)
         self._step_fn = None
 
     # -- the one jitted chaos step ------------------------------------------
@@ -140,6 +149,7 @@ class ChaosHarness:
     def _build_step(self):
         cx, topo, cfg = self.cx, self.topo, self.cfg
         base_opt, loss_fn = self.base_opt, self.loss_fn
+        fuse = self.fuse
         axis = cx.rank_axis
         n = topo.size
         W0 = topo.weight_matrix
@@ -164,14 +174,22 @@ class ChaosHarness:
             loss, grads = jax.value_and_grad(loss_fn)(x, b)
 
             # 3. outgoing values: corruption rides the wire; receivers
-            #    drop non-finite contributions (finite-guard)
+            #    drop non-finite contributions (finite-guard).  Under
+            #    fusion the gather moves dtype-bucketed flat buffers —
+            #    one allgather per bucket, not per leaf.
             out_x = jax.tree.map(
                 lambda l: l * corrupt[idx].astype(l.dtype), x)
+            if fuse:
+                fplan = _fusion.plan_for(out_x)
+                x_bufs = _fusion.flatten(fplan, x)
+                out_bufs = _fusion.flatten(fplan, out_x)
+            else:
+                fplan, x_bufs = None, jax.tree.leaves(x)
+                out_bufs = jax.tree.leaves(out_x)
             finite_own = jnp.asarray(True)
-            for leaf in jax.tree.leaves(out_x):
+            for leaf in out_bufs:
                 finite_own &= jnp.isfinite(leaf).all()
-            gathered = jax.tree.map(lambda l: C.allgather(l[None], axis),
-                                    out_x)
+            gathered_bufs = [C.allgather(l[None], axis) for l in out_bufs]
             finite = C.allgather(finite_own[None], axis)      # [N]
 
             # 4. this rank's repaired receive column (traced surgery):
@@ -192,12 +210,17 @@ class ChaosHarness:
             neigh_col = col.at[idx].set(0.0)
             # zero-weight is not enough against NaN (0 * NaN = NaN): scrub
             # non-finite contributions out of the gathered values too
-            mixed = jax.tree.map(
-                lambda g, l: (jnp.tensordot(
-                    neigh_col.astype(l.dtype),
-                    jnp.where(jnp.isfinite(g), g, 0), axes=1)
-                              + self_w.astype(l.dtype) * l),
-                gathered, x)
+            mix_one = lambda g, l: (jnp.tensordot(
+                neigh_col.astype(l.dtype),
+                jnp.where(jnp.isfinite(g), g, 0), axes=1)
+                                    + self_w.astype(l.dtype) * l)
+            mixed_bufs = [mix_one(g, l)
+                          for g, l in zip(gathered_bufs, x_bufs)]
+            if fuse:
+                mixed = _fusion.unflatten(fplan, mixed_bufs)
+            else:
+                mixed = jax.tree.unflatten(jax.tree.structure(x),
+                                           mixed_bufs)
             updates, st_new = base_opt.update(grads, st, mixed)
             x_new = optax.apply_updates(mixed, updates)
 

@@ -62,13 +62,16 @@ _FLAG_PROBE_CACHE: Dict[str, bool] = {}
 
 def _probe_cache_path() -> str:
     """On-disk probe verdicts, keyed by jaxlib version (flag support only
-    changes with the XLA build): one process pays the probe, every later
-    pytest session / launcher / example reads the file."""
+    changes with the XLA build) AND uid: one process pays the probe, every
+    later pytest session / launcher / example reads the file.  Per-user,
+    not world-shared — on a multi-user host a shared /tmp file would be
+    poisonable by (and unwritable over from) other accounts."""
     import jaxlib
     import tempfile
     ver = getattr(jaxlib, "__version__", "unknown").replace("/", "_")
+    uid = os.getuid() if hasattr(os, "getuid") else 0
     return os.path.join(tempfile.gettempdir(),
-                        f"bluefog_xla_flag_probe_{ver}.json")
+                        f"bluefog_xla_flag_probe_u{uid}_{ver}.json")
 
 
 def _load_probe_cache() -> None:

@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .context import ctx
 from .ops import api as _api
+from .ops import fusion as _fusion
 from .optim import strategies as S
 from .optim._plumbing import mesh_plumbing
 from .parallel.schedule import DynamicSchedule
@@ -76,7 +77,9 @@ def make_train_step(model,
                     sched: Optional[DynamicSchedule] = None,
                     num_steps_per_communication: int = 1,
                     donate: bool = True,
-                    check_vma: Optional[bool] = None):
+                    check_vma: Optional[bool] = None,
+                    fuse: Optional[bool] = None,
+                    fusion_bucket_bytes: Optional[int] = None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -85,6 +88,13 @@ def make_train_step(model,
     (bias-corrected ATC, static topology only — create the opt_state with
     ``create_train_state(..., communication="exact_diffusion")``),
     ``empty`` (local only).
+
+    ``fuse`` (default: ``BLUEFOG_COMM_FUSION``, on): run the exchange over
+    dtype-bucketed flat buffers (``ops/fusion.py``) — collective count per
+    step drops from ``leaves x offsets`` to ``buckets x offsets`` with
+    bit-exact results; ``fusion_bucket_bytes`` tunes the bucket cap
+    (``docs/performance.md``).  Both snapshot at build time, like the
+    exchange backend.
 
     Returns ``train_step(variables, opt_state, batch, step) ->
     (variables, opt_state, loss)`` where ``batch = (x, y)`` with leading
@@ -114,10 +124,13 @@ def make_train_step(model,
     ) else None
     machine_topo = cx.compiled_machine_topology if hierarchical else None
 
-    # the exchange backend binds when the step is BUILT (jit traces once;
-    # reading the env at trace time would freeze whatever the first call
-    # saw and silently ignore later env changes)
+    # the exchange backend and fusion knobs bind when the step is BUILT
+    # (jit traces once; reading the env at trace time would freeze whatever
+    # the first call saw and silently ignore later env changes)
     nar_backend = _api._nar_backend()
+    fuse = _fusion.fusion_enabled(fuse)
+    fusion_bucket_bytes = _fusion.resolve_max_bucket_bytes(
+        fusion_bucket_bytes)
     if check_vma is None:
         # any pallas kernel inside the shard_map needs vma checking off
         # (kernel-internal scratch carries no varying-axes tags): the
@@ -136,7 +149,9 @@ def make_train_step(model,
                 "gradient accumulation (num_steps_per_communication > 1 with "
                 "gradient_allreduce) needs the accumulator state — use "
                 "bf.DistributedGradientAllreduceOptimizer instead")
-        core = S.gradient_allreduce_step(base_opt, cx.rank_axis)
+        core = S.gradient_allreduce_step(
+            base_opt, cx.rank_axis, fuse=fuse,
+            fusion_bucket_bytes=fusion_bucket_bytes)
     elif exact_diffusion:
         if num_steps_per_communication > 1:
             raise ValueError("exact_diffusion assumes one exchange per "
@@ -148,13 +163,15 @@ def make_train_step(model,
             base_opt, comm_type, cx.rank_axis,
             topo=S.exact_diffusion_topology(cx.compiled_topology),
             machine_axes=(cx.machine_axis, cx.local_axis),
-            machine_topo=machine_topo, nar_backend=nar_backend)
+            machine_topo=machine_topo, nar_backend=nar_backend,
+            fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
     else:
         builder = S.atc_step if atc else S.consensus_step
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
                        sched=sched,
                        machine_axes=(cx.machine_axis, cx.local_axis),
-                       machine_topo=machine_topo, nar_backend=nar_backend)
+                       machine_topo=machine_topo, nar_backend=nar_backend,
+                       fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
     if not exact_diffusion:
         core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
                                   num_steps_per_communication)

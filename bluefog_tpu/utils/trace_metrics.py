@@ -1,0 +1,66 @@
+"""Trace-level collective metrics: count communication ops in lowered HLO.
+
+The comm-fusion layer's headline claim — a step's collective count drops
+from ``leaves x offsets`` to ``buckets x offsets`` — is a property of the
+COMPILED program, measurable on any backend (the StableHLO is produced at
+lowering time, before backend-specific compilation).  This module is the
+single home for that proof: ``tests/test_fusion.py`` asserts regression
+bounds with it and ``bench.py --trace-only`` / ``make bench-trace`` report
+it as a CPU-only benchmark mode.
+
+Counting convention: one occurrence of the StableHLO op mnemonic = one
+collective in the program.  ``lax.ppermute`` lowers to
+``stablehlo.collective_permute``, ``psum``/``pmean`` to
+``stablehlo.all_reduce``, ``all_gather`` to ``stablehlo.all_gather``
+(pmean's mean division is elementwise math, not a second collective).
+"""
+
+import re
+import time
+from typing import Any, Dict, Tuple
+
+import jax
+
+__all__ = ["collective_counts", "count_collectives_in_text", "lower_text"]
+
+# op-name mnemonics in jax's StableHLO output; matched with a word
+# boundary so e.g. all_gather never double-counts all_reduce
+_PATTERNS = {
+    "ppermute": re.compile(r"\bstablehlo\.collective_permute\b"),
+    "all_reduce": re.compile(r"\bstablehlo\.all_reduce\b"),
+    "all_gather": re.compile(r"\bstablehlo\.all_gather\b"),
+    "all_to_all": re.compile(r"\bstablehlo\.all_to_all\b"),
+    "reduce_scatter": re.compile(r"\bstablehlo\.reduce_scatter\b"),
+}
+
+
+def count_collectives_in_text(text: str) -> Dict[str, int]:
+    """Per-kind collective-op counts in a StableHLO module string."""
+    counts = {kind: len(pat.findall(text)) for kind, pat in _PATTERNS.items()}
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def lower_text(fn, *args, **kwargs) -> Tuple[str, float]:
+    """Lower ``fn(*args, **kwargs)`` to StableHLO text; returns
+    ``(text, trace_seconds)``.  Accepts an already-jitted callable (has
+    ``.lower``) or a plain one (wrapped in ``jax.jit`` first).  Lowering
+    only TRACES — no backend compile happens, so this is cheap and runs
+    identically on CPU."""
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    t0 = time.perf_counter()
+    lowered = fn.lower(*args, **kwargs)
+    text = lowered.as_text()
+    return text, time.perf_counter() - t0
+
+
+def collective_counts(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Counts of every collective kind in the lowered program, plus
+    ``trace_s`` (wall-clock tracing+lowering time) and ``hlo_lines``
+    (program size — fusion shrinks this too)."""
+    text, trace_s = lower_text(fn, *args, **kwargs)
+    out: Dict[str, Any] = count_collectives_in_text(text)
+    out["trace_s"] = trace_s
+    out["hlo_lines"] = text.count("\n")
+    return out

@@ -1,7 +1,7 @@
 """Mock bench.py for the fake-transport hw_queue integration test.
 
 Writes the same provenance-log lines the real bench writes (start line
-with the fused flag + config, RESULT / partial RESULT / FAIL) so the
+with the fused flag + config, RESULT / partial RESULT / SKIP) so the
 REAL scripts/fused_verdict.py downstream of the two bench stages pairs
 or refuses exactly as it would on hardware.  Behavior comes from argv
 (the PATH shim forwards the `.behavior` spec): ``ok <img_s>``,
@@ -36,12 +36,14 @@ def main():
         value = round(value * 1.04, 1)   # distinct sides -> a real speedup
     line(f"start attempt 1: {CFG}")
     if behavior == "fail":
-        err = {"metric": METRIC, "value": 0.0, "unit": "img/sec/chip",
-               "vs_baseline": 0.0,
-               "error": "accelerator backend unreachable (mock)"}
-        line(f"FAIL {json.dumps(err)}")
-        print(json.dumps(err))
-        sys.exit(3)
+        # mirrors the real watchdog: an unreachable backend is a SKIP
+        # record (exit 0, no value key) — never a value-0.0 "measurement"
+        skip = {"metric": METRIC, "status": "skipped",
+                "unit": "img/sec/chip",
+                "reason": "accelerator backend unreachable (mock)"}
+        line(f"SKIP {json.dumps(skip)}")
+        print(json.dumps(skip))
+        sys.exit(0)
     out = {"metric": METRIC, "value": value, "unit": "img/sec/chip",
            "vs_baseline": round(value / 269.4, 3), "communication": "none",
            "timing": "two-window-differenced"}

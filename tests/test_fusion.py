@@ -1,0 +1,507 @@
+"""Fused flat-buffer communication path vs the per-leaf path.
+
+The comm-fusion layer (``ops/fusion.py``) must be EXACTLY equivalent to
+per-leaf execution — the averaging is elementwise-linear and buckets never
+mix dtypes, so same-dtype results are bit-identical — while dropping the
+compiled collective count from ``leaves x offsets`` to
+``buckets x offsets`` (asserted on the StableHLO via
+``utils/trace_metrics.py``; CPU-only, no TPU needed).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import fusion as F
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.optim._plumbing import mesh_plumbing
+from bluefog_tpu.utils import trace_metrics as TM
+
+from conftest import N_DEVICES as N
+
+CT = S.CommunicationType
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+def ragged_tree(seed=0, n=N):
+    """Global-view pytree with odd shapes, mixed f32/bf16, a scalar leaf,
+    and an EMPTY leaf — the shapes tensor fusion has to survive."""
+    rng = np.random.default_rng(seed)
+    r = lambda *s: jnp.asarray(rng.normal(size=(n,) + s), jnp.float32)
+    rb = lambda *s: jnp.asarray(rng.normal(size=(n,) + s), jnp.bfloat16)
+    return {
+        "a": r(3, 5),
+        "b": rb(7),
+        "scalar": r(),
+        "nested": {"w": r(2, 2, 2), "empty": r(0, 4), "v": rb(5, 3)},
+    }
+
+
+def wide_tree(n_f32=20, n_bf16=4, n=N, seed=1):
+    """>= 20-leaf tree for the acceptance-criteria op-count assert."""
+    rng = np.random.default_rng(seed)
+    tree = {}
+    for i in range(n_f32):
+        tree[f"f{i}"] = jnp.asarray(rng.normal(size=(n, 3 + i % 4)),
+                                    jnp.float32)
+    for i in range(n_bf16):
+        tree[f"h{i}"] = jnp.asarray(rng.normal(size=(n, 5, 1 + i % 3)),
+                                    jnp.bfloat16)
+    return tree
+
+
+def comm_harness(cx, comm_type, fuse, topo=None, sched=None,
+                 backend="xla"):
+    """jit(shard_map(_communicate)) over the 1-D rank mesh."""
+    spec = P(cx.rank_axis)
+
+    def stepper(tree, step):
+        def shard_fn(ts, si):
+            per = jax.tree.map(lambda a: a[0], ts)
+            out = S._communicate(per, comm_type, cx.rank_axis, topo, sched,
+                                 si, None, None, backend, fuse=fuse)
+            return jax.tree.map(lambda a: a[None], out)
+        return jax.shard_map(shard_fn, mesh=cx.mesh,
+                             in_specs=(spec, P()), out_specs=spec)(tree, step)
+    return jax.jit(stepper)
+
+
+def hier_harness(cx, fuse):
+    """2-D (machine, local) mesh harness for the hierarchical mode."""
+    pl = mesh_plumbing(cx, hierarchical=True)
+
+    def stepper(tree, step):
+        def shard_fn(ts, si):
+            out = S._communicate(
+                pl.unwrap(ts), CT.hierarchical_neighbor_allreduce,
+                cx.rank_axis, None, None, si,
+                (cx.machine_axis, cx.local_axis),
+                cx.compiled_machine_topology, "xla", fuse=fuse)
+            return pl.rewrap(out)
+        return jax.shard_map(shard_fn, mesh=pl.mesh,
+                             in_specs=(pl.spec, P()),
+                             out_specs=pl.spec)(pl.reshape_in(tree), step)
+    return jax.jit(stepper)
+
+
+def assert_trees_bitexact(a, b):
+    def eq(x, y):
+        assert x.shape == y.shape and x.dtype == y.dtype, (
+            f"signature mismatch {x.shape}/{x.dtype} vs {y.shape}/{y.dtype}")
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"max |diff| = "
+            f"{np.abs(np.asarray(x, np.float64) - np.asarray(y, np.float64)).max()}")
+    jax.tree.map(eq, a, b)
+
+
+def one_peer_sched(n=N):
+    topo = bf.load_topology()
+    return bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+
+# ---------------------------------------------------------------------------
+# plan unit tests
+# ---------------------------------------------------------------------------
+
+def test_plan_buckets_by_dtype():
+    tree = ragged_tree()
+    plan = F.plan_for(tree, leading_dims=1)
+    assert plan.n_buckets == 2          # f32 + bf16 at the default cap
+    dtypes = {b.dtype for b in plan.buckets}
+    assert dtypes == {jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)}
+    # the empty leaf rides no bucket
+    assert sum(1 for s in plan.slots if s.bucket < 0) == 1
+
+
+def test_plan_chunks_at_bucket_cap():
+    tree = ragged_tree()
+    # 16-byte cap (4 f32 elems): every f32 leaf larger than the cap gets
+    # its own bucket; chunking never splits a leaf
+    plan = F.plan_for(tree, leading_dims=1, max_bucket_bytes=16)
+    assert plan.n_buckets > 2
+    for slot in plan.slots:
+        if slot.bucket >= 0:
+            assert slot.size <= plan.buckets[slot.bucket].nelems
+
+
+def test_flatten_unflatten_roundtrip():
+    tree = ragged_tree()
+    for kwargs in ({"leading_dims": 1},
+                   {"leading_dims": 1, "pad_to": 1024},
+                   {"leading_dims": 1, "max_bucket_bytes": 64}):
+        plan = F.plan_for(tree, **kwargs)
+        assert_trees_bitexact(tree, F.unflatten(plan, F.flatten(plan, tree)))
+
+
+def test_fused_tree_map_rejects_signature_changes():
+    tree = {"a": jnp.ones((4, 4))}
+    with pytest.raises(ValueError, match="shape- and dtype-preserving"):
+        F.fused_tree_map(lambda b: b.astype(jnp.bfloat16), tree)
+
+
+def test_fusion_enabled_resolution(monkeypatch):
+    monkeypatch.delenv("BLUEFOG_COMM_FUSION", raising=False)
+    assert F.fusion_enabled(None) is True          # default on
+    monkeypatch.setenv("BLUEFOG_COMM_FUSION", "0")
+    assert F.fusion_enabled(None) is False
+    assert F.fusion_enabled(True) is True          # explicit beats env
+
+
+# ---------------------------------------------------------------------------
+# exact equivalence: every CommunicationType x {static, dynamic,
+# hierarchical} on the ragged tree
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["static", "dynamic"])
+@pytest.mark.parametrize("comm", [CT.neighbor_allreduce, CT.allreduce,
+                                  CT.empty])
+def test_communicate_fused_matches_perleaf(bf_ctx, comm, mode):
+    if comm != CT.neighbor_allreduce and mode == "dynamic":
+        pytest.skip("dynamic schedules apply to neighbor_allreduce only")
+    tree = ragged_tree()
+    topo = bf_ctx.compiled_topology if mode == "static" else None
+    sched = one_peer_sched() if mode == "dynamic" else None
+    step = jnp.int32(3)
+    out_ref = comm_harness(bf_ctx, comm, False, topo, sched)(tree, step)
+    out_fused = comm_harness(bf_ctx, comm, True, topo, sched)(tree, step)
+    assert_trees_bitexact(out_ref, out_fused)
+
+
+def test_communicate_fused_matches_perleaf_hierarchical(bf_ctx_machines):
+    bf.set_machine_topology(
+        bf.RingGraph(bf_ctx_machines.machine_size), is_weighted=True)
+    tree = ragged_tree()
+    out_ref = hier_harness(bf_ctx_machines, False)(tree, jnp.int32(0))
+    out_fused = hier_harness(bf_ctx_machines, True)(tree, jnp.int32(0))
+    assert_trees_bitexact(out_ref, out_fused)
+
+
+def test_dynamic_fused_steps_track_schedule(bf_ctx):
+    """The step index stays data under fusion: one compiled program, the
+    per-step weight tables still select the right edges."""
+    tree = ragged_tree()
+    sched = one_peer_sched()
+    fused = comm_harness(bf_ctx, CT.neighbor_allreduce, True, None, sched)
+    ref = comm_harness(bf_ctx, CT.neighbor_allreduce, False, None, sched)
+    for t in range(min(sched.period, 3)):
+        assert_trees_bitexact(ref(tree, jnp.int32(t)),
+                              fused(tree, jnp.int32(t)))
+    assert fused._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# HLO collective-count regression (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_hlo_ppermute_count_drops_to_buckets_times_offsets(bf_ctx):
+    tree = wide_tree()
+    n_leaves = len(jax.tree.leaves(tree))
+    assert n_leaves >= 20
+    topo = bf_ctx.compiled_topology
+    K = len(topo.offsets)
+    plan = F.plan_for(jax.tree.map(lambda a: a[0], tree))
+    assert plan.n_buckets == 2          # two dtypes at the default cap
+
+    per_leaf = TM.collective_counts(
+        comm_harness(bf_ctx, CT.neighbor_allreduce, False, topo),
+        tree, jnp.int32(0))
+    fused = TM.collective_counts(
+        comm_harness(bf_ctx, CT.neighbor_allreduce, True, topo),
+        tree, jnp.int32(0))
+    assert per_leaf["ppermute"] == n_leaves * K
+    assert fused["ppermute"] == plan.n_buckets * K
+    assert fused["hlo_lines"] < per_leaf["hlo_lines"]
+
+
+def test_hlo_ppermute_count_dynamic(bf_ctx):
+    tree = wide_tree()
+    sched = one_peer_sched()
+    K = len(sched.offsets)
+    plan = F.plan_for(jax.tree.map(lambda a: a[0], tree))
+    per_leaf = TM.collective_counts(
+        comm_harness(bf_ctx, CT.neighbor_allreduce, False, None, sched),
+        tree, jnp.int32(0))
+    fused = TM.collective_counts(
+        comm_harness(bf_ctx, CT.neighbor_allreduce, True, None, sched),
+        tree, jnp.int32(0))
+    assert per_leaf["ppermute"] == len(jax.tree.leaves(tree)) * K
+    assert fused["ppermute"] == plan.n_buckets * K
+
+
+def test_hlo_allreduce_count_fused(bf_ctx):
+    tree = wide_tree()
+    plan = F.plan_for(jax.tree.map(lambda a: a[0], tree))
+    per_leaf = TM.collective_counts(
+        comm_harness(bf_ctx, CT.allreduce, False), tree, jnp.int32(0))
+    fused = TM.collective_counts(
+        comm_harness(bf_ctx, CT.allreduce, True), tree, jnp.int32(0))
+    assert per_leaf["all_reduce"] == len(jax.tree.leaves(tree))
+    assert fused["all_reduce"] == plan.n_buckets
+
+
+def test_compile_cache_hit_when_only_weights_change(bf_ctx):
+    """Same structure, different values -> one compiled program."""
+    fused = comm_harness(bf_ctx, CT.neighbor_allreduce, True,
+                         bf_ctx.compiled_topology)
+    fused(ragged_tree(seed=0), jnp.int32(0))
+    fused(ragged_tree(seed=42), jnp.int32(7))
+    assert fused._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# full-stack equivalence: strategies through the public wrappers
+# ---------------------------------------------------------------------------
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    params = {"w": jnp.asarray(rng.normal(size=(N, 5)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(N, 3)), jnp.float32),
+              "h": jnp.asarray(rng.normal(size=(N, 4)), jnp.bfloat16)}
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), p.dtype), params)
+    return params, grads
+
+
+def _run_opt(opt, params, grads, steps=4):
+    state = opt.init(params)
+    for t in range(steps):
+        params, state = opt.step(params, grads, state, step=t)
+    return params
+
+
+@pytest.mark.parametrize("factory", [
+    bf.DistributedNeighborAllreduceOptimizer,
+    bf.DistributedAllreduceOptimizer,
+    bf.DistributedGradientAllreduceOptimizer,
+    bf.DistributedAdaptThenCombineOptimizer,
+    bf.DistributedExactDiffusionOptimizer,
+])
+def test_wrapper_fused_matches_perleaf(bf_ctx, factory):
+    if factory is bf.DistributedExactDiffusionOptimizer:
+        bf.set_topology(bf.SymmetricExponentialGraph(N))
+    params, grads = _problem()
+    base = optax.sgd(0.1, momentum=0.9)
+    out_ref = _run_opt(factory(base, fuse=False), params, grads)
+    out_fused = _run_opt(factory(base, fuse=True), params, grads)
+    assert_trees_bitexact(out_ref, out_fused)
+
+
+def test_wrapper_hierarchical_fused_matches_perleaf(bf_ctx_machines):
+    bf.set_machine_topology(
+        bf.RingGraph(bf_ctx_machines.machine_size), is_weighted=True)
+    params, grads = _problem()
+    base = optax.sgd(0.1)
+    ref = _run_opt(bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        base, fuse=False), params, grads)
+    fused = _run_opt(bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        base, fuse=True), params, grads)
+    assert_trees_bitexact(ref, fused)
+
+
+def test_wrapper_dynamic_sched_fused_matches_perleaf(bf_ctx):
+    params, grads = _problem()
+    sched = one_peer_sched()
+    base = optax.sgd(0.05)
+    ref = _run_opt(bf.DistributedNeighborAllreduceOptimizer(
+        base, sched=sched, fuse=False), params, grads, steps=sched.period)
+    fused = _run_opt(bf.DistributedNeighborAllreduceOptimizer(
+        base, sched=sched, fuse=True), params, grads, steps=sched.period)
+    assert_trees_bitexact(ref, fused)
+
+
+def test_env_flag_switches_wrapper_path(bf_ctx, monkeypatch):
+    """BLUEFOG_COMM_FUSION resolves per step build and joins the step
+    cache key — flipping it mid-run changes the program, not the math."""
+    params, grads = _problem()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    state = opt.init(params)
+    monkeypatch.setenv("BLUEFOG_COMM_FUSION", "0")
+    p_off, _ = opt.step(params, grads, state, step=0)
+    monkeypatch.setenv("BLUEFOG_COMM_FUSION", "1")
+    p_on, _ = opt.step(params, grads, state, step=0)
+    assert len(opt._step_cache) == 2
+    assert_trees_bitexact(p_off, p_on)
+
+
+def test_train_step_fused_matches_perleaf(bf_ctx):
+    """make_train_step end to end: forward/backward/exchange/update."""
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    model = MLP(features=(16, 16), num_outputs=4)
+    base = optax.sgd(0.1)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 6, 6, 1)))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, 4, 6, 6, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 4)))
+    outs = {}
+    for fuse in (False, True):
+        v, o = variables, opt_state
+        step = T.make_train_step(model, base, fuse=fuse, donate=False)
+        for t in range(3):
+            v, o, loss = step(v, o, (x, y), jnp.int32(t))
+        outs[fuse] = (v, loss)
+    assert_trees_bitexact(outs[False][0], outs[True][0])
+    assert float(outs[False][1]) == float(outs[True][1])
+
+
+def test_chaos_harness_fused_matches_perleaf(bf_ctx):
+    """The resilience harness's gather+mix rides the fusion layer too."""
+    from bluefog_tpu.resilience import FaultPlan
+    from bluefog_tpu.resilience.harness import ChaosHarness
+    plan = FaultPlan(N, 6).rank_down(2, at=2)
+    params0 = np.zeros((N, 4), np.float32)
+    reports = {}
+    for fuse in (False, True):
+        reports[fuse] = ChaosHarness(plan, fuse=fuse).run(params0, steps=5)
+    np.testing.assert_array_equal(reports[False].losses,
+                                  reports[True].losses)
+    np.testing.assert_array_equal(
+        np.asarray(reports[False].params_final),
+        np.asarray(reports[True].params_final))
+
+
+# ---------------------------------------------------------------------------
+# window subsystem: one flat buffer per dtype
+# ---------------------------------------------------------------------------
+
+def _win_tree(seed=3):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(N, 3, 4)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(N, 5)), jnp.float32),
+            "h": jnp.asarray(rng.normal(size=(N, 2)), jnp.bfloat16)}
+
+
+def test_window_fused_storage_and_equivalence(bf_ctx):
+    from bluefog_tpu.ops import windows as W
+    tree = _win_tree()
+    outs = {}
+    for fuse in (False, True):
+        name = f"fusion_test_{fuse}"
+        assert W.win_create(tree, name, fuse=fuse)
+        w = W._windows[name]
+        if fuse:
+            # internal state is flat dtype buckets, not per-leaf
+            assert w.plan is not None and w.plan.n_buckets == 2
+            assert len(jax.tree.leaves(w.tensor)) == 2
+        else:
+            assert w.plan is None
+        fetched = W.win_fetch(name)
+        assert_trees_bitexact(fetched, tree)       # external view intact
+        W.win_put(tree, name)
+        outs[fuse] = W.win_update(name)
+        W.win_free(name)
+    assert_trees_bitexact(outs[False], outs[True])
+
+
+def test_window_fused_state_dict_roundtrip(bf_ctx):
+    from bluefog_tpu.ops import windows as W
+    tree = _win_tree()
+    assert W.win_create(tree, "fusion_ckpt", fuse=True)
+    W.win_put(tree, "fusion_ckpt")
+    snap = W.win_state_dict()
+    before = W.win_update("fusion_ckpt", clone=True)
+    W.win_free("fusion_ckpt")
+    assert W.win_create(tree, "fusion_ckpt", fuse=True)
+    W.load_win_state_dict(snap)
+    after = W.win_update("fusion_ckpt", clone=True)
+    assert_trees_bitexact(before, after)
+    W.win_free("fusion_ckpt")
+
+
+def test_window_hlo_ppermute_drop(bf_ctx):
+    """The window push kernel's trace sees buckets, not leaves: jitted
+    program collective count drops accordingly."""
+    from bluefog_tpu.ops import windows as W
+    tree = {f"l{i}": jnp.ones((N, 3 + i), jnp.float32) for i in range(6)}
+    counts = {}
+    for fuse in (False, True):
+        name = f"fusion_hlo_{fuse}"
+        assert W.win_create(tree, name, fuse=fuse)
+        w = W._windows[name]
+        fn = W._push_fn(w.topo, False, id(bf_ctx.mesh))
+        D = W._out_matrix(w.topo, None)
+        args = (w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
+                jnp.asarray(D, jnp.float32),
+                W._self_weight_vector(w.topo.size, None),
+                jnp.asarray(False))
+        counts[fuse] = TM.collective_counts(fn, *args)["ppermute"]
+        W.win_free(name)
+    K = len(bf_ctx.compiled_topology.offsets)
+    # per offset: one ppermute per leaf/bucket + one for associated-P
+    assert counts[False] == K * (6 + 1)
+    assert counts[True] == K * (1 + 1)
+
+
+def test_push_sum_fused_matches_perleaf(bf_ctx):
+    params, grads = _problem(seed=9)
+    outs = {}
+    for fuse, env in ((False, "0"), (True, "1")):
+        import os
+        os.environ["BLUEFOG_COMM_FUSION"] = env
+        try:
+            opt = bf.DistributedPushSumOptimizer(
+                optax.sgd(0.05), window_prefix=f"ps_fuse_{fuse}")
+            state = opt.init(params)
+            p = params
+            for t in range(3):
+                p, state = opt.step(p, grads, state, step=t)
+            outs[fuse] = p
+            opt.free()
+        finally:
+            os.environ.pop("BLUEFOG_COMM_FUSION", None)
+    assert_trees_bitexact(outs[False], outs[True])
+
+
+# ---------------------------------------------------------------------------
+# pallas backend: fused flat buckets through the Mosaic interpreter
+# ---------------------------------------------------------------------------
+
+from conftest import JAX_PRE_05  # noqa: E402
+
+
+@pytest.mark.skipif(
+    JAX_PRE_05,
+    reason="fused kernel needs the Mosaic TPU-simulating interpreter; "
+           "jaxlib<0.5 has no CPU lowering for its DMA semaphores")
+@pytest.mark.parametrize("mode", ["static", "dynamic"])
+def test_pallas_flat_buckets_match_perleaf(bf_ctx, mode):
+    """The pre-tiled flat-bucket kernel path (pad_to=FLAT_TILE, no
+    per-leaf _as_tiles padding) matches the per-leaf pallas path."""
+    tree = {k: v for k, v in ragged_tree().items()
+            if k != "b" and k != "nested"}          # float32 only: kernel
+    tree["w"] = jnp.asarray(
+        np.random.default_rng(5).normal(size=(N, 4, 3)), jnp.float32)
+    topo = bf_ctx.compiled_topology if mode == "static" else None
+    sched = one_peer_sched() if mode == "dynamic" else None
+
+    def run(fuse):
+        spec = P(bf_ctx.rank_axis)
+
+        def stepper(t, step):
+            def shard_fn(ts, si):
+                per = jax.tree.map(lambda a: a[0], ts)
+                out = S._communicate(
+                    per, CT.neighbor_allreduce, bf_ctx.rank_axis, topo,
+                    sched, si, None, None, "pallas_interpret", fuse=fuse)
+                return jax.tree.map(lambda a: a[None], out)
+            return jax.shard_map(shard_fn, mesh=bf_ctx.mesh,
+                                 in_specs=(spec, P()), out_specs=spec,
+                                 check_vma=False)(t, step)
+        return jax.jit(stepper)(tree, jnp.int32(1))
+
+    ref = run(False)
+    fused = run(True)
+    def close(a, b):
+        np.testing.assert_allclose(np.asarray(a).reshape(-1),
+                                   np.asarray(b).reshape(-1),
+                                   rtol=1e-6, atol=1e-6)
+    jax.tree.map(close, ref, fused)
