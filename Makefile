@@ -18,7 +18,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
-        bench-trace hwcheck chaos
+        bench-trace bench-overlap hwcheck chaos
 
 test:
 	$(PYTEST) tests/
@@ -69,6 +69,21 @@ bench:
 # accelerator needed (docs/performance.md "Communication fusion")
 bench-trace:
 	python bench.py --trace-only
+
+# Overlap evidence: run the trace bench with the overlapped stepper on vs
+# off and print the collective-pair delta (async start/done pairs on
+# latency-hiding backends; on CPU lowering, the sync count stays unchanged
+# while the mix consumes the prior step's buffer — docs/performance.md
+# "Overlap").  Same JSON as bench-trace, summarized on one line.
+bench-overlap:
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); o=d['overlap']; \
+	print(json.dumps(d)); \
+	print('overlap off: %d sync ppermutes, %d async pairs | overlap on: ' \
+	      '%d sync ppermutes, %d async pairs (StableHLO step: %d -> %d)' \
+	      % (o['off']['synchronous'], o['off']['overlap_eligible'], \
+	         o['on']['synchronous'], o['on']['overlap_eligible'], \
+	         o['off']['ppermute'], o['on']['ppermute']))"
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

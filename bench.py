@@ -380,6 +380,33 @@ def trace_only_main():
                                  fuse=fuse, donate=False)
         report[label] = TM.collective_counts(
             step, variables, opt_state, (x, y), jnp.int32(0))
+
+    # Overlap evidence (staleness-1 delayed-mix pipeline, BLUEFOG_COMM_
+    # OVERLAP / overlap=): per-mode StableHLO counts plus the POST-COMPILE
+    # counts where an async backend splits collectives into start/done
+    # pairs.  On CPU lowering the split never happens — the documented
+    # evidence is then that the overlapped step's synchronous collective
+    # count is UNCHANGED versus the sync step while its mix consumes the
+    # prior step's carried buffer (the collective moved off the critical
+    # path, not multiplied).  `make bench-overlap` prints the delta.
+    overlap_report = {}
+    for label, ov in (("off", False), ("on", True)):
+        step = T.make_train_step(model, base,
+                                 communication="neighbor_allreduce",
+                                 fuse=True, overlap=ov, donate=False)
+        _, ostate = T.create_train_state(
+            model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+            overlap=ov, fuse=True)
+        entry = TM.collective_counts(
+            step, variables, ostate, (x, y), jnp.int32(0))
+        compiled = TM.compiled_collective_counts(
+            step, variables, ostate, (x, y), jnp.int32(0))
+        entry["compiled_ppermute"] = compiled["ppermute"]
+        entry["compiled_ppermute_pairs"] = compiled["ppermute_pairs"]
+        entry["overlap_eligible"] = compiled["ppermute_pairs"]
+        entry["synchronous"] = compiled["ppermute"]
+        overlap_report[label] = entry
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -392,6 +419,7 @@ def trace_only_main():
         "ppermute_drop":
             f"{report['per_leaf']['ppermute']} -> "
             f"{report['fused']['ppermute']}",
+        "overlap": overlap_report,
     }
     print(json.dumps(out))
 

@@ -58,6 +58,7 @@ __all__ = [
     "plan_for",
     "flatten",
     "unflatten",
+    "zero_buffers",
     "fused_tree_map",
 ]
 
@@ -255,6 +256,22 @@ def unflatten(plan: FusionPlan, bufs: Sequence[jax.Array]):
                                    axis=lead)
         leaves[slot.index] = seg.reshape(slot.shape)
     return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def zero_buffers(plan: FusionPlan,
+                 leading_shape: Tuple[int, ...] = ()) -> Tuple[jax.Array, ...]:
+    """Zeroed flat buffers matching ``plan``'s buckets (shape
+    ``leading_shape + [padded]`` each).
+
+    This is the buffer-HANDLE side of cross-step reuse: a pipelined stepper
+    (``optim/strategies`` overlapped mode) carries its in-flight exchange
+    state as exactly these buffers inside the donated opt/train state, so
+    XLA aliases the same allocations step after step — double buffering
+    without any host-side pool.  The zero state is also the pipeline's
+    warmup value: folding it contributes nothing (linear ops map zeros to
+    zeros), which encodes "no exchange has arrived yet" with no flag."""
+    return tuple(jnp.zeros(tuple(leading_shape) + (b.padded,), b.dtype)
+                 for b in plan.buckets)
 
 
 def fused_tree_map(fn: Callable, tree, *,

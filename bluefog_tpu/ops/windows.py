@@ -45,13 +45,27 @@ __all__ = [
     "win_create", "win_free", "win_update", "win_update_then_collect",
     "win_put", "win_put_nonblocking", "win_get", "win_get_nonblocking",
     "win_accumulate", "win_accumulate_nonblocking",
-    "win_poll", "win_wait", "win_mutex", "win_lock",
+    "win_poll", "win_wait", "win_flush", "win_mutex", "win_lock",
     "get_current_created_window_names", "get_win_version",
     "win_associated_p", "win_associated_p_vector",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p", "win_fetch", "win_publish",
     "win_state_dict", "load_win_state_dict",
 ]
+
+
+def _win_double_buffer_enabled(flag: Optional[bool] = None) -> bool:
+    """Double-buffered deferred-commit semantics for the nonblocking
+    window ops (``BLUEFOG_WIN_DOUBLE_BUFFER``, default on): a
+    ``win_*_nonblocking`` call computes into the window's BACK buffer and
+    only ``win_wait`` promotes it to the front — so a concurrent
+    ``win_update``/``win_fetch`` drains the front while the back fills,
+    making the nonblocking API genuinely asynchronous instead of
+    wait-immediately.  Off: the pre-double-buffer behavior (every op
+    commits as soon as its program is dispatched)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("BLUEFOG_WIN_DOUBLE_BUFFER", "1") == "1"
 
 
 class _Window:
@@ -78,9 +92,19 @@ class _Window:
     """
 
     def __init__(self, tensor, topo: CompiledTopology, zero_init: bool,
-                 fuse: Optional[bool] = None):
+                 fuse: Optional[bool] = None,
+                 double_buffer: Optional[bool] = None):
         cx = ctx()
         self.topo = topo
+        # double buffering (BLUEFOG_WIN_DOUBLE_BUFFER, default on):
+        # deferred nonblocking ops stage their result here (the BACK
+        # buffer chain) and win_wait promotes it to the front.  Chained
+        # un-waited ops coalesce into one staged state — the FIFO lane
+        # guarantee "waiting the last handle implies every earlier op
+        # landed" is preserved, and donation-safe (each op consumes the
+        # previous staged arrays, never the live front).
+        self.double_buffer = _win_double_buffer_enabled(double_buffer)
+        self.pending = None
         # padded layout: every rank carries max-in-degree buffer rows so the
         # SPMD shapes agree; rank i's live slots are its first in_degree(i)
         # (irregular graphs — StarGraph etc. — work, VERDICT r1 missing #2)
@@ -125,9 +149,41 @@ class _Window:
             return internal
         return _fusion.unflatten(self.plan, list(internal))
 
+    # -- double-buffer plumbing ---------------------------------------------
+
+    def staged(self):
+        """Latest 5-tuple ``(tensor, buffers, versions, p, p_buffers)``:
+        the back buffer when a deferred op is outstanding (so chained
+        nonblocking ops compose in program order), else the front."""
+        if self.pending is not None:
+            return self.pending
+        return (self.tensor, self.buffers, self.versions, self.p,
+                self.p_buffers)
+
+    def stage(self, state) -> None:
+        """Record an op's result: into the back buffer under double
+        buffering, straight to the front otherwise."""
+        if self.double_buffer:
+            self.pending = state
+        else:
+            self.commit(state)
+
+    def commit(self, state) -> None:
+        (self.tensor, self.buffers, self.versions, self.p,
+         self.p_buffers) = state
+
+    def commit_pending(self) -> None:
+        """Promote the back buffer to the front (win_wait / win_flush)."""
+        if self.pending is not None:
+            self.commit(self.pending)
+            self.pending = None
+
 
 _windows: Dict[str, _Window] = {}
 _with_associated_p = [False]
+# handle -> window name for deferred (double-buffered) commits: win_wait
+# promotes that window's staged state after the underlying wait
+_deferred_commits: Dict[int, str] = {}
 
 # -- true-async dispatch (opt-in) -------------------------------------------
 #
@@ -147,12 +203,16 @@ def _win_async_enabled() -> bool:
     return os.environ.get("BLUEFOG_WIN_ASYNC", "0") == "1"
 
 
-def _dispatch_win_op(run, result_of=None, op_name: str = "win_op"):
+def _dispatch_win_op(run, result_of=None, op_name: str = "win_op",
+                     commit_name: Optional[str] = None):
     """Run ``run()`` inline (default) or on the service lane (async mode).
 
     Returns an int handle valid for win_wait/win_poll either way.
     ``op_name`` labels the service task: a failing async window op then
-    raises a ``ServiceTaskError`` carrying it (service.py)."""
+    raises a ``ServiceTaskError`` carrying it (service.py).
+    ``commit_name``: the window whose staged (back-buffer) state the
+    handle's win_wait must promote — the deferred-commit half of double
+    buffering."""
     # suspend() gate (reference operations.cc:1392-1400): block before any
     # tracing/dispatch/enqueue, so a suspended context issues no put/get/
     # accumulate traffic.  This covers exactly the one-sided *transfer*
@@ -167,10 +227,14 @@ def _dispatch_win_op(run, result_of=None, op_name: str = "win_op"):
     # than a window-op caller (docs/faq.md).
     ctx().wait_if_suspended()
     if _win_async_enabled():
-        return _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE,
-                                             op_name=op_name)
-    run()
-    return _register_handle(result_of() if result_of else None)
+        handle = _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE,
+                                               op_name=op_name)
+    else:
+        run()
+        handle = _register_handle(result_of() if result_of else None)
+    if commit_name is not None:
+        _deferred_commits[handle] = commit_name
+    return handle
 
 
 def _slot_tables(topo: CompiledTopology) -> np.ndarray:
@@ -185,7 +249,8 @@ def windows_exist() -> bool:
 
 
 def win_create(tensor, name: str, zero_init: bool = False,
-               fuse: Optional[bool] = None) -> bool:
+               fuse: Optional[bool] = None,
+               double_buffer: Optional[bool] = None) -> bool:
     """Create a window: per-in-neighbor device buffers + versions + P
     (reference mpi_ops.py:998, mpi_controller.cc:793-866).
 
@@ -194,6 +259,11 @@ def win_create(tensor, name: str, zero_init: bool = False,
     ``fuse`` (default ``BLUEFOG_COMM_FUSION``, on) — over ONE flat buffer
     per dtype instead of per-leaf buffers (see :class:`_Window`): the
     full reference fusion-buffer equivalent.
+
+    ``double_buffer`` (default ``BLUEFOG_WIN_DOUBLE_BUFFER``, on):
+    nonblocking transfer ops stage their result in a BACK buffer and
+    ``win_wait`` promotes it — ``win_update``/``win_fetch`` drain the
+    front while an un-waited op's back buffer fills (docs/windows.md).
 
     The topology is snapshotted at creation; like the reference
     (operations.cc:1286-1311), changing the topology while windows exist is
@@ -209,17 +279,21 @@ def win_create(tensor, name: str, zero_init: bool = False,
             raise ValueError(
                 f"window tensors are global-view: expected leading dim "
                 f"{cx.size}, got {leaf.shape}")
-    _windows[name] = _Window(tensor, topo, zero_init, fuse=fuse)
+    _windows[name] = _Window(tensor, topo, zero_init, fuse=fuse,
+                             double_buffer=double_buffer)
     return True
 
 
 def win_free(name: Optional[str] = None) -> bool:
     if name is None:
         _windows.clear()
+        _deferred_commits.clear()
         return True
     if name not in _windows:
         return False
     del _windows[name]
+    for h in [h for h, n in _deferred_commits.items() if n == name]:
+        del _deferred_commits[h]
     return True
 
 
@@ -238,7 +312,8 @@ def _window(name: str) -> _Window:
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=128)
-def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
+def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int,
+             donate: bool = True):
     """win_put / win_accumulate kernel.
 
     Sends ``x * D[src, dst]`` into dst's buffer slot for src (replace or
@@ -302,9 +377,12 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int):
     # donate the window STATE (buffers/versions/P — replaced by the
     # outputs on every call) so XLA updates it in place; x stays the
     # caller's. TPU only: host platforms ignore donation with a warning
-    # per compile.
-    donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" else ()
-    return jax.jit(wrapper, donate_argnums=donate)
+    # per compile.  Double-buffered windows pass donate=False: their
+    # kernel inputs are the live FRONT state, which must stay readable
+    # (win_update drains it) until win_wait commits the staged result.
+    argnums = ((1, 2, 3, 4)
+               if donate and jax.default_backend() == "tpu" else ())
+    return jax.jit(wrapper, donate_argnums=argnums)
 
 
 @functools.lru_cache(maxsize=128)
@@ -364,7 +442,7 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
 
 @functools.lru_cache(maxsize=128)
 def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
-                   self_scale: bool, mesh_id: int):
+                   self_scale: bool, mesh_id: int, donate: bool = True):
     """Dynamic-schedule variant of :func:`_push_fn`: the step's mixing
     matrix is gathered ON DEVICE from the schedule tables by a traced step
     index, so per-step dynamic window ops (the push-sum paper's one-peer
@@ -376,7 +454,7 @@ def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
     exactly what ``compile_dynamic_schedule`` produces.  Gets keep the
     local tensor unscaled (``self_scale=False``).
     """
-    inner = _push_fn(topo, accumulate, mesh_id)
+    inner = _push_fn(topo, accumulate, mesh_id, donate)
     mats = jnp.asarray(sched.matrices, jnp.float32)        # [T, N, N]
     eye = jnp.eye(topo.size, dtype=jnp.float32)
 
@@ -388,8 +466,9 @@ def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
                      W * (1.0 - eye), sw, with_p)
     # window-state donation as in _push_fn (the inner jit's donation is
     # inlined away under this outer jit, so it must be re-declared here)
-    donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" else ()
-    return jax.jit(wrapper, donate_argnums=donate)
+    argnums = ((1, 2, 3, 4)
+               if donate and jax.default_backend() == "tpu" else ())
+    return jax.jit(wrapper, donate_argnums=argnums)
 
 
 def _check_sched(w: "_Window", sched, step, weights, kind: str):
@@ -509,30 +588,33 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
             raise ValueError(
                 "sched= carries the self weights (diag of the step matrix); "
                 "self_weight= cannot also be given")
-        fn = _push_sched_fn(w.topo, sched, accumulate, True, id(cx.mesh))
+        fn = _push_sched_fn(w.topo, sched, accumulate, True, id(cx.mesh),
+                            not w.double_buffer)
 
         def run():
             x = _win_input(tensor, w)
-            (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-                x, w.buffers, w.versions, w.p, w.p_buffers,
-                jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
+            _, bufs, vers, p, pbufs = w.staged()
+            w.stage(fn(x, bufs, vers, p, pbufs,
+                       jnp.asarray(step, jnp.int32), jnp.asarray(with_p)))
         return _dispatch_win_op(
-            run, lambda: w.tensor,
-            op_name="win_accumulate" if accumulate else "win_put")
+            run, lambda: w.staged()[0],
+            op_name="win_accumulate" if accumulate else "win_put",
+            commit_name=name)
 
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
-    fn = _push_fn(w.topo, accumulate, id(cx.mesh))
+    fn = _push_fn(w.topo, accumulate, id(cx.mesh), not w.double_buffer)
 
     def run():
         x = _win_input(tensor, w)
-        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-            x, w.buffers, w.versions, w.p, w.p_buffers,
-            jnp.asarray(D, jnp.float32), jnp.asarray(sw),
-            jnp.asarray(with_p))
+        _, bufs, vers, p, pbufs = w.staged()
+        w.stage(fn(x, bufs, vers, p, pbufs,
+                   jnp.asarray(D, jnp.float32), jnp.asarray(sw),
+                   jnp.asarray(with_p)))
     return _dispatch_win_op(
-        run, lambda: w.tensor,
-        op_name="win_accumulate" if accumulate else "win_put")
+        run, lambda: w.staged()[0],
+        op_name="win_accumulate" if accumulate else "win_put",
+        commit_name=name)
 
 
 def win_put_nonblocking(tensor, name: str,
@@ -596,24 +678,27 @@ def win_get_nonblocking(name: str,
     with_p = _with_associated_p[0]
     if sched is not None:
         _check_sched(w, sched, step, src_weights, "src_weights")
-        fn = _push_sched_fn(w.topo, sched, False, False, id(cx.mesh))
+        fn = _push_sched_fn(w.topo, sched, False, False, id(cx.mesh),
+                            not w.double_buffer)
 
         def run():
-            (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-                w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
-                jnp.asarray(step, jnp.int32), jnp.asarray(with_p))
-        return _dispatch_win_op(run, lambda: w.buffers,
-                                op_name="win_get")
+            t0, bufs, vers, p, pbufs = w.staged()
+            w.stage(fn(t0, bufs, vers, p, pbufs,
+                       jnp.asarray(step, jnp.int32), jnp.asarray(with_p)))
+        return _dispatch_win_op(run, lambda: w.staged()[1],
+                                op_name="win_get", commit_name=name)
 
     G = _out_matrix(w.topo, src_weights)
-    fn = _push_fn(w.topo, False, id(cx.mesh))
+    fn = _push_fn(w.topo, False, id(cx.mesh), not w.double_buffer)
 
     def run():
-        (w.tensor, w.buffers, w.versions, w.p, w.p_buffers) = fn(
-            w.tensor, w.buffers, w.versions, w.p, w.p_buffers,
-            jnp.asarray(G, jnp.float32),
-            _self_weight_vector(w.topo.size, None), jnp.asarray(with_p))
-    return _dispatch_win_op(run, lambda: w.buffers, op_name="win_get")
+        t0, bufs, vers, p, pbufs = w.staged()
+        w.stage(fn(t0, bufs, vers, p, pbufs,
+                   jnp.asarray(G, jnp.float32),
+                   _self_weight_vector(w.topo.size, None),
+                   jnp.asarray(with_p)))
+    return _dispatch_win_op(run, lambda: w.staged()[1], op_name="win_get",
+                            commit_name=name)
 
 
 def win_get(name: str, src_weights=None, require_mutex: bool = False,
@@ -658,6 +743,12 @@ def win_update(name: str,
     dead in-neighbors degrade to zero-weight rows with their mass absorbed
     into the self weight — bounded staleness instead of averaging a dead
     rank's frozen buffer forever.  The mask is traced data.
+
+    Double buffering: this drains the FRONT state.  Committing (``clone=
+    False``) while a nonblocking op is staged and un-waited is a caller
+    race — that op's later ``win_wait`` overwrites this update's result
+    (docs/windows.md "Double buffering"); peek with ``clone=True`` for
+    mid-flight reads, or ``win_wait`` first.
     """
     w = _window(name)
     cx = ctx()
@@ -713,11 +804,39 @@ def win_poll(handle: int) -> bool:
 
 
 def win_wait(handle: int) -> bool:
+    """Complete a nonblocking window op: block until its program ran, then
+    — under double buffering — promote the window's staged back buffer to
+    the front.  Staged ops COALESCE: waiting a later handle on the same
+    window also publishes every earlier (FIFO-ordered) op's effect, and
+    waiting an earlier handle publishes any later op that already
+    completed — per-handle isolation is not provided (docs/windows.md)."""
     if handle >= _ASYNC_BASE // 2:
         _service.wait(handle - _ASYNC_BASE)
-        return True
-    synchronize(handle)
+    else:
+        synchronize(handle)
+    name = _deferred_commits.pop(handle, None)
+    if name is not None and name in _windows:
+        _windows[name].commit_pending()
     return True
+
+
+def win_flush(name: Optional[str] = None) -> None:
+    """Promote any staged (back-buffer) window state without a handle —
+    for one window or all.  The state-dict restore path needs this: a
+    snapshot taken with a put in flight restores that put as staged
+    again, and the original handle does not survive the restore."""
+    if name is not None:
+        _window(name).commit_pending()
+        stale = [h for h, n in _deferred_commits.items() if n == name]
+    else:
+        for w in _windows.values():
+            w.commit_pending()
+        stale = list(_deferred_commits)
+    # handles flushed without a win_wait would otherwise pin their map
+    # entries for the process lifetime (their later win_wait, if any, is
+    # a no-op commit either way)
+    for h in stale:
+        del _deferred_commits[h]
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
@@ -757,10 +876,21 @@ def win_state_dict() -> Dict[str, Dict[str, jax.Array]]:
     # (in-place updates), so a live view would be deleted under an
     # async/overlapped checkpoint write
     snap = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
-    return {name: {"tensor": snap(w.tensor), "buffers": snap(w.buffers),
-                   "versions": snap(w.versions), "p": snap(w.p),
-                   "p_buffers": snap(w.p_buffers)}
-            for name, w in _windows.items()}
+    out = {}
+    for name, w in _windows.items():
+        entry = {"tensor": snap(w.tensor), "buffers": snap(w.buffers),
+                 "versions": snap(w.versions), "p": snap(w.p),
+                 "p_buffers": snap(w.p_buffers)}
+        if w.pending is not None:
+            # BOTH buffers roundtrip: the staged back buffer of an
+            # un-waited nonblocking op is real state — dropping it would
+            # silently lose the op across a checkpoint
+            pt, pb, pv, pp, ppb = w.pending
+            entry["pending"] = {"tensor": snap(pt), "buffers": snap(pb),
+                                "versions": snap(pv), "p": snap(pp),
+                                "p_buffers": snap(ppb)}
+        out[name] = entry
+    return out
 
 
 def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
@@ -803,6 +933,17 @@ def load_win_state_dict(state: Dict[str, Dict], strict: bool = True) -> None:
         w.versions = jnp.array(leaves["versions"], copy=True)
         w.p = jnp.array(leaves["p"], copy=True)
         w.p_buffers = jnp.array(leaves["p_buffers"], copy=True)
+        pend = leaves.get("pending")
+        if pend is not None:
+            # re-staged, not committed: publishing an op the original run
+            # never waited would reorder it against that run's win_updates;
+            # call win_flush(name) to promote it deliberately
+            w.pending = (restore(pend["tensor"]), restore(pend["buffers"]),
+                         jnp.array(pend["versions"], copy=True),
+                         jnp.array(pend["p"], copy=True),
+                         jnp.array(pend["p_buffers"], copy=True))
+        else:
+            w.pending = None
 
 
 def turn_on_win_ops_with_associated_p():

@@ -3,13 +3,33 @@
 Both the optimizer wrappers and the train-step builder run per-rank cores
 inside ``shard_map`` over either the flat ``rank`` mesh or the 2-D
 ``(machine, local)`` mesh; this module is the single home for the
-wrap/unwrap and [N] <-> [M, L] reshaping that entails.
+wrap/unwrap and [N] <-> [M, L] reshaping that entails, and for the
+step-cache key that decides when a wrapper must rebuild its jitted step.
 """
 
 from typing import Any, Callable, NamedTuple
 
 import jax
 from jax.sharding import PartitionSpec as P
+
+
+def step_cache_key(cx, params, nar_backend: str, fuse: bool,
+                   bucket_bytes: int, overlap: bool = False):
+    """Everything that changes the COMPILED step program: mesh/topology
+    identity, the exchange backend, the fusion knobs (they reshape the
+    collective schedule), the overlap mode (it reshapes the carried state
+    and the whole pipeline), and the parameter tree structure.  One home
+    for the tuple so the wrappers and any future cache agree on what
+    invalidates a step — a knob resolved at build time but missing here
+    would silently serve a stale program."""
+    return (id(cx.mesh),
+            id(cx._compiled),
+            id(cx._compiled_machine),
+            nar_backend,
+            bool(fuse),
+            int(bucket_bytes),
+            bool(overlap),
+            jax.tree.structure(params))
 
 
 class MeshPlumbing(NamedTuple):

@@ -22,12 +22,14 @@ All functions are axis-level: they expect to be called inside ``shard_map``
 with per-rank pytrees, like ``lax.psum``.
 """
 
+import os
 from enum import Enum
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from ..ops import api as _api
 from ..ops import collectives as C
@@ -298,6 +300,280 @@ def exact_diffusion_init(base: optax.GradientTransformation, params):
     first step under ``jax.jit(..., donate_argnums=...)``."""
     return {"base": base.init(params),
             "psi_prev": jax.tree.map(jnp.array, params)}
+
+
+# ---------------------------------------------------------------------------
+# Overlapped stepping: the staleness-1 delayed-mix pipeline
+# ---------------------------------------------------------------------------
+#
+# The synchronous strategies above issue their neighbor exchange on the
+# critical path of the step that consumes it.  The reference hides that
+# latency with per-parameter backward hooks (optimizers.py:354-414); the
+# XLA-native equivalent is to pipeline the mix across STEP boundaries:
+#
+#   * the jitted step at t FOLDS IN the exchange launched at t-1 (its
+#     result rides the carried opt state as in-flight flat buffers — one
+#     per dtype bucket, ``ops/fusion.py`` — plus the self weight of the
+#     matrix that produced it), and
+#   * LAUNCHES the exchange whose result step t+1 will fold.
+#
+# For the consensus/CTA/AWC family the launch runs on the step's INPUT
+# parameters, so inside one program the ppermutes depend only on program
+# inputs and their result feeds only a program output: XLA's scheduler is
+# free to run the entire forward/backward/update concurrently with the
+# collective (with the async-collective flags it emits start/done pairs
+# spanning the whole step).  For ATC and exact-diffusion the launch value
+# is the adapted iterate, so the collective sits at the program tail; the
+# fold still takes it OFF the consuming step's critical path.
+#
+# Semantics — the self term is always FRESH, the neighbor contributions are
+# one step STALE (classic delayed-gossip / staleness-1 mixing):
+#
+#   consensus:  x_{t+1} = adapt(d_{t-1} x_t + N_{t-1}(x_{t-1}), g(x_t))
+#   ATC:        z_t = adapt(x_t, g(x_t));  x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})
+#   exact-diff: same as ATC over the bias-corrected phi iterate
+#
+# where N_t(x) = C_t(x) - d_t x is the neighbor part of the step-t mix
+# C_t and d_t its self weight.  Warmup: the pipeline starts with a ZERO
+# buffer and self weight 1, so step 0 is a pure local step (the first
+# exchange is in flight); from step 1 on the recurrence above holds
+# exactly — bit-for-bit, asserted in tests/test_overlap.py.
+
+
+def overlap_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the overlapped-stepping gate: explicit argument wins, else
+    ``BLUEFOG_COMM_OVERLAP`` (default OFF — staleness-1 mixing is a
+    semantic change, unlike fusion, so it is opt-in).  Snapshot at
+    build/init time like the fusion knobs: the in-flight buffers live in
+    the opt state, so the resolved value shapes the state layout."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("BLUEFOG_COMM_OVERLAP", "0") == "1"
+
+
+_OVERLAP_COMM_TYPES = (CommunicationType.neighbor_allreduce,
+                       CommunicationType.allreduce)
+
+
+def _check_overlap_comm(comm_type: CommunicationType, sched) -> None:
+    if comm_type not in _OVERLAP_COMM_TYPES:
+        raise ValueError(
+            f"overlapped stepping supports neighbor_allreduce and allreduce "
+            f"mixing only (got {comm_type}): hierarchical's two-level mix "
+            f"has no single in-flight self weight, and empty has no "
+            f"exchange to pipeline")
+    if comm_type == CommunicationType.allreduce and sched is not None:
+        raise ValueError("dynamic schedules apply to neighbor_allreduce only")
+
+
+def _mix_self_weight(comm_type: CommunicationType, axis_name,
+                     topo: Optional[CompiledTopology],
+                     sched: Optional[DynamicSchedule], step):
+    """Self weight of the mix the current launch uses, as a traced f32
+    scalar.  It rides the in-flight state so the NEXT step's fold pairs
+    the stale neighbor sum with the self weight of the same matrix —
+    total mass stays 1 even under per-step dynamic schedules."""
+    if comm_type == CommunicationType.allreduce:
+        return jnp.float32(1.0) / lax.axis_size(axis_name)
+    if sched is not None:
+        t = jnp.asarray(step) % sched.period
+        return jnp.asarray(sched.self_weights,
+                           jnp.float32)[t][lax.axis_index(axis_name)]
+    return jnp.asarray(topo.self_weights,
+                       jnp.float32)[lax.axis_index(axis_name)]
+
+
+def _inflight_pack(neigh, fuse: bool, bucket_bytes: Optional[int]):
+    """Neighbor-part tree -> carried representation (flat dtype buckets
+    under fusion: the plan is trace-time-cached, the buffers themselves are
+    donated with the opt state, so XLA reuses the same handles every
+    step)."""
+    if not fuse:
+        return neigh
+    plan = F.plan_for(neigh, max_bucket_bytes=bucket_bytes)
+    return tuple(F.flatten(plan, neigh))
+
+
+def _inflight_unpack(bufs, template, fuse: bool,
+                     bucket_bytes: Optional[int]):
+    if not fuse:
+        return bufs
+    plan = F.plan_for(template, max_bucket_bytes=bucket_bytes)
+    return F.unflatten(plan, list(bufs))
+
+
+def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
+                    machine_axes, machine_topo, nar_backend,
+                    fuse, bucket_bytes):
+    """Run the exchange on ``x`` and return the in-flight state the NEXT
+    step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t."""
+    full = _communicate(x, comm_type, axis_name, topo, sched, step,
+                        machine_axes, machine_topo, nar_backend, fuse,
+                        bucket_bytes)
+    d = _mix_self_weight(comm_type, axis_name, topo, sched, step)
+    neigh = jax.tree.map(lambda f, l: f - d.astype(l.dtype) * l, full, x)
+    return {"bufs": _inflight_pack(neigh, fuse, bucket_bytes),
+            "self_w": d}
+
+
+def _delayed_fold(x, inflight, fuse: bool, bucket_bytes: Optional[int]):
+    """Fold the in-flight neighbor sum with the FRESH self term:
+    ``d_prev * x + N_prev``.  At warmup (zero buffer, d=1) this is ``x``."""
+    neigh = _inflight_unpack(inflight["bufs"], x, fuse, bucket_bytes)
+    d = inflight["self_w"]
+    return jax.tree.map(lambda l, nb: d.astype(l.dtype) * l + nb, x, neigh)
+
+
+def delayed_init(base: optax.GradientTransformation, params,
+                 fuse: Optional[bool] = None,
+                 fusion_bucket_bytes: Optional[int] = None,
+                 exact_diffusion: bool = False):
+    """Per-rank init for the overlapped strategies: base state plus the
+    warmup in-flight state (zero buffers, self weight 1 — step 0 folds
+    nothing and is a pure local step).  ``fuse``/``fusion_bucket_bytes``
+    must resolve to the SAME values the step builder will use: the
+    carried-buffer layout is part of the state structure."""
+    fuse = F.fusion_enabled(fuse)
+    bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+    if fuse:
+        bufs = F.zero_buffers(F.plan_for(params, max_bucket_bytes=bucket))
+    else:
+        bufs = jax.tree.map(jnp.zeros_like, params)
+    state = {"base": base.init(params),
+             "inflight": {"bufs": bufs, "self_w": jnp.float32(1.0)}}
+    if exact_diffusion:
+        # copy, not alias, for the same donation reason as
+        # exact_diffusion_init
+        state["psi_prev"] = jax.tree.map(jnp.array, params)
+    return state
+
+
+def delayed_consensus_step(base: optax.GradientTransformation,
+                           comm_type: CommunicationType, axis_name,
+                           topo=None, sched=None, machine_axes=None,
+                           machine_topo=None, nar_backend=None, fuse=None,
+                           fusion_bucket_bytes=None):
+    """Overlapped consensus/CTA/AWC: fold the previous step's mix, adapt at
+    the folded point (gradients at the pre-fold parameters, matching
+    :func:`consensus_step`'s composition), and launch this step's exchange
+    on the INPUT parameters — the flagship overlap case: the collective
+    depends only on program inputs and feeds only a program output, so XLA
+    schedules it concurrently with the whole forward/backward/update.
+
+    Recurrence (after the step-0 warmup):
+    ``x_{t+1} = adapt(d_{t-1} x_t + N_{t-1}(x_{t-1}), g(x_t))``.
+    State: ``{"base": ..., "inflight": {"bufs", "self_w"}}`` —
+    create it with :func:`delayed_init` using the same fusion knobs."""
+    _check_overlap_comm(comm_type, sched)
+    nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
+    bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+
+    def step_fn(params, grads, opt_state, step=0):
+        mixed = _delayed_fold(params, opt_state["inflight"], fuse, bucket)
+        updates, base_new = base.update(grads, opt_state["base"], mixed)
+        new_params = optax.apply_updates(mixed, updates)
+        infl_new = _delayed_launch(params, comm_type, axis_name, topo,
+                                   sched, step, machine_axes, machine_topo,
+                                   nar_backend, fuse, bucket)
+        return new_params, {"base": base_new, "inflight": infl_new}
+
+    return step_fn
+
+
+def delayed_atc_step(base: optax.GradientTransformation,
+                     comm_type: CommunicationType, axis_name,
+                     topo=None, sched=None, machine_axes=None,
+                     machine_topo=None, nar_backend=None, fuse=None,
+                     fusion_bucket_bytes=None):
+    """Overlapped adapt-then-combine: local adapt, fold the PREVIOUS
+    adapted iterate's exchange, launch this one's.  The launch value is
+    the adapted iterate, so the collective sits at the program tail; the
+    consuming fold at t+1 still reads only carried state — the exchange
+    result never blocks a step's critical path.
+
+    Recurrence (after the step-0 warmup): ``z_t = adapt(x_t, g(x_t));
+    x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})``."""
+    _check_overlap_comm(comm_type, sched)
+    nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
+    bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+
+    def step_fn(params, grads, opt_state, step=0):
+        updates, base_new = base.update(grads, opt_state["base"], params)
+        adapted = optax.apply_updates(params, updates)
+        combined = _delayed_fold(adapted, opt_state["inflight"], fuse,
+                                 bucket)
+        infl_new = _delayed_launch(adapted, comm_type, axis_name, topo,
+                                   sched, step, machine_axes, machine_topo,
+                                   nar_backend, fuse, bucket)
+        return combined, {"base": base_new, "inflight": infl_new}
+
+    return step_fn
+
+
+def delayed_exact_diffusion_step(base: optax.GradientTransformation,
+                                 comm_type: CommunicationType, axis_name,
+                                 topo=None, machine_axes=None,
+                                 machine_topo=None, nar_backend=None,
+                                 fuse=None, fusion_bucket_bytes=None):
+    """Overlapped exact-diffusion (the gradient-tracking-family member):
+    the psi/phi bias correction runs exactly as in
+    :func:`exact_diffusion_step`, but the combine of phi is the delayed
+    fold and the launch carries phi's exchange to the next step.  Static
+    symmetric topology only, like the synchronous variant (validate with
+    :func:`exact_diffusion_topology` first).  Warmup: step 0 reduces to
+    the plain local adapt (phi_0 folds against the zero buffer).
+    State adds ``psi_prev`` (:func:`delayed_init` with
+    ``exact_diffusion=True``)."""
+    _check_overlap_comm(comm_type, None)
+    nar_backend = nar_backend or _api._nar_backend()
+    fuse = F.fusion_enabled(fuse)
+    bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+
+    def step_fn(params, grads, opt_state, step=0):
+        updates, base_new = base.update(grads, opt_state["base"], params)
+        psi = optax.apply_updates(params, updates)
+        phi = jax.tree.map(lambda s, x, sp: s + x - sp,
+                           psi, params, opt_state["psi_prev"])
+        combined = _delayed_fold(phi, opt_state["inflight"], fuse, bucket)
+        infl_new = _delayed_launch(phi, comm_type, axis_name, topo,
+                                   None, step, machine_axes, machine_topo,
+                                   nar_backend, fuse, bucket)
+        return combined, {"base": base_new, "psi_prev": psi,
+                          "inflight": infl_new}
+
+    return step_fn
+
+
+def delayed_local_step(base: optax.GradientTransformation):
+    """Local-only branch for overlapped steps — the resilience
+    integration: besides the plain local adapt, it RESETS the pipeline
+    (zero buffers, self weight 1).  A degraded step must not leave the
+    old in-flight buffer around: folding it after recovery would mix
+    staleness-2+ garbage — and if a rank died mid-pipeline, its
+    contribution is already summed into the buffer and cannot be masked
+    out post-hoc.  Resetting degrades the NEXT fold to pure self weight
+    (the warmup fold), exactly the bounded-staleness semantics
+    ``ops/windows.py`` documents for dead neighbors.  Pair with the
+    overlapped step via :func:`with_degraded_guard` (both branches carry
+    the same state structure, including ``psi_prev`` when present)."""
+
+    def step_fn(params, grads, opt_state, step=0):
+        updates, base_new = base.update(grads, opt_state["base"], params)
+        new_params = optax.apply_updates(params, updates)
+        infl = opt_state["inflight"]
+        out = {"base": base_new,
+               "inflight": {"bufs": jax.tree.map(jnp.zeros_like,
+                                                 infl["bufs"]),
+                            "self_w": jnp.ones_like(infl["self_w"])}}
+        if "psi_prev" in opt_state:
+            # restart the correction at the new local point (plain-ATC
+            # restart): the old psi_prev belongs to the abandoned pipeline
+            out["psi_prev"] = new_params
+        return new_params, out
+
+    return step_fn
 
 
 def with_local_steps(step_fn: Callable, local_step_fn: Callable,

@@ -35,7 +35,7 @@ from ..ops import fusion as _fusion
 from ..ops import windows as W
 from ..parallel.schedule import DynamicSchedule
 from . import strategies as S
-from ._plumbing import mesh_plumbing
+from ._plumbing import mesh_plumbing, step_cache_key
 
 __all__ = [
     "DistributedGradientAllreduceOptimizer",
@@ -65,7 +65,8 @@ class _JittedStrategyOptimizer:
                  num_steps_per_communication: int = 1,
                  sched: Optional[DynamicSchedule] = None,
                  fuse: Optional[bool] = None,
-                 fusion_bucket_bytes: Optional[int] = None):
+                 fusion_bucket_bytes: Optional[int] = None,
+                 overlap: Optional[bool] = None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
@@ -78,6 +79,31 @@ class _JittedStrategyOptimizer:
         # values join the step-cache key, like the exchange backend).
         self.fuse = fuse
         self.fusion_bucket_bytes = fusion_bucket_bytes
+        # overlapped stepping (staleness-1 delayed-mix pipeline,
+        # strategies.py): resolved HERE, not per step build — the
+        # in-flight buffers live in the opt state created by init(), so
+        # the mode (and, under overlap, the fusion knobs shaping those
+        # buffers) must bind once for the optimizer's lifetime.
+        self.overlap = S.overlap_enabled(overlap)
+        if self.overlap:
+            if gradient_allreduce:
+                raise ValueError(
+                    "overlap=True does not apply to gradient allreduce: "
+                    "there is no weight exchange to pipeline (the gradient "
+                    "average IS the step's input)")
+            if comm_type not in (CommunicationType.neighbor_allreduce,
+                                 CommunicationType.allreduce):
+                raise ValueError(
+                    f"overlap=True supports neighbor_allreduce/allreduce "
+                    f"mixing only, got {comm_type}")
+            if num_steps_per_communication != 1:
+                raise ValueError(
+                    "overlap=True assumes one exchange per step "
+                    "(num_steps_per_communication=1); local-steps schedules "
+                    "already take the exchange off most steps entirely")
+            self._overlap_fuse = _fusion.fusion_enabled(fuse)
+            self._overlap_bucket = _fusion.resolve_max_bucket_bytes(
+                fusion_bucket_bytes)
         if exact_diffusion and num_steps_per_communication != 1:
             raise ValueError(
                 "exact-diffusion's correction assumes one exchange per "
@@ -95,6 +121,13 @@ class _JittedStrategyOptimizer:
         """Base optimizer state, batched over the rank axis (so scalar state
         like momentum/count exists per rank, matching N independent
         reference processes)."""
+        if self.overlap:
+            # warmup in-flight state rides along (zero buffers, self
+            # weight 1): the SAME fusion knobs the step builder will use
+            return jax.vmap(lambda p: S.delayed_init(
+                self.base, p, fuse=self._overlap_fuse,
+                fusion_bucket_bytes=self._overlap_bucket,
+                exact_diffusion=self.exact_diffusion))(params)
         if self.gradient_allreduce and self.k > 1:
             return jax.vmap(lambda p: S.grad_accum_init(self.base, p))(params)
         if self.exact_diffusion:
@@ -114,10 +147,31 @@ class _JittedStrategyOptimizer:
         if hierarchical:
             machine_topo = cx.compiled_machine_topology
 
-        fuse = _fusion.fusion_enabled(self.fuse)
-        bucket_bytes = _fusion.resolve_max_bucket_bytes(
-            self.fusion_bucket_bytes)
-        if self.gradient_allreduce:
+        if self.overlap:
+            fuse, bucket_bytes = self._overlap_fuse, self._overlap_bucket
+        else:
+            fuse = _fusion.fusion_enabled(self.fuse)
+            bucket_bytes = _fusion.resolve_max_bucket_bytes(
+                self.fusion_bucket_bytes)
+        if self.overlap:
+            if self.exact_diffusion:
+                if self.comm_type == CommunicationType.neighbor_allreduce:
+                    topo = S.exact_diffusion_topology(cx.compiled_topology)
+                step_core = S.delayed_exact_diffusion_step(
+                    self.base, self.comm_type, cx.rank_axis, topo=topo,
+                    machine_axes=(cx.machine_axis, cx.local_axis),
+                    machine_topo=machine_topo, fuse=fuse,
+                    fusion_bucket_bytes=bucket_bytes)
+            else:
+                builder = (S.delayed_atc_step if self.atc
+                           else S.delayed_consensus_step)
+                step_core = builder(
+                    self.base, self.comm_type, cx.rank_axis, topo=topo,
+                    sched=self.sched,
+                    machine_axes=(cx.machine_axis, cx.local_axis),
+                    machine_topo=machine_topo, fuse=fuse,
+                    fusion_bucket_bytes=bucket_bytes)
+        elif self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k,
                 fuse=fuse, fusion_bucket_bytes=bucket_bytes)
@@ -144,9 +198,10 @@ class _JittedStrategyOptimizer:
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
                 fusion_bucket_bytes=bucket_bytes)
-        if not (self.gradient_allreduce or self.exact_diffusion):
-            # grad-allreduce accumulates internally; exact-diffusion is
-            # one-exchange-per-step by construction
+        if not (self.gradient_allreduce or self.exact_diffusion
+                or self.overlap):
+            # grad-allreduce accumulates internally; exact-diffusion and
+            # overlap are one-exchange-per-step by construction
             step_core = S.with_local_steps(
                 step_core, S.local_sgd_like_step(self.base), self.k)
 
@@ -174,13 +229,16 @@ class _JittedStrategyOptimizer:
 
     def step(self, params, grads, opt_state, step: int = 0):
         cx = ctx()
-        key = (id(cx.mesh),
-               id(cx._compiled),
-               id(cx._compiled_machine),
-               _api._nar_backend(),
-               _fusion.fusion_enabled(self.fuse),
-               _fusion.resolve_max_bucket_bytes(self.fusion_bucket_bytes),
-               jax.tree.structure(params))
+        # under overlap the fusion knobs were pinned at construction (they
+        # shape the carried in-flight buffers created by init())
+        if self.overlap:
+            fuse, bucket = self._overlap_fuse, self._overlap_bucket
+        else:
+            fuse = _fusion.fusion_enabled(self.fuse)
+            bucket = _fusion.resolve_max_bucket_bytes(
+                self.fusion_bucket_bytes)
+        key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
+                             self.overlap)
         if key not in self._step_cache:
             self._step_cache[key] = self._build(key)
         return self._step_cache[key](params, grads, opt_state,
@@ -198,23 +256,31 @@ def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
 
 
 def DistributedAllreduceOptimizer(base, num_steps_per_communication=1,
-                                  fuse=None, fusion_bucket_bytes=None):
+                                  fuse=None, fusion_bucket_bytes=None,
+                                  overlap=None):
     """CTA with global weight averaging (optimizers.py:1301)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.allreduce,
         num_steps_per_communication=num_steps_per_communication,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
 
 
 def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
                                           sched: Optional[DynamicSchedule] = None,
-                                          fuse=None, fusion_bucket_bytes=None):
+                                          fuse=None, fusion_bucket_bytes=None,
+                                          overlap=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
-    decentralized optimizer (optimizers.py:1326)."""
+    decentralized optimizer (optimizers.py:1326).
+
+    ``overlap`` (default ``BLUEFOG_COMM_OVERLAP``, off): staleness-1
+    delayed-mix pipeline — the step folds the PREVIOUS step's exchange and
+    launches its own off the critical path (docs/performance.md
+    "Overlap").  Changes the recurrence (fresh self term, one-step-stale
+    neighbor terms); keep it off for exact-averaging tests."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
@@ -231,32 +297,37 @@ def DistributedAdaptThenCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None):
     """ATC: local update inside the step, then communicate the adapted
-    weights (optimizers.py:1426; internal :485-841)."""
+    weights (optimizers.py:1426; internal :485-841).  ``overlap``: the
+    combine of the adapted iterate lands one step later (staleness-1
+    delayed mix, docs/performance.md "Overlap")."""
     return _JittedStrategyOptimizer(
         base, communication_type, atc=True,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
 
 
 def DistributedAdaptWithCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
-    runs the collective and the update math in parallel."""
+    runs the collective and the update math in parallel.  ``overlap``
+    goes further: the exchange result is consumed one step later, taking
+    even its LATENCY off the critical path (shared delayed-consensus
+    implementation; docs/performance.md "Overlap")."""
     return _JittedStrategyOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
 
 
 def DistributedExactDiffusionOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
-        fuse=None, fusion_bucket_bytes=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -269,10 +340,14 @@ def DistributedExactDiffusionOptimizer(
     under a dynamic one-peer schedule (measured blow-up to ~1e34 at
     lr 0.2 on the quadratic benchmark) — so ``sched=`` is deliberately
     not accepted; use the neighbor-CTA/ATC families for time-varying
-    graphs."""
+    graphs.
+
+    ``overlap``: the phi-combine lands one step later (staleness-1 delayed
+    mix with a documented warmup local step — the gradient-tracking-family
+    member of the pipeline, strategies.delayed_exact_diffusion_step)."""
     return _JittedStrategyOptimizer(
         base, communication_type, exact_diffusion=True,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ from ..context import ctx
 from ..ops import collectives as C
 from ..ops import fusion as _fusion
 from ..parallel.schedule import CompiledTopology
+from ..optim.strategies import overlap_enabled as _strategies_overlap_enabled
 from . import faults as _faults
 from . import membership as _mem
 
@@ -121,13 +122,25 @@ class ChaosHarness:
     gather + consensus mix run over dtype-bucketed flat buffers
     (``ops/fusion.py``) — one allgather per bucket instead of one per
     parameter leaf, bit-exact (the mix is elementwise-linear).
+
+    ``overlap`` (default ``BLUEFOG_COMM_OVERLAP``, off): staleness-1
+    delayed-mix pipeline under chaos — the step mixes the gathered values
+    LAUNCHED at the previous step (carried in the loop state) while
+    launching this step's gather off the critical path.  Crucially, the
+    liveness-masked repair column is built at FOLD time from the CURRENT
+    beliefs/fault tables: a rank that died after its value entered the
+    pipeline gets zero weight when the stale buffer is folded, its mass
+    absorbed into the receiver's self weight — a mid-pipeline death
+    degrades to self-weight instead of folding stale garbage.  Step 0
+    folds the gathered initial parameters (synchronous warmup).
     """
 
     def __init__(self, plan, *, base_opt=None,
                  topo: Optional[CompiledTopology] = None,
                  cfg: Optional[_mem.LivenessConfig] = None,
                  loss_fn: Optional[Callable] = None,
-                 fuse: Optional[bool] = None):
+                 fuse: Optional[bool] = None,
+                 overlap: Optional[bool] = None):
         if isinstance(plan, _faults.FaultPlan):
             plan = plan.compile()
         self.plan: _faults.CompiledFaultPlan = plan
@@ -142,6 +155,7 @@ class ChaosHarness:
         self.loss_fn = loss_fn or _default_quadratic
         # snapshot at construction (the chaos step compiles once)
         self.fuse = _fusion.fusion_enabled(fuse)
+        self.overlap = _strategies_overlap_enabled(overlap)
         self._step_fn = None
 
     # -- the one jitted chaos step ------------------------------------------
@@ -149,14 +163,14 @@ class ChaosHarness:
     def _build_step(self):
         cx, topo, cfg = self.cx, self.topo, self.cfg
         base_opt, loss_fn = self.base_opt, self.loss_fn
-        fuse = self.fuse
+        fuse, overlap = self.fuse, self.overlap
         axis = cx.rank_axis
         n = topo.size
         W0 = topo.weight_matrix
         spec = P(axis)
 
         def shard_fn(p_s, opt_s, lh_s, batch_s, step, alive, active,
-                     link_ok, corrupt):
+                     link_ok, corrupt, gprev_s, fprev_s):
             x = jax.tree.map(lambda a: a[0], p_s)
             st = jax.tree.map(lambda a: a[0], opt_s)
             b = jax.tree.map(lambda a: a[0], batch_s)
@@ -191,14 +205,28 @@ class ChaosHarness:
                 finite_own &= jnp.isfinite(leaf).all()
             gathered_bufs = [C.allgather(l[None], axis) for l in out_bufs]
             finite = C.allgather(finite_own[None], axis)      # [N]
+            if overlap:
+                # staleness-1 pipeline: this step's gather only LAUNCHES
+                # (it becomes the next step's carried buffer, so XLA can
+                # overlap it with the rest of the step); the values mixed
+                # BELOW are the ones launched at step t-1, with their
+                # launch-time finite verdicts
+                mix_bufs_in = [g[0] for g in gprev_s]
+                mix_finite = fprev_s[0]
+            else:
+                mix_bufs_in, mix_finite = gathered_bufs, finite
 
             # 4. this rank's repaired receive column (traced surgery):
             #    zero anything dead/suspect/inactive/dropped/non-finite,
-            #    self weight absorbs the lost mass
+            #    self weight absorbs the lost mass.  Under overlap this
+            #    column is built from the CURRENT step's beliefs and fault
+            #    tables but applied to the IN-FLIGHT (stale) values — the
+            #    liveness repair reaches into the pipeline: a rank that
+            #    died after launch contributes nothing at fold time.
             col = jnp.asarray(W0)[:, idx]
             # trusted already excludes confirmed-dead peers (suspect_after
             # <= confirm_after by LivenessConfig)
-            keep = trusted & (active > 0) & (link_ok[:, idx] > 0) & finite
+            keep = trusted & (active > 0) & (link_ok[:, idx] > 0) & mix_finite
             col = jnp.where(keep, col, 0.0).at[idx].set(0.0)
             self_w = 1.0 - col.sum()
             col = col.at[idx].set(self_w)
@@ -206,7 +234,9 @@ class ChaosHarness:
             # 5. mix, then adapt at the mixed point.  The self term uses
             #    the LOCAL clean value, not the (possibly corrupted)
             #    outgoing one — corruption rides the wire, it does not
-            #    poison the sender's own state
+            #    poison the sender's own state.  (Under overlap the self
+            #    term is FRESH while neighbor terms are one step stale —
+            #    the delayed-mix semantics of optim/strategies.)
             neigh_col = col.at[idx].set(0.0)
             # zero-weight is not enough against NaN (0 * NaN = NaN): scrub
             # non-finite contributions out of the gathered values too
@@ -215,7 +245,7 @@ class ChaosHarness:
                 jnp.where(jnp.isfinite(g), g, 0), axes=1)
                                     + self_w.astype(l.dtype) * l)
             mixed_bufs = [mix_one(g, l)
-                          for g, l in zip(gathered_bufs, x_bufs)]
+                          for g, l in zip(mix_bufs_in, x_bufs)]
             if fuse:
                 mixed = _fusion.unflatten(fplan, mixed_bufs)
             else:
@@ -237,16 +267,22 @@ class ChaosHarness:
             votes = confirmed_dead.astype(jnp.int32)          # my view
             lead = lambda t: jax.tree.map(lambda a: a[None], t)
             return (lead(x_new), lead(st_new), row[None], loss[None],
-                    col[None], votes[None])
+                    col[None], votes[None],
+                    tuple(g[None] for g in gathered_bufs), finite[None])
 
-        def stepper(params, opt_state, last_heard, batch, step, tables):
+        def stepper(params, opt_state, last_heard, batch, step, tables,
+                    carried):
             alive, active, link_ok, corrupt = _faults.at_step(tables, step)
-            p2, o2, lh2, loss_r, cols, votes = jax.shard_map(
+            gprev, fprev = carried
+            (p2, o2, lh2, loss_r, cols, votes, gnew,
+             fnew) = jax.shard_map(
                 shard_fn, mesh=cx.mesh,
-                in_specs=(spec, spec, spec, spec, P(), P(), P(), P(), P()),
-                out_specs=(spec, spec, spec, spec, spec, spec),
+                in_specs=(spec, spec, spec, spec, P(), P(), P(), P(), P(),
+                          spec, spec),
+                out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
             )(params, opt_state, last_heard, batch,
-              jnp.asarray(step, jnp.int32), alive, active, link_ok, corrupt)
+              jnp.asarray(step, jnp.int32), alive, active, link_ok, corrupt,
+              gprev, fprev)
             # survivor metrics (active-weighted)
             wsum = jnp.maximum(active.sum(), 1.0)
             loss_mean = (loss_r * active).sum() / wsum
@@ -257,9 +293,34 @@ class ChaosHarness:
             cons = jnp.sqrt((dist2 * active).sum() / wsum)
             W_eff = cols.T                       # cols[j] is column j
             dead_votes = votes.sum(axis=0)
-            return p2, o2, lh2, loss_mean, cons, W_eff, dead_votes
+            return (p2, o2, lh2, loss_mean, cons, W_eff, dead_votes,
+                    (gnew, fnew))
 
         return jax.jit(stepper)
+
+    def _initial_carried(self, params):
+        """Warmup in-flight state: the gathered INITIAL parameters with
+        all-finite verdicts, tiled to every rank's view — step 0 then
+        folds x_0's values (a synchronous first mix), and from step 1 on
+        the carried buffer is one step stale.  Built host-side: no
+        collective needed, params are already global-view."""
+        n = self.plan.size
+        if self.fuse:
+            # leading_dims=1 keeps the rank axis: same bucket layout as
+            # the per-rank plan inside the step (sizes exclude lead dims)
+            gplan = _fusion.plan_for(params, leading_dims=1)
+            bufs = _fusion.flatten(gplan, params)     # [N, L] per bucket
+        else:
+            bufs = list(jax.tree.leaves(params))
+        from ..ops import api as _api
+        # rank-sharded like every other loop-carried array: an uncommitted
+        # host layout here would give the first call its own jit cache
+        # entry (sharding is part of the key) — one warmup recompile
+        gathered0 = tuple(
+            _api.to_global(jnp.broadcast_to(b[None], (n,) + b.shape))
+            for b in bufs)
+        finite0 = _api.to_global(jnp.ones((n, n), bool))
+        return (gathered0, finite0)
 
     # -- driver --------------------------------------------------------------
 
@@ -295,12 +356,14 @@ class ChaosHarness:
                   for ev in getattr(self.plan, "events", [])]
         _tl.record_resilience_event("chaos_run_start",
                                     f"{steps} steps, {n} ranks")
+        carried = self._initial_carried(params)
         losses, cons, votes_t, mats = [], [], [], []
         announced = set()
         for t in range(steps):
             (params, opt_state, state, loss, ce, W_eff,
-             votes) = self._step_fn(params, opt_state, state,
-                                    batch_of(t), t, tables)
+             votes, carried) = self._step_fn(params, opt_state, state,
+                                             batch_of(t), t, tables,
+                                             carried)
             losses.append(float(loss))
             cons.append(float(ce))
             votes_np = np.asarray(votes)

@@ -143,6 +143,45 @@ def xla_flag_supported(flag: str) -> bool:
     return next(iter(xla_flags_supported([flag]).values()))
 
 
+# Async-collective / latency-hiding-scheduler candidates.  These are what
+# turn the overlapped stepper's off-critical-path collectives
+# (BLUEFOG_COMM_OVERLAP, docs/performance.md "Overlap") into actual
+# start/done pairs the scheduler can move compute between.  Names vary by
+# XLA build generation, hence the probe: anything the installed build does
+# not know is skipped (an unknown XLA_FLAGS name is a process FATAL).
+LATENCY_HIDING_FLAGS = [
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+]
+
+
+def latency_hiding_flags(env: Dict[str, str]) -> Dict[str, str]:
+    """Probe-gate and append the async-collective / latency-hiding
+    scheduler flags to ``env['XLA_FLAGS']``.
+
+    Each candidate is checked against the installed XLA build first
+    (:func:`xla_flags_supported`: one throwaway subprocess, disk-cached
+    per jaxlib version) and appended only when known — injecting an
+    unknown name would fatal the real process at first backend use, while
+    skipping a tuning flag merely loses overlap.  User-set flags win
+    (:func:`append_xla_flag` semantics); ``BLUEFOG_NO_XLA_FLAG_INJECT``
+    or ``BLUEFOG_LATENCY_HIDING=0`` skips entirely.  Applied by the
+    ``bfrun`` launcher for non-CPU platforms (``run.py``); call it
+    yourself before first backend use for un-launched programs.
+    Documented in docs/env_variable.md."""
+    if env.get("BLUEFOG_NO_XLA_FLAG_INJECT"):
+        return env
+    if env.get("BLUEFOG_LATENCY_HIDING", "1") == "0":
+        return env
+    support = xla_flags_supported(LATENCY_HIDING_FLAGS)
+    for flag in LATENCY_HIDING_FLAGS:
+        if support[flag.lstrip("-").split("=", 1)[0]]:
+            append_xla_flag(env, flag)
+    return env
+
+
 def arm_low_core_cpu_mitigations(env: Dict[str, str],
                                  terminate_timeout_s: int = 1200
                                  ) -> Dict[str, str]:

@@ -161,6 +161,15 @@ def _apply_common_flags(args, env: dict, local_slots: int) -> dict:
             env["JAX_PLATFORMS"] = "cpu"
     elif args.platform:
         env["JAX_PLATFORMS"] = args.platform
+    # async-collective / latency-hiding scheduler flags, probe-gated
+    # against the installed XLA build (skip with BLUEFOG_LATENCY_HIDING=0
+    # / BLUEFOG_NO_XLA_FLAG_INJECT).  CPU targets skip them — whether
+    # forced by --platform cpu or by an inherited JAX_PLATFORMS=cpu:
+    # XLA:CPU keeps collectives synchronous anyway and the virtual-device
+    # runs value deterministic scheduling.
+    platform_hint = (args.platform or env.get("JAX_PLATFORMS", "")).lower()
+    if "cpu" not in platform_hint:
+        env_util.latency_hiding_flags(env)
     return env
 
 

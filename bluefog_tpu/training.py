@@ -45,7 +45,10 @@ def replicate_to_ranks(tree, size: Optional[int] = None):
 
 def create_train_state(model, base_opt: optax.GradientTransformation,
                        rng, sample_input, train: bool = True,
-                       communication: str = None):
+                       communication: str = None,
+                       overlap: Optional[bool] = None,
+                       fuse: Optional[bool] = None,
+                       fusion_bucket_bytes: Optional[int] = None):
     """Initialize (variables, opt_state) in global view.
 
     All ranks start from the same weights, matching the reference's
@@ -53,13 +56,26 @@ def create_train_state(model, base_opt: optax.GradientTransformation,
     Pass the SAME ``communication`` you will give ``make_train_step`` when
     the strategy carries extra state (``exact_diffusion`` adds the
     psi_prev tree); for every other mode the argument is ignored.
+
+    ``overlap`` (default ``BLUEFOG_COMM_OVERLAP``, off): the overlapped
+    stepper carries its in-flight exchange buffers in the opt state —
+    pass the same ``overlap``/``fuse``/``fusion_bucket_bytes`` you will
+    give ``make_train_step`` so the carried-buffer layout matches the
+    step that donates it.
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     extra = {k: v for k, v in variables.items() if k != "params"}
     gparams = replicate_to_ranks(params)
     gextra = replicate_to_ranks(extra)
-    if communication == "exact_diffusion":
+    if S.overlap_enabled(overlap):
+        # the ONE definition of the pipeline state layout (warmup in-flight
+        # buffers + optional psi_prev) lives in strategies.delayed_init
+        opt_state = jax.vmap(lambda p: S.delayed_init(
+            base_opt, p, fuse=fuse,
+            fusion_bucket_bytes=fusion_bucket_bytes,
+            exact_diffusion=communication == "exact_diffusion"))(gparams)
+    elif communication == "exact_diffusion":
         # the ONE definition of the ED state layout lives in strategies.py
         # (psi_prev copied there: params+opt_state donation stays legal)
         opt_state = jax.vmap(
@@ -79,7 +95,8 @@ def make_train_step(model,
                     donate: bool = True,
                     check_vma: Optional[bool] = None,
                     fuse: Optional[bool] = None,
-                    fusion_bucket_bytes: Optional[int] = None):
+                    fusion_bucket_bytes: Optional[int] = None,
+                    overlap: Optional[bool] = None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -95,6 +112,17 @@ def make_train_step(model,
     bit-exact results; ``fusion_bucket_bytes`` tunes the bucket cap
     (``docs/performance.md``).  Both snapshot at build time, like the
     exchange backend.
+
+    ``overlap`` (default ``BLUEFOG_COMM_OVERLAP``, off): staleness-1
+    delayed-mix pipeline — the step folds the PREVIOUS step's exchange
+    result (carried in the donated opt state as fused flat buffers) and
+    launches this step's exchange off the critical path, so XLA schedules
+    the ppermute traffic concurrently with forward/backward
+    (docs/performance.md "Overlap").  Supported for ``neighbor_allreduce``
+    / ``allreduce`` / ``exact_diffusion`` with
+    ``num_steps_per_communication=1``; create the opt state with
+    ``create_train_state(..., overlap=True)``.  Step 0 is a documented
+    warmup (local-only) step.
 
     Returns ``train_step(variables, opt_state, batch, step) ->
     (variables, opt_state, loss)`` where ``batch = (x, y)`` with leading
@@ -131,6 +159,20 @@ def make_train_step(model,
     fuse = _fusion.fusion_enabled(fuse)
     fusion_bucket_bytes = _fusion.resolve_max_bucket_bytes(
         fusion_bucket_bytes)
+    overlap = S.overlap_enabled(overlap)
+    if overlap:
+        if communication not in ("neighbor_allreduce", "allreduce",
+                                 "exact_diffusion"):
+            raise ValueError(
+                f"overlap=True supports neighbor_allreduce / allreduce / "
+                f"exact_diffusion, got {communication!r} (gradient "
+                f"averaging has no weight exchange to pipeline; "
+                f"hierarchical's two-level mix has no single in-flight "
+                f"self weight)")
+        if num_steps_per_communication > 1:
+            raise ValueError(
+                "overlap=True assumes one exchange per step "
+                "(num_steps_per_communication=1)")
     if check_vma is None:
         # any pallas kernel inside the shard_map needs vma checking off
         # (kernel-internal scratch carries no varying-axes tags): the
@@ -143,7 +185,23 @@ def make_train_step(model,
             or getattr(getattr(model, "block_cls", None),
                        "contains_pallas", False))
         check_vma = not (nar_backend.startswith("pallas") or model_pallas)
-    if grad_ar:
+    if overlap:
+        if exact_diffusion:
+            core = S.delayed_exact_diffusion_step(
+                base_opt, comm_type, cx.rank_axis,
+                topo=S.exact_diffusion_topology(cx.compiled_topology),
+                machine_axes=(cx.machine_axis, cx.local_axis),
+                machine_topo=machine_topo, nar_backend=nar_backend,
+                fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        else:
+            builder = S.delayed_atc_step if atc else S.delayed_consensus_step
+            core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
+                           sched=sched,
+                           machine_axes=(cx.machine_axis, cx.local_axis),
+                           machine_topo=machine_topo,
+                           nar_backend=nar_backend, fuse=fuse,
+                           fusion_bucket_bytes=fusion_bucket_bytes)
+    elif grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
                 "gradient accumulation (num_steps_per_communication > 1 with "
@@ -172,7 +230,7 @@ def make_train_step(model,
                        machine_axes=(cx.machine_axis, cx.local_axis),
                        machine_topo=machine_topo, nar_backend=nar_backend,
                        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
-    if not exact_diffusion:
+    if not (exact_diffusion or overlap):
         core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
                                   num_steps_per_communication)
 

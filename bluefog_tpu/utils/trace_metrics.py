@@ -13,6 +13,17 @@ collective in the program.  ``lax.ppermute`` lowers to
 ``stablehlo.collective_permute``, ``psum``/``pmean`` to
 ``stablehlo.all_reduce``, ``all_gather`` to ``stablehlo.all_gather``
 (pmean's mean division is elementwise math, not a second collective).
+
+Async split ops: backends that hide collective latency split an op into a
+``collective-permute-start`` / ``collective-permute-done`` pair in the
+OPTIMIZED HLO (the latency-hiding scheduler then moves compute between
+the two halves).  The counters recognize both dialect spellings; a fused
+(synchronous) ``collective-permute`` never matches the ``-start/-done``
+forms and vice versa.  ``compiled_collective_counts`` inspects the
+post-compile text where the split happens — CPU lowering keeps
+collectives synchronous, which is itself the documented evidence mode for
+the overlap pipeline (per-step sync count unchanged while the mix
+consumes the prior step's buffer).
 """
 
 import re
@@ -21,23 +32,57 @@ from typing import Any, Dict, Tuple
 
 import jax
 
-__all__ = ["collective_counts", "count_collectives_in_text", "lower_text"]
+__all__ = ["collective_counts", "compiled_collective_counts",
+           "count_collectives_in_text", "lower_text"]
 
-# op-name mnemonics in jax's StableHLO output; matched with a word
-# boundary so e.g. all_gather never double-counts all_reduce
+# op-name mnemonics in jax's StableHLO output and the optimized-HLO
+# dialect; matched with a word boundary so e.g. all_gather never
+# double-counts all_reduce, and the sync forms never match the async
+# -start/-done splits.  HLO-dialect forms carry a (?<!%) guard:
+# instruction NAMES and operand references are %-prefixed
+# (`%collective-permute.1 = ... collective-permute(%x)`), and counting
+# them would tally every op at least twice — only the un-prefixed opcode
+# position is the op itself.
 _PATTERNS = {
-    "ppermute": re.compile(r"\bstablehlo\.collective_permute\b"),
-    "all_reduce": re.compile(r"\bstablehlo\.all_reduce\b"),
-    "all_gather": re.compile(r"\bstablehlo\.all_gather\b"),
-    "all_to_all": re.compile(r"\bstablehlo\.all_to_all\b"),
-    "reduce_scatter": re.compile(r"\bstablehlo\.reduce_scatter\b"),
+    "ppermute": re.compile(
+        r"\bstablehlo\.collective_permute\b(?!_)"
+        r"|(?<!%)\bcollective-permute\b(?!-(?:start|done))"),
+    "all_reduce": re.compile(
+        r"\bstablehlo\.all_reduce\b"
+        r"|(?<!%)\ball-reduce\b(?!-(?:start|done))"),
+    "all_gather": re.compile(
+        r"\bstablehlo\.all_gather\b"
+        r"|(?<!%)\ball-gather\b(?!-(?:start|done))"),
+    "all_to_all": re.compile(
+        r"\bstablehlo\.all_to_all\b|(?<!%)\ball-to-all\b"),
+    "reduce_scatter": re.compile(
+        r"\bstablehlo\.reduce_scatter\b|(?<!%)\breduce-scatter\b"),
+}
+
+# async split halves (overlap-eligible collectives), outside "total"
+_ASYNC_PATTERNS = {
+    "ppermute_start": re.compile(
+        r"\bstablehlo\.collective_permute_start\b"
+        r"|(?<!%)\bcollective-permute-start\b"),
+    "ppermute_done": re.compile(
+        r"\bstablehlo\.collective_permute_done\b"
+        r"|(?<!%)\bcollective-permute-done\b"),
 }
 
 
 def count_collectives_in_text(text: str) -> Dict[str, int]:
-    """Per-kind collective-op counts in a StableHLO module string."""
+    """Per-kind collective-op counts in an HLO/StableHLO module string.
+
+    ``total`` sums the synchronous kinds; the async split halves are
+    reported separately as ``ppermute_start``/``ppermute_done`` with
+    ``ppermute_pairs`` = complete start/done pairs (the overlap-eligible
+    collective count)."""
     counts = {kind: len(pat.findall(text)) for kind, pat in _PATTERNS.items()}
     counts["total"] = sum(counts.values())
+    for kind, pat in _ASYNC_PATTERNS.items():
+        counts[kind] = len(pat.findall(text))
+    counts["ppermute_pairs"] = min(counts["ppermute_start"],
+                                   counts["ppermute_done"])
     return counts
 
 
@@ -63,4 +108,22 @@ def collective_counts(fn, *args, **kwargs) -> Dict[str, Any]:
     out: Dict[str, Any] = count_collectives_in_text(text)
     out["trace_s"] = trace_s
     out["hlo_lines"] = text.count("\n")
+    return out
+
+
+def compiled_collective_counts(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Collective counts in the POST-COMPILE (optimized) HLO — where a
+    latency-hiding backend splits async collectives into start/done pairs
+    (``ppermute_pairs`` counts them; ``ppermute`` counts the ops left
+    synchronous).  Unlike :func:`collective_counts` this runs the backend
+    compiler; on CPU the collectives stay synchronous, so a zero pair
+    count there is expected, not a regression — assert on the sync count
+    instead."""
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    t0 = time.perf_counter()
+    compiled = fn.lower(*args, **kwargs).compile()
+    text = compiled.as_text()
+    out: Dict[str, Any] = count_collectives_in_text(text)
+    out["compile_s"] = time.perf_counter() - t0
     return out

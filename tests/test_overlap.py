@@ -1,0 +1,556 @@
+"""Overlapped decentralized stepping: the staleness-1 delayed-mix pipeline.
+
+Acceptance criteria (ISSUE 3):
+
+* Bit-exact pipeline equivalence — for each delayed strategy variant the
+  overlapped jitted step, after its warmup step, reproduces the explicit
+  staleness-1 reference recurrence exactly (float equality, ragged
+  mixed-dtype trees).  The reference here is an independently written
+  jitted program computing the recurrence from its formula with explicit
+  carried arguments (same op structure, so XLA's fast-math FMA contraction
+  matches; the C operator itself is proven against per-leaf execution in
+  test_fusion.py).
+* Compile stability — advancing dynamic schedules and flipping the
+  degraded guard under overlap trigger zero recompiles.
+* Trace evidence — on CPU lowering the overlapped step's synchronous
+  collective count is unchanged while the mix consumes the prior step's
+  carried buffer (async start/done pairs are a backend property;
+  utils/trace_metrics counts both forms).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.ops import fusion as F
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.run import env_util
+from bluefog_tpu.utils import trace_metrics as TM
+
+from conftest import N_DEVICES as N
+
+CT = S.CommunicationType
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+def ragged_tree(seed=0, n=N):
+    """Mixed f32/bf16 global-view pytree with a scalar and an empty leaf."""
+    rng = np.random.default_rng(seed)
+    r = lambda *s: jnp.asarray(rng.normal(size=(n,) + s), jnp.float32)
+    rb = lambda *s: jnp.asarray(rng.normal(size=(n,) + s), jnp.bfloat16)
+    return {
+        "a": r(3, 5),
+        "b": rb(7),
+        "scalar": r(),
+        "nested": {"w": r(2, 2, 2), "empty": r(0, 4), "v": rb(5, 3)},
+    }
+
+
+def grads_like(tree, seed=1):
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), p.dtype), tree)
+
+
+def assert_trees_bitexact(a, b):
+    def eq(x, y):
+        assert x.shape == y.shape and x.dtype == y.dtype, (
+            f"signature mismatch {x.shape}/{x.dtype} vs {y.shape}/{y.dtype}")
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"max |diff| = "
+            f"{np.abs(np.asarray(x, np.float64) - np.asarray(y, np.float64)).max()}")
+    jax.tree.map(eq, a, b)
+
+
+def one_peer_sched(n=N):
+    topo = bf.load_topology()
+    return bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+
+def make_reference_stepper(cx, mode, comm_type, topo=None, sched=None,
+                           fuse=True, base=None):
+    """One jitted program per step implementing the EXPLICIT staleness-1
+    recurrence with the in-flight state as plain arguments:
+
+      consensus: m_t = d_prev x_t + nbuf;  x_{t+1} = adapt(m_t, g_t)
+                 launch value v_t = x_t
+      atc:       z_t = adapt(x_t, g_t);    x_{t+1} = d_prev z_t + nbuf
+                 launch value v_t = z_t
+      ed:        psi/phi as exact-diffusion; x_{t+1} = d_prev phi_t + nbuf
+                 launch value v_t = phi_t
+
+    with nbuf' = C_t(v_t) - d_t v_t and d_prev' = d_t.  Carries the
+    neighbor buffer as a per-leaf TREE (the pipeline carries fused flat
+    buckets — the roundtrip is exact, so results must still match
+    bitwise)."""
+    spec = P(cx.rank_axis)
+    size = cx.size
+
+    def self_weight(step):
+        if comm_type == CT.allreduce:
+            return jnp.float32(1.0) / lax.axis_size(cx.rank_axis)
+        if sched is not None:
+            t = jnp.asarray(step) % sched.period
+            return jnp.asarray(sched.self_weights,
+                               jnp.float32)[t][lax.axis_index(cx.rank_axis)]
+        return jnp.asarray(topo.self_weights,
+                           jnp.float32)[lax.axis_index(cx.rank_axis)]
+
+    @jax.jit
+    def ref_step(x, nbuf, dprev, psi_prev, g, bst, step):
+        def shard_fn(xs, nbs, dps, pps, gs, bs, si):
+            x_r = jax.tree.map(lambda a: a[0], xs)
+            nb_r = jax.tree.map(lambda a: a[0], nbs)
+            pp_r = jax.tree.map(lambda a: a[0], pps)
+            g_r = jax.tree.map(lambda a: a[0], gs)
+            b_r = jax.tree.map(lambda a: a[0], bs)
+            dp = dps[0]
+            fold = lambda v: jax.tree.map(
+                lambda l, nb: dp.astype(l.dtype) * l + nb, v, nb_r)
+            if mode == "consensus":
+                mixed = fold(x_r)
+                upd, b_new = base.update(g_r, b_r, mixed)
+                x_new = optax.apply_updates(mixed, upd)
+                launch = x_r
+                pp_new = pp_r
+            elif mode == "atc":
+                upd, b_new = base.update(g_r, b_r, x_r)
+                z = optax.apply_updates(x_r, upd)
+                x_new = fold(z)
+                launch = z
+                pp_new = pp_r
+            else:                                      # exact-diffusion
+                upd, b_new = base.update(g_r, b_r, x_r)
+                psi = optax.apply_updates(x_r, upd)
+                phi = jax.tree.map(lambda s_, l, sp: s_ + l - sp,
+                                   psi, x_r, pp_r)
+                x_new = fold(phi)
+                launch = phi
+                pp_new = psi
+            full = S._communicate(launch, comm_type, cx.rank_axis, topo,
+                                  sched, si, None, None, "xla", fuse=fuse)
+            d = self_weight(si)
+            nb_new = jax.tree.map(lambda f_, l: f_ - d.astype(l.dtype) * l,
+                                  full, launch)
+            lead = lambda t_: jax.tree.map(lambda a: a[None], t_)
+            return (lead(x_new), lead(nb_new), d[None], lead(pp_new),
+                    lead(b_new))
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, spec, spec, spec, spec, P()),
+            out_specs=(spec, spec, spec, spec, spec),
+        )(x, nbuf, dprev, psi_prev, g, bst, step)
+
+    def run(params, grads, steps):
+        x = params
+        nbuf = jax.tree.map(jnp.zeros_like, params)
+        dprev = jnp.ones((size,), jnp.float32)
+        psi_prev = jax.tree.map(jnp.array, params)
+        if mode == "ed":
+            bst = jax.vmap(base.init)(params)
+        else:
+            bst = jax.vmap(base.init)(params)
+        for t in range(steps):
+            x, nbuf, dprev, psi_prev, bst = ref_step(
+                x, nbuf, dprev, psi_prev, grads, bst, jnp.int32(t))
+        return x
+
+    return run
+
+
+def to_global_tree(tree):
+    """Rank-shard a global-view tree like the steppers' outputs: keeps the
+    compile-count asserts about STEADY STATE (host-layout first inputs
+    would add one warmup compile that has nothing to do with overlap)."""
+    from bluefog_tpu.ops import api as _api
+    return jax.tree.map(_api.to_global, tree)
+
+
+def run_wrapper(opt, params, grads, steps):
+    params, grads = to_global_tree(params), to_global_tree(grads)
+    state = to_global_tree(opt.init(params))
+    p = params
+    for t in range(steps):
+        p, state = opt.step(p, grads, state, step=t)
+    return p, state
+
+
+# ---------------------------------------------------------------------------
+# bit-exact pipeline equivalence, per delayed variant
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fuse", [True, False])
+def test_delayed_consensus_matches_reference(bf_ctx, fuse):
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.1, momentum=0.9)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, overlap=True,
+                                                   fuse=fuse)
+    got, _ = run_wrapper(opt, params, grads, steps=5)
+    ref = make_reference_stepper(bf_ctx, "consensus",
+                                 CT.neighbor_allreduce,
+                                 topo=bf_ctx.compiled_topology, fuse=fuse,
+                                 base=base)(params, grads, 5)
+    assert_trees_bitexact(got, ref)
+
+
+def test_delayed_awc_shares_consensus_semantics(bf_ctx):
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.05)
+    awc, _ = run_wrapper(bf.DistributedAdaptWithCombineOptimizer(
+        base, overlap=True), params, grads, steps=4)
+    ref = make_reference_stepper(bf_ctx, "consensus",
+                                 CT.neighbor_allreduce,
+                                 topo=bf_ctx.compiled_topology,
+                                 base=base)(params, grads, 4)
+    assert_trees_bitexact(awc, ref)
+
+
+def test_delayed_atc_matches_reference(bf_ctx):
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.1, momentum=0.9)
+    opt = bf.DistributedAdaptThenCombineOptimizer(base, overlap=True)
+    got, _ = run_wrapper(opt, params, grads, steps=5)
+    ref = make_reference_stepper(bf_ctx, "atc", CT.neighbor_allreduce,
+                                 topo=bf_ctx.compiled_topology,
+                                 base=base)(params, grads, 5)
+    assert_trees_bitexact(got, ref)
+
+
+def test_delayed_dynamic_schedule_matches_reference(bf_ctx):
+    """The launch at step t uses the step-t matrix; its fold at t+1 pairs
+    the stale neighbor sum with the SAME matrix's self weight — mass
+    conserved under per-step dynamic schedules."""
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    sched = one_peer_sched()
+    base = optax.sgd(0.05)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, sched=sched,
+                                                   overlap=True)
+    steps = sched.period + 2
+    got, _ = run_wrapper(opt, params, grads, steps)
+    ref = make_reference_stepper(bf_ctx, "consensus",
+                                 CT.neighbor_allreduce, sched=sched,
+                                 base=base)(params, grads, steps)
+    assert_trees_bitexact(got, ref)
+
+
+def test_delayed_allreduce_matches_reference(bf_ctx):
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.1)
+    opt = bf.DistributedAllreduceOptimizer(base, overlap=True)
+    got, _ = run_wrapper(opt, params, grads, steps=4)
+    ref = make_reference_stepper(bf_ctx, "consensus", CT.allreduce,
+                                 base=base)(params, grads, 4)
+    assert_trees_bitexact(got, ref)
+
+
+def test_delayed_exact_diffusion_matches_reference(bf_ctx):
+    bf.set_topology(bf.SymmetricExponentialGraph(N))
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.05)
+    opt = bf.DistributedExactDiffusionOptimizer(base, overlap=True)
+    got, _ = run_wrapper(opt, params, grads, steps=5)
+    # the wrapper mixes over the damped (I+W)/2 topology
+    damped = S.exact_diffusion_topology(bf_ctx.compiled_topology)
+    ref = make_reference_stepper(bf_ctx, "ed", CT.neighbor_allreduce,
+                                 topo=damped, base=base)(params, grads, 5)
+    assert_trees_bitexact(got, ref)
+
+
+def test_warmup_step_is_local_only(bf_ctx):
+    """Step 0 folds the zero buffer with self weight 1: a pure local
+    adapt — the documented warmup while the first exchange is in
+    flight."""
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    base = optax.sgd(0.1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, overlap=True)
+    state = opt.init(params)
+    p1, state = opt.step(params, grads, state, step=0)
+    local = bf.DistributedGradientAllreduceOptimizer(base)  # any local base
+    upd, _ = jax.vmap(base.update)(grads, jax.vmap(base.init)(params),
+                                   params)
+    expected = optax.apply_updates(params, upd)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a, np.float64), np.asarray(b, np.float64), rtol=1e-6),
+        p1, expected)
+    # and the launched in-flight state is no longer the warmup zeros
+    bufs = jax.tree.leaves(state["inflight"]["bufs"])
+    assert any(np.abs(np.asarray(b)).sum() > 0 for b in bufs)
+
+
+def test_delayed_neighbor_averaging_contracts_spread(bf_ctx):
+    """Zero-gradient pipeline = pure delayed gossip: per-rank spread
+    still contracts (the consensus property survives staleness-1)."""
+    rng = np.random.default_rng(3)
+    params = {"w": jnp.asarray(rng.normal(size=(N, 6)), jnp.float32)}
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0),
+                                                   overlap=True)
+    state = opt.init(params)
+    p = params
+    for t in range(40):
+        p, state = opt.step(p, zeros, state, step=t)
+    spread0 = np.asarray(params["w"]).std(axis=0).mean()
+    spread1 = np.asarray(p["w"]).std(axis=0).mean()
+    assert spread1 < 0.05 * spread0
+
+
+# ---------------------------------------------------------------------------
+# state layout + knob validation
+# ---------------------------------------------------------------------------
+
+def test_overlap_state_carries_fused_buckets(bf_ctx):
+    params = ragged_tree()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1),
+                                                   overlap=True, fuse=True)
+    state = opt.init(params)
+    per_rank = jax.tree.map(lambda a: a[0], params)
+    plan = F.plan_for(per_rank)
+    bufs = state["inflight"]["bufs"]
+    assert isinstance(bufs, tuple) and len(bufs) == plan.n_buckets
+    for buf, bucket in zip(bufs, plan.buckets):
+        assert buf.shape == (N, bucket.padded) and buf.dtype == bucket.dtype
+    assert state["inflight"]["self_w"].shape == (N,)
+
+
+def test_overlap_knob_validation(bf_ctx):
+    base = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="gradient allreduce"):
+        bf.DistributedGradientAllreduceOptimizer(base).__class__(
+            base, CT.empty, gradient_allreduce=True, overlap=True)
+    with pytest.raises(ValueError, match="neighbor_allreduce/allreduce"):
+        bf.DistributedAdaptThenCombineOptimizer(
+            base, communication_type=CT.hierarchical_neighbor_allreduce,
+            overlap=True)
+    with pytest.raises(ValueError, match="one exchange per step"):
+        bf.DistributedNeighborAllreduceOptimizer(
+            base, num_steps_per_communication=2, overlap=True)
+    with pytest.raises(ValueError, match="supports neighbor_allreduce"):
+        T.make_train_step(None, base, communication="gradient_allreduce",
+                          overlap=True)
+
+
+def test_overlap_env_flag_and_cache_key(bf_ctx, monkeypatch):
+    """BLUEFOG_COMM_OVERLAP resolves at construction; overlap joins the
+    step-cache key, so one optimizer run never mixes programs."""
+    monkeypatch.setenv("BLUEFOG_COMM_OVERLAP", "1")
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    assert opt.overlap is True
+    monkeypatch.setenv("BLUEFOG_COMM_OVERLAP", "0")
+    assert bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1)).overlap is False
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    run_wrapper(opt, params, grads, steps=2)
+    assert len(opt._step_cache) == 1
+    key = next(iter(opt._step_cache))
+    assert True in key                      # overlap flag is in the key
+
+
+# ---------------------------------------------------------------------------
+# compile stability
+# ---------------------------------------------------------------------------
+
+def test_overlap_dynamic_schedule_never_recompiles(bf_ctx):
+    params, grads = ragged_tree(), grads_like(ragged_tree())
+    sched = one_peer_sched()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.05),
+                                                   sched=sched,
+                                                   overlap=True)
+    run_wrapper(opt, params, grads, steps=sched.period * 2)
+    assert len(opt._step_cache) == 1
+    assert next(iter(opt._step_cache.values()))._cache_size() == 1
+
+
+def test_overlap_degraded_guard_zero_recompiles(bf_ctx):
+    """Flipping faults under overlap is traced data: the degraded branch
+    resets the pipeline (zero buffer, self weight 1) inside the SAME
+    compiled program."""
+    cx = bf_ctx
+    base = optax.sgd(0.1)
+    topo = cx.compiled_topology
+    delayed = S.delayed_consensus_step(base, CT.neighbor_allreduce,
+                                       cx.rank_axis, topo=topo,
+                                       nar_backend="xla", fuse=True)
+    guarded = S.with_degraded_guard(delayed, S.delayed_local_step(base))
+    spec = P(cx.rank_axis)
+
+    def stepper(p, g, st, step, degraded):
+        def shard_fn(ps, gs, sts, si, dg):
+            p_new, st_new = guarded(
+                jax.tree.map(lambda a: a[0], ps),
+                jax.tree.map(lambda a: a[0], gs),
+                jax.tree.map(lambda a: a[0], sts), si, dg)
+            lead = lambda t: jax.tree.map(lambda a: a[None], t)
+            return lead(p_new), lead(st_new)
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, spec, P(), P()), out_specs=(spec, spec),
+        )(p, g, st, step, degraded)
+
+    fn = jax.jit(stepper)
+    params = to_global_tree(ragged_tree())
+    grads = to_global_tree(grads_like(ragged_tree()))
+    state = to_global_tree(
+        jax.vmap(lambda pp: S.delayed_init(base, pp, fuse=True))(params))
+    p = params
+    degraded_seq = [False, False, True, False, True, False]
+    for t, dg in enumerate(degraded_seq):
+        p, state = fn(p, grads, state, jnp.int32(t), jnp.asarray(dg))
+        if dg:
+            # pipeline reset: the degraded step leaves warmup state behind
+            for b in jax.tree.leaves(state["inflight"]["bufs"]):
+                assert np.abs(np.asarray(b)).sum() == 0
+            np.testing.assert_array_equal(
+                np.asarray(state["inflight"]["self_w"]), 1.0)
+    assert fn._cache_size() == 1
+    jax.tree.map(lambda a: np.isfinite(np.asarray(a, np.float64)).all(), p)
+
+
+# ---------------------------------------------------------------------------
+# train-step integration
+# ---------------------------------------------------------------------------
+
+def _mlp_problem(seed=0):
+    from bluefog_tpu.models.mlp import MLP
+    model = MLP(features=(16, 16), num_outputs=4)
+    base = optax.sgd(0.1)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(N, 4, 6, 6, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 4)))
+    return model, base, x, y
+
+
+def test_train_step_overlap_loss_decreases(bf_ctx):
+    model, base, x, y = _mlp_problem()
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 6, 6, 1)),
+        overlap=True)
+    assert "inflight" in opt_state
+    variables, opt_state = (to_global_tree(variables),
+                            to_global_tree(opt_state))
+    step = T.make_train_step(model, base, overlap=True, donate=False)
+    losses = []
+    for t in range(10):
+        variables, opt_state, loss = step(variables, opt_state, (x, y),
+                                          jnp.int32(t))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert step._cache_size() == 1          # step index stays traced data
+
+
+def test_train_step_overlap_sync_collective_count_unchanged(bf_ctx):
+    """Trace evidence (CPU lowering): the overlapped step issues the SAME
+    per-step synchronous collective count as the sync step — the exchange
+    moved off the critical path, it did not multiply — while the mix
+    consumes the prior step's carried buffer."""
+    model, base, x, y = _mlp_problem()
+    counts = {}
+    for ov in (False, True):
+        variables, opt_state = T.create_train_state(
+            model, base, jax.random.key(0), jnp.zeros((1, 6, 6, 1)),
+            overlap=ov)
+        step = T.make_train_step(model, base, overlap=ov, donate=False)
+        counts[ov] = TM.collective_counts(step, variables, opt_state,
+                                          (x, y), jnp.int32(0))
+    assert counts[True]["ppermute"] == counts[False]["ppermute"]
+    assert counts[True]["ppermute"] > 0
+
+
+def test_trace_metrics_counts_async_pairs():
+    text = """
+      %cps = collective-permute-start(f32[8]{0} %p0)
+      %cpd = collective-permute-done(%cps)
+      %cp = collective-permute(f32[8]{0} %p1)
+      stablehlo.collective_permute %x
+    """
+    counts = TM.count_collectives_in_text(text)
+    assert counts["ppermute_start"] == 1
+    assert counts["ppermute_done"] == 1
+    assert counts["ppermute_pairs"] == 1
+    assert counts["ppermute"] == 2          # sync forms only
+    assert counts["total"] == 2             # pairs reported separately
+
+
+# ---------------------------------------------------------------------------
+# resilience: mid-pipeline death degrades to self weight
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_overlap_kill_mid_pipeline(bf_ctx):
+    from bluefog_tpu.resilience import FaultPlan, LivenessConfig
+    from bluefog_tpu.resilience.harness import ChaosHarness
+    plan = FaultPlan(N, 40).rank_down(3, at=12)
+    h = ChaosHarness(plan, cfg=LivenessConfig(suspect_after=2,
+                                              confirm_after=4),
+                     overlap=True)
+    rep = h.run(np.zeros((N, 4), np.float32), steps=40)
+    assert np.isfinite(rep.losses).all()
+    assert list(rep.confirmed_dead) == [3]
+    # fold-time repair: at the death step the dead rank's stale in-flight
+    # value already gets zero weight (current fault tables mask the fold)
+    rep.check_matrix_invariants(step=12)
+    rep.check_matrix_invariants(step=-1)
+    rep.assert_bounded(max_consensus_error=2.0)
+    assert rep.losses[-1] < rep.losses[12]
+
+
+@pytest.mark.chaos
+def test_chaos_overlap_never_recompiles(bf_ctx):
+    from bluefog_tpu.resilience import FaultPlan, empty_plan
+    from bluefog_tpu.resilience.harness import ChaosHarness
+    h = ChaosHarness(empty_plan(N, 10), overlap=True)
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    h.plan = FaultPlan(N, 10).rank_down(2, at=1).compile()
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    assert h._step_fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# latency-hiding flag helper (satellite)
+# ---------------------------------------------------------------------------
+
+def test_latency_hiding_flags_probe_gated(monkeypatch):
+    probed = []
+
+    def fake_support(flags):
+        probed.extend(flags)
+        names = {f: f.lstrip("-").split("=", 1)[0] for f in flags}
+        # first candidate supported, rest not
+        first = env_util.LATENCY_HIDING_FLAGS[0]
+        return {names[f]: f == first for f in flags}
+
+    monkeypatch.setattr(env_util, "xla_flags_supported", fake_support)
+    env = {}
+    env_util.latency_hiding_flags(env)
+    assert env_util.LATENCY_HIDING_FLAGS[0] in env["XLA_FLAGS"]
+    for flag in env_util.LATENCY_HIDING_FLAGS[1:]:
+        assert flag not in env["XLA_FLAGS"]
+    assert probed == env_util.LATENCY_HIDING_FLAGS
+
+
+def test_latency_hiding_flags_user_wins_and_opt_out(monkeypatch):
+    monkeypatch.setattr(env_util, "xla_flags_supported",
+                        lambda flags: {f.lstrip("-").split("=", 1)[0]: True
+                                       for f in flags})
+    first = env_util.LATENCY_HIDING_FLAGS[0]
+    name = first.lstrip("-").split("=", 1)[0]
+    env = {"XLA_FLAGS": f"--{name}=false"}
+    env_util.latency_hiding_flags(env)
+    assert env["XLA_FLAGS"].count(name) == 1          # user setting wins
+    env2 = {"BLUEFOG_LATENCY_HIDING": "0"}
+    env_util.latency_hiding_flags(env2)
+    assert "XLA_FLAGS" not in env2
+    env3 = {"BLUEFOG_NO_XLA_FLAG_INJECT": "1"}
+    env_util.latency_hiding_flags(env3)
+    assert "XLA_FLAGS" not in env3

@@ -499,3 +499,98 @@ def test_win_update_clone_commits_nothing(bf_ctx):
     np.testing.assert_allclose(np.asarray(bf.win_fetch("w")), np.asarray(x))
     committed = bf.win_update("w")
     np.testing.assert_allclose(np.asarray(peek), np.asarray(committed))
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered nonblocking semantics (overlap PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_nonblocking_deferred_wait_matches_blocking(bf_ctx):
+    """win_put_nonblocking + deferred win_wait produces exactly the
+    blocking win_put result — and until the wait, win_update drains the
+    FRONT buffer (the pre-put state): genuinely asynchronous semantics
+    instead of wait-immediately."""
+    x = rank_tensor()
+    pushed = jnp.asarray(np.random.default_rng(0).normal(
+        size=np.asarray(x).shape), jnp.float32)
+
+    assert bf.win_create(x, "dbl_block")
+    bf.win_put(pushed, "dbl_block")
+    blocking = np.asarray(bf.win_update("dbl_block"))
+
+    assert bf.win_create(x, "dbl_async")
+    baseline = np.asarray(bf.win_update("dbl_async", clone=True))
+    h = bf.win_put_nonblocking(pushed, "dbl_async")
+    # BEFORE the wait: the back buffer holds the put, the front is
+    # untouched — an update sees the pre-put state
+    before = np.asarray(bf.win_update("dbl_async", clone=True))
+    np.testing.assert_array_equal(before, baseline)
+    assert bf.win_wait(h)                      # promote back -> front
+    after = np.asarray(bf.win_update("dbl_async"))
+    np.testing.assert_array_equal(after, blocking)
+
+
+def test_nonblocking_chain_waits_last_handle(bf_ctx):
+    """Chained un-waited ops coalesce in program order; waiting the last
+    handle publishes the whole chain (the FIFO guarantee), and a later
+    wait on an earlier handle is a no-op."""
+    bf.set_topology(bf.ExponentialTwoGraph(N))
+    x = rank_tensor((2,))
+    bf.win_create(x, "dbl_chain", zero_init=True)
+    h1 = bf.win_put_nonblocking(x, "dbl_chain")
+    h2 = bf.win_accumulate_nonblocking(x, "dbl_chain")
+    assert bf.win_wait(h2)
+    assert bf.win_wait(h1)                     # already published: no-op
+    topo = bf.load_topology()
+    U = (nx.to_numpy_array(topo) != 0).astype(np.float64)
+    np.fill_diagonal(U, 0.0)
+    got = np.asarray(bf.win_update("dbl_chain", self_weight=1.0,
+                                   neighbor_weights=U))
+    # put (1x) then accumulate (1x more): buffers hold 2x the neighbor
+    # values; update with weight 1 adds them onto the local tensor
+    expected = np.asarray(x, np.float64).copy()
+    W = nx.to_numpy_array(topo)
+    for dst in range(N):
+        for src in range(N):
+            if src != dst and W[src, dst] != 0:
+                expected[dst] += 2.0 * np.asarray(x, np.float64)[src]
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_double_buffer_state_dict_roundtrips_both_buffers(bf_ctx):
+    """win_state_dict carries the staged BACK buffer of an un-waited op
+    alongside the front; the restore re-stages it and win_flush promotes
+    it — the full put survives a checkpoint taken mid-flight."""
+    x = rank_tensor()
+    pushed = rank_tensor() * 3.0
+    assert bf.win_create(x, "dbl_ckpt")
+    bf.win_put(x, "dbl_ckpt")                  # committed front state
+    h = bf.win_put_nonblocking(pushed, "dbl_ckpt")   # staged back state
+    snap = bf.win_state_dict()
+    assert "pending" in snap["dbl_ckpt"]
+    front_before = np.asarray(bf.win_update("dbl_ckpt", clone=True))
+    bf.win_wait(h)
+    promoted_before = np.asarray(bf.win_update("dbl_ckpt", clone=True))
+    bf.win_free("dbl_ckpt")
+
+    assert bf.win_create(x, "dbl_ckpt")
+    bf.load_win_state_dict(snap)
+    # restored front first (the staged op is NOT auto-published)
+    np.testing.assert_array_equal(
+        np.asarray(bf.win_update("dbl_ckpt", clone=True)), front_before)
+    bf.win_flush("dbl_ckpt")
+    np.testing.assert_array_equal(
+        np.asarray(bf.win_update("dbl_ckpt", clone=True)), promoted_before)
+
+
+def test_double_buffer_opt_out_env(bf_ctx, monkeypatch):
+    """BLUEFOG_WIN_DOUBLE_BUFFER=0 restores wait-immediately visibility."""
+    monkeypatch.setenv("BLUEFOG_WIN_DOUBLE_BUFFER", "0")
+    x = rank_tensor()
+    pushed = rank_tensor() * 2.0
+    assert bf.win_create(x, "dbl_off")
+    baseline = np.asarray(bf.win_update("dbl_off", clone=True))
+    h = bf.win_put_nonblocking(pushed, "dbl_off")
+    visible = np.asarray(bf.win_update("dbl_off", clone=True))
+    assert not np.array_equal(visible, baseline)   # committed pre-wait
+    bf.win_wait(h)
