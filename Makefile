@@ -18,7 +18,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
-        bench-trace bench-overlap hwcheck chaos
+        bench-trace bench-overlap hwcheck chaos metrics-smoke
 
 test:
 	$(PYTEST) tests/
@@ -84,6 +84,13 @@ bench-overlap:
 	      % (o['off']['synchronous'], o['off']['overlap_eligible'], \
 	         o['on']['synchronous'], o['on']['overlap_eligible'], \
 	         o['off']['ppermute'], o['on']['ppermute']))"
+
+# Observability smoke (<=60s, CPU): 5-step telemetry-on loop — validates
+# the JSONL schema (BLUEFOG_METRICS sink) and that consensus distance is
+# finite and strictly decreasing on a consensus-only run
+# (docs/observability.md).
+metrics-smoke:
+	python scripts/metrics_smoke.py
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

@@ -30,6 +30,7 @@ enable_persistent_cache()   # shared by every script that imports bench
 import bluefog_tpu as bf
 from bluefog_tpu import training as T
 from bluefog_tpu.models.resnet import ResNet50, ResNet50Fused
+from bluefog_tpu.observability import metrics as bf_metrics
 
 BASELINE_PER_ACCEL = 4310.6 / 16  # img/sec per V100 (BASELINE.md row 1)
 # Single source for the watchdog defaults: the provenance start line and
@@ -351,6 +352,8 @@ def trace_only_main():
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
     jax.config.update("jax_platforms", "cpu")
+    # host metrics ride the emitted JSON (fusion plan shape, cache stats)
+    bf_metrics.enable()
 
     from bluefog_tpu.models.mlp import MLP
     from bluefog_tpu.ops import fusion as fusion_mod
@@ -419,12 +422,23 @@ def trace_only_main():
         "ppermute_drop":
             f"{report['per_leaf']['ppermute']} -> "
             f"{report['fused']['ppermute']}",
+        "ppermute_bytes_per_step": report["fused"]["ppermute_bytes"],
+        "total_collective_bytes_per_step": report["fused"]["total_bytes"],
         "overlap": overlap_report,
+        # final host-registry snapshot: comm-volume, fusion-plan shape and
+        # cache stats travel WITH the perf number in the BENCH_*.json
+        "metrics": bf_metrics.registry.snapshot(),
     }
     print(json.dumps(out))
 
 
 def main():
+    # host metrics registry on for the whole run: the final snapshot is
+    # embedded in the result JSON ("metrics": fusion plan shape/padding
+    # waste, step-cache recompiles, window/service counters), so perf
+    # trajectory files carry comm-volume and recompile counts alongside
+    # the step times
+    bf_metrics.enable()
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -682,6 +696,7 @@ def main():
         # achieved fraction of the chip's peak bf16 FLOP/s (MFU);
         # step_flops is per-device (post-SPMD-partitioning HLO)
         out["mfu_pct"] = round(step_flops / dt / peak * 100, 1)
+    out["metrics"] = bf_metrics.registry.snapshot()
     runlog(f"RESULT {json.dumps(out)} (per-pair step times: "
            f"{[round(t, 4) for t in step_times]})")
     print(json.dumps(out))

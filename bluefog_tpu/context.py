@@ -328,6 +328,12 @@ def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
     from . import timeline as _tl
     if os.environ.get("BLUEFOG_TIMELINE") and not _tl.timeline_enabled():
         _tl.timeline_start(rank=_context.rank())
+    # BLUEFOG_METRICS=<prefix> opens the JSONL metrics sink and enables
+    # the host registry the same way (observability/export.py)
+    if os.environ.get("BLUEFOG_METRICS"):
+        from .observability import export as _export
+        if not _export.metrics_active():
+            _export.metrics_start(rank=_context.rank())
     return _context
 
 
@@ -335,9 +341,11 @@ def shutdown() -> None:
     global _context
     from .ops import windows as _win
     from . import timeline as _tl
+    from .observability import export as _export
     _win.win_free()
     _win.turn_off_win_ops_with_associated_p()
     _tl.timeline_end()
+    _export.metrics_end()
     _context = None
 
 

@@ -102,6 +102,9 @@ def _declare(lib):
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
         ctypes.c_int64]
     lib.bft_timeline_record_at.restype = None
+    lib.bft_timeline_counter.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double, ctypes.c_int64]
+    lib.bft_timeline_counter.restype = None
     lib.bft_timeline_now_us.argtypes = []
     lib.bft_timeline_now_us.restype = ctypes.c_int64
     lib.bft_timeline_dropped.argtypes = []

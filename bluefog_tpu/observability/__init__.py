@@ -1,0 +1,33 @@
+"""Runtime telemetry subsystem — the observability layer.
+
+Three cooperating pieces (docs/observability.md):
+
+* ``ingraph``  — traced per-step training-health aggregates computed
+  INSIDE the jitted step (consensus distance, mixing-matrix mass, norms,
+  pipeline flags), returned as a ``TelemetrySnapshot`` aux pytree via the
+  ``telemetry=`` flag on the optimizer factories and
+  ``training.make_train_step``.
+* ``metrics``  — process-local host registry (counters/gauges/histograms
+  with named labels), instrumented into fusion, windows, the service,
+  resilience, and the step cache.  Free when disabled.
+* ``export``   — JSONL per-step series (``BLUEFOG_METRICS=<prefix>``),
+  Prometheus text dump, and Chrome-tracing counter lanes
+  (``"ph":"C"``) on the existing timeline.
+
+Only ``metrics`` loads eagerly (it is stdlib-only and imported from
+hot-path modules — fusion, windows, service, timeline); ``ingraph`` and
+``export`` resolve lazily so importing this package never drags the JAX
+optimizer stack or the timeline into an import cycle.
+"""
+
+import importlib
+
+from . import metrics
+
+__all__ = ["metrics", "ingraph", "export"]
+
+
+def __getattr__(name):
+    if name in ("ingraph", "export"):
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,219 @@
+"""Telemetry/metrics exporters: JSONL step series, Prometheus text,
+Perfetto counter lanes.
+
+Three sinks over the same data (``ingraph.TelemetrySnapshot`` aux outputs
+plus the host registry, ``observability/metrics.py``):
+
+* **JSONL** — one line per logged step, ``<prefix><rank>.jsonl``
+  (activation mirrors the timeline: ``BLUEFOG_METRICS=<prefix>`` before
+  ``bf.init()``, or :func:`metrics_start` explicitly).  Schema:
+  ``{"step", "t_us", "rank", <telemetry fields as float or [N] list>,
+  "counters": {registry snapshot}}`` — see ``docs/observability.md``.
+* **Prometheus text** — :func:`prometheus_text` renders the registry in
+  exposition format for a scrape endpoint or a ``curl``-able dump.
+* **Timeline counter lanes** — when the Chrome-tracing timeline is open,
+  :func:`log_step` also emits ``"ph":"C"`` counter events
+  (``timeline.record_counter``), so consensus distance, norms, and queue
+  depth render as live graph lanes NEXT TO the op spans in Perfetto —
+  the "watch the consensus process" headline UX.
+"""
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from .. import timeline as _tl
+from . import metrics as _metrics
+
+__all__ = [
+    "METRICS_ENV", "metrics_start", "metrics_end", "metrics_active",
+    "metrics_path", "log_step", "telemetry_to_host", "prometheus_text",
+    "validate_jsonl", "REQUIRED_JSONL_KEYS",
+]
+
+METRICS_ENV = "BLUEFOG_METRICS"
+
+# every JSONL line carries at least these keys (validate_jsonl contract,
+# shared by the tests and `make metrics-smoke`)
+REQUIRED_JSONL_KEYS = ("step", "t_us", "rank")
+
+# (file handle, path, rank, t0, enabled_registry_here)
+_sink = [None]
+
+
+def metrics_active() -> bool:
+    return _sink[0] is not None
+
+
+def metrics_path() -> Optional[str]:
+    return _sink[0][1] if _sink[0] else None
+
+
+def metrics_start(file_prefix: Optional[str] = None,
+                  rank: Optional[int] = None) -> Optional[str]:
+    """Open the per-rank JSONL metrics file and enable the host registry.
+
+    Called automatically by ``bf.init()`` when ``BLUEFOG_METRICS`` is set
+    (the same activation pattern as ``BLUEFOG_TIMELINE``).  Returns the
+    path, or None when no prefix resolves."""
+    if metrics_active():
+        raise RuntimeError(
+            "metrics export already started; call metrics_end() first")
+    if file_prefix is None:
+        file_prefix = os.environ.get(METRICS_ENV)
+    if not file_prefix:
+        return None
+    if rank is None:
+        from .. import context as _ctx
+        rank = _ctx.ctx().rank() if _ctx.is_initialized() else 0
+    path = f"{file_prefix}{rank}.jsonl"
+    f = open(path, "w")
+    enabled_here = not _metrics.enabled()
+    _metrics.enable()
+    _sink[0] = (f, path, rank, time.perf_counter(), enabled_here)
+    return path
+
+
+def metrics_end() -> None:
+    """Close the JSONL sink (idempotent).  The registry keeps its values —
+    only the enable flag is restored when :func:`metrics_start` set it."""
+    if _sink[0] is None:
+        return
+    f, _path, _rank, _t0, enabled_here = _sink[0]
+    _sink[0] = None
+    try:
+        f.close()
+    finally:
+        if enabled_here:
+            _metrics.disable()
+
+
+def telemetry_to_host(snapshot) -> Dict[str, object]:
+    """TelemetrySnapshot (or mapping) with device leaves -> plain floats /
+    float lists, ready for ``json.dumps``.  ``[N]`` leaves (the global
+    view a wrapped step returns) become per-rank lists; scalars become
+    floats."""
+    import numpy as np
+    if hasattr(snapshot, "asdict"):
+        snapshot = snapshot.asdict()
+    elif hasattr(snapshot, "_asdict"):
+        snapshot = snapshot._asdict()
+    out = {}
+    for k, v in dict(snapshot).items():
+        a = np.asarray(v, dtype=np.float64)
+        if a.ndim == 0:
+            out[k] = float(a)
+        else:
+            out[k] = [float(x) for x in a.reshape(-1)]
+    return out
+
+
+def _mean(v) -> float:
+    return float(sum(v) / len(v)) if isinstance(v, list) else float(v)
+
+
+def log_step(step: int, telemetry=None, extra: Optional[Dict] = None,
+             counters: bool = True) -> Optional[Dict]:
+    """Write one JSONL record for ``step`` and mirror the numeric fields
+    onto the timeline as counter lanes.
+
+    ``telemetry``: a :class:`~.ingraph.TelemetrySnapshot` (device arrays
+    fine — fetched here, OUTSIDE the jitted step) or an already-host dict.
+    ``extra``: additional JSON-able fields merged into the record.
+    ``counters=False`` skips the registry snapshot (cheaper lines).
+    Returns the record written, or None when no sink is open AND no
+    timeline is recording (nothing to do)."""
+    sink = _sink[0]
+    timeline_on = _tl.timeline_enabled()
+    if sink is None and not timeline_on:
+        return None
+    record: Dict[str, object] = {
+        "step": int(step),
+        "t_us": int((time.perf_counter() - (sink[3] if sink else 0.0)) * 1e6),
+        "rank": sink[2] if sink else 0,
+    }
+    tel_host = telemetry_to_host(telemetry) if telemetry is not None else {}
+    record.update(tel_host)
+    if extra:
+        record.update(extra)
+    if counters and _metrics.enabled():
+        record["counters"] = _metrics.registry.snapshot()
+    if sink is not None:
+        f = sink[0]
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+    if timeline_on:
+        # Perfetto counter lanes: per-rank telemetry collapses to the mean
+        # (one value per timestamp per lane); host gauges ride along so
+        # queue depth lines up with the op spans
+        for k, v in tel_host.items():
+            if k == "step":
+                continue
+            _tl.record_counter(f"telemetry/{k}", _mean(v))
+        if extra:
+            for k, v in extra.items():
+                if isinstance(v, (int, float)):
+                    _tl.record_counter(f"telemetry/{k}", float(v))
+    return record
+
+
+def prometheus_text(reg: Optional[_metrics.Registry] = None) -> str:
+    """Render the registry in Prometheus exposition format."""
+    reg = reg or _metrics.registry
+    lines = []
+    for m in reg.metrics():
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for key, val in m._items():
+            labels = ",".join(f'{k}="{v}"' for k, v in key)
+            if m.kind == "histogram":
+                for le, c in zip(m.buckets, val["buckets"]):
+                    ls = (labels + "," if labels else "") + f'le="{le}"'
+                    lines.append(f"{m.name}_bucket{{{ls}}} {c}")
+                ls = (labels + "," if labels else "") + 'le="+Inf"'
+                lines.append(f"{m.name}_bucket{{{ls}}} {val['count']}")
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(f"{m.name}_sum{suffix} {val['sum']}")
+                lines.append(f"{m.name}_count{suffix} {val['count']}")
+            else:
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(f"{m.name}{suffix} {val}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
+    """Parse a metrics JSONL file, enforcing the schema: every line is a
+    JSON object carrying ``required`` keys, with every numeric field
+    finite.  Returns the records; raises ValueError on violations (the
+    ``make metrics-smoke`` gate)."""
+    import math
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid JSON: {e}")
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{lineno}: not a JSON object")
+            missing = [k for k in required if k not in rec]
+            if missing:
+                raise ValueError(f"{path}:{lineno}: missing keys {missing}")
+
+            def check(k, v):
+                if isinstance(v, float) and not math.isfinite(v):
+                    raise ValueError(
+                        f"{path}:{lineno}: non-finite value for {k!r}")
+                if isinstance(v, list):
+                    for x in v:
+                        check(k, x)
+            for k, v in rec.items():
+                if not isinstance(v, dict):
+                    check(k, v)
+            records.append(rec)
+    return records

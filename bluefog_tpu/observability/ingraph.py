@@ -1,0 +1,203 @@
+"""In-graph training-health telemetry: traced per-step aggregates.
+
+The paper's neighbor-averaging claim — sparse-topology mixing matches
+allreduce quality at a fraction of the communication — rests on spectral
+properties that runtime machinery can silently degrade: the resilience
+layer repairs mixing matrices around deaths (``resilience/repair.py``),
+dynamic schedules rotate edge sets, and the overlapped stepper mixes
+one-step-stale neighbor values.  This module computes the health signals
+*inside* the jitted step, where they cost one extra ``pmean`` per fusion
+bucket instead of a post-hoc host reduction over the whole parameter tree:
+
+* **consensus distance** ``||x_i - x_bar||^2`` — THE consensus-process
+  observable (exponential-graph analysis, arXiv:2110.13363: convergence =
+  optimization error + consensus error).  Computed over the same fused
+  flat buffers the exchange already built (``ops/fusion.py``), so the
+  extra collective count is ``buckets``, not ``leaves``.
+* **mix column/row sums** — the step's effective mixing-matrix mass at
+  this rank.  Column sum != 1 means the receiver's weights no longer
+  conserve mass (a broken repair corrupts the iterates); row sum != 1
+  with column sum == 1 means the matrix is column- but not
+  doubly-stochastic (exact-averaging fixed points are gone — exactly the
+  silent degradation a column-family repair introduces).
+* **param / grad / update norms** — the weight-update telemetry gap
+  (arXiv:2004.13336) for sharded training.
+* **staleness / warmup / degraded flags** — which pipeline the value came
+  from: synchronous (0) vs the staleness-1 overlapped fold (1), whether
+  the fold was a warmup fold (zero in-flight buffer, self weight 1), and
+  whether the degraded guard's local branch ran.
+
+Everything is returned as a :class:`TelemetrySnapshot` — a small NamedTuple
+pytree of f32 scalars per rank — threaded through ``optim/strategies.py``
+as an aux output.  The gate is build-time (``telemetry=`` argument, env
+``BLUEFOG_TELEMETRY``): with telemetry off the builders take the exact
+pre-telemetry code path, asserted bit-identical on the lowered StableHLO
+by ``tests/test_observability.py``.
+"""
+
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import fusion as F
+
+__all__ = [
+    "TELEMETRY_ENV", "telemetry_enabled", "TelemetrySnapshot",
+    "consensus_distance", "tree_l2", "tree_diff_l2", "mix_mass",
+    "strategy_snapshot", "UNMEASURED",
+]
+
+TELEMETRY_ENV = "BLUEFOG_TELEMETRY"
+
+# sentinel for "this step did not measure the field" (e.g. consensus
+# distance in a degraded step that must issue no collective at all) —
+# distinguishable from every real squared distance, which is >= 0
+UNMEASURED = -1.0
+
+
+def telemetry_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the in-graph telemetry gate: explicit argument wins, else
+    ``BLUEFOG_TELEMETRY`` (default OFF).  Builders resolve this when the
+    step is constructed — same snapshot discipline as the fusion knobs
+    (jit traces once; the resolved value joins the step-cache key)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get(TELEMETRY_ENV, "0") == "1"
+
+
+class TelemetrySnapshot(NamedTuple):
+    """Per-rank, per-step training-health aggregates (f32 scalars inside
+    the shard_map body; ``[N]`` arrays once gathered to the global view).
+
+    ``consensus_dist`` is ``||x_i - x_bar||^2`` over the post-step
+    parameters (:data:`UNMEASURED` when the step could not issue the
+    pmean); ``mix_col_sum``/``mix_row_sum`` are this rank's column/row
+    mass of the step's mixing matrix; ``staleness`` is 0 for synchronous
+    mixing, 1 for the overlapped staleness-1 fold; ``warmup`` flags a
+    warmup fold (zero in-flight buffer); ``degraded`` flags the
+    degraded-guard/local branch."""
+    step: jax.Array
+    consensus_dist: jax.Array
+    param_norm: jax.Array
+    grad_norm: jax.Array
+    update_norm: jax.Array
+    mix_col_sum: jax.Array
+    mix_row_sum: jax.Array
+    staleness: jax.Array
+    warmup: jax.Array
+    degraded: jax.Array
+
+    def asdict(self):
+        return dict(zip(self._fields, self))
+
+
+FIELDS = TelemetrySnapshot._fields
+
+
+def _buffers(tree, fuse: bool, bucket_bytes: Optional[int]):
+    """Tree -> flat f32 views: the fused dtype buckets when fusion is on
+    (the plan is the trace-time-cached one the exchange already uses, so
+    the telemetry pmean count is ``buckets``, not ``leaves``), else the
+    non-empty leaves."""
+    if fuse:
+        plan = F.plan_for(tree, max_bucket_bytes=bucket_bytes)
+        bufs = F.flatten(plan, tree)
+    else:
+        bufs = [l for l in jax.tree.leaves(tree)]
+    return [b.astype(jnp.float32) for b in bufs if b.size]
+
+
+def consensus_distance(tree, axis_name, fuse: bool = True,
+                       bucket_bytes: Optional[int] = None):
+    """``||x_i - x_bar||^2`` in f32: one pmean per fusion bucket, squared
+    distance accumulated over buckets.  Padding tail elements are equal
+    (zero) on every rank and contribute exactly 0."""
+    d = jnp.float32(0.0)
+    for b in _buffers(tree, fuse, bucket_bytes):
+        mean = lax.pmean(b, axis_name)
+        d = d + jnp.sum((b - mean) ** 2)
+    return d
+
+
+def tree_l2(tree):
+    """f32 l2 norm over every element of the tree."""
+    s = jnp.float32(0.0)
+    for l in jax.tree.leaves(tree):
+        if l.size:
+            s = s + jnp.sum(jnp.square(l.astype(jnp.float32)))
+    return jnp.sqrt(s)
+
+
+def tree_diff_l2(a, b):
+    """f32 l2 norm of ``a - b`` (same structure)."""
+    s = jnp.float32(0.0)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        if la.size:
+            diff = la.astype(jnp.float32) - lb.astype(jnp.float32)
+            s = s + jnp.sum(jnp.square(diff))
+    return jnp.sqrt(s)
+
+
+def mix_mass(comm_type, axis_name, topo=None, sched=None, step=0,
+             machine_axes=None, machine_topo=None):
+    """This rank's (column sum, row sum) of the step's mixing matrix, as
+    traced f32 scalars.
+
+    ``comm_type`` is duck-typed on ``.value`` (the
+    ``strategies.CommunicationType`` enum) to keep this module importable
+    without the optimizer stack.  Column convention throughout
+    (``parallel/topology.py``): ``W[i, j]`` is the weight receiver j
+    applies to i's value, so MY column sum is the mass I apply to what I
+    receive and MY row sum is the mass my value gets across receivers.
+    """
+    value = getattr(comm_type, "value", str(comm_type))
+    one = jnp.float32(1.0)
+    if value in ("empty", "allreduce"):
+        # identity / uniform-1/N mixing: both sums are exactly 1
+        return one, one
+    if value == "neighbor.allreduce":
+        idx = lax.axis_index(axis_name)
+        if sched is not None:
+            t = jnp.asarray(step) % sched.period
+            W = jnp.asarray(sched.matrices, jnp.float32)[t]
+        else:
+            W = jnp.asarray(topo.weight_matrix, jnp.float32)
+        return W[:, idx].sum(), W[idx, :].sum()
+    if value == "hierarchical.neighbor.allreduce":
+        machine_axis, _local_axis = machine_axes
+        W = jnp.asarray(machine_topo.weight_matrix, jnp.float32)
+        m = lax.axis_index(machine_axis)
+        return W[:, m].sum(), W[m, :].sum()
+    raise ValueError(f"unknown communication type {value!r}")
+
+
+def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
+                      col_sum, row_sum, fuse, bucket_bytes,
+                      staleness=0.0, warmup=0.0, degraded=0.0,
+                      measure_consensus: bool = True) -> TelemetrySnapshot:
+    """Assemble the snapshot a strategy step returns.
+
+    ``axis_name`` may be a tuple (hierarchical mode pmeans over both mesh
+    axes).  ``measure_consensus=False`` (the degraded/local guard branch,
+    which must issue NO collective) reports :data:`UNMEASURED` instead.
+    ``warmup`` may be traced (the overlapped variants derive it from the
+    in-flight self weight)."""
+    if measure_consensus:
+        cd = consensus_distance(new_params, axis_name, fuse, bucket_bytes)
+    else:
+        cd = jnp.float32(UNMEASURED)
+    return TelemetrySnapshot(
+        step=jnp.asarray(step, jnp.int32),
+        consensus_dist=cd,
+        param_norm=tree_l2(new_params),
+        grad_norm=tree_l2(grads),
+        update_norm=tree_diff_l2(new_params, old_params),
+        mix_col_sum=jnp.asarray(col_sum, jnp.float32),
+        mix_row_sum=jnp.asarray(row_sum, jnp.float32),
+        staleness=jnp.asarray(staleness, jnp.float32),
+        warmup=jnp.asarray(warmup, jnp.float32),
+        degraded=jnp.asarray(degraded, jnp.float32),
+    )

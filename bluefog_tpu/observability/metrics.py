@@ -1,0 +1,219 @@
+"""Process-local host metrics registry: counters, gauges, histograms.
+
+The in-graph telemetry (``observability/ingraph.py``) measures what happens
+*inside* the jitted step; this registry measures everything around it — how
+many collectives the fusion layer planned, how often windows promote their
+back buffer, how deep the service queue runs, how often the step cache
+recompiles.  The reference has no equivalent (its only observability is the
+timeline); this is the Prometheus-shaped half of the observability layer.
+
+Design constraints:
+
+* **Disabled by default, free when disabled.**  Every instrumentation site
+  guards with ``if metrics.enabled():`` — a single list-indexed bool read,
+  no argument packing, no dict allocation — so the hot path (window ops,
+  service submits) pays nothing until someone opts in
+  (``BLUEFOG_METRICS=<prefix>`` at init, or :func:`enable`).  Asserted by
+  ``tests/test_observability.py``.
+* **Named labels.**  ``counter("bf_win_ops_total").inc(op="put")`` keeps one
+  float per label combination, Prometheus-style; the label key is the
+  sorted kv tuple so ``(a=1, b=2)`` and ``(b=2, a=1)`` share a cell.
+* **JSON-able snapshots.**  :meth:`Registry.snapshot` returns a flat
+  ``{"name{k=v}": value}`` dict (histograms nest ``count/sum/buckets``) that
+  drops straight into a ``BENCH_*.json`` or a JSONL line; the Prometheus
+  text rendering lives in ``observability/export.py``.
+"""
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+__all__ = [
+    "enabled", "enable", "disable",
+    "Counter", "Gauge", "Histogram", "Registry",
+    "registry", "counter", "gauge", "histogram",
+]
+
+# single-cell state read by every hot-path guard; a list (not a module
+# global rebound on toggle) so `from ... import enabled` call sites and the
+# toggles always see the same cell
+_state = [False]
+
+
+def enabled() -> bool:
+    """Hot-path gate: instrumentation sites call this FIRST and skip all
+    metric work (including label-kwarg packing) when it returns False."""
+    return _state[0]
+
+
+def enable() -> None:
+    _state[0] = True
+
+
+def disable() -> None:
+    _state[0] = False
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_repr(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+
+    def _items(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    """Monotonic counter with optional named labels."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(value)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    """Last-write-wins gauge with optional named labels."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(value)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+# default buckets span microseconds-to-minutes of seconds and 1B-to-1GB of
+# bytes reasonably; override per histogram when the range is known
+DEFAULT_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 100.0,
+                   1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): each cell keeps
+    per-bucket counts plus running sum/count."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Iterable[float]] = None):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        value = float(value)
+        with self._lock:
+            cell = self._values.get(key)
+            if cell is None:
+                cell = {"count": 0, "sum": 0.0,
+                        "buckets": [0] * len(self.buckets)}
+                self._values[key] = cell
+            cell["count"] += 1
+            cell["sum"] += value
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    cell["buckets"][i] += 1
+
+    def cell(self, **labels):
+        return self._values.get(_label_key(labels))
+
+
+class Registry:
+    """Name -> metric map.  Get-or-create accessors are the public surface;
+    re-registering a name with a different kind is a programming error and
+    raises rather than silently aliasing two meanings."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        m = self._metrics.get(name)          # lock-free fast path (GIL-safe)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name, help, **kw)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> Dict[str, object]:
+        """Flat JSON-able view: ``{"name" or "name{k=v}": value}``;
+        histogram cells nest ``{"count", "sum", "buckets": {"le": n}}``."""
+        out: Dict[str, object] = {}
+        for m in self.metrics():
+            for key, val in m._items():
+                cell_name = m.name + _label_repr(key)
+                if m.kind == "histogram":
+                    out[cell_name] = {
+                        "count": val["count"], "sum": val["sum"],
+                        "buckets": {repr(le): c for le, c in
+                                    zip(m.buckets, val["buckets"])}}
+                else:
+                    out[cell_name] = val
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+registry = Registry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Optional[Iterable[float]] = None) -> Histogram:
+    return registry.histogram(name, help, buckets)

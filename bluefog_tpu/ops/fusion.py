@@ -50,11 +50,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _metrics
+
 __all__ = [
     "DEFAULT_MAX_BUCKET_BYTES",
     "FusionPlan",
     "fusion_enabled",
     "resolve_max_bucket_bytes",
+    "plan_bytes",
     "plan_for",
     "flatten",
     "unflatten",
@@ -203,14 +206,45 @@ def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
                       buckets=tuple(buckets), leading_dims=leading_dims)
 
 
+def plan_bytes(plan: FusionPlan) -> Tuple[int, int]:
+    """(payload bytes, padding-waste bytes) of a plan's buckets, per
+    leading slice — the fusion efficiency numbers the metrics registry
+    tracks."""
+    payload = sum(b.nelems * jnp.dtype(b.dtype).itemsize
+                  for b in plan.buckets)
+    waste = sum((b.padded - b.nelems) * jnp.dtype(b.dtype).itemsize
+                for b in plan.buckets)
+    return int(payload), int(waste)
+
+
 def plan_for(tree, *, max_bucket_bytes: Optional[int] = None,
              pad_to: int = 1, leading_dims: int = 0) -> FusionPlan:
     """Build (or fetch the cached) :class:`FusionPlan` for ``tree``'s
     abstract signature.  Safe to call inside a traced function — the plan
     depends only on static shapes/dtypes/structure."""
     treedef, sig = _abstract_signature(tree, leading_dims)
-    return _build_plan(treedef, sig, resolve_max_bucket_bytes(max_bucket_bytes),
+    plan = _build_plan(treedef, sig,
+                       resolve_max_bucket_bytes(max_bucket_bytes),
                        int(pad_to), int(leading_dims))
+    if _metrics.enabled():
+        # trace-time only (compiled steps never re-enter Python here):
+        # gauges describe the LAST plan consulted, the counter every
+        # consult; cache stats separate fresh builds from lru hits
+        payload, waste = plan_bytes(plan)
+        _metrics.counter("bf_fusion_plan_consults_total",
+                         "fusion plan lookups (trace-time)").inc()
+        g = _metrics.gauge("bf_fusion_plan",
+                           "shape of the last fusion plan consulted")
+        g.set(plan.n_buckets, field="buckets")
+        g.set(len(plan.slots), field="leaves")
+        g.set(payload, field="payload_bytes")
+        g.set(waste, field="padding_waste_bytes")
+        info = _build_plan.cache_info()
+        c = _metrics.gauge("bf_fusion_plan_cache",
+                           "lru stats of the fusion-plan cache")
+        c.set(info.hits, field="hits")
+        c.set(info.misses, field="builds")
+    return plan
 
 
 def flatten(plan: FusionPlan, tree) -> List[jax.Array]:

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import service as _service
 from ..context import ctx
+from ..observability import metrics as _metrics
 from ..parallel.schedule import CompiledTopology
 from . import api as _api
 from . import fusion as _fusion
@@ -177,6 +178,11 @@ class _Window:
         if self.pending is not None:
             self.commit(self.pending)
             self.pending = None
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_win_promotes_total",
+                    "double-buffer back-to-front promotions "
+                    "(win_wait/win_flush)").inc()
 
 
 _windows: Dict[str, _Window] = {}
@@ -226,6 +232,12 @@ def _dispatch_win_op(run, result_of=None, op_name: str = "win_op",
     # reads.  Hard constraint: resume() must come from a different thread
     # than a window-op caller (docs/faq.md).
     ctx().wait_if_suspended()
+    if _metrics.enabled():
+        # one funnel counts every one-sided transfer op (put/accumulate/
+        # get), labeled by op and dispatch mode — the window-traffic series
+        _metrics.counter("bf_win_ops_total",
+                         "one-sided window transfer ops").inc(
+            op=op_name, mode="async" if _win_async_enabled() else "inline")
     if _win_async_enabled():
         handle = _ASYNC_BASE + _service.submit(run, lane=_service.WIN_LANE,
                                                op_name=op_name)
@@ -752,6 +764,10 @@ def win_update(name: str,
     """
     w = _window(name)
     cx = ctx()
+    if _metrics.enabled():
+        _metrics.counter("bf_win_updates_total",
+                         "win_update buffer folds").inc(
+            peek="1" if clone else "0")
     U, sw = _update_matrix(w.topo, self_weight, neighbor_weights)
     U = jnp.asarray(U, jnp.float32)
     sw = jnp.asarray(sw, jnp.float32)

@@ -14,11 +14,13 @@ from jax.sharding import PartitionSpec as P
 
 
 def step_cache_key(cx, params, nar_backend: str, fuse: bool,
-                   bucket_bytes: int, overlap: bool = False):
+                   bucket_bytes: int, overlap: bool = False,
+                   telemetry: bool = False):
     """Everything that changes the COMPILED step program: mesh/topology
     identity, the exchange backend, the fusion knobs (they reshape the
     collective schedule), the overlap mode (it reshapes the carried state
-    and the whole pipeline), and the parameter tree structure.  One home
+    and the whole pipeline), the telemetry gate (it adds the snapshot
+    outputs and their pmeans), and the parameter tree structure.  One home
     for the tuple so the wrappers and any future cache agree on what
     invalidates a step — a knob resolved at build time but missing here
     would silently serve a stale program."""
@@ -29,6 +31,7 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
             bool(fuse),
             int(bucket_bytes),
             bool(overlap),
+            bool(telemetry),
             jax.tree.structure(params))
 
 

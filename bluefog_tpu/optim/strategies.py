@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ..observability import ingraph as IG
 from ..ops import api as _api
 from ..ops import collectives as C
 from ..ops import fusion as F
@@ -116,10 +117,20 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     return jax.tree.map(fn, params)
 
 
+def _telemetry_axis(comm_type: CommunicationType, axis_name, machine_axes):
+    """Axis (or axes) the telemetry pmean runs over: the flat rank axis,
+    or both mesh axes under the hierarchical 2-D plumbing."""
+    if (comm_type == CommunicationType.hierarchical_neighbor_allreduce
+            and machine_axes is not None):
+        return tuple(machine_axes)
+    return axis_name
+
+
 def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
                             accumulate_steps: int = 1,
                             fuse: Optional[bool] = None,
-                            fusion_bucket_bytes: Optional[int] = None):
+                            fusion_bucket_bytes: Optional[int] = None,
+                            telemetry: bool = False):
     """Horovod-style synchronous data parallelism
     (reference _DistributedOptimizer, optimizers.py:166-294).
 
@@ -132,6 +143,13 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
     The gradient average rides the comm-fusion layer when ``fuse`` resolves
     on (this is exactly the reference's Horovod-style fusion buffer): one
     allreduce per dtype bucket instead of one per gradient leaf.
+
+    ``telemetry`` (build-time bool, observability/ingraph.py): the step
+    additionally returns a :class:`~..observability.ingraph.
+    TelemetrySnapshot` aux — consensus distance over the updated weights
+    (~0 for lockstep gradient averaging; drift means divergence), norms,
+    and identity mix mass.  Off (the default) leaves the traced program
+    untouched — bit-identical StableHLO, asserted by test.
     """
     do_fuse = F.fusion_enabled(fuse)
 
@@ -142,11 +160,21 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
                                     max_bucket_bytes=fusion_bucket_bytes)
         return jax.tree.map(f, tree)
 
+    def _snap(step, p_new, p_old, grads):
+        return IG.strategy_snapshot(
+            step=step, new_params=p_new, old_params=p_old, grads=grads,
+            axis_name=axis_name, col_sum=1.0, row_sum=1.0, fuse=do_fuse,
+            bucket_bytes=fusion_bucket_bytes)
+
     if accumulate_steps <= 1:
         def step_fn(params, grads, opt_state, step=0):
             g = _avg(grads)
             updates, opt_state = base.update(g, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state
+            new_params = optax.apply_updates(params, updates)
+            if telemetry:
+                return new_params, opt_state, _snap(step, new_params,
+                                                    params, grads)
+            return new_params, opt_state
         return step_fn
 
     k = int(accumulate_steps)
@@ -163,6 +191,26 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
 
         def local_branch(p, acc, bs):
             return p, acc, bs
+
+        if telemetry:
+            # both cond branches must carry the snapshot; the local branch
+            # issues no collective and reports consensus as UNMEASURED
+            def comm_branch_t(p, acc, bs):
+                p_new, acc_new, bs_new = comm_branch(p, acc, bs)
+                return p_new, acc_new, bs_new, _snap(step, p_new, p, grads)
+
+            def local_branch_t(p, acc, bs):
+                snap = IG.strategy_snapshot(
+                    step=step, new_params=p, old_params=p, grads=grads,
+                    axis_name=axis_name, col_sum=1.0, row_sum=1.0,
+                    fuse=do_fuse, bucket_bytes=fusion_bucket_bytes,
+                    measure_consensus=False)
+                return p, acc, bs, snap
+
+            p_new, accum_new, base_new, snap = jax.lax.cond(
+                do_comm, comm_branch_t, local_branch_t, params, accum,
+                opt_state["base"])
+            return p_new, {"base": base_new, "accum": accum_new}, snap
 
         p_new, accum_new, base_new = jax.lax.cond(
             do_comm, comm_branch, local_branch, params, accum,
@@ -182,11 +230,17 @@ def consensus_step(base: optax.GradientTransformation,
                    comm_type: CommunicationType, axis_name,
                    topo=None, sched=None, machine_axes=None,
                    machine_topo=None, nar_backend=None, fuse=None,
-                   fusion_bucket_bytes=None):
+                   fusion_bucket_bytes=None, telemetry: bool = False):
     """Consensus/CTA/AWC family (reference _DistributedReduceOptimizer,
     optimizers.py:297-482): average the *weights*, apply the local update
     computed from gradients at the pre-average point.  Only the exchange
-    is fused (``fuse``); the optimizer state stays per-leaf."""
+    is fused (``fuse``); the optimizer state stays per-leaf.
+
+    ``telemetry`` (build-time bool): return an extra
+    ``TelemetrySnapshot`` — consensus distance over the post-update
+    weights (one pmean per fusion bucket), the step's mixing-matrix
+    column/row mass at this rank, and the norm trio.  ``False`` (default)
+    is the exact pre-telemetry trace (bit-identical StableHLO)."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
 
@@ -195,7 +249,19 @@ def consensus_step(base: optax.GradientTransformation,
                                 step, machine_axes, machine_topo,
                                 nar_backend, fuse, fusion_bucket_bytes)
         updates, opt_state = base.update(grads, opt_state, averaged)
-        return optax.apply_updates(averaged, updates), opt_state
+        new_params = optax.apply_updates(averaged, updates)
+        if telemetry:
+            col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
+                                   machine_axes, machine_topo)
+            snap = IG.strategy_snapshot(
+                step=step, new_params=new_params, old_params=params,
+                grads=grads,
+                axis_name=_telemetry_axis(comm_type, axis_name,
+                                          machine_axes),
+                col_sum=col, row_sum=row, fuse=fuse,
+                bucket_bytes=fusion_bucket_bytes)
+            return new_params, opt_state, snap
+        return new_params, opt_state
 
     return step_fn
 
@@ -203,13 +269,15 @@ def consensus_step(base: optax.GradientTransformation,
 def atc_step(base: optax.GradientTransformation,
              comm_type: CommunicationType, axis_name,
              topo=None, sched=None, machine_axes=None, machine_topo=None,
-             nar_backend=None, fuse=None, fusion_bucket_bytes=None):
+             nar_backend=None, fuse=None, fusion_bucket_bytes=None,
+             telemetry: bool = False):
     """Adapt-then-combine (reference _DistributedAdaptThenCombineOptimizer,
     optimizers.py:485-841): local update first, then average the updated
     weights.  The reference re-implements each torch optimizer's math inside
     the gradient hook; with optax the base transformation is already a pure
     function, so ATC is just the other composition order.  Only the
-    exchange is fused (``fuse``); the optimizer state stays per-leaf."""
+    exchange is fused (``fuse``); the optimizer state stays per-leaf.
+    ``telemetry`` as in :func:`consensus_step`."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
 
@@ -219,6 +287,17 @@ def atc_step(base: optax.GradientTransformation,
         combined = _communicate(adapted, comm_type, axis_name, topo, sched,
                                 step, machine_axes, machine_topo,
                                 nar_backend, fuse, fusion_bucket_bytes)
+        if telemetry:
+            col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
+                                   machine_axes, machine_topo)
+            snap = IG.strategy_snapshot(
+                step=step, new_params=combined, old_params=params,
+                grads=grads,
+                axis_name=_telemetry_axis(comm_type, axis_name,
+                                          machine_axes),
+                col_sum=col, row_sum=row, fuse=fuse,
+                bucket_bytes=fusion_bucket_bytes)
+            return combined, opt_state, snap
         return combined, opt_state
 
     return step_fn
@@ -228,7 +307,7 @@ def exact_diffusion_step(base: optax.GradientTransformation,
                          comm_type: CommunicationType, axis_name,
                          topo=None, sched=None, machine_axes=None,
                          machine_topo=None, nar_backend=None, fuse=None,
-                         fusion_bucket_bytes=None):
+                         fusion_bucket_bytes=None, telemetry: bool = False):
     """Exact-Diffusion (a.k.a. D2): the bias-corrected diffusion recursion
     from the reference authors' own line of work (Yuan/Ying et al.; no
     reference-code counterpart — a beyond-parity strategy):
@@ -257,7 +336,22 @@ def exact_diffusion_step(base: optax.GradientTransformation,
         combined = _communicate(phi, comm_type, axis_name, topo, sched,
                                 step, machine_axes, machine_topo,
                                 nar_backend, fuse, fusion_bucket_bytes)
-        return combined, {"base": base_new, "psi_prev": psi}
+        state_new = {"base": base_new, "psi_prev": psi}
+        if telemetry:
+            # the mixed topology is the DAMPED (I+W)/2 matrix the caller
+            # validated/compiled (exact_diffusion_topology) — its mass
+            # telemetry is what the recursion actually uses
+            col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
+                                   machine_axes, machine_topo)
+            snap = IG.strategy_snapshot(
+                step=step, new_params=combined, old_params=params,
+                grads=grads,
+                axis_name=_telemetry_axis(comm_type, axis_name,
+                                          machine_axes),
+                col_sum=col, row_sum=row, fuse=fuse,
+                bucket_bytes=fusion_bucket_bytes)
+            return combined, state_new, snap
+        return combined, state_new
 
     return step_fn
 
@@ -448,11 +542,28 @@ def delayed_init(base: optax.GradientTransformation, params,
     return state
 
 
+def _delayed_snapshot(comm_type, axis_name, topo, sched, step, machine_axes,
+                      machine_topo, fuse, bucket, *, new_params, old_params,
+                      grads, inflight_prev):
+    """Snapshot for the overlapped family: staleness 1, warmup derived
+    from the folded in-flight state (self weight 1 <=> zero buffer — the
+    step-0 / post-reset warmup fold), mix mass of the CURRENT launch."""
+    col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
+                           machine_axes, machine_topo)
+    warmup = (inflight_prev["self_w"] >= 1.0).astype(jnp.float32)
+    return IG.strategy_snapshot(
+        step=step, new_params=new_params, old_params=old_params,
+        grads=grads,
+        axis_name=_telemetry_axis(comm_type, axis_name, machine_axes),
+        col_sum=col, row_sum=row, fuse=fuse, bucket_bytes=bucket,
+        staleness=1.0, warmup=warmup)
+
+
 def delayed_consensus_step(base: optax.GradientTransformation,
                            comm_type: CommunicationType, axis_name,
                            topo=None, sched=None, machine_axes=None,
                            machine_topo=None, nar_backend=None, fuse=None,
-                           fusion_bucket_bytes=None):
+                           fusion_bucket_bytes=None, telemetry: bool = False):
     """Overlapped consensus/CTA/AWC: fold the previous step's mix, adapt at
     the folded point (gradients at the pre-fold parameters, matching
     :func:`consensus_step`'s composition), and launch this step's exchange
@@ -476,7 +587,15 @@ def delayed_consensus_step(base: optax.GradientTransformation,
         infl_new = _delayed_launch(params, comm_type, axis_name, topo,
                                    sched, step, machine_axes, machine_topo,
                                    nar_backend, fuse, bucket)
-        return new_params, {"base": base_new, "inflight": infl_new}
+        state_new = {"base": base_new, "inflight": infl_new}
+        if telemetry:
+            snap = _delayed_snapshot(
+                comm_type, axis_name, topo, sched, step, machine_axes,
+                machine_topo, fuse, bucket, new_params=new_params,
+                old_params=params, grads=grads,
+                inflight_prev=opt_state["inflight"])
+            return new_params, state_new, snap
+        return new_params, state_new
 
     return step_fn
 
@@ -485,7 +604,7 @@ def delayed_atc_step(base: optax.GradientTransformation,
                      comm_type: CommunicationType, axis_name,
                      topo=None, sched=None, machine_axes=None,
                      machine_topo=None, nar_backend=None, fuse=None,
-                     fusion_bucket_bytes=None):
+                     fusion_bucket_bytes=None, telemetry: bool = False):
     """Overlapped adapt-then-combine: local adapt, fold the PREVIOUS
     adapted iterate's exchange, launch this one's.  The launch value is
     the adapted iterate, so the collective sits at the program tail; the
@@ -507,7 +626,15 @@ def delayed_atc_step(base: optax.GradientTransformation,
         infl_new = _delayed_launch(adapted, comm_type, axis_name, topo,
                                    sched, step, machine_axes, machine_topo,
                                    nar_backend, fuse, bucket)
-        return combined, {"base": base_new, "inflight": infl_new}
+        state_new = {"base": base_new, "inflight": infl_new}
+        if telemetry:
+            snap = _delayed_snapshot(
+                comm_type, axis_name, topo, sched, step, machine_axes,
+                machine_topo, fuse, bucket, new_params=combined,
+                old_params=params, grads=grads,
+                inflight_prev=opt_state["inflight"])
+            return combined, state_new, snap
+        return combined, state_new
 
     return step_fn
 
@@ -516,7 +643,8 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
                                  comm_type: CommunicationType, axis_name,
                                  topo=None, machine_axes=None,
                                  machine_topo=None, nar_backend=None,
-                                 fuse=None, fusion_bucket_bytes=None):
+                                 fuse=None, fusion_bucket_bytes=None,
+                                 telemetry: bool = False):
     """Overlapped exact-diffusion (the gradient-tracking-family member):
     the psi/phi bias correction runs exactly as in
     :func:`exact_diffusion_step`, but the combine of phi is the delayed
@@ -540,13 +668,22 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
         infl_new = _delayed_launch(phi, comm_type, axis_name, topo,
                                    None, step, machine_axes, machine_topo,
                                    nar_backend, fuse, bucket)
-        return combined, {"base": base_new, "psi_prev": psi,
-                          "inflight": infl_new}
+        state_new = {"base": base_new, "psi_prev": psi,
+                     "inflight": infl_new}
+        if telemetry:
+            snap = _delayed_snapshot(
+                comm_type, axis_name, topo, None, step, machine_axes,
+                machine_topo, fuse, bucket, new_params=combined,
+                old_params=params, grads=grads,
+                inflight_prev=opt_state["inflight"])
+            return combined, state_new, snap
+        return combined, state_new
 
     return step_fn
 
 
-def delayed_local_step(base: optax.GradientTransformation):
+def delayed_local_step(base: optax.GradientTransformation,
+                       telemetry: bool = False):
     """Local-only branch for overlapped steps — the resilience
     integration: besides the plain local adapt, it RESETS the pipeline
     (zero buffers, self weight 1).  A degraded step must not leave the
@@ -571,6 +708,17 @@ def delayed_local_step(base: optax.GradientTransformation):
             # restart the correction at the new local point (plain-ATC
             # restart): the old psi_prev belongs to the abandoned pipeline
             out["psi_prev"] = new_params
+        if telemetry:
+            # degraded pipeline-reset branch: NO collective may be issued
+            # (the topology is distrusted), so consensus is UNMEASURED;
+            # identity mix, warmup flagged (the next fold is the warmup
+            # fold against the freshly zeroed buffer)
+            snap = IG.strategy_snapshot(
+                step=step, new_params=new_params, old_params=params,
+                grads=grads, axis_name=None, col_sum=1.0, row_sum=1.0,
+                fuse=False, bucket_bytes=None, staleness=1.0, warmup=1.0,
+                degraded=1.0, measure_consensus=False)
+            return new_params, out, snap
         return new_params, out
 
     return step_fn
@@ -596,12 +744,35 @@ def with_local_steps(step_fn: Callable, local_step_fn: Callable,
     return stepped
 
 
-def local_sgd_like_step(base: optax.GradientTransformation):
-    """The no-communication branch: plain local update."""
+def local_sgd_like_step(base: optax.GradientTransformation,
+                        telemetry: bool = False, axis_name=None,
+                        fuse=None, fusion_bucket_bytes=None,
+                        degraded: bool = False):
+    """The no-communication branch: plain local update.
+
+    ``telemetry``: return the snapshot too (both ``lax.cond`` branches of
+    :func:`with_local_steps` / :func:`with_degraded_guard` must carry the
+    same structure).  ``degraded=True`` marks the degraded-guard flavor:
+    consensus stays UNMEASURED (a degraded step must issue NO collective)
+    and the ``degraded`` field is set; the default (routine local steps of
+    a ``num_steps_per_communication`` schedule) measures consensus over
+    ``axis_name`` — drift between exchanges is exactly what local-step
+    schedules need to watch."""
+    do_fuse = F.fusion_enabled(fuse)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, opt_state = base.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+        new_params = optax.apply_updates(params, updates)
+        if telemetry:
+            measure = (axis_name is not None) and not degraded
+            snap = IG.strategy_snapshot(
+                step=step, new_params=new_params, old_params=params,
+                grads=grads, axis_name=axis_name, col_sum=1.0, row_sum=1.0,
+                fuse=do_fuse, bucket_bytes=fusion_bucket_bytes,
+                degraded=1.0 if degraded else 0.0,
+                measure_consensus=measure)
+            return new_params, opt_state, snap
+        return new_params, opt_state
 
     return step_fn
 
@@ -622,6 +793,12 @@ def with_degraded_guard(step_fn: Callable, local_step_fn: Callable):
     fault plan, a majority vote, the service watchdog), never from
     rank-local values.  Per-EDGE degradation belongs in the mixing matrix
     (``repair.repair_matrix_traced``), not here.
+
+    Telemetry: build BOTH branches with the same ``telemetry`` flag (the
+    local branch via ``local_sgd_like_step(..., degraded=True)`` or
+    ``delayed_local_step(..., telemetry=True)``) so the cond outputs
+    match; the local branch's snapshot flags ``degraded=1`` — the
+    degraded-guard branch-hit series.
     """
 
     def guarded(params, grads, opt_state, step=0, degraded=False):

@@ -30,10 +30,12 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ..context import ctx
+from ..observability import ingraph as IG
 from ..ops import api as _api
 from ..ops import fusion as _fusion
 from ..ops import windows as W
 from ..parallel.schedule import DynamicSchedule
+from ..utils.compile_cache import note_step_cache
 from . import strategies as S
 from ._plumbing import mesh_plumbing, step_cache_key
 
@@ -66,12 +68,18 @@ class _JittedStrategyOptimizer:
                  sched: Optional[DynamicSchedule] = None,
                  fuse: Optional[bool] = None,
                  fusion_bucket_bytes: Optional[int] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 telemetry: Optional[bool] = None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
         self.gradient_allreduce = gradient_allreduce
         self.exact_diffusion = exact_diffusion
+        # in-graph telemetry gate (observability/ingraph.py): None =
+        # resolve from BLUEFOG_TELEMETRY at step-build time, like the
+        # fusion knobs; the resolved value joins the step-cache key.  With
+        # telemetry on, step() returns (params, state, TelemetrySnapshot).
+        self.telemetry = telemetry
         # comm-fusion knobs (ops/fusion.py): only the EXCHANGE fuses into
         # flat dtype buckets; optimizer state (momentum, psi_prev, accum)
         # stays per-leaf.  None = resolve from BLUEFOG_COMM_FUSION /
@@ -136,7 +144,7 @@ class _JittedStrategyOptimizer:
                 lambda p: S.exact_diffusion_init(self.base, p))(params)
         return jax.vmap(self.base.init)(params)
 
-    def _build(self, key):
+    def _build(self, key, telemetry: bool = False):
         cx = ctx()
         hierarchical = (
             self.comm_type == CommunicationType.hierarchical_neighbor_allreduce)
@@ -161,7 +169,7 @@ class _JittedStrategyOptimizer:
                     self.base, self.comm_type, cx.rank_axis, topo=topo,
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
-                    fusion_bucket_bytes=bucket_bytes)
+                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
             else:
                 builder = (S.delayed_atc_step if self.atc
                            else S.delayed_consensus_step)
@@ -170,11 +178,12 @@ class _JittedStrategyOptimizer:
                     sched=self.sched,
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
-                    fusion_bucket_bytes=bucket_bytes)
+                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
         elif self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k,
-                fuse=fuse, fusion_bucket_bytes=bucket_bytes)
+                fuse=fuse, fusion_bucket_bytes=bucket_bytes,
+                telemetry=telemetry)
         elif self.exact_diffusion:
             if self.comm_type not in (
                     CommunicationType.neighbor_allreduce,
@@ -189,7 +198,7 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
-                fusion_bucket_bytes=bucket_bytes)
+                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
@@ -197,37 +206,54 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
-                fusion_bucket_bytes=bucket_bytes)
+                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
         if not (self.gradient_allreduce or self.exact_diffusion
                 or self.overlap):
             # grad-allreduce accumulates internally; exact-diffusion and
-            # overlap are one-exchange-per-step by construction
+            # overlap are one-exchange-per-step by construction.  The local
+            # branch must mirror the comm branch's telemetry structure.
+            tel_axis = S._telemetry_axis(
+                self.comm_type, cx.rank_axis,
+                (cx.machine_axis, cx.local_axis))
             step_core = S.with_local_steps(
-                step_core, S.local_sgd_like_step(self.base), self.k)
+                step_core,
+                S.local_sgd_like_step(self.base, telemetry=telemetry,
+                                      axis_name=tel_axis, fuse=fuse,
+                                      fusion_bucket_bytes=bucket_bytes),
+                self.k)
 
         pl = mesh_plumbing(cx, hierarchical)
 
         def stepper(params, grads, opt_state, step_idx):
             def shard_fn(p, g, st, si):
-                p_new, st_new = step_core(
+                out = step_core(
                     pl.unwrap(p), pl.unwrap(g), pl.unwrap(st), si)
+                if telemetry:
+                    p_new, st_new, snap = out
+                    return (pl.rewrap(p_new), pl.rewrap(st_new),
+                            pl.rewrap(snap))
+                p_new, st_new = out
                 return pl.rewrap(p_new), pl.rewrap(st_new)
             p2, g2, st2 = (pl.reshape_in(params), pl.reshape_in(grads),
                            pl.reshape_in(opt_state))
+            n_out = 3 if telemetry else 2
             # check_vma off under the pallas backend (same exemption as
             # ops/api.py / training.py: the fused kernel's outputs carry
             # no varying-manual-axes tags)
-            p_out, st_out = jax.shard_map(
+            out = jax.shard_map(
                 shard_fn, mesh=pl.mesh,
                 in_specs=(pl.spec, pl.spec, pl.spec, P()),
-                out_specs=(pl.spec, pl.spec),
+                out_specs=(pl.spec,) * n_out,
                 check_vma=not _api._nar_backend().startswith("pallas"),
             )(p2, g2, st2, step_idx)
-            return pl.reshape_out(p_out), pl.reshape_out(st_out)
+            return tuple(pl.reshape_out(o) for o in out)
 
         return jax.jit(stepper)
 
     def step(self, params, grads, opt_state, step: int = 0):
+        """One optimizer step.  Returns ``(params, opt_state)`` — plus a
+        global-view :class:`~..observability.ingraph.TelemetrySnapshot`
+        (``[N]`` per field) when telemetry resolves on."""
         cx = ctx()
         # under overlap the fusion knobs were pinned at construction (they
         # shape the carried in-flight buffers created by init())
@@ -237,38 +263,48 @@ class _JittedStrategyOptimizer:
             fuse = _fusion.fusion_enabled(self.fuse)
             bucket = _fusion.resolve_max_bucket_bytes(
                 self.fusion_bucket_bytes)
+        telemetry = IG.telemetry_enabled(self.telemetry)
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
-                             self.overlap)
-        if key not in self._step_cache:
-            self._step_cache[key] = self._build(key)
+                             self.overlap, telemetry)
+        hit = key in self._step_cache
+        note_step_cache(hit)
+        if not hit:
+            self._step_cache[key] = self._build(key, telemetry)
         return self._step_cache[key](params, grads, opt_state,
                                      jnp.asarray(step, jnp.int32))
 
 
 def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
-                                          fuse=None, fusion_bucket_bytes=None):
+                                          fuse=None, fusion_bucket_bytes=None,
+                                          telemetry=None):
     """Synchronous Horovod-style gradient averaging
-    (optimizers.py:1376; internal _DistributedOptimizer:166-294)."""
+    (optimizers.py:1376; internal _DistributedOptimizer:166-294).
+
+    ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): ``step()``
+    additionally returns a per-rank ``TelemetrySnapshot``
+    (docs/observability.md); off is bit-identical to the plain step."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.empty, gradient_allreduce=True,
         num_steps_per_communication=num_steps_per_communication,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
+        telemetry=telemetry)
 
 
 def DistributedAllreduceOptimizer(base, num_steps_per_communication=1,
                                   fuse=None, fusion_bucket_bytes=None,
-                                  overlap=None):
+                                  overlap=None, telemetry=None):
     """CTA with global weight averaging (optimizers.py:1301)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.allreduce,
         num_steps_per_communication=num_steps_per_communication,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
+        telemetry=telemetry)
 
 
 def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
                                           sched: Optional[DynamicSchedule] = None,
                                           fuse=None, fusion_bucket_bytes=None,
-                                          overlap=None):
+                                          overlap=None, telemetry=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
     decentralized optimizer (optimizers.py:1326).
 
@@ -276,28 +312,34 @@ def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
     delayed-mix pipeline — the step folds the PREVIOUS step's exchange and
     launches its own off the critical path (docs/performance.md
     "Overlap").  Changes the recurrence (fresh self term, one-step-stale
-    neighbor terms); keep it off for exact-averaging tests."""
+    neighbor terms); keep it off for exact-averaging tests.
+
+    ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): ``step()`` returns
+    ``(params, state, TelemetrySnapshot)`` — consensus distance, mixing
+    mass, norms, pipeline flags per rank (docs/observability.md)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
+        telemetry=telemetry)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
         base, num_steps_per_communication=1, fuse=None,
-        fusion_bucket_bytes=None):
+        fusion_bucket_bytes=None, telemetry=None):
     """CTA with machine-level neighbor averaging (optimizers.py:1352)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.hierarchical_neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
+        telemetry=telemetry)
 
 
 def DistributedAdaptThenCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None, overlap=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
     """ATC: local update inside the step, then communicate the adapted
     weights (optimizers.py:1426; internal :485-841).  ``overlap``: the
     combine of the adapted iterate lands one step later (staleness-1
@@ -305,14 +347,15 @@ def DistributedAdaptThenCombineOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, atc=True,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
+        telemetry=telemetry)
 
 
 def DistributedAdaptWithCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None, overlap=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
     runs the collective and the update math in parallel.  ``overlap``
@@ -322,12 +365,13 @@ def DistributedAdaptWithCombineOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
+        telemetry=telemetry)
 
 
 def DistributedExactDiffusionOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
-        fuse=None, fusion_bucket_bytes=None, overlap=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -347,7 +391,8 @@ def DistributedExactDiffusionOptimizer(
     member of the pipeline, strategies.delayed_exact_diffusion_step)."""
     return _JittedStrategyOptimizer(
         base, communication_type, exact_diffusion=True,
-        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap)
+        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
+        telemetry=telemetry)
 
 
 # ---------------------------------------------------------------------------
@@ -376,7 +421,12 @@ class _WindowOptimizerBase:
         self._name = window_prefix + ".params"
         self.k = num_steps_per_communication
         self._created = False
-        self._local = _JittedStrategyOptimizer(base, CommunicationType.empty)
+        # telemetry pinned OFF (not env-resolved): the window family's
+        # step() composes this local adapt with host-side window ops and
+        # returns 2-tuples; in-graph telemetry does not apply here (watch
+        # window traffic via the host metrics registry instead)
+        self._local = _JittedStrategyOptimizer(base, CommunicationType.empty,
+                                               telemetry=False)
         # mutable per-iteration weighting knobs (matrices), reference
         # optimizers.py:852-858
         self.dst_weights = None

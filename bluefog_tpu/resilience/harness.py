@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import timeline as _tl
 from ..context import ctx
+from ..observability import metrics as _metrics
 from ..ops import collectives as C
 from ..ops import fusion as _fusion
 from ..parallel.schedule import CompiledTopology
@@ -354,6 +355,14 @@ class ChaosHarness:
 
         events = [f"plan: {ev.kind} rank={ev.rank} step={ev.step}"
                   for ev in getattr(self.plan, "events", [])]
+        if _metrics.enabled():
+            # fault ONSETS come from the compiled plan (the ground truth
+            # the injected tables execute); suspects/confirms/repairs are
+            # counted as they are observed below
+            for ev in getattr(self.plan, "events", []):
+                _metrics.counter(
+                    "bf_resilience_faults_total",
+                    "planned fault onsets by kind").inc(kind=ev.kind)
         _tl.record_resilience_event("chaos_run_start",
                                     f"{steps} steps, {n} ranks")
         carried = self._initial_carried(params)
@@ -377,6 +386,11 @@ class ChaosHarness:
                     msg = f"rank {r} confirmed dead at step {t}; " \
                           f"mixing matrix repaired"
                     events.append(msg)
+                    if _metrics.enabled():
+                        _metrics.counter(
+                            "bf_resilience_confirms_total",
+                            "majority-confirmed deaths (each implies a "
+                            "matrix repair)").inc()
                     _tl.record_resilience_event("repair", msg)
         _tl.record_resilience_event("chaos_run_end",
                                     f"final consensus error {cons[-1]:.3g}")

@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import native
+from .observability import metrics as _metrics
 from .utils import blog
 
 __all__ = ["start", "stop", "running", "submit", "poll", "wait", "release",
@@ -86,6 +87,12 @@ def mark_rank_degraded(rank: int, reason: str) -> None:
         _degraded[rank] = reason
         callbacks = list(_degraded_callbacks)
     if first:
+        if _metrics.enabled():
+            _metrics.counter("bf_service_degraded_total",
+                             "ranks newly marked degraded").inc()
+            _metrics.gauge("bf_service_degraded_ranks",
+                           "ranks currently marked degraded").set(
+                len(_degraded))
         blog.log(blog.WARN, f"rank {rank} marked degraded: {reason}")
         from . import timeline as _tl
         _tl.record_resilience_event("degraded", f"rank {rank}: {reason}")
@@ -190,6 +197,10 @@ def submit(fn: Callable[[], object], lane: int = -1, *,
     or stalling task then surfaces as a :class:`ServiceTaskError` carrying
     both, and the rank is marked degraded (:func:`degraded_ranks`).
     """
+    if _metrics.enabled():
+        _metrics.counter("bf_service_tasks_total",
+                         "tasks submitted to the service").inc(
+            op=op_name or "task")
     lib = _lib_or_none()
     if lib is None:
         # no native runtime: run inline; the handle is born completed
@@ -217,6 +228,11 @@ def submit(fn: Callable[[], object], lane: int = -1, *,
         raise RuntimeError("service not running")
     with _lock:
         _meta[handle] = (op_name, rank)
+    if _metrics.enabled():
+        _metrics.gauge("bf_service_pending",
+                       "tasks enqueued-but-unfinished on the service "
+                       "(sampled at submit)").set(
+            int(lib.bft_service_pending()))
     return handle
 
 
@@ -268,6 +284,12 @@ def wait(handle: int, timeout_ms: int = -1):
     state = int(lib.bft_handle_wait(handle, timeout_ms))
     if state == 0:
         op_name, rank = _meta.get(handle, (None, None))
+        if _metrics.enabled():
+            # stall-watchdog fire: a wait deadline elapsed with the task
+            # still pending — the queue-health alarm series
+            _metrics.counter("bf_service_stalls_total",
+                             "wait timeouts on pending handles").inc(
+                op=op_name or "task")
         if rank is not None:
             mark_rank_degraded(
                 rank, f"{op_name or 'task'} still pending after "

@@ -33,13 +33,40 @@ __all__ = [
     "timeline_start", "timeline_end", "timeline_enabled",
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
     "record_op_phase", "op_phase", "record_resilience_event",
+    "record_counter",
 ]
 
 _ENV = "BLUEFOG_TIMELINE"
 
+# largest double JSON can carry; counter samples are clamped into
+# [-_JSON_MAX, _JSON_MAX] — json has no Infinity, and a diverged run
+# (the one time you NEED the lane) must not corrupt the whole trace
+_JSON_MAX = 1.7976931348623157e308
+
+
+def _finite_counter_value(value):
+    """JSON-legal float for a counter sample, or None to drop it.
+    ``inf`` clamps to the double max (the lane spikes visibly instead of
+    invalidating the file); ``NaN`` has no honest rendering and drops."""
+    v = float(value)
+    if v != v:                   # NaN
+        return None
+    if v == float("inf"):
+        return _JSON_MAX
+    if v == float("-inf"):
+        return -_JSON_MAX
+    return v
+
 
 class _PyWriter:
-    """Pure-Python fallback writer: same file format as the native one."""
+    """Pure-Python fallback writer: same file format as the native one.
+
+    Output is STRICT JSON (parses with ``json.load``): events are
+    comma-separated with no trailing comma and the array is closed by
+    ``close()``, which is idempotent — ``atexit``-registered
+    ``timeline_end`` may run after an explicit ``timeline_end()`` already
+    closed the file, and a second close must be a no-op, not a write on a
+    closed handle."""
 
     def __init__(self, path: str, rank: int):
         self._f = open(path, "w")
@@ -47,12 +74,19 @@ class _PyWriter:
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._lanes = {}
+        self._first = True
+        self._closed = False
         self._f.write("[\n")
         self._emit({"name": "process_name", "ph": "M", "pid": rank,
                     "args": {"name": f"rank {rank}"}})
 
     def _emit(self, ev):
-        self._f.write(json.dumps(ev) + ",\n")
+        # comma BEFORE every event but the first: the array never carries
+        # a dangling comma, so the file is valid JSON the moment the
+        # closing bracket lands
+        prefix = "" if self._first else ",\n"
+        self._first = False
+        self._f.write(prefix + json.dumps(ev))
 
     def _lane(self, tensor: str) -> int:
         if tensor not in self._lanes:
@@ -69,6 +103,8 @@ class _PyWriter:
                ts_us: int = -1):
         ts = self.now_us() if ts_us < 0 else ts_us
         with self._lock:
+            if self._closed:
+                return
             tid = self._lane(tensor)
             ev = {"name": activity, "cat": "bluefog", "ph": phase, "ts": ts,
                   "pid": self._rank, "tid": tid}
@@ -78,13 +114,31 @@ class _PyWriter:
                 ev["s"] = "t"
             self._emit(ev)
 
+    def counter(self, name: str, value: float, series: str = "value",
+                ts_us: int = -1):
+        """Chrome-tracing counter event (``"ph":"C"``): renders as a graph
+        lane named ``name`` with one series per ``args`` key.  Non-finite
+        samples are clamped/dropped (the strict-JSON guarantee holds even
+        when training diverges)."""
+        value = _finite_counter_value(value)
+        if value is None:
+            return
+        ts = self.now_us() if ts_us < 0 else ts_us
+        with self._lock:
+            if self._closed:
+                return
+            self._emit({"name": name, "cat": "bluefog", "ph": "C", "ts": ts,
+                        "pid": self._rank, "args": {series: value}})
+
     def close(self):
         with self._lock:
-            self._emit({"name": "timeline_closed", "ph": "i", "pid": self._rank,
-                        "tid": 0, "ts": 0, "s": "g"})
-            # strip nothing; chrome tolerates the trailing comma but we close
-            # the array properly by writing a bare null-free final object above
-            self._f.write("{}\n]\n")
+            if self._closed:
+                return
+            self._closed = True
+            self._emit({"name": "timeline_closed", "ph": "i",
+                        "pid": self._rank, "tid": 0, "ts": self.now_us(),
+                        "s": "g"})
+            self._f.write("\n]\n")
             self._f.close()
 
 
@@ -129,6 +183,20 @@ class _Timeline:
                 dur_us)
         elif self._py is not None:
             self._py.record(tensor, activity, phase, dur_us, ts_us)
+
+    def counter(self, name: str, value: float, series: str = "value",
+                ts_us: int = -1):
+        # sanitize HERE for the native path too: csrc's %.17g would print
+        # 'nan'/'inf', which no JSON parser accepts (the Python writer
+        # sanitizes again for direct _PyWriter users)
+        value = _finite_counter_value(value)
+        if value is None:
+            return
+        if self._native is not None:
+            self._native.bft_timeline_counter(
+                name.encode(), series.encode(), value, ts_us)
+        elif self._py is not None:
+            self._py.counter(name, value, series, ts_us)
 
     def now_us(self) -> int:
         if self._native is not None:
@@ -227,10 +295,29 @@ def record_op_span(name: str, activity: str, token):
     _timeline.record(name, activity, "X", max(0, end - start_us), start_us)
 
 
+def record_counter(name: str, value: float, series: str = "value",
+                   ts_us: int = -1):
+    """Emit a Chrome-tracing counter sample (``"ph":"C"``) — Perfetto
+    renders each distinct ``name`` as a live graph lane next to the op
+    spans.  The observability exporter mirrors per-step telemetry through
+    here (``observability/export.py::log_step``); call it directly for
+    custom lanes.  No-op unless the timeline is enabled."""
+    if _timeline.enabled:
+        _timeline.counter(name, value, series, ts_us)
+
+
 def record_resilience_event(kind: str, detail: str = ""):
     """Fault/repair instant on the dedicated ``resilience`` lane: chaos-run
     boundaries, fault onsets, membership confirmations, matrix repairs.
-    No-op unless the timeline is enabled (like every host activity)."""
+    Counted in the host metrics registry when that is enabled
+    (``bf_resilience_events_total{kind=...}``); the timeline instant is
+    emitted only while a timeline is open (like every host activity)."""
+    from .observability import metrics as _metrics
+    if _metrics.enabled():
+        _metrics.counter(
+            "bf_resilience_events_total",
+            "resilience events by kind (fault onsets, degradations, "
+            "confirmations, repairs, chaos-run boundaries)").inc(kind=kind)
     if _timeline.enabled:
         name = f"{kind}: {detail}" if detail else kind
         _timeline.record("resilience", name, "i")

@@ -22,6 +22,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .context import ctx
+from .observability import ingraph as IG
 from .ops import api as _api
 from .ops import fusion as _fusion
 from .optim import strategies as S
@@ -96,7 +97,8 @@ def make_train_step(model,
                     check_vma: Optional[bool] = None,
                     fuse: Optional[bool] = None,
                     fusion_bucket_bytes: Optional[int] = None,
-                    overlap: Optional[bool] = None):
+                    overlap: Optional[bool] = None,
+                    telemetry: Optional[bool] = None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -124,8 +126,18 @@ def make_train_step(model,
     ``create_train_state(..., overlap=True)``.  Step 0 is a documented
     warmup (local-only) step.
 
+    ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): compute traced
+    training-health aggregates INSIDE the step — consensus distance
+    ``||x_i - x_bar||^2`` (one pmean per fusion bucket), mixing-matrix
+    column/row mass, param/grad/update norms, overlap staleness/warmup
+    flags — returned as a 4th output, a per-rank
+    ``observability.ingraph.TelemetrySnapshot`` with ``[N]`` fields
+    (docs/observability.md).  Off lowers to bit-identical StableHLO
+    (asserted by ``tests/test_observability.py``).
+
     Returns ``train_step(variables, opt_state, batch, step) ->
-    (variables, opt_state, loss)`` where ``batch = (x, y)`` with leading
+    (variables, opt_state, loss)`` — plus the telemetry snapshot when
+    ``telemetry`` resolves on — where ``batch = (x, y)`` with leading
     [N, B_local] dims and ``loss`` is the cross-rank mean.
     """
     cx = ctx()
@@ -160,6 +172,7 @@ def make_train_step(model,
     fusion_bucket_bytes = _fusion.resolve_max_bucket_bytes(
         fusion_bucket_bytes)
     overlap = S.overlap_enabled(overlap)
+    telemetry = IG.telemetry_enabled(telemetry)
     if overlap:
         if communication not in ("neighbor_allreduce", "allreduce",
                                  "exact_diffusion"):
@@ -192,7 +205,8 @@ def make_train_step(model,
                 topo=S.exact_diffusion_topology(cx.compiled_topology),
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, nar_backend=nar_backend,
-                fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+                fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
+                telemetry=telemetry)
         else:
             builder = S.delayed_atc_step if atc else S.delayed_consensus_step
             core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
@@ -200,7 +214,8 @@ def make_train_step(model,
                            machine_axes=(cx.machine_axis, cx.local_axis),
                            machine_topo=machine_topo,
                            nar_backend=nar_backend, fuse=fuse,
-                           fusion_bucket_bytes=fusion_bucket_bytes)
+                           fusion_bucket_bytes=fusion_bucket_bytes,
+                           telemetry=telemetry)
     elif grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
@@ -209,7 +224,7 @@ def make_train_step(model,
                 "bf.DistributedGradientAllreduceOptimizer instead")
         core = S.gradient_allreduce_step(
             base_opt, cx.rank_axis, fuse=fuse,
-            fusion_bucket_bytes=fusion_bucket_bytes)
+            fusion_bucket_bytes=fusion_bucket_bytes, telemetry=telemetry)
     elif exact_diffusion:
         if num_steps_per_communication > 1:
             raise ValueError("exact_diffusion assumes one exchange per "
@@ -222,17 +237,25 @@ def make_train_step(model,
             topo=S.exact_diffusion_topology(cx.compiled_topology),
             machine_axes=(cx.machine_axis, cx.local_axis),
             machine_topo=machine_topo, nar_backend=nar_backend,
-            fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+            fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
+            telemetry=telemetry)
     else:
         builder = S.atc_step if atc else S.consensus_step
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
                        sched=sched,
                        machine_axes=(cx.machine_axis, cx.local_axis),
                        machine_topo=machine_topo, nar_backend=nar_backend,
-                       fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes)
+                       fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
+                       telemetry=telemetry)
     if not (exact_diffusion or overlap):
-        core = S.with_local_steps(core, S.local_sgd_like_step(base_opt),
-                                  num_steps_per_communication)
+        tel_axis = S._telemetry_axis(
+            comm_type, cx.rank_axis, (cx.machine_axis, cx.local_axis))
+        core = S.with_local_steps(
+            core,
+            S.local_sgd_like_step(base_opt, telemetry=telemetry,
+                                  axis_name=tel_axis, fuse=fuse,
+                                  fusion_bucket_bytes=fusion_bucket_bytes),
+            num_steps_per_communication)
 
     pl = mesh_plumbing(cx, hierarchical)
 
@@ -255,25 +278,35 @@ def make_train_step(model,
 
             (loss, new_extra), grads = jax.value_and_grad(
                 local_loss, has_aux=True)(params)
-            params_new, st_new = core(params, grads, st, si)
+            if telemetry:
+                params_new, st_new, snap = core(params, grads, st, si)
+            else:
+                params_new, st_new = core(params, grads, st, si)
             mean_loss = jax.lax.pmean(
                 loss, cx.rank_axis if not hierarchical
                 else (cx.machine_axis, cx.local_axis))
             v_new = {"params": params_new, **new_extra}
+            if telemetry:
+                return (pl.rewrap(v_new), pl.rewrap(st_new), mean_loss,
+                        pl.rewrap(snap))
             return pl.rewrap(v_new), pl.rewrap(st_new), mean_loss
 
         v2, o2 = pl.reshape_in(variables), pl.reshape_in(opt_state)
         b2 = pl.reshape_in(batch)
+        # telemetry adds one sharded output (the snapshot) after the loss
+        out_specs = ((pl.spec, pl.spec, P(), pl.spec) if telemetry
+                     else (pl.spec, pl.spec, P()))
         # check_vma off under the pallas backend: the fused-exchange
         # kernel's outputs carry no varying-manual-axes tags (same
         # exemption as ops/api.py's _shardmapped pallas path)
-        v_out, o_out, loss = jax.shard_map(
+        out = jax.shard_map(
             shard_fn, mesh=pl.mesh,
             in_specs=(pl.spec, pl.spec, pl.spec, P()),
-            out_specs=(pl.spec, pl.spec, P()),
+            out_specs=out_specs,
             check_vma=check_vma,
         )(v2, o2, b2, step_idx)
-        return pl.reshape_out(v_out), pl.reshape_out(o_out), loss
+        return tuple(o if i == 2 else pl.reshape_out(o)
+                     for i, o in enumerate(out))
 
     return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
 

@@ -12,6 +12,8 @@ import os
 
 import jax
 
+from ..observability import metrics as _metrics
+
 _DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".jax_cache")
@@ -34,3 +36,21 @@ def enable_persistent_cache(cache_dir: str = None) -> str:
     except Exception:
         return ""   # older jax without the knobs: cold compiles still work
     return cache_dir
+
+
+def note_step_cache(hit: bool) -> None:
+    """Record a jitted-step cache consult in the host metrics registry
+    (``bf_step_cache_total{result="hit"|"build"}``).
+
+    A "build" is a retrace+recompile of the whole SPMD step — the
+    canonical silent performance bug in this codebase (a knob missing
+    from ``optim/_plumbing.step_cache_key`` serves stale programs; a knob
+    churning per step recompiles every call).  The counter makes the
+    recompile rate a first-class series next to step times in the bench
+    JSON (``bench.py "metrics"``).  Free when the registry is disabled.
+    """
+    if _metrics.enabled():
+        _metrics.counter(
+            "bf_step_cache_total",
+            "jitted-step cache consults by result (build = recompile)",
+        ).inc(result="hit" if hit else "build")

@@ -69,6 +69,108 @@ _ASYNC_PATTERNS = {
         r"|(?<!%)\bcollective-permute-done\b"),
 }
 
+# ---------------------------------------------------------------------------
+# Payload-byte estimation: parse operand/result tensor types off the op line
+# ---------------------------------------------------------------------------
+#
+# StableHLO spells types `tensor<8x128xf32>` (result types after `->`);
+# the HLO dialect spells them `f32[8,128]{1,0}` with the RESULT type first
+# on the line (`%name = f32[8,128]{1,0} collective-permute(...)`).  Bytes
+# are counted from the RESULT side — for every collective here the result
+# payload equals the moved payload (permute/reduce preserve shape; gather's
+# result IS the gathered volume), so "bytes moved per program execution"
+# is the honest reading.  Layout annotations and tuple wrappers are
+# tolerated; unknown dtypes count as 0 rather than guessing.
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "i8": 1,
+    "s16": 2, "u16": 2, "i16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+_STABLEHLO_TENSOR = re.compile(r"tensor<([^>]*)>")
+_HLO_TYPE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\](?:\{[^}]*\})?")
+# un-prefixed opcode position on an HLO-dialect line (instruction names
+# are %-prefixed); sync and async-split spellings both terminate the
+# result-type head
+_HLO_OPCODE = re.compile(
+    r"(?<!%)\b(?:all-reduce|collective-permute|all-gather|all-to-all|"
+    r"reduce-scatter)(?:-(?:start|done))?\b")
+
+
+def _stablehlo_tensor_bytes(spec: str) -> int:
+    """``'8x128xf32'`` / ``'f32'`` (0-d) -> byte count (0 if unknown)."""
+    parts = spec.strip().split("x")
+    dtype = parts[-1].strip()
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:
+        return 0
+    n = 1
+    for d in parts[:-1]:
+        d = d.strip()
+        if not d.isdigit():
+            return 0      # dynamic dim ('?') — unknowable, do not guess
+        n *= int(d)
+    return n * size
+
+
+def _hlo_type_bytes(dtype: str, dims: str) -> int:
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        d = d.strip()
+        if d:
+            n *= int(d)
+    return n * size
+
+
+def _stablehlo_arrow_bytes(line: str) -> int:
+    """Bytes of the result types after ``->`` on a StableHLO line."""
+    specs = _STABLEHLO_TENSOR.findall(line.split("->", 1)[1])
+    return sum(_stablehlo_tensor_bytes(s) for s in specs)
+
+
+def _op_result_bytes(lines, i: int, lookahead: int = 64) -> int:
+    """Result-side payload bytes of the op whose mnemonic sits on
+    ``lines[i]`` (see module comment).
+
+    StableHLO regioned ops (all_reduce with its reducer block) put the
+    type signature on the region-CLOSING line, so when the mnemonic line
+    carries no ``->`` the scanner walks forward to the first line that
+    does (bounded; reducer-body element ops carry bare ``: tensor<f32>``
+    types without an arrow, so the first arrow is the op's signature).
+    """
+    line = lines[i]
+    if "stablehlo" in line or "tensor<" in line:
+        if "->" in line:
+            return _stablehlo_arrow_bytes(line)
+        stripped = line.rstrip()
+        matches = list(_STABLEHLO_TENSOR.finditer(stripped))
+        if matches and matches[-1].end() == len(stripped):
+            # single-line arrowless form ends WITH its value type
+            # (`stablehlo.add %a, %b : tensor<f32>`); a trailing `({`
+            # region opener means any tensor<> on the line is an attr
+            # type (replica_groups), not the signature
+            return _stablehlo_tensor_bytes(matches[-1].group(1))
+        for j in range(i + 1, min(i + 1 + lookahead, len(lines))):
+            if "->" in lines[j]:
+                return _stablehlo_arrow_bytes(lines[j])
+        return 0
+    # HLO dialect (single-line): the result type(s) precede the OPCODE —
+    # cut at the opcode occurrence, not at the first '(' (a tuple result
+    # `(f32[100]{0}, f32[50]{0}) all-reduce(...)` opens a paren before the
+    # operand list), then parse every type token in the head
+    m = _HLO_OPCODE.search(line)
+    head = line[:m.start()] if m else line.split("(", 1)[0]
+    return sum(_hlo_type_bytes(d, dims)
+               for d, dims in _HLO_TYPE.findall(head))
+
 
 def count_collectives_in_text(text: str) -> Dict[str, int]:
     """Per-kind collective-op counts in an HLO/StableHLO module string.
@@ -76,13 +178,29 @@ def count_collectives_in_text(text: str) -> Dict[str, int]:
     ``total`` sums the synchronous kinds; the async split halves are
     reported separately as ``ppermute_start``/``ppermute_done`` with
     ``ppermute_pairs`` = complete start/done pairs (the overlap-eligible
-    collective count)."""
+    collective count).
+
+    Per-kind ``<kind>_bytes`` estimate the payload moved per program
+    execution (result-side tensor volume parsed off each op line; see the
+    payload-estimation comment above), with ``total_bytes`` summing the
+    synchronous kinds — so ``bench.py --trace-only`` reports bytes moved,
+    not just op counts."""
     counts = {kind: len(pat.findall(text)) for kind, pat in _PATTERNS.items()}
     counts["total"] = sum(counts.values())
     for kind, pat in _ASYNC_PATTERNS.items():
         counts[kind] = len(pat.findall(text))
     counts["ppermute_pairs"] = min(counts["ppermute_start"],
                                    counts["ppermute_done"])
+    sync_kinds = list(_PATTERNS)
+    bytes_by_kind = {kind: 0 for kind in sync_kinds}
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        for kind in sync_kinds:
+            if _PATTERNS[kind].search(line):
+                bytes_by_kind[kind] += _op_result_bytes(lines, i)
+    for kind in sync_kinds:
+        counts[f"{kind}_bytes"] = bytes_by_kind[kind]
+    counts["total_bytes"] = sum(bytes_by_kind.values())
     return counts
 
 

@@ -29,9 +29,11 @@ constexpr uint32_t kQueueCapacity = 1 << 16;  // 65536 in-flight records
 struct Record {
   char tensor[kMaxName];
   char activity[kMaxName];
-  char phase;        // 'B' begin, 'E' end, 'X' complete, 'i' instant
+  char phase;        // 'B' begin, 'E' end, 'X' complete, 'i' instant,
+                     // 'C' counter (tensor = lane name, activity = series)
   int64_t ts_us;     // microseconds since timeline open
   int64_t dur_us;    // only for 'X'
+  double value;      // only for 'C'
   uint32_t tid;      // lane id (stable hash of tensor name)
 };
 
@@ -163,7 +165,25 @@ class TimelineWriter {
     r.phase = phase;
     r.ts_us = ts_us < 0 ? now_us() : ts_us;
     r.dur_us = dur_us;
+    r.value = 0.0;
     r.tid = lane(r.tensor);
+    if (queue_.push(r)) cv_.notify_one();
+    else dropped_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Chrome-tracing counter sample ("ph":"C"): `name` is the lane, `series`
+  // the args key, `value` the sample.  Renders as a graph lane in Perfetto.
+  void counter(const char* name, const char* series, double value,
+               int64_t ts_us) {
+    if (!active()) return;
+    Record r;
+    std::snprintf(r.tensor, kMaxName, "%s", name ? name : "");
+    std::snprintf(r.activity, kMaxName, "%s", series ? series : "value");
+    r.phase = 'C';
+    r.ts_us = ts_us < 0 ? now_us() : ts_us;
+    r.dur_us = 0;
+    r.value = value;
+    r.tid = 0;  // counters are process-scoped; no lane metadata needed
     if (queue_.push(r)) cv_.notify_one();
     else dropped_.fetch_add(1, std::memory_order_relaxed);
   }
@@ -185,6 +205,15 @@ class TimelineWriter {
     char tensor[2 * kMaxName], activity[2 * kMaxName];
     json_escape(r.tensor, tensor, sizeof tensor);
     json_escape(r.activity, activity, sizeof activity);
+    if (r.phase == 'C') {
+      // counter lane: name = lane, args = {series: value}; no tid (the
+      // lane-metadata path below would mislabel thread 0)
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"bluefog\",\"ph\":\"C\","
+                   "\"ts\":%lld,\"pid\":%d,\"args\":{\"%s\":%.17g}},\n",
+                   tensor, (long long)r.ts_us, rank_, activity, r.value);
+      return;
+    }
     if (!seen_lane_[r.tid % 4096]) {
       seen_lane_[r.tid % 4096] = true;
       std::fprintf(file_,
@@ -276,6 +305,12 @@ void bft_timeline_record(const char* tensor, const char* activity, char phase,
 void bft_timeline_record_at(const char* tensor, const char* activity,
                             char phase, int64_t ts_us, int64_t dur_us) {
   writer()->record(tensor, activity, phase, ts_us, dur_us);
+}
+
+// counter sample ("ph":"C"): renders as a Perfetto graph lane
+void bft_timeline_counter(const char* name, const char* series, double value,
+                          int64_t ts_us) {
+  writer()->counter(name, series, value, ts_us);
 }
 
 int64_t bft_timeline_now_us() { return writer()->now_us(); }

@@ -1,0 +1,805 @@
+"""Observability subsystem: in-graph telemetry, host metrics, exporters.
+
+Acceptance (ISSUE 4): in-graph consensus distance matches a NumPy
+reference on ragged mixed-dtype trees across all strategies (per-leaf,
+fused, overlapped), column-sum telemetry flags a deliberately broken
+repaired matrix, JSONL round-trips, timeline counter events appear as
+``"ph":"C"`` records, and ``telemetry=False`` lowers to byte-identical
+StableHLO versus the pre-telemetry code path.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import timeline as TL
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.observability import ingraph as IG
+from bluefog_tpu.observability import metrics as M
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.utils import trace_metrics as TM
+
+from conftest import N_DEVICES as N
+
+CT = S.CommunicationType
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test starts and ends with a disabled, empty registry (the
+    registry is process-global)."""
+    M.disable()
+    M.registry.reset()
+    yield
+    M.disable()
+    M.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def ragged_tree(seed=0, n=N, bf16=True):
+    """Global-view pytree with odd shapes, mixed f32/bf16, a scalar leaf,
+    and an EMPTY leaf — the shapes the telemetry has to survive."""
+    rng = np.random.default_rng(seed)
+    r = lambda *s: jnp.asarray(rng.normal(size=(n,) + s), jnp.float32)
+    rb = lambda *s: jnp.asarray(
+        rng.normal(size=(n,) + s), jnp.bfloat16 if bf16 else jnp.float32)
+    return {
+        "a": r(3, 5),
+        "b": rb(7),
+        "scalar": r(),
+        "nested": {"w": r(2, 2, 2), "empty": r(0, 4), "v": rb(5, 3)},
+    }
+
+
+def np_consensus_reference(params_new):
+    """Per-rank sum over leaves of ``||x_i - mean_j x_j||^2``, f64 on
+    f32-cast leaves — the independent reference for the in-graph value."""
+    leaves = [np.asarray(l.astype(jnp.float32), np.float64)
+              for l in jax.tree.leaves(params_new) if l.size]
+    n = leaves[0].shape[0]
+    out = np.zeros(n)
+    for l in leaves:
+        flat = l.reshape(n, -1)
+        out += ((flat - flat.mean(axis=0, keepdims=True)) ** 2).sum(axis=1)
+    return out
+
+
+def one_peer_sched(n=N):
+    topo = bf.load_topology()
+    return bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+
+def _check_snapshot_consensus(params_new, snap, has_bf16=True):
+    ref = np_consensus_reference(params_new)
+    got = np.asarray(snap.consensus_dist, np.float64)
+    # bf16 leaves: XLA may keep higher intermediate precision inside the
+    # fused step than the bf16-rounded outputs the reference reads
+    tol = dict(rtol=2e-2, atol=5e-3) if has_bf16 else dict(rtol=1e-4,
+                                                           atol=1e-6)
+    np.testing.assert_allclose(got, ref, **tol)
+
+
+# ---------------------------------------------------------------------------
+# gate resolution
+# ---------------------------------------------------------------------------
+
+def test_telemetry_default_off(monkeypatch):
+    monkeypatch.delenv("BLUEFOG_TELEMETRY", raising=False)
+    assert IG.telemetry_enabled() is False
+    assert IG.telemetry_enabled(None) is False
+
+
+def test_telemetry_env_on(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TELEMETRY", "1")
+    assert IG.telemetry_enabled() is True
+
+
+def test_telemetry_explicit_flag_beats_env(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TELEMETRY", "1")
+    assert IG.telemetry_enabled(False) is False
+    monkeypatch.setenv("BLUEFOG_TELEMETRY", "0")
+    assert IG.telemetry_enabled(True) is True
+
+
+# ---------------------------------------------------------------------------
+# consensus distance vs NumPy across strategies
+# ---------------------------------------------------------------------------
+
+STRATEGY_CASES = [
+    "consensus_perleaf", "consensus_fused", "atc_fused", "allreduce",
+    "dynamic", "overlap_consensus", "overlap_atc",
+]
+
+
+@pytest.mark.parametrize("case", STRATEGY_CASES)
+def test_consensus_distance_matches_numpy(bf_ctx, case):
+    base = optax.sgd(0.05, momentum=0.9)
+    kw = dict(telemetry=True)
+    if case == "consensus_perleaf":
+        opt = bf.DistributedNeighborAllreduceOptimizer(base, fuse=False, **kw)
+    elif case == "consensus_fused":
+        opt = bf.DistributedNeighborAllreduceOptimizer(base, fuse=True, **kw)
+    elif case == "atc_fused":
+        opt = bf.DistributedAdaptThenCombineOptimizer(base, fuse=True, **kw)
+    elif case == "allreduce":
+        opt = bf.DistributedAllreduceOptimizer(base, **kw)
+    elif case == "dynamic":
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            base, sched=one_peer_sched(), **kw)
+    elif case == "overlap_consensus":
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            base, overlap=True, fuse=True, **kw)
+    elif case == "overlap_atc":
+        opt = bf.DistributedAdaptThenCombineOptimizer(
+            base, overlap=True, fuse=True, **kw)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.3 * a, ragged_tree(seed=7))
+    state = opt.init(params)
+    for t in range(2):   # overlap: past warmup, with a live in-flight fold
+        params, state, snap = opt.step(params, grads, state, t)
+    _check_snapshot_consensus(params, snap)
+    # structural checks shared by every strategy
+    assert np.asarray(snap.step).shape == (N,)
+    assert np.all(np.asarray(snap.param_norm) > 0)
+    assert np.all(np.asarray(snap.grad_norm) > 0)
+    assert np.all(np.asarray(snap.update_norm) > 0)
+    expect_stale = 1.0 if case.startswith("overlap") else 0.0
+    np.testing.assert_array_equal(np.asarray(snap.staleness),
+                                  np.full(N, expect_stale, np.float32))
+
+
+def test_gradient_allreduce_consensus_near_zero(bf_ctx):
+    """Lockstep gradient averaging from equal starts keeps ranks equal:
+    the consensus series should sit at ~0 — drift means divergence."""
+    base = optax.sgd(0.1)
+    opt = bf.DistributedGradientAllreduceOptimizer(base, telemetry=True)
+    one = jax.tree.map(lambda a: a[:1], ragged_tree())
+    params = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (N,) + a.shape[1:]), one)
+    grads = jax.tree.map(lambda a: 0.3 * a, ragged_tree(seed=3))
+    state = opt.init(params)
+    params, state, snap = opt.step(params, grads, state, 0)
+    assert np.all(np.asarray(snap.consensus_dist) < 1e-6)
+    np.testing.assert_array_equal(np.asarray(snap.mix_col_sum),
+                                  np.ones(N, np.float32))
+
+
+def test_exact_diffusion_consensus_matches_numpy(bf_ctx):
+    bf.set_topology(bf.SymmetricExponentialGraph(N), is_weighted=True)
+    base = optax.sgd(0.05)
+    opt = bf.DistributedExactDiffusionOptimizer(base, telemetry=True)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.3 * a, ragged_tree(seed=5))
+    state = opt.init(params)
+    params, state, snap = opt.step(params, grads, state, 0)
+    _check_snapshot_consensus(params, snap)
+    # damped (I+W)/2 of a symmetric doubly-stochastic matrix is doubly
+    # stochastic: both masses exactly 1
+    np.testing.assert_allclose(np.asarray(snap.mix_col_sum), 1.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(snap.mix_row_sum), 1.0, atol=1e-5)
+
+
+def test_train_step_consensus_matches_numpy(bf_ctx):
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    model = MLP(features=(12,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    step = T.make_train_step(model, base,
+                             communication="neighbor_allreduce",
+                             telemetry=True, donate=False)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, 2, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 2)))
+    variables, opt_state, loss, snap = step(variables, opt_state, (x, y),
+                                            jnp.int32(0))
+    ref = np_consensus_reference(variables["params"])
+    np.testing.assert_allclose(np.asarray(snap.consensus_dist, np.float64),
+                               ref, rtol=1e-4, atol=1e-6)
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# mixing-matrix mass telemetry
+# ---------------------------------------------------------------------------
+
+def _mass_harness(cx, topo):
+    """jit(shard_map) probe of mix_mass over a compiled topology."""
+    spec = P(cx.rank_axis)
+
+    def probe(step):
+        def sf(si):
+            col, row = IG.mix_mass(CT.neighbor_allreduce, cx.rank_axis,
+                                   topo=topo, step=si)
+            return col[None], row[None]
+        return jax.shard_map(sf, mesh=cx.mesh, in_specs=(P(),),
+                             out_specs=(spec, spec))(step)
+    return jax.jit(probe)
+
+
+def test_mix_mass_healthy_topology(bf_ctx):
+    col, row = _mass_harness(bf_ctx, bf_ctx.compiled_topology)(jnp.int32(0))
+    # default exp2 with uniform column-normalized weights: columns sum to 1
+    np.testing.assert_allclose(np.asarray(col), 1.0, atol=1e-6)
+
+
+def test_column_sum_flags_broken_repaired_matrix(bf_ctx):
+    """A deliberately broken 'repair' (one column scaled to 0.8 mass) must
+    show up in the column-sum telemetry at exactly that rank."""
+    from bluefog_tpu.resilience.repair import repair_matrix
+    W = bf_ctx.compiled_topology.weight_matrix.copy()
+    alive = np.ones(N, bool)
+    alive[2] = False
+    R = repair_matrix(W, alive, family="column")   # healthy repair
+    np.testing.assert_allclose(R.sum(axis=0), 1.0, atol=1e-9)
+    broken = R.copy()
+    broken[:, 5] *= 0.8                             # the deliberate break
+    topo = bf.compile_weight_matrix(broken)
+    col, row = _mass_harness(bf_ctx, topo)(jnp.int32(0))
+    col = np.asarray(col)
+    assert abs(col[5] - 0.8) < 1e-6, col
+    healthy = np.delete(col, 5)
+    np.testing.assert_allclose(healthy, 1.0, atol=1e-6)
+
+
+def test_row_sum_flags_non_doubly_stochastic_repair(bf_ctx):
+    """Column-family repair of the (doubly-stochastic) directed exp2
+    matrix preserves column sums but breaks ROW sums — the silent
+    degradation the row-sum series exists to catch: the repaired matrix
+    is still column-stochastic (iterates stay bounded) but no longer
+    doubly-stochastic (exact-averaging fixed points gone)."""
+    from bluefog_tpu.resilience.repair import repair_matrix
+    W = bf_ctx.compiled_topology.weight_matrix
+    # healthy circulant exp2 with uniform weights IS doubly stochastic
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-9)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-9)
+    alive = np.ones(N, bool)
+    alive[1] = False
+    R = repair_matrix(W, alive, family="column")
+    np.testing.assert_allclose(R.sum(axis=0), 1.0, atol=1e-9)
+    topo = bf.compile_weight_matrix(R)
+    col, row = _mass_harness(bf_ctx, topo)(jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(col), 1.0, atol=1e-6)
+    row = np.asarray(row)
+    survivors = np.arange(N) != 1
+    assert np.any(np.abs(row[survivors] - 1.0) > 1e-3), (
+        f"row sums unexpectedly stayed stochastic: {row}")
+
+
+def test_mix_mass_dynamic_schedule(bf_ctx):
+    sched = one_peer_sched()
+    spec = P(bf_ctx.rank_axis)
+
+    def probe(step):
+        def sf(si):
+            col, row = IG.mix_mass(CT.neighbor_allreduce, bf_ctx.rank_axis,
+                                   sched=sched, step=si)
+            return col[None], row[None]
+        return jax.shard_map(sf, mesh=bf_ctx.mesh, in_specs=(P(),),
+                             out_specs=(spec, spec))(step)
+    f = jax.jit(probe)
+    for t in range(min(3, sched.period)):
+        col, _row = f(jnp.int32(t))
+        np.testing.assert_allclose(np.asarray(col), 1.0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# pipeline flags: overlap warmup / staleness, degraded guard, local steps
+# ---------------------------------------------------------------------------
+
+def test_overlap_warmup_flag_sequence(bf_ctx):
+    base = optax.sgd(0.1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, overlap=True,
+                                                   telemetry=True)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    state = opt.init(params)
+    params, state, s0 = opt.step(params, grads, state, 0)
+    np.testing.assert_array_equal(np.asarray(s0.warmup), np.ones(N))
+    np.testing.assert_array_equal(np.asarray(s0.staleness), np.ones(N))
+    params, state, s1 = opt.step(params, grads, state, 1)
+    np.testing.assert_array_equal(np.asarray(s1.warmup), np.zeros(N))
+
+
+def test_degraded_guard_branch_hits(bf_ctx):
+    cx = bf_ctx
+    base = optax.sgd(0.1)
+    comm = S.consensus_step(base, CT.neighbor_allreduce, cx.rank_axis,
+                            topo=cx.compiled_topology, nar_backend="xla",
+                            fuse=True, telemetry=True)
+    local = S.local_sgd_like_step(base, telemetry=True, degraded=True)
+    guarded = S.with_degraded_guard(comm, local)
+    spec = P(cx.rank_axis)
+
+    def stepper(params, grads, st, step, degraded):
+        def sf(p, g, s, si, dg):
+            out = guarded(jax.tree.map(lambda a: a[0], p),
+                          jax.tree.map(lambda a: a[0], g),
+                          jax.tree.map(lambda a: a[0], s), si, dg)
+            return jax.tree.map(lambda a: a[None], out)
+        return jax.shard_map(
+            sf, mesh=cx.mesh, in_specs=(spec, spec, spec, P(), P()),
+            out_specs=(spec, spec, spec))(params, grads, st, step, degraded)
+
+    f = jax.jit(stepper)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    st = jax.vmap(base.init)(params)
+    _, _, snap_ok = f(params, grads, st, jnp.int32(0), jnp.asarray(False))
+    np.testing.assert_array_equal(np.asarray(snap_ok.degraded), np.zeros(N))
+    assert np.all(np.asarray(snap_ok.consensus_dist) >= 0)
+    _, _, snap_deg = f(params, grads, st, jnp.int32(1), jnp.asarray(True))
+    np.testing.assert_array_equal(np.asarray(snap_deg.degraded), np.ones(N))
+    # the degraded branch issues NO collective: consensus is UNMEASURED
+    np.testing.assert_array_equal(np.asarray(snap_deg.consensus_dist),
+                                  np.full(N, IG.UNMEASURED, np.float32))
+    np.testing.assert_array_equal(np.asarray(snap_deg.mix_col_sum),
+                                  np.ones(N))
+
+
+def test_local_steps_schedule_telemetry(bf_ctx):
+    """k=2: the non-comm step reports identity mix and still-measured
+    consensus; the comm step reports the topology's mass."""
+    base = optax.sgd(0.1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        base, num_steps_per_communication=2, telemetry=True)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    state = opt.init(params)
+    _, _, snap_local = opt.step(params, grads, state, 0)   # 0 % 2 != 1
+    np.testing.assert_array_equal(np.asarray(snap_local.mix_col_sum),
+                                  np.ones(N))
+    assert np.all(np.asarray(snap_local.consensus_dist) >= 0)
+    _, _, snap_comm = opt.step(params, grads, state, 1)    # comm step
+    np.testing.assert_allclose(np.asarray(snap_comm.mix_col_sum), 1.0,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead guarantee
+# ---------------------------------------------------------------------------
+
+HLO_CASES = [
+    ("neighbor_allreduce", False, True, False),
+    ("neighbor_allreduce", False, False, False),
+    ("neighbor_allreduce", False, True, True),
+    ("neighbor_allreduce", True, True, False),
+    ("neighbor_allreduce", True, True, True),
+    ("exact_diffusion", False, True, False),
+    ("exact_diffusion", False, True, True),
+]
+
+
+@pytest.mark.parametrize("comm,atc,fuse,overlap", HLO_CASES)
+def test_telemetry_off_is_hlo_identical(bf_ctx, comm, atc, fuse, overlap,
+                                        monkeypatch):
+    """telemetry=False must lower to byte-identical StableHLO versus the
+    pre-telemetry builder (the default path with the env unset) for
+    consensus/ATC/exact-diffusion x fused x overlap."""
+    monkeypatch.delenv("BLUEFOG_TELEMETRY", raising=False)
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    if comm == "exact_diffusion":
+        bf.set_topology(bf.SymmetricExponentialGraph(N), is_weighted=True)
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        communication=comm, overlap=overlap, fuse=fuse)
+    mk = lambda **kw: T.make_train_step(
+        model, base, communication=comm, atc=atc, fuse=fuse,
+        overlap=overlap, donate=False, **kw)
+    x = jnp.zeros((N, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((N, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    text_off, _ = TM.lower_text(mk(telemetry=False), *args)
+    text_default, _ = TM.lower_text(mk(), *args)
+    assert text_off == text_default
+    text_on, _ = TM.lower_text(mk(telemetry=True), *args)
+    assert text_on != text_off
+    # the on-path's extra collectives are exactly the consensus pmeans:
+    # one all_reduce per fusion bucket (a single f32 bucket here — or one
+    # per nonempty leaf when unfused) on top of the loss pmean
+    c_off = TM.count_collectives_in_text(text_off)
+    c_on = TM.count_collectives_in_text(text_on)
+    params_per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+    if fuse:
+        from bluefog_tpu.ops import fusion as F
+        extra = F.plan_for(params_per_rank).n_buckets
+    else:
+        extra = len([l for l in jax.tree.leaves(params_per_rank) if l.size])
+    assert c_on["all_reduce"] == c_off["all_reduce"] + extra
+    assert c_on["ppermute"] == c_off["ppermute"]
+
+
+def test_wrapper_telemetry_off_is_hlo_identical(bf_ctx, monkeypatch):
+    monkeypatch.delenv("BLUEFOG_TELEMETRY", raising=False)
+    base = optax.sgd(0.05)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, fuse=True)
+    state = opt.init(params)
+    args = (params, grads, state, jnp.int32(0))
+    text_off, _ = TM.lower_text(opt._build(None, telemetry=False), *args)
+    # the env-resolved default (what step() computes with the env unset)
+    # must take the same build path as explicit telemetry=False
+    text_default, _ = TM.lower_text(
+        opt._build(None, telemetry=IG.telemetry_enabled(opt.telemetry)),
+        *args)
+    assert text_off == text_default
+    text_on, _ = TM.lower_text(opt._build(None, telemetry=True), *args)
+    assert text_on != text_off
+    c_off = TM.count_collectives_in_text(text_off)
+    c_on = TM.count_collectives_in_text(text_on)
+    assert c_off["all_reduce"] == 0          # pure neighbor exchange
+    assert c_on["all_reduce"] == 2           # one pmean per dtype bucket
+    assert c_on["ppermute"] == c_off["ppermute"]
+
+
+def test_disabled_registry_creates_no_metrics(bf_ctx):
+    """Hot paths guarded by metrics.enabled() must create NOTHING while
+    the registry is disabled."""
+    from bluefog_tpu.ops import fusion as F
+    assert not M.enabled()
+    F.plan_for(jax.tree.map(lambda a: a[0], ragged_tree(seed=11)))
+    bf.win_create(ragged_tree(seed=12)["a"], "obs.disabled")
+    bf.win_put(ragged_tree(seed=12)["a"], "obs.disabled")
+    bf.win_update("obs.disabled")
+    bf.win_free("obs.disabled")
+    assert M.registry.snapshot() == {}
+
+
+def test_disabled_enabled_check_allocates_nothing():
+    """The hot-path guard is one list-indexed bool read: zero Python
+    allocations attributable to the metrics module."""
+    import tracemalloc
+    M.disable()
+    M.enabled()        # warm any lazy state
+    tracemalloc.start()
+    s1 = tracemalloc.take_snapshot()
+    for _ in range(1000):
+        M.enabled()
+    s2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    flt = (tracemalloc.Filter(True, M.__file__),)
+    delta = sum(st.size_diff for st in s2.filter_traces(flt).compare_to(
+        s1.filter_traces(flt), "filename"))
+    # no PER-CALL growth: 1000 calls allocating anything would show >=28kB
+    # (one-off interpreter noise of a few dozen bytes is tolerated)
+    assert delta < 1000, (
+        f"metrics.py allocated {delta} bytes over 1000 disabled-path calls")
+
+
+# ---------------------------------------------------------------------------
+# host metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_with_labels():
+    M.enable()
+    c = M.counter("t_ops_total")
+    c.inc(op="put")
+    c.inc(2, op="put")
+    c.inc(op="get")
+    assert c.value(op="put") == 3.0
+    assert c.value(op="get") == 1.0
+    snap = M.registry.snapshot()
+    assert snap["t_ops_total{op=put}"] == 3.0
+
+
+def test_gauge_set_and_add():
+    M.enable()
+    g = M.gauge("t_depth")
+    g.set(4)
+    g.add(-1)
+    assert g.value() == 3.0
+    g.set(7, lane="win")
+    assert g.value(lane="win") == 7.0
+
+
+def test_histogram_buckets():
+    M.enable()
+    h = M.histogram("t_lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    cell = h.cell()
+    assert cell["count"] == 4
+    assert cell["sum"] == pytest.approx(55.55)
+    assert cell["buckets"] == [1, 2, 3]      # cumulative
+    snap = M.registry.snapshot()
+    assert snap["t_lat"]["count"] == 4
+
+
+def test_metric_kind_clash_raises_and_snapshot_is_json():
+    M.enable()
+    M.counter("t_x")
+    with pytest.raises(TypeError):
+        M.gauge("t_x")
+    M.gauge("t_g").set(1.5, a="b")
+    M.histogram("t_h").observe(2.0)
+    json.dumps(M.registry.snapshot())        # must serialize cleanly
+
+
+# ---------------------------------------------------------------------------
+# layer instrumentation
+# ---------------------------------------------------------------------------
+
+def test_fusion_plan_metrics(bf_ctx):
+    from bluefog_tpu.ops import fusion as F
+    M.enable()
+    tree = {"w": jnp.zeros((977,), jnp.float32),
+            "v": jnp.zeros((13,), jnp.bfloat16)}
+    plan = F.plan_for(tree, pad_to=128)
+    snap = M.registry.snapshot()
+    assert snap["bf_fusion_plan{field=buckets}"] == plan.n_buckets
+    payload, waste = F.plan_bytes(plan)
+    assert snap["bf_fusion_plan{field=payload_bytes}"] == payload
+    assert snap["bf_fusion_plan{field=padding_waste_bytes}"] == waste
+    assert waste > 0                          # 977 % 128 != 0
+    assert snap["bf_fusion_plan_consults_total"] >= 1
+
+
+def test_window_op_metrics(bf_ctx):
+    M.enable()
+    x = jnp.ones((N, 4), jnp.float32)
+    assert bf.win_create({"p": x, "q": 2 * x}, "obs.win")
+    bf.win_put({"p": x, "q": x}, "obs.win")
+    bf.win_update("obs.win")
+    bf.win_free("obs.win")
+    snap = M.registry.snapshot()
+    assert snap["bf_win_ops_total{mode=inline,op=win_put}"] == 1.0
+    assert snap["bf_win_updates_total{peek=0}"] == 1.0
+    # default double buffering: the blocking win_put's win_wait promoted
+    assert snap["bf_win_promotes_total"] >= 1.0
+
+
+def test_service_and_resilience_metrics(bf_ctx):
+    from bluefog_tpu import service
+    M.enable()
+    h = service.submit(lambda: 42, op_name="obs_task")
+    assert service.wait(h) == 42
+    TL.record_resilience_event("obs_kind", "detail")
+    service.mark_rank_degraded(6, "observability test")
+    try:
+        snap = M.registry.snapshot()
+        assert snap["bf_service_tasks_total{op=obs_task}"] == 1.0
+        assert snap["bf_resilience_events_total{kind=obs_kind}"] == 1.0
+        # mark_rank_degraded counts AND emits a resilience event
+        assert snap["bf_service_degraded_total"] == 1.0
+        assert snap["bf_resilience_events_total{kind=degraded}"] == 1.0
+        assert snap["bf_service_degraded_ranks"] == 1.0
+    finally:
+        service.clear_degraded_ranks()
+
+
+def test_step_cache_hit_miss_metrics(bf_ctx):
+    M.enable()
+    base = optax.sgd(0.1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    state = opt.init(params)
+    params, state = opt.step(params, grads, state, 0)
+    params, state = opt.step(params, grads, state, 1)
+    c = M.counter("bf_step_cache_total")
+    assert c.value(result="build") == 1.0
+    assert c.value(result="hit") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_jsonl_roundtrip(tmp_path):
+    prefix = str(tmp_path / "series_")
+    path = EX.metrics_start(prefix, rank=0)
+    assert path == prefix + "0.jsonl"
+    assert M.enabled()                        # start enables the registry
+    M.counter("t_total").inc(3)
+    rec = EX.log_step(0, {"consensus_dist": [0.5, 0.25],
+                          "param_norm": 1.0},
+                      extra={"loss": 2.5})
+    assert rec["loss"] == 2.5
+    EX.log_step(1, {"consensus_dist": [0.4, 0.2], "param_norm": 0.9})
+    EX.metrics_end()
+    assert not M.enabled()                    # end restores the gate
+    records = EX.validate_jsonl(path)
+    assert len(records) == 2
+    assert records[0]["consensus_dist"] == [0.5, 0.25]
+    assert records[0]["counters"]["t_total"] == 3.0
+    assert records[1]["step"] == 1
+
+
+def test_jsonl_roundtrips_device_snapshot(bf_ctx, tmp_path):
+    """A real TelemetrySnapshot (device arrays, [N] fields) must fetch,
+    serialize, parse, and validate."""
+    base = optax.sgd(0.1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, telemetry=True)
+    params = ragged_tree()
+    state = opt.init(params)
+    _, _, snap = opt.step(params, jax.tree.map(jnp.zeros_like, params),
+                          state, 0)
+    path = EX.metrics_start(str(tmp_path / "dev_"), rank=0)
+    EX.log_step(0, snap)
+    EX.metrics_end()
+    (rec,) = EX.validate_jsonl(path)
+    assert len(rec["consensus_dist"]) == N
+    got = np.asarray(rec["consensus_dist"])
+    np.testing.assert_allclose(got, np.asarray(snap.consensus_dist),
+                               rtol=1e-6)
+
+
+def test_metrics_env_autostart(tmp_path, monkeypatch):
+    prefix = str(tmp_path / "auto_")
+    monkeypatch.setenv("BLUEFOG_METRICS", prefix)
+    bf.init()
+    assert EX.metrics_active()
+    assert M.enabled()
+    EX.log_step(0, {"consensus_dist": 0.1})
+    bf.shutdown()                             # closes the sink
+    assert not EX.metrics_active()
+    records = EX.validate_jsonl(prefix + "0.jsonl")
+    assert records[0]["consensus_dist"] == 0.1
+
+
+def test_validate_jsonl_rejects_bad_schema(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"step": 0, "t_us": 1}\n')            # missing rank
+    with pytest.raises(ValueError, match="missing keys"):
+        EX.validate_jsonl(str(p))
+    p.write_text('{"step": 0, "t_us": 1, "rank": 0, "x": NaN}\n')
+    with pytest.raises(ValueError, match="non-finite"):
+        EX.validate_jsonl(str(p))
+    p.write_text("not json\n")
+    with pytest.raises(ValueError, match="invalid JSON"):
+        EX.validate_jsonl(str(p))
+
+
+def test_prometheus_text_format():
+    M.enable()
+    M.counter("t_ops_total", "ops so far").inc(5, op="put")
+    M.gauge("t_depth").set(2)
+    M.histogram("t_lat", buckets=(1.0, 10.0)).observe(0.5)
+    text = EX.prometheus_text()
+    assert "# TYPE t_ops_total counter" in text
+    assert 't_ops_total{op="put"} 5.0' in text
+    assert "# HELP t_ops_total ops so far" in text
+    assert "t_depth 2.0" in text
+    assert 't_lat_bucket{le="1.0"} 1' in text
+    assert 't_lat_bucket{le="+Inf"} 1' in text
+    assert "t_lat_count 1" in text
+
+
+def test_timeline_counter_events(bf_ctx, tmp_path):
+    """log_step mirrors telemetry onto the timeline as "ph":"C" counter
+    records — the Perfetto graph-lane contract."""
+    prefix = str(tmp_path / "ctr_")
+    path = bf.timeline_start(prefix, rank=0)
+    EX.log_step(0, {"consensus_dist": [0.5, 0.3], "param_norm": 2.0},
+                extra={"loss": 1.25})
+    EX.log_step(1, {"consensus_dist": [0.4, 0.2], "param_norm": 1.9})
+    bf.timeline_end()
+    events = json.load(open(path))
+    counters = [e for e in events if e.get("ph") == "C"]
+    lanes = {e["name"] for e in counters}
+    assert "telemetry/consensus_dist" in lanes
+    assert "telemetry/param_norm" in lanes
+    assert "telemetry/loss" in lanes
+    cd = [e for e in counters if e["name"] == "telemetry/consensus_dist"]
+    assert len(cd) == 2
+    # per-rank lists collapse to the mean on the lane
+    assert cd[0]["args"]["value"] == pytest.approx(0.4)
+    ts = [e["ts"] for e in cd]
+    assert ts == sorted(ts)
+
+
+def test_record_counter_direct(bf_ctx, tmp_path):
+    path = bf.timeline_start(str(tmp_path / "direct_"), rank=0)
+    TL.record_counter("my/depth", 17.0)
+    TL.record_counter("my/depth", 4.0, series="backlog")
+    bf.timeline_end()
+    events = json.load(open(path))
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters[0]["args"] == {"value": 17.0}
+    assert counters[1]["args"] == {"backlog": 4.0}
+
+
+# ---------------------------------------------------------------------------
+# trace-metrics payload bytes (satellite)
+# ---------------------------------------------------------------------------
+
+def test_collective_bytes_synthetic_text():
+    text = """
+%2 = "stablehlo.collective_permute"(%1) : (tensor<8x128xf32>) -> tensor<8x128xf32>
+%3 = "stablehlo.all_reduce"(%2) <{replica_groups = dense<0> : tensor<1x8xi64>}> ({
+  %9 = stablehlo.add %arg0, %arg1 : tensor<bf16>
+}) : (tensor<16xbf16>) -> tensor<16xbf16>
+%4 = "stablehlo.all_gather"(%3) : (tensor<4xf32>) -> tensor<32xf32>
+%collective-permute.5 = f32[931]{0} collective-permute(f32[931]{0} %p)
+%all-reduce.7 = bf16[64]{0} all-reduce(bf16[64]{0} %q)
+"""
+    c = TM.count_collectives_in_text(text)
+    assert c["ppermute_bytes"] == 8 * 128 * 4 + 931 * 4
+    assert c["all_reduce_bytes"] == 16 * 2 + 64 * 2
+    assert c["all_gather_bytes"] == 32 * 4       # gathered volume
+    assert c["total_bytes"] == (c["ppermute_bytes"] + c["all_reduce_bytes"]
+                                + c["all_gather_bytes"])
+
+
+def test_collective_bytes_hlo_tuple_result():
+    """Post-compile HLO spells fused multi-bucket collectives with TUPLE
+    results — the result-type head ends at the opcode, not at the tuple's
+    opening paren (review regression)."""
+    c = TM.count_collectives_in_text(
+        "%ar = (f32[100]{0}, f32[50]{0}) all-reduce(f32[100]{0} %a, "
+        "f32[50]{0} %b), replica_groups={}")
+    assert c["all_reduce"] == 1
+    assert c["all_reduce_bytes"] == (100 + 50) * 4
+
+
+def test_counter_nonfinite_values_keep_json_valid(bf_ctx, tmp_path):
+    """A diverged run (inf/NaN telemetry) must not corrupt the trace:
+    inf clamps to the double max, NaN drops, and the file stays strict
+    JSON (review regression)."""
+    path = bf.timeline_start(str(tmp_path / "nf_"), rank=0)
+    TL.record_counter("t/x", float("inf"))
+    TL.record_counter("t/x", float("nan"))
+    TL.record_counter("t/x", float("-inf"))
+    TL.record_counter("t/x", 1.0)
+    bf.timeline_end()
+    events = json.load(open(path))           # strict parse must succeed
+    vals = [e["args"]["value"] for e in events if e.get("ph") == "C"]
+    assert len(vals) == 3                    # NaN dropped
+    assert vals[0] > 1e307 and vals[1] < -1e307 and vals[2] == 1.0
+
+
+def test_collective_bytes_unknown_dtype_counts_zero():
+    c = TM.count_collectives_in_text(
+        '%2 = "stablehlo.collective_permute"(%1) : '
+        "(tensor<4xmystery>) -> tensor<4xmystery>")
+    assert c["ppermute"] == 1
+    assert c["ppermute_bytes"] == 0              # never guess
+
+
+def test_collective_bytes_real_program(bf_ctx):
+    cx = bf_ctx
+
+    def f(x):
+        def sf(xs):
+            return jax.lax.pmean(xs[0], cx.rank_axis)[None]
+        return jax.shard_map(sf, mesh=cx.mesh,
+                             in_specs=(P(cx.rank_axis),),
+                             out_specs=P(cx.rank_axis))(x)
+    c = TM.collective_counts(f, jnp.zeros((N, 64), jnp.float32))
+    assert c["all_reduce"] == 1
+    assert c["all_reduce_bytes"] == 64 * 4
+    assert c["total_bytes"] == 64 * 4
+
+
+def test_fused_step_reports_bytes(bf_ctx):
+    """bench --trace-only's headline: the fused step's ppermute payload in
+    bytes must equal offsets x the fusion plan's bucket payload."""
+    from bluefog_tpu.ops import fusion as F
+    base = optax.sgd(0.05)
+    opt = bf.DistributedNeighborAllreduceOptimizer(base, fuse=True)
+    params = ragged_tree()
+    grads = jax.tree.map(lambda a: 0.1 * a, params)
+    state = opt.init(params)
+    fn = opt._build(None, telemetry=False)
+    c = TM.collective_counts(fn, params, grads, state, jnp.int32(0))
+    plan = F.plan_for(jax.tree.map(lambda a: a[0], params))
+    payload, _waste = F.plan_bytes(plan)
+    offsets = len(bf_ctx.compiled_topology.offsets)
+    assert c["ppermute"] == plan.n_buckets * offsets
+    assert c["ppermute_bytes"] == payload * offsets

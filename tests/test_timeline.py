@@ -90,6 +90,53 @@ def test_timeline_disabled_noop():
     assert bf.timeline_end_activity("t") is False
 
 
+def test_pywriter_emits_strict_json_and_idempotent_close(tmp_path):
+    """Regression (ISSUE 4 satellite): the pure-Python writer used to
+    leave a trailing comma before a `{}` sentinel and a second close()
+    (atexit after an explicit timeline_end) wrote on a closed file.  The
+    output must parse with plain ``json.load`` and close() must be safe
+    to call twice."""
+    from bluefog_tpu.timeline import _PyWriter
+    path = str(tmp_path / "pyw.json")
+    w = _PyWriter(path, rank=3)
+    w.record("tensor.a", "PHASE", "B")
+    w.record("tensor.a", "", "E")
+    w.record("tensor.b", "SPAN", "X", dur_us=5, ts_us=10)
+    w.counter("lane/depth", 2.5)
+    w.close()
+    w.close()                                 # idempotent — must not raise
+    w.record("tensor.a", "LATE", "i")         # post-close records dropped
+    with open(path) as f:
+        text = f.read()
+    events = json.loads(text)                 # STRICT parse, no filtering
+    assert ",\n]" not in text and ",]" not in text
+    assert all(isinstance(e, dict) and e for e in events)
+    names = [e.get("name") for e in events]
+    assert "PHASE" in names and "SPAN" in names
+    assert "LATE" not in names
+    assert events[-1]["name"] == "timeline_closed"
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters and counters[0]["args"] == {"value": 2.5}
+
+
+def test_native_writer_counter_events(tmp_path):
+    """Counter lanes through the native writer: "ph":"C" records with a
+    numeric args series (the Perfetto graph-lane contract)."""
+    bf.init()
+    path = bf.timeline_start(str(tmp_path / "natc_"), rank=0)
+    from bluefog_tpu import timeline as tl
+    tl.record_counter("telemetry/consensus_dist", 1.5)
+    tl.record_counter("telemetry/consensus_dist", 0.75)
+    bf.timeline_end()
+    bf.shutdown()
+    events = _load_events(path)
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert len(counters) == 2
+    assert counters[0]["name"] == "telemetry/consensus_dist"
+    assert counters[0]["args"]["value"] == 1.5
+    assert counters[1]["args"]["value"] == 0.75
+
+
 def test_native_library_builds():
     """The C++ writer must actually build and load in this environment;
     the pure-Python fallback is only for toolchain-less installs."""
