@@ -18,7 +18,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
-        bench-trace bench-overlap hwcheck chaos metrics-smoke
+        bench-trace bench-overlap bench-compress hwcheck chaos \
+        metrics-smoke metrics-smoke-compress
 
 test:
 	$(PYTEST) tests/
@@ -85,12 +86,34 @@ bench-overlap:
 	         o['on']['synchronous'], o['on']['overlap_eligible'], \
 	         o['off']['ppermute'], o['on']['ppermute']))"
 
+# Compression evidence (CPU, docs/compression.md): bench-trace JSON with
+# the "compress" block — ppermute_bytes_per_step for the fused train step
+# with compression off vs int8 vs top-k — summarized on one line and
+# GATED: exits non-zero unless int8 moves >= 3x fewer bytes on the wire
+# than the uncompressed fused path.
+bench-compress:
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); c=d['compress']; r=d['compress_bytes_drop']; \
+	print(json.dumps(d)); \
+	print('ppermute bytes/step: off %d | int8 %d (%.2fx) | topk %d (%.2fx)' \
+	      % (c['off']['ppermute_bytes_per_step'], \
+	         c['int8']['ppermute_bytes_per_step'], r['int8'], \
+	         c['topk']['ppermute_bytes_per_step'], r['topk'])); \
+	assert r['int8'] >= 3.0, 'int8 wire reduction %.2fx < 3x' % r['int8']"
+
 # Observability smoke (<=60s, CPU): 5-step telemetry-on loop — validates
 # the JSONL schema (BLUEFOG_METRICS sink) and that consensus distance is
 # finite and strictly decreasing on a consensus-only run
 # (docs/observability.md).
 metrics-smoke:
 	python scripts/metrics_smoke.py
+
+# Compressed-gossip smoke (docs/compression.md): the same gate with the
+# consensus-only run additionally executed under int8 + error feedback
+# and choco difference gossip — consensus distance must still strictly
+# decrease and the carried residual norm stay bounded.
+metrics-smoke-compress:
+	python scripts/metrics_smoke.py --compress
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

@@ -410,6 +410,30 @@ def trace_only_main():
         entry["synchronous"] = compiled["ppermute"]
         overlap_report[label] = entry
 
+    # Compression evidence (compress/, docs/compression.md): the SAME
+    # fused train step with the exchange wire quantized (int8) or
+    # sparsified (top-k) — ppermute count rises (payload + scale/index
+    # arrays per bucket) while bytes-on-wire drop ~4x/~5x.  The
+    # acceptance gate (`make bench-compress`): int8 moves >= 3x fewer
+    # ppermute bytes than the uncompressed fused path.
+    compress_report = {}
+    for label, spec in (("off", None), ("int8", "int8"),
+                        ("topk", "topk:0.1")):
+        step = T.make_train_step(model, base,
+                                 communication="neighbor_allreduce",
+                                 fuse=True, compression=spec, donate=False)
+        _, cstate = T.create_train_state(
+            model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+            compression=spec)
+        entry = TM.collective_counts(
+            step, variables, cstate, (x, y), jnp.int32(0))
+        compress_report[label] = {
+            "ppermute": entry["ppermute"],
+            "ppermute_bytes_per_step": entry["ppermute_bytes"],
+            "total_collective_bytes_per_step": entry["total_bytes"],
+            "hlo_lines": entry["hlo_lines"],
+        }
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -425,6 +449,12 @@ def trace_only_main():
         "ppermute_bytes_per_step": report["fused"]["ppermute_bytes"],
         "total_collective_bytes_per_step": report["fused"]["total_bytes"],
         "overlap": overlap_report,
+        "compress": compress_report,
+        "compress_bytes_drop": {
+            lbl: round(compress_report["off"]["ppermute_bytes_per_step"]
+                       / max(compress_report[lbl]
+                             ["ppermute_bytes_per_step"], 1), 2)
+            for lbl in ("int8", "topk")},
         # final host-registry snapshot: comm-volume, fusion-plan shape and
         # cache stats travel WITH the perf number in the BENCH_*.json
         "metrics": bf_metrics.registry.snapshot(),

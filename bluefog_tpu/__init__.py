@@ -81,6 +81,7 @@ from .ops.api import (
     set_weights_override, clear_weights_override, weights_override,
 )
 
+from . import compress
 from . import resilience
 
 from .ops.ring_attention import (

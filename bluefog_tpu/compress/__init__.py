@@ -1,0 +1,33 @@
+"""Compressed neighbor exchange: quantized/sparsified gossip wire formats
+with error feedback and CHOCO difference gossip.
+
+Select with ``compression=`` on the strategy builders / optimizer
+factories / ``training.make_train_step`` or the ``BLUEFOG_COMM_COMPRESS``
+env var; see ``docs/compression.md`` for the composition matrix with
+fusion / overlap / windows / resilience.
+"""
+
+from .compressors import (          # noqa: F401
+    COMPRESS_ENV,
+    CompressionConfig,
+    Compressor,
+    available_compressors,
+    get_compressor,
+    register_compressor,
+    resolve_compression,
+)
+from .exchange import (             # noqa: F401
+    check_supported,
+    compressed_mix,
+    init_state,
+    reset_state,
+    stateful,
+    wire_stats,
+)
+
+__all__ = [
+    "COMPRESS_ENV", "CompressionConfig", "Compressor",
+    "available_compressors", "get_compressor", "register_compressor",
+    "resolve_compression", "check_supported", "compressed_mix",
+    "init_state", "reset_state", "stateful", "wire_stats",
+]

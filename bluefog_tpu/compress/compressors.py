@@ -1,0 +1,366 @@
+"""Wire compressors for the gossip exchange: quantize / sparsify ONE flat
+buffer at a time.
+
+The fusion layer (``ops/fusion.py``) already packs the parameter pytree
+into a handful of dtype-bucketed flat buffers, so compression operates at
+exactly that granularity: one compress/decompress per BUCKET per exchange,
+never per leaf.  A compressor maps a buffer to a *wire* pytree of arrays
+(what actually rides ``lax.ppermute``/``all_gather``) and back:
+
+    wire = comp.compress(buf, shared_key, rank_key)
+    buf' = comp.decompress(wire, shared_key, shape, dtype)
+
+Design rules every compressor obeys:
+
+* **Deterministic decompression.**  ``decompress`` is a pure function of
+  the wire data and the SHARED key (derived from ``(step, bucket)``, never
+  the rank), so the sender's own reconstruction bit-matches every
+  receiver's — the invariant the error-feedback residual and the CHOCO
+  replica estimates rest on.  Randomness that decorrelates SENDERS
+  (stochastic-rounding noise) uses ``rank_key`` inside ``compress`` only.
+* **Static wire signature.**  The wire arrays' shapes/dtypes depend only
+  on the buffer's static shape/dtype and the config — jit traces once and
+  the collective schedule is fixed.
+* **Known cost.**  :meth:`Compressor.wire_nbytes` reports the wire payload
+  bytes for a buffer size so telemetry (and ``bench.py --trace-only``) can
+  report compression ratio without parsing HLO.
+
+Registry / selection: specs are strings —
+
+    "int8"            uniform 8-bit quantization, per-bucket scale,
+                      stochastic rounding (unbiased)
+    "fp8"             float8_e4m3fn cast with per-bucket scale
+    "topk:0.01"       keep the 1% largest-|x| entries (values + indices)
+    "randomk:0.05"    keep 5% entries at shared-seed random positions
+                      (indices are re-derived from the shared key, so the
+                      wire carries VALUES ONLY)
+    "identity"        no-op compressor (wire = the buffer; exercises the
+                      compressed code path bit-exactly)
+    "choco:<spec>[:gamma=G]"   CHOCO-style difference gossip: compress the
+                      delta against the neighbor replica estimate and mix
+                      with rate gamma (``compress/exchange.py``)
+
+resolved via :func:`resolve_compression` — explicit argument wins, else
+``BLUEFOG_COMM_COMPRESS`` (default off).  ``None``/``"none"``/``"off"``/
+``"0"``/``""`` all mean *no compression*: the builders then take the
+exact pre-compression code path (byte-identical StableHLO, asserted by
+``tests/test_compress.py``).
+"""
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "COMPRESS_ENV", "CompressionConfig", "Compressor",
+    "resolve_compression", "get_compressor", "available_compressors",
+    "register_compressor",
+]
+
+COMPRESS_ENV = "BLUEFOG_COMM_COMPRESS"
+
+_OFF_VALUES = ("", "0", "none", "off", "false")
+
+
+@dataclass(frozen=True)
+class CompressionConfig:
+    """Parsed, hashable compression selection (joins the step-cache key).
+
+    ``name``/``fraction`` select the compressor; ``choco`` switches the
+    exchange from direct compressed gossip to CHOCO difference gossip with
+    mixing rate ``gamma`` (``compress/exchange.py``).
+
+    ``gamma`` stability: CHOCO's consensus stepsize must scale with the
+    compression quality ω (Koloskova et al.: γ* ∝ δ²ω).  Too-large γ
+    under aggressive sparsification contracts for a few dozen steps and
+    then DIVERGES (measured on the 8-rank exp2 mesh, top-10%: γ=0.1
+    reaches 2e-10, γ=0.5 blows past 5e3 by step 200).  The parser
+    therefore defaults γ to ``min(0.5, fraction)`` for sparsifiers and
+    0.5 for quantizers/identity; an explicit ``gamma=`` in the spec
+    always wins."""
+    name: str
+    fraction: Optional[float] = None
+    choco: bool = False
+    gamma: float = 0.5
+
+    @property
+    def spec(self) -> str:
+        """Canonical spec string (round-trips through the parser)."""
+        s = self.name
+        if self.fraction is not None:
+            s += f":{self.fraction:g}"
+        if self.choco:
+            s = f"choco:{s}:gamma={self.gamma:g}"
+        return s
+
+
+def resolve_compression(value=None) -> Optional[CompressionConfig]:
+    """Resolve the compression knob: explicit argument wins, else the
+    ``BLUEFOG_COMM_COMPRESS`` env var (default off).  Builders resolve this
+    when the step is constructed — the same snapshot discipline as the
+    fusion/overlap knobs (jit traces once; and when the compressor carries
+    state, the resolved value shapes the opt-state layout)."""
+    if isinstance(value, CompressionConfig):
+        return value
+    if value is False:
+        return None
+    if value is None:
+        value = os.environ.get(COMPRESS_ENV, "")
+    if not isinstance(value, str):
+        raise TypeError(
+            f"compression must be a spec string, CompressionConfig, or "
+            f"None, got {type(value).__name__}")
+    if value.strip().lower() in _OFF_VALUES:
+        return None
+    return _parse_spec(value.strip())
+
+
+def _parse_spec(spec: str) -> CompressionConfig:
+    tokens = spec.lower().split(":")
+    choco = tokens[0] == "choco"
+    if choco:
+        tokens = tokens[1:]
+    if not tokens or not tokens[0]:
+        raise ValueError(
+            f"compression spec {spec!r} names no compressor; expected e.g. "
+            f"'int8', 'topk:0.01', 'choco:int8:gamma=0.5' "
+            f"(available: {', '.join(available_compressors())})")
+    name, params = tokens[0], tokens[1:]
+    fraction = None
+    gamma = None
+    for p in params:
+        if p.startswith("gamma="):
+            gamma = float(p[len("gamma="):])
+            if not choco:
+                raise ValueError(
+                    f"compression spec {spec!r}: gamma applies to the "
+                    f"choco mode only (prefix the spec with 'choco:')")
+        else:
+            fraction = float(p)
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown compressor {name!r} in spec {spec!r} "
+            f"(available: {', '.join(available_compressors())})")
+    if name in ("topk", "randomk"):
+        if fraction is None:
+            fraction = 0.01
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(
+                f"{name} fraction must be in (0, 1], got {fraction}")
+    elif fraction is not None:
+        raise ValueError(
+            f"compressor {name!r} takes no fraction parameter "
+            f"(spec {spec!r})")
+    if gamma is None:
+        # default γ tracks the compression quality: a sparsifier keeping
+        # fraction F of the coordinates is stable only for γ = O(F)
+        # (see CompressionConfig docstring); quantizers are near-exact
+        # (ω ≈ 1) and take the generous 0.5
+        gamma = min(0.5, fraction) if fraction is not None else 0.5
+    if not (0.0 < gamma <= 1.0):
+        raise ValueError(f"choco gamma must be in (0, 1], got {gamma}")
+    cfg = CompressionConfig(name=name, fraction=fraction, choco=choco,
+                            gamma=gamma)
+    get_compressor(cfg)   # fail fast on unsupported dtypes (fp8 gate)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Compressors
+# ---------------------------------------------------------------------------
+
+class Compressor:
+    """One bucket's wire codec.  Subclasses operate on a single array of
+    any shape (raveled internally); see the module docstring for the
+    determinism contract."""
+
+    name = "abstract"
+    lossless = False
+
+    def compress(self, buf: jax.Array, shared_key, rank_key
+                 ) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def decompress(self, wire: Dict[str, jax.Array], shared_key,
+                   shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+    def wire_nbytes(self, nelems: int, dtype) -> int:
+        """Static wire payload bytes for an ``nelems`` buffer of
+        ``dtype``."""
+        raise NotImplementedError
+
+
+class IdentityCompressor(Compressor):
+    """Wire = the buffer itself.  Exists so the compressed code path can
+    be exercised (and asserted bit-exact) without changing any value."""
+
+    name = "identity"
+    lossless = True
+
+    def compress(self, buf, shared_key, rank_key):
+        return {"v": buf}
+
+    def decompress(self, wire, shared_key, shape, dtype):
+        return wire["v"].reshape(shape).astype(dtype)
+
+    def wire_nbytes(self, nelems, dtype):
+        return int(nelems) * jnp.dtype(dtype).itemsize
+
+
+class Int8Compressor(Compressor):
+    """Uniform 8-bit quantization with one f32 scale per bucket.
+
+    ``scale = max|x| / 127``; encoding uses STOCHASTIC rounding
+    (``floor(x/scale + u)``, u ~ U[0,1) from ``rank_key``) so the
+    quantizer is unbiased — consensus noise averages out instead of
+    biasing the fixed point.  ``rank_key=None`` (the window path, which
+    has no step index) falls back to deterministic round-to-nearest."""
+
+    name = "int8"
+
+    def compress(self, buf, shared_key, rank_key):
+        f = buf.astype(jnp.float32).reshape(-1)
+        scale = jnp.maximum(jnp.max(jnp.abs(f)), jnp.float32(1e-30)) / 127.0
+        t = f / scale
+        if rank_key is not None:
+            q = jnp.floor(t + jax.random.uniform(rank_key, t.shape))
+        else:
+            q = jnp.round(t)
+        q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+        return {"q": q, "scale": scale.reshape(1)}
+
+    def decompress(self, wire, shared_key, shape, dtype):
+        f = wire["q"].astype(jnp.float32) * wire["scale"][0]
+        return f.astype(dtype).reshape(shape)
+
+    def wire_nbytes(self, nelems, dtype):
+        return int(nelems) + 4    # int8 payload + one f32 scale
+
+
+class Fp8Compressor(Compressor):
+    """float8_e4m3fn cast with one f32 scale per bucket (scaled so the
+    bucket max lands at the format's max normal, 448)."""
+
+    name = "fp8"
+    _MAX = 448.0
+
+    def __init__(self):
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "fp8 compression needs jnp.float8_e4m3fn (ml_dtypes); "
+                "this jax build does not provide it — use 'int8' instead")
+
+    def compress(self, buf, shared_key, rank_key):
+        f = buf.astype(jnp.float32).reshape(-1)
+        scale = jnp.maximum(jnp.max(jnp.abs(f)),
+                            jnp.float32(1e-30)) / self._MAX
+        return {"q": (f / scale).astype(jnp.float8_e4m3fn),
+                "scale": scale.reshape(1)}
+
+    def decompress(self, wire, shared_key, shape, dtype):
+        f = wire["q"].astype(jnp.float32) * wire["scale"][0]
+        return f.astype(dtype).reshape(shape)
+
+    def wire_nbytes(self, nelems, dtype):
+        return int(nelems) + 4
+
+
+def _k_of(nelems: int, fraction: float) -> int:
+    return max(1, min(int(nelems), int(round(nelems * fraction))))
+
+
+class TopKCompressor(Compressor):
+    """Magnitude sparsification: keep the k = ceil(fraction * n) entries
+    of largest |x|.  Wire = values (original dtype) + int32 indices —
+    per-rank index sets differ, so indices must ride the wire."""
+
+    name = "topk"
+
+    def __init__(self, fraction: float):
+        self.fraction = float(fraction)
+
+    def compress(self, buf, shared_key, rank_key):
+        f = buf.reshape(-1)
+        k = _k_of(f.shape[0], self.fraction)
+        _, idx = jax.lax.top_k(jnp.abs(f.astype(jnp.float32)), k)
+        return {"v": f[idx], "i": idx.astype(jnp.int32)}
+
+    def decompress(self, wire, shared_key, shape, dtype):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out = jnp.zeros((n,), dtype).at[wire["i"]].set(
+            wire["v"].astype(dtype))
+        return out.reshape(shape)
+
+    def wire_nbytes(self, nelems, dtype):
+        k = _k_of(int(nelems), self.fraction)
+        return k * (jnp.dtype(dtype).itemsize + 4)
+
+
+class RandomKCompressor(Compressor):
+    """Shared-seed random sparsification: the k kept positions derive from
+    the SHARED key (a pure function of ``(step, bucket)``), so every rank
+    uses the same mask and receivers re-derive it — the wire carries
+    VALUES ONLY, the cheapest sparse wire format.  (Per-rank independent
+    masks would need index transmission like top-k; the shared mask is
+    the standard decentralized choice because the mix stays a convex
+    combination coordinate-wise.)"""
+
+    name = "randomk"
+
+    def __init__(self, fraction: float):
+        self.fraction = float(fraction)
+
+    def _indices(self, shared_key, n: int):
+        k = _k_of(n, self.fraction)
+        return jax.random.choice(shared_key, n, shape=(k,), replace=False)
+
+    def compress(self, buf, shared_key, rank_key):
+        f = buf.reshape(-1)
+        return {"v": f[self._indices(shared_key, f.shape[0])]}
+
+    def decompress(self, wire, shared_key, shape, dtype):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        idx = self._indices(shared_key, n)
+        out = jnp.zeros((n,), dtype).at[idx].set(wire["v"].astype(dtype))
+        return out.reshape(shape)
+
+    def wire_nbytes(self, nelems, dtype):
+        return _k_of(int(nelems), self.fraction) * jnp.dtype(dtype).itemsize
+
+
+_REGISTRY = {
+    "identity": lambda cfg: IdentityCompressor(),
+    "int8": lambda cfg: Int8Compressor(),
+    "fp8": lambda cfg: Fp8Compressor(),
+    "topk": lambda cfg: TopKCompressor(cfg.fraction),
+    "randomk": lambda cfg: RandomKCompressor(cfg.fraction),
+}
+
+
+def register_compressor(name: str, factory) -> None:
+    """Add a custom compressor: ``factory(cfg) -> Compressor``.  The name
+    becomes valid in specs (``compression="myname"``)."""
+    _REGISTRY[str(name)] = factory
+
+
+def available_compressors():
+    return sorted(_REGISTRY)
+
+
+def get_compressor(cfg: CompressionConfig) -> Compressor:
+    """Instantiate the compressor a config names (fresh instance; they are
+    stateless — all carried state lives in the opt state,
+    ``compress/exchange.py``)."""
+    if cfg.name not in _REGISTRY:
+        raise ValueError(
+            f"unknown compressor {cfg.name!r} "
+            f"(available: {', '.join(available_compressors())})")
+    return _REGISTRY[cfg.name](cfg)

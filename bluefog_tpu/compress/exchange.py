@@ -1,0 +1,297 @@
+"""Compressed gossip exchange over the fusion layer's flat buffers.
+
+The uncompressed strategies move every fused bucket across the wire at
+full parameter precision (``optim/strategies._communicate``).  This module
+is the compressed drop-in: the SAME mixing weights and collective
+schedule, but the ``lax.ppermute``/``all_gather`` payload is each bucket's
+*wire* encoding (``compress/compressors.py``) — int8/fp8 quantized or
+top-k/random-k sparsified — with per-bucket f32 scales riding alongside.
+
+Three exchange disciplines, selected by the :class:`~.compressors.
+CompressionConfig`:
+
+* **direct** (default): receivers mix ``self_w * x_i + sum_j w_ij
+  D(C(x_j + e_j))`` — the self term is the rank's TRUE value (never
+  compressed), and the **error-feedback residual** ``e_j = (x_j + e_j) -
+  D(C(x_j + e_j))`` is carried in the donated opt state (the PR-3 overlap
+  buffer pattern) and re-injected next step, so quantization error
+  accumulates into later transmissions instead of being lost.
+* **allreduce** flavor of direct: global averaging ships compressed
+  payloads via ``all_gather`` and reduces locally (the GRACE-style
+  compressed allreduce); lossless compressors short-circuit to the plain
+  ``pmean`` (bit-exact).
+* **CHOCO** (``choco:`` specs): difference gossip (Koloskova et al.,
+  CHOCO-SGD).  Each rank carries its own public replica estimate
+  ``x_hat_i`` plus the weighted neighbor-estimate sum ``s_i = sum_j W[j,i]
+  x_hat_j``; only the compressed DELTA ``C(x_i - x_hat_i)`` crosses the
+  wire, every holder applies the identical decompressed delta (the
+  determinism contract in ``compressors.py``), and the iterate mixes with
+  rate gamma: ``x_i <- x_i + gamma * (s_i - x_hat_i)``.  Consensus
+  contracts linearly even under aggressive sparsification, where direct
+  top-k gossip stalls.  Requires a STATIC topology (the accumulated
+  ``s_i`` is only meaningful under a constant W) and column-stochastic
+  weights (every compiled topology here is).
+
+State layout (per rank, rides the donated opt state; create with
+:func:`init_state`, reset on degraded steps with :func:`reset_state`):
+
+    direct + lossy:  {"residual": (buf per bucket, ...)}
+    choco:           {"xhat": (...), "shat": (...)}
+    lossless direct: None  (no state -> no layout change)
+
+Every per-step quantity (step index for the shared PRNG key, weights
+under dynamic schedules) is traced data — compression never adds a
+recompile.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import fusion as F
+from ..ops.collectives import _rotation_pairs, allgather
+from ..observability import metrics as _metrics
+from . import compressors as CP
+
+__all__ = [
+    "stateful", "init_state", "reset_state", "compressed_mix",
+    "wire_stats", "check_supported",
+]
+
+# base PRNG seed for the shared (step, bucket) keys; any constant works —
+# it only has to be the SAME constant on every rank
+_KEY_SEED = 0xC0213
+
+
+def stateful(cfg: Optional[CP.CompressionConfig]) -> bool:
+    """Does this config carry per-rank state (residuals / replica
+    estimates) in the opt state?  Decides the state LAYOUT, so builders
+    resolve it once at construction, like the overlap knob."""
+    if cfg is None:
+        return False
+    if cfg.choco:
+        return True
+    return not CP.get_compressor(cfg).lossless
+
+
+def check_supported(cfg: Optional[CP.CompressionConfig], *,
+                    comm_value: str, sched=None,
+                    overlap: bool = False) -> None:
+    """Build-time validation of a (config, communication mode) pairing;
+    raises ValueError with guidance instead of tracing something wrong."""
+    if cfg is None:
+        return
+    if comm_value == "hierarchical.neighbor.allreduce":
+        raise ValueError(
+            "compression does not support hierarchical_neighbor_allreduce "
+            "yet: the two-level mix would need per-level wire formats; "
+            "use neighbor_allreduce or allreduce, or compression=None")
+    if cfg.choco:
+        if comm_value != "neighbor.allreduce":
+            raise ValueError(
+                f"choco compression is difference GOSSIP — it applies to "
+                f"neighbor_allreduce mixing only (got {comm_value!r})")
+        if sched is not None:
+            raise ValueError(
+                "choco compression requires a static topology: the "
+                "accumulated neighbor-estimate sum s_i = sum_j W[j,i] "
+                "x_hat_j is only meaningful under a constant W (dynamic "
+                "schedules change W per step); use a direct spec like "
+                "'int8' or 'topk:0.01' with dynamic schedules")
+        if overlap:
+            raise ValueError(
+                "choco compression does not compose with overlap=True: "
+                "the CHOCO mix x + gamma*(s - x_hat) has no single "
+                "in-flight self weight to pipeline; use a direct spec "
+                "('int8', 'topk:...') under overlap")
+
+
+def _zero_state_bufs(tree, fuse: bool, bucket_bytes: Optional[int]):
+    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
+    return tuple(jnp.zeros_like(b) for b in bufs)
+
+
+def init_state(cfg: Optional[CP.CompressionConfig], params, *,
+               fuse: Optional[bool] = None,
+               bucket_bytes: Optional[int] = None):
+    """Per-rank compression state for ``params``, or ``None`` when the
+    config is stateless.  ``fuse``/``bucket_bytes`` must resolve to the
+    SAME values the step builder uses — the carried-buffer layout is part
+    of the state structure (exactly the ``delayed_init`` contract)."""
+    if not stateful(cfg):
+        return None
+    fuse = F.fusion_enabled(fuse)
+    bufs = _zero_state_bufs(params, fuse, bucket_bytes)
+    if cfg.choco:
+        # the warmup estimates are ZERO (not x_0): every rank's copy of
+        # x_hat_j must start identical WITHOUT a communication round, and
+        # zero is the only value all ranks agree on for free.  The first
+        # few steps transmit large deltas while x_hat catches up — the
+        # documented CHOCO warmup.
+        return {"xhat": bufs,
+                "shat": tuple(jnp.zeros_like(b) for b in bufs)}
+    return {"residual": bufs}
+
+
+def reset_state(state):
+    """Zero every carried buffer — the degraded-step reset: a repaired or
+    guard-skipped step must not re-inject residuals (or trust replica
+    estimates) accumulated against a topology that membership now
+    distrusts.  Mesh-uniform like the degraded flag itself, so choco
+    estimates stay rank-consistent (every rank restarts the warmup
+    together)."""
+    if state is None:
+        return None
+    return jax.tree.map(jnp.zeros_like, state)
+
+
+def wire_stats(cfg: CP.CompressionConfig, bufs) -> Tuple[int, int]:
+    """(wire bytes, raw bytes) of one compressed transfer of ``bufs`` —
+    static ints, computable at trace time."""
+    comp = CP.get_compressor(cfg)
+    wire = sum(comp.wire_nbytes(int(b.size), b.dtype)
+               for b in bufs if b.size)
+    raw = sum(int(b.size) * jnp.dtype(b.dtype).itemsize
+              for b in bufs if b.size)
+    return int(wire), int(raw)
+
+
+def _shared_key(step, bucket: int):
+    key = jax.random.key(_KEY_SEED)
+    key = jax.random.fold_in(key, jnp.asarray(step, jnp.int32))
+    return jax.random.fold_in(key, bucket)
+
+
+def _neighbor_terms(axis_name, topo, sched, step, dtype, idx):
+    """(self_w, [(pairs, w), ...]) in ``dtype`` — EXACTLY the weight
+    construction of ``collectives.neighbor_allreduce`` (static) /
+    ``dynamic_neighbor_allreduce`` (sched), so the identity compressor's
+    mix is bit-identical to the uncompressed path."""
+    if sched is not None:
+        t = jnp.asarray(step) % sched.period
+        self_w = jnp.asarray(sched.self_weights)[t][idx].astype(dtype)
+        recv_w = jnp.asarray(sched.recv_weights)[t]
+        terms = [(_rotation_pairs(sched.size, off),
+                  recv_w[k, idx].astype(dtype))
+                 for k, off in enumerate(sched.offsets)]
+        return self_w, terms
+    self_w = jnp.asarray(topo.self_weights, dtype)[idx]
+    terms = [(shift.pairs, jnp.asarray(shift.recv_weights, dtype)[idx])
+             for shift in topo.shifts]
+    return self_w, terms
+
+
+def _note_metrics(cfg, wire_bytes: int, raw_bytes: int) -> None:
+    if not _metrics.enabled():
+        return
+    # trace-time only, like the fusion-plan gauges: describes the LAST
+    # compressed exchange planned, counts every plan consult
+    _metrics.counter("bf_compress_consults_total",
+                     "compressed-exchange plans (trace-time)").inc(
+        spec=cfg.spec)
+    g = _metrics.gauge("bf_compress_plan",
+                       "shape of the last compressed exchange planned")
+    g.set(wire_bytes, field="wire_bytes")
+    g.set(raw_bytes, field="raw_bytes")
+    g.set(raw_bytes / max(wire_bytes, 1), field="ratio")
+
+
+def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
+                   mode: str, axis_name, topo=None, sched=None, step=0,
+                   fuse: bool = True, bucket_bytes: Optional[int] = None):
+    """One compressed exchange of ``tree`` (per-rank, inside shard_map).
+
+    ``mode``: ``"neighbor"`` (weighted gossip over ``topo``/``sched``) or
+    ``"allreduce"`` (global mean via compressed all_gather).  Returns
+    ``(mixed_tree, new_state, diag)`` where ``diag`` carries traced f32
+    ``residual_norm`` plus static ``wire_bytes``/``ratio`` for the
+    telemetry snapshot."""
+    comp = CP.get_compressor(cfg)
+    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
+    wire_bytes, raw_bytes = wire_stats(cfg, bufs)
+    _note_metrics(cfg, wire_bytes, raw_bytes)
+    idx = lax.axis_index(axis_name)
+    res_norm2 = jnp.float32(0.0)
+    mixed: List[jax.Array] = []
+    new_parts: Dict[str, List[jax.Array]] = {}
+
+    for b, buf in enumerate(bufs):
+        if buf.size == 0:
+            # zero-size passthrough leaf (unfused mode): nothing to move
+            mixed.append(buf)
+            for k in ("residual", "xhat", "shat"):
+                if state is not None and k in state:
+                    new_parts.setdefault(k, []).append(state[k][b])
+            continue
+        skey = _shared_key(step, b)
+        rkey = jax.random.fold_in(skey, idx)
+
+        if cfg.choco:
+            xhat, shat = state["xhat"][b], state["shat"][b]
+            delta = buf - xhat
+            wire = comp.compress(delta, skey, rkey)
+            d_own = comp.decompress(wire, skey, buf.shape, buf.dtype)
+            self_w, terms = _neighbor_terms(axis_name, topo, sched, step,
+                                            buf.dtype, idx)
+            acc = self_w * d_own
+            for pairs, w in terms:
+                arrived = jax.tree.map(
+                    lambda a: lax.ppermute(a, axis_name, pairs), wire)
+                acc = acc + w * comp.decompress(arrived, skey, buf.shape,
+                                                buf.dtype)
+            xhat_new = xhat + d_own
+            shat_new = shat + acc
+            gamma = jnp.asarray(cfg.gamma, buf.dtype)
+            mixed.append(buf + gamma * (shat_new - xhat_new))
+            new_parts.setdefault("xhat", []).append(xhat_new)
+            new_parts.setdefault("shat", []).append(shat_new)
+            # the carried compression error: how far the public estimate
+            # lags the true iterate
+            err = (buf - xhat_new).astype(jnp.float32)
+            res_norm2 = res_norm2 + jnp.sum(err * err)
+            continue
+
+        # -- direct mode (with error feedback when lossy) ----------------
+        residual = state["residual"][b] if state is not None else None
+        t_val = buf if residual is None else buf + residual
+        if mode == "allreduce" and comp.lossless:
+            # nothing to gain from the gather path; pmean is bit-exact
+            mixed.append(lax.pmean(buf, axis_name))
+            continue
+        wire = comp.compress(t_val, skey, rkey)
+        d_own = comp.decompress(wire, skey, buf.shape, buf.dtype)
+        if mode == "allreduce":
+            gathered = jax.tree.map(lambda a: allgather(a[None], axis_name),
+                                    wire)
+            dec = jax.vmap(lambda w: comp.decompress(w, skey, buf.shape,
+                                                     buf.dtype))(gathered)
+            n = lax.axis_size(axis_name)
+            # self term is the TRUE value; neighbors contribute their
+            # decompressed transmissions
+            out = (buf + dec.sum(axis=0) - dec[idx]) / n
+        else:
+            self_w, terms = _neighbor_terms(axis_name, topo, sched, step,
+                                            buf.dtype, idx)
+            out = self_w * buf
+            for pairs, w in terms:
+                arrived = jax.tree.map(
+                    lambda a: lax.ppermute(a, axis_name, pairs), wire)
+                out = out + w * comp.decompress(arrived, skey, buf.shape,
+                                                buf.dtype)
+        mixed.append(out)
+        if residual is not None:
+            res_new = t_val - d_own
+            new_parts.setdefault("residual", []).append(res_new)
+            r32 = res_new.astype(jnp.float32)
+            res_norm2 = res_norm2 + jnp.sum(r32 * r32)
+
+    if state is None:
+        new_state = None
+    else:
+        new_state = {k: tuple(v) for k, v in new_parts.items()}
+    diag = {"residual_norm": jnp.sqrt(res_norm2),
+            "wire_bytes": float(wire_bytes),
+            "ratio": float(raw_bytes) / float(max(wire_bytes, 1))}
+    return F.restore(plan, tree, mixed), new_state, diag

@@ -78,7 +78,14 @@ class TelemetrySnapshot(NamedTuple):
     mass of the step's mixing matrix; ``staleness`` is 0 for synchronous
     mixing, 1 for the overlapped staleness-1 fold; ``warmup`` flags a
     warmup fold (zero in-flight buffer); ``degraded`` flags the
-    degraded-guard/local branch."""
+    degraded-guard/local branch.
+
+    Compression fields (``compress/``): ``compress_ratio`` is raw bytes /
+    wire bytes of one exchange payload (1 with compression off),
+    ``residual_norm`` the l2 of the carried error-feedback residual (for
+    choco, of ``x - x_hat``; 0 when nothing is carried), ``wire_bytes``
+    the compressed payload bytes of one transfer (0 = unmeasured,
+    compression off)."""
     step: jax.Array
     consensus_dist: jax.Array
     param_norm: jax.Array
@@ -89,6 +96,9 @@ class TelemetrySnapshot(NamedTuple):
     staleness: jax.Array
     warmup: jax.Array
     degraded: jax.Array
+    compress_ratio: jax.Array
+    residual_norm: jax.Array
+    wire_bytes: jax.Array
 
     def asdict(self):
         return dict(zip(self._fields, self))
@@ -102,11 +112,7 @@ def _buffers(tree, fuse: bool, bucket_bytes: Optional[int]):
     (the plan is the trace-time-cached one the exchange already uses, so
     the telemetry pmean count is ``buckets``, not ``leaves``), else the
     non-empty leaves."""
-    if fuse:
-        plan = F.plan_for(tree, max_bucket_bytes=bucket_bytes)
-        bufs = F.flatten(plan, tree)
-    else:
-        bufs = [l for l in jax.tree.leaves(tree)]
+    _, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
     return [b.astype(jnp.float32) for b in bufs if b.size]
 
 
@@ -177,6 +183,8 @@ def mix_mass(comm_type, axis_name, topo=None, sched=None, step=0,
 def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
                       col_sum, row_sum, fuse, bucket_bytes,
                       staleness=0.0, warmup=0.0, degraded=0.0,
+                      compress_ratio=1.0, residual_norm=0.0,
+                      wire_bytes=0.0,
                       measure_consensus: bool = True) -> TelemetrySnapshot:
     """Assemble the snapshot a strategy step returns.
 
@@ -184,7 +192,8 @@ def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
     axes).  ``measure_consensus=False`` (the degraded/local guard branch,
     which must issue NO collective) reports :data:`UNMEASURED` instead.
     ``warmup`` may be traced (the overlapped variants derive it from the
-    in-flight self weight)."""
+    in-flight self weight); ``residual_norm`` may be traced (the
+    compressed exchange's carried-error l2)."""
     if measure_consensus:
         cd = consensus_distance(new_params, axis_name, fuse, bucket_bytes)
     else:
@@ -200,4 +209,7 @@ def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
         staleness=jnp.asarray(staleness, jnp.float32),
         warmup=jnp.asarray(warmup, jnp.float32),
         degraded=jnp.asarray(degraded, jnp.float32),
+        compress_ratio=jnp.asarray(compress_ratio, jnp.float32),
+        residual_norm=jnp.asarray(residual_norm, jnp.float32),
+        wire_bytes=jnp.asarray(wire_bytes, jnp.float32),
     )

@@ -61,6 +61,8 @@ __all__ = [
     "plan_for",
     "flatten",
     "unflatten",
+    "flat_views",
+    "restore",
     "zero_buffers",
     "fused_tree_map",
 ]
@@ -290,6 +292,29 @@ def unflatten(plan: FusionPlan, bufs: Sequence[jax.Array]):
                                    axis=lead)
         leaves[slot.index] = seg.reshape(slot.shape)
     return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def flat_views(tree, *, fuse: bool = True,
+               max_bucket_bytes: Optional[int] = None, pad_to: int = 1):
+    """``(plan, bufs)``: the fused dtype buckets when ``fuse`` (plan is
+    the trace-time-cached one), else ``(None, leaves)`` — the single home
+    for "give me the tree as the flat buffers the exchange moves", shared
+    by the in-graph telemetry (``observability/ingraph.py``) and the
+    compressed exchange (``compress/exchange.py``).  Invert with
+    :func:`restore`."""
+    if fuse:
+        plan = plan_for(tree, max_bucket_bytes=max_bucket_bytes,
+                        pad_to=pad_to)
+        return plan, flatten(plan, tree)
+    return None, list(jax.tree.leaves(tree))
+
+
+def restore(plan: Optional[FusionPlan], tree, bufs):
+    """Inverse of :func:`flat_views`: buffers (possibly transformed
+    elementwise) back to ``tree``'s structure."""
+    if plan is not None:
+        return unflatten(plan, list(bufs))
+    return jax.tree.unflatten(jax.tree.structure(tree), list(bufs))
 
 
 def zero_buffers(plan: FusionPlan,

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import service as _service
+from ..compress import compressors as _compress
 from ..context import ctx
 from ..observability import metrics as _metrics
 from ..parallel.schedule import CompiledTopology
@@ -94,9 +95,34 @@ class _Window:
 
     def __init__(self, tensor, topo: CompiledTopology, zero_init: bool,
                  fuse: Optional[bool] = None,
-                 double_buffer: Optional[bool] = None):
+                 double_buffer: Optional[bool] = None,
+                 compression=None):
         cx = ctx()
         self.topo = topo
+        # wire compression for the one-sided TRANSFER ops (put / get /
+        # accumulate): the outgoing weighted value is encoded per
+        # leaf/bucket (compress/compressors.py) and decoded into the
+        # destination buffer — the buffers themselves stay full precision,
+        # and win_update's local fold is untouched.  QUANTIZERS ONLY
+        # (identity/int8/fp8): a window op has no carried residual slot,
+        # so (a) choco's two-sided recursion cannot run here and (b)
+        # sparsifiers would decode untransmitted coordinates as zeros
+        # with nothing re-injecting them — every win_update would then
+        # fold hard zeros into ~(1-F) of each buffer, silently decaying
+        # those parameters.  Quantizers are dense and near-exact, so
+        # deterministic round-to-nearest without error feedback is sound
+        # for bounded-staleness buffers (docs/compression.md).
+        self.compression = _compress.resolve_compression(compression)
+        if self.compression is not None and (
+                self.compression.choco
+                or self.compression.fraction is not None):
+            raise ValueError(
+                f"window ops support dense quantizing compression only "
+                f"('int8', 'fp8', 'identity'); got "
+                f"{self.compression.spec!r}: choco's recursion and the "
+                f"sparsifiers' untransmitted-as-zero decoding both need "
+                f"carried state a one-sided window op does not have — "
+                f"use the optimizer/strategy layer for those")
         # double buffering (BLUEFOG_WIN_DOUBLE_BUFFER, default on):
         # deferred nonblocking ops stage their result here (the BACK
         # buffer chain) and win_wait promotes it to the front.  Chained
@@ -262,7 +288,8 @@ def windows_exist() -> bool:
 
 def win_create(tensor, name: str, zero_init: bool = False,
                fuse: Optional[bool] = None,
-               double_buffer: Optional[bool] = None) -> bool:
+               double_buffer: Optional[bool] = None,
+               compression=None) -> bool:
     """Create a window: per-in-neighbor device buffers + versions + P
     (reference mpi_ops.py:998, mpi_controller.cc:793-866).
 
@@ -276,6 +303,13 @@ def win_create(tensor, name: str, zero_init: bool = False,
     nonblocking transfer ops stage their result in a BACK buffer and
     ``win_wait`` promotes it — ``win_update``/``win_fetch`` drain the
     front while an un-waited op's back buffer fills (docs/windows.md).
+
+    ``compression`` (default ``BLUEFOG_COMM_COMPRESS``, off): put / get /
+    accumulate encode their wire payload with the named compressor
+    (dense quantizers only — ``'int8'``, ``'fp8'``, ``'identity'``;
+    sparsifier and choco specs are rejected with guidance); the window
+    buffers and ``win_update``'s local fold stay full precision
+    (docs/compression.md).
 
     The topology is snapshotted at creation; like the reference
     (operations.cc:1286-1311), changing the topology while windows exist is
@@ -292,7 +326,8 @@ def win_create(tensor, name: str, zero_init: bool = False,
                 f"window tensors are global-view: expected leading dim "
                 f"{cx.size}, got {leaf.shape}")
     _windows[name] = _Window(tensor, topo, zero_init, fuse=fuse,
-                             double_buffer=double_buffer)
+                             double_buffer=double_buffer,
+                             compression=compression)
     return True
 
 
@@ -325,7 +360,7 @@ def _window(name: str) -> _Window:
 
 @functools.lru_cache(maxsize=128)
 def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int,
-             donate: bool = True):
+             donate: bool = True, compression=None):
     """win_put / win_accumulate kernel.
 
     Sends ``x * D[src, dst]`` into dst's buffer slot for src (replace or
@@ -336,12 +371,20 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int,
     ``x``/``buffers`` may be PYTREES — the whole tree moves in this one
     program (fusion-buffer equivalent; jit's cache keys on the tree
     structure, so arrays and trees coexist).
+
+    ``compression`` (a :class:`~..compress.CompressionConfig`, hashable —
+    part of this cache's key): the outgoing weighted value rides the wire
+    in its compressed encoding per leaf/bucket per offset and is decoded
+    into the destination buffer; the associated-P scalar always moves
+    uncompressed (it is one float).
     """
     cx = ctx()
     size = topo.size
     slots = _slot_tables(topo)
     from .collectives import _rotation_pairs
     spec = P(cx.rank_axis)
+    comp = (_compress.get_compressor(compression)
+            if compression is not None else None)
 
     def wrapper(x, buffers, versions, p, p_buffers, D, self_w, with_p):
         def shard_fn(xs, bufs, vers, ps, pbufs, D_, self_w_, with_p_):
@@ -354,11 +397,26 @@ def _push_fn(topo: CompiledTopology, accumulate: bool, mesh_id: int,
                 send_w = D_[ar, (ar + offset) % size][idx]
                 has_edge = (D_[(ar - offset) % size, ar] != 0)[idx]
                 slot = jnp.asarray(slots[k])[idx]
+                # static per-offset shared key (window ops carry no step
+                # index); only dense quantizers reach here and they run
+                # deterministic rounding (rank_key=None)
+                wkey = (jax.random.fold_in(
+                    jax.random.key(0x71D0), k) if comp is not None else None)
 
                 def leaf_exchange(x_r, buf):
-                    arrived = lax.ppermute(
-                        send_w.astype(x_r.dtype) * x_r, cx.rank_axis,
-                        _rotation_pairs(size, offset))
+                    send_val = send_w.astype(x_r.dtype) * x_r
+                    if comp is not None:
+                        wire = comp.compress(send_val, wkey, None)
+                        arrived_wire = jax.tree.map(
+                            lambda a: lax.ppermute(
+                                a, cx.rank_axis,
+                                _rotation_pairs(size, offset)), wire)
+                        arrived = comp.decompress(arrived_wire, wkey,
+                                                  x_r.shape, x_r.dtype)
+                    else:
+                        arrived = lax.ppermute(
+                            send_val, cx.rank_axis,
+                            _rotation_pairs(size, offset))
                     old = buf[slot]
                     new = arrived + old if accumulate else arrived
                     return buf.at[slot].set(
@@ -454,7 +512,8 @@ def _update_fn(topo: CompiledTopology, mesh_id: int):
 
 @functools.lru_cache(maxsize=128)
 def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
-                   self_scale: bool, mesh_id: int, donate: bool = True):
+                   self_scale: bool, mesh_id: int, donate: bool = True,
+                   compression=None):
     """Dynamic-schedule variant of :func:`_push_fn`: the step's mixing
     matrix is gathered ON DEVICE from the schedule tables by a traced step
     index, so per-step dynamic window ops (the push-sum paper's one-peer
@@ -466,7 +525,7 @@ def _push_sched_fn(topo: CompiledTopology, sched, accumulate: bool,
     exactly what ``compile_dynamic_schedule`` produces.  Gets keep the
     local tensor unscaled (``self_scale=False``).
     """
-    inner = _push_fn(topo, accumulate, mesh_id, donate)
+    inner = _push_fn(topo, accumulate, mesh_id, donate, compression)
     mats = jnp.asarray(sched.matrices, jnp.float32)        # [T, N, N]
     eye = jnp.eye(topo.size, dtype=jnp.float32)
 
@@ -601,7 +660,7 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
                 "sched= carries the self weights (diag of the step matrix); "
                 "self_weight= cannot also be given")
         fn = _push_sched_fn(w.topo, sched, accumulate, True, id(cx.mesh),
-                            not w.double_buffer)
+                            not w.double_buffer, w.compression)
 
         def run():
             x = _win_input(tensor, w)
@@ -615,7 +674,8 @@ def _push_like_nonblocking(tensor, name: str, self_weight, dst_weights,
 
     D = _out_matrix(w.topo, dst_weights)
     sw = _self_weight_vector(w.topo.size, self_weight)
-    fn = _push_fn(w.topo, accumulate, id(cx.mesh), not w.double_buffer)
+    fn = _push_fn(w.topo, accumulate, id(cx.mesh), not w.double_buffer,
+                  w.compression)
 
     def run():
         x = _win_input(tensor, w)
@@ -691,7 +751,7 @@ def win_get_nonblocking(name: str,
     if sched is not None:
         _check_sched(w, sched, step, src_weights, "src_weights")
         fn = _push_sched_fn(w.topo, sched, False, False, id(cx.mesh),
-                            not w.double_buffer)
+                            not w.double_buffer, w.compression)
 
         def run():
             t0, bufs, vers, p, pbufs = w.staged()
@@ -701,7 +761,8 @@ def win_get_nonblocking(name: str,
                                 op_name="win_get", commit_name=name)
 
     G = _out_matrix(w.topo, src_weights)
-    fn = _push_fn(w.topo, False, id(cx.mesh), not w.double_buffer)
+    fn = _push_fn(w.topo, False, id(cx.mesh), not w.double_buffer,
+                  w.compression)
 
     def run():
         t0, bufs, vers, p, pbufs = w.staged()

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ..compress import compressors as CP
+from ..compress import exchange as CX
 from ..observability import ingraph as IG
 from ..ops import api as _api
 from ..ops import collectives as C
@@ -54,7 +56,9 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  machine_topo: Optional[CompiledTopology] = None,
                  nar_backend: Optional[str] = None,
                  fuse: Optional[bool] = None,
-                 fusion_bucket_bytes: Optional[int] = None):
+                 fusion_bucket_bytes: Optional[int] = None,
+                 compression: Optional[CP.CompressionConfig] = None,
+                 comp_state=None):
     """Apply the configured averaging to ``params``.
 
     ``nar_backend``: exchange backend SNAPSHOT.  Builders capture it when
@@ -68,7 +72,27 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     versus the per-leaf path (the averaging is elementwise-linear and
     buckets never mix dtypes); ``fusion_bucket_bytes`` caps bucket size
     for chunking/overlap.  Builders snapshot both like ``nar_backend``.
+
+    ``compression`` (a resolved :class:`~..compress.CompressionConfig`):
+    route the exchange through the compressed wire
+    (``compress/exchange.py``) — the call then returns ``(averaged,
+    new_comp_state, diag)`` instead of the bare tree, with ``comp_state``
+    the carried residual/estimate buffers.  ``None`` takes EXACTLY the
+    pre-compression path (byte-identical StableHLO, asserted by
+    ``tests/test_compress.py``).  The compressed path runs its own
+    ppermute loop, so ``nar_backend`` (the pallas kernels) does not apply
+    to it.
     """
+    if compression is not None:
+        if comm_type == CommunicationType.empty:
+            return params, comp_state, _null_comp_diag()
+        mode = ("allreduce" if comm_type == CommunicationType.allreduce
+                else "neighbor")
+        return CX.compressed_mix(
+            params, comp_state, compression, mode=mode,
+            axis_name=axis_name, topo=topo, sched=sched, step=step,
+            fuse=F.fusion_enabled(fuse),
+            bucket_bytes=fusion_bucket_bytes)
     if comm_type == CommunicationType.empty:
         return params
     do_fuse = F.fusion_enabled(fuse)
@@ -117,6 +141,40 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     return jax.tree.map(fn, params)
 
 
+def _null_comp_diag():
+    """Diag for a compressed build whose step moved nothing (empty comm)."""
+    return {"residual_norm": jnp.float32(0.0), "wire_bytes": 0.0,
+            "ratio": 1.0}
+
+
+def _communicate_c(params, comm_type, axis_name, topo, sched, step,
+                   machine_axes, machine_topo, nar_backend, fuse,
+                   fusion_bucket_bytes, cfg, comp_state):
+    """:func:`_communicate` with a UNIFORM ``(tree, comp_state', diag)``
+    return, so the strategy bodies need no per-site branching: ``cfg is
+    None`` takes the exact uncompressed path (byte-identical StableHLO)
+    and reports ``(tree, None, None)``."""
+    if cfg is None:
+        tree = _communicate(params, comm_type, axis_name, topo, sched,
+                            step, machine_axes, machine_topo, nar_backend,
+                            fuse, fusion_bucket_bytes)
+        return tree, None, None
+    return _communicate(params, comm_type, axis_name, topo, sched, step,
+                        machine_axes, machine_topo, nar_backend, fuse,
+                        fusion_bucket_bytes, cfg, comp_state)
+
+
+def _comp_snap_kwargs(diag):
+    """Compression fields for :func:`~..observability.ingraph.
+    strategy_snapshot` from a compressed exchange's diag (``None`` =
+    compression off: ratio 1, nothing carried, wire bytes unmeasured)."""
+    if diag is None:
+        return {}
+    return dict(compress_ratio=diag["ratio"],
+                residual_norm=diag["residual_norm"],
+                wire_bytes=diag["wire_bytes"])
+
+
 def _telemetry_axis(comm_type: CommunicationType, axis_name, machine_axes):
     """Axis (or axes) the telemetry pmean runs over: the flat rank axis,
     or both mesh axes under the hierarchical 2-D plumbing."""
@@ -130,7 +188,8 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
                             accumulate_steps: int = 1,
                             fuse: Optional[bool] = None,
                             fusion_bucket_bytes: Optional[int] = None,
-                            telemetry: bool = False):
+                            telemetry: bool = False,
+                            compression=None):
     """Horovod-style synchronous data parallelism
     (reference _DistributedOptimizer, optimizers.py:166-294).
 
@@ -150,31 +209,45 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
     (~0 for lockstep gradient averaging; drift means divergence), norms,
     and identity mix mass.  Off (the default) leaves the traced program
     untouched — bit-identical StableHLO, asserted by test.
+
+    ``compression`` (spec/config, ``compress/``): compress the GRADIENT
+    average's wire (error-feedback EF-SGD) — lossy configs add a
+    ``"compress"`` key to the state (see :func:`grad_accum_init`).
     """
     do_fuse = F.fusion_enabled(fuse)
+    cfg = CP.resolve_compression(compression)
+    if cfg is not None:
+        CX.check_supported(cfg, comm_value="allreduce")
+    comp_stateful = CX.stateful(cfg)
 
-    def _avg(tree):
-        f = lambda x: C.allreduce(x, axis_name, average=True)
-        if do_fuse:
-            return F.fused_tree_map(f, tree,
-                                    max_bucket_bytes=fusion_bucket_bytes)
-        return jax.tree.map(f, tree)
+    def _avg(tree, cs, step):
+        # rides the shared plumbing: _communicate's allreduce branch is
+        # the exact pre-compression fused/per-leaf gradient average
+        return _communicate_c(
+            tree, CommunicationType.allreduce, axis_name, None, None,
+            step, None, None, None, do_fuse, fusion_bucket_bytes, cfg, cs)
 
-    def _snap(step, p_new, p_old, grads):
+    def _snap(step, p_new, p_old, grads, diag):
         return IG.strategy_snapshot(
             step=step, new_params=p_new, old_params=p_old, grads=grads,
             axis_name=axis_name, col_sum=1.0, row_sum=1.0, fuse=do_fuse,
-            bucket_bytes=fusion_bucket_bytes)
+            bucket_bytes=fusion_bucket_bytes, **_comp_snap_kwargs(diag))
 
     if accumulate_steps <= 1:
         def step_fn(params, grads, opt_state, step=0):
-            g = _avg(grads)
-            updates, opt_state = base.update(g, opt_state, params)
+            if comp_stateful:
+                bs, cs = opt_state["base"], opt_state["compress"]
+            else:
+                bs, cs = opt_state, None
+            g, cs_new, diag = _avg(grads, cs, step)
+            updates, bs_new = base.update(g, bs, params)
             new_params = optax.apply_updates(params, updates)
+            out_state = ({"base": bs_new, "compress": cs_new}
+                         if comp_stateful else bs_new)
             if telemetry:
-                return new_params, opt_state, _snap(step, new_params,
-                                                    params, grads)
-            return new_params, opt_state
+                return new_params, out_state, _snap(step, new_params,
+                                                    params, grads, diag)
+            return new_params, out_state
         return step_fn
 
     k = int(accumulate_steps)
@@ -182,22 +255,37 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
     def step_fn(params, grads, opt_state, step=0):
         accum = jax.tree.map(jnp.add, opt_state["accum"], grads)
         do_comm = (jnp.asarray(step) % k) == (k - 1)
+        cs = opt_state["compress"] if comp_stateful else None
 
         def comm_branch(p, acc, bs):
-            g = _avg(jax.tree.map(lambda x: x / k, acc))
+            g, cs_new, diag = _avg(jax.tree.map(lambda x: x / k, acc),
+                                   cs, step)
             updates, bs_new = base.update(g, bs, p)
             p_new = optax.apply_updates(p, updates)
-            return p_new, jax.tree.map(jnp.zeros_like, acc), bs_new
+            return (p_new, jax.tree.map(jnp.zeros_like, acc), bs_new,
+                    cs_new, diag)
 
         def local_branch(p, acc, bs):
-            return p, acc, bs
+            # residuals persist across accumulate-only steps: EF error is
+            # re-injected at the NEXT transmission, not discarded
+            return p, acc, bs, cs
+
+        def pack(p_new, acc_new, bs_new, cs_new):
+            st = {"base": bs_new, "accum": acc_new}
+            if comp_stateful:
+                st["compress"] = cs_new
+            return p_new, st
 
         if telemetry:
             # both cond branches must carry the snapshot; the local branch
             # issues no collective and reports consensus as UNMEASURED
             def comm_branch_t(p, acc, bs):
-                p_new, acc_new, bs_new = comm_branch(p, acc, bs)
-                return p_new, acc_new, bs_new, _snap(step, p_new, p, grads)
+                p_new, acc_new, bs_new, cs_new, diag = comm_branch(
+                    p, acc, bs)
+                # diag is consumed INSIDE the branch (its static fields
+                # cannot cross the cond boundary)
+                return (p_new, acc_new, bs_new, cs_new,
+                        _snap(step, p_new, p, grads, diag))
 
             def local_branch_t(p, acc, bs):
                 snap = IG.strategy_snapshot(
@@ -205,32 +293,63 @@ def gradient_allreduce_step(base: optax.GradientTransformation, axis_name,
                     axis_name=axis_name, col_sum=1.0, row_sum=1.0,
                     fuse=do_fuse, bucket_bytes=fusion_bucket_bytes,
                     measure_consensus=False)
-                return p, acc, bs, snap
+                return p, acc, bs, cs, snap
 
-            p_new, accum_new, base_new, snap = jax.lax.cond(
+            p_new, accum_new, base_new, cs_new, snap = jax.lax.cond(
                 do_comm, comm_branch_t, local_branch_t, params, accum,
                 opt_state["base"])
-            return p_new, {"base": base_new, "accum": accum_new}, snap
+            out = pack(p_new, accum_new, base_new, cs_new)
+            return out[0], out[1], snap
 
-        p_new, accum_new, base_new = jax.lax.cond(
-            do_comm, comm_branch, local_branch, params, accum,
-            opt_state["base"])
-        return p_new, {"base": base_new, "accum": accum_new}
+        p_new, accum_new, base_new, cs_new = jax.lax.cond(
+            do_comm, lambda p, a, b: comm_branch(p, a, b)[:4],
+            local_branch, params, accum, opt_state["base"])
+        return pack(p_new, accum_new, base_new, cs_new)
 
     return step_fn
 
 
-def grad_accum_init(base: optax.GradientTransformation, params):
-    """Per-rank init for the accumulating gradient-allreduce state."""
+def compression_state(compression, params, fuse=None,
+                      fusion_bucket_bytes=None):
+    """Per-rank compression state for a resolved config (or spec), or
+    ``None`` when stateless — the single init used by every strategy's
+    state builder.  Must see the SAME ``fuse``/``fusion_bucket_bytes`` the
+    step builder resolves (the carried-buffer layout is part of the state
+    structure, exactly like :func:`delayed_init`)."""
+    cfg = CP.resolve_compression(compression)
+    return CX.init_state(cfg, params, fuse=F.fusion_enabled(fuse),
+                         bucket_bytes=fusion_bucket_bytes)
+
+
+def compress_wrap_init(base: optax.GradientTransformation, params,
+                       compression, fuse=None, fusion_bucket_bytes=None):
+    """Per-rank init for the consensus/CTA/ATC family under STATEFUL
+    compression: ``{"base": ..., "compress": ...}`` (the plain family
+    keeps the raw base state when compression is off or lossless)."""
     return {"base": base.init(params),
-            "accum": jax.tree.map(jnp.zeros_like, params)}
+            "compress": compression_state(compression, params, fuse,
+                                          fusion_bucket_bytes)}
+
+
+def grad_accum_init(base: optax.GradientTransformation, params,
+                    compression=None, fuse=None, fusion_bucket_bytes=None):
+    """Per-rank init for the accumulating gradient-allreduce state
+    (plus the EF residual buffers when ``compression`` is stateful)."""
+    st = {"base": base.init(params),
+          "accum": jax.tree.map(jnp.zeros_like, params)}
+    cfg = CP.resolve_compression(compression)
+    if CX.stateful(cfg):
+        st["compress"] = compression_state(cfg, params, fuse,
+                                           fusion_bucket_bytes)
+    return st
 
 
 def consensus_step(base: optax.GradientTransformation,
                    comm_type: CommunicationType, axis_name,
                    topo=None, sched=None, machine_axes=None,
                    machine_topo=None, nar_backend=None, fuse=None,
-                   fusion_bucket_bytes=None, telemetry: bool = False):
+                   fusion_bucket_bytes=None, telemetry: bool = False,
+                   compression=None):
     """Consensus/CTA/AWC family (reference _DistributedReduceOptimizer,
     optimizers.py:297-482): average the *weights*, apply the local update
     computed from gradients at the pre-average point.  Only the exchange
@@ -240,16 +359,31 @@ def consensus_step(base: optax.GradientTransformation,
     ``TelemetrySnapshot`` — consensus distance over the post-update
     weights (one pmean per fusion bucket), the step's mixing-matrix
     column/row mass at this rank, and the norm trio.  ``False`` (default)
-    is the exact pre-telemetry trace (bit-identical StableHLO)."""
+    is the exact pre-telemetry trace (bit-identical StableHLO).
+
+    ``compression`` (spec string or config, ``compress/``): compress the
+    exchange wire.  Stateful configs (lossy / choco) change the state
+    layout to ``{"base": ..., "compress": ...}`` — create it with
+    :func:`compress_wrap_init`."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
-        averaged = _communicate(params, comm_type, axis_name, topo, sched,
-                                step, machine_axes, machine_topo,
-                                nar_backend, fuse, fusion_bucket_bytes)
-        updates, opt_state = base.update(grads, opt_state, averaged)
+        if comp_stateful:
+            st, cs = opt_state["base"], opt_state["compress"]
+        else:
+            st, cs = opt_state, None
+        averaged, cs_new, diag = _communicate_c(
+            params, comm_type, axis_name, topo, sched, step,
+            machine_axes, machine_topo, nar_backend, fuse,
+            fusion_bucket_bytes, cfg, cs)
+        updates, st_new = base.update(grads, st, averaged)
         new_params = optax.apply_updates(averaged, updates)
+        out_state = ({"base": st_new, "compress": cs_new}
+                     if comp_stateful else st_new)
         if telemetry:
             col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
                                    machine_axes, machine_topo)
@@ -259,9 +393,9 @@ def consensus_step(base: optax.GradientTransformation,
                 axis_name=_telemetry_axis(comm_type, axis_name,
                                           machine_axes),
                 col_sum=col, row_sum=row, fuse=fuse,
-                bucket_bytes=fusion_bucket_bytes)
-            return new_params, opt_state, snap
-        return new_params, opt_state
+                bucket_bytes=fusion_bucket_bytes, **_comp_snap_kwargs(diag))
+            return new_params, out_state, snap
+        return new_params, out_state
 
     return step_fn
 
@@ -270,23 +404,34 @@ def atc_step(base: optax.GradientTransformation,
              comm_type: CommunicationType, axis_name,
              topo=None, sched=None, machine_axes=None, machine_topo=None,
              nar_backend=None, fuse=None, fusion_bucket_bytes=None,
-             telemetry: bool = False):
+             telemetry: bool = False, compression=None):
     """Adapt-then-combine (reference _DistributedAdaptThenCombineOptimizer,
     optimizers.py:485-841): local update first, then average the updated
     weights.  The reference re-implements each torch optimizer's math inside
     the gradient hook; with optax the base transformation is already a pure
     function, so ATC is just the other composition order.  Only the
     exchange is fused (``fuse``); the optimizer state stays per-leaf.
-    ``telemetry`` as in :func:`consensus_step`."""
+    ``telemetry`` as in :func:`consensus_step`; ``compression`` as in
+    :func:`consensus_step` (the ADAPTED iterate's wire is compressed)."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
-        updates, opt_state = base.update(grads, opt_state, params)
+        if comp_stateful:
+            st, cs = opt_state["base"], opt_state["compress"]
+        else:
+            st, cs = opt_state, None
+        updates, st_new = base.update(grads, st, params)
         adapted = optax.apply_updates(params, updates)
-        combined = _communicate(adapted, comm_type, axis_name, topo, sched,
-                                step, machine_axes, machine_topo,
-                                nar_backend, fuse, fusion_bucket_bytes)
+        combined, cs_new, diag = _communicate_c(
+            adapted, comm_type, axis_name, topo, sched, step,
+            machine_axes, machine_topo, nar_backend, fuse,
+            fusion_bucket_bytes, cfg, cs)
+        out_state = ({"base": st_new, "compress": cs_new}
+                     if comp_stateful else st_new)
         if telemetry:
             col, row = IG.mix_mass(comm_type, axis_name, topo, sched, step,
                                    machine_axes, machine_topo)
@@ -296,9 +441,9 @@ def atc_step(base: optax.GradientTransformation,
                 axis_name=_telemetry_axis(comm_type, axis_name,
                                           machine_axes),
                 col_sum=col, row_sum=row, fuse=fuse,
-                bucket_bytes=fusion_bucket_bytes)
-            return combined, opt_state, snap
-        return combined, opt_state
+                bucket_bytes=fusion_bucket_bytes, **_comp_snap_kwargs(diag))
+            return combined, out_state, snap
+        return combined, out_state
 
     return step_fn
 
@@ -307,7 +452,8 @@ def exact_diffusion_step(base: optax.GradientTransformation,
                          comm_type: CommunicationType, axis_name,
                          topo=None, sched=None, machine_axes=None,
                          machine_topo=None, nar_backend=None, fuse=None,
-                         fusion_bucket_bytes=None, telemetry: bool = False):
+                         fusion_bucket_bytes=None, telemetry: bool = False,
+                         compression=None):
     """Exact-Diffusion (a.k.a. D2): the bias-corrected diffusion recursion
     from the reference authors' own line of work (Yuan/Ying et al.; no
     reference-code counterpart — a beyond-parity strategy):
@@ -324,19 +470,28 @@ def exact_diffusion_step(base: optax.GradientTransformation,
     tests/test_optimizers.py::test_exact_diffusion_removes_diffusion_bias).
     State: ``{"base": ..., "psi_prev": ...}`` (psi_prev starts at x_0, so
     the first step reduces to plain ATC — the standard initialization).
-    Only the phi exchange is fused (``fuse``); psi_prev stays per-leaf."""
+    Only the phi exchange is fused (``fuse``); psi_prev stays per-leaf.
+    ``compression`` compresses the PHI exchange (stateful configs add a
+    ``"compress"`` key; :func:`exact_diffusion_init` carries it)."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, base_new = base.update(grads, opt_state["base"], params)
         psi = optax.apply_updates(params, updates)
         phi = jax.tree.map(lambda s, x, sp: s + x - sp,
                            psi, params, opt_state["psi_prev"])
-        combined = _communicate(phi, comm_type, axis_name, topo, sched,
-                                step, machine_axes, machine_topo,
-                                nar_backend, fuse, fusion_bucket_bytes)
+        combined, cs_new, diag = _communicate_c(
+            phi, comm_type, axis_name, topo, sched, step,
+            machine_axes, machine_topo, nar_backend, fuse,
+            fusion_bucket_bytes, cfg,
+            opt_state["compress"] if comp_stateful else None)
         state_new = {"base": base_new, "psi_prev": psi}
+        if comp_stateful:
+            state_new["compress"] = cs_new
         if telemetry:
             # the mixed topology is the DAMPED (I+W)/2 matrix the caller
             # validated/compiled (exact_diffusion_topology) — its mass
@@ -349,7 +504,7 @@ def exact_diffusion_step(base: optax.GradientTransformation,
                 axis_name=_telemetry_axis(comm_type, axis_name,
                                           machine_axes),
                 col_sum=col, row_sum=row, fuse=fuse,
-                bucket_bytes=fusion_bucket_bytes)
+                bucket_bytes=fusion_bucket_bytes, **_comp_snap_kwargs(diag))
             return combined, state_new, snap
         return combined, state_new
 
@@ -388,12 +543,20 @@ def exact_diffusion_topology(compiled_topo):
     return compile_weight_matrix((_np.eye(n) + W) / 2.0)
 
 
-def exact_diffusion_init(base: optax.GradientTransformation, params):
+def exact_diffusion_init(base: optax.GradientTransformation, params,
+                         compression=None, fuse=None,
+                         fusion_bucket_bytes=None):
     """Per-rank init for exact-diffusion: psi_prev = x_0 as a COPY —
     aliasing the live parameter buffers would double-donate them on the
-    first step under ``jax.jit(..., donate_argnums=...)``."""
-    return {"base": base.init(params),
-            "psi_prev": jax.tree.map(jnp.array, params)}
+    first step under ``jax.jit(..., donate_argnums=...)``.  Stateful
+    ``compression`` adds the carried residual/estimate buffers."""
+    st = {"base": base.init(params),
+          "psi_prev": jax.tree.map(jnp.array, params)}
+    cfg = CP.resolve_compression(compression)
+    if CX.stateful(cfg):
+        st["compress"] = compression_state(cfg, params, fuse,
+                                           fusion_bucket_bytes)
+    return st
 
 
 # ---------------------------------------------------------------------------
@@ -498,16 +661,27 @@ def _inflight_unpack(bufs, template, fuse: bool,
 
 def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
                     machine_axes, machine_topo, nar_backend,
-                    fuse, bucket_bytes):
+                    fuse, bucket_bytes, compression=None, comp_state=None):
     """Run the exchange on ``x`` and return the in-flight state the NEXT
-    step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t."""
-    full = _communicate(x, comm_type, axis_name, topo, sched, step,
-                        machine_axes, machine_topo, nar_backend, fuse,
-                        bucket_bytes)
+    step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t.
+
+    With ``compression`` the launch's WIRE is compressed (direct mode
+    only; choco is rejected at build time) — the carried in-flight buffers
+    hold the already-DECOMPRESSED neighbor part, and the error-feedback
+    residual rides the opt state next to them, double-buffered by the
+    same donation discipline.  Returns ``(inflight, comp_state', diag)``
+    then."""
+    full, cs_new, diag = _communicate_c(
+        x, comm_type, axis_name, topo, sched, step, machine_axes,
+        machine_topo, nar_backend, fuse, bucket_bytes, compression,
+        comp_state)
     d = _mix_self_weight(comm_type, axis_name, topo, sched, step)
     neigh = jax.tree.map(lambda f, l: f - d.astype(l.dtype) * l, full, x)
-    return {"bufs": _inflight_pack(neigh, fuse, bucket_bytes),
+    infl = {"bufs": _inflight_pack(neigh, fuse, bucket_bytes),
             "self_w": d}
+    if compression is not None:
+        return infl, cs_new, diag
+    return infl
 
 
 def _delayed_fold(x, inflight, fuse: bool, bucket_bytes: Optional[int]):
@@ -521,12 +695,15 @@ def _delayed_fold(x, inflight, fuse: bool, bucket_bytes: Optional[int]):
 def delayed_init(base: optax.GradientTransformation, params,
                  fuse: Optional[bool] = None,
                  fusion_bucket_bytes: Optional[int] = None,
-                 exact_diffusion: bool = False):
+                 exact_diffusion: bool = False,
+                 compression=None):
     """Per-rank init for the overlapped strategies: base state plus the
     warmup in-flight state (zero buffers, self weight 1 — step 0 folds
     nothing and is a pure local step).  ``fuse``/``fusion_bucket_bytes``
     must resolve to the SAME values the step builder will use: the
-    carried-buffer layout is part of the state structure."""
+    carried-buffer layout is part of the state structure.  Stateful
+    ``compression`` adds the error-feedback residual buffers next to the
+    in-flight exchange buffers (same donation discipline)."""
     fuse = F.fusion_enabled(fuse)
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
     if fuse:
@@ -539,12 +716,15 @@ def delayed_init(base: optax.GradientTransformation, params,
         # copy, not alias, for the same donation reason as
         # exact_diffusion_init
         state["psi_prev"] = jax.tree.map(jnp.array, params)
+    cfg = CP.resolve_compression(compression)
+    if CX.stateful(cfg):
+        state["compress"] = compression_state(cfg, params, fuse, bucket)
     return state
 
 
 def _delayed_snapshot(comm_type, axis_name, topo, sched, step, machine_axes,
                       machine_topo, fuse, bucket, *, new_params, old_params,
-                      grads, inflight_prev):
+                      grads, inflight_prev, diag=None):
     """Snapshot for the overlapped family: staleness 1, warmup derived
     from the folded in-flight state (self weight 1 <=> zero buffer — the
     step-0 / post-reset warmup fold), mix mass of the CURRENT launch."""
@@ -556,14 +736,15 @@ def _delayed_snapshot(comm_type, axis_name, topo, sched, step, machine_axes,
         grads=grads,
         axis_name=_telemetry_axis(comm_type, axis_name, machine_axes),
         col_sum=col, row_sum=row, fuse=fuse, bucket_bytes=bucket,
-        staleness=1.0, warmup=warmup)
+        staleness=1.0, warmup=warmup, **_comp_snap_kwargs(diag))
 
 
 def delayed_consensus_step(base: optax.GradientTransformation,
                            comm_type: CommunicationType, axis_name,
                            topo=None, sched=None, machine_axes=None,
                            machine_topo=None, nar_backend=None, fuse=None,
-                           fusion_bucket_bytes=None, telemetry: bool = False):
+                           fusion_bucket_bytes=None, telemetry: bool = False,
+                           compression=None):
     """Overlapped consensus/CTA/AWC: fold the previous step's mix, adapt at
     the folded point (gradients at the pre-fold parameters, matching
     :func:`consensus_step`'s composition), and launch this step's exchange
@@ -574,26 +755,39 @@ def delayed_consensus_step(base: optax.GradientTransformation,
     Recurrence (after the step-0 warmup):
     ``x_{t+1} = adapt(d_{t-1} x_t + N_{t-1}(x_{t-1}), g(x_t))``.
     State: ``{"base": ..., "inflight": {"bufs", "self_w"}}`` —
-    create it with :func:`delayed_init` using the same fusion knobs."""
+    create it with :func:`delayed_init` using the same fusion knobs.
+    ``compression`` (direct specs only): the launch's wire is compressed;
+    the carried buffers hold the decompressed neighbor part and the EF
+    residual rides the state (``delayed_init(compression=...)``)."""
     _check_overlap_comm(comm_type, sched)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, sched=sched,
+                       overlap=True)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
         mixed = _delayed_fold(params, opt_state["inflight"], fuse, bucket)
         updates, base_new = base.update(grads, opt_state["base"], mixed)
         new_params = optax.apply_updates(mixed, updates)
-        infl_new = _delayed_launch(params, comm_type, axis_name, topo,
-                                   sched, step, machine_axes, machine_topo,
-                                   nar_backend, fuse, bucket)
+        launch = _delayed_launch(params, comm_type, axis_name, topo,
+                                 sched, step, machine_axes, machine_topo,
+                                 nar_backend, fuse, bucket, cfg,
+                                 opt_state.get("compress")
+                                 if comp_stateful else None)
+        infl_new, cs_new, diag = (launch if cfg is not None
+                                  else (launch, None, None))
         state_new = {"base": base_new, "inflight": infl_new}
+        if comp_stateful:
+            state_new["compress"] = cs_new
         if telemetry:
             snap = _delayed_snapshot(
                 comm_type, axis_name, topo, sched, step, machine_axes,
                 machine_topo, fuse, bucket, new_params=new_params,
                 old_params=params, grads=grads,
-                inflight_prev=opt_state["inflight"])
+                inflight_prev=opt_state["inflight"], diag=diag)
             return new_params, state_new, snap
         return new_params, state_new
 
@@ -604,7 +798,8 @@ def delayed_atc_step(base: optax.GradientTransformation,
                      comm_type: CommunicationType, axis_name,
                      topo=None, sched=None, machine_axes=None,
                      machine_topo=None, nar_backend=None, fuse=None,
-                     fusion_bucket_bytes=None, telemetry: bool = False):
+                     fusion_bucket_bytes=None, telemetry: bool = False,
+                     compression=None):
     """Overlapped adapt-then-combine: local adapt, fold the PREVIOUS
     adapted iterate's exchange, launch this one's.  The launch value is
     the adapted iterate, so the collective sits at the program tail; the
@@ -612,27 +807,38 @@ def delayed_atc_step(base: optax.GradientTransformation,
     result never blocks a step's critical path.
 
     Recurrence (after the step-0 warmup): ``z_t = adapt(x_t, g(x_t));
-    x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})``."""
+    x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})``.  ``compression`` as in
+    :func:`delayed_consensus_step` (the adapted iterate's wire)."""
     _check_overlap_comm(comm_type, sched)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, sched=sched,
+                       overlap=True)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, base_new = base.update(grads, opt_state["base"], params)
         adapted = optax.apply_updates(params, updates)
         combined = _delayed_fold(adapted, opt_state["inflight"], fuse,
                                  bucket)
-        infl_new = _delayed_launch(adapted, comm_type, axis_name, topo,
-                                   sched, step, machine_axes, machine_topo,
-                                   nar_backend, fuse, bucket)
+        launch = _delayed_launch(adapted, comm_type, axis_name, topo,
+                                 sched, step, machine_axes, machine_topo,
+                                 nar_backend, fuse, bucket, cfg,
+                                 opt_state.get("compress")
+                                 if comp_stateful else None)
+        infl_new, cs_new, diag = (launch if cfg is not None
+                                  else (launch, None, None))
         state_new = {"base": base_new, "inflight": infl_new}
+        if comp_stateful:
+            state_new["compress"] = cs_new
         if telemetry:
             snap = _delayed_snapshot(
                 comm_type, axis_name, topo, sched, step, machine_axes,
                 machine_topo, fuse, bucket, new_params=combined,
                 old_params=params, grads=grads,
-                inflight_prev=opt_state["inflight"])
+                inflight_prev=opt_state["inflight"], diag=diag)
             return combined, state_new, snap
         return combined, state_new
 
@@ -644,7 +850,8 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
                                  topo=None, machine_axes=None,
                                  machine_topo=None, nar_backend=None,
                                  fuse=None, fusion_bucket_bytes=None,
-                                 telemetry: bool = False):
+                                 telemetry: bool = False,
+                                 compression=None):
     """Overlapped exact-diffusion (the gradient-tracking-family member):
     the psi/phi bias correction runs exactly as in
     :func:`exact_diffusion_step`, but the combine of phi is the delayed
@@ -653,11 +860,15 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
     :func:`exact_diffusion_topology` first).  Warmup: step 0 reduces to
     the plain local adapt (phi_0 folds against the zero buffer).
     State adds ``psi_prev`` (:func:`delayed_init` with
-    ``exact_diffusion=True``)."""
+    ``exact_diffusion=True``).  ``compression`` as in
+    :func:`delayed_consensus_step` (the phi iterate's wire)."""
     _check_overlap_comm(comm_type, None)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
+    cfg = CP.resolve_compression(compression)
+    CX.check_supported(cfg, comm_value=comm_type.value, overlap=True)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
         updates, base_new = base.update(grads, opt_state["base"], params)
@@ -665,17 +876,23 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
         phi = jax.tree.map(lambda s, x, sp: s + x - sp,
                            psi, params, opt_state["psi_prev"])
         combined = _delayed_fold(phi, opt_state["inflight"], fuse, bucket)
-        infl_new = _delayed_launch(phi, comm_type, axis_name, topo,
-                                   None, step, machine_axes, machine_topo,
-                                   nar_backend, fuse, bucket)
+        launch = _delayed_launch(phi, comm_type, axis_name, topo,
+                                 None, step, machine_axes, machine_topo,
+                                 nar_backend, fuse, bucket, cfg,
+                                 opt_state.get("compress")
+                                 if comp_stateful else None)
+        infl_new, cs_new, diag = (launch if cfg is not None
+                                  else (launch, None, None))
         state_new = {"base": base_new, "psi_prev": psi,
                      "inflight": infl_new}
+        if comp_stateful:
+            state_new["compress"] = cs_new
         if telemetry:
             snap = _delayed_snapshot(
                 comm_type, axis_name, topo, None, step, machine_axes,
                 machine_topo, fuse, bucket, new_params=combined,
                 old_params=params, grads=grads,
-                inflight_prev=opt_state["inflight"])
+                inflight_prev=opt_state["inflight"], diag=diag)
             return combined, state_new, snap
         return combined, state_new
 
@@ -708,6 +925,11 @@ def delayed_local_step(base: optax.GradientTransformation,
             # restart the correction at the new local point (plain-ATC
             # restart): the old psi_prev belongs to the abandoned pipeline
             out["psi_prev"] = new_params
+        if "compress" in opt_state:
+            # same reasoning as the pipeline reset: residuals/replica
+            # estimates accumulated against the distrusted topology must
+            # not be re-injected after recovery (compress/exchange.py)
+            out["compress"] = CX.reset_state(opt_state["compress"])
         if telemetry:
             # degraded pipeline-reset branch: NO collective may be issued
             # (the topology is distrusted), so consensus is UNMEASURED;
@@ -747,7 +969,7 @@ def with_local_steps(step_fn: Callable, local_step_fn: Callable,
 def local_sgd_like_step(base: optax.GradientTransformation,
                         telemetry: bool = False, axis_name=None,
                         fuse=None, fusion_bucket_bytes=None,
-                        degraded: bool = False):
+                        degraded: bool = False, compression=None):
     """The no-communication branch: plain local update.
 
     ``telemetry``: return the snapshot too (both ``lax.cond`` branches of
@@ -757,12 +979,30 @@ def local_sgd_like_step(base: optax.GradientTransformation,
     and the ``degraded`` field is set; the default (routine local steps of
     a ``num_steps_per_communication`` schedule) measures consensus over
     ``axis_name`` — drift between exchanges is exactly what local-step
-    schedules need to watch."""
+    schedules need to watch.
+
+    ``compression``: pass the SAME config the comm branch uses so the
+    cond structures match — the local branch carries the
+    residual/estimate state through unchanged (EF errors are re-injected
+    at the next exchange) except under ``degraded=True``, where it RESETS
+    them: the repaired column falls back to self weight and stale
+    residuals must not ride into the recovered topology."""
     do_fuse = F.fusion_enabled(fuse)
+    cfg = CP.resolve_compression(compression)
+    comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
-        updates, opt_state = base.update(grads, opt_state, params)
+        if comp_stateful:
+            st, cs = opt_state["base"], opt_state["compress"]
+        else:
+            st, cs = opt_state, None
+        updates, st_new = base.update(grads, st, params)
         new_params = optax.apply_updates(params, updates)
+        if comp_stateful:
+            out_state = {"base": st_new,
+                         "compress": CX.reset_state(cs) if degraded else cs}
+        else:
+            out_state = st_new
         if telemetry:
             measure = (axis_name is not None) and not degraded
             snap = IG.strategy_snapshot(
@@ -771,8 +1011,8 @@ def local_sgd_like_step(base: optax.GradientTransformation,
                 fuse=do_fuse, bucket_bytes=fusion_bucket_bytes,
                 degraded=1.0 if degraded else 0.0,
                 measure_consensus=measure)
-            return new_params, opt_state, snap
-        return new_params, opt_state
+            return new_params, out_state, snap
+        return new_params, out_state
 
     return step_fn
 

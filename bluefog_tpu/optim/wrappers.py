@@ -29,6 +29,8 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from ..compress import compressors as _cp
+from ..compress import exchange as _cx
 from ..context import ctx
 from ..observability import ingraph as IG
 from ..ops import api as _api
@@ -69,12 +71,24 @@ class _JittedStrategyOptimizer:
                  fuse: Optional[bool] = None,
                  fusion_bucket_bytes: Optional[int] = None,
                  overlap: Optional[bool] = None,
-                 telemetry: Optional[bool] = None):
+                 telemetry: Optional[bool] = None,
+                 compression=None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
         self.gradient_allreduce = gradient_allreduce
         self.exact_diffusion = exact_diffusion
+        # wire compression (compress/): resolved HERE, like overlap — a
+        # stateful config (lossy / choco) shapes the opt-state layout
+        # created by init(), so it must bind once for the optimizer's
+        # lifetime.  The resolved spec joins the step-cache key.
+        self.compression = _cp.resolve_compression(compression)
+        _cx.check_supported(
+            self.compression,
+            comm_value=("allreduce" if gradient_allreduce
+                        else comm_type.value),
+            sched=sched, overlap=S.overlap_enabled(overlap))
+        self._comp_stateful = _cx.stateful(self.compression)
         # in-graph telemetry gate (observability/ingraph.py): None =
         # resolve from BLUEFOG_TELEMETRY at step-build time, like the
         # fusion knobs; the resolved value joins the step-cache key.  With
@@ -109,8 +123,13 @@ class _JittedStrategyOptimizer:
                     "overlap=True assumes one exchange per step "
                     "(num_steps_per_communication=1); local-steps schedules "
                     "already take the exchange off most steps entirely")
-            self._overlap_fuse = _fusion.fusion_enabled(fuse)
-            self._overlap_bucket = _fusion.resolve_max_bucket_bytes(
+        if self.overlap or self._comp_stateful:
+            # the fusion knobs pin at construction: the carried buffers
+            # (in-flight exchange under overlap, residuals/estimates under
+            # stateful compression) are laid out by init() and must match
+            # every step the builder ever produces
+            self._pinned_fuse = _fusion.fusion_enabled(fuse)
+            self._pinned_bucket = _fusion.resolve_max_bucket_bytes(
                 fusion_bucket_bytes)
         if exact_diffusion and num_steps_per_communication != 1:
             raise ValueError(
@@ -129,19 +148,37 @@ class _JittedStrategyOptimizer:
         """Base optimizer state, batched over the rank axis (so scalar state
         like momentum/count exists per rank, matching N independent
         reference processes)."""
+        cfg = self.compression
         if self.overlap:
             # warmup in-flight state rides along (zero buffers, self
             # weight 1): the SAME fusion knobs the step builder will use
             return jax.vmap(lambda p: S.delayed_init(
-                self.base, p, fuse=self._overlap_fuse,
-                fusion_bucket_bytes=self._overlap_bucket,
-                exact_diffusion=self.exact_diffusion))(params)
+                self.base, p, fuse=self._pinned_fuse,
+                fusion_bucket_bytes=self._pinned_bucket,
+                exact_diffusion=self.exact_diffusion,
+                compression=cfg))(params)
         if self.gradient_allreduce and self.k > 1:
-            return jax.vmap(lambda p: S.grad_accum_init(self.base, p))(params)
+            return jax.vmap(lambda p: S.grad_accum_init(
+                self.base, p, compression=cfg,
+                fuse=self._pinned_fuse if self._comp_stateful else None,
+                fusion_bucket_bytes=(self._pinned_bucket
+                                     if self._comp_stateful else None))
+            )(params)
         if self.exact_diffusion:
             # psi_prev carries the rank axis already (it IS the params)
             return jax.vmap(
-                lambda p: S.exact_diffusion_init(self.base, p))(params)
+                lambda p: S.exact_diffusion_init(
+                    self.base, p, compression=cfg,
+                    fuse=self._pinned_fuse if self._comp_stateful else None,
+                    fusion_bucket_bytes=(self._pinned_bucket
+                                         if self._comp_stateful else None))
+            )(params)
+        if self._comp_stateful:
+            # plain consensus/CTA/ATC family: the state gains the carried
+            # residual/estimate buffers ({"base", "compress"})
+            return jax.vmap(lambda p: S.compress_wrap_init(
+                self.base, p, cfg, fuse=self._pinned_fuse,
+                fusion_bucket_bytes=self._pinned_bucket))(params)
         return jax.vmap(self.base.init)(params)
 
     def _build(self, key, telemetry: bool = False):
@@ -155,12 +192,13 @@ class _JittedStrategyOptimizer:
         if hierarchical:
             machine_topo = cx.compiled_machine_topology
 
-        if self.overlap:
-            fuse, bucket_bytes = self._overlap_fuse, self._overlap_bucket
+        if self.overlap or self._comp_stateful:
+            fuse, bucket_bytes = self._pinned_fuse, self._pinned_bucket
         else:
             fuse = _fusion.fusion_enabled(self.fuse)
             bucket_bytes = _fusion.resolve_max_bucket_bytes(
                 self.fusion_bucket_bytes)
+        cfg = self.compression
         if self.overlap:
             if self.exact_diffusion:
                 if self.comm_type == CommunicationType.neighbor_allreduce:
@@ -169,7 +207,8 @@ class _JittedStrategyOptimizer:
                     self.base, self.comm_type, cx.rank_axis, topo=topo,
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
-                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
+                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
+                    compression=cfg)
             else:
                 builder = (S.delayed_atc_step if self.atc
                            else S.delayed_consensus_step)
@@ -178,12 +217,13 @@ class _JittedStrategyOptimizer:
                     sched=self.sched,
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
-                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
+                    fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
+                    compression=cfg)
         elif self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k,
                 fuse=fuse, fusion_bucket_bytes=bucket_bytes,
-                telemetry=telemetry)
+                telemetry=telemetry, compression=cfg)
         elif self.exact_diffusion:
             if self.comm_type not in (
                     CommunicationType.neighbor_allreduce,
@@ -198,7 +238,8 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
-                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
+                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
+                compression=cfg)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
@@ -206,12 +247,14 @@ class _JittedStrategyOptimizer:
                 sched=self.sched,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
-                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry)
+                fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
+                compression=cfg)
         if not (self.gradient_allreduce or self.exact_diffusion
                 or self.overlap):
             # grad-allreduce accumulates internally; exact-diffusion and
             # overlap are one-exchange-per-step by construction.  The local
-            # branch must mirror the comm branch's telemetry structure.
+            # branch must mirror the comm branch's telemetry AND
+            # compression-state structure.
             tel_axis = S._telemetry_axis(
                 self.comm_type, cx.rank_axis,
                 (cx.machine_axis, cx.local_axis))
@@ -219,7 +262,8 @@ class _JittedStrategyOptimizer:
                 step_core,
                 S.local_sgd_like_step(self.base, telemetry=telemetry,
                                       axis_name=tel_axis, fuse=fuse,
-                                      fusion_bucket_bytes=bucket_bytes),
+                                      fusion_bucket_bytes=bucket_bytes,
+                                      compression=cfg),
                 self.k)
 
         pl = mesh_plumbing(cx, hierarchical)
@@ -255,17 +299,18 @@ class _JittedStrategyOptimizer:
         global-view :class:`~..observability.ingraph.TelemetrySnapshot`
         (``[N]`` per field) when telemetry resolves on."""
         cx = ctx()
-        # under overlap the fusion knobs were pinned at construction (they
-        # shape the carried in-flight buffers created by init())
-        if self.overlap:
-            fuse, bucket = self._overlap_fuse, self._overlap_bucket
+        # under overlap / stateful compression the fusion knobs were
+        # pinned at construction (they shape the carried buffers created
+        # by init())
+        if self.overlap or self._comp_stateful:
+            fuse, bucket = self._pinned_fuse, self._pinned_bucket
         else:
             fuse = _fusion.fusion_enabled(self.fuse)
             bucket = _fusion.resolve_max_bucket_bytes(
                 self.fusion_bucket_bytes)
         telemetry = IG.telemetry_enabled(self.telemetry)
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
-                             self.overlap, telemetry)
+                             self.overlap, telemetry, self.compression)
         hit = key in self._step_cache
         note_step_cache(hit)
         if not hit:
@@ -276,7 +321,7 @@ class _JittedStrategyOptimizer:
 
 def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
                                           fuse=None, fusion_bucket_bytes=None,
-                                          telemetry=None):
+                                          telemetry=None, compression=None):
     """Synchronous Horovod-style gradient averaging
     (optimizers.py:1376; internal _DistributedOptimizer:166-294).
 
@@ -287,24 +332,26 @@ def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
         base, CommunicationType.empty, gradient_allreduce=True,
         num_steps_per_communication=num_steps_per_communication,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedAllreduceOptimizer(base, num_steps_per_communication=1,
                                   fuse=None, fusion_bucket_bytes=None,
-                                  overlap=None, telemetry=None):
+                                  overlap=None, telemetry=None,
+                                  compression=None):
     """CTA with global weight averaging (optimizers.py:1301)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.allreduce,
         num_steps_per_communication=num_steps_per_communication,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
                                           sched: Optional[DynamicSchedule] = None,
                                           fuse=None, fusion_bucket_bytes=None,
-                                          overlap=None, telemetry=None):
+                                          overlap=None, telemetry=None,
+                                          compression=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
     decentralized optimizer (optimizers.py:1326).
 
@@ -321,25 +368,29 @@ def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
         base, CommunicationType.neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
         base, num_steps_per_communication=1, fuse=None,
-        fusion_bucket_bytes=None, telemetry=None):
-    """CTA with machine-level neighbor averaging (optimizers.py:1352)."""
+        fusion_bucket_bytes=None, telemetry=None, compression=None):
+    """CTA with machine-level neighbor averaging (optimizers.py:1352).
+    ``compression`` is accepted for API uniformity but any non-off value
+    is rejected with guidance (the two-level mix has no compressed wire
+    format yet; see docs/compression.md)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.hierarchical_neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedAdaptThenCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
+        compression=None):
     """ATC: local update inside the step, then communicate the adapted
     weights (optimizers.py:1426; internal :485-841).  ``overlap``: the
     combine of the adapted iterate lands one step later (staleness-1
@@ -348,14 +399,15 @@ def DistributedAdaptThenCombineOptimizer(
         base, communication_type, atc=True,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedAdaptWithCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
-        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
+        compression=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
     runs the collective and the update math in parallel.  ``overlap``
@@ -366,12 +418,13 @@ def DistributedAdaptWithCombineOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 def DistributedExactDiffusionOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
-        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None):
+        fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
+        compression=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -392,7 +445,7 @@ def DistributedExactDiffusionOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, exact_diffusion=True,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry)
+        telemetry=telemetry, compression=compression)
 
 
 # ---------------------------------------------------------------------------
@@ -411,7 +464,9 @@ class _WindowOptimizerBase:
     _instance_counter = [0]   # default names stay unique AND deterministic
 
     def __init__(self, base, window_prefix: Optional[str] = None,
-                 num_steps_per_communication: int = 1):
+                 num_steps_per_communication: int = 1,
+                 telemetry: Optional[bool] = None,
+                 compression=None):
         self.base = base
         if window_prefix is None:
             # deterministic per creation order, so same-program checkpoint
@@ -421,12 +476,18 @@ class _WindowOptimizerBase:
         self._name = window_prefix + ".params"
         self.k = num_steps_per_communication
         self._created = False
-        # telemetry pinned OFF (not env-resolved): the window family's
-        # step() composes this local adapt with host-side window ops and
-        # returns 2-tuples; in-graph telemetry does not apply here (watch
-        # window traffic via the host metrics registry instead)
+        # in-graph telemetry now extends to the window family (the old
+        # 2-tuple pin is gone): the local-adapt core carries the snapshot
+        # — consensus distance over the post-window-average weights plus
+        # the norm trio; identity mix mass (the window fold's weights live
+        # host-side, watch them via the metrics registry).  With telemetry
+        # resolved on, step() returns (params, state, TelemetrySnapshot).
+        self.telemetry = telemetry
         self._local = _JittedStrategyOptimizer(base, CommunicationType.empty,
-                                               telemetry=False)
+                                               telemetry=telemetry)
+        # wire compression for the window transfer ops rides win_create
+        # (the window owns the wire format; direct specs only)
+        self.compression = _cp.resolve_compression(compression)
         # mutable per-iteration weighting knobs (matrices), reference
         # optimizers.py:852-858
         self.dst_weights = None
@@ -439,7 +500,8 @@ class _WindowOptimizerBase:
                 "state = opt.init(params) first to create the windows")
 
     def init(self, params, zero_init: bool = False):
-        if not W.win_create(params, self._name, zero_init=zero_init):
+        if not W.win_create(params, self._name, zero_init=zero_init,
+                            compression=self.compression):
             raise ValueError(f"Cannot allocate window for {self._name}")
         self._created = True
         return self._local.init(params)
@@ -504,8 +566,10 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
     from ``compile_dynamic_schedule`` are) so mass is conserved."""
 
     def __init__(self, base, window_prefix: Optional[str] = None,
-                 num_steps_per_communication: int = 1, sched=None):
-        super().__init__(base, window_prefix, num_steps_per_communication)
+                 num_steps_per_communication: int = 1, sched=None,
+                 telemetry: Optional[bool] = None, compression=None):
+        super().__init__(base, window_prefix, num_steps_per_communication,
+                         telemetry=telemetry, compression=compression)
         self.sched = sched
 
     def init(self, params):
@@ -533,14 +597,18 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
             # local step: adapt the *biased* window iterate so the update
             # survives the next collect (gradients are at the de-biased view)
             biased = W.win_fetch(self._name)
-            adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
+            out = self._apply_base(biased, grads, opt_state, step)
+            adapted, opt_state = out[0], out[1]
             W.win_publish(self._name, adapted)
+            if len(out) == 3:           # telemetry snapshot rides along
+                return self._debias(adapted), opt_state, out[2]
             return self._debias(adapted), opt_state
         # the biased iterate lives in the window; `params` is the de-biased
         # view; local adapt on the biased variable with gradients at the
         # de-biased point (stochastic gradient-push)
         biased = W.win_fetch(self._name)
-        adapted, opt_state = self._apply_base(biased, grads, opt_state, step)
+        out = self._apply_base(biased, grads, opt_state, step)
+        adapted, opt_state = out[0], out[1]
         if self.sched is not None:
             W.win_accumulate(adapted, self._name, require_mutex=True,
                              sched=self.sched, step=step)
@@ -548,4 +616,6 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
             W.win_accumulate(adapted, self._name, self_weight=self.alpha,
                              dst_weights=self.dst_weights, require_mutex=True)
         collected = W.win_update_then_collect(self._name)
+        if len(out) == 3:
+            return self._debias(collected), opt_state, out[2]
         return self._debias(collected), opt_state

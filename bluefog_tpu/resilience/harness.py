@@ -37,6 +37,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import timeline as _tl
+from ..compress import compressors as _cp
+from ..compress import exchange as _cx
 from ..context import ctx
 from ..observability import metrics as _metrics
 from ..ops import collectives as C
@@ -134,6 +136,12 @@ class ChaosHarness:
     absorbed into the receiver's self weight — a mid-pipeline death
     degrades to self-weight instead of folding stale garbage.  Step 0
     folds the gathered initial parameters (synchronous warmup).
+
+    ``compression`` (default ``BLUEFOG_COMM_COMPRESS``, off): the gather
+    moves compressed wire payloads (direct specs only); error-feedback
+    residuals ride the loop state and reset for inactive ranks — the
+    repaired column falls back to self weight with residuals cleared
+    (docs/compression.md).
     """
 
     def __init__(self, plan, *, base_opt=None,
@@ -141,7 +149,8 @@ class ChaosHarness:
                  cfg: Optional[_mem.LivenessConfig] = None,
                  loss_fn: Optional[Callable] = None,
                  fuse: Optional[bool] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 compression=None):
         if isinstance(plan, _faults.FaultPlan):
             plan = plan.compile()
         self.plan: _faults.CompiledFaultPlan = plan
@@ -157,6 +166,21 @@ class ChaosHarness:
         # snapshot at construction (the chaos step compiles once)
         self.fuse = _fusion.fusion_enabled(fuse)
         self.overlap = _strategies_overlap_enabled(overlap)
+        # wire compression under chaos (compress/): the per-step gather
+        # moves compressed payloads; error-feedback residuals ride the
+        # loop-carried state and RESET for inactive ranks — a repaired/
+        # degraded column falls back to self weight without re-injecting
+        # residuals accumulated against the dead topology.  Direct specs
+        # only: choco's accumulated estimates assume a constant W, which
+        # is exactly what liveness repair violates.
+        self.compression = _cp.resolve_compression(compression)
+        if self.compression is not None and self.compression.choco:
+            raise ValueError(
+                "ChaosHarness supports direct compression specs only "
+                "('int8', 'topk:0.01', ...): choco's accumulated replica "
+                "estimates assume a constant mixing matrix, which liveness "
+                "repair deliberately changes per step")
+        self._comp_stateful = _cx.stateful(self.compression)
         self._step_fn = None
 
     # -- the one jitted chaos step ------------------------------------------
@@ -165,13 +189,17 @@ class ChaosHarness:
         cx, topo, cfg = self.cx, self.topo, self.cfg
         base_opt, loss_fn = self.base_opt, self.loss_fn
         fuse, overlap = self.fuse, self.overlap
+        comp_cfg = self.compression
+        comp = (_cp.get_compressor(comp_cfg)
+                if comp_cfg is not None else None)
+        comp_stateful = self._comp_stateful
         axis = cx.rank_axis
         n = topo.size
         W0 = topo.weight_matrix
         spec = P(axis)
 
         def shard_fn(p_s, opt_s, lh_s, batch_s, step, alive, active,
-                     link_ok, corrupt, gprev_s, fprev_s):
+                     link_ok, corrupt, gprev_s, fprev_s, rprev_s):
             x = jax.tree.map(lambda a: a[0], p_s)
             st = jax.tree.map(lambda a: a[0], opt_s)
             b = jax.tree.map(lambda a: a[0], batch_s)
@@ -191,7 +219,10 @@ class ChaosHarness:
             # 3. outgoing values: corruption rides the wire; receivers
             #    drop non-finite contributions (finite-guard).  Under
             #    fusion the gather moves dtype-bucketed flat buffers —
-            #    one allgather per bucket, not per leaf.
+            #    one allgather per bucket, not per leaf.  Under
+            #    compression the gather moves each bucket's WIRE encoding
+            #    (compress/compressors.py) and decodes all rows locally;
+            #    error-feedback residuals ride rprev_s.
             out_x = jax.tree.map(
                 lambda l: l * corrupt[idx].astype(l.dtype), x)
             if fuse:
@@ -204,7 +235,26 @@ class ChaosHarness:
             finite_own = jnp.asarray(True)
             for leaf in out_bufs:
                 finite_own &= jnp.isfinite(leaf).all()
-            gathered_bufs = [C.allgather(l[None], axis) for l in out_bufs]
+            if comp is not None:
+                gathered_bufs, res_new = [], []
+                res_prev = [r[0] for r in rprev_s]
+                for b, ob in enumerate(out_bufs):
+                    skey = jax.random.fold_in(jax.random.fold_in(
+                        jax.random.key(0xC405), step), b)
+                    rkey = jax.random.fold_in(skey, idx)
+                    t = ob + res_prev[b] if comp_stateful else ob
+                    wire = comp.compress(t, skey, rkey)
+                    gw = jax.tree.map(
+                        lambda a: C.allgather(a[None], axis), wire)
+                    dec = jax.vmap(lambda w: comp.decompress(
+                        w, skey, ob.shape, ob.dtype))(gw)
+                    gathered_bufs.append(dec)
+                    if comp_stateful:
+                        res_new.append(t - dec[idx])
+            else:
+                gathered_bufs = [C.allgather(l[None], axis)
+                                 for l in out_bufs]
+                res_new = []
             finite = C.allgather(finite_own[None], axis)      # [N]
             if overlap:
                 # staleness-1 pipeline: this step's gather only LAUNCHES
@@ -266,24 +316,33 @@ class ChaosHarness:
                             jnp.zeros_like(col).at[idx].set(1.0))
 
             votes = confirmed_dead.astype(jnp.int32)          # my view
+            # residual reset for inactive ranks: a frozen/degraded rank's
+            # error feedback must not re-inject into the repaired topology
+            # when (if) it recovers — it restarts clean, like the overlap
+            # pipeline reset in optim/strategies.delayed_local_step
+            res_out = tuple(
+                jnp.where(me_active, r, jnp.zeros_like(r))
+                for r in res_new)
             lead = lambda t: jax.tree.map(lambda a: a[None], t)
             return (lead(x_new), lead(st_new), row[None], loss[None],
                     col[None], votes[None],
-                    tuple(g[None] for g in gathered_bufs), finite[None])
+                    tuple(g[None] for g in gathered_bufs), finite[None],
+                    tuple(r[None] for r in res_out))
 
         def stepper(params, opt_state, last_heard, batch, step, tables,
                     carried):
             alive, active, link_ok, corrupt = _faults.at_step(tables, step)
-            gprev, fprev = carried
+            gprev, fprev, rprev = carried
             (p2, o2, lh2, loss_r, cols, votes, gnew,
-             fnew) = jax.shard_map(
+             fnew, rnew) = jax.shard_map(
                 shard_fn, mesh=cx.mesh,
                 in_specs=(spec, spec, spec, spec, P(), P(), P(), P(), P(),
-                          spec, spec),
-                out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
+                          spec, spec, spec),
+                out_specs=(spec, spec, spec, spec, spec, spec, spec, spec,
+                           spec),
             )(params, opt_state, last_heard, batch,
               jnp.asarray(step, jnp.int32), alive, active, link_ok, corrupt,
-              gprev, fprev)
+              gprev, fprev, rprev)
             # survivor metrics (active-weighted)
             wsum = jnp.maximum(active.sum(), 1.0)
             loss_mean = (loss_r * active).sum() / wsum
@@ -295,7 +354,7 @@ class ChaosHarness:
             W_eff = cols.T                       # cols[j] is column j
             dead_votes = votes.sum(axis=0)
             return (p2, o2, lh2, loss_mean, cons, W_eff, dead_votes,
-                    (gnew, fnew))
+                    (gnew, fnew, rnew))
 
         return jax.jit(stepper)
 
@@ -321,7 +380,14 @@ class ChaosHarness:
             _api.to_global(jnp.broadcast_to(b[None], (n,) + b.shape))
             for b in bufs)
         finite0 = _api.to_global(jnp.ones((n, n), bool))
-        return (gathered0, finite0)
+        # error-feedback residuals start at zero (nothing transmitted
+        # yet), shaped like the per-rank buffers ([N, ...] global view);
+        # empty tuple when the compression config carries no state
+        if self._comp_stateful:
+            res0 = tuple(_api.to_global(jnp.zeros_like(b)) for b in bufs)
+        else:
+            res0 = ()
+        return (gathered0, finite0, res0)
 
     # -- driver --------------------------------------------------------------
 

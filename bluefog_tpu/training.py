@@ -21,6 +21,8 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .compress import compressors as _cp
+from .compress import exchange as _cx
 from .context import ctx
 from .observability import ingraph as IG
 from .ops import api as _api
@@ -49,7 +51,8 @@ def create_train_state(model, base_opt: optax.GradientTransformation,
                        communication: str = None,
                        overlap: Optional[bool] = None,
                        fuse: Optional[bool] = None,
-                       fusion_bucket_bytes: Optional[int] = None):
+                       fusion_bucket_bytes: Optional[int] = None,
+                       compression=None):
     """Initialize (variables, opt_state) in global view.
 
     All ranks start from the same weights, matching the reference's
@@ -63,24 +66,41 @@ def create_train_state(model, base_opt: optax.GradientTransformation,
     pass the same ``overlap``/``fuse``/``fusion_bucket_bytes`` you will
     give ``make_train_step`` so the carried-buffer layout matches the
     step that donates it.
+
+    ``compression`` (default ``BLUEFOG_COMM_COMPRESS``, off): stateful
+    configs (lossy / choco) carry residual/estimate buffers in the opt
+    state — pass the same ``compression`` (and fusion knobs) you will
+    give ``make_train_step``, for the same layout reason as ``overlap``.
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     extra = {k: v for k, v in variables.items() if k != "params"}
     gparams = replicate_to_ranks(params)
     gextra = replicate_to_ranks(extra)
+    cfg = _cp.resolve_compression(compression)
     if S.overlap_enabled(overlap):
         # the ONE definition of the pipeline state layout (warmup in-flight
-        # buffers + optional psi_prev) lives in strategies.delayed_init
+        # buffers + optional psi_prev + compression residuals) lives in
+        # strategies.delayed_init
         opt_state = jax.vmap(lambda p: S.delayed_init(
             base_opt, p, fuse=fuse,
             fusion_bucket_bytes=fusion_bucket_bytes,
-            exact_diffusion=communication == "exact_diffusion"))(gparams)
+            exact_diffusion=communication == "exact_diffusion",
+            compression=cfg))(gparams)
     elif communication == "exact_diffusion":
         # the ONE definition of the ED state layout lives in strategies.py
         # (psi_prev copied there: params+opt_state donation stays legal)
         opt_state = jax.vmap(
-            lambda p: S.exact_diffusion_init(base_opt, p))(gparams)
+            lambda p: S.exact_diffusion_init(
+                base_opt, p, compression=cfg, fuse=fuse,
+                fusion_bucket_bytes=fusion_bucket_bytes))(gparams)
+    elif _cx.stateful(cfg):
+        # every make_train_step strategy that carries compression state
+        # wraps it as {"base", "compress"} (grad-AR accumulation is the
+        # wrapper-optimizer path, rejected by make_train_step)
+        opt_state = jax.vmap(lambda p: S.compress_wrap_init(
+            base_opt, p, cfg, fuse=fuse,
+            fusion_bucket_bytes=fusion_bucket_bytes))(gparams)
     else:
         opt_state = jax.vmap(base_opt.init)(gparams)
     return {"params": gparams, **gextra}, opt_state
@@ -98,7 +118,8 @@ def make_train_step(model,
                     fuse: Optional[bool] = None,
                     fusion_bucket_bytes: Optional[int] = None,
                     overlap: Optional[bool] = None,
-                    telemetry: Optional[bool] = None):
+                    telemetry: Optional[bool] = None,
+                    compression=None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -125,6 +146,16 @@ def make_train_step(model,
     ``num_steps_per_communication=1``; create the opt state with
     ``create_train_state(..., overlap=True)``.  Step 0 is a documented
     warmup (local-only) step.
+
+    ``compression`` (default ``BLUEFOG_COMM_COMPRESS``, off): compress
+    the exchange wire over the fused buckets — ``"int8"``/``"fp8"``
+    quantization, ``"topk:0.01"``/``"randomk:0.05"`` sparsification, or
+    ``"choco:<spec>[:gamma=G]"`` difference gossip (``docs/
+    compression.md``).  Lossy configs carry error-feedback residuals in
+    the donated opt state: create it with ``create_train_state(...,
+    compression=...)``.  ``None``/off lowers to byte-identical StableHLO
+    versus the pre-compression step (asserted by
+    ``tests/test_compress.py``).
 
     ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): compute traced
     training-health aggregates INSIDE the step — consensus distance
@@ -173,6 +204,11 @@ def make_train_step(model,
         fusion_bucket_bytes)
     overlap = S.overlap_enabled(overlap)
     telemetry = IG.telemetry_enabled(telemetry)
+    compression = _cp.resolve_compression(compression)
+    _cx.check_supported(
+        compression,
+        comm_value="allreduce" if grad_ar else comm_type.value,
+        sched=sched, overlap=overlap)
     if overlap:
         if communication not in ("neighbor_allreduce", "allreduce",
                                  "exact_diffusion"):
@@ -206,7 +242,7 @@ def make_train_step(model,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, nar_backend=nar_backend,
                 fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-                telemetry=telemetry)
+                telemetry=telemetry, compression=compression)
         else:
             builder = S.delayed_atc_step if atc else S.delayed_consensus_step
             core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
@@ -215,7 +251,7 @@ def make_train_step(model,
                            machine_topo=machine_topo,
                            nar_backend=nar_backend, fuse=fuse,
                            fusion_bucket_bytes=fusion_bucket_bytes,
-                           telemetry=telemetry)
+                           telemetry=telemetry, compression=compression)
     elif grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
@@ -224,7 +260,8 @@ def make_train_step(model,
                 "bf.DistributedGradientAllreduceOptimizer instead")
         core = S.gradient_allreduce_step(
             base_opt, cx.rank_axis, fuse=fuse,
-            fusion_bucket_bytes=fusion_bucket_bytes, telemetry=telemetry)
+            fusion_bucket_bytes=fusion_bucket_bytes, telemetry=telemetry,
+            compression=compression)
     elif exact_diffusion:
         if num_steps_per_communication > 1:
             raise ValueError("exact_diffusion assumes one exchange per "
@@ -238,7 +275,7 @@ def make_train_step(model,
             machine_axes=(cx.machine_axis, cx.local_axis),
             machine_topo=machine_topo, nar_backend=nar_backend,
             fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-            telemetry=telemetry)
+            telemetry=telemetry, compression=compression)
     else:
         builder = S.atc_step if atc else S.consensus_step
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
@@ -246,7 +283,7 @@ def make_train_step(model,
                        machine_axes=(cx.machine_axis, cx.local_axis),
                        machine_topo=machine_topo, nar_backend=nar_backend,
                        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-                       telemetry=telemetry)
+                       telemetry=telemetry, compression=compression)
     if not (exact_diffusion or overlap):
         tel_axis = S._telemetry_axis(
             comm_type, cx.rank_axis, (cx.machine_axis, cx.local_axis))
@@ -254,7 +291,8 @@ def make_train_step(model,
             core,
             S.local_sgd_like_step(base_opt, telemetry=telemetry,
                                   axis_name=tel_axis, fuse=fuse,
-                                  fusion_bucket_bytes=fusion_bucket_bytes),
+                                  fusion_bucket_bytes=fusion_bucket_bytes,
+                                  compression=compression),
             num_steps_per_communication)
 
     pl = mesh_plumbing(cx, hierarchical)

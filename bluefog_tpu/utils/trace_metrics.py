@@ -83,14 +83,28 @@ _ASYNC_PATTERNS = {
 # tolerated; unknown dtypes count as 0 rather than guessing.
 
 _DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "i8": 1,
-    "s16": 2, "u16": 2, "i16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "i32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "i64": 8, "f64": 8, "c64": 8,
+    "pred": 1, "s8": 1, "u8": 1, "i8": 1, "ui8": 1, "i1": 1,
+    "s16": 2, "u16": 2, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "ui32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "ui64": 8, "f64": 8, "c64": 8,
     "c128": 16,
     "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
-    "f8e5m2fnuz": 1,
+    "f8e5m2fnuz": 1, "f8e3m4": 1, "f8e4m3": 1, "f8e8m0fnu": 1,
 }
+
+
+def _dtype_nbytes(dtype: str):
+    """Per-element bytes of a dialect dtype token, or None when unknown.
+
+    StableHLO capitalizes the f8 family (``f8E4M3FN``) while the HLO
+    dialect spells it lowercase (``f8e4m3fn``) — compression puts these
+    (and ``i8``) on the wire, so byte estimation must not silently drop
+    them (the pre-fix estimator was effectively f32-only in practice:
+    every uncompressed buffer it ever saw was 4-byte)."""
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:
+        size = _DTYPE_BYTES.get(dtype.lower())
+    return size
 
 _STABLEHLO_TENSOR = re.compile(r"tensor<([^>]*)>")
 _HLO_TYPE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\](?:\{[^}]*\})?")
@@ -106,7 +120,7 @@ def _stablehlo_tensor_bytes(spec: str) -> int:
     """``'8x128xf32'`` / ``'f32'`` (0-d) -> byte count (0 if unknown)."""
     parts = spec.strip().split("x")
     dtype = parts[-1].strip()
-    size = _DTYPE_BYTES.get(dtype)
+    size = _dtype_nbytes(dtype)
     if size is None:
         return 0
     n = 1
@@ -119,7 +133,7 @@ def _stablehlo_tensor_bytes(spec: str) -> int:
 
 
 def _hlo_type_bytes(dtype: str, dims: str) -> int:
-    size = _DTYPE_BYTES.get(dtype)
+    size = _dtype_nbytes(dtype)
     if size is None:
         return 0
     n = 1

@@ -11,6 +11,14 @@ whole observability pipeline end to end:
 3. a lenet-style training run with ``make_train_step(telemetry=True)``
    must produce finite telemetry and a decreasing loss.
 
+``--compress`` (``make metrics-smoke-compress``) adds the compressed-
+gossip legs (``bluefog_tpu/compress/``): the consensus-only run repeated
+under ``int8`` quantization with error feedback and under
+``choco:int8`` difference gossip — consensus distance must STILL
+strictly decrease, the carried residual norm must stay bounded (below
+the parameter norm), and the snapshot must report a compression ratio
+> 1 (docs/compression.md).
+
 Exit 0 on success, 1 with a readable message otherwise.
 """
 
@@ -45,7 +53,38 @@ def fail(msg):
     sys.exit(1)
 
 
+def compress_leg(params, grads, spec, steps=6):
+    """Consensus-only compressed-gossip gate for one spec: strictly
+    decreasing consensus distance, bounded residual, ratio > 1."""
+    import optax
+    import numpy as np
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True, compression=spec)
+    state = opt.init(params)
+    p = params
+    series, res, ratio = [], [], None
+    for t in range(steps):
+        p, state, snap = opt.step(p, grads, state, t)
+        EX.log_step(t, snap, extra={"phase": f"compress:{spec}"})
+        series.append(float(np.asarray(snap.consensus_dist).mean()))
+        res.append(float(np.asarray(snap.residual_norm).mean()))
+        pn = float(np.asarray(snap.param_norm).mean())
+        ratio = float(np.asarray(snap.compress_ratio).mean())
+    if not all(np.isfinite(series)):
+        fail(f"[{spec}] consensus distance went non-finite: {series}")
+    if not all(b < a for a, b in zip(series, series[1:])):
+        fail(f"[{spec}] consensus distance not strictly decreasing: "
+             f"{series}")
+    if not all(np.isfinite(res)) or max(res) >= pn:
+        fail(f"[{spec}] residual norm unbounded: max {max(res)} vs "
+             f"param norm {pn}")
+    if ratio is None or ratio <= 1.0:
+        fail(f"[{spec}] compression ratio not > 1: {ratio}")
+    return series, max(res), ratio
+
+
 def main():
+    do_compress = "--compress" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -60,6 +99,7 @@ def main():
     rng = np.random.default_rng(0)
     params = {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
               "b": jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)}
+    params0 = params          # pristine spread for the compressed legs
     grads = jax.tree.map(jnp.zeros_like, params)
     opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0),
                                                    telemetry=True)
@@ -73,6 +113,18 @@ def main():
         fail(f"consensus distance went non-finite: {series}")
     if not all(b < a for a, b in zip(series, series[1:])):
         fail(f"consensus distance not strictly decreasing: {series}")
+
+    # -- compressed-gossip legs (--compress) ----------------------------
+    comp_out = {}
+    if do_compress:
+        for spec in ("int8", "choco:int8:gamma=0.9"):
+            cseries, cres, cratio = compress_leg(params0, grads, spec)
+            comp_out[spec] = {
+                "consensus_first": round(cseries[0], 6),
+                "consensus_last": round(cseries[-1], 6),
+                "residual_norm_max": round(cres, 6),
+                "ratio": round(cratio, 2),
+            }
 
     # -- telemetry-on training run --------------------------------------
     from bluefog_tpu import training as T
@@ -105,14 +157,15 @@ def main():
         records = EX.validate_jsonl(path)
     except ValueError as e:
         fail(f"JSONL schema violation: {e}")
-    if len(records) != 2 * STEPS:
-        fail(f"expected {2 * STEPS} JSONL records, found {len(records)}")
+    expected = 2 * STEPS + (2 * 6 if do_compress else 0)
+    if len(records) != expected:
+        fail(f"expected {expected} JSONL records, found {len(records)}")
     cons = [r for r in records if r.get("phase") == "consensus"]
     cds = [float(np.mean(r["consensus_dist"])) for r in cons]
     if not all(b < a for a, b in zip(cds, cds[1:])):
         fail(f"JSONL consensus series not decreasing: {cds}")
 
-    print(json.dumps({
+    out = {
         "status": "ok",
         "jsonl": path,
         "records": len(records),
@@ -120,7 +173,10 @@ def main():
         "consensus_last": round(series[-1], 6),
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
-    }))
+    }
+    if comp_out:
+        out["compress"] = comp_out
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,670 @@
+"""Compressed neighbor exchange (``bluefog_tpu/compress/``).
+
+Covers the ISSUE-5 acceptance surface:
+
+* spec parsing / env resolution / validation errors with guidance;
+* compressor codecs: identity exact, int8/fp8 quantization error bounds,
+  top-k magnitude selection, random-k shared-mask determinism;
+* the IDENTITY compressor is BIT-exact versus the uncompressed fused path
+  across every strategy family (consensus/CTA, ATC, exact-diffusion,
+  gradient allreduce, global allreduce, dynamic schedules, overlapped
+  delayed variants) on ragged mixed-dtype trees;
+* ``compression=None`` lowers to byte-identical StableHLO versus not
+  passing the knob at all, and differs once a compressor is on;
+* error feedback: residual norm bounded, consensus distance strictly
+  decreasing on consensus-only runs under int8 and top-k+choco;
+* trace-level evidence: the int8 train step moves >= 3x fewer ppermute
+  bytes than the uncompressed fused step (the ``make bench-compress``
+  gate in miniature) — which also regression-tests the byte estimator on
+  non-f32 wire dtypes;
+* windows (compressed put/get wire), resilience (ChaosHarness residual
+  reset), telemetry fields, and the step-cache key.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.compress import compressors as CP
+from bluefog_tpu.compress import exchange as CX
+from bluefog_tpu.observability import ingraph as IG
+from bluefog_tpu.ops import windows as W
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.optim._plumbing import step_cache_key
+from bluefog_tpu.utils import trace_metrics as TM
+
+
+def ragged_tree(n, rng, dtype_b=jnp.bfloat16):
+    """Global-view [N, ...] tree: ragged shapes, mixed dtypes, a scalar
+    leaf and a zero-size leaf — the fusion layer's worst customers."""
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(n, 5)), dtype_b),
+        "s": jnp.asarray(rng.normal(size=(n,)), jnp.float32),
+        "e": jnp.zeros((n, 0), jnp.float32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing / resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_off_values():
+    for v in (None, "", "none", "off", "0", False, "None", "OFF"):
+        if v is None:
+            continue  # None reads the env; covered below
+        assert CP.resolve_compression(v) is None
+
+
+def test_resolve_none_reads_env(monkeypatch):
+    monkeypatch.delenv(CP.COMPRESS_ENV, raising=False)
+    assert CP.resolve_compression(None) is None
+    monkeypatch.setenv(CP.COMPRESS_ENV, "int8")
+    cfg = CP.resolve_compression(None)
+    assert cfg.name == "int8" and not cfg.choco
+    monkeypatch.setenv(CP.COMPRESS_ENV, "choco:topk:0.25:gamma=0.7")
+    cfg = CP.resolve_compression(None)
+    assert (cfg.name, cfg.fraction, cfg.choco, cfg.gamma) == \
+        ("topk", 0.25, True, 0.7)
+
+
+def test_spec_roundtrip_and_explicit_beats_env(monkeypatch):
+    monkeypatch.setenv(CP.COMPRESS_ENV, "int8")
+    cfg = CP.resolve_compression("choco:randomk:0.5:gamma=0.25")
+    assert cfg.spec == "choco:randomk:0.5:gamma=0.25"
+    assert CP.resolve_compression(cfg.spec) == cfg
+    assert CP.resolve_compression(cfg) is cfg
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuchthing", "topk:0", "topk:1.5", "int8:0.5", "choco:",
+    "int8:gamma=0.5", "choco:int8:gamma=0", "choco:int8:gamma=2",
+])
+def test_bad_specs_raise_with_guidance(bad):
+    with pytest.raises(ValueError):
+        CP.resolve_compression(bad)
+
+
+def test_stateful_classification():
+    assert not CX.stateful(None)
+    assert not CX.stateful(CP.resolve_compression("identity"))
+    assert CX.stateful(CP.resolve_compression("int8"))
+    assert CX.stateful(CP.resolve_compression("topk:0.1"))
+    assert CX.stateful(CP.resolve_compression("choco:identity"))
+
+
+def test_check_supported_guidance():
+    int8 = CP.resolve_compression("int8")
+    choco = CP.resolve_compression("choco:int8")
+    CX.check_supported(None, comm_value="hierarchical.neighbor.allreduce")
+    with pytest.raises(ValueError, match="hierarchical"):
+        CX.check_supported(int8,
+                           comm_value="hierarchical.neighbor.allreduce")
+    with pytest.raises(ValueError, match="neighbor_allreduce mixing only"):
+        CX.check_supported(choco, comm_value="allreduce")
+    with pytest.raises(ValueError, match="static topology"):
+        CX.check_supported(choco, comm_value="neighbor.allreduce",
+                           sched=object())
+    with pytest.raises(ValueError, match="overlap"):
+        CX.check_supported(choco, comm_value="neighbor.allreduce",
+                           overlap=True)
+
+
+# ---------------------------------------------------------------------------
+# Compressor codecs (no mesh needed)
+# ---------------------------------------------------------------------------
+
+def test_identity_codec_exact():
+    comp = CP.get_compressor(CP.resolve_compression("identity"))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(37,)),
+                    jnp.float32)
+    wire = comp.compress(x, None, None)
+    np.testing.assert_array_equal(
+        np.asarray(comp.decompress(wire, None, x.shape, x.dtype)),
+        np.asarray(x))
+    assert comp.wire_nbytes(37, jnp.float32) == 37 * 4
+
+
+def test_int8_codec_error_bound_and_wire():
+    comp = CP.get_compressor(CP.resolve_compression("int8"))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(257,)), jnp.float32)
+    key = jax.random.key(7)
+    wire = comp.compress(x, key, key)
+    assert wire["q"].dtype == jnp.int8 and wire["scale"].shape == (1,)
+    dec = comp.decompress(wire, key, x.shape, x.dtype)
+    scale = float(np.abs(np.asarray(x)).max()) / 127.0
+    # stochastic rounding: |error| < one quantization step
+    assert float(jnp.abs(dec - x).max()) < scale + 1e-7
+    assert comp.wire_nbytes(257, jnp.float32) == 257 + 4
+    # deterministic fallback (window path): rank_key=None round-to-nearest
+    dec2 = comp.decompress(comp.compress(x, key, None), key, x.shape,
+                           x.dtype)
+    assert float(jnp.abs(dec2 - x).max()) <= scale / 2 + 1e-7
+
+
+def test_int8_zero_buffer_stays_zero():
+    comp = CP.get_compressor(CP.resolve_compression("int8"))
+    x = jnp.zeros((16,), jnp.float32)
+    key = jax.random.key(0)
+    dec = comp.decompress(comp.compress(x, key, key), key, x.shape, x.dtype)
+    np.testing.assert_array_equal(np.asarray(dec), np.zeros(16, np.float32))
+
+
+def test_fp8_codec_if_available():
+    if not hasattr(jnp, "float8_e4m3fn"):
+        with pytest.raises(ValueError, match="fp8"):
+            CP.resolve_compression("fp8")
+        return
+    comp = CP.get_compressor(CP.resolve_compression("fp8"))
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(64,)),
+                    jnp.float32)
+    dec = comp.decompress(comp.compress(x, None, None), None, x.shape,
+                          x.dtype)
+    # e4m3 keeps ~2-3 significant bits at the top of the range
+    assert float(jnp.abs(dec - x).max()) < 0.1 * float(jnp.abs(x).max())
+    assert comp.wire_nbytes(64, jnp.float32) == 64 + 4
+
+
+def test_topk_keeps_largest():
+    comp = CP.get_compressor(CP.resolve_compression("topk:0.25"))
+    x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.3, 0.0, 1.0, -0.05],
+                    jnp.float32)
+    wire = comp.compress(x, None, None)
+    assert wire["v"].shape == (2,) and wire["i"].dtype == jnp.int32
+    dec = np.asarray(comp.decompress(wire, None, x.shape, x.dtype))
+    expect = np.zeros(8, np.float32)
+    expect[1], expect[3] = -5.0, 3.0
+    np.testing.assert_array_equal(dec, expect)
+    assert comp.wire_nbytes(8, jnp.float32) == 2 * (4 + 4)
+
+
+def test_randomk_shared_mask_deterministic():
+    comp = CP.get_compressor(CP.resolve_compression("randomk:0.5"))
+    x = jnp.arange(10, dtype=jnp.float32) + 1.0
+    key = jax.random.key(3)
+    wire = comp.compress(x, key, None)
+    assert set(wire.keys()) == {"v"}     # values only: indices re-derived
+    dec1 = np.asarray(comp.decompress(wire, key, x.shape, x.dtype))
+    dec2 = np.asarray(comp.decompress(wire, key, x.shape, x.dtype))
+    np.testing.assert_array_equal(dec1, dec2)
+    kept = np.nonzero(dec1)[0]
+    assert len(kept) == 5
+    np.testing.assert_array_equal(dec1[kept], np.asarray(x)[kept])
+    assert comp.wire_nbytes(10, jnp.float32) == 5 * 4
+
+
+def test_wire_stats():
+    cfg = CP.resolve_compression("int8")
+    bufs = [jnp.zeros((100,), jnp.float32), jnp.zeros((8,), jnp.bfloat16),
+            jnp.zeros((0,), jnp.float32)]
+    wire, raw = CX.wire_stats(cfg, bufs)
+    assert raw == 400 + 16 and wire == 104 + 12
+
+
+# ---------------------------------------------------------------------------
+# Identity == uncompressed, bit-exact, across strategies
+# ---------------------------------------------------------------------------
+
+def _run_pair(make_opt, params, grads, steps=3):
+    o0, o1 = make_opt(None), make_opt("identity")
+    s0, s1 = o0.init(params), o1.init(params)
+    p0 = p1 = params
+    for t in range(steps):
+        p0, s0 = o0.step(p0, grads, s0, t)[:2]
+        p1, s1 = o1.step(p1, grads, s1, t)[:2]
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p0[k]), np.asarray(p1[k]),
+                                      err_msg=f"leaf {k}")
+
+
+@pytest.mark.parametrize("fuse", [True, False], ids=["fused", "per_leaf"])
+def test_identity_bitexact_consensus(bf_ctx, fuse):
+    rng = np.random.default_rng(0)
+    params = ragged_tree(bf.size(), rng)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    _run_pair(lambda c: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), fuse=fuse, compression=c), params, grads)
+
+
+def test_identity_bitexact_atc_and_awc(bf_ctx):
+    rng = np.random.default_rng(1)
+    params = ragged_tree(bf.size(), rng)
+    grads = {k: jnp.asarray(rng.normal(size=v.shape), v.dtype)
+             for k, v in params.items()}
+    _run_pair(lambda c: bf.DistributedAdaptThenCombineOptimizer(
+        optax.sgd(0.05), compression=c), params, grads)
+    _run_pair(lambda c: bf.DistributedAdaptWithCombineOptimizer(
+        optax.sgd(0.05), compression=c), params, grads)
+
+
+def test_identity_bitexact_allreduce_and_grad_ar(bf_ctx):
+    rng = np.random.default_rng(2)
+    params = ragged_tree(bf.size(), rng)
+    grads = {k: jnp.asarray(rng.normal(size=v.shape), v.dtype)
+             for k, v in params.items()}
+    _run_pair(lambda c: bf.DistributedAllreduceOptimizer(
+        optax.sgd(0.05), compression=c), params, grads)
+    _run_pair(lambda c: bf.DistributedGradientAllreduceOptimizer(
+        optax.sgd(0.05), compression=c), params, grads)
+
+
+def test_identity_bitexact_exact_diffusion(bf_ctx):
+    n = bf.size()
+    bf.set_topology(bf.SymmetricExponentialGraph(n), is_weighted=True)
+    rng = np.random.default_rng(3)
+    params = ragged_tree(n, rng)
+    grads = {k: jnp.asarray(rng.normal(size=v.shape), v.dtype)
+             for k, v in params.items()}
+    _run_pair(lambda c: bf.DistributedExactDiffusionOptimizer(
+        optax.sgd(0.05), compression=c), params, grads)
+
+
+def test_identity_bitexact_dynamic_schedule(bf_ctx):
+    n = bf.size()
+    topo = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+    rng = np.random.default_rng(4)
+    params = ragged_tree(n, rng)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    _run_pair(lambda c: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), sched=sched, compression=c), params, grads,
+        steps=4)
+
+
+def test_identity_bitexact_overlap(bf_ctx):
+    rng = np.random.default_rng(5)
+    params = ragged_tree(bf.size(), rng)
+    grads = {k: jnp.asarray(rng.normal(size=v.shape), v.dtype)
+             for k, v in params.items()}
+    _run_pair(lambda c: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), overlap=True, compression=c), params, grads)
+    _run_pair(lambda c: bf.DistributedAdaptThenCombineOptimizer(
+        optax.sgd(0.05), overlap=True, compression=c), params, grads)
+
+
+# ---------------------------------------------------------------------------
+# compression=None -> byte-identical StableHLO
+# ---------------------------------------------------------------------------
+
+def test_compression_off_is_hlo_identical(bf_ctx):
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    x = jnp.zeros((n, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    t_default, _ = TM.lower_text(
+        T.make_train_step(model, base, donate=False), *args)
+    t_off, _ = TM.lower_text(
+        T.make_train_step(model, base, donate=False, compression="none"),
+        *args)
+    assert t_default == t_off
+    # identity goes through the compressed machinery: same VALUES
+    # (asserted elsewhere) but a different program — proves the off path
+    # really is the pre-compression trace, not identity-compression
+    t_id, _ = TM.lower_text(
+        T.make_train_step(model, base, donate=False,
+                          compression="identity"), *args)
+    assert t_id != t_off
+
+
+def test_compression_joins_step_cache_key(bf_ctx):
+    cx = bf_ctx
+    params = {"w": jnp.zeros((bf.size(), 3), jnp.float32)}
+    k_none = step_cache_key(cx, params, "xla", True, 1 << 20)
+    k_int8 = step_cache_key(cx, params, "xla", True, 1 << 20,
+                            compression=CP.resolve_compression("int8"))
+    k_int8b = step_cache_key(cx, params, "xla", True, 1 << 20,
+                             compression=CP.resolve_compression("int8"))
+    assert k_none != k_int8 and k_int8 == k_int8b
+
+
+# ---------------------------------------------------------------------------
+# Lossy numerics: error feedback + consensus contraction
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec,steps,factor,res_frac,res_decays", [
+    # quantization: contracts nearly as fast as exact gossip, residual
+    # stays at the quantization-noise floor (far below the iterate)
+    ("int8", 6, 100, 0.1, False),
+    # sparsification: a 50% sparsifier's step-0 residual is, by
+    # construction, the untransmitted HALF of the iterate — same order
+    # as the parameter norm; "bounded" means it never grows past a few
+    # times the iterate.  Top-k's magnitude selection DRAINS the
+    # residual (the biggest errors transmit next); random-k's floor is
+    # the unmasked half of whatever the iterate converges to, which
+    # need not halve — mesh-size dependent, so no decay assertion
+    ("topk:0.5", 12, 10, 3.0, True),
+    ("randomk:0.5", 12, 10, 3.0, False),
+])
+def test_consensus_contracts_under_compression(bf_ctx, spec, steps,
+                                               factor, res_frac,
+                                               res_decays):
+    rng = np.random.default_rng(6)
+    params = ragged_tree(bf.size(), rng)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression=spec, telemetry=True)
+    st = opt.init(params)
+    p = params
+    series, res_norms = [], []
+    for t in range(steps):
+        p, st, snap = opt.step(p, grads, st, t)
+        series.append(float(np.asarray(snap.consensus_dist).mean()))
+        res_norms.append(float(np.asarray(snap.residual_norm).mean()))
+    assert all(np.isfinite(series))
+    assert series[-1] < series[0] / factor, series
+    # error-feedback residual bounded and non-exploding
+    pn = float(np.asarray(snap.param_norm).mean())
+    assert all(np.isfinite(res_norms))
+    assert max(res_norms) < res_frac * pn, (res_norms, pn)
+    if res_decays:
+        assert res_norms[-1] < res_norms[0] / 2, res_norms
+    # compression telemetry fields populated
+    assert float(np.asarray(snap.compress_ratio).mean()) > 1.0
+    assert float(np.asarray(snap.wire_bytes).mean()) > 0.0
+
+
+def test_choco_identity_gamma1_matches_plain_gossip(bf_ctx):
+    """With the identity compressor and gamma=1, the CHOCO recursion's
+    step-1+ mix equals plain neighbor averaging (x_hat == x after one
+    delta): the difference-gossip recursion is exact at zero compression.
+    """
+    rng = np.random.default_rng(7)
+    params = ragged_tree(bf.size(), rng, dtype_b=jnp.float32)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    plain = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    choco = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression="choco:identity:gamma=1")
+    sp, sc = plain.init(params), choco.init(params)
+    pp = pc = params
+    for t in range(3):
+        pp, sp = plain.step(pp, grads, sp, t)[:2]
+        pc, sc = choco.step(pc, grads, sc, t)[:2]
+    for k in params:
+        np.testing.assert_allclose(np.asarray(pp[k], np.float32),
+                                   np.asarray(pc[k], np.float32),
+                                   atol=1e-5, err_msg=f"leaf {k}")
+
+
+def test_choco_gamma_defaults_scale_with_fraction():
+    """Satellite of the γ-stability finding: CHOCO with γ ≫ ω diverges
+    after an initial contraction, so the DEFAULT γ must track the
+    sparsifier's kept fraction."""
+    assert CP.resolve_compression("choco:topk:0.1").gamma == 0.1
+    assert CP.resolve_compression("choco:randomk:0.02").gamma == 0.02
+    assert CP.resolve_compression("choco:topk:0.9").gamma == 0.5
+    assert CP.resolve_compression("choco:int8").gamma == 0.5
+    # explicit gamma always wins
+    assert CP.resolve_compression("choco:topk:0.1:gamma=0.3").gamma == 0.3
+
+
+def test_choco_topk_contracts_where_direct_stalls(bf_ctx):
+    """CHOCO under aggressive top-k (DEFAULT gamma = the kept fraction):
+    consensus must keep contracting over a long horizon — the difference
+    compression drains the full disagreement, unlike direct sparsified
+    gossip (whose floor the direct test above documents), and the
+    fraction-scaled default γ keeps the recursion in its stable region
+    (γ ≫ ω contracts briefly and then diverges; docs/compression.md)."""
+    rng = np.random.default_rng(8)
+    params = ragged_tree(bf.size(), rng)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression="choco:topk:0.25", telemetry=True)
+    st = opt.init(params)
+    p = params
+    series = []
+    for t in range(40):
+        p, st, snap = opt.step(p, grads, st, t)
+        series.append(float(np.asarray(snap.consensus_dist).mean()))
+    assert all(np.isfinite(series))
+    # deep contraction AND no late-horizon blow-back
+    assert series[-1] < series[0] / 100, (series[0], series[-1])
+    assert series[-1] <= min(series) * 10, series[-10:]
+
+
+def test_compressed_training_loss_decreases(bf_ctx):
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    rng = np.random.default_rng(9)
+    model = MLP(features=(16,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    step_fn = T.make_train_step(model, base, compression="int8",
+                                donate=False)
+    x = jnp.asarray(rng.normal(size=(n, 2, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(n, 2)))
+    losses = []
+    for t in range(5):
+        variables, opt_state, loss = step_fn(variables, opt_state, (x, y),
+                                             jnp.int32(t))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_degraded_guard_resets_residuals(bf_ctx):
+    """The degraded local branch must zero the carried compression state
+    (self-weight fallback with residuals reset)."""
+    from jax.sharding import PartitionSpec as P
+    cx = bf_ctx
+    n = bf.size()
+    base = optax.sgd(0.0)
+    cfg = CP.resolve_compression("int8")
+    comm = S.consensus_step(base, S.CommunicationType.neighbor_allreduce,
+                            cx.rank_axis, topo=cx.compiled_topology,
+                            nar_backend="xla", compression=cfg)
+    local = S.local_sgd_like_step(base, degraded=True, compression=cfg)
+    guarded = S.with_degraded_guard(comm, local)
+    spec = P(cx.rank_axis)
+
+    def stepper(params, grads, st, step, degraded):
+        def sf(p, g, s, si, dg):
+            out = guarded(jax.tree.map(lambda a: a[0], p),
+                          jax.tree.map(lambda a: a[0], g),
+                          jax.tree.map(lambda a: a[0], s), si, dg)
+            return jax.tree.map(lambda a: a[None], out)
+        return jax.shard_map(
+            sf, mesh=cx.mesh, in_specs=(spec, spec, spec, P(), P()),
+            out_specs=(spec, spec))(params, grads, st, step, degraded)
+
+    f = jax.jit(stepper)
+    rng = np.random.default_rng(10)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)}
+    grads = jax.tree.map(jnp.zeros_like, params)
+    st = jax.vmap(lambda p: S.compress_wrap_init(base, p, cfg))(params)
+    # one comm step accumulates a nonzero residual
+    p1, st1 = f(params, grads, st, jnp.int32(0), jnp.asarray(False))
+    r1 = np.abs(np.asarray(st1["compress"]["residual"][0])).max()
+    assert r1 > 0.0
+    # a degraded step resets it to zero
+    _, st2 = f(p1, grads, st1, jnp.int32(1), jnp.asarray(True))
+    r2 = np.abs(np.asarray(st2["compress"]["residual"][0])).max()
+    assert r2 == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Trace-level evidence + byte-estimator regressions
+# ---------------------------------------------------------------------------
+
+def test_int8_step_moves_3x_fewer_ppermute_bytes(bf_ctx):
+    """The acceptance gate in miniature: the compressed train step's
+    lowered program moves >= 3x fewer ppermute payload bytes — which also
+    exercises the estimator on i8 wire tensors."""
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    model = MLP(features=(16, 16), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    x = jnp.zeros((n, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 2), jnp.int32)
+    c_off = TM.collective_counts(
+        T.make_train_step(model, base, donate=False),
+        variables, opt_state, (x, y), jnp.int32(0))
+    _, ost8 = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    c_int8 = TM.collective_counts(
+        T.make_train_step(model, base, donate=False, compression="int8"),
+        variables, ost8, (x, y), jnp.int32(0))
+    assert c_int8["ppermute_bytes"] > 0
+    assert c_off["ppermute_bytes"] >= 3 * c_int8["ppermute_bytes"], \
+        (c_off["ppermute_bytes"], c_int8["ppermute_bytes"])
+
+
+def test_byte_estimator_non_f32_stablehlo():
+    text = """
+%0 = "stablehlo.collective_permute"(%a) : (tensor<100xi8>) -> tensor<100xi8>
+%1 = "stablehlo.collective_permute"(%b) : (tensor<50xbf16>) -> tensor<50xbf16>
+%2 = "stablehlo.collective_permute"(%c) : (tensor<8xf8E4M3FN>) -> tensor<8xf8E4M3FN>
+%3 = "stablehlo.collective_permute"(%d) : (tensor<4xui8>) -> tensor<4xui8>
+"""
+    c = TM.count_collectives_in_text(text)
+    assert c["ppermute"] == 4
+    assert c["ppermute_bytes"] == 100 + 100 + 8 + 4
+
+
+def test_byte_estimator_non_f32_hlo_dialect():
+    text = """
+%p0 = s8[256]{0} collective-permute(%x), channel_id=1
+%p1 = bf16[32,4]{1,0} collective-permute(%y), channel_id=2
+%p2 = f8e4m3fn[16]{0} collective-permute(%z), channel_id=3
+%p3 = u8[12]{0} collective-permute(%w), channel_id=4
+"""
+    c = TM.count_collectives_in_text(text)
+    assert c["ppermute"] == 4
+    assert c["ppermute_bytes"] == 256 + 256 + 16 + 12
+
+
+def test_byte_estimator_unknown_dtype_still_zero():
+    text = ('%0 = "stablehlo.collective_permute"(%a) : '
+            "(tensor<4xmystery>) -> tensor<4xmystery>")
+    assert TM.count_collectives_in_text(text)["ppermute_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Windows / resilience / telemetry integrations
+# ---------------------------------------------------------------------------
+
+def test_window_identity_compression_bitexact(bf_ctx):
+    n = bf.size()
+    rng = np.random.default_rng(11)
+    tree = {"a": jnp.asarray(rng.normal(size=(n, 6)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(n, 3, 2)), jnp.float32)}
+    assert W.win_create(tree, "tcU")
+    W.win_put(tree, "tcU")
+    avg_u = W.win_update("tcU")
+    W.win_free("tcU")
+    assert W.win_create(tree, "tcI", compression="identity")
+    W.win_put(tree, "tcI")
+    avg_i = W.win_update("tcI")
+    W.win_free("tcI")
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(avg_i[k]),
+                                      np.asarray(avg_u[k]))
+
+
+def test_window_int8_compression_close_and_choco_rejected(bf_ctx):
+    n = bf.size()
+    rng = np.random.default_rng(12)
+    tree = {"a": jnp.asarray(rng.normal(size=(n, 8)), jnp.float32)}
+    assert W.win_create(tree, "tc8", compression="int8")
+    W.win_put(tree, "tc8")
+    avg_c = W.win_update("tc8")
+    W.win_free("tc8")
+    assert W.win_create(tree, "tcu2")
+    W.win_put(tree, "tcu2")
+    avg_u = W.win_update("tcu2")
+    W.win_free("tcu2")
+    assert np.abs(np.asarray(avg_c["a"]) -
+                  np.asarray(avg_u["a"])).max() < 0.05
+    # choco AND sparsifiers rejected: a window op has no carried state,
+    # so untransmitted-as-zero decoding would decay the buffers
+    for bad in ("choco:int8", "topk:0.1", "randomk:0.1"):
+        with pytest.raises(ValueError, match="dense quantizing"):
+            W.win_create(tree, "tcx", compression=bad)
+
+
+@pytest.mark.chaos
+def test_chaos_harness_int8_bounded_and_invariants(bf_ctx):
+    from bluefog_tpu.resilience import FaultPlan
+    n = bf.size()
+    rng = np.random.default_rng(13)
+    plan = FaultPlan(n, 14).rank_down(min(3, n - 1), at=5)
+    h = bf.resilience.ChaosHarness(plan, compression="int8")
+    x0 = jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)
+    rep = h.run(x0, steps=14)
+    rep.check_matrix_invariants()
+    rep.assert_bounded(max_consensus_error=5.0)
+    with pytest.raises(ValueError, match="direct compression specs only"):
+        bf.resilience.ChaosHarness(plan, compression="choco:int8")
+
+
+def test_window_family_telemetry_snapshot(bf_ctx):
+    """Satellite: the window optimizers now carry in-graph telemetry
+    (previously silently pinned off) — telemetry on returns a 3-tuple
+    with finite fields, off keeps the 2-tuple contract."""
+    n = bf.size()
+    rng = np.random.default_rng(14)
+    tree = {"a": jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)}
+    grads = jax.tree.map(jnp.zeros_like, tree)
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), telemetry=True)
+    st = opt.init(tree)
+    out = opt.step(tree, grads, st, 0)
+    assert len(out) == 3
+    snap = out[2]
+    assert np.isfinite(np.asarray(snap.consensus_dist)).all()
+    assert np.isfinite(np.asarray(snap.param_norm)).all()
+    opt.free()
+    opt2 = bf.DistributedWinPutOptimizer(optax.sgd(0.05), telemetry=False)
+    st2 = opt2.init(tree)
+    assert len(opt2.step(tree, grads, st2, 0)) == 2
+    opt2.free()
+
+
+def test_hierarchical_factory_rejects_compression(bf_ctx):
+    with pytest.raises(ValueError, match="hierarchical"):
+        bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+            optax.sgd(0.1), compression="int8")
+    # off values stay accepted (API uniformity)
+    bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(0.1), compression="none")
+
+
+def test_telemetry_snapshot_has_compression_fields():
+    assert "compress_ratio" in IG.FIELDS
+    assert "residual_norm" in IG.FIELDS
+    assert "wire_bytes" in IG.FIELDS
+
+
+def test_compress_metrics_registry(bf_ctx):
+    from bluefog_tpu.observability import metrics as M
+    was = M.enabled()
+    M.enable()
+    try:
+        M.registry  # touch
+        rng = np.random.default_rng(15)
+        params = ragged_tree(bf.size(), rng)
+        grads = jax.tree.map(jnp.zeros_like, params)
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.0), compression="int8")
+        st = opt.init(params)
+        opt.step(params, grads, st, 0)
+        snap = M.registry.snapshot()
+        assert any(k.startswith("bf_compress_consults_total")
+                   for k in snap), snap.keys()
+        assert snap["bf_compress_plan{field=ratio}"] > 1.0
+    finally:
+        if not was:
+            M.disable()

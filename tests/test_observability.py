@@ -245,12 +245,13 @@ def test_column_sum_flags_broken_repaired_matrix(bf_ctx):
     R = repair_matrix(W, alive, family="column")   # healthy repair
     np.testing.assert_allclose(R.sum(axis=0), 1.0, atol=1e-9)
     broken = R.copy()
-    broken[:, 5] *= 0.8                             # the deliberate break
+    bad = N - 1        # derived from the mesh (N=4 CI leg has no rank 5)
+    broken[:, bad] *= 0.8                           # the deliberate break
     topo = bf.compile_weight_matrix(broken)
     col, row = _mass_harness(bf_ctx, topo)(jnp.int32(0))
     col = np.asarray(col)
-    assert abs(col[5] - 0.8) < 1e-6, col
-    healthy = np.delete(col, 5)
+    assert abs(col[bad] - 0.8) < 1e-6, col
+    healthy = np.delete(col, bad)
     np.testing.assert_allclose(healthy, 1.0, atol=1e-6)
 
 
