@@ -18,8 +18,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
-        bench-trace bench-overlap bench-compress hwcheck chaos \
-        metrics-smoke metrics-smoke-compress
+        bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
+        chaos metrics-smoke metrics-smoke-compress
 
 test:
 	$(PYTEST) tests/
@@ -100,6 +100,28 @@ bench-compress:
 	         c['int8']['ppermute_bytes_per_step'], r['int8'], \
 	         c['topk']['ppermute_bytes_per_step'], r['topk'])); \
 	assert r['int8'] >= 3.0, 'int8 wire reduction %.2fx < 3x' % r['int8']"
+
+# Hybrid scale-out evidence (CPU, docs/hybrid_scaleout.md): bench-trace
+# JSON with the "hybrid" block — per-rank ppermute bytes/step of the
+# decentralized (dp, fsdp) train step at fsdp=1 (replicated fused path)
+# vs fsdp=2 vs fsdp=2+int8 — summarized on one line and GATED: exits
+# non-zero unless fsdp=2 moves >= 2x fewer per-rank gossip bytes than
+# the replicated fused path AND int8 on top multiplies the reduction.
+bench-hybrid:
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); h=d['hybrid']; r=d['hybrid_bytes_drop']; \
+	assert h, 'hybrid block skipped: bench needs an even mesh of >= 4 devices (got %s)' % d['mesh']; \
+	print(json.dumps(d)); \
+	print('per-rank gossip bytes/step: replicated %d | fsdp2 %d (%.2fx) ' \
+	      '| fsdp2+int8 %d (%.2fx)' \
+	      % (h['replicated']['ppermute_bytes_per_step'], \
+	         h['fsdp2']['ppermute_bytes_per_step'], r['fsdp2'], \
+	         h['fsdp2_int8']['ppermute_bytes_per_step'], \
+	         r['fsdp2_int8'])); \
+	assert r['fsdp2'] >= 2.0, 'fsdp=2 wire reduction %.2fx < 2x' % r['fsdp2']; \
+	assert h['fsdp2_int8']['ppermute_bytes_per_step'] * 2 \
+	       <= h['fsdp2']['ppermute_bytes_per_step'], \
+	       'int8 on top of fsdp=2 did not multiply the reduction'"
 
 # Observability smoke (<=60s, CPU): 5-step telemetry-on loop — validates
 # the JSONL schema (BLUEFOG_METRICS sink) and that consensus distance is

@@ -434,6 +434,50 @@ def trace_only_main():
             "hlo_lines": entry["hlo_lines"],
         }
 
+    # Hybrid scale-out evidence (docs/hybrid_scaleout.md): the SAME
+    # decentralized train step on a (dp, fsdp) mesh — FSDP shards the
+    # weight update inside a pod, gossip runs over the dp axis only, so
+    # each rank's ppermute payload is its 1/fsdp shard.  The acceptance
+    # gate (`make bench-hybrid`): per-rank gossip bytes/step at fsdp=2
+    # must be <= 1/2 of the replicated (fsdp=1) fused path, and int8 on
+    # top must multiply the reduction.
+    hybrid_report = {}
+    hybrid_drop = {}
+    if n >= 4 and n % 2 == 0:
+        from bluefog_tpu.parallel import topology as topo_mod
+        from bluefog_tpu.parallel.fsdp import (
+            dfsdp_mesh, make_decentralized_fsdp_lm_train_step)
+        from bluefog_tpu.parallel.schedule import compile_topology
+
+        hdp = n // 2
+        htopo = compile_topology(topo_mod.ExponentialGraph(hdp))
+        hmodel = MLP(features=(32,) * depth, num_outputs=10)
+        hparams = hmodel.init(jax.random.key(0),
+                              jnp.zeros((1, 8, 8, 1)))["params"]
+        hx = jnp.zeros((hdp, 4, 8, 8, 1), jnp.float32)
+        hy = jnp.zeros((hdp, 4), jnp.int32)
+        for label, fsdp_n, spec in (("replicated", 1, None),
+                                    ("fsdp2", 2, None),
+                                    ("fsdp2_int8", 2, "int8")):
+            hmesh = dfsdp_mesh(dp=hdp, fsdp=fsdp_n)
+            hstep, hplace = make_decentralized_fsdp_lm_train_step(
+                hmodel, base, hmesh, topo=htopo, donate=False, fuse=True,
+                compression=spec)
+            hp, ho = hplace(hparams)
+            entry = TM.collective_counts(hstep, hp, ho, hx, hy,
+                                         jnp.int32(0))
+            hybrid_report[label] = {
+                "ppermute": entry["ppermute"],
+                "ppermute_bytes_per_step": entry["ppermute_bytes"],
+                "total_collective_bytes_per_step": entry["total_bytes"],
+                "hlo_lines": entry["hlo_lines"],
+            }
+        rep = hybrid_report["replicated"]["ppermute_bytes_per_step"]
+        hybrid_drop = {
+            lbl: round(rep / max(
+                hybrid_report[lbl]["ppermute_bytes_per_step"], 1), 2)
+            for lbl in ("fsdp2", "fsdp2_int8")}
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -455,6 +499,8 @@ def trace_only_main():
                        / max(compress_report[lbl]
                              ["ppermute_bytes_per_step"], 1), 2)
             for lbl in ("int8", "topk")},
+        "hybrid": hybrid_report,
+        "hybrid_bytes_drop": hybrid_drop,
         # final host-registry snapshot: comm-volume, fusion-plan shape and
         # cache stats travel WITH the perf number in the BENCH_*.json
         "metrics": bf_metrics.registry.snapshot(),

@@ -56,8 +56,8 @@ from ..observability import metrics as _metrics
 from . import compressors as CP
 
 __all__ = [
-    "stateful", "init_state", "reset_state", "compressed_mix",
-    "wire_stats", "check_supported",
+    "stateful", "init_state", "sharded_state_layout", "reset_state",
+    "compressed_mix", "wire_stats", "check_supported",
 ]
 
 # base PRNG seed for the shared (step, bucket) keys; any constant works —
@@ -108,22 +108,25 @@ def check_supported(cfg: Optional[CP.CompressionConfig], *,
                 "('int8', 'topk:...') under overlap")
 
 
-def _zero_state_bufs(tree, fuse: bool, bucket_bytes: Optional[int]):
-    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
+def _zero_state_bufs(tree, fuse: bool, bucket_bytes: Optional[int],
+                     leaf_groups=None):
+    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes,
+                              leaf_groups=leaf_groups)
     return tuple(jnp.zeros_like(b) for b in bufs)
 
 
 def init_state(cfg: Optional[CP.CompressionConfig], params, *,
                fuse: Optional[bool] = None,
-               bucket_bytes: Optional[int] = None):
+               bucket_bytes: Optional[int] = None, leaf_groups=None):
     """Per-rank compression state for ``params``, or ``None`` when the
     config is stateless.  ``fuse``/``bucket_bytes`` must resolve to the
     SAME values the step builder uses — the carried-buffer layout is part
-    of the state structure (exactly the ``delayed_init`` contract)."""
+    of the state structure (exactly the ``delayed_init`` contract);
+    ``leaf_groups`` likewise when the exchange buckets with groups."""
     if not stateful(cfg):
         return None
     fuse = F.fusion_enabled(fuse)
-    bufs = _zero_state_bufs(params, fuse, bucket_bytes)
+    bufs = _zero_state_bufs(params, fuse, bucket_bytes, leaf_groups)
     if cfg.choco:
         # the warmup estimates are ZERO (not x_0): every rank's copy of
         # x_hat_j must start identical WITHOUT a communication round, and
@@ -133,6 +136,37 @@ def init_state(cfg: Optional[CP.CompressionConfig], params, *,
         return {"xhat": bufs,
                 "shat": tuple(jnp.zeros_like(b) for b in bufs)}
     return {"residual": bufs}
+
+
+def sharded_state_layout(cfg: Optional[CP.CompressionConfig], params,
+                         inner_specs, mesh, *, gossip_axis: str = "dp",
+                         fuse: Optional[bool] = None,
+                         bucket_bytes: Optional[int] = None):
+    """Zero per-rank compression state for the HYBRID sharded-
+    decentralized path, in the GLOBAL view a ``(dp, fsdp)`` train step
+    carries (``parallel/tensor.py``).
+
+    The codec there encodes each mesh cell's 1/fsdp SHARD of every fused
+    bucket, so the error-feedback residuals (and CHOCO replica estimates)
+    are shard-sized too and live SHARDED in the donated opt state: fused
+    buffers come out ``[dp, fsdp, padded_shard]`` placed
+    ``P(gossip, fsdp)``; the unfused layout mirrors the parameter leaves
+    with their own within-replica specs.  ``params`` is the SINGLE-replica
+    tree, ``inner_specs`` its within-replica spec tree.  Returns ``None``
+    for stateless configs — no layout change, exactly like
+    :func:`init_state`."""
+    if not stateful(cfg):
+        return None
+    fuse = F.fusion_enabled(fuse)
+
+    def zeros():
+        return tuple(F.sharded_zero_buffers(
+            params, inner_specs, mesh, gossip_axis=gossip_axis,
+            fuse=fuse, max_bucket_bytes=bucket_bytes))
+
+    if cfg.choco:
+        return {"xhat": zeros(), "shat": zeros()}
+    return {"residual": zeros()}
 
 
 def reset_state(state):
@@ -200,16 +234,22 @@ def _note_metrics(cfg, wire_bytes: int, raw_bytes: int) -> None:
 
 def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
                    mode: str, axis_name, topo=None, sched=None, step=0,
-                   fuse: bool = True, bucket_bytes: Optional[int] = None):
+                   fuse: bool = True, bucket_bytes: Optional[int] = None,
+                   leaf_groups=None):
     """One compressed exchange of ``tree`` (per-rank, inside shard_map).
 
     ``mode``: ``"neighbor"`` (weighted gossip over ``topo``/``sched``) or
     ``"allreduce"`` (global mean via compressed all_gather).  Returns
     ``(mixed_tree, new_state, diag)`` where ``diag`` carries traced f32
     ``residual_norm`` plus static ``wire_bytes``/``ratio`` for the
-    telemetry snapshot."""
+    telemetry snapshot.  ``leaf_groups`` (hybrid 2-level meshes,
+    ``ops/fusion.py::shard_groups``): partitions the buckets so
+    inner-axis-replicated leaves never share codec statistics with
+    cell-varying shard data — their mixed value must be identical on
+    every cell."""
     comp = CP.get_compressor(cfg)
-    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
+    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes,
+                              leaf_groups=leaf_groups)
     wire_bytes, raw_bytes = wire_stats(cfg, bufs)
     _note_metrics(cfg, wire_bytes, raw_bytes)
     idx = lax.axis_index(axis_name)

@@ -117,33 +117,84 @@ def _buffers(tree, fuse: bool, bucket_bytes: Optional[int]):
 
 
 def consensus_distance(tree, axis_name, fuse: bool = True,
-                       bucket_bytes: Optional[int] = None):
+                       bucket_bytes: Optional[int] = None, sum_axis=None,
+                       leaf_weights=None):
     """``||x_i - x_bar||^2`` in f32: one pmean per fusion bucket, squared
     distance accumulated over buckets.  Padding tail elements are equal
-    (zero) on every rank and contribute exactly 0."""
+    (zero) on every rank and contribute exactly 0.
+
+    ``sum_axis`` (the hybrid sharded-decentralized path): the mesh
+    axis/axes the PARAMETERS are sharded over.  The pmean must run over
+    ``axis_name`` (the gossip axis) ONLY — averaging over the model-
+    sharding axis would compare different parameter shards and hide
+    cross-pod disagreement — while the per-shard squared distances psum
+    over ``sum_axis`` so every rank reports its replica's FULL-parameter
+    consensus distance.
+
+    ``leaf_weights`` (a float tree matching ``tree``): per-leaf factor on
+    the squared contribution.  The hybrid path passes 1/replication for
+    leaves the fsdp axis could not shard (every cell holds them whole, so
+    the ``sum_axis`` psum would otherwise count them fsdp times).  The
+    collective count stays one pmean per non-empty bucket — only the
+    local accumulation changes."""
+    if leaf_weights is None:
+        d = jnp.float32(0.0)
+        for b in _buffers(tree, fuse, bucket_bytes):
+            mean = lax.pmean(b, axis_name)
+            d = d + jnp.sum((b - mean) ** 2)
+        if sum_axis:
+            d = lax.psum(d, sum_axis)
+        return d
+    plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes)
+    diffs = []
+    for b in bufs:
+        b32 = b.astype(jnp.float32)
+        diffs.append(b32 - lax.pmean(b32, axis_name) if b.size else b32)
     d = jnp.float32(0.0)
-    for b in _buffers(tree, fuse, bucket_bytes):
-        mean = lax.pmean(b, axis_name)
-        d = d + jnp.sum((b - mean) ** 2)
+    for dl, w in zip(jax.tree.leaves(F.restore(plan, tree, diffs)),
+                     jax.tree.leaves(leaf_weights)):
+        if dl.size:
+            d = d + jnp.float32(w) * jnp.sum(jnp.square(dl))
+    if sum_axis:
+        d = lax.psum(d, sum_axis)
     return d
 
 
-def tree_l2(tree):
-    """f32 l2 norm over every element of the tree."""
+def tree_l2(tree, sum_axis=None, leaf_weights=None):
+    """f32 l2 norm over every element of the tree (``sum_axis``: psum the
+    squared sum over the model-sharding axis first, so sharded trees
+    report the full-replica norm; ``leaf_weights`` as in
+    :func:`consensus_distance`)."""
     s = jnp.float32(0.0)
-    for l in jax.tree.leaves(tree):
+    ws = (None if leaf_weights is None
+          else jax.tree.leaves(leaf_weights))
+    for i, l in enumerate(jax.tree.leaves(tree)):
         if l.size:
-            s = s + jnp.sum(jnp.square(l.astype(jnp.float32)))
+            q = jnp.sum(jnp.square(l.astype(jnp.float32)))
+            if ws is not None:
+                q = jnp.float32(ws[i]) * q
+            s = s + q
+    if sum_axis:
+        s = lax.psum(s, sum_axis)
     return jnp.sqrt(s)
 
 
-def tree_diff_l2(a, b):
-    """f32 l2 norm of ``a - b`` (same structure)."""
+def tree_diff_l2(a, b, sum_axis=None, leaf_weights=None):
+    """f32 l2 norm of ``a - b`` (same structure; ``sum_axis`` and
+    ``leaf_weights`` as in :func:`tree_l2`)."""
     s = jnp.float32(0.0)
-    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+    ws = (None if leaf_weights is None
+          else jax.tree.leaves(leaf_weights))
+    for i, (la, lb) in enumerate(zip(jax.tree.leaves(a),
+                                     jax.tree.leaves(b))):
         if la.size:
             diff = la.astype(jnp.float32) - lb.astype(jnp.float32)
-            s = s + jnp.sum(jnp.square(diff))
+            q = jnp.sum(jnp.square(diff))
+            if ws is not None:
+                q = jnp.float32(ws[i]) * q
+            s = s + q
+    if sum_axis:
+        s = lax.psum(s, sum_axis)
     return jnp.sqrt(s)
 
 
@@ -184,7 +235,7 @@ def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
                       col_sum, row_sum, fuse, bucket_bytes,
                       staleness=0.0, warmup=0.0, degraded=0.0,
                       compress_ratio=1.0, residual_norm=0.0,
-                      wire_bytes=0.0,
+                      wire_bytes=0.0, sum_axis=None, leaf_weights=None,
                       measure_consensus: bool = True) -> TelemetrySnapshot:
     """Assemble the snapshot a strategy step returns.
 
@@ -193,17 +244,30 @@ def strategy_snapshot(*, step, new_params, old_params, grads, axis_name,
     which must issue NO collective) reports :data:`UNMEASURED` instead.
     ``warmup`` may be traced (the overlapped variants derive it from the
     in-flight self weight); ``residual_norm`` may be traced (the
-    compressed exchange's carried-error l2)."""
+    compressed exchange's carried-error l2).
+
+    ``sum_axis`` (the hybrid ``(dp, fsdp)`` path): the model-sharding
+    axis/axes.  Consensus stays a pmean over ``axis_name`` — the gossip
+    axis only — and every squared aggregate (consensus, norms) psums over
+    ``sum_axis``, so each rank reports full-replica health for its 1/fsdp
+    shard's exchange; ``leaf_weights`` de-duplicates leaves the sharding
+    replicated (:func:`consensus_distance`)."""
     if measure_consensus:
-        cd = consensus_distance(new_params, axis_name, fuse, bucket_bytes)
+        cd = consensus_distance(new_params, axis_name, fuse, bucket_bytes,
+                                sum_axis=sum_axis,
+                                leaf_weights=leaf_weights)
     else:
         cd = jnp.float32(UNMEASURED)
     return TelemetrySnapshot(
         step=jnp.asarray(step, jnp.int32),
         consensus_dist=cd,
-        param_norm=tree_l2(new_params),
-        grad_norm=tree_l2(grads),
-        update_norm=tree_diff_l2(new_params, old_params),
+        param_norm=tree_l2(new_params, sum_axis=sum_axis,
+                           leaf_weights=leaf_weights),
+        grad_norm=tree_l2(grads, sum_axis=sum_axis,
+                          leaf_weights=leaf_weights),
+        update_norm=tree_diff_l2(new_params, old_params,
+                                 sum_axis=sum_axis,
+                                 leaf_weights=leaf_weights),
         mix_col_sum=jnp.asarray(col_sum, jnp.float32),
         mix_row_sum=jnp.asarray(row_sum, jnp.float32),
         staleness=jnp.asarray(staleness, jnp.float32),

@@ -58,7 +58,13 @@ __all__ = [
     "fusion_enabled",
     "resolve_max_bucket_bytes",
     "plan_bytes",
+    "gossip_wire_bytes",
     "plan_for",
+    "shard_shape",
+    "shard_groups",
+    "shard_plan_for",
+    "norm_spec",
+    "sharded_zero_buffers",
     "flatten",
     "unflatten",
     "flat_views",
@@ -149,9 +155,14 @@ def _abstract_signature(tree, leading_dims: int):
 
 @functools.lru_cache(maxsize=512)
 def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
-                leading_dims: int) -> FusionPlan:
+                leading_dims: int,
+                leaf_groups: Optional[Tuple[Any, ...]] = None) -> FusionPlan:
     # stable dtype grouping in first-appearance order (determinism matters:
-    # the window subsystem persists fused state across checkpoints)
+    # the window subsystem persists fused state across checkpoints).
+    # ``leaf_groups`` adds a caller-chosen partition on top of the dtype
+    # one — the hybrid mesh path separates inner-axis-SHARDED from
+    # REPLICATED leaves so a replicated leaf's bucket statistics (codec
+    # scales) never see cell-varying shard data (see shard_groups).
     order: List[Any] = []
     groups = {}
     for i, (shape, dtype) in enumerate(sig):
@@ -164,14 +175,15 @@ def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
         if size == 0 or int(np.prod(shape, dtype=np.int64)) == 0:
             groups.setdefault(None, []).append((i, shape, dtype, 0))
             continue
-        key = jnp.dtype(dtype)
+        key = (leaf_groups[i] if leaf_groups is not None else None,
+               jnp.dtype(dtype))
         if key not in groups:
             order.append(key)
         groups.setdefault(key, []).append((i, shape, dtype, size))
 
     slots: List[Optional[_Slot]] = [None] * len(sig)
     buckets: List[_Bucket] = []
-    itemsize = {k: jnp.dtype(k).itemsize for k in order}
+    itemsize = {k: jnp.dtype(k[1]).itemsize for k in order}
     for key in order:
         current: List[Tuple[int, Tuple[int, ...], Any, int]] = []
         cur_elems = 0
@@ -186,7 +198,8 @@ def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
                                  shape=shape, dtype=jnp.dtype(dtype))
                 start += size
             padded = elems + ((-elems) % pad_to)
-            buckets.append(_Bucket(dtype=key, nelems=elems, padded=padded))
+            buckets.append(_Bucket(dtype=key[1], nelems=elems,
+                                   padded=padded))
 
         cap_elems = max(1, max_bytes // itemsize[key])
         for member in groups[key]:
@@ -211,7 +224,13 @@ def _build_plan(treedef, sig, max_bytes: int, pad_to: int,
 def plan_bytes(plan: FusionPlan) -> Tuple[int, int]:
     """(payload bytes, padding-waste bytes) of a plan's buckets, per
     leading slice — the fusion efficiency numbers the metrics registry
-    tracks."""
+    tracks.
+
+    On a plan built over LOCAL SHARD shapes (:func:`shard_plan_for`, the
+    hybrid ``(dp, fsdp)`` path) these are already PER-RANK wire numbers:
+    each mesh cell ships exactly its plan's buckets per collective offset,
+    so the replicated-path figure divides by the sharding factor with no
+    further accounting."""
     payload = sum(b.nelems * jnp.dtype(b.dtype).itemsize
                   for b in plan.buckets)
     waste = sum((b.padded - b.nelems) * jnp.dtype(b.dtype).itemsize
@@ -219,15 +238,170 @@ def plan_bytes(plan: FusionPlan) -> Tuple[int, int]:
     return int(payload), int(waste)
 
 
+def gossip_wire_bytes(plan: FusionPlan, n_transfers: int = 1) -> int:
+    """Per-rank bytes one gossip round puts on the wire for this plan:
+    the PADDED bucket bytes (padding tails ride the permutes too), times
+    ``n_transfers`` (one per circulant offset of the topology).  With a
+    shard plan this is the 1/fsdp-size per-rank number the hybrid path
+    moves — the quantity ``make bench-hybrid`` gates on."""
+    total = sum(b.padded * jnp.dtype(b.dtype).itemsize
+                for b in plan.buckets)
+    return int(total) * int(n_transfers)
+
+
+def shard_shape(shape: Tuple[int, ...], spec,
+                axis_sizes) -> Tuple[int, ...]:
+    """Local shard shape of one leaf under a ``PartitionSpec`` for the
+    mesh axes in ``axis_sizes`` (a ``{axis_name: size}`` mapping); axes
+    the spec does not name divide nothing.  Raises on non-divisible dims
+    — silent uneven sharding would corrupt the flatten offsets."""
+    out = list(shape)
+    for d, names in enumerate(spec):
+        if names is None:
+            continue
+        for name in (names if isinstance(names, tuple) else (names,)):
+            n = int(axis_sizes.get(name, 1))
+            if n <= 1:
+                continue
+            if out[d] % n:
+                raise ValueError(
+                    f"dim {d} of shape {tuple(shape)} is not divisible by "
+                    f"mesh axis {name!r} (size {n}); fusion shard plans "
+                    f"need even sharding")
+            out[d] //= n
+    return tuple(out)
+
+
+def shard_groups(specs, axis_names) -> Tuple[str, ...]:
+    """Per-leaf fusion group keys for a mesh-axis-aware plan: leaves the
+    given inner axes SHARD vs leaves they REPLICATE must never share a
+    bucket.  A replicated leaf's exchange must come out bitwise identical
+    on every inner-axis cell (its shard_map out_spec declares it
+    replicated), which under a lossy codec only holds when its bucket
+    statistics — e.g. the int8 per-bucket scale — see no cell-varying
+    shard data."""
+    from jax.sharding import PartitionSpec as P
+    out = []
+    wanted = set(axis_names)
+    for s in jax.tree_util.tree_flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]:
+        names = set()
+        for entry in s:
+            if entry is None:
+                continue
+            names.update(entry if isinstance(entry, tuple) else (entry,))
+        out.append("shard" if names & wanted else "rep")
+    return tuple(out)
+
+
+def shard_plan_for(tree, specs, axis_sizes, *,
+                   max_bucket_bytes: Optional[int] = None,
+                   pad_to: int = 1) -> FusionPlan:
+    """:func:`plan_for` over the LOCAL SHARD shapes of ``tree`` — the
+    mesh-axis-aware planning entry for the hybrid sharded-decentralized
+    path: buckets are laid out per shard and lane padding applies to the
+    shard, so the plan describes exactly the flat buffers a ``(dp, fsdp)``
+    cell builds inside ``shard_map`` (each rank's gossip payload is its
+    1/fsdp slice, never the replica).
+
+    ``specs`` is the within-replica ``PartitionSpec`` tree (e.g.
+    ``fsdp_specs``/``transformer_tp_rules`` output) and ``axis_sizes``
+    maps the model-sharding axis names to their mesh sizes.  The result
+    is the SAME cached :class:`FusionPlan` the shard_map body gets from
+    ``plan_for`` on its local tree — host-side state builders (in-flight
+    overlap buffers, compression residuals) use this to allocate matching
+    global-view buffers."""
+    from jax.sharding import PartitionSpec as P
+    leaves, treedef = jax.tree.flatten(tree)
+    spec_leaves = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    if len(leaves) != len(spec_leaves):
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, specs describe "
+            f"{len(spec_leaves)}")
+    shards = [
+        jax.ShapeDtypeStruct(
+            shard_shape(tuple(int(d) for d in leaf.shape), spec,
+                        axis_sizes),
+            leaf.dtype)
+        for leaf, spec in zip(leaves, spec_leaves)]
+    return plan_for(jax.tree.unflatten(treedef, shards),
+                    max_bucket_bytes=max_bucket_bytes, pad_to=pad_to,
+                    leaf_groups=shard_groups(specs, axis_sizes.keys()))
+
+
+def norm_spec(spec):
+    """Strip trailing ``None`` entries from a ``PartitionSpec``:
+    ``P('dp', 'fsdp', None)`` and ``P('dp', 'fsdp')`` describe the SAME
+    sharding but compare UNEQUAL as ``NamedSharding``s (observed on
+    jaxlib 0.4.x), and ``shard_map`` normalizes its outputs — so state
+    placed with the long spelling recompiles the step on its second call.
+    Every hybrid-path placement normalizes through here to match the
+    steady-state output shardings."""
+    from jax.sharding import PartitionSpec as P
+    entries = list(spec)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def sharded_zero_buffers(params, inner_specs, mesh, *,
+                         gossip_axis: str = "dp", fuse: bool = True,
+                         max_bucket_bytes: Optional[int] = None):
+    """Zero global-view carried buffers for the hybrid ``(dp, fsdp)``
+    path — the single home for the layout every hybrid state builder
+    allocates (the overlap in-flight buffers in
+    ``parallel/tensor.py::hybrid_inflight_state`` and the compression
+    residuals/estimates in ``compress/exchange.py::sharded_state_layout``
+    must stay structurally identical, or the carried opt state diverges
+    from what the shard_map body folds).
+
+    ``params`` is the SINGLE-replica tree, ``inner_specs`` its
+    within-replica spec tree.  Fused: one ``[dp, *inner_sizes, padded]``
+    buffer per shard-plan bucket, placed ``P(gossip_axis, *inner)``;
+    unfused: per-leaf ``[dp, ...]`` zeros with their own (normalized)
+    within-replica placements.  Returns a LIST in bucket / tree-flatten
+    order — callers tuple or unflatten it into their state shape."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    inner = tuple(a for a in mesh.axis_names if a != gossip_axis)
+    lead = (mesh.shape[gossip_axis],) + tuple(mesh.shape[a] for a in inner)
+    if fuse:
+        plan = shard_plan_for(params, inner_specs,
+                              {a: mesh.shape[a] for a in inner},
+                              max_bucket_bytes=max_bucket_bytes)
+        return [jax.device_put(
+                    jnp.zeros(lead + (b.padded,), b.dtype),
+                    NamedSharding(mesh, P(gossip_axis, *inner)))
+                for b in plan.buckets]
+    spec_leaves = jax.tree_util.tree_flatten(
+        inner_specs, is_leaf=lambda x: isinstance(x, P))[0]
+    return [jax.device_put(
+                jnp.zeros((lead[0],) + tuple(l.shape), l.dtype),
+                NamedSharding(mesh, norm_spec(P(gossip_axis, *s))))
+            for l, s in zip(jax.tree.leaves(params), spec_leaves)]
+
+
 def plan_for(tree, *, max_bucket_bytes: Optional[int] = None,
-             pad_to: int = 1, leading_dims: int = 0) -> FusionPlan:
+             pad_to: int = 1, leading_dims: int = 0,
+             leaf_groups=None) -> FusionPlan:
     """Build (or fetch the cached) :class:`FusionPlan` for ``tree``'s
     abstract signature.  Safe to call inside a traced function — the plan
-    depends only on static shapes/dtypes/structure."""
+    depends only on static shapes/dtypes/structure.
+
+    ``leaf_groups`` (one hashable per leaf, in tree-flatten order):
+    leaves with different group keys never share a bucket, on top of the
+    dtype partition.  The hybrid mesh path passes :func:`shard_groups` so
+    replicated and sharded leaves bucket separately."""
     treedef, sig = _abstract_signature(tree, leading_dims)
+    if leaf_groups is not None:
+        leaf_groups = tuple(leaf_groups)
+        if len(leaf_groups) != len(sig):
+            raise ValueError(
+                f"{len(leaf_groups)} leaf groups for a {len(sig)}-leaf "
+                f"tree")
     plan = _build_plan(treedef, sig,
                        resolve_max_bucket_bytes(max_bucket_bytes),
-                       int(pad_to), int(leading_dims))
+                       int(pad_to), int(leading_dims), leaf_groups)
     if _metrics.enabled():
         # trace-time only (compiled steps never re-enter Python here):
         # gauges describe the LAST plan consulted, the counter every
@@ -295,16 +469,17 @@ def unflatten(plan: FusionPlan, bufs: Sequence[jax.Array]):
 
 
 def flat_views(tree, *, fuse: bool = True,
-               max_bucket_bytes: Optional[int] = None, pad_to: int = 1):
+               max_bucket_bytes: Optional[int] = None, pad_to: int = 1,
+               leaf_groups=None):
     """``(plan, bufs)``: the fused dtype buckets when ``fuse`` (plan is
     the trace-time-cached one), else ``(None, leaves)`` — the single home
     for "give me the tree as the flat buffers the exchange moves", shared
     by the in-graph telemetry (``observability/ingraph.py``) and the
     compressed exchange (``compress/exchange.py``).  Invert with
-    :func:`restore`."""
+    :func:`restore`.  ``leaf_groups`` as in :func:`plan_for`."""
     if fuse:
         plan = plan_for(tree, max_bucket_bytes=max_bucket_bytes,
-                        pad_to=pad_to)
+                        pad_to=pad_to, leaf_groups=leaf_groups)
         return plan, flatten(plan, tree)
     return None, list(jax.tree.leaves(tree))
 
@@ -335,7 +510,7 @@ def zero_buffers(plan: FusionPlan,
 
 def fused_tree_map(fn: Callable, tree, *,
                    max_bucket_bytes: Optional[int] = None,
-                   pad_to: int = 1):
+                   pad_to: int = 1, leaf_groups=None):
     """Apply an elementwise-linear, shape/dtype-preserving collective once
     per fusion bucket instead of once per leaf.
 
@@ -346,7 +521,7 @@ def fused_tree_map(fn: Callable, tree, *,
     collective this layer fuses does); violations raise at trace time
     rather than silently corrupting the unflatten."""
     plan = plan_for(tree, max_bucket_bytes=max_bucket_bytes, pad_to=pad_to,
-                    leading_dims=0)
+                    leading_dims=0, leaf_groups=leaf_groups)
     bufs = flatten(plan, tree)
     out = []
     for spec, buf in zip(plan.buckets, bufs):

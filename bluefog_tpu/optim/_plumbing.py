@@ -15,17 +15,20 @@ from jax.sharding import PartitionSpec as P
 
 def step_cache_key(cx, params, nar_backend: str, fuse: bool,
                    bucket_bytes: int, overlap: bool = False,
-                   telemetry: bool = False, compression=None):
+                   telemetry: bool = False, compression=None,
+                   gossip_axis=None):
     """Everything that changes the COMPILED step program: mesh/topology
     identity, the exchange backend, the fusion knobs (they reshape the
     collective schedule), the overlap mode (it reshapes the carried state
     and the whole pipeline), the telemetry gate (it adds the snapshot
     outputs and their pmeans), the compression config (it changes the
     wire dtypes, the collective schedule, and possibly the state layout),
-    and the parameter tree structure.  One home for the tuple so the
-    wrappers and any future cache agree on what invalidates a step — a
-    knob resolved at build time but missing here would silently serve a
-    stale program."""
+    the gossip axis (the hybrid mesh builders exchange over one named
+    axis of a larger mesh — a different axis is a different collective
+    schedule), and the parameter tree structure.  One home for the tuple
+    so the wrappers and any future cache agree on what invalidates a
+    step — a knob resolved at build time but missing here would silently
+    serve a stale program."""
     return (id(cx.mesh),
             id(cx._compiled),
             id(cx._compiled_machine),
@@ -35,6 +38,7 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
             bool(overlap),
             bool(telemetry),
             None if compression is None else compression.spec,
+            gossip_axis,
             jax.tree.structure(params))
 
 

@@ -58,8 +58,17 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  fuse: Optional[bool] = None,
                  fusion_bucket_bytes: Optional[int] = None,
                  compression: Optional[CP.CompressionConfig] = None,
-                 comp_state=None):
+                 comp_state=None,
+                 fusion_groups=None):
     """Apply the configured averaging to ``params``.
+
+    ``axis_name`` is the GOSSIP axis — it need not be the whole mesh.
+    Inside a 2-level ``(dp, fsdp)`` ``shard_map`` (the hybrid sharded-
+    decentralized path, ``parallel/tensor.py``) every weight lookup,
+    mixing column, and collective here indexes ``lax.axis_index(axis_name)``
+    only, so the exchange runs per fsdp cell over the dp axis and each
+    rank's payload is its 1/fsdp shard; the fsdp axis never appears in
+    the schedule (GSPMD sharding of the flat buffers handles it).
 
     ``nar_backend``: exchange backend SNAPSHOT.  Builders capture it when
     the step is constructed (jit traces once and would otherwise freeze
@@ -82,6 +91,10 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     ``tests/test_compress.py``).  The compressed path runs its own
     ppermute loop, so ``nar_backend`` (the pallas kernels) does not apply
     to it.
+
+    ``fusion_groups`` (``ops/fusion.py::shard_groups``, hybrid path):
+    per-leaf bucket-partition keys — sharded and replicated leaves must
+    not share codec statistics on a 2-level mesh.
     """
     if compression is not None:
         if comm_type == CommunicationType.empty:
@@ -92,7 +105,7 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
             params, comp_state, compression, mode=mode,
             axis_name=axis_name, topo=topo, sched=sched, step=step,
             fuse=F.fusion_enabled(fuse),
-            bucket_bytes=fusion_bucket_bytes)
+            bucket_bytes=fusion_bucket_bytes, leaf_groups=fusion_groups)
     if comm_type == CommunicationType.empty:
         return params
     do_fuse = F.fusion_enabled(fuse)
@@ -137,7 +150,7 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     if do_fuse:
         return F.fused_tree_map(fn, params,
                                 max_bucket_bytes=fusion_bucket_bytes,
-                                pad_to=pad_to)
+                                pad_to=pad_to, leaf_groups=fusion_groups)
     return jax.tree.map(fn, params)
 
 
@@ -149,7 +162,8 @@ def _null_comp_diag():
 
 def _communicate_c(params, comm_type, axis_name, topo, sched, step,
                    machine_axes, machine_topo, nar_backend, fuse,
-                   fusion_bucket_bytes, cfg, comp_state):
+                   fusion_bucket_bytes, cfg, comp_state,
+                   fusion_groups=None):
     """:func:`_communicate` with a UNIFORM ``(tree, comp_state', diag)``
     return, so the strategy bodies need no per-site branching: ``cfg is
     None`` takes the exact uncompressed path (byte-identical StableHLO)
@@ -157,11 +171,13 @@ def _communicate_c(params, comm_type, axis_name, topo, sched, step,
     if cfg is None:
         tree = _communicate(params, comm_type, axis_name, topo, sched,
                             step, machine_axes, machine_topo, nar_backend,
-                            fuse, fusion_bucket_bytes)
+                            fuse, fusion_bucket_bytes,
+                            fusion_groups=fusion_groups)
         return tree, None, None
     return _communicate(params, comm_type, axis_name, topo, sched, step,
                         machine_axes, machine_topo, nar_backend, fuse,
-                        fusion_bucket_bytes, cfg, comp_state)
+                        fusion_bucket_bytes, cfg, comp_state,
+                        fusion_groups=fusion_groups)
 
 
 def _comp_snap_kwargs(diag):
@@ -175,9 +191,20 @@ def _comp_snap_kwargs(diag):
                 wire_bytes=diag["wire_bytes"])
 
 
-def _telemetry_axis(comm_type: CommunicationType, axis_name, machine_axes):
+def _telemetry_axis(comm_type: CommunicationType, axis_name, machine_axes,
+                    gossip_axis=None):
     """Axis (or axes) the telemetry pmean runs over: the flat rank axis,
-    or both mesh axes under the hierarchical 2-D plumbing."""
+    or both mesh axes under the hierarchical 2-D plumbing.
+
+    ``gossip_axis`` (the hybrid sharded-decentralized path,
+    ``parallel/tensor.py``): when set, the pmean runs over it ONLY — on a
+    ``(dp, fsdp)`` mesh a pmean over fsdp would average DIFFERENT
+    parameter shards, hiding exactly the cross-pod disagreement consensus
+    distance exists to expose; the fsdp reduction is a psum of squared
+    per-shard distances instead (``ingraph.strategy_snapshot(sum_axis=)``).
+    """
+    if gossip_axis is not None:
+        return gossip_axis
     if (comm_type == CommunicationType.hierarchical_neighbor_allreduce
             and machine_axes is not None):
         return tuple(machine_axes)
@@ -640,28 +667,32 @@ def _mix_self_weight(comm_type: CommunicationType, axis_name,
                        jnp.float32)[lax.axis_index(axis_name)]
 
 
-def _inflight_pack(neigh, fuse: bool, bucket_bytes: Optional[int]):
+def _inflight_pack(neigh, fuse: bool, bucket_bytes: Optional[int],
+                   fusion_groups=None):
     """Neighbor-part tree -> carried representation (flat dtype buckets
     under fusion: the plan is trace-time-cached, the buffers themselves are
     donated with the opt state, so XLA reuses the same handles every
     step)."""
     if not fuse:
         return neigh
-    plan = F.plan_for(neigh, max_bucket_bytes=bucket_bytes)
+    plan = F.plan_for(neigh, max_bucket_bytes=bucket_bytes,
+                      leaf_groups=fusion_groups)
     return tuple(F.flatten(plan, neigh))
 
 
 def _inflight_unpack(bufs, template, fuse: bool,
-                     bucket_bytes: Optional[int]):
+                     bucket_bytes: Optional[int], fusion_groups=None):
     if not fuse:
         return bufs
-    plan = F.plan_for(template, max_bucket_bytes=bucket_bytes)
+    plan = F.plan_for(template, max_bucket_bytes=bucket_bytes,
+                      leaf_groups=fusion_groups)
     return F.unflatten(plan, list(bufs))
 
 
 def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
                     machine_axes, machine_topo, nar_backend,
-                    fuse, bucket_bytes, compression=None, comp_state=None):
+                    fuse, bucket_bytes, compression=None, comp_state=None,
+                    fusion_groups=None):
     """Run the exchange on ``x`` and return the in-flight state the NEXT
     step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t.
 
@@ -674,20 +705,23 @@ def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
     full, cs_new, diag = _communicate_c(
         x, comm_type, axis_name, topo, sched, step, machine_axes,
         machine_topo, nar_backend, fuse, bucket_bytes, compression,
-        comp_state)
+        comp_state, fusion_groups=fusion_groups)
     d = _mix_self_weight(comm_type, axis_name, topo, sched, step)
     neigh = jax.tree.map(lambda f, l: f - d.astype(l.dtype) * l, full, x)
-    infl = {"bufs": _inflight_pack(neigh, fuse, bucket_bytes),
+    infl = {"bufs": _inflight_pack(neigh, fuse, bucket_bytes,
+                                   fusion_groups),
             "self_w": d}
     if compression is not None:
         return infl, cs_new, diag
     return infl
 
 
-def _delayed_fold(x, inflight, fuse: bool, bucket_bytes: Optional[int]):
+def _delayed_fold(x, inflight, fuse: bool, bucket_bytes: Optional[int],
+                  fusion_groups=None):
     """Fold the in-flight neighbor sum with the FRESH self term:
     ``d_prev * x + N_prev``.  At warmup (zero buffer, d=1) this is ``x``."""
-    neigh = _inflight_unpack(inflight["bufs"], x, fuse, bucket_bytes)
+    neigh = _inflight_unpack(inflight["bufs"], x, fuse, bucket_bytes,
+                             fusion_groups)
     d = inflight["self_w"]
     return jax.tree.map(lambda l, nb: d.astype(l.dtype) * l + nb, x, neigh)
 

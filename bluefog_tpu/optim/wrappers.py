@@ -310,7 +310,8 @@ class _JittedStrategyOptimizer:
                 self.fusion_bucket_bytes)
         telemetry = IG.telemetry_enabled(self.telemetry)
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
-                             self.overlap, telemetry, self.compression)
+                             self.overlap, telemetry, self.compression,
+                             gossip_axis=cx.rank_axis)
         hit = key in self._step_cache
         note_step_cache(hit)
         if not hit:

@@ -21,6 +21,7 @@ the gathered activations back for FLOPs, and the flash kernel keeps
 attention O(T) — together the classic long-context/large-model recipe.
 """
 
+import os
 from typing import Optional
 
 import jax
@@ -128,19 +129,39 @@ def make_fsdp_lm_train_step(model, base_opt: optax.GradientTransformation,
     return jax.jit(step, donate_argnums=(0, 1) if donate else ()), place
 
 
-def dfsdp_mesh(dp: int, fsdp: int, devices=None) -> Mesh:
+def dfsdp_mesh(dp: Optional[int] = None, fsdp: Optional[int] = None,
+               devices=None) -> Mesh:
     """A ``(dp, fsdp)`` mesh: ``dp`` decentralized replicas, each fully
-    sharded over ``fsdp`` ICI-adjacent chips (the trailing axis)."""
+    sharded over ``fsdp`` ICI-adjacent chips (the trailing axis).
+
+    ``fsdp=None`` reads ``BLUEFOG_MESH_FSDP`` (default 1 — pure
+    decentralized DP); ``dp=None`` takes every remaining device.  A
+    device list longer than ``dp * fsdp`` is TRIMMED, exactly like
+    :func:`fsdp_mesh` (the pre-fix behavior raised instead, so
+    ``dfsdp_mesh(2, 2)`` on an 8-device host failed while
+    ``fsdp_mesh(4)`` worked — regression-tested in
+    ``tests/test_fsdp.py``)."""
+    if fsdp is None:
+        fsdp = int(os.environ.get("BLUEFOG_MESH_FSDP", "1"))
+    if fsdp <= 0:
+        raise ValueError(f"fsdp must be positive, got {fsdp}")
     devices = np.asarray(devices if devices is not None
-                         else jax.devices()[: dp * fsdp])
-    if devices.size != dp * fsdp:
-        raise ValueError(f"need {dp * fsdp} devices, have {devices.size}")
-    return Mesh(devices.reshape(dp, fsdp), ("dp", "fsdp"))
+                         else jax.devices()).reshape(-1)
+    if dp is None:
+        dp = devices.size // fsdp
+        if dp == 0:
+            raise ValueError(
+                f"need at least {fsdp} devices for fsdp={fsdp}, have "
+                f"{devices.size}")
+    need = dp * fsdp
+    if devices.size < need:
+        raise ValueError(f"need {need} devices, have {devices.size}")
+    return Mesh(devices[:need].reshape(dp, fsdp), ("dp", "fsdp"))
 
 
 def make_decentralized_fsdp_lm_train_step(
         model, base_opt: optax.GradientTransformation, mesh: Mesh,
-        topo=None, sched=None, donate: bool = True):
+        topo=None, sched=None, donate: bool = True, **comm_kwargs):
     """Decentralized DP composed with FSDP on ONE ``(dp, fsdp)`` mesh.
 
     Sibling of ``tensor.make_decentralized_tp_lm_train_step`` (same
@@ -153,6 +174,14 @@ def make_decentralized_fsdp_lm_train_step(
     own 1/fsdp shard — the decentralized traffic shrinks with the
     sharding, exactly like the ×tp composition.
 
+    The exchange runs through the unified comm hot path
+    (``parallel/tensor.py::sharded_neighbor_mix``): ``comm_kwargs``
+    accepts ``fuse=``/``fusion_bucket_bytes=`` (shard-shaped flat
+    buckets), ``compression=`` (the codec encodes the 1/fsdp slice —
+    multiplying this composition's wire win), ``overlap=`` (staleness-1
+    delayed-mix pipeline) and ``telemetry=`` (consensus over the dp
+    gossip axis only); see ``docs/hybrid_scaleout.md``.
+
     Returns ``(step_fn, place_fn)`` with ``step_fn(params, opt_state,
     tokens, targets, step) -> (params, opt_state, loss)``;
     ``tokens``/``targets`` are [dp, B_local, T]; parameter leaves carry a
@@ -162,4 +191,4 @@ def make_decentralized_fsdp_lm_train_step(
     return make_decentralized_sharded_lm_train_step(
         model, base_opt, mesh,
         lambda p: fsdp_specs(p, mesh, axis="fsdp"),
-        topo=topo, sched=sched, donate=donate)
+        topo=topo, sched=sched, donate=donate, **comm_kwargs)

@@ -120,6 +120,29 @@ def test_fsdp_multi_step_training_decreases_loss():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+def test_dfsdp_mesh_trims_devices_like_fsdp_mesh(monkeypatch):
+    """Regression: ``dfsdp_mesh`` used to demand EXACTLY dp*fsdp devices
+    (``dfsdp_mesh(2, 2, devices=jax.devices())`` raised on an 8-device
+    host) while ``fsdp_mesh`` trimmed; both now trim, and
+    ``dfsdp_mesh()`` resolves its shape from the device count and
+    ``BLUEFOG_MESH_FSDP``."""
+    from bluefog_tpu.parallel.fsdp import dfsdp_mesh
+
+    if N < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = dfsdp_mesh(2, 2, devices=jax.devices())   # N > 4: must trim
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 2}
+    # defaults: fsdp from env (1), dp = everything that fits
+    monkeypatch.delenv("BLUEFOG_MESH_FSDP", raising=False)
+    assert dict(dfsdp_mesh().shape) == {"dp": N, "fsdp": 1}
+    monkeypatch.setenv("BLUEFOG_MESH_FSDP", "2")
+    assert dict(dfsdp_mesh().shape) == {"dp": N // 2, "fsdp": 2}
+    with pytest.raises(ValueError):
+        dfsdp_mesh(N, 2)                             # genuinely too few
+    with pytest.raises(ValueError):
+        dfsdp_mesh(2, 0)
+
+
 def test_decentralized_fsdp_matches_unsharded_decentralized():
     """dp x fsdp composition: replicas neighbor-average their ZeRO shards;
     result must equal the unsharded decentralized computation."""

@@ -1,0 +1,552 @@
+"""Hybrid sharded-decentralized comm hot path: (dp, fsdp) mesh tests.
+
+The equivalence bar mirrors the fusion/overlap/compress suites: the
+mesh-axis-aware exchange (``parallel/tensor.py::sharded_neighbor_mix`` /
+``sharded_delayed_mix``) must be BIT-EXACT against the per-leaf replicated
+reference (host reproduction of the exact collective op order) and against
+the existing single-axis compressed machinery applied per fsdp cell —
+sharding is an execution layout, never a semantics change.  Knob changes
+(step index, dynamic-schedule edges, compression keys) must stay traced
+data (compile-count asserts), and the all-knobs-off path must lower to
+byte-identical StableHLO versus the pre-hybrid per-leaf code (kept
+verbatim below as the frozen reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.compress import compressors as CP
+from bluefog_tpu.compress import exchange as CX
+from bluefog_tpu.models.mlp import MLP
+from bluefog_tpu.observability import ingraph as IG
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.ops import fusion as F
+from bluefog_tpu.parallel import topology as topo_mod
+from bluefog_tpu.parallel.dynamic import GetDynamicOnePeerSendRecvRanks
+from bluefog_tpu.parallel.fsdp import dfsdp_mesh, fsdp_specs
+from bluefog_tpu.parallel.schedule import (compile_dynamic_schedule,
+                                           compile_topology)
+from bluefog_tpu.parallel.tensor import (
+    _mirror_specs, hybrid_inflight_state,
+    make_decentralized_sharded_lm_train_step, sharded_delayed_mix,
+    sharded_neighbor_mix)
+
+from conftest import N_DEVICES
+
+pytestmark = pytest.mark.skipif(
+    N_DEVICES < 4 or N_DEVICES % 2,
+    reason="hybrid (dp, fsdp) tests need an even mesh of >= 4 devices")
+
+DP = max(N_DEVICES // 2, 1)
+FS = 2
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return dfsdp_mesh(DP, FS)
+
+
+@pytest.fixture(scope="module")
+def topo():
+    # fully connected at DP=4: THREE circulant offsets (one more than the
+    # exponential graph) and uniform 1/4 mixing weights.  The power-of-two
+    # weights matter for the bit-exact bar: w*x is then EXACT, so the
+    # compiled program's FMA fusion (jitted mixers) rounds identically to
+    # the eager host reference — with 1/3 weights the fused multiply-add
+    # is 1 ulp off and "bit-exact" would silently depend on codegen.
+    return compile_topology(topo_mod.FullyConnectedGraph(DP))
+
+
+@pytest.fixture(scope="module")
+def sched():
+    return compile_dynamic_schedule(
+        lambda r: GetDynamicOnePeerSendRecvRanks(
+            topo_mod.ExponentialGraph(DP), r), DP)
+
+
+def ragged_tree(seed=0, scale=1.0):
+    """Global-view [DP, ...] tree: ragged shapes, an fsdp-indivisible leaf
+    (replicated by the specs), a bf16 leaf, and a per-rank scalar."""
+    ks = jax.random.split(jax.random.key(seed), 5)
+    return {
+        "w": scale * jax.random.normal(ks[0], (DP, 8, 6), jnp.float32),
+        "blk": {"kernel": jax.random.normal(ks[1], (DP, 4, 4)),
+                "odd": jax.random.normal(ks[2], (DP, 3))},
+        "half": jax.random.normal(ks[3], (DP, 2, 8)).astype(jnp.bfloat16),
+        "s": jax.random.normal(ks[4], (DP,)),
+    }
+
+
+def inner_specs_of(gtree, mesh):
+    return fsdp_specs(jax.tree.map(lambda a: a[0], gtree), mesh,
+                      axis="fsdp")
+
+
+def place_tree(gtree, mesh):
+    specs = jax.tree.map(
+        lambda s: P("dp", *s), inner_specs_of(gtree, mesh),
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        gtree, specs)
+
+
+def host_mix(gx, topo=None, sched=None, t=0):
+    """Per-leaf replicated reference with the EXACT op order of
+    ``collectives.neighbor_allreduce`` / ``dynamic_neighbor_allreduce``
+    (self term first, then one weighted add per offset) — bit-exact, not
+    just allclose."""
+    def mix_leaf(leaf):
+        res = []
+        for i in range(DP):
+            x = leaf[i]
+            if sched is not None:
+                tt = t % sched.period
+                acc = jnp.asarray(
+                    sched.self_weights)[tt][i].astype(x.dtype) * x
+                for k, off in enumerate(sched.offsets):
+                    w = jnp.asarray(
+                        sched.recv_weights)[tt][k, i].astype(x.dtype)
+                    acc = acc + w * leaf[(i - off) % DP]
+            else:
+                acc = jnp.asarray(topo.self_weights, x.dtype)[i] * x
+                for shift in topo.shifts:
+                    srcs = [s for (s, d) in shift.pairs if d == i]
+                    r = leaf[srcs[0]] if srcs else jnp.zeros_like(x)
+                    acc = acc + jnp.asarray(shift.recv_weights,
+                                            x.dtype)[i] * r
+            res.append(acc)
+        return jnp.stack(res)
+    return jax.tree.map(mix_leaf, gx)
+
+
+def assert_trees_bitexact(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------------------------
+# mix equivalence: hybrid fused/unfused vs the per-leaf replicated reference
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fuse", [True, False])
+@pytest.mark.parametrize("dynamic", [False, True])
+def test_mix_matches_replicated_reference(mesh, topo, sched, dynamic, fuse):
+    gtree = ragged_tree()
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    kw = dict(sched=sched) if dynamic else dict(topo=topo)
+    # dynamic needs the schedule-period wrap; static weights are step-free
+    for t in (0, 1, 2) if dynamic else (0,):
+        mixed, cs, snap = sharded_neighbor_mix(
+            gp, t, mesh=mesh, inner_specs=ispecs, fuse=fuse, **kw)
+        assert cs is None and snap is None
+        ref = host_mix(gtree, topo=None if dynamic else topo,
+                       sched=sched if dynamic else None, t=t)
+        assert_trees_bitexact(mixed, ref)
+
+
+def test_compressed_mix_matches_per_cell_reference(mesh, topo):
+    """int8 hybrid == the EXISTING single-axis compressed machinery run
+    independently on each fsdp cell's shard tree (same bucket layout, same
+    (step, bucket) keys, same dp-indexed rank keys) — the codec really
+    encodes the 1/fsdp shard, bit for bit."""
+    from jax.sharding import Mesh
+
+    gtree = ragged_tree()
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    cfg = CP.resolve_compression("int8")
+    cs0 = CX.sharded_state_layout(cfg, jax.tree.map(lambda a: a[0], gtree),
+                                  ispecs, mesh, fuse=True)
+    mixed, cs1, _ = sharded_neighbor_mix(
+        gp, 3, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+        compression=cfg, comp_state=cs0)
+
+    spec_leaves = jax.tree.flatten(
+        ispecs, is_leaf=lambda x: isinstance(x, P))[0]
+
+    def cell_slice(leaf, spec, k):
+        for d, name in enumerate(spec):
+            if name == "fsdp":
+                n = leaf.shape[1 + d] // FS
+                return jax.lax.slice_in_dim(leaf, k * n, (k + 1) * n,
+                                            axis=1 + d)
+        return leaf
+
+    # the hybrid buckets with shard/rep groups (a replicated leaf's codec
+    # must not see cell-varying scale data); the reference must bucket
+    # identically for the wire to match bit for bit
+    groups = F.shard_groups(ispecs, ("fsdp",))
+    dp_mesh = Mesh(np.asarray(jax.devices()[:DP]), ("dp",))
+    spec = jax.tree.map(lambda _: P("dp"), gtree)
+
+    def body(p_shard, st_shard):
+        out, st, _ = CX.compressed_mix(
+            jax.tree.map(lambda a: a[0], p_shard),
+            jax.tree.map(lambda a: a[0], st_shard),
+            cfg, mode="neighbor", axis_name="dp", topo=topo, step=3,
+            fuse=True, leaf_groups=groups)
+        lead = lambda t: jax.tree.map(lambda a: a[None], t)
+        return lead(out), lead(st)
+
+    ref_fn = None   # one traced reference program, reused for every cell
+    for k in range(FS):
+        leaves, treedef = jax.tree_util.tree_flatten(gtree)
+        cell = jax.tree_util.tree_unflatten(
+            treedef, [cell_slice(l, s, k)
+                      for l, s in zip(leaves, spec_leaves)])
+        state0 = jax.vmap(
+            lambda p: CX.init_state(cfg, p, fuse=True,
+                                    leaf_groups=groups))(cell)
+        if ref_fn is None:
+            st_spec = jax.tree.map(lambda _: P("dp"), state0)
+            # jit the reference like the hybrid path (and production):
+            # eager shard_map compiles without the jit pipeline's FMA
+            # contraction, which costs 1 ulp on the codec arithmetic
+            ref_fn = jax.jit(jax.shard_map(body, mesh=dp_mesh,
+                                           in_specs=(spec, st_spec),
+                                           out_specs=(spec, st_spec)))
+        ref_mixed, ref_state = ref_fn(cell, state0)
+
+        got_leaves, _ = jax.tree_util.tree_flatten(mixed)
+        got_cell = [cell_slice(l, s, k)
+                    for l, s in zip(got_leaves, spec_leaves)]
+        assert_trees_bitexact(got_cell, jax.tree.leaves(ref_mixed))
+        for got_r, ref_r in zip(cs1["residual"], ref_state["residual"]):
+            np.testing.assert_array_equal(np.asarray(got_r[:, k]),
+                                          np.asarray(ref_r))
+
+
+def test_choco_identity_gamma1_equals_plain_gossip(mesh, topo):
+    """The PR-5 invariant holds on the hybrid mesh: choco with a lossless
+    codec and gamma=1 reproduces plain neighbor averaging."""
+    gtree = ragged_tree()
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    cfg = CP.resolve_compression("choco:identity:gamma=1")
+    cs0 = CX.sharded_state_layout(cfg, jax.tree.map(lambda a: a[0], gtree),
+                                  ispecs, mesh, fuse=True)
+    mixed, cs1, _ = sharded_neighbor_mix(
+        gp, 0, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+        compression=cfg, comp_state=cs0)
+    ref = host_mix(gtree, topo=topo)
+    for a, b in zip(jax.tree.leaves(mixed), jax.tree.leaves(ref)):
+        # the identity holds in exact arithmetic; the choco recursion's
+        # different op order costs ~1 ulp, which in bf16 is ~1e-2
+        tol = 2e-2 if a.dtype == jnp.bfloat16 else 1e-5
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("fuse", [True, False])
+def test_delayed_mix_matches_host_recurrence(mesh, topo, fuse):
+    """Overlapped hybrid: warmup fold is the identity, and from step 1 on
+    ``x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})`` holds bit-for-bit.  The
+    fused variant runs with telemetry ON: the snapshot must not perturb
+    the recurrence, the warmup flag flips 1 -> 0 after the first fold
+    (zero buffer, d=1), and staleness pins at 1."""
+    gtree = ragged_tree()
+    ispecs = inner_specs_of(gtree, mesh)
+    single = jax.tree.map(lambda a: a[0], gtree)
+    infl = hybrid_inflight_state(single, ispecs, mesh, fuse=fuse)
+    telemetry = fuse
+
+    def dmul(d_vec, leaf):
+        dd = d_vec.reshape((DP,) + (1,) * (leaf.ndim - 1))
+        return dd.astype(leaf.dtype) * leaf
+
+    d = jnp.asarray(topo.self_weights, jnp.float32)
+    nbuf, dprev = None, None
+    z = place_tree(gtree, mesh)
+    z_host = gtree
+    for t in range(3):
+        kw = (dict(telemetry=True,
+                   grads=jax.tree.map(jnp.zeros_like, z), old_params=z)
+              if telemetry else {})
+        combined, infl, _, snap = sharded_delayed_mix(
+            z, t, infl, mesh=mesh, inner_specs=ispecs, topo=topo,
+            fuse=fuse, **kw)
+        if telemetry:
+            assert float(snap.warmup[0, 0]) == (1.0 if t == 0 else 0.0)
+            assert float(snap.staleness[0, 0]) == 1.0
+        if t == 0:
+            ref = z_host                       # warmup: zero buffer, d=1
+        else:
+            ref = jax.tree.map(
+                lambda zl, nb: dmul(dprev, zl) + nb, z_host, nbuf)
+        assert_trees_bitexact(combined, ref)
+        full = host_mix(z_host, topo=topo)
+        nbuf = jax.tree.map(lambda f, zl: f - dmul(d, zl), full, z_host)
+        dprev = d
+        z_host = jax.tree.map(lambda a: a + 0.25, z_host)
+        z = place_tree(z_host, mesh)
+
+
+# ---------------------------------------------------------------------------
+# train-step integration
+# ---------------------------------------------------------------------------
+
+def _mlp_setup(mesh):
+    model = MLP(features=(8, 8), num_outputs=4)
+    x = jax.random.normal(jax.random.key(0), (DP, 2, 4, 4, 1))
+    y = jax.random.randint(jax.random.key(1), (DP, 2), 0, 4)
+    params = model.init(jax.random.key(2), x[0])["params"]
+    inner_fn = lambda p: fsdp_specs(p, mesh, axis="fsdp")
+    return model, x, y, params, inner_fn
+
+
+def test_hybrid_train_step_matches_replicated_reference(mesh, topo):
+    model, x, y, params, inner_fn = _mlp_setup(mesh)
+    opt = optax.sgd(0.1, momentum=0.9)
+    step, place = make_decentralized_sharded_lm_train_step(
+        model, opt, mesh, inner_fn, topo=topo, donate=False, fuse=True)
+    gp, go = place(params)
+    p1, _, loss = step(gp, go, x, y, jnp.int32(0))
+
+    # replicated reference: per-replica grad+update on host, then W-mix
+    def one_loss(p, xb, yb):
+        logits = model.apply({"params": p}, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    refs, losses = [], []
+    for r in range(DP):
+        l, g = jax.value_and_grad(one_loss)(params, x[r], y[r])
+        upd, _ = opt.update(g, opt.init(params), params)
+        refs.append(optax.apply_updates(params, upd))
+        losses.append(float(l))
+    gref = jax.tree.map(lambda *ls: jnp.stack(ls), *refs)
+    ref_mixed = host_mix(gref, topo=topo)
+    np.testing.assert_allclose(float(loss), np.mean(losses), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(ref_mixed)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_disabled_hybrid_lowers_byte_identical_stablehlo(mesh, topo):
+    """Acceptance gate: with every knob off the new builder's lowered
+    StableHLO is byte-identical to the pre-hybrid per-leaf code (frozen
+    verbatim here)."""
+    from bluefog_tpu.ops import collectives as C
+    from bluefog_tpu.parallel.tensor import _shard_like
+
+    model, x, y, params, inner_fn = _mlp_setup(mesh)
+    opt = optax.sgd(0.05)
+
+    def legacy_builder():
+        dp = mesh.shape["dp"]
+
+        def _dp_specs(p):
+            inner = inner_fn(jax.tree.map(lambda a: a[0], p))
+            return jax.tree.map(lambda spec: P("dp", *spec), inner,
+                                is_leaf=lambda s: isinstance(s, P))
+
+        def _loss(p, tokens, targets):
+            def one(p_, tok, tgt):
+                logits = model.apply({"params": p_}, tok)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgt).mean()
+            return jax.vmap(one)(p, tokens, targets)
+
+        def _mix(p, step):
+            specs = _dp_specs(p)
+
+            def body(p_shard, step_s):
+                def mix_leaf(a):
+                    return C.neighbor_allreduce(a[0], "dp", topo)[None]
+                return jax.tree.map(mix_leaf, p_shard)
+
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+            )(p, step)
+
+        def _constrain(tree, specs):
+            return jax.tree.map(
+                lambda leaf, spec: jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(mesh, spec)), tree, specs)
+
+        def step_fn(p, opt_state, tokens, targets, step=0):
+            step = jnp.asarray(step, jnp.int32)
+            specs = _dp_specs(p)
+
+            def mean_loss(pp):
+                return _loss(pp, tokens, targets).mean()
+
+            loss, grads = jax.value_and_grad(mean_loss)(p)
+            grads = jax.tree.map(lambda g: g * dp, grads)
+            grads = _constrain(grads, specs)
+            updates, opt_state = jax.vmap(opt.update)(grads, opt_state, p)
+            opt_state = _constrain(opt_state,
+                                   _mirror_specs(opt_state, p, specs))
+            p = optax.apply_updates(p, updates)
+            p = _mix(p, step)
+            return p, opt_state, loss
+
+        return jax.jit(step_fn)
+
+    new_step, place = make_decentralized_sharded_lm_train_step(
+        model, opt, mesh, inner_fn, topo=topo, donate=False, fuse=False,
+        overlap=False, compression=None, telemetry=False)
+    gp, go = place(params)
+    args = (gp, go, x, y, jnp.int32(0))
+    assert (new_step.lower(*args).as_text()
+            == legacy_builder().lower(*args).as_text())
+
+
+def test_hybrid_knobs_zero_recompiles(mesh, sched, topo):
+    """Step advances (incl. dynamic-schedule edge hops), overlap folds,
+    telemetry, and compression keys are all traced data: one compiled
+    program per build."""
+    model, x, y, params, inner_fn = _mlp_setup(mesh)
+    opt = optax.sgd(0.05)
+    step, place = make_decentralized_sharded_lm_train_step(
+        model, opt, mesh, inner_fn, sched=sched, donate=False, fuse=True,
+        overlap=True, telemetry=True)
+    gp, st = place(params)
+    assert set(st.keys()) == {"base", "inflight"}
+    for t in range(sched.period + 2):
+        gp, st, loss, snap = step(gp, st, x, y, jnp.int32(t))
+    assert step._cache_size() == 1
+    assert snap.consensus_dist.shape == (DP, FS)
+    assert float(snap.staleness[0, 0]) == 1.0
+
+    step_c, place_c = make_decentralized_sharded_lm_train_step(
+        model, opt, mesh, inner_fn, topo=topo, donate=False, fuse=True,
+        compression="int8")
+    gp, st = place_c(params)
+    assert set(st.keys()) == {"base", "compress"}
+    for t in range(3):
+        gp, st, loss = step_c(gp, st, x, y, jnp.int32(t))
+    assert step_c._cache_size() == 1
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# telemetry: consensus over the gossip axis only
+# ---------------------------------------------------------------------------
+
+def test_telemetry_axis_gossip_override():
+    CT = S.CommunicationType
+    assert S._telemetry_axis(CT.neighbor_allreduce, "dp", None,
+                             gossip_axis="dp") == "dp"
+    # without the override the hierarchical mode widens to both axes —
+    # the hybrid path must never take that branch
+    assert S._telemetry_axis(CT.hierarchical_neighbor_allreduce, "r",
+                             ("machine", "local")) == ("machine", "local")
+    assert S._telemetry_axis(CT.hierarchical_neighbor_allreduce, "r",
+                             ("machine", "local"),
+                             gossip_axis="machine") == "machine"
+
+
+def test_hybrid_snapshot_consensus_over_dp_only(mesh):
+    """The snapshot's consensus distance equals the host full-replica
+    ``||x_i - x_bar||^2`` over the dp axis (replicated leaves counted
+    once), and is identical across fsdp cells of one dp rank — a pmean
+    over fsdp would instead average different shards and shrink it.
+
+    Uses an exponential graph, NOT the module's fully-connected fixture:
+    one fully-connected round reaches consensus and the ~0 squared
+    distances drown in f32 cancellation — nothing left to compare."""
+    topo = compile_topology(topo_mod.ExponentialGraph(DP))
+    gtree = ragged_tree(seed=7)
+    gp = place_tree(gtree, mesh)
+    grads = jax.tree.map(lambda a: a * 0.1, gp)
+    ispecs = inner_specs_of(gtree, mesh)
+    mixed, _, snap = sharded_neighbor_mix(
+        gp, 0, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+        telemetry=True, grads=grads, old_params=gp)
+    assert snap.consensus_dist.shape == (DP, FS)
+
+    host_cd = np.zeros(DP, np.float64)
+    for leaf in jax.tree.leaves(mixed):
+        l32 = np.asarray(leaf, np.float64).reshape(DP, -1)
+        host_cd += ((l32 - l32.mean(axis=0, keepdims=True)) ** 2).sum(1)
+    got = np.asarray(snap.consensus_dist)
+    # rtol covers the bf16 leaf: XLA fuses the bf16 mix into the in-graph
+    # consensus, which then reads pre-rounding f32 intermediates while the
+    # RETURNED leaf is bf16-materialized — a bf16-eps-level wobble in the
+    # health metric.  The axis bugs this test guards against (pmean over
+    # fsdp, double-counted replicated leaves) are O(1) errors.
+    np.testing.assert_allclose(got[:, 0], host_cd, rtol=2e-3)
+    np.testing.assert_array_equal(got[:, 0], got[:, 1])
+
+    # full-replica norms: grad norm must match the host value, not the
+    # per-shard one (psum over fsdp with replicated leaves de-duplicated)
+    host_gn = np.sqrt(sum(
+        (np.asarray(l, np.float64) ** 2).reshape(DP, -1).sum(1)
+        for l in jax.tree.leaves(grads)))
+    np.testing.assert_allclose(np.asarray(snap.grad_norm)[:, 0], host_gn,
+                               rtol=1e-4)
+
+    # mixing-matrix mass telemetry indexes the dp axis only
+    W = np.asarray(topo.weight_matrix, np.float64)
+    np.testing.assert_allclose(np.asarray(snap.mix_col_sum)[:, 0],
+                               W.sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(snap.mix_row_sum)[:, 0],
+                               W.sum(axis=1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# wire-byte accounting: the 1/fsdp claim
+# ---------------------------------------------------------------------------
+
+def test_shard_plan_halves_per_rank_wire_bytes(mesh):
+    """The shard plan's per-rank bytes are exactly 1/fsdp of the
+    replicated plan's for fully divisible trees, and the sharded leaves'
+    share otherwise."""
+    single = {"a": jnp.zeros((8, 6)), "b": jnp.zeros((4, 4))}
+    specs = fsdp_specs(single, mesh, axis="fsdp")
+    full = F.plan_for(single)
+    shard = F.shard_plan_for(single, specs, {"fsdp": FS})
+    assert F.plan_bytes(shard)[0] * FS == F.plan_bytes(full)[0]
+    assert (F.gossip_wire_bytes(shard, 3) * FS
+            == F.gossip_wire_bytes(full, 3))
+    # an fsdp-indivisible leaf stays replicated: it keeps its full bytes
+    ragged = {"a": jnp.zeros((8, 6)), "odd": jnp.zeros((3,))}
+    rspecs = fsdp_specs(ragged, mesh, axis="fsdp")
+    rshard = F.shard_plan_for(ragged, rspecs, {"fsdp": FS})
+    assert F.plan_bytes(rshard)[0] == (8 * 6 // FS + 3) * 4
+
+
+def test_mix_program_cache_reuses_traced_programs(mesh, topo):
+    """Repeat eager mixer calls with the same static config must reuse
+    the cached shard_map program (a fresh closure per call would miss
+    jax's pjit cache and re-trace the whole exchange every step)."""
+    from bluefog_tpu.parallel import tensor as T
+
+    gtree = ragged_tree()
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    kw = dict(mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True)
+    sharded_neighbor_mix(gp, 0, **kw)            # warm this config
+    n = len(T._PROGRAM_CACHE)
+    key, prog = next(reversed(T._PROGRAM_CACHE.items()))
+    a, _, _ = sharded_neighbor_mix(gp, 1, **kw)
+    b, _, _ = sharded_neighbor_mix(gp, 2, **kw)
+    assert len(T._PROGRAM_CACHE) == n            # no new entry
+    assert T._PROGRAM_CACHE[key] is prog         # same traced program
+    assert_trees_bitexact(a, b)                  # static topo: step-free
+    # a different topology object is a different program
+    other = compile_topology(topo_mod.RingGraph(DP))
+    sharded_neighbor_mix(gp, 0, mesh=mesh, inner_specs=ispecs,
+                         topo=other, fuse=True)
+    assert len(T._PROGRAM_CACHE) == n + 1
+
+
+def test_compression_state_lives_sharded(mesh, topo):
+    """EF residuals ride the donated opt state SHARDED: each device owns
+    1/(dp*fsdp) of every carried buffer."""
+    gtree = ragged_tree()
+    single = jax.tree.map(lambda a: a[0], gtree)
+    ispecs = inner_specs_of(gtree, mesh)
+    cfg = CP.resolve_compression("int8")
+    cs = CX.sharded_state_layout(cfg, single, ispecs, mesh, fuse=True)
+    for buf in cs["residual"]:
+        assert buf.shape[:2] == (DP, FS)
+        shard = buf.sharding.shard_shape(buf.shape)
+        assert int(np.prod(shard)) * DP * FS == int(np.prod(buf.shape))
