@@ -19,7 +19,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
-        chaos metrics-smoke metrics-smoke-compress
+        chaos metrics-smoke metrics-smoke-compress health-smoke
 
 test:
 	$(PYTEST) tests/
@@ -136,6 +136,15 @@ metrics-smoke:
 # decrease and the carried residual norm stay bounded.
 metrics-smoke-compress:
 	python scripts/metrics_smoke.py --compress
+
+# Fleet-health smoke (docs/observability.md "Fleet health & bfmonitor"):
+# the metrics smoke plus the CI gate over the health engine — a clean
+# 20-step consensus-only fleet must make `bfmonitor --once --json`
+# report ZERO alerts, and the same fleet with an injected chaos
+# straggler must gate (--fail-on warn exits 1 with exactly the
+# straggler verdict on the seeded rank, consensus still contracting).
+health-smoke:
+	python scripts/metrics_smoke.py --health
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

@@ -215,6 +215,78 @@ def measure_step_time_amortized(window, k_small, k_large, pairs=3,
         return t, [t], True
 
 
+# exception text from a failed bf.init()/backend bring-up, recorded by
+# main for the skip record's diagnosis block (a RAISED init and a HUNG
+# init need different fixes; the record must distinguish them)
+_INIT_EXC = [None]
+
+# env vars that decide which backend JAX tries to reach and how — the
+# first things to check on an "unreachable" skip
+_DIAG_ENV = ("JAX_PLATFORMS", "TPU_LIBRARY_PATH", "TPU_SKIP_MDS_QUERY",
+             "PJRT_DEVICE", "XLA_FLAGS", "TPU_WORKER_ID",
+             "TPU_WORKER_HOSTNAMES")
+
+
+def _backend_diagnosis(probe_timeout: float = None) -> dict:
+    """Structured evidence for a ``"status": "skipped"`` record: WHY was
+    the backend unreachable?  BENCH_r02..r05 all skipped with the bare
+    cause string, leaving the recurring outage undebuggable after the
+    fact — this block rides the BENCH JSON so the evidence is banked
+    contemporaneously.
+
+    Collects: the init exception (if bring-up RAISED rather than hung),
+    the backend-selection env vars, a subprocess visible-device probe
+    (bounded by ``BENCH_PROBE_TIMEOUT``, default 8 s — a probe that
+    itself hangs is the 'transport wedged' signature, and it must not
+    wedge the watchdog that is about to exit), and the tail of the
+    newest accelerator driver log (``BENCH_DRIVER_LOG_GLOB``, default
+    ``/tmp/tpu_logs/*``)."""
+    import glob as _glob
+    import subprocess
+
+    if probe_timeout is None:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "8"))
+    diag = {
+        "exception": _INIT_EXC[0],
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "env": {k: os.environ[k] for k in _DIAG_ENV if k in os.environ},
+    }
+    # fresh-process device probe: distinguishes "enumeration itself hangs
+    # /raises" (transport/driver down) from "enumeration answers but RPCs
+    # die later" (the round-2→3 half-alive signature)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "print(len(ds), ds[0].platform, ds[0].device_kind)"],
+            capture_output=True, text=True, timeout=probe_timeout)
+        if r.returncode == 0:
+            diag["device_probe"] = r.stdout.strip()
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            diag["device_probe"] = "failed: " + " | ".join(tail)
+    except subprocess.TimeoutExpired:
+        diag["device_probe"] = (f"timed out after {probe_timeout:.0f}s "
+                                f"(backend enumeration hangs)")
+    except OSError as e:
+        diag["device_probe"] = f"probe unavailable: {e}"
+    # newest driver log tail (libtpu defaults to /tmp/tpu_logs)
+    pat = os.environ.get("BENCH_DRIVER_LOG_GLOB", "/tmp/tpu_logs/*")
+    try:
+        logs = [p for p in _glob.glob(pat) if os.path.isfile(p)]
+        if logs:
+            newest = max(logs, key=os.path.getmtime)
+            with open(newest, errors="replace") as f:
+                tail = f.readlines()[-12:]
+            diag["driver_log"] = {"path": newest,
+                                  "tail": [ln.rstrip("\n") for ln in tail]}
+        else:
+            diag["driver_log"] = f"no files match {pat}"
+    except OSError as e:
+        diag["driver_log"] = f"unreadable: {e}"
+    return diag
+
+
 def _init_watchdog(seconds: int):
     """Fail fast (one readable JSON error line) if the accelerator
     backend hangs before the first step completes — a tunneled transport
@@ -321,7 +393,10 @@ def _init_watchdog(seconds: int):
                     "status": "skipped",
                     "unit": "img/sec/chip",
                     "reason": f"{cause} "
-                              f"({why}, attempt {attempt}/{max_attempts})"}
+                              f"({why}, attempt {attempt}/{max_attempts})",
+                    # banked evidence for the recurring outage (r02-r05
+                    # skipped with nothing but the cause string)
+                    "diagnosis": _backend_diagnosis()}
                 runlog(f"SKIP {json.dumps(skip)}")
                 print(json.dumps(skip), flush=True)
                 os._exit(0)
@@ -589,7 +664,24 @@ def main():
            f"init_timeout={init_timeout} "
            f"total_budget={os.environ.get('BENCH_TOTAL_BUDGET', DEFAULT_TOTAL_BUDGET)}")
     advance, cancel = _init_watchdog(init_timeout)
-    bf.init()
+    try:
+        bf.init()
+    except Exception as e:                       # noqa: BLE001 — a raised
+        # bring-up is a SKIP with evidence, same contract as a hung one:
+        # no value key, exit 0, diagnosis banked in the JSON.  Disarm
+        # the watchdog FIRST: the diagnosis probe can block up to
+        # BENCH_PROBE_TIMEOUT, and a watchdog firing mid-diagnosis would
+        # os._exit with its own (wrong) "hung" record
+        cancel()
+        _INIT_EXC[0] = f"{type(e).__name__}: {e}"
+        skip = {"metric": METRIC, "status": "skipped",
+                "unit": "img/sec/chip",
+                "reason": f"accelerator backend init raised "
+                          f"({type(e).__name__})",
+                "diagnosis": _backend_diagnosis()}
+        runlog(f"SKIP {json.dumps(skip)}")
+        print(json.dumps(skip), flush=True)
+        sys.exit(0)
     runlog(f"init ok: {len(jax.devices())} x {jax.devices()[0].device_kind} "
            f"({jax.default_backend()})")
     advance("first compile+step")

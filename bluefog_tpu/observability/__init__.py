@@ -1,33 +1,43 @@
 """Runtime telemetry subsystem — the observability layer.
 
-Three cooperating pieces (docs/observability.md):
+Cooperating pieces (docs/observability.md):
 
-* ``ingraph``  — traced per-step training-health aggregates computed
+* ``ingraph``   — traced per-step training-health aggregates computed
   INSIDE the jitted step (consensus distance, mixing-matrix mass, norms,
   pipeline flags), returned as a ``TelemetrySnapshot`` aux pytree via the
   ``telemetry=`` flag on the optimizer factories and
   ``training.make_train_step``.
-* ``metrics``  — process-local host registry (counters/gauges/histograms
+* ``metrics``   — process-local host registry (counters/gauges/histograms
   with named labels), instrumented into fusion, windows, the service,
   resilience, and the step cache.  Free when disabled.
-* ``export``   — JSONL per-step series (``BLUEFOG_METRICS=<prefix>``),
+* ``export``    — JSONL per-step series (``BLUEFOG_METRICS=<prefix>``),
   Prometheus text dump, and Chrome-tracing counter lanes
   (``"ph":"C"``) on the existing timeline.
+* ``phases``    — wall-clock step-phase timers around the host step loop
+  (exchange launch / fold / compute / export), recorded as registry
+  histograms, Perfetto lanes, and JSONL ``"phases"`` fields.
+* ``aggregate`` — fleet-wide merge of the per-rank JSONL series:
+  step-aligned cross-rank spread stats tolerating missing / partial /
+  lagging ranks.
+* ``health``    — rule-based health engine over the fleet view:
+  structured ``HealthReport`` verdicts (consensus stall/diverge,
+  non-finite, residual blow-up, straggler skew, dead ranks, compile
+  storms) for ``bfmonitor`` and the future closed-loop controller.
 
 Only ``metrics`` loads eagerly (it is stdlib-only and imported from
-hot-path modules — fusion, windows, service, timeline); ``ingraph`` and
-``export`` resolve lazily so importing this package never drags the JAX
-optimizer stack or the timeline into an import cycle.
+hot-path modules — fusion, windows, service, timeline); everything else
+resolves lazily so importing this package never drags the JAX optimizer
+stack or the timeline into an import cycle.
 """
 
 import importlib
 
 from . import metrics
 
-__all__ = ["metrics", "ingraph", "export"]
+__all__ = ["metrics", "ingraph", "export", "phases", "aggregate", "health"]
 
 
 def __getattr__(name):
-    if name in ("ingraph", "export"):
+    if name in ("ingraph", "export", "phases", "aggregate", "health"):
         return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,531 @@
+"""Fleet-wide aggregation of the per-rank JSONL step series.
+
+PR 4's exporter writes one ``<prefix><rank>.jsonl`` per process; this
+module merges them back into a STEP-ALIGNED fleet view — the sensing
+input for the health engine (``observability/health.py``) and the
+``bfmonitor`` dashboard (``run/monitor.py``), and the series the
+ROADMAP's closed-loop controller will consume.
+
+Robustness is the whole point — a fleet view that dies on the first
+sick rank can never diagnose one:
+
+* **missing / lagging ranks** — a rank absent at a step simply does not
+  contribute to that step's spread stats; the gap is recorded as a
+  :class:`Gap` so the health engine can turn it into a verdict instead
+  of the reader crashing.
+* **truncated final lines** — a writer killed mid-step leaves a partial
+  last line; it is dropped and flagged (``kind="truncated"``), never a
+  parse abort.  Mid-file garbage (disk-level corruption) likewise skips
+  the line and records a ``parse_error`` gap.
+* **ranks that never wrote** — when the caller states the expected
+  fleet size, silent ranks surface as ``missing_file`` gaps.
+* **single-process virtual meshes** — the CPU test mesh runs N ranks in
+  one process, so ONE file carries ``[N]``-list telemetry fields.
+  :func:`load_fleet` explodes those lists into N virtual rank series
+  (list position = rank), so the same fleet view works on a laptop run
+  and a real multi-host fleet.
+
+Pure host-side stdlib + numpy: importing this module never touches JAX.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Gap", "RankSeries", "SpreadStats", "FleetView", "TailCache",
+    "read_jsonl_tolerant", "discover_series", "load_fleet", "spread",
+    "STEP_WALL_FIELD",
+]
+
+# per-step host wall time, microseconds (written by export.log_step;
+# older series fall back to consecutive t_us deltas)
+STEP_WALL_FIELD = "step_wall_us"
+
+
+def _step_of(rec: dict) -> Optional[int]:
+    """The record's step index as an int, or None when absent/garbled.
+    Older series written before the exporter stopped letting the
+    in-graph counter clobber the log index may carry an [N] list here —
+    every virtual rank saw the same counter, so position 0 serves."""
+    v = rec.get("step")
+    if isinstance(v, list):
+        v = v[0] if v else None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return int(v)
+
+# telemetry fields the fleet view treats as per-rank health series (the
+# TelemetrySnapshot fields plus the exporter extras); anything else
+# numeric still aggregates, these are just the documented core set
+CORE_FIELDS = (
+    "consensus_dist", "param_norm", "grad_norm", "update_norm",
+    "mix_col_sum", "mix_row_sum", "staleness", "warmup", "degraded",
+    "compress_ratio", "residual_norm", "wire_bytes",
+)
+
+
+@dataclasses.dataclass
+class Gap:
+    """One observed hole in the fleet's series (health-event input).
+
+    ``kind``: ``missing_file`` (expected rank never wrote),
+    ``truncated`` (final line cut mid-write — writer killed),
+    ``parse_error`` (mid-file garbage skipped), ``missing_steps``
+    (holes inside one rank's step sequence).  ``step``: where the hole
+    sits in the step sequence (the nearest preceding parsed step for a
+    corrupt line, the newest missing step for a hole) — lets the health
+    engine window out gaps the fleet has long since moved past."""
+    kind: str
+    rank: Optional[int] = None
+    detail: str = ""
+    step: Optional[int] = None
+
+    def asdict(self):
+        return {"kind": self.kind, "rank": self.rank,
+                "detail": self.detail, "step": self.step}
+
+
+@dataclasses.dataclass
+class RankSeries:
+    """One rank's parsed step series (physical file or virtual slice)."""
+    rank: int
+    records: List[dict]
+    path: Optional[str] = None
+    truncated: bool = False
+
+    def steps(self) -> List[int]:
+        out = []
+        for r in self.records:
+            s = _step_of(r)
+            if s is not None:
+                out.append(s)
+        return out
+
+    def last_step(self) -> Optional[int]:
+        s = self.steps()
+        return max(s) if s else None
+
+
+@dataclasses.dataclass
+class SpreadStats:
+    """Cross-rank spread of one field at one step."""
+    n: int
+    min: float
+    max: float
+    p50: float
+    p95: float
+    mean: float
+
+    def asdict(self):
+        return {k: getattr(self, k)
+                for k in ("n", "min", "max", "p50", "p95", "mean")}
+
+
+def spread(values: Sequence[float]) -> Optional[SpreadStats]:
+    """min/max/p50/p95/mean over the ranks present (None when empty).
+    Non-finite samples participate — a NaN consensus distance must
+    poison the stat visibly, not vanish from it."""
+    vals = np.asarray([float(v) for v in values], np.float64)
+    if vals.size == 0:
+        return None
+    if np.isfinite(vals).all():
+        p50, p95 = np.percentile(vals, [50, 95])
+    else:
+        p50 = p95 = float("nan")
+    return SpreadStats(n=int(vals.size), min=float(vals.min()),
+                       max=float(vals.max()), p50=float(p50),
+                       p95=float(p95), mean=float(vals.mean()))
+
+
+class TailCache:
+    """Per-file incremental parse state for live tailing.
+
+    ``load_fleet(..., cache=)`` with one cache held across frames makes
+    each monitoring pass parse only the bytes APPENDED since the last
+    one — the live ``bfmonitor`` loop skips re-reading and re-parsing
+    the run's history every 2 seconds (the view over the cached records
+    is still rebuilt per call).  A file that shrank (rotated /
+    restarted writer) resets its entry."""
+
+    def __init__(self):
+        # path -> [byte offset past last complete line, records, gaps,
+        #          complete-line count, step of last parsed record]
+        self._files: Dict[str, list] = {}
+
+
+def read_jsonl_tolerant(path: str, cache: Optional[TailCache] = None
+                        ) -> Tuple[List[dict], List[Gap]]:
+    """Parse a metrics JSONL file without ever raising on bad data.
+
+    Unlike ``export.validate_jsonl`` (the strict CI gate), this reader is
+    for live monitoring of files another process is still writing — or
+    stopped writing mid-line when it was killed.  Returns
+    ``(records, gaps)``: an unparseable FINAL line is dropped as a
+    ``truncated`` gap (the writer died or has not finished the line);
+    unparseable mid-file lines are skipped as ``parse_error`` gaps.
+
+    ``cache``: a :class:`TailCache` carried across calls parses only
+    appended bytes.  The offset only ever advances past COMPLETE
+    (newline-terminated) lines, so a partial final line is re-examined
+    next call once the writer finishes it — transient tail state
+    (records without a newline yet, truncated gaps) is returned but
+    never cached."""
+    state = cache._files.get(path) if cache is not None else None
+    if state is None:
+        state = [0, [], [], 0, None]
+    try:
+        if state[0] and os.path.getsize(path) < state[0]:
+            state = [0, [], [], 0, None]     # rotated/shrunk: start over
+        with open(path, "rb") as f:
+            f.seek(state[0])
+            chunk = f.read()
+    except OSError as e:
+        return [], [Gap("missing_file", detail=f"{path}: {e}")]
+    complete, sep, remainder = chunk.rpartition(b"\n")
+    if sep:
+        for raw in complete.split(b"\n"):
+            state[3] += 1
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError("not a JSON object")
+            except ValueError as e:
+                state[2].append(Gap("parse_error",
+                                    detail=f"{path}:{state[3]}: {e}",
+                                    step=state[4]))
+                continue
+            state[1].append(rec)
+            s = _step_of(rec)
+            if s is not None:
+                state[4] = s
+        state[0] += len(complete) + 1
+    if cache is not None:
+        cache._files[path] = state
+    records = list(state[1])
+    gaps = list(state[2])
+    tail = remainder.decode("utf-8", errors="replace").strip()
+    if tail:
+        try:
+            rec = json.loads(tail)
+            if not isinstance(rec, dict):
+                raise ValueError("not a JSON object")
+            records.append(rec)        # complete line missing its newline
+        except ValueError as e:
+            gaps.append(Gap("truncated",
+                            detail=f"{path}: final line cut ({e})",
+                            step=state[4]))
+    return records, gaps
+
+
+def discover_series(prefix: str) -> Dict[int, str]:
+    """``<prefix><rank>.jsonl`` files on disk, keyed by integer rank."""
+    out: Dict[int, str] = {}
+    pat = re.compile(re.escape(os.path.basename(prefix)) + r"(\d+)\.jsonl$")
+    for path in glob.glob(glob.escape(prefix) + "*.jsonl"):
+        m = pat.match(os.path.basename(path))
+        if m:
+            out[int(m.group(1))] = path
+    return out
+
+
+def _virtual_width(records: List[dict]) -> int:
+    """Longest consistent per-rank list width across the core telemetry
+    fields (0 = no list fields, nothing to explode)."""
+    width = 0
+    for rec in records:
+        for k in CORE_FIELDS:
+            v = rec.get(k)
+            if isinstance(v, list) and len(v) > 1:
+                if width and len(v) != width:
+                    return 0          # inconsistent: do not explode
+                width = len(v)
+    return width
+
+
+def _explode(series: RankSeries, width: int) -> List[RankSeries]:
+    """Split one physical series whose telemetry fields are [N] lists
+    into N virtual rank series (list position = rank).  Host-shared
+    fields (t_us, step_wall_us, counters, loss, ...) replicate — on a
+    virtual mesh every rank lives in the same process clock."""
+    out = []
+    for r in range(width):
+        recs = []
+        for rec in series.records:
+            sub = {}
+            for k, v in rec.items():
+                if isinstance(v, list) and len(v) == width:
+                    sub[k] = v[r]
+                else:
+                    sub[k] = v
+            sub["rank"] = r
+            recs.append(sub)
+        out.append(RankSeries(rank=r, records=recs, path=series.path,
+                              truncated=series.truncated))
+    return out
+
+
+class FleetView:
+    """Step-aligned merge of per-rank series.
+
+    ``per_rank``: rank -> {step -> record}; ``gaps``: every hole the
+    loader observed (missing files, truncation, parse errors, missing
+    steps).  All accessors tolerate partial data — a stat over a step
+    only sees the ranks that reported it."""
+
+    def __init__(self, series: List[RankSeries], gaps: List[Gap],
+                 expected_ranks: Optional[int] = None):
+        self.series = {s.rank: s for s in series}
+        self.gaps = list(gaps)
+        self.expected_ranks = expected_ranks
+        self.per_rank: Dict[int, Dict[int, dict]] = {}
+        for s in series:
+            by_step: Dict[int, dict] = {}
+            for rec in s.records:
+                step = _step_of(rec)
+                if step is not None:
+                    by_step[step] = rec
+            self.per_rank[s.rank] = by_step
+        # holes inside each rank's own step sequence — counted
+        # arithmetically and enumerated BOUNDED: one absurd (but
+        # valid-JSON) step value must not materialize a range(1e15) set
+        # in the loader whose whole contract is never dying on bad data
+        for rank, by_step in self.per_rank.items():
+            if by_step:
+                steps = sorted(by_step)
+                n_missing = (steps[-1] - steps[0] + 1) - len(steps)
+                if n_missing > 0:
+                    head = []
+                    for a, b in zip(steps, steps[1:]):
+                        for m in range(a + 1, min(b, a + 9)):
+                            head.append(m)
+                            if len(head) == 8:
+                                break
+                        if len(head) == 8:
+                            break
+                    last_missing = next(
+                        b - 1 for a, b in zip(reversed(steps[:-1]),
+                                              reversed(steps[1:]))
+                        if b - a > 1)
+                    self.gaps.append(Gap(
+                        "missing_steps", rank=rank,
+                        detail=f"{n_missing} step(s) absent between "
+                               f"{steps[0]} and {steps[-1]} "
+                               f"(first {head}"
+                               f"{'...' if n_missing > len(head) else ''})",
+                        step=last_missing))
+        if expected_ranks is not None:
+            for r in range(expected_ranks):
+                if r not in self.per_rank:
+                    self.gaps.append(Gap(
+                        "missing_file", rank=r,
+                        detail="rank never wrote a series file"))
+
+    # -- shape ---------------------------------------------------------------
+
+    @property
+    def ranks(self) -> List[int]:
+        return sorted(self.per_rank)
+
+    def steps(self) -> List[int]:
+        """Sorted union of every rank's reported steps."""
+        all_steps = set()
+        for by_step in self.per_rank.values():
+            all_steps.update(by_step)
+        return sorted(all_steps)
+
+    def last_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def rank_last_step(self, rank: int) -> Optional[int]:
+        by_step = self.per_rank.get(rank) or {}
+        return max(by_step) if by_step else None
+
+    # -- field access --------------------------------------------------------
+
+    def value(self, rank: int, step: int, field: str):
+        """One rank's numeric value at one step; lists (an unexploded
+        global-view field) collapse to their mean; None when absent."""
+        rec = self.per_rank.get(rank, {}).get(step)
+        if rec is None:
+            return None
+        v = rec.get(field)
+        if isinstance(v, list):
+            return float(np.mean(v)) if v else None
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        return None
+
+    def series_of(self, rank: int, field: str) -> List[Tuple[int, float]]:
+        """Sorted ``(step, value)`` pairs for one rank's field."""
+        by_step = self.per_rank.get(rank) or {}
+        out = []
+        for step in sorted(by_step):
+            v = self.value(rank, step, field)
+            if v is not None:
+                out.append((step, v))
+        return out
+
+    def fleet_spread(self, step: int, field: str,
+                     exclude: Optional[float] = None
+                     ) -> Optional[SpreadStats]:
+        """Cross-rank spread of one field at one step (present ranks
+        only).  ``exclude``: drop ranks reporting this sentinel value
+        (e.g. the ``-1`` UNMEASURED consensus of a degraded
+        no-collective step, which would otherwise skew the stats)."""
+        vals = []
+        for rank in self.ranks:
+            v = self.value(rank, step, field)
+            if v is not None and (exclude is None or v != exclude):
+                vals.append(v)
+        return spread(vals)
+
+    def spread_series(self, field: str,
+                      steps: Optional[Sequence[int]] = None
+                      ) -> List[Tuple[int, SpreadStats]]:
+        out = []
+        for step in (steps if steps is not None else self.steps()):
+            st = self.fleet_spread(step, field)
+            if st is not None:
+                out.append((step, st))
+        return out
+
+    def missing_ranks(self, step: int) -> List[int]:
+        """Ranks that reported SOME step but not this one."""
+        return [r for r in self.ranks if step not in self.per_rank[r]]
+
+    # -- derived: step wall time --------------------------------------------
+
+    def step_wall_s(self, rank: int) -> List[Tuple[int, float]]:
+        """Per-step host wall seconds for one rank: the explicit
+        ``step_wall_us`` field when the exporter wrote it, else
+        consecutive ``t_us`` deltas (first step then has no sample)."""
+        by_step = self.per_rank.get(rank) or {}
+        steps = sorted(by_step)
+        explicit = []
+        for step in steps:
+            v = by_step[step].get(STEP_WALL_FIELD)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                explicit.append((step, float(v) / 1e6))
+        if explicit:
+            return explicit
+        out = []
+        for prev, cur in zip(steps, steps[1:]):
+            t0, t1 = by_step[prev].get("t_us"), by_step[cur].get("t_us")
+            if isinstance(t0, (int, float)) and isinstance(t1, (int, float)):
+                out.append((cur, max(0.0, float(t1) - float(t0)) / 1e6))
+        return out
+
+    # -- counters ------------------------------------------------------------
+
+    def counter_delta(self, name: str, rank: Optional[int] = None,
+                      window: Optional[int] = None,
+                      agg: str = "sum") -> float:
+        """Increase of one registry counter cell (exact snapshot key, e.g.
+        ``bf_step_cache_total{result=build}``) over the window.
+
+        Counters are PROCESS-scoped, so the delta is computed per
+        physical counter stream and then aggregated: exploded virtual
+        ranks share one file (one representative reads it once, never N
+        times), and on a real multi-file fleet each rank's file is its
+        own stream — mixing first/last across processes would compare
+        unrelated counters.  ``agg``: ``"sum"`` totals the streams,
+        ``"max"`` takes the worst stream — right for counters every
+        process increments for the same fleet-wide event (a synchronized
+        recompile, a majority-confirmed death), where the sum would
+        scale with fleet size.  Pass ``rank`` to restrict to one
+        stream."""
+        if rank is not None:
+            reps = [rank]
+        else:
+            by_stream: Dict[object, int] = {}
+            for r in self.ranks:
+                s = self.series.get(r)
+                key = s.path if (s is not None and s.path) else ("rank", r)
+                by_stream.setdefault(key, r)
+            reps = sorted(by_stream.values())
+        lo = None if window is None else (self.last_step() or 0) - window + 1
+        deltas = []
+        for r in reps:
+            by_step = self.per_rank.get(r) or {}
+            first = last = None
+            for step in sorted(by_step):
+                if lo is not None and step < lo:
+                    continue
+                c = by_step[step].get("counters")
+                if not isinstance(c, dict):
+                    continue
+                if name not in c:
+                    # registry counters are created on their FIRST
+                    # increment: a snapshot that lacks the key pins the
+                    # baseline at 0, so a counter appearing mid-series
+                    # with value 1 reads as one event, not zero
+                    if last is None:
+                        first = 0.0
+                    continue
+                if first is None:
+                    first = float(c[name])
+                last = float(c[name])
+            if first is not None and last is not None:
+                deltas.append(last - first)
+        if not deltas:
+            return 0.0
+        return max(deltas) if agg == "max" else sum(deltas)
+
+    def counter_keys(self, prefix: str) -> List[str]:
+        """Snapshot keys starting with ``prefix`` seen anywhere."""
+        keys = set()
+        for by_step in self.per_rank.values():
+            for rec in by_step.values():
+                c = rec.get("counters")
+                if isinstance(c, dict):
+                    keys.update(k for k in c if k.startswith(prefix))
+        return sorted(keys)
+
+
+def load_fleet(prefix: Optional[str] = None, *,
+               paths: Optional[Dict[int, str]] = None,
+               expected_ranks: Optional[int] = None,
+               explode_virtual: bool = True,
+               cache: Optional[TailCache] = None) -> FleetView:
+    """Build the fleet view from ``<prefix><rank>.jsonl`` files (or an
+    explicit ``{rank: path}`` map).
+
+    ``expected_ranks``: fleet size the caller knows out of band — silent
+    ranks become ``missing_file`` gaps.  ``explode_virtual``: when a
+    SINGLE physical series carries ``[N]``-list telemetry (the
+    single-process virtual mesh), split it into N virtual rank series so
+    per-rank rules see per-rank values.  ``cache``: a
+    :class:`TailCache` held across calls makes repeated loads parse only
+    appended bytes (the live-monitor path)."""
+    if paths is None:
+        if prefix is None:
+            raise ValueError("load_fleet needs a prefix or explicit paths")
+        paths = discover_series(prefix)
+    series: List[RankSeries] = []
+    gaps: List[Gap] = []
+    for rank in sorted(paths):
+        records, file_gaps = read_jsonl_tolerant(paths[rank], cache)
+        for g in file_gaps:
+            if g.rank is None:
+                g.rank = rank
+        gaps.extend(file_gaps)
+        truncated = any(g.kind == "truncated" for g in file_gaps)
+        series.append(RankSeries(rank=rank, records=records,
+                                 path=paths[rank], truncated=truncated))
+    if explode_virtual and len(series) == 1 and series[0].records:
+        width = _virtual_width(series[0].records)
+        if width:
+            series = _explode(series[0], width)
+            if expected_ranks is None:
+                expected_ranks = width
+    return FleetView(series, gaps, expected_ranks=expected_ranks)

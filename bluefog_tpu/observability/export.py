@@ -25,6 +25,7 @@ from typing import Dict, Optional
 
 from .. import timeline as _tl
 from . import metrics as _metrics
+from . import phases as _phases
 
 __all__ = [
     "METRICS_ENV", "metrics_start", "metrics_end", "metrics_active",
@@ -38,7 +39,24 @@ METRICS_ENV = "BLUEFOG_METRICS"
 # shared by the tests and `make metrics-smoke`)
 REQUIRED_JSONL_KEYS = ("step", "t_us", "rank")
 
-# (file handle, path, rank, t0, enabled_registry_here)
+
+class _Sink:
+    """Open JSONL sink: file handle + rank + clocks.  ``last_log`` feeds
+    the per-record ``step_wall_us`` field (host wall time since the
+    previous ``log_step`` — the straggler-attribution time base the
+    fleet aggregator reads)."""
+
+    __slots__ = ("f", "path", "rank", "t0", "enabled_here", "last_log")
+
+    def __init__(self, f, path, rank, t0, enabled_here):
+        self.f = f
+        self.path = path
+        self.rank = rank
+        self.t0 = t0
+        self.enabled_here = enabled_here
+        self.last_log = None
+
+
 _sink = [None]
 
 
@@ -47,7 +65,7 @@ def metrics_active() -> bool:
 
 
 def metrics_path() -> Optional[str]:
-    return _sink[0][1] if _sink[0] else None
+    return _sink[0].path if _sink[0] else None
 
 
 def metrics_start(file_prefix: Optional[str] = None,
@@ -71,21 +89,24 @@ def metrics_start(file_prefix: Optional[str] = None,
     f = open(path, "w")
     enabled_here = not _metrics.enabled()
     _metrics.enable()
-    _sink[0] = (f, path, rank, time.perf_counter(), enabled_here)
+    # phases timed by a previous loop that never logged them must not be
+    # misattributed to this sink's first record
+    _phases.reset_step_phases()
+    _sink[0] = _Sink(f, path, rank, time.perf_counter(), enabled_here)
     return path
 
 
 def metrics_end() -> None:
     """Close the JSONL sink (idempotent).  The registry keeps its values —
     only the enable flag is restored when :func:`metrics_start` set it."""
-    if _sink[0] is None:
+    sink = _sink[0]
+    if sink is None:
         return
-    f, _path, _rank, _t0, enabled_here = _sink[0]
     _sink[0] = None
     try:
-        f.close()
+        sink.f.close()
     finally:
-        if enabled_here:
+        if sink.enabled_here:
             _metrics.disable()
 
 
@@ -122,40 +143,82 @@ def log_step(step: int, telemetry=None, extra: Optional[Dict] = None,
     fine — fetched here, OUTSIDE the jitted step) or an already-host dict.
     ``extra``: additional JSON-able fields merged into the record.
     ``counters=False`` skips the registry snapshot (cheaper lines).
+
+    Beyond the telemetry fields the record carries ``step_wall_us``
+    (host wall time since the previous ``log_step`` on this sink — the
+    per-rank step-time series the fleet aggregator and the health
+    engine's straggler rule consume) and, when the step loop timed any
+    :mod:`~.phases` phases, a ``"phases": {name: seconds}`` dict (the
+    device->host telemetry fetch below is itself timed as the
+    ``export`` phase).
+
     Returns the record written, or None when no sink is open AND no
     timeline is recording (nothing to do)."""
     sink = _sink[0]
     timeline_on = _tl.timeline_enabled()
     if sink is None and not timeline_on:
         return None
+    now = time.perf_counter()
     record: Dict[str, object] = {
         "step": int(step),
-        "t_us": int((time.perf_counter() - (sink[3] if sink else 0.0)) * 1e6),
-        "rank": sink[2] if sink else 0,
+        "t_us": int((now - (sink.t0 if sink else 0.0)) * 1e6),
+        "rank": sink.rank if sink else 0,
     }
+    if sink is not None:
+        if sink.last_log is not None:
+            record["step_wall_us"] = int((now - sink.last_log) * 1e6)
+        sink.last_log = now
+    # the snapshot fetch is the device sync — THE host-visible export
+    # cost; time it as the `export` phase so it lands in this record
+    t_fetch = time.perf_counter()
     tel_host = telemetry_to_host(telemetry) if telemetry is not None else {}
+    if telemetry is not None:
+        _phases.record_phase("export", time.perf_counter() - t_fetch)
+    # the snapshot's in-graph step counter must not clobber the caller's
+    # log index (several loops may share one sink, and on the virtual
+    # mesh it is an [N] list, not a scalar)
+    tel_host.pop("step", None)
     record.update(tel_host)
     if extra:
         record.update(extra)
+    staged = _phases.take_step_phases()
+    if staged:
+        record["phases"] = staged
     if counters and _metrics.enabled():
         record["counters"] = _metrics.registry.snapshot()
     if sink is not None:
-        f = sink[0]
-        f.write(json.dumps(record) + "\n")
-        f.flush()
+        sink.f.write(json.dumps(record) + "\n")
+        sink.f.flush()
     if timeline_on:
-        # Perfetto counter lanes: per-rank telemetry collapses to the mean
-        # (one value per timestamp per lane); host gauges ride along so
+        # Perfetto counter lanes: each per-rank telemetry field renders
+        # as its cross-rank mean PLUS `_min`/`_max` companion lanes —
+        # a single straggling or diverging rank must stay visible in the
+        # trace instead of averaging away; host gauges ride along so
         # queue depth lines up with the op spans
         for k, v in tel_host.items():
-            if k == "step":
-                continue
             _tl.record_counter(f"telemetry/{k}", _mean(v))
+            if isinstance(v, list) and len(v) > 1:
+                _tl.record_counter(f"telemetry/{k}_min", min(v))
+                _tl.record_counter(f"telemetry/{k}_max", max(v))
         if extra:
             for k, v in extra.items():
                 if isinstance(v, (int, float)):
                     _tl.record_counter(f"telemetry/{k}", float(v))
     return record
+
+
+def _escape_label_value(v: str) -> str:
+    """Label-value escaping per the Prometheus exposition format:
+    backslash, double-quote, and line-feed must be escaped (in that
+    order — escaping the backslash last would double the others)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP text escaping (exposition format): backslash and line-feed
+    only — quotes are legal in HELP."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def prometheus_text(reg: Optional[_metrics.Registry] = None) -> str:
@@ -164,10 +227,11 @@ def prometheus_text(reg: Optional[_metrics.Registry] = None) -> str:
     lines = []
     for m in reg.metrics():
         if m.help:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         for key, val in m._items():
-            labels = ",".join(f'{k}="{v}"' for k, v in key)
+            labels = ",".join(f'{k}="{_escape_label_value(v)}"'
+                              for k, v in key)
             if m.kind == "histogram":
                 for le, c in zip(m.buckets, val["buckets"]):
                     ls = (labels + "," if labels else "") + f'le="{le}"'

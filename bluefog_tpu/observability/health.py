@@ -1,0 +1,469 @@
+"""Rule-based fleet health engine: structured anomaly verdicts.
+
+The sensing half of the ROADMAP's closed-loop adaptive controller: the
+aggregated fleet view (``observability/aggregate.py``) goes in, a
+machine-consumable :class:`HealthReport` of :class:`Verdict` records
+comes out — so the controller (and ``bfmonitor``, and CI gates) consume
+VERDICTS, not raw series.  Every rule has a documented threshold with an
+env knob (``BLUEFOG_HEALTH_*``), and the defaults are calibrated to
+raise ZERO false alarms on a clean 20-step consensus-only reference run
+(asserted by ``tests/test_fleet_health.py`` and ``make health-smoke``).
+
+Rules over the trailing window of ``cfg.window`` steps:
+
+* ``consensus_stall``    — consensus distance stopped contracting while
+  still far from consensus: the spectral-gap contraction the paper's
+  claim rests on has stalled (slow-mixing topology, dead edges, or a
+  CHOCO γ backed too far off).
+* ``consensus_diverge``  — consensus distance GREW by ``diverge_ratio``
+  over the window: the mixing recursion is unstable.
+* ``non_finite``         — NaN/inf in consensus/norm/loss series: the
+  iterates are corrupt (critical).
+* ``residual_blowup``    — carried error-feedback residual exceeds
+  ``residual_factor`` x param norm: the documented γ≫ω instability
+  boundary (docs/compression.md "γ stability").
+* ``straggler``          — one rank's median step wall time exceeds
+  ``straggler_factor`` x the fleet median.
+* ``dead_rank``          — a rank stopped reporting ``dead_after`` steps
+  ago while the fleet advanced; ``rank_silent`` — an expected rank never
+  wrote a file at all.
+* ``dead_rank_confirmed`` / ``repair`` / ``degraded`` — fed from the
+  resilience counters (``record_resilience_event`` /
+  ``bf_resilience_*``) riding the JSONL records.
+* ``compile_storm``      — ``bf_step_cache_total{result=build}`` grew by
+  more than ``compile_builds`` inside the window: a knob is churning the
+  step cache (``utils/compile_cache.note_step_cache``).
+* ``series_gap``         — loader-level holes (truncated tails, parse
+  errors, missing steps) surfaced as verdicts while the window still
+  covers them (old, moved-past gaps stay in ``view.gaps`` only).
+* ``no_data``            — the view is empty with nothing even expected:
+  a typo'd prefix must not pass a ``--fail-on`` gate green.
+
+Severity: ``info`` verdicts are context (repairs, chaos boundaries);
+``warn``/``critical`` are ALERTS — ``report.ok`` is False iff any alert
+fired.  Results are mirrored to the host registry as ``bf_health_*``
+gauges and appendable to a verdict JSONL (:func:`write_verdicts`).
+"""
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import List, Optional
+
+from . import aggregate as AG
+from . import metrics as _metrics
+
+__all__ = [
+    "HealthConfig", "Verdict", "HealthReport", "evaluate",
+    "write_verdicts", "UNMEASURED",
+]
+
+# mirrors ingraph.UNMEASURED without importing the JAX stack: consensus
+# distance -1 means "this step issued no collective" (degraded branch)
+UNMEASURED = -1.0
+
+_ENV_PREFIX = "BLUEFOG_HEALTH_"
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return int(v) if v else default
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Rule thresholds (env defaults in parentheses; see
+    docs/observability.md "Fleet health & bfmonitor").
+
+    ``window``            steps per verdict window (8)
+    ``stall_ratio``       stall fires when cd_end/cd_start exceeds this
+                          over a FULL window (0.9 — i.e. <10% contraction)
+    ``stall_floor``       ...and cd_end is still above this absolute
+                          floor (1e-8): converged-and-flat is healthy
+    ``diverge_ratio``     diverge fires at cd_end/cd_start above this (4)
+    ``residual_factor``   residual blow-up at residual_norm > factor x
+                          param_norm (1.0 — the metrics-smoke bound)
+    ``straggler_factor``  rank median step time > factor x fleet median (2)
+    ``straggler_floor_s`` ignore sub-floor absolute step times (1e-4:
+                          microsecond jitter is not a straggler)
+    ``dead_after``        rank considered dead after lagging this many
+                          steps behind the fleet max (window)
+    ``compile_builds``    step-cache builds tolerated per window (2)
+    """
+    window: int = 8
+    stall_ratio: float = 0.9
+    stall_floor: float = 1e-8
+    diverge_ratio: float = 4.0
+    residual_factor: float = 1.0
+    straggler_factor: float = 2.0
+    straggler_floor_s: float = 1e-4
+    dead_after: Optional[int] = None
+    compile_builds: int = 2
+
+    @classmethod
+    def from_env(cls) -> "HealthConfig":
+        return cls(
+            window=_env_int("WINDOW", 8),
+            stall_ratio=_env_float("STALL_RATIO", 0.9),
+            stall_floor=_env_float("STALL_FLOOR", 1e-8),
+            diverge_ratio=_env_float("DIVERGE_RATIO", 4.0),
+            residual_factor=_env_float("RESIDUAL_FACTOR", 1.0),
+            straggler_factor=_env_float("STRAGGLER_FACTOR", 2.0),
+            straggler_floor_s=_env_float("STRAGGLER_FLOOR_S", 1e-4),
+            dead_after=(_env_int("DEAD_AFTER", 0) or None),
+            compile_builds=_env_int("COMPILE_BUILDS", 2),
+        )
+
+    def resolved_dead_after(self) -> int:
+        return self.dead_after if self.dead_after else self.window
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One structured health finding.
+
+    ``rank`` is None for fleet-wide verdicts; ``value``/``threshold``
+    carry the measured quantity and the rule boundary it crossed so the
+    controller can reason about margins, not just booleans."""
+    rule: str
+    severity: str                      # info | warn | critical
+    message: str
+    rank: Optional[int] = None
+    step_lo: Optional[int] = None
+    step_hi: Optional[int] = None
+    value: Optional[float] = None
+    threshold: Optional[float] = None
+
+    def asdict(self):
+        d = dataclasses.asdict(self)
+        # JSONL must stay strictly parseable even for inf/nan evidence
+        for k in ("value", "threshold"):
+            if d[k] is not None and not math.isfinite(d[k]):
+                d[k] = repr(d[k])
+        return d
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """Verdicts for one trailing step window (the controller contract:
+    one report per evaluation, ``ok`` false iff any warn/critical)."""
+    step_lo: int
+    step_hi: int
+    ranks: int
+    verdicts: List[Verdict]
+
+    @property
+    def alerts(self) -> List[Verdict]:
+        return [v for v in self.verdicts
+                if v.severity in ("warn", "critical")]
+
+    @property
+    def ok(self) -> bool:
+        return not self.alerts
+
+    def by_rule(self, rule: str) -> List[Verdict]:
+        return [v for v in self.verdicts if v.rule == rule]
+
+    def asdict(self):
+        return {
+            "step_lo": self.step_lo, "step_hi": self.step_hi,
+            "ranks": self.ranks, "ok": self.ok,
+            "alerts": len(self.alerts),
+            "verdicts": [v.asdict() for v in self.verdicts],
+        }
+
+
+def _finite(v: Optional[float]) -> bool:
+    return v is not None and math.isfinite(v)
+
+
+def _windowed(series, lo: int):
+    return [(s, v) for s, v in series if s >= lo]
+
+
+def _consensus_series(view: AG.FleetView, rank: int, lo: int):
+    """Rank's consensus series inside the window, UNMEASURED (degraded
+    no-collective steps) excluded — those steps measured nothing."""
+    return [(s, v) for s, v in _windowed(view.series_of(
+        rank, "consensus_dist"), lo) if v != UNMEASURED]
+
+
+def _consensus_rules(view, cfg, lo, hi, out):
+    full = cfg.window
+    stalled, diverged = [], []
+    evidence = {}
+    for rank in view.ranks:
+        cd = _consensus_series(view, rank, lo)
+        if len(cd) < 2:
+            continue
+        vals = [v for _, v in cd]
+        if not all(_finite(v) for v in vals):
+            continue                       # non_finite rule owns these
+        first, last = vals[0], vals[-1]
+        if first <= 0:
+            continue                       # already exactly at consensus
+        ratio = last / first
+        if ratio >= cfg.diverge_ratio:
+            diverged.append(rank)
+            evidence[rank] = ratio
+        # stall needs a FULL window of evidence: short tails at startup
+        # must not alarm
+        elif (len(cd) >= full and ratio > cfg.stall_ratio
+                and last > cfg.stall_floor):
+            stalled.append(rank)
+            evidence[rank] = ratio
+
+    def emit(ranks, rule, severity, threshold, fmt):
+        if not ranks:
+            return
+        if len(ranks) == len(view.ranks):
+            worst = max(ranks, key=lambda r: evidence[r])
+            out.append(Verdict(rule, severity,
+                               fmt("all ranks", evidence[worst]),
+                               rank=None, step_lo=lo, step_hi=hi,
+                               value=evidence[worst], threshold=threshold))
+        else:
+            for r in ranks:
+                out.append(Verdict(rule, severity, fmt(f"rank {r}",
+                                                       evidence[r]),
+                                   rank=r, step_lo=lo, step_hi=hi,
+                                   value=evidence[r], threshold=threshold))
+
+    emit(diverged, "consensus_diverge", "critical", cfg.diverge_ratio,
+         lambda who, v: f"consensus distance grew {v:.3g}x over steps "
+                        f"{lo}..{hi} on {who} (mixing unstable; check "
+                        f"topology repair and CHOCO gamma)")
+    emit(stalled, "consensus_stall", "warn", cfg.stall_ratio,
+         lambda who, v: f"consensus distance contracted only "
+                        f"{(1 - v) * 100:.1f}% over steps {lo}..{hi} on "
+                        f"{who} while still above floor (slow-mixing "
+                        f"topology or stalled exchange)")
+
+
+_FINITE_FIELDS = ("consensus_dist", "param_norm", "grad_norm",
+                  "update_norm", "residual_norm", "loss")
+
+
+def _non_finite_rule(view, cfg, lo, hi, out):
+    for rank in view.ranks:
+        for field in _FINITE_FIELDS:
+            bad = [(s, v) for s, v in _windowed(
+                view.series_of(rank, field), lo)
+                if v is not None and not math.isfinite(v)]
+            if bad:
+                s, v = bad[0]
+                out.append(Verdict(
+                    "non_finite", "critical",
+                    f"rank {rank}: {field} went non-finite ({v!r}) at "
+                    f"step {s} — iterates corrupt",
+                    rank=rank, step_lo=s, step_hi=bad[-1][0], value=v))
+                break      # one verdict per rank says it all
+
+
+def _residual_rule(view, cfg, lo, hi, out):
+    for rank in view.ranks:
+        res = dict(_windowed(view.series_of(rank, "residual_norm"), lo))
+        pn = dict(_windowed(view.series_of(rank, "param_norm"), lo))
+        worst, at = 0.0, None
+        for s, r in res.items():
+            p = pn.get(s)
+            if _finite(r) and _finite(p) and p > 0 and r / p > worst:
+                worst, at = r / p, s
+        if at is not None and worst > cfg.residual_factor:
+            out.append(Verdict(
+                "residual_blowup", "critical",
+                f"rank {rank}: error-feedback residual reached "
+                f"{worst:.3g}x the param norm at step {at} — the "
+                f"gamma >> omega instability boundary "
+                f"(docs/compression.md); back off CHOCO gamma or the "
+                f"compression ratio",
+                rank=rank, step_lo=lo, step_hi=hi, value=worst,
+                threshold=cfg.residual_factor))
+
+
+def _straggler_rule(view, cfg, lo, hi, out):
+    medians = {}
+    for rank in view.ranks:
+        wall = [v for s, v in view.step_wall_s(rank) if s >= lo]
+        if wall:
+            medians[rank] = float(sorted(wall)[len(wall) // 2])
+    if len(medians) < 3:
+        return                       # no meaningful fleet baseline
+    fleet = sorted(medians.values())[len(medians) // 2]
+    if fleet < cfg.straggler_floor_s:
+        return
+    for rank, med in sorted(medians.items()):
+        if med > cfg.straggler_factor * fleet:
+            out.append(Verdict(
+                "straggler", "warn",
+                f"rank {rank}: median step {med * 1e3:.1f} ms is "
+                f"{med / fleet:.1f}x the fleet median "
+                f"{fleet * 1e3:.1f} ms over steps {lo}..{hi}",
+                rank=rank, step_lo=lo, step_hi=hi, value=med / fleet,
+                threshold=cfg.straggler_factor))
+
+
+def _dead_rank_rule(view, cfg, lo, hi, out):
+    dead_after = cfg.resolved_dead_after()
+    for rank in view.ranks:
+        last = view.rank_last_step(rank)
+        if last is None:
+            continue               # missing_file gap owns the no-data case
+        if hi - last >= dead_after:
+            out.append(Verdict(
+                "dead_rank", "critical",
+                f"rank {rank}: last report at step {last}, fleet is at "
+                f"{hi} ({hi - last} steps behind) — rank presumed dead "
+                f"or wedged",
+                rank=rank, step_lo=last, step_hi=hi,
+                value=float(hi - last), threshold=float(dead_after)))
+
+
+_GAP_SEVERITY = {"missing_file": "critical", "truncated": "info",
+                 "missing_steps": "warn", "parse_error": "warn"}
+
+
+def _gap_rule(view, cfg, lo, hi, out):
+    for gap in view.gaps:
+        if gap.kind == "missing_file":
+            out.append(Verdict(
+                "rank_silent", "critical",
+                f"rank {gap.rank}: expected but never wrote a series "
+                f"file ({gap.detail or 'no JSONL found'})",
+                rank=gap.rank, step_lo=lo, step_hi=hi))
+        else:
+            # a gap the fleet moved past `window` steps ago is history,
+            # not an ACTIVE condition: alarming on it forever would pin
+            # report.ok false for the rest of the run (it stays visible
+            # in view.gaps / the bfmonitor gaps list).  Gaps with no
+            # step anchor cannot be aged out and always report.
+            if gap.step is not None and gap.step < lo:
+                continue
+            out.append(Verdict(
+                "series_gap", _GAP_SEVERITY.get(gap.kind, "warn"),
+                f"{gap.kind}: {gap.detail}" + (
+                    f" (rank {gap.rank})" if gap.rank is not None else ""),
+                rank=gap.rank, step_lo=lo, step_hi=hi))
+
+
+def _counter_rules(view, cfg, lo, hi, out):
+    # agg="max" throughout: every process increments its own copy of
+    # these counters for the same fleet-wide event, so a fleet-summed
+    # delta would scale the alarm threshold with fleet size (one
+    # synchronized recompile on 8 ranks is 1 event, not 8)
+    confirms = view.counter_delta("bf_resilience_confirms_total",
+                                  agg="max")
+    if confirms > 0:
+        out.append(Verdict(
+            "dead_rank_confirmed", "warn",
+            f"{int(confirms)} rank death(s) majority-confirmed and the "
+            f"mixing matrix repaired during the series "
+            f"(bf_resilience_confirms_total)",
+            step_lo=lo, step_hi=hi, value=confirms))
+    for key in view.counter_keys("bf_resilience_events_total"):
+        delta = view.counter_delta(key, agg="max")
+        if delta <= 0:
+            continue
+        kind = key[key.find("kind=") + 5:].rstrip("}")
+        sev = "warn" if kind in ("degraded", "fault") else "info"
+        out.append(Verdict(
+            "resilience_event", sev,
+            f"{int(delta)} resilience event(s) of kind {kind!r} "
+            f"recorded during the series",
+            step_lo=lo, step_hi=hi, value=delta))
+    builds = view.counter_delta("bf_step_cache_total{result=build}",
+                                window=cfg.window, agg="max")
+    if builds > cfg.compile_builds:
+        out.append(Verdict(
+            "compile_storm", "warn",
+            f"{int(builds)} whole-step recompiles inside the last "
+            f"{cfg.window} steps (> {cfg.compile_builds}) — a knob is "
+            f"churning the step-cache key (utils/compile_cache)",
+            step_lo=lo, step_hi=hi, value=builds,
+            threshold=float(cfg.compile_builds)))
+
+
+_SEVERITY_RANK = {"critical": 0, "warn": 1, "info": 2}
+
+# rules with a nonzero bf_health_alerts cell from the previous
+# evaluation — zeroed when they resolve
+_alerted_rules = set()
+
+
+def evaluate(view: AG.FleetView,
+             cfg: Optional[HealthConfig] = None) -> HealthReport:
+    """Run every rule over the trailing ``cfg.window`` steps of the
+    fleet view; mirror the outcome to ``bf_health_*`` registry gauges
+    when the host registry is enabled."""
+    cfg = cfg or HealthConfig.from_env()
+    steps = view.steps()
+    hi = steps[-1] if steps else 0
+    lo = max(steps[0] if steps else 0, hi - cfg.window + 1)
+    out: List[Verdict] = []
+    if steps:
+        _consensus_rules(view, cfg, lo, hi, out)
+        _non_finite_rule(view, cfg, lo, hi, out)
+        _residual_rule(view, cfg, lo, hi, out)
+        _straggler_rule(view, cfg, lo, hi, out)
+        _dead_rank_rule(view, cfg, lo, hi, out)
+        _counter_rules(view, cfg, lo, hi, out)
+    elif not any(g.kind == "missing_file" for g in view.gaps):
+        # an empty view with nothing even expected must NOT read as
+        # healthy: a typo'd prefix in a `--fail-on` CI gate would
+        # otherwise pass green while monitoring nothing
+        out.append(Verdict(
+            "no_data", "critical",
+            "no series data found — wrong prefix, or the fleet never "
+            "wrote a step"))
+    _gap_rule(view, cfg, lo, hi, out)
+    out.sort(key=lambda v: (_SEVERITY_RANK.get(v.severity, 3), v.rule,
+                            -1 if v.rank is None else v.rank))
+    report = HealthReport(step_lo=lo, step_hi=hi,
+                          ranks=len(view.ranks), verdicts=out)
+    if _metrics.enabled():
+        _metrics.gauge(
+            "bf_health_ok",
+            "1 when the last health evaluation raised no warn/critical "
+            "verdict").set(1.0 if report.ok else 0.0)
+        _metrics.gauge(
+            "bf_health_last_step",
+            "newest step the last health evaluation saw").set(float(hi))
+        alerts = _metrics.gauge(
+            "bf_health_alerts",
+            "active warn/critical verdicts by rule (last evaluation)")
+        by_rule = {}
+        for v in report.alerts:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        # an alert that resolved must drop to 0 on the scrape surface,
+        # not linger at its old count
+        for rule in _alerted_rules - set(by_rule):
+            alerts.set(0.0, rule=rule)
+        _alerted_rules.clear()
+        _alerted_rules.update(by_rule)
+        for rule, n in by_rule.items():
+            alerts.set(float(n), rule=rule)
+    return report
+
+
+def write_verdicts(report: HealthReport, path: str,
+                   append: bool = True) -> None:
+    """Append the report to a verdict JSONL: one summary line (``kind:
+    report``) then one line per verdict (``kind: verdict``) — the
+    machine-consumable trail the controller tails."""
+    now_us = int(time.time() * 1e6)
+    with open(path, "a" if append else "w") as f:
+        head = {"kind": "report", "t_us": now_us}
+        head.update(report.asdict())
+        del head["verdicts"]
+        f.write(json.dumps(head) + "\n")
+        for v in report.verdicts:
+            rec = {"kind": "verdict", "t_us": now_us}
+            rec.update(v.asdict())
+            f.write(json.dumps(rec) + "\n")

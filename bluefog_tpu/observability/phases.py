@@ -1,0 +1,138 @@
+"""Step-phase profiling: wall-clock timers around the host-side step loop.
+
+The in-graph telemetry says WHAT the consensus process did; the phase
+timers say WHERE the host step's wall time went — the time base
+straggler attribution needs.  Four canonical phases:
+
+* ``exchange`` — launching the communication (window put/get/accumulate
+  and their waits; for the jitted-strategy family the exchange lives
+  inside the graph and is covered by ``compute``),
+* ``fold``     — folding received buffers (``win_update`` / collect),
+* ``compute``  — the jitted step dispatch (forward/backward/update —
+  and, fused in-graph, the exchange itself),
+* ``export``   — telemetry fetch + JSONL/timeline write
+  (``export.log_step`` times its device->host fetch here).
+
+Each timed phase records THREE ways, all free when observability is off:
+
+1. host registry histogram ``bf_step_phase_seconds{phase=...}``
+   (Prometheus-ready latency distribution),
+2. a Perfetto span on the ``step_phase`` timeline lane plus a
+   ``phase/<name>_ms`` counter lane — the phase timings graph NEXT TO
+   the op spans and telemetry lanes,
+3. a per-step staging dict drained by ``export.log_step`` into the JSONL
+   record (``"phases": {name: seconds}``), which is how the fleet
+   aggregator and the health engine's straggler rule see per-rank phase
+   time.
+
+Zero cost when disabled: :func:`step_phase` returns a shared
+``nullcontext`` after ONE bool check when neither the metrics registry
+nor the timeline is active — the same guard discipline as every other
+instrumentation site (``observability/metrics.py``).
+
+Usage (any host step loop)::
+
+    from bluefog_tpu.observability import phases
+
+    with phases.step_phase("compute"):
+        out = step_fn(variables, opt_state, batch, i)
+    export.log_step(i, snap)           # drains the staged phase timings
+
+The built-in optimizer wrappers (``optim/wrappers.py``) and
+``training.run_steps`` already instrument their loops.
+"""
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from .. import timeline as _tl
+from . import metrics as _metrics
+
+__all__ = ["PHASES", "step_phase", "record_phase", "take_step_phases",
+           "reset_step_phases", "profiling_active"]
+
+PHASES = ("exchange", "fold", "compute", "export")
+
+# sub-us to minutes: host phase timings live well inside this span
+_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0,
+            3.0, 10.0, 30.0, 100.0)
+
+# phase -> seconds staged for the NEXT export.log_step record; a plain
+# dict (no lock): step loops are single-threaded by construction, and a
+# racing reader at worst misattributes one sample to a neighboring step
+_staged: Dict[str, float] = {}
+
+_NULL = contextlib.nullcontext()
+
+
+def profiling_active() -> bool:
+    """One-bool-each gate shared by every phase site: phases record only
+    while the metrics registry or a timeline is on."""
+    return _metrics.enabled() or _tl.timeline_enabled()
+
+
+def record_phase(name: str, seconds: float) -> None:
+    """Record one already-measured phase duration (histogram + Perfetto
+    lanes + the staged dict).  No-op while profiling is inactive."""
+    if not profiling_active():
+        return
+    _staged[name] = _staged.get(name, 0.0) + seconds
+    if _metrics.enabled():
+        _metrics.histogram(
+            "bf_step_phase_seconds",
+            "host wall time per step phase (exchange/fold/compute/export)",
+            buckets=_BUCKETS).observe(seconds, phase=name)
+    # the counter lane graphs the per-step duration; the span (emitted by
+    # the context manager, which knows the start timestamp) shows extent
+    _tl.record_counter(f"phase/{name}_ms", seconds * 1e3)
+
+
+class _PhaseTimer:
+    """Reusable timer context: span on the ``step_phase`` lane + the
+    :func:`record_phase` sinks."""
+
+    __slots__ = ("_name", "_t0", "_token")
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        self._token = _tl.op_start_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        _tl.record_op_span("step_phase", self._name, self._token)
+        record_phase(self._name, dt)
+        return False
+
+
+def step_phase(name: str):
+    """Context manager timing one phase of the host step loop.
+
+    Returns a shared no-op context (ONE bool check, nothing allocated)
+    while neither metrics nor a timeline is enabled — safe to leave in
+    hot paths permanently."""
+    if not profiling_active():
+        return _NULL
+    return _PhaseTimer(name)
+
+
+def reset_step_phases() -> None:
+    """Discard staged timings.  Called when a JSONL sink opens
+    (``export.metrics_start``): phases timed by a PREVIOUS loop that
+    never logged them must not land on the new sink's first record."""
+    _staged.clear()
+
+
+def take_step_phases() -> Optional[Dict[str, float]]:
+    """Drain the staged per-step phase durations ({phase: seconds}), or
+    None when nothing was staged.  Called by ``export.log_step`` so the
+    timings land on the SAME JSONL record as the step's telemetry."""
+    if not _staged:
+        return None
+    out = dict(_staged)
+    _staged.clear()
+    return out

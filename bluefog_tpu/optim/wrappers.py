@@ -33,6 +33,7 @@ from ..compress import compressors as _cp
 from ..compress import exchange as _cx
 from ..context import ctx
 from ..observability import ingraph as IG
+from ..observability import phases as _ph
 from ..ops import api as _api
 from ..ops import fusion as _fusion
 from ..ops import windows as W
@@ -316,8 +317,12 @@ class _JittedStrategyOptimizer:
         note_step_cache(hit)
         if not hit:
             self._step_cache[key] = self._build(key, telemetry)
-        return self._step_cache[key](params, grads, opt_state,
-                                     jnp.asarray(step, jnp.int32))
+        # `compute` phase = the whole jitted dispatch: for this family
+        # the exchange is fused INTO the graph, so exchange/fold have no
+        # separate host extent (the window family times them apart)
+        with _ph.step_phase("compute"):
+            return self._step_cache[key](params, grads, opt_state,
+                                         jnp.asarray(step, jnp.int32))
 
 
 def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
@@ -530,9 +535,14 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
         self._require_init()
         if not self._should_communicate(step):
             return self._apply_base(params, grads, opt_state, step)
-        W.win_wait(W.win_put_nonblocking(params, self._name,
-                                         dst_weights=self.dst_weights))
-        averaged = W.win_update(self._name, require_mutex=True)
+        # step-phase timers (observability/phases.py): `exchange` = the
+        # one-sided launch + wait, `fold` = the buffer average; the local
+        # adapt inside _apply_base times itself as `compute`
+        with _ph.step_phase("exchange"):
+            W.win_wait(W.win_put_nonblocking(params, self._name,
+                                             dst_weights=self.dst_weights))
+        with _ph.step_phase("fold"):
+            averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
 
 
@@ -545,10 +555,12 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
         if not self._should_communicate(step):
             return self._apply_base(params, grads, opt_state, step)
         # publish current weights in the window, then pull neighbors'
-        W.win_publish(self._name, params)
-        W.win_wait(W.win_get_nonblocking(self._name,
-                                         src_weights=self.src_weights))
-        averaged = W.win_update(self._name, require_mutex=True)
+        with _ph.step_phase("exchange"):
+            W.win_publish(self._name, params)
+            W.win_wait(W.win_get_nonblocking(self._name,
+                                             src_weights=self.src_weights))
+        with _ph.step_phase("fold"):
+            averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
 
 
@@ -610,13 +622,17 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         biased = W.win_fetch(self._name)
         out = self._apply_base(biased, grads, opt_state, step)
         adapted, opt_state = out[0], out[1]
-        if self.sched is not None:
-            W.win_accumulate(adapted, self._name, require_mutex=True,
-                             sched=self.sched, step=step)
-        else:
-            W.win_accumulate(adapted, self._name, self_weight=self.alpha,
-                             dst_weights=self.dst_weights, require_mutex=True)
-        collected = W.win_update_then_collect(self._name)
+        with _ph.step_phase("exchange"):
+            if self.sched is not None:
+                W.win_accumulate(adapted, self._name, require_mutex=True,
+                                 sched=self.sched, step=step)
+            else:
+                W.win_accumulate(adapted, self._name,
+                                 self_weight=self.alpha,
+                                 dst_weights=self.dst_weights,
+                                 require_mutex=True)
+        with _ph.step_phase("fold"):
+            collected = W.win_update_then_collect(self._name)
         if len(out) == 3:
             return self._debias(collected), opt_state, out[2]
         return self._debias(collected), opt_state

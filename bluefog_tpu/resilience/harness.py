@@ -446,6 +446,13 @@ class ChaosHarness:
             mats.append(np.asarray(W_eff))
             n_alive = int(self.plan.alive[min(t, self.plan.horizon - 1)]
                           .sum())
+            if _metrics.enabled():
+                # fleet-size gauge for the health engine / bfmonitor
+                # degraded-rank summary (docs/observability.md)
+                _metrics.gauge(
+                    "bf_resilience_alive_ranks",
+                    "ranks alive per the compiled fault plan at the "
+                    "current chaos step").set(float(n_alive))
             for r in np.nonzero(votes_np > n_alive // 2)[0]:
                 if r not in announced:
                     announced.add(int(r))

@@ -1,0 +1,280 @@
+"""``bfmonitor`` — live terminal fleet dashboard over the JSONL series.
+
+Tails the ``<prefix><rank>.jsonl`` metrics files a training fleet writes
+(``BLUEFOG_METRICS=<prefix>``), aggregates them into the step-aligned
+fleet view (``observability/aggregate.py``), runs the health engine
+(``observability/health.py``), and renders a per-rank dashboard:
+sparkline consensus/step-time columns, cross-rank spread stats, active
+alerts, and the degraded-rank summary.
+
+Modes::
+
+    bfmonitor /tmp/series_                # live dashboard, 2 s refresh
+    bfmonitor /tmp/series_ --once         # render one frame and exit
+    bfmonitor /tmp/series_ --once --json  # machine-readable report (CI
+                                          # gating: `make health-smoke`)
+    bfmonitor /tmp/series_ --verdicts /tmp/verdicts.jsonl
+                                          # also append HealthReports to
+                                          # a verdict JSONL (controller
+                                          # feed)
+
+Exit status: 0 normally; with ``--fail-on warn`` (or ``critical``) a
+``--once`` run exits 1 when a verdict at (or above) that severity is
+active — the CI-gate contract.
+"""
+
+import argparse
+import json
+import math
+import sys
+import time
+from typing import List, Optional
+
+from ..observability import aggregate as AG
+from ..observability import health as H
+
+__all__ = ["main", "build_report", "render_dashboard", "sparkline"]
+
+_TICKS = "▁▂▃▄▅▆▇█"
+_SEV_TAG = {"critical": "CRIT", "warn": "warn", "info": "info"}
+
+
+def sparkline(values: List[float], width: int = 12,
+              log_scale: bool = False) -> str:
+    """Unicode sparkline of the LAST ``width`` samples.  ``log_scale``
+    suits geometric series (consensus distance spans decades); non-finite
+    samples render as ``!``."""
+    vals = values[-width:]
+    if not vals:
+        return ""
+    finite = [v for v in vals if math.isfinite(v)]
+    if not finite:
+        return "!" * len(vals)
+    if log_scale:
+        floor = min((v for v in finite if v > 0), default=1.0)
+        xform = lambda v: math.log10(max(v, floor * 1e-3))
+        finite = [xform(v) for v in finite]
+    else:
+        xform = lambda v: v
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if not math.isfinite(v):
+            out.append("!")
+            continue
+        x = xform(v)
+        frac = 0.5 if span <= 0 else (x - lo) / span
+        out.append(_TICKS[min(len(_TICKS) - 1,
+                              max(0, int(frac * len(_TICKS))))])
+    return "".join(out)
+
+
+def _fmt(v: Optional[float], unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if not math.isfinite(v):
+        return repr(v)
+    if unit == "ms":
+        return f"{v * 1e3:.1f}ms"
+    if v != 0 and (abs(v) < 1e-3 or abs(v) >= 1e5):
+        return f"{v:.2e}"
+    return f"{v:.4g}"
+
+
+def _strict_json(obj):
+    """RFC 8259-safe copy: bare NaN/Infinity would make ``--json`` output
+    unparseable by strict consumers (jq, the CI gate) on exactly the
+    sick runs the monitor exists to diagnose — stringify them, same
+    treatment as ``Verdict.asdict``."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return repr(obj)
+    if isinstance(obj, dict):
+        return {k: _strict_json(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_strict_json(v) for v in obj]
+    return obj
+
+
+def build_report(prefix: str, *, window: Optional[int] = None,
+                 expected_ranks: Optional[int] = None,
+                 verdicts_path: Optional[str] = None,
+                 cache: Optional[AG.TailCache] = None):
+    """One monitoring pass: load the fleet view, evaluate health, and
+    assemble the JSON-able report dict ``--once --json`` prints (the
+    same dict `make health-smoke` asserts on).  Returns
+    ``(view, health_report, report_dict)``."""
+    cfg = H.HealthConfig.from_env()
+    if window:
+        cfg.window = window
+    view = AG.load_fleet(prefix, expected_ranks=expected_ranks,
+                         cache=cache)
+    report = H.evaluate(view, cfg)
+    if verdicts_path:
+        H.write_verdicts(report, verdicts_path)
+    last = view.last_step()
+    per_rank = {}
+    for rank in view.ranks:
+        cd = [x for x in view.series_of(rank, "consensus_dist")
+              if x[1] != H.UNMEASURED]
+        wall = view.step_wall_s(rank)
+        per_rank[str(rank)] = {
+            "last_step": view.rank_last_step(rank),
+            "consensus_dist": cd[-1][1] if cd else None,
+            "step_wall_s": wall[-1][1] if wall else None,
+            "steps": len(view.per_rank.get(rank, {})),
+        }
+    spreads = {}
+    if last is not None:
+        for field in ("consensus_dist", "param_norm", "residual_norm",
+                      "wire_bytes"):
+            # a degraded no-collective step reports the -1 UNMEASURED
+            # consensus sentinel — evidence, not a measurement
+            st = view.fleet_spread(
+                last, field,
+                exclude=H.UNMEASURED if field == "consensus_dist" else None)
+            if st is not None:
+                spreads[field] = st.asdict()
+        walls = [w[-1][1] for w in
+                 (view.step_wall_s(r) for r in view.ranks) if w]
+        st = AG.spread(walls)
+        if st is not None:
+            spreads["step_wall_s"] = st.asdict()
+    out = {
+        "prefix": prefix,
+        "ok": report.ok,
+        "ranks": len(view.ranks),
+        "expected_ranks": view.expected_ranks,
+        "last_step": last,
+        "window": [report.step_lo, report.step_hi],
+        "alerts": len(report.alerts),
+        "verdicts": [v.asdict() for v in report.verdicts],
+        "per_rank": per_rank,
+        "spread": spreads,
+        "gaps": [g.asdict() for g in view.gaps],
+    }
+    return view, report, _strict_json(out)
+
+
+def render_dashboard(view, report, *, width: int = 12) -> str:
+    """The human frame: header, per-rank sparkline table, alerts."""
+    lines = []
+    last = view.last_step()
+    stamp = time.strftime("%H:%M:%S")
+    status = ("OK" if report.ok
+              else f"{len(report.alerts)} ALERT"
+                   f"{'S' if len(report.alerts) != 1 else ''}")
+    lines.append(
+        f"bfmonitor  {stamp}  fleet: {len(view.ranks)} rank(s)"
+        + (f" (expected {view.expected_ranks})"
+           if view.expected_ranks
+           and view.expected_ranks != len(view.ranks) else "")
+        + f"  step: {'-' if last is None else last}"
+          f"  window: {report.step_lo}..{report.step_hi}  [{status}]")
+    dead = {v.rank for v in report.verdicts
+            if v.rule in ("dead_rank", "rank_silent")
+            and v.rank is not None}
+    if dead:
+        lines.append(f"degraded/dead ranks: "
+                     f"{', '.join(str(r) for r in sorted(dead))}")
+    if last is not None:
+        st = view.fleet_spread(last, "consensus_dist",
+                               exclude=H.UNMEASURED)
+        if st is not None:
+            lines.append(
+                f"consensus@{last}:  min {_fmt(st.min)}  p50 "
+                f"{_fmt(st.p50)}  p95 {_fmt(st.p95)}  max {_fmt(st.max)}")
+    lines.append("")
+    lines.append(f"{'rank':>4} {'steps':>5} {'consensus':>10} "
+                 f"{'trend':<{width}} {'step':>8} {'trend':<{width}}  flags")
+    flagged = {}
+    for v in report.alerts:
+        if v.rank is not None:
+            flagged.setdefault(v.rank, []).append(v.rule)
+    for rank in view.ranks:
+        cd = [x for _, x in view.series_of(rank, "consensus_dist")
+              if x != H.UNMEASURED]
+        wall = [w for _, w in view.step_wall_s(rank)]
+        nsteps = len(view.per_rank.get(rank, {}))
+        lines.append(
+            f"{rank:>4} {nsteps:>5} "
+            f"{_fmt(cd[-1] if cd else None):>10} "
+            f"{sparkline(cd, width, log_scale=True):<{width}} "
+            f"{_fmt(wall[-1] if wall else None, 'ms'):>8} "
+            f"{sparkline(wall, width):<{width}}  "
+            f"{','.join(flagged.get(rank, [])) or '-'}")
+    if report.verdicts:
+        lines.append("")
+        lines.append("verdicts:")
+        for v in report.verdicts:
+            lines.append(f"  [{_SEV_TAG.get(v.severity, v.severity)}] "
+                         f"{v.rule}: {v.message}")
+    return "\n".join(lines)
+
+
+_FAIL_LEVELS = {"never": (), "critical": ("critical",),
+                "warn": ("warn", "critical")}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bfmonitor",
+        description="live fleet health dashboard over BLUEFOG_METRICS "
+                    "JSONL series (docs/observability.md)")
+    p.add_argument("prefix",
+                   help="metrics prefix: tails <prefix><rank>.jsonl")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame / report and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report instead of the "
+                        "dashboard (CI gating)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="live refresh seconds (default 2)")
+    p.add_argument("--window", type=int, default=None,
+                   help="health window in steps (default "
+                        "BLUEFOG_HEALTH_WINDOW or 8)")
+    p.add_argument("--ranks", type=int, default=None,
+                   help="expected fleet size: silent ranks become "
+                        "rank_silent verdicts")
+    p.add_argument("--verdicts", default=None, metavar="PATH",
+                   help="append HealthReports to this verdict JSONL "
+                        "(the controller feed)")
+    p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
+                   default="never",
+                   help="with --once: exit 1 when a verdict at or above "
+                        "this severity is active")
+    args = p.parse_args(argv)
+
+    # one cache across live frames: each refresh parses only the bytes
+    # the fleet appended since the previous one
+    cache = AG.TailCache()
+
+    def frame():
+        view, report, out = build_report(
+            args.prefix, window=args.window, expected_ranks=args.ranks,
+            verdicts_path=args.verdicts, cache=cache)
+        if args.json:
+            print(json.dumps(out))
+        else:
+            print(render_dashboard(view, report))
+        return report
+
+    if args.once:
+        report = frame()
+        bad = [v for v in report.verdicts
+               if v.severity in _FAIL_LEVELS[args.fail_on]]
+        return 1 if bad else 0
+    try:
+        while True:
+            if not args.json:
+                # clear + home, like watch(1); plain frames in json mode
+                sys.stdout.write("\x1b[2J\x1b[H")
+            frame()
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

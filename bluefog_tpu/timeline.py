@@ -33,7 +33,7 @@ __all__ = [
     "timeline_start", "timeline_end", "timeline_enabled",
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
     "record_op_phase", "op_phase", "record_resilience_event",
-    "record_counter",
+    "record_counter", "op_start_us", "record_op_span",
 ]
 
 _ENV = "BLUEFOG_TIMELINE"

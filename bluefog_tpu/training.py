@@ -24,7 +24,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .compress import compressors as _cp
 from .compress import exchange as _cx
 from .context import ctx
+from .observability import export as _ex
 from .observability import ingraph as IG
+from .observability import phases as _phases
 from .ops import api as _api
 from .ops import fusion as _fusion
 from .optim import strategies as S
@@ -32,7 +34,7 @@ from .optim._plumbing import mesh_plumbing
 from .parallel.schedule import DynamicSchedule
 
 __all__ = ["create_train_state", "make_train_step", "cross_entropy_loss",
-           "replicate_to_ranks", "make_lm_train_step"]
+           "replicate_to_ranks", "make_lm_train_step", "run_steps"]
 
 
 def cross_entropy_loss(logits, labels):
@@ -347,6 +349,41 @@ def make_train_step(model,
                      for i, o in enumerate(out))
 
     return jax.jit(stepper, donate_argnums=(0, 1) if donate else ())
+
+
+def run_steps(step_fn, variables, opt_state, batches, num_steps: int, *,
+              start_step: int = 0, log: bool = True):
+    """Drive a :func:`make_train_step` function as an instrumented
+    host-side step loop.
+
+    Each iteration runs the jitted dispatch under the ``compute``
+    step-phase timer (``observability/phases.py``) and — when a JSONL
+    sink or timeline is open — exports the step's telemetry, loss, step
+    wall time, and phase timings via ``export.log_step``, which is all
+    ``bfmonitor`` / the fleet health engine need to watch the run live
+    (docs/observability.md "Fleet health & bfmonitor").  With
+    observability off this is a plain loop: the phase timer is one bool
+    check and ``log_step`` returns immediately.
+
+    ``batches``: a fixed global batch or a callable ``step -> batch``.
+    Returns ``(variables, opt_state, losses)``.
+    """
+    batch_of = batches if callable(batches) else (lambda _t: batches)
+    losses = []
+    for t in range(start_step, start_step + num_steps):
+        with _phases.step_phase("compute"):
+            out = step_fn(variables, opt_state, batch_of(t),
+                          jnp.asarray(t, jnp.int32))
+            variables, opt_state, loss = out[0], out[1], out[2]
+            snap = out[3] if len(out) > 3 else None
+            # the scalar fetch is the device sync: jit dispatch returns
+            # immediately, so timing it alone would attribute the whole
+            # device execution to no phase
+            loss = float(loss)
+        losses.append(loss)
+        if log:
+            _ex.log_step(t, snap, extra={"loss": loss})
+    return variables, opt_state, losses
 
 
 def make_lm_train_step(model, base_opt: optax.GradientTransformation,

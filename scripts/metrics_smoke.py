@@ -19,6 +19,15 @@ strictly decrease, the carried residual norm must stay bounded (below
 the parameter norm), and the snapshot must report a compression ratio
 > 1 (docs/compression.md).
 
+``--health`` (``make health-smoke``) adds the fleet-health CI gate
+(docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
+consensus-only fleet replayed into per-rank JSONL series must make
+``bfmonitor --once --json`` report ok with ZERO alerts (and a
+still-contracting consensus), while the same fleet with an injected
+chaos straggler (one rank's host step loop delayed ~5x) must gate —
+``--fail-on warn`` exits 1 with exactly the straggler verdict on the
+seeded rank, consensus still healthy.
+
 Exit 0 on success, 1 with a readable message otherwise.
 """
 
@@ -83,8 +92,104 @@ def compress_leg(params, grads, spec, steps=6):
     return series, max(res), ratio
 
 
+HEALTH_STEPS = 20
+SLEEP_NORMAL, SLEEP_STRAGGLER = 0.004, 0.02
+
+
+def bfmonitor_json(prefix, *extra):
+    """Run the REAL ``bfmonitor`` CLI (the console-script entry point) in
+    a subprocess and parse its ``--once --json`` report."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.monitor", prefix,
+         "--once", "--json", *extra],
+        capture_output=True, text=True, timeout=120)
+    if r.returncode not in (0, 1) or not r.stdout.strip():
+        fail(f"bfmonitor crashed (rc={r.returncode}): {r.stderr[-500:]}")
+    return r.returncode, json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def health_legs(n, tmp):
+    """The ``make health-smoke`` gate: clean fleet => zero alerts;
+    chaos-straggler fleet => exactly the straggler verdict, and the
+    CLI's ``--fail-on warn`` exit code flips."""
+    import time as _time
+    from bluefog_tpu.observability import aggregate as AGG
+
+    # one consensus-only trajectory, banked once (snapshots are cheap to
+    # re-log), then replayed into one JSONL series PER RANK — the chaos
+    # straggler is a genuine host-side delay on the seeded rank's step
+    # loop, so the verdict comes from measured step_wall_us, not from a
+    # fabricated field
+    rng = np.random.default_rng(7)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 6, 3)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(n, 4)), jnp.float32)}
+    grads = jax.tree.map(jnp.zeros_like, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0),
+                                                   telemetry=True)
+    state = opt.init(params)
+    p, snaps = params, []
+    for t in range(HEALTH_STEPS):
+        p, state, snap = opt.step(p, grads, state, t)
+        snaps.append(snap)
+
+    def replay(prefix, straggler=None):
+        for r in range(n):
+            EX.metrics_start(prefix, rank=r)
+            for t, snap in enumerate(snaps):
+                _time.sleep(SLEEP_STRAGGLER if r == straggler
+                            else SLEEP_NORMAL)
+                EX.log_step(t, snap)
+            EX.metrics_end()
+
+    clean = os.path.join(tmp, "health_clean_")
+    faulty = os.path.join(tmp, "health_straggler_")
+    seeded = n - 1
+    replay(clean)
+    replay(faulty, straggler=seeded)
+
+    # -- clean fleet: ok, zero alerts, consensus still contracting ------
+    rc, out = bfmonitor_json(clean, "--fail-on", "warn")
+    if rc != 0 or not out["ok"] or out["alerts"] != 0:
+        fail(f"clean fleet raised alerts (rc={rc}): "
+             f"{[v for v in out['verdicts']]}")
+    if out["ranks"] != n or out["last_step"] != HEALTH_STEPS - 1:
+        fail(f"clean fleet view wrong shape: {out['ranks']} ranks @ "
+             f"step {out['last_step']}")
+    means = [st.mean for _, st in AGG.load_fleet(clean)
+             .spread_series("consensus_dist")]
+    if not all(np.isfinite(means)) or not means[-1] < means[0]:
+        fail(f"clean fleet consensus not contracting: {means}")
+    if not all(b < a for a, b in zip(means[:5], means[1:6])):
+        fail(f"clean fleet consensus head not strictly decreasing: "
+             f"{means[:6]}")
+
+    # -- straggler fleet: gated, attributed, consensus still healthy ----
+    rc, out = bfmonitor_json(faulty, "--fail-on", "warn")
+    if rc != 1:
+        fail(f"straggler fleet did not gate (--fail-on warn rc={rc}): "
+             f"{out['verdicts']}")
+    alerts = [v for v in out["verdicts"]
+              if v["severity"] in ("warn", "critical")]
+    if {v["rule"] for v in alerts} != {"straggler"}:
+        fail(f"expected exactly the straggler verdict, got {alerts}")
+    if [v["rank"] for v in alerts] != [seeded]:
+        fail(f"straggler attributed to wrong rank: {alerts} "
+             f"(seeded rank {seeded})")
+    if any(v["rule"].startswith("consensus") for v in out["verdicts"]):
+        fail(f"straggler run raised consensus verdicts: {out['verdicts']}")
+    return {
+        "clean_alerts": 0,
+        "straggler_rank": seeded,
+        "straggler_ratio": round(alerts[0]["value"], 2),
+        "consensus_first": round(means[0], 6),
+        "consensus_last": round(means[-1], 6),
+    }
+
+
 def main():
     do_compress = "--compress" in sys.argv
+    do_health = "--health" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -150,6 +255,12 @@ def main():
     if losses[-1] >= losses[0]:
         fail(f"training loss did not decrease: {losses}")
 
+    # -- fleet health gate (--health / make health-smoke) ---------------
+    health_out = None
+    if do_health:
+        EX.metrics_end()           # release the sink for the per-rank legs
+        health_out = health_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -176,6 +287,8 @@ def main():
     }
     if comp_out:
         out["compress"] = comp_out
+    if health_out:
+        out["health"] = health_out
     print(json.dumps(out))
 
 

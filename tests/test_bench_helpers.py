@@ -153,3 +153,45 @@ def test_watchdog_skips_cleanly_when_nothing_banked(tmp_path):
     assert "value" not in out and "vs_baseline" not in out
     assert "unreachable" in out["reason"]
     assert "SKIP" in (tmp_path / "log").read_text()
+    # the skip record must bank the structured diagnosis (r02-r05 skips
+    # carried nothing but the cause string — undebuggable after the fact)
+    diag = out["diagnosis"]
+    assert diag["jax_platforms"] == "cpu"
+    assert "device_probe" in diag and "driver_log" in diag
+
+
+def test_backend_diagnosis_structure(tmp_path, monkeypatch):
+    """_backend_diagnosis collects the init exception, backend env, a
+    bounded visible-device probe, and the newest driver-log tail."""
+    import bench
+    logs = tmp_path / "tpu_logs"
+    logs.mkdir()
+    (logs / "driver.log").write_text(
+        "\n".join(f"line {i}" for i in range(30)) + "\n")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("BENCH_DRIVER_LOG_GLOB", str(logs / "*"))
+    bench._INIT_EXC[0] = "RuntimeError: no TPU found"
+    try:
+        d = bench._backend_diagnosis(probe_timeout=90)
+    finally:
+        bench._INIT_EXC[0] = None
+    assert d["exception"] == "RuntimeError: no TPU found"
+    assert d["jax_platforms"] == "cpu"
+    # probe format: "<n> <platform> <device_kind>" on success
+    assert d["device_probe"].split()[1] == "cpu", d["device_probe"]
+    assert d["driver_log"]["path"] == str(logs / "driver.log")
+    assert d["driver_log"]["tail"][-1] == "line 29"
+    assert len(d["driver_log"]["tail"]) == 12
+    import json
+    json.dumps(d)     # the whole block must ride the BENCH JSON
+
+
+def test_backend_diagnosis_no_driver_log(tmp_path, monkeypatch):
+    import bench
+    monkeypatch.setenv("BENCH_DRIVER_LOG_GLOB",
+                       str(tmp_path / "nothing" / "*"))
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "0.001")   # probe hangs ->
+    d = bench._backend_diagnosis()                       # bounded timeout
+    assert d["exception"] is None
+    assert "timed out" in d["device_probe"]
+    assert "no files match" in d["driver_log"]

@@ -1,0 +1,741 @@
+"""Fleet health engine (PR 7): cross-rank aggregation, anomaly verdicts,
+and the ``bfmonitor`` dashboard.
+
+Acceptance (ISSUE 7): aggregation degrades gracefully on every observed
+gap shape (missing steps, truncated final lines, ragged step counts, a
+rank that never wrote) and flags the gap as a health event; the health
+engine detects each seeded anomaly class — consensus stall, divergence,
+non-finite iterates, residual blow-up at γ≫ω, straggler skew, dead
+rank — with ZERO false alarms on a clean 20-step reference run; and
+``bfmonitor --once --json`` carries the verdicts (the CI-gate contract
+``make health-smoke`` drives end to end).
+
+Everything here is host-side (stdlib + numpy): no JAX, no mesh.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.observability import aggregate as AG
+from bluefog_tpu.observability import health as H
+from bluefog_tpu.observability import metrics as M
+from bluefog_tpu.run import monitor as MON
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    M.disable()
+    M.registry.reset()
+    yield
+    M.disable()
+    M.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# synthetic series builders
+# ---------------------------------------------------------------------------
+
+def contracting(t, r=0):
+    """The healthy reference: geometric consensus contraction with a
+    small per-rank offset (real fleets never agree to the last bit)."""
+    return 0.5 * (0.7 ** t) * (1.0 + 0.01 * r)
+
+
+def make_records(steps, rank, cd=contracting, wall_us=1000, **fields):
+    recs = []
+    for t in steps:
+        rec = {"step": t, "t_us": (t + 1) * wall_us, "rank": rank,
+               "step_wall_us": wall_us, "param_norm": 10.0,
+               "consensus_dist": cd(t, rank) if callable(cd) else cd}
+        for k, v in fields.items():
+            rec[k] = v(t) if callable(v) else v
+        recs.append(rec)
+    return recs
+
+
+def write_fleet(tmp_path, per_rank, name="s_"):
+    """per_rank: {rank: record list} -> prefix on disk."""
+    prefix = str(tmp_path / name)
+    for rank, recs in per_rank.items():
+        with open(f"{prefix}{rank}.jsonl", "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+    return prefix
+
+
+def healthy_fleet(tmp_path, n=4, steps=20):
+    return write_fleet(tmp_path, {
+        r: make_records(range(steps), r, wall_us=1000 + 17 * r)
+        for r in range(n)})
+
+
+# ---------------------------------------------------------------------------
+# aggregation: tolerant reader + gap shapes (satellite 4)
+# ---------------------------------------------------------------------------
+
+def test_read_jsonl_tolerant_truncated_final_line(tmp_path):
+    """A writer killed mid-step leaves a cut final line: records before
+    it parse, the tail is dropped as a `truncated` gap, never a raise."""
+    p = tmp_path / "t0.jsonl"
+    good = make_records(range(3), 0)
+    p.write_text("".join(json.dumps(r) + "\n" for r in good)
+                 + '{"step": 3, "t_us": 400, "cons')
+    records, gaps = AG.read_jsonl_tolerant(str(p))
+    assert [r["step"] for r in records] == [0, 1, 2]
+    assert [g.kind for g in gaps] == ["truncated"]
+
+
+def test_read_jsonl_tolerant_midfile_garbage(tmp_path):
+    p = tmp_path / "t0.jsonl"
+    good = make_records(range(3), 0)
+    lines = [json.dumps(r) for r in good]
+    lines.insert(1, "\x00disk garbage\x00")
+    lines.insert(3, '["a json array, not an object"]')
+    p.write_text("\n".join(lines) + "\n")
+    records, gaps = AG.read_jsonl_tolerant(str(p))
+    assert [r["step"] for r in records] == [0, 1, 2]
+    assert sorted(g.kind for g in gaps) == ["parse_error", "parse_error"]
+
+
+def test_read_jsonl_tolerant_missing_file(tmp_path):
+    records, gaps = AG.read_jsonl_tolerant(str(tmp_path / "nope.jsonl"))
+    assert records == [] and [g.kind for g in gaps] == ["missing_file"]
+
+
+def test_discover_series_matches_rank_suffix_only(tmp_path):
+    prefix = healthy_fleet(tmp_path, n=3)
+    (tmp_path / "s_x.jsonl").write_text("{}\n")        # non-numeric rank
+    (tmp_path / "other_0.jsonl").write_text("{}\n")    # different prefix
+    assert sorted(AG.discover_series(prefix)) == [0, 1, 2]
+
+
+def test_fleet_view_missing_steps_flagged_and_tolerated(tmp_path):
+    """A hole inside one rank's sequence becomes a missing_steps gap; the
+    spread at the hole only sees the ranks that reported it."""
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(10), 0),
+        1: make_records([t for t in range(10) if t not in (4, 5)], 1),
+        2: make_records(range(10), 2),
+    })
+    view = AG.load_fleet(prefix)
+    holes = [g for g in view.gaps if g.kind == "missing_steps"]
+    assert len(holes) == 1 and holes[0].rank == 1
+    assert view.missing_ranks(4) == [1]
+    assert view.fleet_spread(4, "consensus_dist").n == 2
+    assert view.fleet_spread(3, "consensus_dist").n == 3
+    # ...and the health engine surfaces the hole as a verdict
+    report = H.evaluate(view)
+    assert [v.rank for v in report.by_rule("series_gap")] == [1]
+
+
+def test_fleet_view_ragged_step_counts(tmp_path):
+    """A lagging rank (fewer steps) is not an error — and not yet dead
+    when inside the dead_after horizon."""
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0),
+        1: make_records(range(20), 1),
+        2: make_records(range(18), 2),     # 2 behind < dead_after (8)
+    })
+    view = AG.load_fleet(prefix)
+    assert view.last_step() == 19
+    assert view.rank_last_step(2) == 17
+    assert view.fleet_spread(19, "consensus_dist").n == 2
+    report = H.evaluate(view)
+    assert report.ok, [v.asdict() for v in report.alerts]
+
+
+def test_fleet_view_silent_rank_gap_and_verdict(tmp_path):
+    """An expected rank that never wrote a file surfaces as a
+    missing_file gap and a critical rank_silent verdict."""
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(10), r) for r in range(3)})
+    view = AG.load_fleet(prefix, expected_ranks=4)
+    assert [g.kind for g in view.gaps] == ["missing_file"]
+    report = H.evaluate(view)
+    (v,) = report.by_rule("rank_silent")
+    assert v.severity == "critical" and v.rank == 3
+    assert not report.ok
+
+
+def test_truncated_tail_is_health_event_not_alert(tmp_path):
+    """A truncated final line is evidence (info verdict), not an alarm:
+    live files are cut mid-line whenever the monitor races the writer."""
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(10), 0),
+        1: make_records(range(10), 1),
+        2: make_records(range(10), 2),
+    })
+    with open(f"{prefix}1.jsonl", "a") as f:
+        f.write('{"step": 10, "t_us":')
+    view = AG.load_fleet(prefix)
+    report = H.evaluate(view)
+    gap_verdicts = report.by_rule("series_gap")
+    assert len(gap_verdicts) == 1
+    assert gap_verdicts[0].severity == "info"
+    assert report.ok
+
+
+def test_virtual_mesh_single_file_explodes_to_ranks(tmp_path):
+    """One physical series carrying [N]-list telemetry (the CPU virtual
+    mesh) splits into N virtual rank series, list position = rank."""
+    prefix = str(tmp_path / "v_")
+    with open(prefix + "0.jsonl", "w") as f:
+        for t in range(6):
+            f.write(json.dumps({
+                "step": t, "t_us": 1000 * (t + 1), "rank": 0,
+                "step_wall_us": 1000,
+                "consensus_dist": [contracting(t, r) for r in range(4)],
+                "param_norm": [10.0] * 4}) + "\n")
+    view = AG.load_fleet(prefix)
+    assert view.ranks == [0, 1, 2, 3]
+    assert view.expected_ranks == 4
+    assert view.value(2, 3, "consensus_dist") == pytest.approx(
+        contracting(3, 2))
+    # host-shared fields replicate
+    assert view.value(3, 3, "param_norm") == 10.0
+
+
+def test_spread_stats_match_numpy():
+    vals = [3.0, 1.0, 4.0, 1.5, 9.0, 2.6, 5.0, 3.5]
+    st = AG.spread(vals)
+    assert st.n == len(vals)
+    assert st.min == 1.0 and st.max == 9.0
+    assert st.p50 == pytest.approx(np.percentile(vals, 50))
+    assert st.p95 == pytest.approx(np.percentile(vals, 95))
+    assert st.mean == pytest.approx(np.mean(vals))
+    assert AG.spread([]) is None
+
+
+def test_spread_nonfinite_poisons_visibly():
+    st = AG.spread([1.0, float("nan"), 2.0])
+    assert math.isnan(st.p50) and math.isnan(st.p95)
+
+
+def test_step_wall_falls_back_to_t_us_deltas(tmp_path):
+    """Series written before step_wall_us existed still yield step times
+    from consecutive t_us deltas (first step has no sample)."""
+    prefix = str(tmp_path / "old_")
+    with open(prefix + "0.jsonl", "w") as f:
+        for t in range(4):
+            f.write(json.dumps({"step": t, "t_us": 2000 * t, "rank": 0,
+                                "consensus_dist": contracting(t)}) + "\n")
+    view = AG.load_fleet(prefix)
+    wall = view.step_wall_s(0)
+    assert [s for s, _ in wall] == [1, 2, 3]
+    assert all(v == pytest.approx(2e-3) for _, v in wall)
+
+
+def test_counter_delta_window_and_keys(tmp_path):
+    prefix = str(tmp_path / "c_")
+    with open(prefix + "0.jsonl", "w") as f:
+        for t in range(10):
+            f.write(json.dumps({
+                "step": t, "t_us": 1000 * t, "rank": 0,
+                "consensus_dist": contracting(t),
+                "counters": {"bf_step_cache_total{result=build}": min(t, 3),
+                             "bf_x_total{kind=a}": t}}) + "\n")
+    view = AG.load_fleet(prefix)
+    assert view.counter_delta("bf_step_cache_total{result=build}") == 3
+    # window excludes the early growth
+    assert view.counter_delta("bf_step_cache_total{result=build}",
+                              window=5) == 0
+    assert view.counter_keys("bf_x_") == ["bf_x_total{kind=a}"]
+    assert view.counter_delta("bf_never_written_total") == 0.0
+
+
+def test_counter_delta_sums_per_file_on_real_fleets(tmp_path):
+    """Counters are process-scoped: on a multi-FILE fleet the delta is
+    per stream, summed — never first-of-rank-0 vs last-of-rank-N (which
+    reads 0 when only rank 0's counter grew), and never N x the true
+    value on an exploded virtual mesh (one file = one stream)."""
+    def recs(rank, builds):
+        return [dict(r, counters={"bf_b_total": b})
+                for r, b in zip(make_records(range(len(builds)), rank),
+                                builds)]
+    prefix = write_fleet(tmp_path, {
+        0: recs(0, [0, 1, 3, 3]),          # grew by 3
+        1: recs(1, [5, 5, 5, 5]),          # flat (pre-window growth)
+        2: recs(2, [0, 0, 0, 2]),          # grew by 2
+    })
+    view = AG.load_fleet(prefix)
+    assert view.counter_delta("bf_b_total") == 5.0
+    assert view.counter_delta("bf_b_total", rank=1) == 0.0
+    # ...and the resilience rule built on it fires on the summed delta
+    prefix2 = write_fleet(tmp_path, {
+        0: [dict(r, counters={"bf_resilience_confirms_total": int(t >= 2)})
+            for t, r in enumerate(make_records(range(9), 0))],
+        1: [dict(r, counters={}) for r in make_records(range(9), 1)],
+        2: [dict(r, counters={}) for r in make_records(range(9), 2)],
+    }, name="rz_")
+    report = H.evaluate(AG.load_fleet(prefix2))
+    (c,) = report.by_rule("dead_rank_confirmed")
+    assert c.value == 1.0
+
+
+def test_stale_gaps_age_out_of_the_verdict_window(tmp_path):
+    """A parse error / step hole the fleet moved past `window` steps ago
+    must not pin report.ok false forever: it stays in view.gaps but
+    raises no verdict.  Fresh gaps still do."""
+    steps = 40
+    per_rank = {r: make_records(range(steps), r) for r in range(3)}
+    per_rank[1] = [r for r in per_rank[1] if r["step"] not in (3, 4)]
+    prefix = write_fleet(tmp_path, per_rank)
+    # mid-file garbage early in rank 0's series
+    p = f"{prefix}0.jsonl"
+    lines = open(p).read().splitlines()
+    lines.insert(2, "\x00garbage\x00")
+    open(p, "w").write("\n".join(lines) + "\n")
+    view = AG.load_fleet(prefix)
+    assert {g.kind for g in view.gaps} == {"parse_error", "missing_steps"}
+    assert all(g.step is not None and g.step < 10 for g in view.gaps)
+    report = H.evaluate(view)               # window = steps 33..39
+    assert report.by_rule("series_gap") == []
+    assert report.ok, [v.asdict() for v in report.alerts]
+    # the same holes ARE verdicts while the window still covers them
+    early = H.evaluate(view, H.HealthConfig(window=steps))
+    assert len(early.by_rule("series_gap")) == 2
+
+
+def test_absurd_step_value_does_not_hang_the_loader(tmp_path):
+    """One valid-JSON record with a t_us-magnitude step must not
+    materialize a range(1e15) set: the missing count is arithmetic, the
+    enumeration bounded — the loader's contract is never dying on bad
+    data, semantically absurd included."""
+    recs = make_records(range(5), 0)
+    recs.append(dict(recs[-1], step=10**15))
+    prefix = write_fleet(tmp_path, {0: recs})
+    view = AG.load_fleet(prefix)            # must return promptly
+    (hole,) = [g for g in view.gaps if g.kind == "missing_steps"]
+    assert f"{10**15 - 5} step(s) absent" in hole.detail
+    assert hole.step == 10**15 - 1
+    report = H.evaluate(view)               # rules stay bounded too
+    assert report.step_hi == 10**15
+
+
+def test_tail_cache_incremental_matches_full_reload(tmp_path):
+    """A TailCache held across frames parses only appended bytes yet
+    yields the same view as a cold load — including a partial final
+    line that completes later, and a rotated (shrunk) file."""
+    prefix = str(tmp_path / "live_")
+    path = prefix + "0.jsonl"
+    cache = AG.TailCache()
+
+    def dump(recs):
+        return "".join(json.dumps(r) + "\n" for r in recs)
+
+    recs = make_records(range(5), 0)
+    open(path, "w").write(dump(recs[:3]))
+    v1 = AG.load_fleet(prefix, cache=cache)
+    assert v1.steps() == [0, 1, 2]
+    # append one full record plus a PARTIAL line: the partial must show
+    # as a transient truncated gap and not poison the cached offset
+    partial = json.dumps(recs[4])
+    with open(path, "a") as f:
+        f.write(dump([recs[3]]) + partial[:19])
+    v2 = AG.load_fleet(prefix, cache=cache)
+    assert v2.steps() == [0, 1, 2, 3]
+    assert [g.kind for g in v2.gaps] == ["truncated"]
+    # writer finishes the line: the cache re-reads only the tail
+    with open(path, "a") as f:
+        f.write(partial[19:] + "\n")
+    v3 = AG.load_fleet(prefix, cache=cache)
+    cold = AG.load_fleet(prefix)
+    assert v3.steps() == cold.steps() == [0, 1, 2, 3, 4]
+    assert v3.gaps == cold.gaps == []
+    assert [v3.value(0, t, "consensus_dist") for t in range(5)] == \
+           [cold.value(0, t, "consensus_dist") for t in range(5)]
+    # rotation: the file shrinks -> the cache entry resets, no stale rows
+    open(path, "w").write(dump(make_records(range(2), 0)))
+    v4 = AG.load_fleet(prefix, cache=cache)
+    assert v4.steps() == [0, 1]
+
+
+def test_compile_storm_threshold_is_per_stream_not_fleet_summed(tmp_path):
+    """One synchronized recompile on every rank of an 8-rank fleet is 1
+    build per stream — it must NOT read as 8 > compile_builds and alarm
+    (counter deltas for process-replicated events aggregate by max)."""
+    def recs(rank, builds):
+        return [dict(r, counters={"bf_step_cache_total{result=build}": b})
+                for r, b in zip(make_records(range(len(builds)), rank),
+                                builds)]
+    prefix = write_fleet(tmp_path, {
+        r: recs(r, [1, 1, 1, 2, 2, 2, 2, 2]) for r in range(8)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    assert report.by_rule("compile_storm") == []
+    assert report.ok, [v.asdict() for v in report.alerts]
+    # ...while one rank churning past the threshold still fires
+    prefix2 = write_fleet(tmp_path, {
+        r: recs(r, [1, 1, 1, 2, 2, 2, 2, 2] if r else
+                list(range(1, 9))) for r in range(8)}, name="churn_")
+    report = H.evaluate(AG.load_fleet(prefix2))
+    (v,) = report.by_rule("compile_storm")
+    assert v.value == 7.0
+
+
+def test_empty_view_is_not_healthy(tmp_path):
+    """A prefix matching zero files must not pass a --fail-on CI gate
+    green: monitoring nothing is critical, not ok."""
+    report = H.evaluate(AG.load_fleet(str(tmp_path / "no_such_")))
+    (v,) = report.by_rule("no_data")
+    assert v.severity == "critical" and not report.ok
+    # ...but expected_ranks already covers the hole via rank_silent
+    report = H.evaluate(AG.load_fleet(str(tmp_path / "no_such_"),
+                                      expected_ranks=2))
+    assert report.by_rule("no_data") == []
+    assert len(report.by_rule("rank_silent")) == 2
+
+
+def test_report_excludes_unmeasured_and_stays_strict_json(tmp_path):
+    """The --once --json contract: degraded steps' -1 UNMEASURED
+    consensus sentinel must not skew per_rank/spread, and non-finite
+    evidence must serialize as strings (strict RFC 8259 output)."""
+    per_rank = {r: make_records(range(10), r) for r in range(3)}
+    per_rank[2][-1]["consensus_dist"] = H.UNMEASURED   # degraded last step
+    per_rank[1][-1]["param_norm"] = float("nan")
+    prefix = write_fleet(tmp_path, per_rank)
+    _, _, out = MON.build_report(prefix)
+    assert out["spread"]["consensus_dist"]["n"] == 2
+    assert out["spread"]["consensus_dist"]["min"] > 0
+    # rank 2's last MEASURED consensus is reported, not the sentinel
+    assert out["per_rank"]["2"]["consensus_dist"] == pytest.approx(
+        contracting(8, 2))
+    json.loads(json.dumps(out, allow_nan=False))   # must not raise
+    assert out["spread"]["param_norm"]["p50"] == "nan"
+
+
+def test_resolved_alert_gauge_drops_to_zero(tmp_path):
+    """bf_health_alerts{rule=...} must read 0 once the alert resolves —
+    a scrape between evaluations must not see a stale count."""
+    M.enable()
+    flat = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=0.4) for r in range(3)}, "f_")
+    report = H.evaluate(AG.load_fleet(flat))
+    assert not report.ok
+    snap = M.registry.snapshot()
+    assert snap["bf_health_alerts{rule=consensus_stall}"] == 1.0
+    report = H.evaluate(AG.load_fleet(healthy_fleet(tmp_path)))
+    assert report.ok
+    snap = M.registry.snapshot()
+    assert snap["bf_health_alerts{rule=consensus_stall}"] == 0.0
+    assert snap["bf_health_ok"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# health rules: the clean reference raises nothing...
+# ---------------------------------------------------------------------------
+
+def test_clean_reference_run_zero_false_alarms(tmp_path):
+    """The acceptance gate: a clean 20-step contracting 4-rank fleet must
+    produce ZERO warn/critical verdicts at default thresholds."""
+    view = AG.load_fleet(healthy_fleet(tmp_path))
+    report = H.evaluate(view)
+    assert report.ok, [v.asdict() for v in report.alerts]
+    assert report.alerts == []
+    assert report.ranks == 4
+    assert report.step_hi == 19 and report.step_lo == 12   # window 8
+
+
+def test_converged_flat_fleet_is_healthy(tmp_path):
+    """Converged-and-flat (consensus at the floor) must NOT read as a
+    stall: the stall rule only fires above the absolute floor."""
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=1e-12) for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    assert report.ok, [v.asdict() for v in report.alerts]
+
+
+def test_unmeasured_degraded_steps_do_not_alarm(tmp_path):
+    """UNMEASURED (-1) consensus samples — degraded skip-comm steps that
+    issued no collective — are excluded from the consensus rules."""
+    def cd(t, r=0):
+        return H.UNMEASURED if t % 3 == 2 else contracting(t, r)
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=cd) for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    assert not report.by_rule("consensus_stall")
+    assert not report.by_rule("consensus_diverge")
+    assert not report.by_rule("non_finite")
+
+
+def test_startup_short_series_does_not_alarm(tmp_path):
+    """Two steps of flat startup history is not enough evidence for a
+    stall verdict (the rule needs a full window)."""
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(2), r, cd=0.4) for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    assert report.ok, [v.asdict() for v in report.alerts]
+
+
+# ---------------------------------------------------------------------------
+# ...and detects each seeded anomaly class
+# ---------------------------------------------------------------------------
+
+def test_detects_consensus_stall(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=0.3) for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    verdicts = report.by_rule("consensus_stall")
+    assert verdicts and not report.ok
+    # fleet-wide stall collapses to ONE verdict, not one per rank
+    assert len(verdicts) == 1 and verdicts[0].rank is None
+    assert verdicts[0].severity == "warn"
+    assert verdicts[0].value > 0.9        # the measured ratio rides along
+
+
+def test_detects_single_rank_stall_with_rank_attribution(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0),
+        1: make_records(range(20), 1, cd=0.3),
+        2: make_records(range(20), 2),
+    })
+    report = H.evaluate(AG.load_fleet(prefix))
+    (v,) = report.by_rule("consensus_stall")
+    assert v.rank == 1
+
+
+def test_detects_consensus_divergence(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r,
+                        cd=lambda t, r=0: 0.01 * (1.5 ** t))
+        for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    verdicts = report.by_rule("consensus_diverge")
+    assert verdicts and verdicts[0].severity == "critical"
+    assert not report.by_rule("consensus_stall")
+
+
+def test_detects_non_finite(tmp_path):
+    def cd(t, r=0):
+        return float("nan") if t >= 15 else contracting(t, r)
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0),
+        1: make_records(range(20), 1, cd=cd),
+        2: make_records(range(20), 2),
+    })
+    report = H.evaluate(AG.load_fleet(prefix))
+    (v,) = report.by_rule("non_finite")
+    assert v.severity == "critical" and v.rank == 1
+    assert v.step_lo == 15
+    # the NaN rank must not ALSO fire the ratio rules
+    assert not report.by_rule("consensus_diverge")
+
+
+def test_detects_residual_blowup(tmp_path):
+    """Residual norm above the param norm — the documented γ≫ω
+    instability boundary (docs/compression.md)."""
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r,
+                        residual_norm=(lambda t: 0.5 + t)  # crosses 10.0
+                        if r == 1 else 0.1)
+        for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    (v,) = report.by_rule("residual_blowup")
+    assert v.severity == "critical" and v.rank == 1
+    assert v.value > 1.0 and v.threshold == 1.0
+
+
+def test_detects_straggler_skew(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r,
+                        wall_us=5000 if r == 2 else 1000)
+        for r in range(4)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    (v,) = report.by_rule("straggler")
+    assert v.severity == "warn" and v.rank == 2
+    assert v.value == pytest.approx(5.0)
+    assert v.threshold == 2.0
+
+
+def test_straggler_needs_fleet_baseline(tmp_path):
+    """Two ranks cannot outvote each other: no straggler verdict below
+    three reporting ranks, and microsecond-scale jitter never fires."""
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, wall_us=5000 if r else 1000)
+        for r in range(2)})
+    assert not H.evaluate(AG.load_fleet(prefix)).by_rule("straggler")
+    prefix2 = write_fleet(tmp_path, {
+        r: make_records(range(20), r, wall_us=50 if r == 2 else 10)
+        for r in range(4)}, name="tiny_")
+    assert not H.evaluate(AG.load_fleet(prefix2)).by_rule("straggler")
+
+
+def test_detects_dead_rank(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0),
+        1: make_records(range(20), 1),
+        2: make_records(range(8), 2),      # stops 12 behind
+    })
+    report = H.evaluate(AG.load_fleet(prefix))
+    (v,) = report.by_rule("dead_rank")
+    assert v.severity == "critical" and v.rank == 2
+    assert v.value == 12.0
+
+
+def test_detects_compile_storm(tmp_path):
+    builds = lambda t: {"bf_step_cache_total{result=build}": float(t)}
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0, counters=builds)})
+    report = H.evaluate(AG.load_fleet(prefix, explode_virtual=False))
+    (v,) = report.by_rule("compile_storm")
+    assert v.severity == "warn" and v.value == 7.0   # 8-step window
+
+
+def test_resilience_counters_become_verdicts(tmp_path):
+    ctr = {"bf_resilience_confirms_total": 1.0,
+           "bf_resilience_events_total{kind=degraded}": 2.0,
+           "bf_resilience_events_total{kind=repair}": 1.0}
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0,
+                        counters=lambda t: ctr if t > 10 else {})})
+    report = H.evaluate(AG.load_fleet(prefix, explode_virtual=False))
+    (c,) = report.by_rule("dead_rank_confirmed")
+    assert c.severity == "warn" and c.value == 1.0
+    kinds = {v.message.split("kind ")[1].split()[0]: v.severity
+             for v in report.by_rule("resilience_event")}
+    assert kinds["'degraded'"] == "warn"
+    assert kinds["'repair'"] == "info"
+
+
+def test_health_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_HEALTH_WINDOW", "16")
+    monkeypatch.setenv("BLUEFOG_HEALTH_STRAGGLER_FACTOR", "3.5")
+    monkeypatch.setenv("BLUEFOG_HEALTH_DEAD_AFTER", "4")
+    cfg = H.HealthConfig.from_env()
+    assert cfg.window == 16
+    assert cfg.straggler_factor == 3.5
+    assert cfg.resolved_dead_after() == 4
+    monkeypatch.delenv("BLUEFOG_HEALTH_DEAD_AFTER")
+    assert H.HealthConfig.from_env().resolved_dead_after() == 16
+
+
+def test_health_gauges_mirror_report(tmp_path):
+    M.enable()
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=0.3) for r in range(3)})
+    H.evaluate(AG.load_fleet(prefix))
+    snap = M.registry.snapshot()
+    assert snap["bf_health_ok"] == 0.0
+    assert snap["bf_health_last_step"] == 19.0
+    assert snap["bf_health_alerts{rule=consensus_stall}"] == 1.0
+    # a healthy re-evaluation flips the gate back
+    H.evaluate(AG.load_fleet(healthy_fleet(tmp_path, n=3)))
+    assert M.registry.snapshot()["bf_health_ok"] == 1.0
+
+
+def test_write_verdicts_jsonl_roundtrip(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, cd=0.3) for r in range(3)})
+    report = H.evaluate(AG.load_fleet(prefix))
+    # non-finite evidence must still serialize to strict JSON
+    report.verdicts.append(H.Verdict("non_finite", "critical", "seeded",
+                                     value=float("inf")))
+    path = str(tmp_path / "verdicts.jsonl")
+    H.write_verdicts(report, path)
+    H.write_verdicts(report, path)                 # append mode
+    lines = [json.loads(l) for l in open(path)]
+    heads = [l for l in lines if l["kind"] == "report"]
+    assert len(heads) == 2 and heads[0]["ok"] is False
+    verdicts = [l for l in lines if l["kind"] == "verdict"]
+    assert len(verdicts) == 2 * len(report.verdicts)
+    assert any(v["value"] == "inf" for v in verdicts)
+    assert all("rule" in v and "severity" in v and "message" in v
+               for v in verdicts)
+
+
+# ---------------------------------------------------------------------------
+# bfmonitor
+# ---------------------------------------------------------------------------
+
+def test_sparkline_shapes_and_nonfinite():
+    assert MON.sparkline([]) == ""
+    line = MON.sparkline([1, 2, 3, 4, 5, 6, 7, 8], width=8)
+    assert len(line) == 8 and line[0] == "▁" and line[-1] == "█"
+    assert MON.sparkline([1.0, float("nan"), 2.0])[1] == "!"
+    assert MON.sparkline([3.0, 3.0, 3.0]) == "▅▅▅"   # flat mid-band
+    # log scale survives zeros and spans decades without overflow
+    assert len(MON.sparkline([1e-9, 0.0, 1e3], log_scale=True)) == 3
+
+
+def test_build_report_healthy(tmp_path):
+    prefix = healthy_fleet(tmp_path)
+    view, report, out = MON.build_report(prefix)
+    assert out["ok"] is True and out["alerts"] == 0
+    assert out["ranks"] == 4 and out["last_step"] == 19
+    assert set(out["per_rank"]) == {"0", "1", "2", "3"}
+    assert out["per_rank"]["0"]["consensus_dist"] == pytest.approx(
+        contracting(19, 0))
+    assert out["spread"]["consensus_dist"]["n"] == 4
+    assert out["spread"]["step_wall_s"]["max"] >= \
+        out["spread"]["step_wall_s"]["min"]
+    json.dumps(out)                            # the CI-gate contract
+
+
+def test_monitor_once_json_cli(tmp_path, capsys):
+    prefix = healthy_fleet(tmp_path)
+    rc = MON.main([prefix, "--once", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+
+
+def test_monitor_fail_on_gates_exit_code(tmp_path, capsys):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, wall_us=5000 if r == 2 else 1000)
+        for r in range(4)})
+    assert MON.main([prefix, "--once", "--json"]) == 0
+    assert MON.main([prefix, "--once", "--json", "--fail-on", "warn"]) == 1
+    # a warn-level straggler is below the critical gate
+    assert MON.main([prefix, "--once", "--json",
+                     "--fail-on", "critical"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert any(v["rule"] == "straggler" for v in out["verdicts"])
+
+
+def test_monitor_writes_verdict_jsonl(tmp_path, capsys):
+    prefix = healthy_fleet(tmp_path)
+    vpath = str(tmp_path / "verdicts.jsonl")
+    assert MON.main([prefix, "--once", "--json",
+                     "--verdicts", vpath]) == 0
+    capsys.readouterr()
+    (head,) = [json.loads(l) for l in open(vpath)]
+    assert head["kind"] == "report" and head["ok"] is True
+
+
+def test_monitor_expected_ranks_flag(tmp_path, capsys):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(10), r) for r in range(2)})
+    rc = MON.main([prefix, "--once", "--json", "--ranks", "4",
+                   "--fail-on", "critical"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    silent = [v for v in out["verdicts"] if v["rule"] == "rank_silent"]
+    assert sorted(v["rank"] for v in silent) == [2, 3]
+
+
+def test_render_dashboard_frame(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        r: make_records(range(20), r, wall_us=5000 if r == 2 else 1000)
+        for r in range(4)})
+    view, report, _ = MON.build_report(prefix)
+    frame = MON.render_dashboard(view, report)
+    assert "fleet: 4 rank(s)" in frame
+    assert "1 ALERT" in frame
+    row2 = next(l for l in frame.splitlines() if l.lstrip().startswith("2 "))
+    assert "straggler" in row2            # flag lands on the right row
+    assert "[warn] straggler:" in frame
+    assert "▁" in frame or "█" in frame   # sparklines rendered
+
+
+def test_render_dashboard_marks_dead_ranks(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(20), 0),
+        1: make_records(range(20), 1),
+        2: make_records(range(8), 2),
+    })
+    view, report, _ = MON.build_report(prefix)
+    frame = MON.render_dashboard(view, report)
+    assert "degraded/dead ranks: 2" in frame
+    assert "[CRIT] dead_rank:" in frame

@@ -804,3 +804,197 @@ def test_fused_step_reports_bytes(bf_ctx):
     offsets = len(bf_ctx.compiled_topology.offsets)
     assert c["ppermute"] == plan.n_buckets * offsets
     assert c["ppermute_bytes"] == payload * offsets
+
+
+# ---------------------------------------------------------------------------
+# PR 7: exporter hardening + step-phase profiling (fleet health engine's
+# per-rank inputs; the aggregation/health/monitor layers are covered in
+# tests/test_fleet_health.py)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_label_value_escaping():
+    """Exposition-format escaping: backslash, double-quote, and newline
+    in label values must be escaped (previously emitted raw)."""
+    M.enable()
+    M.counter("t_esc_total", 'help with "quotes" kept\nnext').inc(
+        1, path='C:\\tmp\\x', msg='say "hi"\nbye')
+    text = EX.prometheus_text()
+    assert r'path="C:\\tmp\\x"' in text
+    assert r'msg="say \"hi\"\nbye"' in text
+    # HELP escapes backslash + newline only (quotes are legal there)
+    assert '# HELP t_esc_total help with "quotes" kept\\nnext' in text
+    assert "\nnext" not in text.split("# HELP")[1].splitlines()[0]
+
+
+def test_counter_lanes_emit_min_max(bf_ctx, tmp_path):
+    """Per-rank list telemetry renders mean PLUS _min/_max lanes so a
+    single straggling/diverging rank stays visible in the trace; scalar
+    fields get no companion lanes."""
+    path = bf.timeline_start(str(tmp_path / "mm_"), rank=0)
+    EX.log_step(0, {"consensus_dist": [0.1, 0.9, 0.2], "param_norm": 2.0})
+    bf.timeline_end()
+    events = json.load(open(path))
+    by_lane = {}
+    for e in events:
+        if e.get("ph") == "C":
+            by_lane.setdefault(e["name"], []).append(e["args"]["value"])
+    assert by_lane["telemetry/consensus_dist"] == [pytest.approx(0.4)]
+    assert by_lane["telemetry/consensus_dist_min"] == [pytest.approx(0.1)]
+    assert by_lane["telemetry/consensus_dist_max"] == [pytest.approx(0.9)]
+    assert "telemetry/param_norm" in by_lane
+    assert "telemetry/param_norm_min" not in by_lane
+    assert "telemetry/param_norm_max" not in by_lane
+
+
+def test_log_step_keeps_caller_step(tmp_path):
+    """The snapshot's in-graph step counter must not clobber the caller's
+    log index (regression: the smoke's train records landed on steps 0-4
+    twice; on the virtual mesh the field is an [N] list besides)."""
+    path = EX.metrics_start(str(tmp_path / "clb_"), rank=0)
+    EX.log_step(7, {"step": [3, 3], "consensus_dist": [0.5, 0.4]})
+    EX.log_step(8, {"step": 4, "consensus_dist": [0.4, 0.3]})
+    EX.metrics_end()
+    records = EX.validate_jsonl(path)
+    assert [r["step"] for r in records] == [7, 8]
+
+
+def test_log_step_step_wall_us(tmp_path):
+    """Consecutive log_step calls on one sink carry the host wall time
+    since the previous call — the straggler rule's time base.  The first
+    record has no sample (nothing to difference against)."""
+    import time as _time
+    path = EX.metrics_start(str(tmp_path / "wall_"), rank=0)
+    EX.log_step(0, {"consensus_dist": 0.5})
+    _time.sleep(0.01)
+    EX.log_step(1, {"consensus_dist": 0.4})
+    EX.metrics_end()
+    r0, r1 = EX.validate_jsonl(path)
+    assert "step_wall_us" not in r0
+    assert r1["step_wall_us"] >= 10_000 * 0.5      # timer slop margin
+
+
+def test_step_phase_disabled_is_shared_nullcontext():
+    """With metrics and timeline both off, step_phase returns the SAME
+    no-op context object (one bool check, zero allocation) and records
+    nothing."""
+    from bluefog_tpu.observability import phases as PH
+    assert not PH.profiling_active()
+    c1 = PH.step_phase("compute")
+    c2 = PH.step_phase("exchange")
+    assert c1 is c2
+    with c1:
+        pass
+    assert PH.take_step_phases() is None
+    assert M.registry.snapshot() == {}
+
+
+def test_step_phase_records_histogram_and_jsonl(tmp_path):
+    """An enabled phase timer lands in the bf_step_phase_seconds
+    histogram AND on the next log_step record's "phases" dict (drained:
+    the following record must not repeat it)."""
+    import time as _time
+    from bluefog_tpu.observability import phases as PH
+    path = EX.metrics_start(str(tmp_path / "ph_"), rank=0)
+    with PH.step_phase("compute"):
+        _time.sleep(0.002)
+    with PH.step_phase("fold"):
+        pass
+    EX.log_step(0, {"consensus_dist": 0.5})
+    EX.log_step(1, {"consensus_dist": 0.4})
+    EX.metrics_end()
+    r0, r1 = EX.validate_jsonl(path)
+    assert r0["phases"]["compute"] >= 0.002 * 0.5
+    assert set(r0["phases"]) == {"compute", "fold", "export"}
+    assert "phases" not in r1 or "compute" not in r1.get("phases", {})
+    snap = M.registry.snapshot()
+    assert snap["bf_step_phase_seconds{phase=compute}"]["count"] == 1
+    assert snap["bf_step_phase_seconds{phase=fold}"]["count"] == 1
+
+
+def test_metrics_start_discards_stale_staged_phases(tmp_path):
+    """Phases timed by a previous loop that never called log_step must
+    not be misattributed to a NEW sink's first record (the per-rank
+    replay pattern opens one sink after another in one process)."""
+    from bluefog_tpu.observability import phases as PH
+    EX.metrics_start(str(tmp_path / "a_"), rank=0)
+    with PH.step_phase("compute"):
+        pass                       # staged but never drained by log_step
+    EX.metrics_end()
+    path = EX.metrics_start(str(tmp_path / "b_"), rank=1)
+    EX.log_step(0, {"consensus_dist": 0.5})
+    EX.metrics_end()
+    (r0,) = EX.validate_jsonl(path)
+    assert "compute" not in r0.get("phases", {})
+
+
+def test_step_phase_perfetto_span_and_lane(bf_ctx, tmp_path):
+    """Each timed phase emits a complete span on the step_phase lane and
+    a phase/<name>_ms counter sample."""
+    from bluefog_tpu.observability import phases as PH
+    path = bf.timeline_start(str(tmp_path / "phtl_"), rank=0)
+    with PH.step_phase("exchange"):
+        pass
+    bf.timeline_end()
+    events = json.load(open(path))
+    spans = [e for e in events
+             if e.get("ph") == "X" and e.get("name") == "exchange"]
+    assert len(spans) == 1
+    # the span lives on the dedicated step_phase lane
+    lane_meta = [e for e in events if e.get("ph") == "M"
+                 and e.get("name") == "thread_name"
+                 and e["args"]["name"] == "step_phase"]
+    assert lane_meta and spans[0]["tid"] == lane_meta[0]["tid"]
+    lanes = {e["name"] for e in events if e.get("ph") == "C"}
+    assert "phase/exchange_ms" in lanes
+
+
+def test_window_optimizer_phases_reach_jsonl(bf_ctx, tmp_path):
+    """The window-family wrappers time exchange/fold around the one-sided
+    ops; driving one step under an open sink must land both phases on the
+    JSONL record."""
+    base = optax.sgd(0.1)
+    opt = bf.DistributedWinPutOptimizer(base, window_prefix="phase_probe")
+    params = ragged_tree()
+    state = opt.init(params)
+    path = EX.metrics_start(str(tmp_path / "win_"), rank=0)
+    try:
+        new_params, state = opt.step(params, jax.tree.map(
+            jnp.zeros_like, params), state, 0)
+        EX.log_step(0, None)
+    finally:
+        EX.metrics_end()
+        opt.free()
+    (rec,) = EX.validate_jsonl(path)
+    assert rec["phases"]["exchange"] > 0
+    assert rec["phases"]["fold"] > 0
+
+
+def test_run_steps_loop_exports_series(bf_ctx, tmp_path):
+    """training.run_steps drives a telemetry-on step and exports one
+    JSONL record per step with loss + compute phase + telemetry."""
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    import optax as _optax
+    rng = np.random.default_rng(3)
+    model = MLP(features=(8,), num_outputs=4)
+    base = _optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    step_fn = T.make_train_step(model, base,
+                                communication="neighbor_allreduce",
+                                telemetry=True)
+    x = jnp.asarray(rng.normal(size=(N, 2, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 2)))
+    path = EX.metrics_start(str(tmp_path / "run_"), rank=0)
+    try:
+        variables, opt_state, losses = T.run_steps(
+            step_fn, variables, opt_state, (x, y), 4)
+    finally:
+        EX.metrics_end()
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    records = EX.validate_jsonl(path)
+    assert [r["step"] for r in records] == [0, 1, 2, 3]
+    assert all(r["loss"] == pytest.approx(l)
+               for r, l in zip(records, losses))
+    assert all("compute" in r["phases"] for r in records)
+    assert all(len(r["consensus_dist"]) == N for r in records)
