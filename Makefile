@@ -19,7 +19,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
-        chaos metrics-smoke metrics-smoke-compress health-smoke
+        chaos metrics-smoke metrics-smoke-compress health-smoke \
+        profile-smoke
 
 test:
 	$(PYTEST) tests/
@@ -145,6 +146,17 @@ metrics-smoke-compress:
 # straggler verdict on the seeded rank, consensus still contracting).
 health-smoke:
 	python scripts/metrics_smoke.py --health
+
+# Comm-profiler smoke (docs/observability.md "Comm profiling & fleet
+# traces"): an edge probe on the virtual mesh with a synthetic delay
+# seeded on one topology edge must rank exactly that edge slowest and
+# round-trip through the JSONL "edges" record, the bf_edge_* gauges,
+# and `bfmonitor --once --json`; measured overlap efficiency must be
+# ~0 for the synchronous step and measurably positive under the
+# delayed-mix pipeline; and a two-rank trace merge with injected clock
+# skew must recover the offset and validate (bftrace).
+profile-smoke:
+	python scripts/metrics_smoke.py --profile
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

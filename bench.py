@@ -583,6 +583,66 @@ def trace_only_main():
     print(json.dumps(out))
 
 
+def profile_edges_main():
+    """Edge-probe mode (``--profile-edges``): measure every topology
+    edge's ppermute round-trip at fusion-bucket-representative payload
+    sizes and print the :class:`EdgeCostMatrix` as one JSON line — the
+    standalone entry to the comm profiler (``observability/commprof.py``,
+    docs/observability.md "Comm profiling & fleet traces").
+
+    Platform is EXPLICIT, not auto-detected: the default is the 8-device
+    virtual CPU mesh (absolute numbers are host dispatch cost; the
+    ordering and the ``BLUEFOG_EDGE_PROBE_DELAY_US`` smoke hook exercise
+    the full pipeline), and pricing real links is an explicit
+    ``JAX_PLATFORMS=tpu python bench.py --profile-edges`` on the pod —
+    auto-detect could silently land the probe on one local chip and
+    write a meaningless matrix to the controller artifact.  Every matrix
+    (report, JSONL, artifact) carries a ``"platform"`` field so a
+    consumer can reject a synthetic (cpu) matrix as a link model.
+    Writes the controller artifact when ``BLUEFOG_EDGE_ARTIFACT`` names
+    a path."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+    bf_metrics.enable()
+
+    from bluefog_tpu.models.mlp import MLP
+    from bluefog_tpu.observability import commprof as CPROF
+    from bluefog_tpu.ops import fusion as fusion_mod
+
+    cx = bf.init()
+    n = bf.size()
+    # probe payloads representative of what the fused exchange actually
+    # ships: the train-step fusion plan's padded bucket bytes
+    depth = int(os.environ.get("BENCH_TRACE_LAYERS", "12"))
+    model = MLP(features=(32,) * depth, num_outputs=10)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8, 8, 1)))["params"]
+    plan = fusion_mod.plan_for(params)
+    sizes = fusion_mod.bucket_probe_sizes(plan)
+    repeats = int(os.environ.get("BENCH_PROBE_REPEATS", "3"))
+    matrix = CPROF.probe_edges(sizes=sizes, repeats=repeats)
+    slowest = matrix.slowest_edge()
+    out = {
+        "mode": "profile-edges",
+        "mesh": n,
+        "platform": matrix.platform,
+        "offsets": list(cx.compiled_topology.offsets),
+        "sizes": list(sizes),
+        "edges": matrix.asdict(),
+        "slowest_edge": list(slowest) if slowest else None,
+        "slowest_latency_us": (matrix.latency_us(*slowest)
+                               if slowest else None),
+        "artifact": os.environ.get("BLUEFOG_EDGE_ARTIFACT"),
+        "metrics": bf_metrics.registry.snapshot(),
+    }
+    print(json.dumps(out))
+
+
 def main():
     # host metrics registry on for the whole run: the final snapshot is
     # embedded in the result JSON ("metrics": fusion plan shape/padding
@@ -873,5 +933,7 @@ def main():
 if __name__ == "__main__":
     if "--trace-only" in sys.argv:
         trace_only_main()
+    elif "--profile-edges" in sys.argv:
+        profile_edges_main()
     else:
         main()

@@ -21,8 +21,16 @@ Cooperating pieces (docs/observability.md):
   lagging ranks.
 * ``health``    — rule-based health engine over the fleet view:
   structured ``HealthReport`` verdicts (consensus stall/diverge,
-  non-finite, residual blow-up, straggler skew, dead ranks, compile
-  storms) for ``bfmonitor`` and the future closed-loop controller.
+  non-finite, residual blow-up, straggler skew, overlap collapse, dead
+  ranks, compile storms) for ``bfmonitor`` and the future closed-loop
+  controller.
+* ``commprof``  — measured comm-path profiling: the per-edge link cost
+  matrix (ppermute probe harness -> ``EdgeCostMatrix`` -> ``bf_edge_*``
+  gauges / JSONL ``"edges"`` record / controller artifact) and the
+  exposed-vs-hidden overlap-efficiency split of the delayed-mix
+  pipeline.
+* ``tracemerge`` — ``bftrace``: merge N per-rank Chrome traces into one
+  clock-aligned fleet trace with cross-rank gossip flow arrows.
 
 Only ``metrics`` loads eagerly (it is stdlib-only and imported from
 hot-path modules — fusion, windows, service, timeline); everything else
@@ -34,10 +42,13 @@ import importlib
 
 from . import metrics
 
-__all__ = ["metrics", "ingraph", "export", "phases", "aggregate", "health"]
+_LAZY = ("ingraph", "export", "phases", "aggregate", "health", "commprof",
+         "tracemerge")
+
+__all__ = ["metrics", *_LAZY]
 
 
 def __getattr__(name):
-    if name in ("ingraph", "export", "phases", "aggregate", "health"):
+    if name in _LAZY:
         return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

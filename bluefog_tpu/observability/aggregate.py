@@ -67,7 +67,18 @@ CORE_FIELDS = (
     "consensus_dist", "param_norm", "grad_norm", "update_norm",
     "mix_col_sum", "mix_row_sum", "staleness", "warmup", "degraded",
     "compress_ratio", "residual_norm", "wire_bytes",
+    "overlap_efficiency",
 )
+
+
+def _numeric_list(v) -> bool:
+    """True for a list of plain numbers — the only list shape the
+    virtual-mesh explode may split.  Structured lists (the ``edges``
+    record's entry dicts) must pass through whole even when their length
+    happens to equal the fleet width."""
+    return (isinstance(v, list)
+            and all(isinstance(x, (int, float))
+                    and not isinstance(x, bool) for x in v))
 
 
 @dataclasses.dataclass
@@ -155,7 +166,8 @@ class TailCache:
 
     def __init__(self):
         # path -> [byte offset past last complete line, records, gaps,
-        #          complete-line count, step of last parsed record]
+        #          complete-line count, step of last parsed record,
+        #          inode of the file the offset belongs to]
         self._files: Dict[str, list] = {}
 
 
@@ -178,11 +190,18 @@ def read_jsonl_tolerant(path: str, cache: Optional[TailCache] = None
     never cached."""
     state = cache._files.get(path) if cache is not None else None
     if state is None:
-        state = [0, [], [], 0, None]
+        state = [0, [], [], 0, None, None]
     try:
-        if state[0] and os.path.getsize(path) < state[0]:
-            state = [0, [], [], 0, None]     # rotated/shrunk: start over
         with open(path, "rb") as f:
+            st = os.fstat(f.fileno())
+            # a rotated writer REPLACES the live file (export.rotate_file)
+            # — a new inode, or a same-inode truncation, means the cached
+            # offset belongs to a different byte stream: start over
+            # rather than resume mid-line in the new file
+            if (state[5] is not None and state[5] != st.st_ino) or \
+                    (state[0] and st.st_size < state[0]):
+                state = [0, [], [], 0, None, None]
+            state[5] = st.st_ino
             f.seek(state[0])
             chunk = f.read()
     except OSError as e:
@@ -262,7 +281,7 @@ def _explode(series: RankSeries, width: int) -> List[RankSeries]:
         for rec in series.records:
             sub = {}
             for k, v in rec.items():
-                if isinstance(v, list) and len(v) == width:
+                if _numeric_list(v) and len(v) == width:
                     sub[k] = v[r]
                 else:
                     sub[k] = v
@@ -402,6 +421,20 @@ class FleetView:
     def missing_ranks(self, step: int) -> List[int]:
         """Ranks that reported SOME step but not this one."""
         return [r for r in self.ranks if step not in self.per_rank[r]]
+
+    def latest_edges(self) -> Optional[dict]:
+        """The newest ``"edges"`` record (the comm profiler's measured
+        per-edge cost matrix riding the JSONL) anywhere in the fleet:
+        ``{"step", "rank", "entries"}``, or None when no rank has probed
+        — the view ``bfmonitor --once --json`` hands the controller."""
+        best = None
+        for rank, by_step in self.per_rank.items():
+            for step, rec in by_step.items():
+                edges = rec.get("edges")
+                if isinstance(edges, list) and edges and (
+                        best is None or step > best["step"]):
+                    best = {"step": step, "rank": rank, "entries": edges}
+        return best
 
     # -- derived: step wall time --------------------------------------------
 

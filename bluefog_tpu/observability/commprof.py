@@ -1,0 +1,530 @@
+"""Comm-path profiler: measured per-edge link costs and overlap efficiency.
+
+PR 4 made training health observable and PR 7 turned per-rank series into
+fleet verdicts, but every performance claim still rode on *trace-level
+estimates* (ppermute counts, ``plan_bytes``).  This module is the missing
+half of the sensing stack — it MEASURES the communication path:
+
+* **Edge probe harness** (:func:`probe_edges`): time ``lax.ppermute``
+  round-trips along every edge of the compiled topology at
+  fusion-bucket-representative payload sizes and produce an
+  :class:`EdgeCostMatrix` — per-``(src, dst)`` one-way latency (µs) and
+  effective bandwidth (GB/s).  This is the measured per-edge cost model
+  the ROADMAP's closed-loop controller needs to pick bandwidth-optimal
+  exchange schedules for a direct-connect topology (arXiv:2309.13541) or
+  decide when to switch to one-peer dynamic exponential graphs
+  (arXiv:2110.13363).  The matrix exports three ways: ``bf_edge_*``
+  registry gauges, a JSONL ``"edges"`` record on the metrics series, and
+  a machine-readable JSON artifact (``BLUEFOG_EDGE_ARTIFACT``).
+
+  Probe rounds are **step-indexed traced data**: one jitted program per
+  (edge pair, payload size) whose round index is a traced scalar, so
+  repeated rounds NEVER recompile, and the probe programs live in their
+  own cache — the training step cache is untouched (zero step recompiles,
+  asserted by ``tests/test_commprof.py``).
+
+* **Measured overlap efficiency** (:func:`measure_overlap`,
+  ``optimizer.probe_overlap``): split a step's exchange time into
+  *hidden* (off the parameter critical path) vs *exposed* by timing three
+  programs — the full step, a **pruned** step whose in-flight launch is
+  dead-code-eliminated (the carried ``inflight`` state passes through
+  unchanged, so XLA drops the ppermutes feeding it), and an
+  exchange-only program that prices the full exchange.  ``efficiency =
+  hidden / exchange_total``: ≈0 means the exchange sits on the critical
+  path (synchronous), ≈1 means the delayed-mix pipeline took all of it
+  off.  The sample stages an ``overlap_efficiency`` JSONL field
+  (``phases.stage_field``) the health engine's ``overlap_collapse`` rule
+  watches.
+
+Virtual-mesh semantics: on the single-process CPU test mesh all "links"
+share one host, so absolute numbers measure dispatch+execute cost, not
+wire time — the ORDERING is still meaningful, and the synthetic delay
+hook (``BLUEFOG_EDGE_PROBE_DELAY_US`` / ``inject_delay_s=``) lets the
+smoke gate assert the whole pipeline ranks a seeded slow edge slowest
+(``make profile-smoke``).
+"""
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import metrics as _metrics
+from . import phases as _phases
+from .. import timeline as _tl
+
+__all__ = [
+    "EdgeCostMatrix", "OverlapSample", "probe_edges", "topology_edges",
+    "export_edge_matrix", "measure_overlap", "resolve_injected_delays",
+    "EDGE_ARTIFACT_ENV", "EDGE_DELAY_ENV", "EDGE_MAX_BYTES_ENV",
+]
+
+EDGE_ARTIFACT_ENV = "BLUEFOG_EDGE_ARTIFACT"
+EDGE_DELAY_ENV = "BLUEFOG_EDGE_PROBE_DELAY_US"
+EDGE_MAX_BYTES_ENV = "BLUEFOG_EDGE_PROBE_MAX_BYTES"
+
+# default probe payload cap: big enough to leave the latency regime on a
+# real interconnect, small enough that a full exp2 probe stays sub-second
+DEFAULT_MAX_PROBE_BYTES = 4 << 20
+
+
+@dataclasses.dataclass
+class EdgeCostMatrix:
+    """Measured per-edge link costs for one topology.
+
+    ``entries``: one dict per probed (directed edge, payload size) —
+    ``{"src", "dst", "bytes", "rounds", "inner", "latency_us", "gbps"}``
+    with ``latency_us`` the estimated ONE-WAY time (half the measured
+    round trip) and ``gbps`` the one-way payload rate.  This nested-list
+    form is exactly the JSONL ``"edges"`` record and the controller
+    artifact — no separate wire schema.
+
+    ``platform`` records what the probe actually priced (``"tpu"`` =
+    real links, ``"cpu"`` = the single-host virtual mesh, where absolute
+    numbers are dispatch cost and only the ORDERING is meaningful) — a
+    controller must not consume a synthetic matrix as a link model."""
+
+    n: int
+    entries: List[dict]
+    step: Optional[int] = None
+    platform: Optional[str] = None
+
+    def asdict(self) -> dict:
+        return {"n": self.n, "step": self.step, "platform": self.platform,
+                "entries": self.entries}
+
+    @classmethod
+    def fromdict(cls, d: dict) -> "EdgeCostMatrix":
+        return cls(n=int(d["n"]), entries=list(d["entries"]),
+                   step=d.get("step"), platform=d.get("platform"))
+
+    def save(self, path: str) -> str:
+        """The machine-readable artifact the controller consumes."""
+        with open(path, "w") as f:
+            json.dump(self.asdict(), f)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "EdgeCostMatrix":
+        with open(path) as f:
+            return cls.fromdict(json.load(f))
+
+    def edges(self) -> List[Tuple[int, int]]:
+        return sorted({(e["src"], e["dst"]) for e in self.entries})
+
+    def latency_us(self, src: int, dst: int,
+                   nbytes: Optional[int] = None) -> Optional[float]:
+        """One-way latency for an edge — at ``nbytes``, or the LARGEST
+        probed payload (the bandwidth-regime number) when unspecified."""
+        cand = [e for e in self.entries
+                if e["src"] == src and e["dst"] == dst
+                and (nbytes is None or e["bytes"] == nbytes)]
+        if not cand:
+            return None
+        return max(cand, key=lambda e: e["bytes"])["latency_us"]
+
+    def slowest_edge(self, nbytes: Optional[int] = None
+                     ) -> Optional[Tuple[int, int]]:
+        """The edge a schedule optimizer should route around."""
+        worst, arg = -1.0, None
+        for src, dst in self.edges():
+            lat = self.latency_us(src, dst, nbytes)
+            if lat is not None and lat > worst:
+                worst, arg = lat, (src, dst)
+        return arg
+
+    def to_gauges(self) -> None:
+        """Mirror onto the host registry as ``bf_edge_*`` gauges (one
+        cell per edge x payload size) — the scrape-endpoint view."""
+        if not _metrics.enabled():
+            return
+        lat = _metrics.gauge(
+            "bf_edge_latency_us",
+            "measured one-way edge latency (ppermute round-trip / 2)")
+        bw = _metrics.gauge(
+            "bf_edge_gbps", "measured one-way edge payload rate")
+        for e in self.entries:
+            labels = dict(src=e["src"], dst=e["dst"], bytes=e["bytes"])
+            lat.set(e["latency_us"], **labels)
+            bw.set(e["gbps"], **labels)
+
+
+def topology_edges(topo=None) -> List[Tuple[int, int]]:
+    """Directed edges (src -> dst) of a compiled topology: ``W[src, dst]
+    != 0`` off the diagonal (``W[i, j]`` = weight of i's value at j, the
+    ``compile_weight_matrix`` convention — ``src`` transmits to ``dst``,
+    who folds it).  ``topo`` defaults to the current context's compiled
+    topology; a networkx ``DiGraph`` (``bf.load_topology()``) works too
+    (``nx.to_numpy_array`` keeps the same i->j orientation)."""
+    if topo is None:
+        from ..context import ctx
+        topo = ctx().compiled_topology
+    if not hasattr(topo, "weight_matrix"):    # networkx DiGraph
+        return sorted((int(s), int(d)) for s, d in topo.edges()
+                      if int(s) != int(d))
+    W = np.asarray(topo.weight_matrix)
+    out = []
+    for src in range(W.shape[0]):
+        for dst in range(W.shape[1]):
+            if src != dst and W[src, dst] != 0:
+                out.append((src, dst))
+    return sorted(out)
+
+
+def resolve_injected_delays(spec: Optional[str] = None
+                            ) -> Dict[Tuple[int, int], float]:
+    """Parse the synthetic-delay hook: ``"src-dst:us[,src-dst:us...]"``
+    (``BLUEFOG_EDGE_PROBE_DELAY_US``) -> ``{(src, dst): seconds}``.  The
+    virtual-mesh test hook: the probe harness sleeps this long inside the
+    timed window of that edge's rounds, so the smoke gate can assert the
+    matrix ranks a seeded slow edge slowest without real slow hardware."""
+    if spec is None:
+        spec = os.environ.get(EDGE_DELAY_ENV, "")
+    out: Dict[Tuple[int, int], float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            edge, us = part.split(":")
+            src, dst = edge.split("-")
+            out[(int(src), int(dst))] = float(us) * 1e-6
+        except ValueError:
+            raise ValueError(
+                f"bad {EDGE_DELAY_ENV} entry {part!r} "
+                f"(want 'src-dst:us[,src-dst:us...]')")
+    return out
+
+
+def _resolve_max_probe_bytes(value: Optional[int] = None) -> int:
+    if value is not None:
+        return int(value)
+    return int(os.environ.get(EDGE_MAX_BYTES_ENV,
+                              str(DEFAULT_MAX_PROBE_BYTES)))
+
+
+# (mesh, axis, unordered pair, nelems, dtype, inner) -> jitted probe.
+# A pair's program serves BOTH directed edges (the round trip crosses both
+# directions); the round index is traced, so re-probing never recompiles.
+# Keyed by the Mesh VALUE (jax meshes hash by devices + axis names), not
+# id() — a context re-init that frees the old mesh must not alias a new
+# mesh allocated at the recycled address onto a stale cached program.
+_probe_programs: Dict[tuple, object] = {}
+_PROBE_CACHE_CAP = 4096    # re-init churn backstop, far above any real use
+
+
+def _probe_program(mesh, axis: str, pair: Tuple[int, int],
+                   nelems: int, dtype, inner: int):
+    key = (mesh, axis, pair, nelems, jnp.dtype(dtype).name, inner)
+    fn = _probe_programs.get(key)
+    if fn is not None:
+        return fn
+    a, b = pair
+    fwd, rev = ((a, b),), ((b, a),)
+
+    def shard_body(buf, r):
+        # fold the traced round index into the payload so back-to-back
+        # rounds cannot be served from a constant-folded result
+        v = buf + r.astype(buf.dtype)
+
+        def one(_, x):
+            x = lax.ppermute(x, axis, fwd)
+            return lax.ppermute(x, axis, rev)
+
+        return lax.fori_loop(0, inner, one, v)
+
+    def probe(buf, r):
+        return jax.shard_map(shard_body, mesh=mesh,
+                             in_specs=(P(axis), P()), out_specs=P(axis))(
+            buf, r)
+
+    fn = jax.jit(probe)
+    if len(_probe_programs) >= _PROBE_CACHE_CAP:
+        _probe_programs.clear()
+    _probe_programs[key] = fn
+    if _metrics.enabled():
+        _metrics.counter(
+            "bf_edge_probe_programs_total",
+            "edge-probe programs built (one per pair x payload size; "
+            "rounds are traced data and never add to this)").inc()
+    return fn
+
+
+def probe_cache_size() -> int:
+    """Compiled edge-probe programs currently cached (test hook: a second
+    probe pass over the same config must not grow this)."""
+    return len(_probe_programs)
+
+
+def _timed_probe_rounds(fn, buf, repeats: int, delay_s: float,
+                        label: str) -> float:
+    """Minimum wall seconds over ``repeats`` timed rounds (round 0 pays
+    the compile and is discarded); ``delay_s`` sleeps inside the timed
+    window (the synthetic slow-edge hook)."""
+    best = float("inf")
+    for r in range(repeats + 1):
+        tok = _tl.op_start_us()
+        t0 = time.perf_counter()
+        out = fn(buf, jnp.int32(r))
+        if delay_s:
+            time.sleep(delay_s)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        _tl.record_op_span("edge_probe", label, tok)
+        if r:
+            best = min(best, dt)
+    if _metrics.enabled():
+        _metrics.counter(
+            "bf_edge_probe_rounds_total",
+            "timed edge-probe rounds executed").inc(repeats)
+    return best
+
+
+def probe_edges(sizes: Optional[Sequence[int]] = None, *,
+                topo=None, edges: Optional[Sequence[Tuple[int, int]]] = None,
+                repeats: int = 3, inner: int = 4,
+                dtype=jnp.float32, step: Optional[int] = None,
+                inject_delay_s: Optional[Dict[Tuple[int, int], float]] = None,
+                export: bool = True) -> EdgeCostMatrix:
+    """Measure every topology edge and return the :class:`EdgeCostMatrix`.
+
+    ``sizes``: payload bytes per probe, each capped at
+    ``BLUEFOG_EDGE_PROBE_MAX_BYTES``.  Default ``(4096, 1 MiB)`` —
+    generic latency- and bandwidth-regime payloads; there is no
+    "current params" to derive real bucket sizes from, so callers that
+    have a tree should pass
+    ``fusion.bucket_probe_sizes(fusion.plan_for(params))`` (what
+    ``bench.py --profile-edges`` does) to price the links at the
+    payloads the fused exchange actually ships.  ``repeats`` timed rounds per
+    (edge, size) keep the MINIMUM (the standard latency-probe estimator —
+    scheduler noise only ever adds time); ``inner`` round trips run
+    inside one dispatch so per-dispatch overhead amortizes.
+
+    ``inject_delay_s``: ``{(src, dst): seconds}`` synthetic per-edge
+    delay applied host-side inside the timed window (test hook; merged
+    with the µs-denominated ``BLUEFOG_EDGE_PROBE_DELAY_US``).  ``export``: mirror the matrix
+    to gauges / JSONL / artifact via :func:`export_edge_matrix`.
+
+    Cost: one compile per (unordered pair, size) on first probe — reused
+    forever after — plus ``repeats`` timed dispatches per UNORDERED pair
+    (both directed entries share the pair's round-trip measurement; a
+    direction is only re-timed when it carries an injected delay).
+    The training step cache is never consulted or invalidated."""
+    from ..context import ctx
+    cx = ctx()
+    topo = topo if topo is not None else cx.compiled_topology
+    mesh, axis, n = cx.mesh, cx.rank_axis, cx.size
+    if edges is None:
+        edges = topology_edges(topo)
+    if sizes is None:
+        sizes = default_probe_sizes()
+    cap = _resolve_max_probe_bytes()
+    itemsize = jnp.dtype(dtype).itemsize
+    sizes = sorted({max(itemsize, min(int(s), cap)) for s in sizes})
+    delays = dict(resolve_injected_delays())
+    if inject_delay_s:
+        delays.update(inject_delay_s)
+
+    entries: List[dict] = []
+    for nbytes in sizes:
+        nelems = max(1, nbytes // itemsize)
+        buf = jnp.zeros((n, nelems), dtype)
+        # one timed pass per UNORDERED pair: the probe program's round
+        # trip crosses both directions, so timing (a,b) and (b,a)
+        # separately would measure the identical quantity twice for
+        # double the synced dispatches.  Both directed entries share the
+        # pair's number; only a direction carrying an injected test
+        # delay is re-timed with the delay in its window.
+        base: Dict[Tuple[int, int], float] = {}
+        for pair in sorted({(min(s, d), max(s, d)) for s, d in edges}):
+            fn = _probe_program(mesh, axis, pair, nelems, dtype, inner)
+            base[pair] = _timed_probe_rounds(
+                fn, buf, repeats, 0.0,
+                f"probe {pair[0]}<->{pair[1]} {nbytes}B")
+        for src, dst in edges:
+            pair = (min(src, dst), max(src, dst))
+            delay = delays.get((src, dst), 0.0)
+            if delay:
+                fn = _probe_program(mesh, axis, pair, nelems, dtype, inner)
+                best = _timed_probe_rounds(
+                    fn, buf, repeats, delay,
+                    f"probe {src}->{dst} {nbytes}B")
+            else:
+                best = base[pair]
+            round_trip_s = best / inner
+            latency_us = round_trip_s / 2.0 * 1e6
+            gbps = (nelems * itemsize) / max(round_trip_s / 2.0, 1e-12) / 1e9
+            entries.append({
+                "src": int(src), "dst": int(dst),
+                "bytes": int(nelems * itemsize), "rounds": int(repeats),
+                "inner": int(inner),
+                "latency_us": round(latency_us, 3),
+                "gbps": round(gbps, 6),
+            })
+    platform = getattr(np.asarray(mesh.devices).flat[0], "platform", None)
+    matrix = EdgeCostMatrix(n=n, entries=entries, step=step,
+                            platform=platform)
+    if export:
+        export_edge_matrix(matrix, step=step)
+    return matrix
+
+
+def default_probe_sizes() -> Tuple[int, ...]:
+    """Generic latency-regime + bandwidth-regime payloads — the
+    ``sizes=None`` default.  Callers with a real tree should pass
+    ``ops.fusion.bucket_probe_sizes(plan)`` instead."""
+    return (4096, 1 << 20)
+
+
+def export_edge_matrix(matrix: EdgeCostMatrix,
+                       step: Optional[int] = None,
+                       artifact_path: Optional[str] = None) -> Optional[dict]:
+    """Fan the matrix out to every sink: ``bf_edge_*`` gauges, a JSONL
+    ``"edges"`` record on the open metrics series (the round-trip the
+    acceptance gate walks: matrix -> JSONL -> ``bfmonitor --once
+    --json``), and the controller artifact when ``artifact_path`` or
+    ``BLUEFOG_EDGE_ARTIFACT`` names one.
+
+    With an explicit ``step``, a dedicated record is written at that
+    step and returned.  With ``step=None`` (a probe inside a live
+    training loop) the matrix is STAGED instead (``phases.stage_field``)
+    and rides the loop's next ``export.log_step`` record — a standalone
+    write would collide with the record the loop already logged for that
+    step (the fleet view keeps the last record per (rank, step), so the
+    edges-only line would evict that step's telemetry).  Returns None in
+    staging mode."""
+    from . import export as _export
+    matrix.to_gauges()
+    if artifact_path is None:
+        artifact_path = os.environ.get(EDGE_ARTIFACT_ENV)
+    if artifact_path:
+        matrix.save(artifact_path)
+    if step is None and matrix.step is None:
+        _phases.stage_field("edges", matrix.entries)
+        return None
+    return _export.log_step(step if step is not None else matrix.step,
+                            extra={"edges": matrix.entries})
+
+
+# ---------------------------------------------------------------------------
+# Measured overlap efficiency
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OverlapSample:
+    """One measured exposed/hidden split of a step's exchange time.
+
+    ``hidden_s``   exchange time OFF the parameter critical path (the
+                   full program minus the launch-pruned program),
+    ``exposed_s``  exchange time still ON it (exchange total - hidden),
+    ``efficiency`` hidden / exchange total in [0, 1]: 0 = the pipeline
+                   degenerated to synchronous, 1 = fully overlapped."""
+
+    efficiency: float
+    hidden_s: float
+    exposed_s: float
+    t_full_s: float
+    t_pruned_s: float
+    t_comm_s: float
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _timed_once(fn, args) -> float:
+    """One synced dispatch, wall seconds."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def _time_interleaved(fns_args, repeats: int) -> List[float]:
+    """Minimum wall seconds per program over ``repeats`` INTERLEAVED
+    rounds (one discarded warmup round absorbs the compiles).
+
+    Interleaving matters: the efficiency estimate subtracts two
+    near-equal times (full vs pruned step), so timing all repeats of one
+    program and then all of the other would let slow host drift (CPU
+    frequency, cache state, a background process ramping up) land
+    directly in the difference.  Round-robin sampling makes each round
+    see the same host conditions for every program."""
+    best = [float("inf")] * len(fns_args)
+    for r in range(repeats + 1):
+        for i, (fn, args) in enumerate(fns_args):
+            dt = _timed_once(fn, args)
+            if r:
+                best[i] = min(best[i], dt)
+    return best
+
+
+def measure_overlap(full_fn, pruned_fn, comm_fn, args,
+                    comm_args=None, *, repeats: int = 2,
+                    stage: bool = True) -> Optional[OverlapSample]:
+    """Time the three probe programs and compute the exposed/hidden split.
+
+    ``full_fn(*args)``   the real step (all outputs);
+    ``pruned_fn(*args)`` the same step with the in-flight launch pruned —
+                         built by passing the carried ``inflight`` state
+                         through unchanged so XLA dead-code-eliminates
+                         the ppermutes feeding it (verified structurally
+                         in ``tests/test_commprof.py``: the pruned
+                         lowering carries zero collective-permutes under
+                         overlap);
+    ``comm_fn(*comm_args)`` the exchange alone (prices the full
+                         exchange this step would run).
+
+    None of the three may donate their inputs (they are re-invoked on the
+    same arguments).  Returns None when the exchange is too small to
+    price (< 20 µs — nothing to hide).  ``stage=True`` stages the
+    ``overlap_efficiency`` field for the next ``export.log_step`` record,
+    mirrors ``bf_overlap_*`` gauges, and emits ``overlap/*`` timeline
+    counter lanes."""
+    if comm_args is None:
+        comm_args = args
+    t_comm, t_full, t_pruned = _time_interleaved(
+        [(comm_fn, comm_args), (full_fn, args), (pruned_fn, args)],
+        repeats)
+    if t_comm < 20e-6:
+        return None
+    hidden = max(0.0, t_full - t_pruned)
+    hidden = min(hidden, t_comm)
+    exposed = max(0.0, t_comm - hidden)
+    sample = OverlapSample(
+        efficiency=hidden / t_comm, hidden_s=hidden, exposed_s=exposed,
+        t_full_s=t_full, t_pruned_s=t_pruned, t_comm_s=t_comm)
+    if stage:
+        _stage_overlap_sample(sample)
+    return sample
+
+
+def _stage_overlap_sample(sample: OverlapSample) -> None:
+    _phases.stage_field("overlap_efficiency", sample.efficiency)
+    if _metrics.enabled():
+        g = _metrics.gauge(
+            "bf_overlap",
+            "last measured overlap split of the exchange "
+            "(efficiency = hidden / exchange total)")
+        g.set(sample.efficiency, field="efficiency")
+        g.set(sample.hidden_s, field="hidden_s")
+        g.set(sample.exposed_s, field="exposed_s")
+    _tl.record_counter("overlap/efficiency", sample.efficiency)
+    _tl.record_counter("overlap/hidden_ms", sample.hidden_s * 1e3)
+    _tl.record_counter("overlap/exposed_ms", sample.exposed_s * 1e3)
+
+
+def overlap_probe_every(value: Optional[int] = None) -> int:
+    """Resolve the auto-probe cadence (``BLUEFOG_OVERLAP_PROBE_EVERY``,
+    default 0 = off): every K-th optimizer step re-measures the overlap
+    split while profiling is active.  Each probe costs a few extra synced
+    dispatches, so it is opt-in like the timeline."""
+    if value is not None:
+        return int(value)
+    return int(os.environ.get("BLUEFOG_OVERLAP_PROBE_EVERY", "0"))

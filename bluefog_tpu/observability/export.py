@@ -30,31 +30,90 @@ from . import phases as _phases
 __all__ = [
     "METRICS_ENV", "metrics_start", "metrics_end", "metrics_active",
     "metrics_path", "log_step", "telemetry_to_host", "prometheus_text",
-    "validate_jsonl", "REQUIRED_JSONL_KEYS",
+    "validate_jsonl", "REQUIRED_JSONL_KEYS", "resolve_rotation",
+    "rotate_file", "MAX_MB_ENV", "KEEP_ENV",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
+
+# size-based rotation of the append-only JSONL sinks (the per-rank
+# telemetry series here and the health verdict trail in health.py): a
+# long fleet run must not fill the disk.  0 / unset = unbounded.
+MAX_MB_ENV = "BLUEFOG_METRICS_MAX_MB"
+KEEP_ENV = "BLUEFOG_METRICS_KEEP"
+DEFAULT_KEEP = 3
 
 # every JSONL line carries at least these keys (validate_jsonl contract,
 # shared by the tests and `make metrics-smoke`)
 REQUIRED_JSONL_KEYS = ("step", "t_us", "rank")
 
 
+def resolve_rotation(max_mb: Optional[float] = None,
+                     keep: Optional[int] = None) -> tuple:
+    """``(max_bytes, keep)`` rotation policy: explicit arguments win,
+    else ``BLUEFOG_METRICS_MAX_MB`` / ``BLUEFOG_METRICS_KEEP``.
+    ``max_bytes`` 0 disables rotation."""
+    if max_mb is None:
+        max_mb = float(os.environ.get(MAX_MB_ENV, "0") or 0)
+    if keep is None:
+        keep = int(os.environ.get(KEEP_ENV, str(DEFAULT_KEEP)))
+    return int(max_mb * (1 << 20)), max(1, keep)
+
+
+def rotate_file(path: str, keep: int) -> None:
+    """Shift ``path`` -> ``path.1`` -> ... -> ``path.<keep>`` (oldest
+    dropped).  Rotated names no longer end in ``.jsonl``, so the fleet
+    aggregator's discovery never double-counts them; the live reader's
+    ``TailCache`` sees the fresh (smaller) file and resets its offset —
+    rotation looks like a restarted writer, which it is."""
+    for i in range(keep - 1, 0, -1):
+        src, dst = f"{path}.{i}", f"{path}.{i + 1}"
+        if os.path.exists(src):
+            os.replace(src, dst)
+    if os.path.exists(path):
+        os.replace(path, f"{path}.1")
+
+
 class _Sink:
     """Open JSONL sink: file handle + rank + clocks.  ``last_log`` feeds
     the per-record ``step_wall_us`` field (host wall time since the
     previous ``log_step`` — the straggler-attribution time base the
-    fleet aggregator reads)."""
+    fleet aggregator reads).  ``max_bytes``/``keep`` bound the file with
+    size-based rotation (``BLUEFOG_METRICS_MAX_MB``)."""
 
-    __slots__ = ("f", "path", "rank", "t0", "enabled_here", "last_log")
+    __slots__ = ("f", "path", "rank", "t0", "enabled_here", "last_log",
+                 "max_bytes", "keep", "bytes_written")
 
-    def __init__(self, f, path, rank, t0, enabled_here):
+    def __init__(self, f, path, rank, t0, enabled_here,
+                 max_bytes=0, keep=DEFAULT_KEEP):
         self.f = f
         self.path = path
         self.rank = rank
         self.t0 = t0
         self.enabled_here = enabled_here
         self.last_log = None
+        self.max_bytes = max_bytes
+        self.keep = keep
+        self.bytes_written = 0
+
+    def write_line(self, line: str) -> None:
+        # rotate BEFORE the write that would cross the cap: the live
+        # file must always end with the newest record (a monitor tailing
+        # it right after rotation would otherwise see an empty series)
+        if (self.max_bytes and self.bytes_written
+                and self.bytes_written + len(line) > self.max_bytes):
+            self.f.close()
+            rotate_file(self.path, self.keep)
+            self.f = open(self.path, "w")
+            self.bytes_written = 0
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_metrics_rotations_total",
+                    "size-based rotations of the JSONL metrics sink"
+                ).inc()
+        self.f.write(line)
+        self.f.flush()
+        self.bytes_written += len(line)
 
 
 _sink = [None]
@@ -92,7 +151,9 @@ def metrics_start(file_prefix: Optional[str] = None,
     # phases timed by a previous loop that never logged them must not be
     # misattributed to this sink's first record
     _phases.reset_step_phases()
-    _sink[0] = _Sink(f, path, rank, time.perf_counter(), enabled_here)
+    max_bytes, keep = resolve_rotation()
+    _sink[0] = _Sink(f, path, rank, time.perf_counter(), enabled_here,
+                     max_bytes=max_bytes, keep=keep)
     return path
 
 
@@ -179,6 +240,11 @@ def log_step(step: int, telemetry=None, extra: Optional[Dict] = None,
     # mesh it is an [N] list, not a scalar)
     tel_host.pop("step", None)
     record.update(tel_host)
+    # profiler-staged top-level fields (e.g. overlap_efficiency) land on
+    # this step's record; explicit extras win on key collisions
+    fields = _phases.take_step_fields()
+    if fields:
+        record.update(fields)
     if extra:
         record.update(extra)
     staged = _phases.take_step_phases()
@@ -187,8 +253,7 @@ def log_step(step: int, telemetry=None, extra: Optional[Dict] = None,
     if counters and _metrics.enabled():
         record["counters"] = _metrics.registry.snapshot()
     if sink is not None:
-        sink.f.write(json.dumps(record) + "\n")
-        sink.f.flush()
+        sink.write_line(json.dumps(record) + "\n")
     if timeline_on:
         # Perfetto counter lanes: each per-rank telemetry field renders
         # as its cross-rank mean PLUS `_min`/`_max` companion lanes —
@@ -200,10 +265,11 @@ def log_step(step: int, telemetry=None, extra: Optional[Dict] = None,
             if isinstance(v, list) and len(v) > 1:
                 _tl.record_counter(f"telemetry/{k}_min", min(v))
                 _tl.record_counter(f"telemetry/{k}_max", max(v))
-        if extra:
-            for k, v in extra.items():
-                if isinstance(v, (int, float)):
-                    _tl.record_counter(f"telemetry/{k}", float(v))
+        for src in (fields, extra):
+            if src:
+                for k, v in src.items():
+                    if isinstance(v, (int, float)):
+                        _tl.record_counter(f"telemetry/{k}", float(v))
     return record
 
 
@@ -247,11 +313,65 @@ def prometheus_text(reg: Optional[_metrics.Registry] = None) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# structured fields with a defined shape (the schema gate checks them;
+# anything NOT named here is tolerated — unknown fields must never break
+# an old validator reading a new writer's series)
+_EDGE_KEYS = ("src", "dst", "bytes", "latency_us", "gbps")
+
+
+def _check_structured(path, lineno, rec, check):
+    """Shape checks for the documented structured fields: ``phases``
+    (PR 7), ``step_wall_us`` (PR 7), ``edges`` and ``overlap_efficiency``
+    (PR 8).  ``counters`` stays free-form (registry snapshot)."""
+    phases = rec.get("phases")
+    if phases is not None:
+        if not isinstance(phases, dict):
+            raise ValueError(f"{path}:{lineno}: 'phases' must be an object")
+        for k, v in phases.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: phase {k!r} duration is not numeric")
+            check(f"phases.{k}", float(v))
+    wall = rec.get("step_wall_us")
+    if wall is not None:
+        if isinstance(wall, bool) or not isinstance(wall, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: 'step_wall_us' is not numeric")
+        check("step_wall_us", float(wall))
+    eff = rec.get("overlap_efficiency")
+    if eff is not None:
+        if isinstance(eff, bool) or not isinstance(eff, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: 'overlap_efficiency' is not numeric")
+        check("overlap_efficiency", float(eff))
+    edges = rec.get("edges")
+    if edges is not None:
+        if not isinstance(edges, list):
+            raise ValueError(f"{path}:{lineno}: 'edges' must be a list")
+        for e in edges:
+            if not isinstance(e, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: 'edges' entries must be objects")
+            missing = [k for k in _EDGE_KEYS if k not in e]
+            if missing:
+                raise ValueError(
+                    f"{path}:{lineno}: edge entry missing keys {missing}")
+            for k in _EDGE_KEYS:
+                if isinstance(e[k], bool) or not isinstance(
+                        e[k], (int, float)):
+                    raise ValueError(
+                        f"{path}:{lineno}: edge field {k!r} is not numeric")
+                check(f"edges.{k}", float(e[k]))
+
+
 def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     """Parse a metrics JSONL file, enforcing the schema: every line is a
-    JSON object carrying ``required`` keys, with every numeric field
-    finite.  Returns the records; raises ValueError on violations (the
-    ``make metrics-smoke`` gate)."""
+    JSON object carrying ``required`` keys, every numeric field finite,
+    and the documented structured fields (``phases``, ``step_wall_us``,
+    ``edges``, ``overlap_efficiency``) well-shaped.  Fields the schema
+    does not know are tolerated (forward compatibility is part of the
+    contract and regression-tested).  Returns the records; raises
+    ValueError on violations (the ``make metrics-smoke`` gate)."""
     import math
     records = []
     with open(path) as f:
@@ -277,7 +397,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                     for x in v:
                         check(k, x)
             for k, v in rec.items():
-                if not isinstance(v, dict):
+                if not isinstance(v, dict) and k != "edges":
                     check(k, v)
+            _check_structured(path, lineno, rec, check)
             records.append(rec)
     return records

@@ -33,6 +33,11 @@ Rules over the trailing window of ``cfg.window`` steps:
 * ``compile_storm``      — ``bf_step_cache_total{result=build}`` grew by
   more than ``compile_builds`` inside the window: a knob is churning the
   step cache (``utils/compile_cache.note_step_cache``).
+* ``overlap_collapse``   — the measured ``overlap_efficiency`` series
+  (``observability/commprof.py``: hidden / total exchange time) dropped
+  below ``overlap_min``: the delayed-mix pipeline degenerated to
+  synchronous — the exchange is back on the critical path.  Silent on
+  runs that never probe (the clean reference emits no such field).
 * ``series_gap``         — loader-level holes (truncated tails, parse
   errors, missing steps) surfaced as verdicts while the window still
   covers them (old, moved-past gaps stay in ``view.gaps`` only).
@@ -96,6 +101,11 @@ class HealthConfig:
     ``dead_after``        rank considered dead after lagging this many
                           steps behind the fleet max (window)
     ``compile_builds``    step-cache builds tolerated per window (2)
+    ``overlap_min``       overlap_collapse fires when the measured
+                          overlap efficiency drops below this (0.2)
+    ``overlap_samples``   ...for this many CONSECUTIVE latest samples
+                          (2: one cold probe / noisy reading is not a
+                          trend)
     """
     window: int = 8
     stall_ratio: float = 0.9
@@ -106,6 +116,8 @@ class HealthConfig:
     straggler_floor_s: float = 1e-4
     dead_after: Optional[int] = None
     compile_builds: int = 2
+    overlap_min: float = 0.2
+    overlap_samples: int = 2
 
     @classmethod
     def from_env(cls) -> "HealthConfig":
@@ -119,6 +131,8 @@ class HealthConfig:
             straggler_floor_s=_env_float("STRAGGLER_FLOOR_S", 1e-4),
             dead_after=(_env_int("DEAD_AFTER", 0) or None),
             compile_builds=_env_int("COMPILE_BUILDS", 2),
+            overlap_min=_env_float("OVERLAP_MIN", 0.2),
+            overlap_samples=_env_int("OVERLAP_SAMPLES", 2),
         )
 
     def resolved_dead_after(self) -> int:
@@ -310,6 +324,37 @@ def _straggler_rule(view, cfg, lo, hi, out):
                 threshold=cfg.straggler_factor))
 
 
+def _overlap_rule(view, cfg, lo, hi, out):
+    """``overlap_collapse``: the measured overlap efficiency (the comm
+    profiler's hidden/total exchange split) fell below ``overlap_min`` —
+    the delayed-mix pipeline degenerated to synchronous.  Fires only
+    when the LAST ``overlap_samples`` readings are ALL below the floor:
+    the measurement subtracts two near-equal wall times, so one noisy
+    sample (or one cold probe) is not a trend.  Rules only on what was
+    MEASURED — a run that never probes (the clean reference) emits no
+    field and stays silent."""
+    for rank in view.ranks:
+        eff = [(s, v) for s, v in _windowed(
+            view.series_of(rank, "overlap_efficiency"), lo)
+            if _finite(v)]
+        if len(eff) < cfg.overlap_samples:
+            continue
+        step_at, latest = eff[-1]
+        if all(v < cfg.overlap_min
+               for _, v in eff[-cfg.overlap_samples:]):
+            peak = max(v for _, v in eff)
+            out.append(Verdict(
+                "overlap_collapse", "warn",
+                f"rank {rank}: measured overlap efficiency fell to "
+                f"{latest:.2f} at step {step_at} (window peak "
+                f"{peak:.2f}, floor {cfg.overlap_min:g}) — the "
+                f"delayed-mix pipeline degenerated to synchronous; the "
+                f"exchange is back on the critical path "
+                f"(docs/observability.md \"Comm profiling\")",
+                rank=rank, step_lo=lo, step_hi=hi, value=latest,
+                threshold=cfg.overlap_min))
+
+
 def _dead_rank_rule(view, cfg, lo, hi, out):
     dead_after = cfg.resolved_dead_after()
     for rank in view.ranks:
@@ -412,6 +457,7 @@ def evaluate(view: AG.FleetView,
         _non_finite_rule(view, cfg, lo, hi, out)
         _residual_rule(view, cfg, lo, hi, out)
         _straggler_rule(view, cfg, lo, hi, out)
+        _overlap_rule(view, cfg, lo, hi, out)
         _dead_rank_rule(view, cfg, lo, hi, out)
         _counter_rules(view, cfg, lo, hi, out)
     elif not any(g.kind == "missing_file" for g in view.gaps):
@@ -456,7 +502,20 @@ def write_verdicts(report: HealthReport, path: str,
                    append: bool = True) -> None:
     """Append the report to a verdict JSONL: one summary line (``kind:
     report``) then one line per verdict (``kind: verdict``) — the
-    machine-consumable trail the controller tails."""
+    machine-consumable trail the controller tails.
+
+    Bounded like the telemetry JSONL: when ``BLUEFOG_METRICS_MAX_MB`` is
+    set and the file would exceed it, the trail rotates to
+    ``<path>.1..K`` first (``export.rotate_file``) — a wedged fleet
+    alarming every frame for a week must not fill the disk."""
+    from . import export as _export
+    max_bytes, keep = _export.resolve_rotation()
+    if append and max_bytes:
+        try:
+            if os.path.getsize(path) >= max_bytes:
+                _export.rotate_file(path, keep)
+        except OSError:
+            pass
     now_us = int(time.time() * 1e6)
     with open(path, "a" if append else "w") as f:
         head = {"kind": "report", "t_us": now_us}

@@ -50,7 +50,8 @@ from .. import timeline as _tl
 from . import metrics as _metrics
 
 __all__ = ["PHASES", "step_phase", "record_phase", "take_step_phases",
-           "reset_step_phases", "profiling_active"]
+           "reset_step_phases", "profiling_active", "stage_field",
+           "take_step_fields"]
 
 PHASES = ("exchange", "fold", "compute", "export")
 
@@ -62,6 +63,12 @@ _BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0,
 # dict (no lock): step loops are single-threaded by construction, and a
 # racing reader at worst misattributes one sample to a neighboring step
 _staged: Dict[str, float] = {}
+
+# arbitrary top-level numeric fields staged for the NEXT log_step record
+# (same lifecycle as _staged): the comm profiler stages its measured
+# `overlap_efficiency` here so the sample rides the SAME JSONL record as
+# the step's telemetry instead of needing its own schema
+_staged_fields: Dict[str, object] = {}
 
 _NULL = contextlib.nullcontext()
 
@@ -121,10 +128,34 @@ def step_phase(name: str):
 
 
 def reset_step_phases() -> None:
-    """Discard staged timings.  Called when a JSONL sink opens
-    (``export.metrics_start``): phases timed by a PREVIOUS loop that
-    never logged them must not land on the new sink's first record."""
+    """Discard staged timings (and staged fields).  Called when a JSONL
+    sink opens (``export.metrics_start``): phases timed by a PREVIOUS
+    loop that never logged them must not land on the new sink's first
+    record."""
     _staged.clear()
+    _staged_fields.clear()
+
+
+def stage_field(name: str, value) -> None:
+    """Stage one top-level field for the next ``export.log_step`` record
+    — a number (``overlap_efficiency``) or a JSON-ready structure (the
+    ``edges`` matrix).  Last-write-wins per step; no-op while profiling
+    is inactive."""
+    if not profiling_active():
+        return
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        value = float(value)
+    _staged_fields[name] = value
+
+
+def take_step_fields() -> Optional[Dict[str, object]]:
+    """Drain the staged top-level fields (None when nothing staged) —
+    called by ``export.log_step`` alongside :func:`take_step_phases`."""
+    if not _staged_fields:
+        return None
+    out = dict(_staged_fields)
+    _staged_fields.clear()
+    return out
 
 
 def take_step_phases() -> Optional[Dict[str, float]]:

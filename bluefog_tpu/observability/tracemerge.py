@@ -1,0 +1,334 @@
+"""``bftrace`` — merge N per-rank Chrome traces into one fleet trace.
+
+Each rank's timeline (``BLUEFOG_TIMELINE=<prefix>`` ->
+``<prefix><rank>.json``) is written against that process's OWN clock
+(``time.perf_counter`` at ``timeline_start``), so N files loaded side by
+side in Perfetto tell N unrelated stories.  This module makes them one
+causal trace:
+
+* **per-rank process rows** — every event is re-pinned to ``pid = rank``
+  with ``process_name`` / ``process_sort_index`` metadata, so Perfetto
+  renders one row block per rank, in rank order;
+* **clock alignment** — per-rank offsets are estimated from matched
+  exchange spans: the step loop stamps a ``round <k>`` span on the
+  ``gossip`` lane (``timeline.record_gossip_round``), and since a gossip
+  round is a collective, every participating rank finishes round *k*
+  together — the median end-time difference of shared rounds versus the
+  reference rank (lowest rank) IS the clock offset, robust to a few
+  straggling rounds;
+* **cross-rank flow events** — for every gossip round and topology edge,
+  a Chrome-trace flow arrow (``ph:"s"``/``"f"``) links the send side's
+  round span to the receive side's, so a straggling edge shows up as a
+  visibly skewed arrow instead of a guess.  Edges come from an
+  :class:`~.commprof.EdgeCostMatrix` artifact or an explicit list; with
+  neither, flows are omitted (the merge is still aligned).
+
+Pure host-side stdlib: importing this module never touches JAX.
+
+CLI (console script ``bftrace``)::
+
+    bftrace /tmp/trace_ -o merged.json              # <prefix><rank>.json
+    bftrace a.json b.json -o merged.json --edges 0-1,1-0
+    bftrace /tmp/trace_ -o merged.json --edge-matrix edges.json
+
+Prints one JSON report line (ranks, per-rank offsets µs, sync rounds
+matched, flows emitted) and exits non-zero when nothing could be merged.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "load_trace", "discover_traces", "sync_spans", "estimate_offsets",
+    "merge_traces", "validate_merged", "main", "SYNC_PREFIX",
+]
+
+# the span-name prefix the step loops stamp per gossip round
+# (timeline.record_gossip_round) — the cross-rank matching key
+SYNC_PREFIX = "round "
+
+
+def _drop_partial_tail(text: str) -> Optional[list]:
+    """Last-resort repair for a file truncated MID-EVENT (writer killed
+    mid-flush): close the array at the last complete top-level event.
+    Not every ``}`` ends an event (``args`` nests), so try each trailing
+    candidate, bounded — the partial tail is at most one event long."""
+    base = text.rstrip().rstrip(",")
+    cut = len(base)
+    for _ in range(64):
+        cut = base.rfind("}", 0, cut)
+        if cut < 0:
+            return None
+        try:
+            out = json.loads(base[:cut + 1] + "\n]")
+        except json.JSONDecodeError:
+            continue
+        return out if isinstance(out, list) else None
+    return None
+
+
+def load_trace(path: str) -> List[dict]:
+    """Read one Chrome-trace JSON array, tolerantly.
+
+    A writer killed mid-run leaves the array unclosed (the native writer
+    flushes events but only ``close()`` writes the bracket), possibly
+    with a partial event at EOF; the merge exists precisely to debug
+    such runs, so repair — strip a trailing comma, close the array,
+    drop a truncated tail event — rather than refuse."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        events = json.loads(text)
+    except json.JSONDecodeError:
+        repaired = text.rstrip().rstrip(",")
+        if not repaired.endswith("]"):
+            repaired += "\n]"
+        try:
+            events = json.loads(repaired)
+        except json.JSONDecodeError as e:
+            events = _drop_partial_tail(text)
+            if events is None:
+                raise ValueError(f"{path}: not a Chrome trace array ({e})")
+    if isinstance(events, dict):           # {"traceEvents": [...]} form
+        events = events.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: expected a JSON array of events")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def discover_traces(prefix: str) -> Dict[int, str]:
+    """``<prefix><rank>.json`` files on disk, keyed by integer rank —
+    the same discovery contract as the metrics JSONL aggregator."""
+    out: Dict[int, str] = {}
+    pat = re.compile(re.escape(os.path.basename(prefix)) + r"(\d+)\.json$")
+    for path in glob.glob(glob.escape(prefix) + "*.json"):
+        m = pat.match(os.path.basename(path))
+        if m:
+            out[int(m.group(1))] = path
+    return out
+
+
+def sync_spans(events: Sequence[dict],
+               sync_prefix: str = SYNC_PREFIX) -> Dict[str, dict]:
+    """Complete (``ph:"X"``) spans whose name carries the sync prefix,
+    keyed by name — first occurrence wins (a restarted loop re-stamping
+    ``round 0`` must not skew the estimate with a late duplicate)."""
+    out: Dict[str, dict] = {}
+    for e in events:
+        if (e.get("ph") == "X" and isinstance(e.get("name"), str)
+                and e["name"].startswith(sync_prefix)
+                and e["name"] not in out):
+            out[e["name"]] = e
+    return out
+
+
+def _span_end(e: dict) -> float:
+    return float(e.get("ts", 0)) + float(e.get("dur", 0))
+
+
+def estimate_offsets(per_rank_events: Dict[int, Sequence[dict]],
+                     sync_prefix: str = SYNC_PREFIX
+                     ) -> Tuple[Dict[int, float], Dict[int, int]]:
+    """Per-rank clock offsets (µs to ADD to a rank's timestamps) against
+    the reference rank (lowest), from the median end-time difference of
+    shared sync spans.  A collective finishes on every rank together, so
+    the end-to-end difference of round *k* is (mostly) clock skew; the
+    median survives a few genuinely straggling rounds.  Ranks sharing no
+    sync span stay at offset 0 (flagged via a 0 match count)."""
+    ranks = sorted(per_rank_events)
+    if not ranks:
+        return {}, {}
+    ref = ranks[0]
+    ref_spans = sync_spans(per_rank_events[ref], sync_prefix)
+    offsets: Dict[int, float] = {ref: 0.0}
+    matched: Dict[int, int] = {ref: len(ref_spans)}
+    for rank in ranks[1:]:
+        spans = sync_spans(per_rank_events[rank], sync_prefix)
+        shared = sorted(set(ref_spans) & set(spans))
+        matched[rank] = len(shared)
+        if not shared:
+            offsets[rank] = 0.0
+            continue
+        deltas = [_span_end(ref_spans[name]) - _span_end(spans[name])
+                  for name in shared]
+        offsets[rank] = float(statistics.median(deltas))
+    return offsets, matched
+
+
+def _parse_edges(spec: Optional[str]) -> List[Tuple[int, int]]:
+    if not spec:
+        return []
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        src, dst = part.split("-")
+        out.append((int(src), int(dst)))
+    return out
+
+
+def _flow_events(shifted: Dict[int, List[dict]],
+                 edges: Sequence[Tuple[int, int]],
+                 sync_prefix: str) -> List[dict]:
+    """One flow arrow per (gossip round, edge): send side = the src
+    rank's round span end, receive side = the dst rank's — after clock
+    alignment, a skewed arrow IS a straggling edge."""
+    spans = {rank: sync_spans(evs, sync_prefix)
+             for rank, evs in shifted.items()}
+    flows: List[dict] = []
+    fid = 0
+    for src, dst in edges:
+        if src not in spans or dst not in spans:
+            continue
+        for name in sorted(set(spans[src]) & set(spans[dst])):
+            s, d = spans[src][name], spans[dst][name]
+            fid += 1
+            flows.append({"ph": "s", "cat": "gossip",
+                          "name": f"{name} {src}->{dst}", "id": fid,
+                          "pid": src, "tid": s.get("tid", 0),
+                          "ts": _span_end(s)})
+            flows.append({"ph": "f", "bp": "e", "cat": "gossip",
+                          "name": f"{name} {src}->{dst}", "id": fid,
+                          "pid": dst, "tid": d.get("tid", 0),
+                          "ts": _span_end(d)})
+    return flows
+
+
+def merge_traces(paths: Dict[int, str], *,
+                 edges: Optional[Sequence[Tuple[int, int]]] = None,
+                 sync_prefix: str = SYNC_PREFIX,
+                 out_path: Optional[str] = None) -> dict:
+    """Merge per-rank trace files into one aligned fleet trace.
+
+    Returns a report dict: ``events`` (the merged list), ``offsets_us``,
+    ``sync_matched`` (rounds matched per rank), ``flows``, ``ranks``.
+    ``out_path`` additionally writes the merged array to disk."""
+    per_rank = {rank: load_trace(path) for rank, path in sorted(paths.items())}
+    offsets, matched = estimate_offsets(per_rank, sync_prefix)
+    shifted: Dict[int, List[dict]] = {}
+    merged: List[dict] = []
+    for rank in sorted(per_rank):
+        off = offsets.get(rank, 0.0)
+        evs = []
+        for e in per_rank[rank]:
+            # the writers' own process metadata is replaced by the
+            # canonical per-rank rows below (two process_name events on
+            # one pid would race in the viewer)
+            if (e.get("ph") == "M" and e.get("name")
+                    in ("process_name", "process_sort_index")):
+                continue
+            e = dict(e)
+            e["pid"] = rank                 # one process row per rank
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + off
+            evs.append(e)
+        # rank-ordered, named process rows regardless of what the
+        # original writer emitted
+        evs.insert(0, {"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        evs.insert(1, {"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+        shifted[rank] = evs
+        merged.extend(evs)
+    flows = _flow_events(shifted, edges or [], sync_prefix)
+    merged.extend(flows)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return {
+        "ranks": sorted(per_rank),
+        "offsets_us": {str(r): round(offsets.get(r, 0.0), 3)
+                       for r in sorted(per_rank)},
+        "sync_matched": {str(r): matched.get(r, 0)
+                         for r in sorted(per_rank)},
+        "flows": len(flows) // 2,
+        "events": merged,
+        "out_path": out_path,
+    }
+
+
+def validate_merged(events: Sequence[dict]) -> List[str]:
+    """Structural checks on a merged trace; returns a list of problems
+    (empty = valid).  Complete spans must be time-ordered per (pid, tid)
+    row — the invariant the golden-merge test gates on — and every flow
+    start must have its finish."""
+    problems: List[str] = []
+    rows: Dict[Tuple, float] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        ts = float(e.get("ts", 0))
+        if key in rows and ts < rows[key]:
+            problems.append(
+                f"row {key}: span {e.get('name')!r} at {ts} precedes the "
+                f"previous span start {rows[key]}")
+        rows[key] = max(rows.get(key, ts), ts)
+    starts = {e["id"] for e in events if e.get("ph") == "s"}
+    ends = {e["id"] for e in events if e.get("ph") == "f"}
+    for fid in sorted(starts ^ ends):
+        problems.append(f"flow {fid} is unpaired")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bftrace",
+        description="merge per-rank BLUEFOG_TIMELINE Chrome traces into "
+                    "one clock-aligned fleet trace "
+                    "(docs/observability.md)")
+    p.add_argument("inputs", nargs="+",
+                   help="a timeline prefix (discovers <prefix><rank>"
+                        ".json) or explicit per-rank trace files "
+                        "(rank = position)")
+    p.add_argument("-o", "--out", required=True,
+                   help="merged trace path (open in Perfetto)")
+    p.add_argument("--sync-prefix", default=SYNC_PREFIX,
+                   help=f"span-name prefix matched across ranks for "
+                        f"clock alignment (default {SYNC_PREFIX!r})")
+    p.add_argument("--edges", default=None,
+                   help="comma-separated src-dst pairs to draw gossip "
+                        "flow arrows for (e.g. 0-1,1-2)")
+    p.add_argument("--edge-matrix", default=None, metavar="PATH",
+                   help="EdgeCostMatrix artifact (bench.py "
+                        "--profile-edges); its edges supply the flow "
+                        "arrows")
+    args = p.parse_args(argv)
+
+    if len(args.inputs) == 1 and not os.path.exists(args.inputs[0]):
+        paths = discover_traces(args.inputs[0])
+        if not paths:
+            print(f"bftrace: no <prefix><rank>.json files match "
+                  f"{args.inputs[0]!r}", file=sys.stderr)
+            return 1
+    elif len(args.inputs) == 1 and args.inputs[0].endswith(".json"):
+        paths = {0: args.inputs[0]}
+    else:
+        paths = {i: path for i, path in enumerate(args.inputs)}
+
+    edges = _parse_edges(args.edges)
+    if args.edge_matrix:
+        with open(args.edge_matrix) as f:
+            d = json.load(f)
+        edges = sorted({(int(e["src"]), int(e["dst"]))
+                        for e in d.get("entries", [])} | set(edges))
+
+    report = merge_traces(paths, edges=edges,
+                          sync_prefix=args.sync_prefix, out_path=args.out)
+    problems = validate_merged(report["events"])
+    out = {k: v for k, v in report.items() if k != "events"}
+    out["event_count"] = len(report["events"])
+    out["problems"] = problems
+    print(json.dumps(out))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,7 @@ __all__ = [
     "resolve_max_bucket_bytes",
     "plan_bytes",
     "gossip_wire_bytes",
+    "bucket_probe_sizes",
     "plan_for",
     "shard_shape",
     "shard_groups",
@@ -247,6 +248,23 @@ def gossip_wire_bytes(plan: FusionPlan, n_transfers: int = 1) -> int:
     total = sum(b.padded * jnp.dtype(b.dtype).itemsize
                 for b in plan.buckets)
     return int(total) * int(n_transfers)
+
+
+def bucket_probe_sizes(plan: FusionPlan,
+                       cap_bytes: Optional[int] = None) -> Tuple[int, ...]:
+    """Probe payload sizes representative of this plan's buckets — what
+    the edge probe harness (``observability/commprof.py``) actually puts
+    on each link: the PADDED per-bucket wire bytes (padding tails ride
+    the permutes, same accounting as :func:`gossip_wire_bytes`), deduped
+    and sorted, each clipped to ``cap_bytes`` (a probe must not ship a
+    64 MiB bucket just to rank links).  A small latency-regime payload
+    (4 KiB) is always included so the matrix separates per-message cost
+    from bandwidth.  Empty plans fall back to the latency payload only."""
+    cap = int(cap_bytes) if cap_bytes is not None else (4 << 20)
+    sizes = {min(int(b.padded * jnp.dtype(b.dtype).itemsize), cap)
+             for b in plan.buckets}
+    sizes.add(min(4096, cap))
+    return tuple(sorted(s for s in sizes if s > 0))
 
 
 def shard_shape(shape: Tuple[int, ...], spec,

@@ -29,9 +29,11 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from .. import timeline as _tl
 from ..compress import compressors as _cp
 from ..compress import exchange as _cx
 from ..context import ctx
+from ..observability import commprof as _cprof
 from ..observability import ingraph as IG
 from ..observability import phases as _ph
 from ..ops import api as _api
@@ -144,6 +146,9 @@ class _JittedStrategyOptimizer:
         self.k = num_steps_per_communication
         self.sched = sched
         self._step_cache = {}
+        # overlap-probe programs (commprof.measure_overlap inputs), keyed
+        # like the step cache so knob changes rebuild them in lockstep
+        self._probe_cache = {}
 
     def init(self, params):
         """Base optimizer state, batched over the rank axis (so scalar state
@@ -295,10 +300,13 @@ class _JittedStrategyOptimizer:
 
         return jax.jit(stepper)
 
-    def step(self, params, grads, opt_state, step: int = 0):
-        """One optimizer step.  Returns ``(params, opt_state)`` — plus a
-        global-view :class:`~..observability.ingraph.TelemetrySnapshot`
-        (``[N]`` per field) when telemetry resolves on."""
+    def _exec_config(self, params):
+        """Resolve the per-call execution knobs and the step-cache key —
+        the ONE copy :meth:`step` and :meth:`probe_overlap` share.  A
+        drifted second copy would make the probe price a DIFFERENT
+        program than the step actually runs, and the measured overlap
+        efficiency (and the ``overlap_collapse`` health rule) would
+        judge the wrong exchange with no test failing."""
         cx = ctx()
         # under overlap / stateful compression the fusion knobs were
         # pinned at construction (they shape the carried buffers created
@@ -313,16 +321,155 @@ class _JittedStrategyOptimizer:
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
                              self.overlap, telemetry, self.compression,
                              gossip_axis=cx.rank_axis)
+        return fuse, bucket, telemetry, key
+
+    def step(self, params, grads, opt_state, step: int = 0):
+        """One optimizer step.  Returns ``(params, opt_state)`` — plus a
+        global-view :class:`~..observability.ingraph.TelemetrySnapshot`
+        (``[N]`` per field) when telemetry resolves on."""
+        _fuse, _bucket, telemetry, key = self._exec_config(params)
         hit = key in self._step_cache
         note_step_cache(hit)
         if not hit:
             self._step_cache[key] = self._build(key, telemetry)
+        # periodic overlap measurement (BLUEFOG_OVERLAP_PROBE_EVERY):
+        # re-price the exposed/hidden exchange split every K-th step
+        # while profiling is on; the sample stages the
+        # `overlap_efficiency` JSONL field the health engine watches
+        every = _cprof.overlap_probe_every()
+        if every and _ph.profiling_active() and int(step) % every == 0:
+            self.probe_overlap(params, grads, opt_state, step)
         # `compute` phase = the whole jitted dispatch: for this family
         # the exchange is fused INTO the graph, so exchange/fold have no
-        # separate host extent (the window family times them apart)
+        # separate host extent (the window family times them apart).
+        # The gossip-round span is the cross-rank sync anchor bftrace
+        # aligns per-rank clocks with.
+        tok = _tl.op_start_us()
         with _ph.step_phase("compute"):
-            return self._step_cache[key](params, grads, opt_state,
-                                         jnp.asarray(step, jnp.int32))
+            out = self._step_cache[key](params, grads, opt_state,
+                                        jnp.asarray(step, jnp.int32))
+            if _tl.timeline_enabled():
+                # the round span must end when the COLLECTIVE finishes,
+                # not when the host finishes enqueueing — ranks run ahead
+                # of the device by different queue depths, and bftrace's
+                # clock alignment reads span ends as collective-
+                # completion times.  Tracing pays the run-ahead loss;
+                # the un-traced hot path stays fully async.
+                jax.block_until_ready(out)
+        _tl.record_gossip_round(step, tok)
+        return out
+
+    def _comm_layout(self):
+        """``(comm_type, topo, machine_topo, hierarchical)`` of the
+        exchange this optimizer runs — MUST mirror how :meth:`_build`'s
+        branches resolve them (grad-allreduce maps to allreduce mixing,
+        exact-diffusion folds the topology, hierarchical adds the
+        machine topo), or :meth:`probe_overlap` prices a different
+        exchange than the step executes."""
+        cx = ctx()
+        hierarchical = (self.comm_type
+                        == CommunicationType.hierarchical_neighbor_allreduce)
+        comm_type = (CommunicationType.allreduce if self.gradient_allreduce
+                     else self.comm_type)
+        topo = None
+        machine_topo = None
+        if (comm_type == CommunicationType.neighbor_allreduce
+                and self.sched is None):
+            topo = cx.compiled_topology
+            if self.exact_diffusion:
+                topo = S.exact_diffusion_topology(cx.compiled_topology)
+        if hierarchical:
+            machine_topo = cx.compiled_machine_topology
+        return comm_type, topo, machine_topo, hierarchical
+
+    def _build_comm_probe(self, fuse, bucket_bytes):
+        """Exchange-only jitted program: prices the step's FULL exchange
+        (same topology/schedule/backend/fusion/compression knobs) for
+        :meth:`probe_overlap`'s efficiency denominator."""
+        cx = ctx()
+        comm_type, topo, machine_topo, hierarchical = self._comm_layout()
+        cfg = self.compression
+        stateful = self._comp_stateful
+        backend = _api._nar_backend()
+        pl = mesh_plumbing(cx, hierarchical)
+        check_vma = not backend.startswith("pallas")
+
+        def core(tree_s, cs_s, si):
+            out = S._communicate_c(
+                pl.unwrap(tree_s), comm_type, cx.rank_axis, topo,
+                self.sched, si, (cx.machine_axis, cx.local_axis),
+                machine_topo, backend, fuse, bucket_bytes, cfg,
+                pl.unwrap(cs_s) if stateful else None)
+            return pl.rewrap(out[0])
+
+        if stateful:
+            def comm_fn(tree, cs, step_idx):
+                return pl.reshape_out(jax.shard_map(
+                    core, mesh=pl.mesh,
+                    in_specs=(pl.spec, pl.spec, P()), out_specs=pl.spec,
+                    check_vma=check_vma,
+                )(pl.reshape_in(tree), pl.reshape_in(cs), step_idx))
+        else:
+            def comm_fn(tree, step_idx):
+                return pl.reshape_out(jax.shard_map(
+                    lambda t, si: core(t, None, si), mesh=pl.mesh,
+                    in_specs=(pl.spec, P()), out_specs=pl.spec,
+                    check_vma=check_vma,
+                )(pl.reshape_in(tree), step_idx))
+        return jax.jit(comm_fn)
+
+    def probe_overlap(self, params, grads, opt_state, step: int = 0,
+                      repeats: int = 2):
+        """Measure this optimizer's exposed/hidden exchange split
+        (:func:`~..observability.commprof.measure_overlap`).
+
+        Times three non-donating programs on the given arguments: the
+        cached step, a pruned variant whose carried ``inflight`` (and
+        ``compress``) state passes through unchanged — so XLA
+        dead-code-eliminates the delayed-mix LAUNCH, leaving exactly the
+        parameter critical path — and the exchange alone.  Returns an
+        :class:`~..observability.commprof.OverlapSample` (efficiency ~0
+        = synchronous, ~1 = fully pipelined), or None when the step has
+        no exchange to price.  Stages the ``overlap_efficiency`` JSONL
+        field and ``bf_overlap`` gauges as a side effect."""
+        if (self.comm_type == CommunicationType.empty
+                and not self.gradient_allreduce):
+            return None
+        fuse, bucket, telemetry, key = self._exec_config(params)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build(key, telemetry)
+        full = self._step_cache[key]
+        probes = self._probe_cache.get(key)
+        if probes is None:
+            def pruned_fn(p, g, s, i):
+                out = full(p, g, s, i)
+                st = out[1]
+                if isinstance(st, dict):
+                    # pass the carried launch products through unchanged:
+                    # the collectives feeding only them go dead and XLA
+                    # removes them — what remains IS the params critical
+                    # path.  (Without overlap the exchange feeds params
+                    # directly and survives: hidden time reads ~0.)
+                    keep = {k: s[k] for k in ("inflight", "compress")
+                            if k in st}
+                    if keep:
+                        st = {**st, **keep}
+                # the telemetry snapshot is dropped: its compression
+                # diagnostics would keep the pruned launch alive
+                return out[0], st
+            probes = (jax.jit(pruned_fn), self._build_comm_probe(
+                fuse, bucket))
+            self._probe_cache[key] = probes
+        pruned, comm = probes
+        si = jnp.asarray(step, jnp.int32)
+        target = grads if self.gradient_allreduce else params
+        if self._comp_stateful:
+            comm_args = (target, opt_state["compress"], si)
+        else:
+            comm_args = (target, si)
+        return _cprof.measure_overlap(
+            full, pruned, comm, (params, grads, opt_state, si),
+            comm_args, repeats=repeats)
 
 
 def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
@@ -537,10 +684,13 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
             return self._apply_base(params, grads, opt_state, step)
         # step-phase timers (observability/phases.py): `exchange` = the
         # one-sided launch + wait, `fold` = the buffer average; the local
-        # adapt inside _apply_base times itself as `compute`
+        # adapt inside _apply_base times itself as `compute`.  The
+        # gossip-round span anchors bftrace's cross-rank clock alignment.
+        tok = _tl.op_start_us()
         with _ph.step_phase("exchange"):
             W.win_wait(W.win_put_nonblocking(params, self._name,
                                              dst_weights=self.dst_weights))
+        _tl.record_gossip_round(step, tok)
         with _ph.step_phase("fold"):
             averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
@@ -555,10 +705,12 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
         if not self._should_communicate(step):
             return self._apply_base(params, grads, opt_state, step)
         # publish current weights in the window, then pull neighbors'
+        tok = _tl.op_start_us()
         with _ph.step_phase("exchange"):
             W.win_publish(self._name, params)
             W.win_wait(W.win_get_nonblocking(self._name,
                                              src_weights=self.src_weights))
+        _tl.record_gossip_round(step, tok)
         with _ph.step_phase("fold"):
             averaged = W.win_update(self._name, require_mutex=True)
         return self._apply_base(averaged, grads, opt_state, step)
@@ -622,6 +774,7 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         biased = W.win_fetch(self._name)
         out = self._apply_base(biased, grads, opt_state, step)
         adapted, opt_state = out[0], out[1]
+        tok = _tl.op_start_us()
         with _ph.step_phase("exchange"):
             if self.sched is not None:
                 W.win_accumulate(adapted, self._name, require_mutex=True,
@@ -631,6 +784,7 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
                                  self_weight=self.alpha,
                                  dst_weights=self.dst_weights,
                                  require_mutex=True)
+        _tl.record_gossip_round(step, tok)
         with _ph.step_phase("fold"):
             collected = W.win_update_then_collect(self._name)
         if len(out) == 3:

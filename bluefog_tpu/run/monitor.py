@@ -33,7 +33,8 @@ from typing import List, Optional
 from ..observability import aggregate as AG
 from ..observability import health as H
 
-__all__ = ["main", "build_report", "render_dashboard", "sparkline"]
+__all__ = ["main", "build_report", "render_dashboard", "sparkline",
+           "render_edge_heatmap"]
 
 _TICKS = "▁▂▃▄▅▆▇█"
 _SEV_TAG = {"critical": "CRIT", "warn": "warn", "info": "info"}
@@ -140,6 +141,16 @@ def build_report(prefix: str, *, window: Optional[int] = None,
         st = AG.spread(walls)
         if st is not None:
             spreads["step_wall_s"] = st.asdict()
+    # measured overlap efficiency: spread over each rank's LATEST probe
+    # (probes are periodic, so per-step alignment would miss most ranks)
+    effs = []
+    for rank in view.ranks:
+        series = view.series_of(rank, "overlap_efficiency")
+        if series:
+            effs.append(series[-1][1])
+    st = AG.spread(effs)
+    if st is not None:
+        spreads["overlap_efficiency"] = st.asdict()
     out = {
         "prefix": prefix,
         "ok": report.ok,
@@ -151,9 +162,54 @@ def build_report(prefix: str, *, window: Optional[int] = None,
         "verdicts": [v.asdict() for v in report.verdicts],
         "per_rank": per_rank,
         "spread": spreads,
+        # the comm profiler's measured per-edge cost matrix (newest
+        # "edges" record in the fleet) — with the spreads above this
+        # makes the --once --json report the ONE controller feed: health
+        # verdicts, cross-rank spreads, link costs, overlap efficiency
+        "edges": view.latest_edges(),
         "gaps": [g.asdict() for g in view.gaps],
     }
     return view, report, _strict_json(out)
+
+
+def render_edge_heatmap(edges: dict, *, top: int = 0) -> str:
+    """Terminal heatmap of the measured edge cost matrix (``--edges``):
+    one cell per (src row, dst column), shaded by one-way latency
+    normalized across the matrix (``.`` = no edge), with the slowest
+    edges listed under it.  ``edges`` is the ``latest_edges()`` dict."""
+    from ..observability.commprof import EdgeCostMatrix
+    entries = edges["entries"]
+    ranks = sorted({e["src"] for e in entries}
+                   | {e["dst"] for e in entries})
+    m = EdgeCostMatrix(n=(max(ranks) + 1 if ranks else 0),
+                       entries=entries)
+    lat = {(s, d): m.latency_us(s, d) for s, d in m.edges()}
+    finite = [v for v in lat.values() if v is not None and v > 0]
+    lines = [f"edge latency heatmap (probed at step {edges.get('step')}, "
+             f"one-way µs at the largest payload):"]
+    if not finite:
+        return "\n".join(lines + ["  (no finite edge measurements)"])
+    lo, hi = min(finite), max(finite)
+    span = (hi - lo) or 1.0
+    # 3-char column stride shared by the header and every row, so each
+    # dst label sits exactly over its cells
+    header = "      " + "".join(f"{d:>3}" for d in ranks)
+    lines.append(header + "   <- dst")
+    for s in ranks:
+        row = []
+        for d in ranks:
+            v = lat.get((s, d))
+            if v is None:
+                row.append(f"{'.':>3}")
+            else:
+                tick = _TICKS[min(len(_TICKS) - 1,
+                                  int((v - lo) / span * len(_TICKS)))]
+                row.append(f"{tick:>3}")
+        lines.append(f"  {s:>2} |" + "".join(row))
+    worst = sorted(lat.items(), key=lambda kv: -(kv[1] or 0))
+    for (s, d), v in worst[:max(3, top)]:
+        lines.append(f"  slow: {s}->{d}  {_fmt(v)}µs")
+    return "\n".join(lines)
 
 
 def render_dashboard(view, report, *, width: int = 12) -> str:
@@ -239,6 +295,10 @@ def main(argv=None) -> int:
     p.add_argument("--verdicts", default=None, metavar="PATH",
                    help="append HealthReports to this verdict JSONL "
                         "(the controller feed)")
+    p.add_argument("--edges", action="store_true",
+                   help="render the measured edge-cost heatmap (the comm "
+                        "profiler's newest 'edges' record) under the "
+                        "dashboard")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -257,6 +317,14 @@ def main(argv=None) -> int:
             print(json.dumps(out))
         else:
             print(render_dashboard(view, report))
+            if args.edges:
+                edges = out.get("edges")
+                if edges:
+                    print()
+                    print(render_edge_heatmap(edges))
+                else:
+                    print("\n(no edge matrix in the series yet — run the "
+                          "probe: bench.py --profile-edges)")
         return report
 
     if args.once:

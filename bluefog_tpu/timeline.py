@@ -34,6 +34,7 @@ __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
     "record_op_phase", "op_phase", "record_resilience_event",
     "record_counter", "op_start_us", "record_op_span",
+    "record_gossip_round", "GOSSIP_LANE",
 ]
 
 _ENV = "BLUEFOG_TIMELINE"
@@ -293,6 +294,25 @@ def record_op_span(name: str, activity: str, token):
         return
     end = _timeline.now_us()
     _timeline.record(name, activity, "X", max(0, end - start_us), start_us)
+
+
+# the lane every step loop stamps its per-round sync spans on — the
+# cross-rank matching key the fleet trace merger aligns clocks with
+GOSSIP_LANE = "gossip"
+
+
+def record_gossip_round(step, token):
+    """Close a ``round <step>`` span on the :data:`GOSSIP_LANE`.
+
+    Stamped by the optimizer step loops around each exchange-bearing
+    step: a gossip round is a collective, so every participating rank
+    finishes round *k* together — which makes these spans the clock-sync
+    anchors ``bftrace`` (``observability/tracemerge.py``) matches across
+    per-rank trace files to estimate per-rank clock offsets, and the
+    endpoints its cross-rank flow arrows attach to.  ``step`` must be a
+    host int (the loop index, not a traced array); token from
+    :func:`op_start_us`.  No-op while the timeline is disabled."""
+    record_op_span(GOSSIP_LANE, f"round {int(step)}", token)
 
 
 def record_counter(name: str, value: float, series: str = "value",

@@ -21,6 +21,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from . import timeline as _tl
 from .compress import compressors as _cp
 from .compress import exchange as _cx
 from .context import ctx
@@ -371,6 +372,9 @@ def run_steps(step_fn, variables, opt_state, batches, num_steps: int, *,
     batch_of = batches if callable(batches) else (lambda _t: batches)
     losses = []
     for t in range(start_step, start_step + num_steps):
+        # the gossip-round span (sync'd by the loss fetch below) is the
+        # per-round anchor bftrace matches across ranks to align clocks
+        tok = _tl.op_start_us()
         with _phases.step_phase("compute"):
             out = step_fn(variables, opt_state, batch_of(t),
                           jnp.asarray(t, jnp.int32))
@@ -380,6 +384,7 @@ def run_steps(step_fn, variables, opt_state, batches, num_steps: int, *,
             # immediately, so timing it alone would attribute the whole
             # device execution to no phase
             loss = float(loss)
+        _tl.record_gossip_round(t, tok)
         losses.append(loss)
         if log:
             _ex.log_step(t, snap, extra={"loss": loss})

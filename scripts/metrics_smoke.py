@@ -19,6 +19,16 @@ strictly decrease, the carried residual norm must stay bounded (below
 the parameter norm), and the snapshot must report a compression ratio
 > 1 (docs/compression.md).
 
+``--profile`` (``make profile-smoke``) adds the comm-profiler gate
+(docs/observability.md "Comm profiling & fleet traces"): an edge probe
+on the virtual mesh with a synthetic delay seeded on one topology edge
+must rank exactly that edge slowest and round-trip through the JSONL
+``"edges"`` record, the ``bf_edge_*`` gauges, and ``bfmonitor --once
+--json``; the measured overlap efficiency must read ~0 for the
+synchronous step and measurably positive for the delayed-mix pipeline;
+and a two-rank trace merge with a known injected clock skew must recover
+the offset, pair the gossip flow events, and validate.
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -187,9 +197,150 @@ def health_legs(n, tmp):
     }
 
 
+OVERLAP_SYNC_MAX, OVERLAP_PIPE_MIN = 0.2, 0.25
+TRACE_SKEW_US, TRACE_ROUNDS = 250000.0, 8
+TRACE_TOL_US = 30000.0     # sleep() oversleep drift accumulates per round
+                           # on a loaded host; 12 % of a 250 ms skew
+                           # still separates skew from no-skew decisively
+
+
+def timing_leg(leg, tries=2):
+    """Run a wall-clock-sensitive gate up to ``tries`` times.
+
+    ``leg`` returns a result dict or an error string.  The thresholds
+    stay strict — a genuine regression fails every attempt — but one
+    scheduler stall on a shared CI host (the dominant flake source for
+    anything that subtracts near-equal wall times) gets a second look
+    instead of a red build."""
+    for attempt in range(tries):
+        out = leg()
+        if not isinstance(out, str):
+            return out
+        if attempt < tries - 1:
+            print(f"metrics-smoke: retrying timing leg — {out}")
+    fail(out)
+
+
+def profile_legs(n, tmp):
+    """The ``make profile-smoke`` gate: seeded slow edge ranked slowest
+    and round-tripped to the monitor, overlap efficiency separates the
+    synchronous step from the pipeline, merged trace validates."""
+    import time as _time
+    from bluefog_tpu import timeline as TL
+    from bluefog_tpu.context import ctx
+    from bluefog_tpu.observability import commprof as CPROF
+    from bluefog_tpu.observability import metrics as MET
+    from bluefog_tpu.observability import tracemerge as TM
+
+    MET.enable()
+
+    # -- edge probe: seeded delay must rank slowest --------------------
+    edges = CPROF.topology_edges(ctx().compiled_topology)
+    seed = edges[len(edges) // 2]
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=2, inner=2,
+                            inject_delay_s={seed: 0.02}, export=False)
+    if mat.slowest_edge() != seed:
+        fail(f"edge probe ranked {mat.slowest_edge()} slowest, seeded "
+             f"slow edge was {seed}")
+
+    # -- matrix -> gauges + JSONL -> bfmonitor --once --json -----------
+    prefix = os.path.join(tmp, "prof_")
+    EX.metrics_start(prefix, rank=0)
+    EX.log_step(0)
+    CPROF.export_edge_matrix(mat, step=1)
+    EX.metrics_end()
+    snap = MET.registry.snapshot()
+    gkey = (f"bf_edge_latency_us{{bytes=4096,dst={seed[1]},"
+            f"src={seed[0]}}}")
+    if gkey not in snap:
+        fail(f"edge gauges missing {gkey} (have "
+             f"{[k for k in snap if k.startswith('bf_edge')][:3]}...)")
+    _, out = bfmonitor_json(prefix)
+    if not out.get("edges") or not out["edges"].get("entries"):
+        fail(f"bfmonitor report carries no edge matrix: {out.get('edges')}")
+    worst = max(out["edges"]["entries"], key=lambda e: e["latency_us"])
+    if (worst["src"], worst["dst"]) != seed:
+        fail(f"bfmonitor edge matrix worst edge "
+             f"{(worst['src'], worst['dst'])} != seeded {seed}")
+
+    # -- overlap efficiency: sync ~0, pipeline measurably positive -----
+    import optax as _optax
+    rng = np.random.default_rng(3)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 256, 256)), jnp.float32),
+              "v": jnp.asarray(rng.normal(size=(n, 256, 256)), jnp.float32)}
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    def overlap_leg():
+        eff = {}
+        for label, ov in (("sync", False), ("pipeline", True)):
+            opt = bf.DistributedNeighborAllreduceOptimizer(
+                _optax.sgd(0.01), overlap=ov)
+            state = opt.init(params)
+            sample = opt.probe_overlap(params, grads, state, 0, repeats=3)
+            if sample is None:
+                fail(f"overlap probe ({label}) priced no exchange")
+            eff[label] = sample.efficiency
+        if eff["sync"] >= OVERLAP_SYNC_MAX:
+            return (f"synchronous step measured overlap efficiency "
+                    f"{eff['sync']:.3f} (expected ~0 < {OVERLAP_SYNC_MAX})")
+        if (eff["pipeline"] <= OVERLAP_PIPE_MIN
+                or eff["pipeline"] <= eff["sync"]):
+            return (f"delayed-mix pipeline efficiency "
+                    f"{eff['pipeline']:.3f} not measurably positive "
+                    f"(sync {eff['sync']:.3f}, floor {OVERLAP_PIPE_MIN})")
+        return eff
+
+    eff = timing_leg(overlap_leg)
+
+    # -- fleet trace merge: recover an injected clock skew -------------
+    def trace_leg():
+        tprefix = os.path.join(tmp, "trace_")
+        for r in range(2):
+            TL.timeline_start(tprefix, rank=r)
+            for t in range(TRACE_ROUNDS):
+                tok = TL.op_start_us()
+                _time.sleep(0.002)
+                TL.record_gossip_round(t, tok)
+            TL.timeline_end()
+        p1 = f"{tprefix}1.json"
+        with open(p1) as f:
+            evs = json.load(f)
+        for e in evs:
+            if "ts" in e:
+                e["ts"] = e["ts"] + TRACE_SKEW_US
+        with open(p1, "w") as f:
+            json.dump(evs, f)
+        report = TM.merge_traces({0: f"{tprefix}0.json", 1: p1},
+                                 edges=[(0, 1)],
+                                 out_path=os.path.join(tmp, "merged.json"))
+        problems = TM.validate_merged(report["events"])
+        if problems:
+            fail(f"merged trace invalid: {problems}")
+        off1 = report["offsets_us"]["1"]
+        if abs(off1 + TRACE_SKEW_US) > TRACE_TOL_US:
+            return (f"clock skew not recovered: estimated {off1} µs for "
+                    f"an injected {-TRACE_SKEW_US} µs")
+        if report["flows"] != TRACE_ROUNDS:
+            fail(f"expected {TRACE_ROUNDS} gossip flow arrows, got "
+                 f"{report['flows']}")
+        return report
+
+    report = timing_leg(trace_leg)
+    off1 = report["offsets_us"]["1"]
+    return {
+        "seeded_edge": list(seed),
+        "seeded_latency_us": mat.latency_us(*seed),
+        "overlap_eff_sync": round(eff["sync"], 3),
+        "overlap_eff_pipeline": round(eff["pipeline"], 3),
+        "trace_offset_us": round(off1, 1),
+        "trace_flows": report["flows"],
+    }
+
+
 def main():
     do_compress = "--compress" in sys.argv
     do_health = "--health" in sys.argv
+    do_profile = "--profile" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -261,6 +412,12 @@ def main():
         EX.metrics_end()           # release the sink for the per-rank legs
         health_out = health_legs(n, tmp)
 
+    # -- comm-profiler gate (--profile / make profile-smoke) ------------
+    profile_out = None
+    if do_profile:
+        EX.metrics_end()           # release the sink for the probe legs
+        profile_out = profile_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -289,6 +446,8 @@ def main():
         out["compress"] = comp_out
     if health_out:
         out["health"] = health_out
+    if profile_out:
+        out["profile"] = profile_out
     print(json.dumps(out))
 
 

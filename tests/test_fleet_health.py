@@ -15,6 +15,7 @@ Everything here is host-side (stdlib + numpy): no JAX, no mesh.
 
 import json
 import math
+import os
 
 import numpy as np
 import pytest
@@ -739,3 +740,136 @@ def test_render_dashboard_marks_dead_ranks(tmp_path):
     frame = MON.render_dashboard(view, report)
     assert "degraded/dead ranks: 2" in frame
     assert "[CRIT] dead_rank:" in frame
+
+
+# ---------------------------------------------------------------------------
+# PR 8: overlap_collapse rule, edge records, verdict-trail rotation
+# ---------------------------------------------------------------------------
+
+def test_overlap_collapse_fires_on_degenerate_pipeline(tmp_path):
+    """Efficiency measured trending to ~0 -> the pipeline degenerated to
+    synchronous: warn on exactly that rank."""
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(12), 0,
+                        overlap_efficiency=lambda t: max(0.0, 0.8 - 0.1 * t)),
+        1: make_records(range(12), 1, overlap_efficiency=0.8),
+    })
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    vs = report.by_rule("overlap_collapse")
+    assert [v.rank for v in vs] == [0]
+    assert vs[0].severity == "warn"
+    assert vs[0].value < 0.2 and vs[0].threshold == 0.2
+    assert not report.ok
+
+
+def test_overlap_collapse_silent_on_healthy_and_unprobed(tmp_path):
+    """A healthy pipeline (high efficiency) and a run that never probes
+    (no field at all — the clean reference) both stay silent."""
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(12), 0, overlap_efficiency=0.7),
+        1: make_records(range(12), 1),               # never probed
+    })
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    assert report.by_rule("overlap_collapse") == []
+    assert report.ok
+
+
+def test_overlap_collapse_needs_two_samples(tmp_path):
+    """One cold probe reading low is not a trend."""
+    recs = make_records(range(12), 0)
+    recs[-1]["overlap_efficiency"] = 0.01
+    prefix = write_fleet(tmp_path, {0: recs})
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    assert report.by_rule("overlap_collapse") == []
+    # ...but two low samples in the window do fire
+    recs[-2]["overlap_efficiency"] = 0.05
+    prefix = write_fleet(tmp_path, {0: recs}, name="two_")
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    assert len(report.by_rule("overlap_collapse")) == 1
+
+
+def test_overlap_collapse_ignores_single_noisy_sample(tmp_path):
+    """The efficiency measurement subtracts two near-equal wall times —
+    one glitchy low reading among healthy ones must not fire (the rule
+    needs the LAST overlap_samples readings ALL below the floor)."""
+    recs = make_records(range(12), 0, overlap_efficiency=0.8)
+    recs[-1]["overlap_efficiency"] = 0.05           # lone glitch
+    prefix = write_fleet(tmp_path, {0: recs})
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    assert report.by_rule("overlap_collapse") == []
+
+
+def test_overlap_collapse_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_HEALTH_OVERLAP_MIN", "0.9")
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(12), 0, overlap_efficiency=0.7)})
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig.from_env())
+    vs = report.by_rule("overlap_collapse")
+    assert len(vs) == 1 and vs[0].threshold == 0.9
+
+
+def test_latest_edges_returns_newest_record(tmp_path):
+    entry = {"src": 0, "dst": 1, "bytes": 4096, "latency_us": 10.0,
+             "gbps": 1.0}
+    old = dict(entry, latency_us=99.0)
+    r0 = make_records(range(5), 0)
+    r0[1]["edges"] = [old]
+    r0[4]["edges"] = [entry]
+    prefix = write_fleet(tmp_path, {0: r0, 1: make_records(range(5), 1)})
+    got = AG.load_fleet(prefix).latest_edges()
+    assert got["step"] == 4 and got["entries"] == [entry]
+    # no probe anywhere -> None
+    assert AG.load_fleet(write_fleet(
+        tmp_path, {0: make_records(range(3), 0)}, name="no_")
+    ).latest_edges() is None
+
+
+def test_virtual_explode_leaves_edges_record_whole(tmp_path):
+    """An `edges` list whose length happens to equal the fleet width
+    must NOT be split into per-rank fragments by the virtual-mesh
+    explode — only numeric lists explode."""
+    n = 4
+    recs = []
+    for t in range(6):
+        recs.append({"step": t, "t_us": (t + 1) * 1000, "rank": 0,
+                     "consensus_dist": [0.5 * (0.7 ** t)] * n,
+                     "param_norm": [10.0] * n})
+    edge_list = [{"src": i, "dst": (i + 1) % n, "bytes": 4096,
+                  "latency_us": 10.0 + i, "gbps": 1.0}
+                 for i in range(n)]                  # len == width!
+    recs[5]["edges"] = edge_list
+    prefix = write_fleet(tmp_path, {0: recs}, name="vm_")
+    view = AG.load_fleet(prefix)
+    assert len(view.ranks) == n                      # exploded fleet
+    got = view.latest_edges()
+    assert got["entries"] == edge_list               # record intact
+
+
+def test_write_verdicts_rotates_at_size_cap(tmp_path, monkeypatch):
+    from bluefog_tpu.observability import export as EX
+    monkeypatch.setenv(EX.MAX_MB_ENV, str(400 / (1 << 20)))
+    monkeypatch.setenv(EX.KEEP_ENV, "2")
+    prefix = healthy_fleet(tmp_path)
+    report = H.evaluate(AG.load_fleet(prefix), H.HealthConfig())
+    path = str(tmp_path / "verdicts.jsonl")
+    for _ in range(12):
+        H.write_verdicts(report, path)
+    assert os.path.getsize(path) <= 800              # bounded
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")
+    # every surviving line still parses (the trail stays machine-readable)
+    for p in (path, path + ".1"):
+        with open(p) as f:
+            for line in f:
+                json.loads(line)
+
+
+def test_monitor_report_spreads_overlap_efficiency(tmp_path):
+    prefix = write_fleet(tmp_path, {
+        0: make_records(range(10), 0, overlap_efficiency=0.9),
+        1: make_records(range(10), 1, overlap_efficiency=0.5),
+    })
+    _, _, out = MON.build_report(prefix)
+    sp = out["spread"]["overlap_efficiency"]
+    assert sp["n"] == 2 and sp["min"] == 0.5 and sp["max"] == 0.9
+    assert out["edges"] is None

@@ -998,3 +998,150 @@ def test_run_steps_loop_exports_series(bf_ctx, tmp_path):
                for r, l in zip(records, losses))
     assert all("compute" in r["phases"] for r in records)
     assert all(len(r["consensus_dist"]) == N for r in records)
+
+
+# ---------------------------------------------------------------------------
+# PR 8: schema gate for the profiler fields + unknown-field tolerance
+# ---------------------------------------------------------------------------
+
+def _line(p, **fields):
+    rec = {"step": 0, "t_us": 1, "rank": 0}
+    rec.update(fields)
+    p.write_text(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def test_validate_jsonl_accepts_profiler_fields(tmp_path):
+    p = tmp_path / "ok.jsonl"
+    records = EX.validate_jsonl(_line(
+        p, step_wall_us=1200, overlap_efficiency=0.83,
+        phases={"compute": 0.01, "export": 0.002},
+        edges=[{"src": 0, "dst": 1, "bytes": 4096, "latency_us": 11.5,
+                "gbps": 0.4, "rounds": 3}]))
+    assert records[0]["edges"][0]["latency_us"] == 11.5
+
+
+def test_validate_jsonl_tolerates_unknown_fields(tmp_path):
+    """Forward compatibility is part of the contract: an old validator
+    reading a NEWER writer's series (unknown scalars, lists, and nested
+    objects) must pass — only documented fields are shape-checked."""
+    p = tmp_path / "fw.jsonl"
+    records = EX.validate_jsonl(_line(
+        p, future_scalar=3.5, future_list=[1, 2],
+        future_obj={"anything": {"nested": "fine"}},
+        future_str="label"))
+    assert records[0]["future_obj"]["anything"]["nested"] == "fine"
+
+
+def test_validate_jsonl_rejects_malformed_profiler_fields(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    with pytest.raises(ValueError, match="phases"):
+        EX.validate_jsonl(_line(p, phases=[1, 2]))
+    with pytest.raises(ValueError, match="not numeric"):
+        EX.validate_jsonl(_line(p, phases={"compute": "fast"}))
+    with pytest.raises(ValueError, match="step_wall_us"):
+        EX.validate_jsonl(_line(p, step_wall_us="soon"))
+    with pytest.raises(ValueError, match="non-finite"):
+        EX.validate_jsonl(_line(p, step_wall_us=float("nan")))
+    with pytest.raises(ValueError, match="overlap_efficiency"):
+        EX.validate_jsonl(_line(p, overlap_efficiency=[0.5]))
+    with pytest.raises(ValueError, match="edges"):
+        EX.validate_jsonl(_line(p, edges={"src": 0}))
+    with pytest.raises(ValueError, match="missing keys"):
+        EX.validate_jsonl(_line(p, edges=[{"src": 0, "dst": 1}]))
+    with pytest.raises(ValueError, match="non-finite"):
+        EX.validate_jsonl(_line(p, edges=[
+            {"src": 0, "dst": 1, "bytes": 1, "latency_us": float("inf"),
+             "gbps": 1.0}]))
+
+
+# ---------------------------------------------------------------------------
+# PR 8: size-based JSONL rotation (BLUEFOG_METRICS_MAX_MB)
+# ---------------------------------------------------------------------------
+
+def test_jsonl_rotation_bounds_file_and_keeps_k(tmp_path, monkeypatch):
+    """Long fleet runs must not fill the disk: the sink rotates at the
+    size cap, keeps the last K rotated files, and the LIVE path always
+    stays the newest records."""
+    monkeypatch.setenv(EX.MAX_MB_ENV, str(300 / (1 << 20)))   # ~300 bytes
+    monkeypatch.setenv(EX.KEEP_ENV, "2")
+    path = EX.metrics_start(str(tmp_path / "rot_"), rank=0)
+    for t in range(40):
+        EX.log_step(t, {"consensus_dist": 0.5}, counters=False)
+    EX.metrics_end()
+    assert os.path.getsize(path) <= 600           # bounded, not 40 lines
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")        # oldest dropped
+    # rotated files are invisible to fleet discovery (no .jsonl suffix)
+    from bluefog_tpu.observability import aggregate as AG
+    assert list(AG.discover_series(str(tmp_path / "rot_"))) == [0]
+    # the live file still validates and ends at the newest step
+    records = EX.validate_jsonl(path)
+    assert records and records[-1]["step"] == 39
+
+
+def test_tail_cache_follows_rotation(tmp_path, monkeypatch):
+    """A live bfmonitor holding a TailCache across a rotation sees the
+    fresh file as a restarted writer (offset reset), never garbage."""
+    from bluefog_tpu.observability import aggregate as AG
+    monkeypatch.setenv(EX.MAX_MB_ENV, str(300 / (1 << 20)))
+    path = EX.metrics_start(str(tmp_path / "live_"), rank=0)
+    cache = AG.TailCache()
+    for t in range(3):
+        EX.log_step(t, {"consensus_dist": 0.5}, counters=False)
+    view = AG.load_fleet(str(tmp_path / "live_"), cache=cache)
+    assert view.rank_last_step(0) == 2
+    for t in range(3, 30):                        # forces >=1 rotation
+        EX.log_step(t, {"consensus_dist": 0.5}, counters=False)
+    EX.metrics_end()
+    view = AG.load_fleet(str(tmp_path / "live_"), cache=cache)
+    assert view.rank_last_step(0) == 29
+    assert not any(g.kind == "parse_error" for g in view.gaps)
+
+
+def test_rotate_file_shift_chain(tmp_path):
+    p = str(tmp_path / "f.jsonl")
+    for gen in ("one", "two", "three"):
+        with open(p, "w") as f:
+            f.write(gen)
+        EX.rotate_file(p, keep=2)
+    assert open(p + ".1").read() == "three"
+    assert open(p + ".2").read() == "two"         # "one" aged out
+    assert not os.path.exists(p)
+
+
+# ---------------------------------------------------------------------------
+# PR 8: staged top-level fields (phases.stage_field)
+# ---------------------------------------------------------------------------
+
+def test_stage_field_drains_into_next_record_only(tmp_path):
+    from bluefog_tpu.observability import phases as PH
+    path = EX.metrics_start(str(tmp_path / "sf_"), rank=0)
+    PH.stage_field("overlap_efficiency", 0.75)
+    EX.log_step(0)
+    EX.log_step(1)
+    EX.metrics_end()
+    records = EX.validate_jsonl(path)
+    assert records[0]["overlap_efficiency"] == 0.75
+    assert "overlap_efficiency" not in records[1]
+
+
+def test_stage_field_inactive_without_profiling(tmp_path):
+    from bluefog_tpu.observability import phases as PH
+    PH.stage_field("overlap_efficiency", 0.5)     # nothing active: no-op
+    path = EX.metrics_start(str(tmp_path / "si_"), rank=0)
+    EX.log_step(0)
+    EX.metrics_end()
+    assert "overlap_efficiency" not in EX.validate_jsonl(path)[0]
+
+
+def test_metrics_start_discards_stale_staged_fields(tmp_path):
+    from bluefog_tpu.observability import phases as PH
+    EX.metrics_start(str(tmp_path / "sa_"), rank=0)
+    PH.stage_field("overlap_efficiency", 0.9)     # staged, never logged
+    EX.metrics_end()
+    path = EX.metrics_start(str(tmp_path / "sb_"), rank=0)
+    EX.log_step(0)
+    EX.metrics_end()
+    assert "overlap_efficiency" not in EX.validate_jsonl(path)[0]

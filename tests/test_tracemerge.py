@@ -1,0 +1,235 @@
+"""Fleet trace merger (PR 8): clock alignment, flow pairing, validation.
+
+Golden-merge acceptance (ISSUE 8 satellite): two synthetic rank trace
+files with a KNOWN injected clock skew merge into one trace whose
+per-rank offset recovers the skew exactly (the synthetic spans are
+deterministic), whose gossip flow events pair send/recv sides per round
+and edge, and whose per-row timestamps stay monotonic.
+
+Pure host-side stdlib: no JAX, no mesh.
+"""
+
+import json
+
+import pytest
+
+from bluefog_tpu.observability import tracemerge as TM
+
+
+# ---------------------------------------------------------------------------
+# synthetic rank traces
+# ---------------------------------------------------------------------------
+
+def rank_events(rank, *, skew_us=0.0, rounds=5, period_us=2000,
+                dur_us=300, jitter=None):
+    """One rank's trace: thread metadata + `round k` gossip spans on a
+    private clock shifted by ``skew_us`` (positive = this rank's clock
+    reads LATER than the reference's for the same instant)."""
+    evs = [{"name": "process_name", "ph": "M", "pid": rank,
+            "args": {"name": f"proc {rank}"}},
+           {"name": "thread_name", "ph": "M", "pid": rank, "tid": 1,
+            "args": {"name": "gossip"}}]
+    for k in range(rounds):
+        ts = skew_us + k * period_us + (jitter(k) if jitter else 0.0)
+        evs.append({"name": f"round {k}", "cat": "bluefog", "ph": "X",
+                    "ts": ts, "dur": dur_us, "pid": rank, "tid": 1})
+    return evs
+
+
+def write_rank(tmp_path, rank, events, prefix="trace_"):
+    path = str(tmp_path / f"{prefix}{rank}.json")
+    with open(path, "w") as f:
+        json.dump(events, f)
+    return path
+
+
+def two_rank_fleet(tmp_path, skew_us=7777.0, rounds=5, jitter=None):
+    p0 = write_rank(tmp_path, 0, rank_events(0, rounds=rounds))
+    p1 = write_rank(tmp_path, 1, rank_events(1, skew_us=skew_us,
+                                             rounds=rounds, jitter=jitter))
+    return {0: p0, 1: p1}
+
+
+# ---------------------------------------------------------------------------
+# golden merge: skew recovery, flows, monotonicity
+# ---------------------------------------------------------------------------
+
+def test_offset_recovers_injected_skew_exactly(tmp_path):
+    paths = two_rank_fleet(tmp_path, skew_us=7777.0)
+    report = TM.merge_traces(paths, edges=[(0, 1)])
+    # rank 1's clock reads 7777 µs late -> subtract it to align
+    assert report["offsets_us"]["1"] == pytest.approx(-7777.0)
+    assert report["offsets_us"]["0"] == 0.0
+    assert report["sync_matched"]["1"] == 5
+
+
+def test_offset_median_survives_straggling_rounds(tmp_path):
+    """A few rounds where one rank genuinely lagged must not bend the
+    clock estimate: the median ignores them."""
+    jitter = lambda k: 50000.0 if k in (1, 3) else 0.0
+    paths = two_rank_fleet(tmp_path, skew_us=1000.0, rounds=9,
+                           jitter=jitter)
+    report = TM.merge_traces(paths)
+    assert report["offsets_us"]["1"] == pytest.approx(-1000.0)
+
+
+def test_merged_rows_aligned_and_monotonic(tmp_path):
+    paths = two_rank_fleet(tmp_path, skew_us=7777.0)
+    out_path = str(tmp_path / "merged.json")
+    report = TM.merge_traces(paths, edges=[(0, 1)], out_path=out_path)
+    events = report["events"]
+    assert TM.validate_merged(events) == []
+    # post-alignment, round k END matches across ranks (golden trace)
+    spans = {rank: TM.sync_spans([e for e in events
+                                  if e.get("pid") == rank])
+             for rank in (0, 1)}
+    for k in range(5):
+        e0, e1 = spans[0][f"round {k}"], spans[1][f"round {k}"]
+        assert e0["ts"] + e0["dur"] == pytest.approx(e1["ts"] + e1["dur"])
+    # the merged file on disk parses and matches
+    with open(out_path) as f:
+        assert len(json.load(f)) == len(events)
+
+
+def test_flow_events_pair_send_and_recv_sides(tmp_path):
+    paths = two_rank_fleet(tmp_path, skew_us=500.0)
+    report = TM.merge_traces(paths, edges=[(0, 1), (1, 0)])
+    events = report["events"]
+    starts = [e for e in events if e.get("ph") == "s"]
+    ends = [e for e in events if e.get("ph") == "f"]
+    assert report["flows"] == 10          # 5 rounds x 2 directed edges
+    assert len(starts) == len(ends) == 10
+    by_id = {e["id"]: e for e in starts}
+    for e in ends:
+        s = by_id[e["id"]]
+        assert s["name"] == e["name"]
+        assert {s["pid"], e["pid"]} == {0, 1}
+        assert e.get("bp") == "e"
+    # unknown edges (ranks not present) are skipped, not fabricated
+    report = TM.merge_traces(paths, edges=[(0, 9)])
+    assert report["flows"] == 0
+
+
+def test_process_rows_renamed_and_sorted(tmp_path):
+    paths = two_rank_fleet(tmp_path)
+    events = TM.merge_traces(paths)["events"]
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    # the merger's canonical names win over the writers' ("proc N")
+    assert names == {0: "rank 0", 1: "rank 1"}
+    sorts = {e["pid"]: e["args"]["sort_index"] for e in events
+             if e.get("name") == "process_sort_index"}
+    assert sorts == {0: 0, 1: 1}
+    assert {e.get("pid") for e in events} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# robustness
+# ---------------------------------------------------------------------------
+
+def test_load_trace_repairs_unclosed_array(tmp_path):
+    """A writer killed mid-run leaves the array unclosed — the merge
+    exists to debug such runs, so the loader repairs rather than
+    refuses."""
+    events = rank_events(0, rounds=2)
+    text = json.dumps(events)
+    cut = text.rstrip().rstrip("]").rstrip().rstrip(",")
+    path = tmp_path / "cut_0.json"
+    path.write_text(cut + ",")
+    loaded = TM.load_trace(str(path))
+    assert len(loaded) == len(events)
+    (tmp_path / "garbage.json").write_text("not json at all {{{")
+    with pytest.raises(ValueError):
+        TM.load_trace(str(tmp_path / "garbage.json"))
+
+
+def test_load_trace_drops_partial_tail_event(tmp_path):
+    """A rank SIGKILLed mid-flush leaves a PARTIAL event at EOF (not
+    just a missing bracket): the loader drops back to the last complete
+    event instead of refusing the whole file."""
+    events = rank_events(0, rounds=3)
+    text = json.dumps(events)
+    # cut inside the final event's body — past its opening brace, before
+    # its closing one
+    last_open = text.rindex('{"')
+    path = tmp_path / "part_0.json"
+    path.write_text(text[:last_open + 12])
+    loaded = TM.load_trace(str(path))
+    assert 0 < len(loaded) < len(events)
+    assert loaded == events[:len(loaded)]
+
+
+def test_sync_spans_first_occurrence_wins():
+    evs = [{"name": "round 0", "ph": "X", "ts": 100, "dur": 10},
+           {"name": "round 0", "ph": "X", "ts": 9999, "dur": 10},
+           {"name": "round 1", "ph": "B", "ts": 50}]
+    spans = TM.sync_spans(evs)
+    assert spans["round 0"]["ts"] == 100      # restart duplicate ignored
+    assert "round 1" not in spans             # only complete spans count
+
+
+def test_no_shared_rounds_means_offset_zero(tmp_path):
+    p0 = write_rank(tmp_path, 0, rank_events(0, rounds=3))
+    bare = [e for e in rank_events(1, rounds=3)
+            if not str(e.get("name", "")).startswith("round")]
+    p1 = write_rank(tmp_path, 1, bare)
+    report = TM.merge_traces({0: p0, 1: p1})
+    assert report["offsets_us"]["1"] == 0.0
+    assert report["sync_matched"]["1"] == 0
+
+
+def test_validate_merged_flags_unpaired_flow_and_backwards_row():
+    good = [{"name": "a", "ph": "X", "ts": 10, "dur": 5, "pid": 0,
+             "tid": 1},
+            {"name": "b", "ph": "X", "ts": 20, "dur": 5, "pid": 0,
+             "tid": 1}]
+    assert TM.validate_merged(good) == []
+    bad = good + [{"name": "c", "ph": "X", "ts": 1, "dur": 5, "pid": 0,
+                   "tid": 1},
+                  {"ph": "s", "id": 42, "ts": 10, "pid": 0, "tid": 1}]
+    problems = TM.validate_merged(bad)
+    assert any("precedes" in p for p in problems)
+    assert any("flow 42" in p for p in problems)
+
+
+def test_discover_traces(tmp_path):
+    for r in (0, 1, 11):
+        write_rank(tmp_path, r, rank_events(r))
+    (tmp_path / "trace_0.json.1").write_text("[]")     # rotated: ignored
+    found = TM.discover_traces(str(tmp_path / "trace_"))
+    assert sorted(found) == [0, 1, 11]
+
+
+def test_cli_merges_prefix_and_reports(tmp_path, capsys):
+    two_rank_fleet(tmp_path, skew_us=300.0)
+    out_path = str(tmp_path / "merged.json")
+    rc = TM.main([str(tmp_path / "trace_"), "-o", out_path,
+                  "--edges", "0-1"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["ranks"] == [0, 1]
+    assert report["offsets_us"]["1"] == pytest.approx(-300.0)
+    assert report["flows"] == 5 and report["problems"] == []
+    with open(out_path) as f:
+        assert TM.validate_merged(json.load(f)) == []
+
+
+def test_cli_edge_matrix_supplies_flow_edges(tmp_path, capsys):
+    two_rank_fleet(tmp_path)
+    artifact = tmp_path / "edges.json"
+    artifact.write_text(json.dumps({
+        "n": 2, "entries": [
+            {"src": 0, "dst": 1, "bytes": 4096, "latency_us": 10.0,
+             "gbps": 1.0}]}))
+    rc = TM.main([str(tmp_path / "trace_"), "-o",
+                  str(tmp_path / "m.json"), "--edge-matrix",
+                  str(artifact)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["flows"] == 5
+
+
+def test_cli_missing_prefix_fails(tmp_path, capsys):
+    rc = TM.main([str(tmp_path / "nope_"), "-o",
+                  str(tmp_path / "m.json")])
+    assert rc == 1
