@@ -20,7 +20,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
-        profile-smoke
+        profile-smoke control-smoke
 
 test:
 	$(PYTEST) tests/
@@ -157,6 +157,17 @@ health-smoke:
 # skew must recover the offset and validate (bftrace).
 profile-smoke:
 	python scripts/metrics_smoke.py --profile
+
+# Closed-loop controller smoke (docs/control.md): a real training loop
+# over a switchable schedule with a DEAD static exchange and a slow edge
+# injected via BLUEFOG_EDGE_PROBE_DELAY_US must make the controller
+# switch to the one-peer dynamic schedule (consensus_stall), contract
+# consensus, and re-arm onto the cost-reweighted mode; the gamma >> omega
+# seeded run must get its gamma backoff — both landed in the decision
+# JSONL and `bfmonitor --once --json`, with zero step recompiles, and
+# `bfctl replay` reproducing the exact trail from the recorded telemetry.
+control-smoke:
+	python scripts/metrics_smoke.py --control
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

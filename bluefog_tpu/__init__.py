@@ -82,6 +82,7 @@ from .ops.api import (
 )
 
 from . import compress
+from . import control
 from . import resilience
 
 from .ops.ring_attention import (

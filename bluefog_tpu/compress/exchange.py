@@ -284,6 +284,15 @@ def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
             xhat_new = xhat + d_own
             shat_new = shat + acc
             gamma = jnp.asarray(cfg.gamma, buf.dtype)
+            # the closed-loop controller's γ knob (control/actuate.py):
+            # a traced scalar riding the carried state, injected by the
+            # optimizer wrapper when built with control=True — backoff /
+            # re-arm never recompiles.  Absent key (the default) leaves
+            # the math — and the traced program — exactly as before;
+            # scale 1.0 multiplies bit-exactly.
+            scale = state.get("gamma_scale")
+            if scale is not None:
+                gamma = gamma * jnp.asarray(scale, buf.dtype)
             mixed.append(buf + gamma * (shat_new - xhat_new))
             new_parts.setdefault("xhat", []).append(xhat_new)
             new_parts.setdefault("shat", []).append(shat_new)
@@ -331,6 +340,10 @@ def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
         new_state = None
     else:
         new_state = {k: tuple(v) for k, v in new_parts.items()}
+        if "gamma_scale" in state:
+            # carried through unchanged so the state STRUCTURE is stable
+            # across steps (the wrapper overwrites the value host-side)
+            new_state["gamma_scale"] = state["gamma_scale"]
     diag = {"residual_norm": jnp.sqrt(res_norm2),
             "wire_bytes": float(wire_bytes),
             "ratio": float(raw_bytes) / float(max(wire_bytes, 1))}

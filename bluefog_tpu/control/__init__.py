@@ -1,0 +1,51 @@
+"""Closed-loop adaptive control: telemetry in, runtime knob decisions out.
+
+PRs 4-8 built the sensing stack (in-graph telemetry, the fleet health
+engine, the measured edge-cost matrix and overlap efficiency); this
+package closes the loop — a host-side feedback controller that turns
+those signals into runtime topology/schedule/compression decisions, and
+actuates them ONLY through channels that are traced data (the step
+index selecting a :class:`SwitchableSchedule` mode, the CHOCO γ scale
+riding the compression state), so adaptation never recompiles the step.
+
+Layers (docs/control.md):
+
+* :mod:`~.policy`   — the deterministic decision engine: health
+  verdicts + residual margins + measured link costs -> ``Decision``
+  records, with hysteresis and per-knob cooldowns.
+* :mod:`~.actuate`  — :class:`SwitchableSchedule` (pre-compiled mode
+  stack) and the :class:`Actuator` applying decisions to an optimizer.
+* :mod:`~.controller` — the :class:`Controller` facade wiring the
+  sensing loop into the optimizer's step hook and appending the
+  decision JSONL trail ``bfmonitor`` renders and ``bfctl replay``
+  reproduces.
+
+Modes (``BLUEFOG_CONTROL``): ``off`` (default — the controller is
+inert), ``shadow`` (full sensing + policy, decisions logged with
+``applied: false``, nothing actuated — the audit trail to trust before
+enabling), ``on`` (actuate).
+"""
+
+from .policy import (
+    CONTROL_ENV,
+    ControlConfig,
+    Decision,
+    PolicyEngine,
+    control_mode,
+    read_decisions,
+    slow_edge,
+)
+from .actuate import (
+    Actuator,
+    SwitchableSchedule,
+    build_switchable_schedule,
+    reweight_matrix_by_cost,
+)
+from .controller import Controller, DECISIONS_SUFFIX
+
+__all__ = [
+    "CONTROL_ENV", "ControlConfig", "Decision", "PolicyEngine",
+    "control_mode", "read_decisions", "slow_edge",
+    "Actuator", "SwitchableSchedule", "build_switchable_schedule",
+    "reweight_matrix_by_cost", "Controller", "DECISIONS_SUFFIX",
+]

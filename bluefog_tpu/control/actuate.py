@@ -1,0 +1,254 @@
+"""Actuation layer: apply controller decisions through recompile-free
+channels only.
+
+The whole design constraint (ROADMAP "closed-loop adaptive controller")
+is that adaptation must never churn the step cache: every actuated knob
+is either TRACED DATA the compiled program already consumes, or a value
+already folded into ``optim/_plumbing.step_cache_key`` whose programs
+were built up front.  Two channels exist:
+
+* **Schedule mode** — a :class:`SwitchableSchedule` stacks the candidate
+  mixing schedules (static matrix, one-peer dynamic exponential,
+  cost-reweighted static) into ONE compiled
+  :class:`~..parallel.schedule.DynamicSchedule` whose period covers
+  every mode; the mode is selected by remapping the step index the
+  jitted program receives (``virtual_step``) — the step index is traced
+  data, so switching modes is a pure host-side integer change.  Zero
+  recompiles, asserted by ``tests/test_control.py``.
+* **CHOCO γ scale** — a float32 scalar riding the carried compression
+  state (``compress/exchange.py`` reads ``state["gamma_scale"]``), so
+  backing off / re-arming the consensus stepsize is a traced-value
+  change.  The optimizer wrapper injects the current value each step
+  when built with ``control=True`` (``BLUEFOG_CONTROL=on``).
+
+The :class:`Actuator` holds the live knob values and implements the
+optimizer's controller-hook protocol (``graph_step`` / ``after_step``),
+so it can be attached directly for tests; the full sensing loop lives in
+:class:`~.controller.Controller`.
+"""
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel import dynamic as _dyn
+from ..parallel.schedule import (
+    DynamicSchedule,
+    compile_dynamic_matrices,
+)
+from . import policy as _policy
+
+__all__ = [
+    "SwitchableSchedule", "build_switchable_schedule",
+    "reweight_matrix_by_cost", "Actuator",
+]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class SwitchableSchedule:
+    """Several mixing schedules compiled into one fixed-shape program.
+
+    ``sched`` is a plain :class:`DynamicSchedule` of period
+    ``n_modes * base_period`` whose weight tables hold mode m's step-t
+    matrix at row ``m * base_period + t``; the offset superset is the
+    union over modes, so every mode runs through the SAME compiled
+    collective schedule (absent edges simply carry zero weight).  Pass
+    ``sched`` to the optimizer (``sched=sw.sched``) and feed it
+    ``virtual_step(step, mode)`` as the step index — the controller's
+    mode knob is then pure traced data."""
+
+    sched: DynamicSchedule
+    mode_names: Tuple[str, ...]
+    base_period: int
+
+    def mode_index(self, name: str) -> int:
+        try:
+            return self.mode_names.index(name)
+        except ValueError:
+            raise ValueError(
+                f"unknown schedule mode {name!r} "
+                f"(have {list(self.mode_names)})") from None
+
+    def virtual_step(self, step: int, mode: int) -> int:
+        """Host-side step remap selecting ``mode``'s table rows: the
+        jitted program computes ``vstep % period`` with ``period =
+        n_modes * base_period``, so row ``mode * T + step % T`` is
+        exactly mode's step-t matrix."""
+        return int(mode) * self.base_period + int(step) % self.base_period
+
+    def matrices_for(self, name: str) -> np.ndarray:
+        """Mode ``name``'s ``[T, N, N]`` matrix stack (reference/tests)."""
+        m = self.mode_index(name)
+        lo = m * self.base_period
+        return self.sched.matrices[lo:lo + self.base_period]
+
+
+def reweight_matrix_by_cost(W: np.ndarray, cost, alpha: float = 1.0
+                            ) -> np.ndarray:
+    """Reweight a column-stochastic mixing matrix by MEASURED edge costs
+    (arXiv:2309.13541: exchange schedules should follow the real link
+    model, not the nominal graph).
+
+    Each off-diagonal ``W[i, j]`` is scaled by ``(median_latency /
+    latency(i -> j)) ** alpha`` — slow edges lose mixing weight, fast
+    edges gain it — then every column is renormalized to sum to 1
+    (receiver j's average stays an average; column-stochasticity, the
+    mass-conservation invariant every compiled topology here satisfies,
+    is preserved exactly).  ``cost`` is an
+    :class:`~..observability.commprof.EdgeCostMatrix`."""
+    W = np.asarray(W, dtype=np.float64).copy()
+    n = W.shape[0]
+    lats = {}
+    for i in range(n):
+        for j in range(n):
+            if i != j and W[i, j] != 0:
+                lat = cost.latency_us(i, j)
+                if lat is not None and math.isfinite(lat) and lat > 0:
+                    lats[(i, j)] = lat
+    if not lats:
+        return W
+    med = sorted(lats.values())[len(lats) // 2]
+    if med <= 0:
+        return W
+    for (i, j), lat in lats.items():
+        W[i, j] *= (med / lat) ** alpha
+    col = W.sum(axis=0)
+    col[col == 0] = 1.0
+    return W / col[None, :]
+
+
+def _digraph_of(topo):
+    """The networkx digraph of a compiled topology (reconstructed from
+    the weight matrix when the topology was compiled from a raw W)."""
+    import networkx as nx
+    if topo.digraph is not None:
+        return topo.digraph
+    W = np.asarray(topo.weight_matrix)
+    g = nx.DiGraph()
+    g.add_nodes_from(range(W.shape[0]))
+    for s, d in zip(*np.nonzero(W)):
+        if s != d:
+            g.add_edge(int(s), int(d))
+    return g
+
+
+def build_switchable_schedule(topo=None, *,
+                              static_matrix: Optional[np.ndarray] = None,
+                              factory=None,
+                              period: Optional[int] = None,
+                              cost_matrix=None,
+                              cost_alpha: float = 1.0,
+                              max_period: int = 4096
+                              ) -> SwitchableSchedule:
+    """Compile the controller's schedule modes into one
+    :class:`SwitchableSchedule`.
+
+    Modes (in index order):
+
+    * ``"static"``  — ``static_matrix`` (default: ``topo``'s compiled
+      weight matrix) repeated every step;
+    * ``"dynamic"`` — the one-peer dynamic schedule from ``factory``
+      (default: ``GetDynamicOnePeerSendRecvRanks`` over ``topo``'s
+      digraph — the O(1)-degree rotation of arXiv:2110.13363);
+    * ``"cost"``    — ``static_matrix`` reweighted by the measured
+      ``cost_matrix`` (:func:`reweight_matrix_by_cost`); only present
+      when a matrix is supplied.  Callers must gate the matrix with
+      ``commprof.matrix_is_usable`` first — a synthetic or stale matrix
+      must not become a link model.
+
+    ``topo`` defaults to the current context's compiled topology."""
+    if topo is None:
+        from ..context import ctx
+        topo = ctx().compiled_topology
+    W = (np.asarray(static_matrix, np.float64) if static_matrix is not None
+         else np.asarray(topo.weight_matrix, np.float64))
+    n = W.shape[0]
+    if factory is None:
+        factory = _dyn.one_peer_factory(_digraph_of(topo))
+    if period is None:
+        period = _dyn.schedule_period(factory, n, max_period=max_period)
+    dyn_mats = _dyn.dynamic_mixing_matrices(factory, n, period)
+    stacks = [np.repeat(W[None], period, axis=0), dyn_mats]
+    names = ["static", "dynamic"]
+    if cost_matrix is not None:
+        cost_W = reweight_matrix_by_cost(W, cost_matrix, cost_alpha)
+        stacks.append(np.repeat(cost_W[None], period, axis=0))
+        names.append("cost")
+    sched = compile_dynamic_matrices(np.concatenate(stacks, axis=0))
+    return SwitchableSchedule(sched=sched, mode_names=tuple(names),
+                              base_period=period)
+
+
+class Actuator:
+    """Applies :class:`~.policy.Decision` records to one optimizer.
+
+    Implements the optimizer controller-hook protocol
+    (``graph_step``/``after_step``) so it can be attached directly
+    (``opt.attach_controller(actuator)``) — the compile-count test
+    drives interventions this way without the sensing loop.  In
+    ``shadow`` mode :meth:`apply` records but never moves a knob."""
+
+    def __init__(self, optimizer, *,
+                 schedule: Optional[SwitchableSchedule] = None,
+                 mode: Optional[str] = None,
+                 initial_mode: Optional[str] = None):
+        self.opt = optimizer
+        self.schedule = schedule
+        self.mode = _policy.control_mode(mode)
+        if schedule is not None:
+            name = initial_mode or schedule.mode_names[0]
+            self.sched_mode = schedule.mode_index(name)
+        else:
+            self.sched_mode = 0
+        cfg = getattr(optimizer, "compression", None)
+        self.gamma_knob = bool(cfg is not None and getattr(cfg, "choco",
+                                                          False))
+
+    # -- optimizer hook protocol --------------------------------------------
+
+    def graph_step(self, step: int) -> int:
+        if self.schedule is None:
+            return int(step)
+        return self.schedule.virtual_step(step, self.sched_mode)
+
+    def after_step(self, step: int) -> None:
+        """No sensing here — the Controller subclasses the loop."""
+
+    # -- knobs --------------------------------------------------------------
+
+    @property
+    def mode_name(self) -> Optional[str]:
+        if self.schedule is None:
+            return None
+        return self.schedule.mode_names[self.sched_mode]
+
+    @property
+    def gamma_scale(self) -> float:
+        knobs = getattr(self.opt, "control_knobs", None)
+        return float(knobs.get("gamma_scale", 1.0)) if knobs else 1.0
+
+    def available_modes(self) -> Tuple[str, ...]:
+        return self.schedule.mode_names if self.schedule else ()
+
+    def apply(self, decision: _policy.Decision) -> bool:
+        """Actuate one decision.  Returns True when a knob actually
+        moved (always False in shadow mode — the audit-trail contract)."""
+        if self.mode != "on":
+            return False
+        if decision.knob == "schedule" and self.schedule is not None:
+            self.sched_mode = self.schedule.mode_index(str(decision.value))
+            return True
+        if decision.knob == "gamma" and self.gamma_knob:
+            knobs = getattr(self.opt, "control_knobs", None)
+            # the optimizer must have the γ leaf PLUMBED (built with
+            # control=True): writing the knob of an unplumbed optimizer
+            # would log applied:true for an intervention the traced
+            # program never sees — the trail must stay truthful
+            if knobs is None or not getattr(self.opt, "_gamma_plumbed",
+                                            False):
+                return False
+            knobs["gamma_scale"] = float(decision.value)
+            return True
+        return False

@@ -1,0 +1,244 @@
+"""The closed-loop controller: sensing -> policy -> actuation per step.
+
+Attach one to an optimizer and the loop runs itself from inside the
+optimizer's step hook::
+
+    sw = control.build_switchable_schedule(cost_matrix=usable_matrix)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), sched=sw.sched, telemetry=True, control=True)
+    ctl = control.Controller(opt, schedule=sw, prefix="/tmp/series_")
+    ...
+    params, state, snap = opt.step(params, grads, state, t)  # hook fires
+    export.log_step(t, snap)
+
+Every ``cfg.every`` steps the controller loads the fleet view from the
+JSONL series the run is writing (``observability/aggregate.load_fleet``
+with a tail cache — only appended bytes are parsed), evaluates the
+health engine, feeds verdicts + the measured edge costs to the
+:class:`~.policy.PolicyEngine`, applies the resulting decisions through
+the :class:`~.actuate.Actuator` (``on`` mode only), and appends them to
+the decision JSONL (``<prefix>decisions.jsonl``) that ``bfmonitor``
+renders and ``bfctl replay`` reproduces.
+
+Sensing-artifact hygiene: an ``edges_artifact`` path is loaded ONCE and
+gated through ``commprof.matrix_is_usable`` — a matrix probed on a
+different backend (``platform`` mismatch) or written before this run
+started (stale mtime) is refused with a counter
+(``bf_control_refused_matrix_total``) instead of silently becoming a
+link model.  Edge records riding the telemetry JSONL carry their
+``edges_platform`` and are gated the same way.
+
+Because the hook runs INSIDE ``opt.step(t)`` — before the caller logs
+step t — an evaluation at step t sees records ``<= t-1``.  ``bfctl
+replay`` applies the same cutoff, which is what makes the live and
+replayed trails identical.
+"""
+
+import os
+import time
+from typing import Optional
+
+from ..observability import aggregate as AG
+from ..observability import health as H
+from ..observability import metrics as _metrics
+from . import actuate as _actuate
+from . import policy as _policy
+
+__all__ = ["Controller"]
+
+DECISIONS_SUFFIX = "decisions.jsonl"
+
+_MODE_GAUGE = {"off": 0.0, "shadow": 1.0, "on": 2.0}
+
+
+class Controller(_actuate.Actuator):
+    """Sensing + policy + actuation, attached to one optimizer."""
+
+    def __init__(self, optimizer, *,
+                 prefix: Optional[str] = None,
+                 schedule: Optional[_actuate.SwitchableSchedule] = None,
+                 config: Optional[_policy.ControlConfig] = None,
+                 mode: Optional[str] = None,
+                 initial_mode: Optional[str] = None,
+                 decisions_path: Optional[str] = None,
+                 expected_ranks: Optional[int] = None,
+                 edges_artifact: Optional[str] = None,
+                 health_config: Optional[H.HealthConfig] = None,
+                 attach: bool = True):
+        super().__init__(optimizer, schedule=schedule, mode=mode,
+                         initial_mode=initial_mode)
+        self.cfg = config or _policy.ControlConfig.from_env()
+        if prefix is None:
+            from ..observability import export as _export
+            path = _export.metrics_path()
+            if path is not None:
+                # strip the "<rank>.jsonl" tail of the open sink
+                import re
+                prefix = re.sub(r"\d+\.jsonl$", "", path)
+            else:
+                prefix = os.environ.get(_export.METRICS_ENV)
+        self.prefix = prefix
+        self.expected_ranks = expected_ranks
+        self.decisions_path = decisions_path or (
+            prefix + DECISIONS_SUFFIX if prefix else None)
+        self.health_cfg = health_config or H.HealthConfig.from_env()
+        if self.cfg.health_window:
+            self.health_cfg.window = self.cfg.health_window
+        self.engine = _policy.PolicyEngine(
+            self.cfg, modes=self.available_modes(),
+            initial_mode=self.mode_name, gamma=self.gamma_knob)
+        self._cache = AG.TailCache()
+        self._head = None               # built on the first decision
+        self._platform = None           # resolved lazily (needs jax)
+        self._artifact_entries = None
+        self._artifact_checked = False
+        self._edges_artifact = edges_artifact
+        self.decisions = []             # every Decision this run emitted
+        if attach and self.mode != "off":
+            optimizer.attach_controller(self)
+        self._mirror_gauges()
+
+    # -- sensing ------------------------------------------------------------
+
+    def _live_platform(self) -> Optional[str]:
+        if self._platform is None:
+            try:
+                import jax
+                self._platform = jax.default_backend()
+            except Exception:
+                self._platform = None
+        return self._platform
+
+    def _artifact(self):
+        """The edge-artifact entries, gated once through
+        ``matrix_is_usable`` (refusals counted, never retried — a stale
+        file does not become fresh mid-run)."""
+        if self._artifact_checked:
+            return self._artifact_entries
+        self._artifact_checked = True
+        if not self._edges_artifact:
+            return None
+        from ..observability import commprof as CPROF
+        try:
+            matrix = CPROF.EdgeCostMatrix.load(self._edges_artifact)
+        except (OSError, ValueError, KeyError) as e:
+            self._refuse_matrix(f"unreadable artifact: {e}")
+            return None
+        ok, why = CPROF.matrix_is_usable(
+            matrix, path=self._edges_artifact,
+            platform=self._live_platform())
+        if not ok:
+            self._refuse_matrix(why)
+            return None
+        self._artifact_entries = matrix.entries
+        return self._artifact_entries
+
+    def _refuse_matrix(self, why: str) -> None:
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_control_refused_matrix_total",
+                "edge-cost matrices the controller refused to consume "
+                "(foreign platform / stale mtime / unreadable)").inc()
+        import logging
+        logging.getLogger("bluefog").warning(
+            "controller refused edge matrix: %s", why)
+
+    def _edges(self, view) -> Optional[list]:
+        """Measured edge entries for the policy: the gated artifact
+        first, else the newest in-series record — gated on its recorded
+        ``edges_platform`` the same way."""
+        entries = self._artifact()
+        if entries is not None:
+            return entries
+        latest = view.latest_edges()
+        if not latest:
+            return None
+        platform = latest.get("platform")
+        live = self._live_platform()
+        if platform is not None and live is not None and platform != live:
+            self._refuse_matrix(
+                f"in-series edges probed on {platform!r}, live backend "
+                f"is {live!r}")
+            return None
+        return latest["entries"]
+
+    # -- the per-step hook ---------------------------------------------------
+
+    def after_step(self, step: int) -> None:
+        step = int(step)
+        if self.mode == "off" or self.prefix is None:
+            return
+        if step % self.cfg.every != self.cfg.every - 1:
+            return
+        view = AG.load_fleet(self.prefix,
+                             expected_ranks=self.expected_ranks,
+                             cache=self._cache)
+        report = H.evaluate(view, self.health_cfg)
+        self.evaluate_once(view, report, step)
+
+    def evaluate_once(self, view, report, step: int) -> list:
+        """One explicit policy pass (the hook's body; also the entry
+        point for tests feeding synthetic views/reports)."""
+        decisions = self.engine.evaluate(view, report, int(step),
+                                         edges=self._edges(view))
+        for d in decisions:
+            d.mode = self.mode
+            d.applied = self.apply(d)
+            self.decisions.append(d)
+            self._record(d)
+        if decisions:
+            self._mirror_gauges()
+        return decisions
+
+    # -- trail + gauges ------------------------------------------------------
+
+    def _trail_header(self) -> dict:
+        """The replayable ``control_config`` head record: engine
+        identity PLUS everything else the live evaluation depended on —
+        the full health config (a replay must not fall back to the
+        replaying machine's ``BLUEFOG_HEALTH_*`` env), the expected
+        fleet size, and the gated artifact entries when the controller
+        consumed an edges artifact (they never ride the telemetry
+        JSONL, so the trail itself must carry them)."""
+        if self._head is None:
+            import dataclasses
+            head = self.engine.describe()
+            head["every"] = self.cfg.every
+            head["platform"] = self._live_platform()
+            head["health"] = dataclasses.asdict(self.health_cfg)
+            head["expected_ranks"] = self.expected_ranks
+            if self._artifact_entries is not None:
+                head["artifact_entries"] = self._artifact_entries
+            self._head = head
+        return self._head
+
+    def _record(self, decision: _policy.Decision) -> None:
+        if self.decisions_path:
+            _policy.write_decision(self.decisions_path, decision,
+                                   header=self._trail_header())
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_control_decisions_total",
+                "controller decisions by knob and action").inc(
+                knob=decision.knob, action=decision.action)
+
+    def _mirror_gauges(self) -> None:
+        if not _metrics.enabled():
+            return
+        _metrics.gauge(
+            "bf_control_mode",
+            "controller gate (0 off, 1 shadow, 2 on)").set(
+            _MODE_GAUGE.get(self.mode, 0.0))
+        _metrics.gauge(
+            "bf_control_gamma_scale",
+            "current CHOCO gamma scale the controller holds "
+            "(1 = full rate)").set(self.engine.gamma_scale
+                                   if self.mode != "on"
+                                   else self.gamma_scale)
+        if self.schedule is not None:
+            _metrics.gauge(
+                "bf_control_sched_mode",
+                "current schedule mode index "
+                "(SwitchableSchedule.mode_names order)").set(
+                float(self.engine.mode_index_view()
+                      if self.mode != "on" else self.sched_mode))

@@ -1,0 +1,488 @@
+"""Closed-loop policy engine: health verdicts + measured link costs in,
+structured :class:`Decision` records out.
+
+This is the DECISION half of the ROADMAP's closed-loop adaptive
+controller.  It consumes exactly the sensing endpoints earlier PRs
+built — the fleet view (``observability/aggregate.load_fleet``), the
+health engine's :class:`~..observability.health.HealthReport`, and the
+comm profiler's measured :class:`~..observability.commprof.
+EdgeCostMatrix` — and emits decisions over two runtime knobs the
+actuation layer (``control/actuate.py``) can apply WITHOUT a recompile:
+
+* ``schedule`` — which mode of a pre-compiled
+  :class:`~.actuate.SwitchableSchedule` the exchange runs (static,
+  one-peer dynamic exponential, cost-reweighted).  One-peer dynamic
+  exponential graphs provably match static-graph convergence at O(1)
+  degree (arXiv:2110.13363), so ``consensus_stall`` maps to
+  ``switch -> dynamic``; exchange weights should follow the MEASURED
+  link costs of the actual topology (arXiv:2309.13541), so a measured
+  slow edge prefers the cost-reweighted mode once the fleet is healthy.
+* ``gamma`` — a multiplicative scale on the CHOCO consensus stepsize
+  (traced data riding the compression state, ``compress/exchange.py``).
+  ``residual_blowup`` / a rising ‖residual‖/‖param‖ margin is the
+  documented γ ≫ ω instability boundary (docs/compression.md
+  "γ stability"): back γ off BEFORE the divergence step; re-arm toward
+  full rate once consensus contracts again.
+
+Determinism is a hard contract: decisions are a pure function of
+(engine state, config, the recorded telemetry) — the live controller and
+``bfctl replay`` over the same JSONL series produce the SAME trail, and
+shadow vs on differ only in the ``mode``/``applied`` fields.  That is
+what makes a shadow-mode audit trail trustworthy before anyone enables
+actuation.
+
+Stability machinery:
+
+* **hysteresis** — backoff triggers at ``residual_high``; re-arm
+  requires the margin BELOW the distinct ``residual_low`` floor plus
+  ``rearm_after`` consecutive healthy evaluations, so the controller
+  never chatters across one boundary.
+* **per-knob cooldowns** — at most one decision per knob per
+  ``cooldown`` steps; a persisting verdict does not machine-gun
+  interventions.
+
+Pure host-side stdlib (+ the numpy already inside the fleet view):
+importing this module never touches JAX.
+"""
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CONTROL_ENV", "control_mode", "ControlConfig", "Decision",
+    "PolicyEngine", "slow_edge", "read_decisions", "write_decision",
+    "write_config_record", "DECISION_KEYS",
+]
+
+CONTROL_ENV = "BLUEFOG_CONTROL"
+
+_MODES = ("off", "shadow", "on")
+
+# every decision JSONL record carries at least these keys (the
+# export.validate_jsonl contract for ``kind == "decision"`` lines)
+DECISION_KEYS = ("step", "t_us", "knob", "action", "mode", "applied")
+
+
+def control_mode(value: Optional[str] = None) -> str:
+    """Resolve the controller gate: explicit argument wins, else
+    ``BLUEFOG_CONTROL`` (default ``off``).  ``shadow`` runs the full
+    sensing + policy loop and logs the decisions it WOULD take without
+    actuating anything; ``on`` actuates."""
+    if value is None:
+        value = os.environ.get(CONTROL_ENV, "off")
+    value = (value or "off").strip().lower()
+    if value in ("", "0", "false", "none"):
+        value = "off"
+    if value == "1":
+        value = "on"
+    if value not in _MODES:
+        raise ValueError(
+            f"bad {CONTROL_ENV} value {value!r} (want off|shadow|on)")
+    return value
+
+
+_ENV_PREFIX = "BLUEFOG_CONTROL_"
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return int(v) if v else default
+
+
+@dataclasses.dataclass
+class ControlConfig:
+    """Policy knobs (env defaults in parentheses; docs/control.md).
+
+    ``every``           steps between policy evaluations (8)
+    ``cooldown``        min steps between decisions PER KNOB (16)
+    ``health_window``   health-rule window override (unset = the health
+                        engine's own ``BLUEFOG_HEALTH_WINDOW``)
+    ``gamma_backoff``   multiplicative γ-scale cut per backoff (0.5)
+    ``gamma_floor``     γ-scale never drops below this (0.1)
+    ``gamma_rearm``     γ-scale recovery multiplier per re-arm (2.0)
+    ``residual_high``   backoff when the latest ‖residual‖/‖param‖
+                        margin exceeds this (0.5) AND failed to contract
+                        over the margin window — intervenes BEFORE the
+                        health engine's residual_blowup bound (1.0)
+    ``residual_low``    re-arm only when the margin is below this
+                        (0.1) — the hysteresis gap
+    ``margin_window``   steps of margin history per backoff check (8)
+    ``margin_contract`` the margin must have contracted below this
+                        fraction of its window-start value to count as
+                        healthy warmup (0.9 — the stall-ratio idiom:
+                        CHOCO's warmup legitimately runs margins near 1
+                        while x̂ catches up, but a HEALTHY warmup
+                        contracts; the γ ≫ ω run's margin plateaus)
+    ``rearm_after``     consecutive healthy evaluations before any
+                        re-arm (2)
+    ``edge_slow_factor`` a measured edge slower than factor x the
+                        median prefers the cost-reweighted mode (3.0)
+    """
+    every: int = 8
+    cooldown: int = 16
+    health_window: Optional[int] = None
+    gamma_backoff: float = 0.5
+    gamma_floor: float = 0.1
+    gamma_rearm: float = 2.0
+    residual_high: float = 0.5
+    residual_low: float = 0.1
+    margin_window: int = 8
+    margin_contract: float = 0.9
+    rearm_after: int = 2
+    edge_slow_factor: float = 3.0
+
+    @classmethod
+    def from_env(cls) -> "ControlConfig":
+        return cls(
+            every=_env_int("EVERY", 8),
+            cooldown=_env_int("COOLDOWN", 16),
+            health_window=(_env_int("HEALTH_WINDOW", 0) or None),
+            gamma_backoff=_env_float("GAMMA_BACKOFF", 0.5),
+            gamma_floor=_env_float("GAMMA_FLOOR", 0.1),
+            gamma_rearm=_env_float("GAMMA_REARM", 2.0),
+            residual_high=_env_float("RESIDUAL_HIGH", 0.5),
+            residual_low=_env_float("RESIDUAL_LOW", 0.1),
+            margin_window=_env_int("MARGIN_WINDOW", 8),
+            margin_contract=_env_float("MARGIN_CONTRACT", 0.9),
+            rearm_after=_env_int("REARM_AFTER", 2),
+            edge_slow_factor=_env_float("EDGE_SLOW_FACTOR", 3.0),
+        )
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Decision:
+    """One structured controller decision (the JSONL trail unit).
+
+    ``knob``: ``"schedule"`` or ``"gamma"``; ``action``: ``"switch"``,
+    ``"backoff"``, or ``"rearm"``.  ``value``/``prev`` carry the new and
+    previous knob values (mode NAME for schedule, γ-scale float for
+    gamma).  ``rule`` names the health verdict (or margin rule) that
+    triggered it; ``mode``/``applied`` record whether this run actuated
+    (``on``) or only would have (``shadow``)."""
+    step: int
+    knob: str
+    action: str
+    value: object
+    prev: object
+    rule: str
+    reason: str
+    mode: str = "shadow"
+    applied: bool = False
+
+    def asdict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["kind"] = "decision"
+        return d
+
+    def signature(self) -> Tuple:
+        """The replay-parity identity: everything EXCEPT mode/applied
+        (and wall time) — ``bfctl replay --expect`` compares these."""
+        return (self.step, self.knob, self.action, self.value, self.rule)
+
+
+def slow_edge(entries: Sequence[dict],
+              factor: float) -> Optional[Tuple[int, int, float]]:
+    """The slowest measured edge when it exceeds ``factor`` x the median
+    one-way latency (largest-payload entries only — the bandwidth-regime
+    numbers), else None.  Returns ``(src, dst, ratio)``."""
+    best: Dict[Tuple[int, int], dict] = {}
+    for e in entries or ():
+        key = (int(e["src"]), int(e["dst"]))
+        if key not in best or e["bytes"] > best[key]["bytes"]:
+            best[key] = e
+    lats = sorted(float(e["latency_us"]) for e in best.values())
+    if len(lats) < 2:
+        return None
+    med = lats[len(lats) // 2]
+    if med <= 0:
+        return None
+    (src, dst), worst = max(best.items(),
+                            key=lambda kv: kv[1]["latency_us"])
+    ratio = float(worst["latency_us"]) / med
+    if ratio > factor:
+        return src, dst, ratio
+    return None
+
+
+# health rules that map to the schedule knob (arXiv:2110.13363: one-peer
+# dynamic graphs keep static-graph convergence at O(1) degree, so a
+# stalled/unstable mix is worth a fresh per-step edge set)
+_STALL_RULES = ("consensus_stall", "consensus_diverge")
+# health rules that map to the gamma knob (the γ >> ω boundary)
+_GAMMA_RULES = ("residual_blowup", "consensus_diverge")
+
+
+class PolicyEngine:
+    """Deterministic decision engine over one optimizer's knobs.
+
+    ``modes``: schedule mode names available in the actuator's
+    :class:`~.actuate.SwitchableSchedule` (empty = no schedule knob);
+    ``initial_mode`` the mode the optimizer starts in; ``gamma`` whether
+    the γ-scale knob exists (CHOCO compression).  The engine tracks the
+    knob values it has DECIDED (in shadow mode the real system never
+    moves, but the trail must read as if it had — that is what makes
+    shadow-vs-on trails comparable and replayable)."""
+
+    def __init__(self, cfg: Optional[ControlConfig] = None, *,
+                 modes: Sequence[str] = (),
+                 initial_mode: Optional[str] = None,
+                 gamma: bool = False):
+        self.cfg = cfg or ControlConfig.from_env()
+        self.modes = tuple(modes)
+        if self.modes:
+            self.sched_mode = initial_mode or self.modes[0]
+            if self.sched_mode not in self.modes:
+                raise ValueError(
+                    f"initial mode {self.sched_mode!r} not in {self.modes}")
+        else:
+            self.sched_mode = None
+        self.base_mode = self.sched_mode
+        self.gamma = bool(gamma)
+        self.gamma_scale = 1.0
+        self._last_step: Dict[str, int] = {}
+        self._healthy_streak = 0
+        self._deviated = False          # schedule moved off base_mode
+
+    # -- sensing helpers ----------------------------------------------------
+
+    @staticmethod
+    def residual_margins(view, window: int) -> Tuple[float, float, int]:
+        """``(now, then, samples)`` — max over ranks of
+        ‖residual‖/‖param‖ at the newest step and at the start of the
+        trailing ``window`` steps (plus how many window steps carried
+        both fields).  ``now`` vs ``then`` is the γ-stability trend: a
+        healthy CHOCO warmup runs margins near 1 but CONTRACTS them as
+        x̂ catches up; the γ ≫ ω run's margin plateaus high
+        (docs/compression.md "γ stability")."""
+        now = then = 0.0
+        samples = 0
+        last = view.last_step()
+        if last is None:
+            return 0.0, 0.0, 0
+        lo = last - window + 1
+        for rank in view.ranks:
+            res = dict(view.series_of(rank, "residual_norm"))
+            pn = dict(view.series_of(rank, "param_norm"))
+            common = sorted(s for s in set(res) & set(pn)
+                            if s >= lo and pn[s] > 0)
+            if not common:
+                continue
+            samples = max(samples, len(common))
+            now = max(now, res[common[-1]] / pn[common[-1]])
+            then = max(then, res[common[0]] / pn[common[0]])
+        return now, then, samples
+
+    def _cool(self, knob: str, step: int) -> bool:
+        last = self._last_step.get(knob)
+        return last is None or step - last >= self.cfg.cooldown
+
+    def _preferred_mode(self, edges_entries) -> str:
+        """The schedule mode a HEALTHY fleet should run: the
+        cost-reweighted mode when a usable measured matrix shows a slow
+        edge worth routing around (arXiv:2309.13541), else the base."""
+        if "cost" in self.modes and edges_entries:
+            worst = slow_edge(edges_entries, self.cfg.edge_slow_factor)
+            if worst is not None:
+                return "cost"
+        return self.base_mode
+
+    # -- the decision table -------------------------------------------------
+
+    def evaluate(self, view, report, step: int,
+                 edges: Optional[Sequence[dict]] = None) -> List[Decision]:
+        """One policy pass at ``step``: the health report + fleet view
+        (and optionally the measured edge entries) in, zero or more
+        decisions out.  Mutates the engine's knob model — call in step
+        order; decisions come back with ``mode="shadow"``/``applied=
+        False`` and the caller (Controller / bfctl) stamps actuation."""
+        cfg = self.cfg
+        out: List[Decision] = []
+        # series_gap alerts (truncated tails, mid-file garbage the
+        # tolerant loader skipped) are I/O artifacts, not training
+        # state — and a replay over the finished files cannot observe
+        # them.  The engine's health notion excludes them so live and
+        # replayed trails agree even on corrupted-but-tolerated series.
+        relevant = [v for v in report.alerts if v.rule != "series_gap"]
+        alerts = {v.rule for v in relevant}
+        margin, margin_then, samples = (
+            self.residual_margins(view, cfg.margin_window) if self.gamma
+            else (0.0, 0.0, 0))
+
+        if not relevant:
+            self._healthy_streak += 1
+        else:
+            self._healthy_streak = 0
+
+        # -- schedule knob ---------------------------------------------------
+        if self.modes:
+            stall = sorted(alerts & set(_STALL_RULES))
+            if (stall and "dynamic" in self.modes
+                    and self.sched_mode != "dynamic"
+                    and self._cool("schedule", step)):
+                out.append(self._decide(
+                    step, "schedule", "switch", "dynamic", stall[0],
+                    f"{stall[0]} active: switching to the one-peer "
+                    f"dynamic exponential schedule (O(1) degree, same "
+                    f"convergence class — arXiv:2110.13363)"))
+                self._deviated = True
+            elif (not stall and self._deviated
+                    and self._healthy_streak >= cfg.rearm_after
+                    and self._cool("schedule", step)):
+                target = self._preferred_mode(edges)
+                if target != self.sched_mode:
+                    why = ("measured slow edge persists: preferring the "
+                           "cost-reweighted schedule (arXiv:2309.13541)"
+                           if target == "cost" else
+                           "consensus contracting again: restoring the "
+                           "base schedule")
+                    out.append(self._decide(
+                        step, "schedule", "rearm", target, "rearm", why))
+                    if target == self.base_mode:
+                        self._deviated = False
+
+        # -- gamma knob ------------------------------------------------------
+        if self.gamma:
+            trigger = sorted(alerts & set(_GAMMA_RULES))
+            # high AND not contracting: healthy warmup margins are high
+            # but fall; the unstable run's margin plateaus (hysteresis:
+            # re-arm needs the DISTINCT residual_low floor below)
+            high = (samples >= 2 and margin > cfg.residual_high
+                    and margin > cfg.margin_contract * margin_then)
+            if ((trigger or high) and self.gamma_scale > cfg.gamma_floor
+                    and self._cool("gamma", step)):
+                new = max(cfg.gamma_floor,
+                          self.gamma_scale * cfg.gamma_backoff)
+                rule = trigger[0] if trigger else "residual_margin"
+                out.append(self._decide(
+                    step, "gamma", "backoff", round(new, 6), rule,
+                    f"{rule}: residual/param margin {margin:.3g} "
+                    f"(window start {margin_then:.3g}) — backing CHOCO "
+                    f"gamma off before the gamma >> omega divergence "
+                    f"(docs/compression.md)"))
+            elif (not relevant and not high and margin < cfg.residual_low
+                    and self.gamma_scale < 1.0
+                    and self._healthy_streak >= cfg.rearm_after
+                    and self._cool("gamma", step)):
+                new = min(1.0, self.gamma_scale * cfg.gamma_rearm)
+                out.append(self._decide(
+                    step, "gamma", "rearm", round(new, 6), "rearm",
+                    f"consensus contracted (margin {margin:.3g} < "
+                    f"{cfg.residual_low:g}): re-arming toward full-rate "
+                    f"gossip"))
+
+        # an evaluation that INTERVENED is not a healthy steady state:
+        # the re-arm streak starts counting after the last correction
+        if any(d.action != "rearm" for d in out):
+            self._healthy_streak = 0
+
+        return out
+
+    def _decide(self, step, knob, action, value, rule, reason) -> Decision:
+        prev = self.sched_mode if knob == "schedule" else self.gamma_scale
+        d = Decision(step=int(step), knob=knob, action=action, value=value,
+                     prev=prev, rule=rule, reason=reason)
+        if knob == "schedule":
+            self.sched_mode = value
+        else:
+            self.gamma_scale = float(value)
+        self._last_step[knob] = int(step)
+        return d
+
+    def mode_index_view(self) -> int:
+        """Index of the engine's MODELED schedule mode (what shadow mode
+        mirrors to the ``bf_control_sched_mode`` gauge)."""
+        if self.modes and self.sched_mode in self.modes:
+            return self.modes.index(self.sched_mode)
+        return 0
+
+    def describe(self) -> dict:
+        """The replayable engine identity (the ``control_config`` head
+        record of a decision trail)."""
+        return {
+            "modes": list(self.modes),
+            "initial_mode": self.base_mode,
+            "gamma": self.gamma,
+            "cfg": self.cfg.asdict(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Decision trail I/O (the JSONL the monitor tails and bfctl replays)
+# ---------------------------------------------------------------------------
+
+def write_config_record(path: str, describe: dict,
+                        extra: Optional[dict] = None) -> None:
+    """Open a decision trail with its ``control_config`` head record —
+    everything ``bfctl replay`` needs to re-instantiate the engine
+    (modes, initial mode, gamma knob, config, live platform)."""
+    rec = {"kind": "control_config", "t_us": int(time.time() * 1e6)}
+    rec.update(describe)
+    if extra:
+        rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def write_decision(path: str, decision: Decision,
+                   header: Optional[dict] = None) -> dict:
+    """Append one decision to the trail (size-bounded like the verdict
+    trail: ``BLUEFOG_METRICS_MAX_MB`` rotation applies).
+
+    ``header``: the engine's ``control_config`` describe-dict — written
+    as the first line whenever the trail file does not exist yet, so a
+    freshly opened AND a freshly ROTATED trail both carry the replayable
+    head record (a rotation without it would orphan every later
+    decision from its engine identity)."""
+    from ..observability import export as _export
+    max_bytes, keep = _export.resolve_rotation()
+    if max_bytes:
+        try:
+            if os.path.getsize(path) >= max_bytes:
+                _export.rotate_file(path, keep)
+        except OSError:
+            pass
+    if header is not None and not os.path.exists(path):
+        write_config_record(path, header)
+    rec = decision.asdict()
+    rec["t_us"] = int(time.time() * 1e6)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def read_decisions(path: str) -> Tuple[Optional[dict], List[dict]]:
+    """Parse a decision trail tolerantly: ``(config_record, decisions)``
+    — unknown lines are skipped, a missing file reads as empty (the
+    monitor's discovery probe must never raise)."""
+    config = None
+    decisions: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("kind") == "control_config" and config is None:
+                    config = rec
+                elif rec.get("kind") == "decision":
+                    decisions.append(rec)
+    except OSError:
+        pass
+    return config, decisions

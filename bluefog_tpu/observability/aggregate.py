@@ -425,15 +425,19 @@ class FleetView:
     def latest_edges(self) -> Optional[dict]:
         """The newest ``"edges"`` record (the comm profiler's measured
         per-edge cost matrix riding the JSONL) anywhere in the fleet:
-        ``{"step", "rank", "entries"}``, or None when no rank has probed
-        — the view ``bfmonitor --once --json`` hands the controller."""
+        ``{"step", "rank", "entries", "platform"}``, or None when no
+        rank has probed — the view ``bfmonitor --once --json`` hands the
+        controller.  ``platform`` (the sibling ``edges_platform`` field)
+        is what the probe priced; consumers must gate on it
+        (``commprof.matrix_is_usable``) before acting."""
         best = None
         for rank, by_step in self.per_rank.items():
             for step, rec in by_step.items():
                 edges = rec.get("edges")
                 if isinstance(edges, list) and edges and (
                         best is None or step > best["step"]):
-                    best = {"step": step, "rank": rank, "entries": edges}
+                    best = {"step": step, "rank": rank, "entries": edges,
+                            "platform": rec.get("edges_platform")}
         return best
 
     # -- derived: step wall time --------------------------------------------

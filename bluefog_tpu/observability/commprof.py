@@ -63,8 +63,15 @@ from .. import timeline as _tl
 __all__ = [
     "EdgeCostMatrix", "OverlapSample", "probe_edges", "topology_edges",
     "export_edge_matrix", "measure_overlap", "resolve_injected_delays",
+    "matrix_is_usable",
     "EDGE_ARTIFACT_ENV", "EDGE_DELAY_ENV", "EDGE_MAX_BYTES_ENV",
 ]
+
+# when this process started sensing (import time = before any probe this
+# run could have written): the staleness epoch matrix_is_usable gates
+# artifact mtimes against — an artifact left behind by a PREVIOUS run
+# (possibly a different fleet) must not be consumed as live link costs
+_RUN_EPOCH = time.time()
 
 EDGE_ARTIFACT_ENV = "BLUEFOG_EDGE_ARTIFACT"
 EDGE_DELAY_ENV = "BLUEFOG_EDGE_PROBE_DELAY_US"
@@ -154,6 +161,52 @@ class EdgeCostMatrix:
             labels = dict(src=e["src"], dst=e["dst"], bytes=e["bytes"])
             lat.set(e["latency_us"], **labels)
             bw.set(e["gbps"], **labels)
+
+
+def matrix_is_usable(matrix: EdgeCostMatrix, *,
+                     path: Optional[str] = None,
+                     platform: Optional[str] = None,
+                     run_epoch: Optional[float] = None
+                     ) -> Tuple[bool, str]:
+    """Gate a sensing artifact before anything ACTS on it: ``(ok,
+    reason)``.
+
+    The probe records what it actually priced (``matrix.platform``); a
+    matrix probed on a different backend than the live one — the classic
+    case being a CPU virtual-mesh matrix (dispatch cost, not wire time)
+    consumed on a TPU fleet — is refused, as is a matrix that recorded
+    no platform at all.  With ``path`` given, an artifact whose mtime
+    predates this run (``run_epoch``, default: process sensing start) is
+    refused too: a file left behind by a previous run describes a fleet
+    that no longer exists.
+
+    ``platform`` defaults to the live JAX backend.  This is the shared
+    guard the closed-loop controller (``control/``), ``bfctl``, and any
+    schedule optimizer must route matrices through — ``bench.py
+    --profile-edges`` documents the synthetic-matrix hazard; this
+    enforces it."""
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    if matrix.platform is None:
+        return False, ("matrix records no platform — probed by a "
+                       "pre-guard writer; re-probe before acting on it")
+    if matrix.platform != platform:
+        return False, (f"matrix probed on {matrix.platform!r} but the "
+                       f"live backend is {platform!r} — a synthetic "
+                       f"matrix must not become a link model")
+    if path is not None:
+        if run_epoch is None:
+            run_epoch = _RUN_EPOCH
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError as e:
+            return False, f"artifact unreadable: {e}"
+        if mtime < run_epoch:
+            return False, (f"artifact mtime predates this run by "
+                           f"{run_epoch - mtime:.0f}s — stale link "
+                           f"costs from a previous fleet")
+    return True, "ok"
 
 
 def topology_edges(topo=None) -> List[Tuple[int, int]]:
@@ -408,9 +461,16 @@ def export_edge_matrix(matrix: EdgeCostMatrix,
         matrix.save(artifact_path)
     if step is None and matrix.step is None:
         _phases.stage_field("edges", matrix.entries)
+        if matrix.platform is not None:
+            _phases.stage_field("edges_platform", matrix.platform)
         return None
+    extra = {"edges": matrix.entries}
+    if matrix.platform is not None:
+        # the consumer-side guard (matrix_is_usable / the controller)
+        # needs to know what the in-series matrix priced
+        extra["edges_platform"] = matrix.platform
     return _export.log_step(step if step is not None else matrix.step,
-                            extra={"edges": matrix.entries})
+                            extra=extra)
 
 
 # ---------------------------------------------------------------------------

@@ -318,6 +318,37 @@ def prometheus_text(reg: Optional[_metrics.Registry] = None) -> str:
 # an old validator reading a new writer's series)
 _EDGE_KEYS = ("src", "dst", "bytes", "latency_us", "gbps")
 
+# controller-trail record kinds (control/policy.py) and their required
+# keys: a "decision" line is the closed-loop controller's audit unit, a
+# "control_config" line the trail's replayable head record.  Lines of
+# these kinds replace the telemetry-record required keys (they carry no
+# "rank" — decisions are fleet-scoped) but keep the numeric-finiteness
+# and unknown-field-tolerance contracts.
+_KIND_REQUIRED = {
+    "decision": ("step", "t_us", "knob", "action", "mode", "applied"),
+    "control_config": ("t_us",),
+}
+
+_DECISION_STR_KEYS = ("knob", "action", "mode")
+
+
+def _check_decision(path, lineno, rec):
+    for k in _DECISION_STR_KEYS:
+        if not isinstance(rec[k], str):
+            raise ValueError(
+                f"{path}:{lineno}: decision field {k!r} must be a string")
+    if not isinstance(rec["applied"], bool):
+        raise ValueError(
+            f"{path}:{lineno}: decision field 'applied' must be a bool")
+    if rec["mode"] not in ("shadow", "on"):
+        raise ValueError(
+            f"{path}:{lineno}: decision mode {rec['mode']!r} not in "
+            f"('shadow', 'on')")
+    if isinstance(rec.get("step"), bool) or not isinstance(
+            rec.get("step"), (int, float)):
+        raise ValueError(
+            f"{path}:{lineno}: decision field 'step' is not numeric")
+
 
 def _check_structured(path, lineno, rec, check):
     """Shape checks for the documented structured fields: ``phases``
@@ -368,10 +399,13 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     """Parse a metrics JSONL file, enforcing the schema: every line is a
     JSON object carrying ``required`` keys, every numeric field finite,
     and the documented structured fields (``phases``, ``step_wall_us``,
-    ``edges``, ``overlap_efficiency``) well-shaped.  Fields the schema
-    does not know are tolerated (forward compatibility is part of the
-    contract and regression-tested).  Returns the records; raises
-    ValueError on violations (the ``make metrics-smoke`` gate)."""
+    ``edges``, ``overlap_efficiency``) well-shaped.  Controller-trail
+    lines (``kind: decision`` / ``control_config``, control/policy.py)
+    validate against their own required keys and shape instead.  Fields
+    the schema does not know are tolerated (forward compatibility is
+    part of the contract and regression-tested).  Returns the records;
+    raises ValueError on violations (the ``make metrics-smoke`` /
+    ``make control-smoke`` gates)."""
     import math
     records = []
     with open(path) as f:
@@ -385,9 +419,15 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 raise ValueError(f"{path}:{lineno}: invalid JSON: {e}")
             if not isinstance(rec, dict):
                 raise ValueError(f"{path}:{lineno}: not a JSON object")
-            missing = [k for k in required if k not in rec]
+            kind = rec.get("kind")
+            required_here = (_KIND_REQUIRED[kind]
+                             if isinstance(kind, str)
+                             and kind in _KIND_REQUIRED else required)
+            missing = [k for k in required_here if k not in rec]
             if missing:
                 raise ValueError(f"{path}:{lineno}: missing keys {missing}")
+            if kind == "decision":
+                _check_decision(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

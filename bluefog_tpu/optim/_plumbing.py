@@ -16,7 +16,7 @@ from jax.sharding import PartitionSpec as P
 def step_cache_key(cx, params, nar_backend: str, fuse: bool,
                    bucket_bytes: int, overlap: bool = False,
                    telemetry: bool = False, compression=None,
-                   gossip_axis=None):
+                   gossip_axis=None, control: bool = False):
     """Everything that changes the COMPILED step program: mesh/topology
     identity, the exchange backend, the fusion knobs (they reshape the
     collective schedule), the overlap mode (it reshapes the carried state
@@ -25,10 +25,13 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
     wire dtypes, the collective schedule, and possibly the state layout),
     the gossip axis (the hybrid mesh builders exchange over one named
     axis of a larger mesh — a different axis is a different collective
-    schedule), and the parameter tree structure.  One home for the tuple
-    so the wrappers and any future cache agree on what invalidates a
-    step — a knob resolved at build time but missing here would silently
-    serve a stale program."""
+    schedule), the control gate (``BLUEFOG_CONTROL=on`` threads the γ
+    knob through the carried state — the gate itself is keyed; every
+    value the controller later actuates is traced data), and the
+    parameter tree structure.  One home for the tuple so the wrappers
+    and any future cache agree on what invalidates a step — a knob
+    resolved at build time but missing here would silently serve a stale
+    program."""
     return (id(cx.mesh),
             id(cx._compiled),
             id(cx._compiled_machine),
@@ -39,6 +42,7 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
             bool(telemetry),
             None if compression is None else compression.spec,
             gossip_axis,
+            bool(control),
             jax.tree.structure(params))
 
 

@@ -33,6 +33,7 @@ from .. import timeline as _tl
 from ..compress import compressors as _cp
 from ..compress import exchange as _cx
 from ..context import ctx
+from ..control import policy as _ctl_policy
 from ..observability import commprof as _cprof
 from ..observability import ingraph as IG
 from ..observability import phases as _ph
@@ -75,7 +76,8 @@ class _JittedStrategyOptimizer:
                  fusion_bucket_bytes: Optional[int] = None,
                  overlap: Optional[bool] = None,
                  telemetry: Optional[bool] = None,
-                 compression=None):
+                 compression=None,
+                 control: Optional[bool] = None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
@@ -145,6 +147,19 @@ class _JittedStrategyOptimizer:
                 "~1e34 blow-up at lr 0.2 on the quadratic benchmark)")
         self.k = num_steps_per_communication
         self.sched = sched
+        # closed-loop controller plumbing (control/): the gate resolves
+        # at construction (None = BLUEFOG_CONTROL == "on") and joins the
+        # step-cache key; every value the controller later actuates —
+        # the schedule mode via the traced step index, the CHOCO gamma
+        # scale via the carried compression state — is traced data, so
+        # interventions never rebuild the step (tests/test_control.py).
+        self._control = (bool(control) if control is not None
+                         else _ctl_policy.control_mode() == "on")
+        self.control_knobs = {"gamma_scale": 1.0}
+        self._controller = None
+        self._gamma_plumbed = (self._control
+                               and self.compression is not None
+                               and self.compression.choco)
         self._step_cache = {}
         # overlap-probe programs (commprof.measure_overlap inputs), keyed
         # like the step cache so knob changes rebuild them in lockstep
@@ -320,13 +335,51 @@ class _JittedStrategyOptimizer:
         telemetry = IG.telemetry_enabled(self.telemetry)
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
                              self.overlap, telemetry, self.compression,
-                             gossip_axis=cx.rank_axis)
+                             gossip_axis=cx.rank_axis,
+                             control=self._control)
         return fuse, bucket, telemetry, key
+
+    # -- closed-loop controller hook (control/) ------------------------------
+
+    def attach_controller(self, controller) -> None:
+        """Attach a controller/actuator (``control.Controller`` or a bare
+        ``control.Actuator``).  The object supplies ``graph_step(step)``
+        — the traced step index actually dispatched (a
+        ``SwitchableSchedule`` selects its mode this way) — and
+        ``after_step(step)``, invoked after every dispatch (where the
+        Controller runs its sensing/policy pass)."""
+        self._controller = controller
+
+    def detach_controller(self) -> None:
+        self._controller = None
+
+    def _with_control_state(self, opt_state):
+        """Inject the current γ scale as a traced leaf of the carried
+        compression state (``control=True`` + choco only).  The value
+        lives in ``self.control_knobs`` (the actuator's write target);
+        re-injected every call, so the program only ever sees a stable
+        state STRUCTURE with a varying traced value — backoff/re-arm
+        never retrace."""
+        if not self._gamma_plumbed:
+            return opt_state
+        comp = dict(opt_state["compress"])
+        # [N] like every carried state leaf (the step shard_maps the
+        # state over the rank axis; each rank sees its scalar)
+        comp["gamma_scale"] = jnp.full(
+            (ctx().size,), self.control_knobs.get("gamma_scale", 1.0),
+            jnp.float32)
+        out = dict(opt_state)
+        out["compress"] = comp
+        return out
 
     def step(self, params, grads, opt_state, step: int = 0):
         """One optimizer step.  Returns ``(params, opt_state)`` — plus a
         global-view :class:`~..observability.ingraph.TelemetrySnapshot`
         (``[N]`` per field) when telemetry resolves on."""
+        # the controller hook remaps the step index (a SwitchableSchedule
+        # mode select — pure traced data) and injects the current γ scale
+        ctl = self._controller
+        gstep = step if ctl is None else ctl.graph_step(step)
         _fuse, _bucket, telemetry, key = self._exec_config(params)
         hit = key in self._step_cache
         note_step_cache(hit)
@@ -338,7 +391,8 @@ class _JittedStrategyOptimizer:
         # `overlap_efficiency` JSONL field the health engine watches
         every = _cprof.overlap_probe_every()
         if every and _ph.profiling_active() and int(step) % every == 0:
-            self.probe_overlap(params, grads, opt_state, step)
+            self.probe_overlap(params, grads, opt_state, gstep)
+        opt_state = self._with_control_state(opt_state)
         # `compute` phase = the whole jitted dispatch: for this family
         # the exchange is fused INTO the graph, so exchange/fold have no
         # separate host extent (the window family times them apart).
@@ -347,7 +401,7 @@ class _JittedStrategyOptimizer:
         tok = _tl.op_start_us()
         with _ph.step_phase("compute"):
             out = self._step_cache[key](params, grads, opt_state,
-                                        jnp.asarray(step, jnp.int32))
+                                        jnp.asarray(gstep, jnp.int32))
             if _tl.timeline_enabled():
                 # the round span must end when the COLLECTIVE finishes,
                 # not when the host finishes enqueueing — ranks run ahead
@@ -357,6 +411,12 @@ class _JittedStrategyOptimizer:
                 # the un-traced hot path stays fully async.
                 jax.block_until_ready(out)
         _tl.record_gossip_round(step, tok)
+        if ctl is not None:
+            # the sensing/policy pass (control.Controller.after_step)
+            # runs AFTER the dispatch, before the caller logs step t —
+            # so an evaluation at step t sees records <= t-1, the same
+            # cutoff `bfctl replay` applies (trail determinism)
+            ctl.after_step(step)
         return out
 
     def _comm_layout(self):
@@ -435,6 +495,9 @@ class _JittedStrategyOptimizer:
         if (self.comm_type == CommunicationType.empty
                 and not self.gradient_allreduce):
             return None
+        # under control the probe prices the SAME state structure the
+        # step dispatches (γ-scale leaf injected)
+        opt_state = self._with_control_state(opt_state)
         fuse, bucket, telemetry, key = self._exec_config(params)
         if key not in self._step_cache:
             self._step_cache[key] = self._build(key, telemetry)
@@ -491,20 +554,20 @@ def DistributedGradientAllreduceOptimizer(base, num_steps_per_communication=1,
 def DistributedAllreduceOptimizer(base, num_steps_per_communication=1,
                                   fuse=None, fusion_bucket_bytes=None,
                                   overlap=None, telemetry=None,
-                                  compression=None):
+                                  compression=None, control=None):
     """CTA with global weight averaging (optimizers.py:1301)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.allreduce,
         num_steps_per_communication=num_steps_per_communication,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression)
+        telemetry=telemetry, compression=compression, control=control)
 
 
 def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
                                           sched: Optional[DynamicSchedule] = None,
                                           fuse=None, fusion_bucket_bytes=None,
                                           overlap=None, telemetry=None,
-                                          compression=None):
+                                          compression=None, control=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
     decentralized optimizer (optimizers.py:1326).
 
@@ -516,12 +579,19 @@ def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
 
     ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): ``step()`` returns
     ``(params, state, TelemetrySnapshot)`` — consensus distance, mixing
-    mass, norms, pipeline flags per rank (docs/observability.md)."""
+    mass, norms, pipeline flags per rank (docs/observability.md).
+
+    ``control`` (default ``BLUEFOG_CONTROL == "on"``): thread the
+    closed-loop controller's runtime knobs through the step — the
+    schedule mode of an attached ``control.SwitchableSchedule`` (via the
+    traced step index) and the CHOCO γ scale (via the carried
+    compression state).  Attach with
+    ``control.Controller(opt, ...)`` (docs/control.md)."""
     return _JittedStrategyOptimizer(
         base, CommunicationType.neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression)
+        telemetry=telemetry, compression=compression, control=control)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
@@ -543,7 +613,7 @@ def DistributedAdaptThenCombineOptimizer(
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None):
+        compression=None, control=None):
     """ATC: local update inside the step, then communicate the adapted
     weights (optimizers.py:1426; internal :485-841).  ``overlap``: the
     combine of the adapted iterate lands one step later (staleness-1
@@ -552,7 +622,7 @@ def DistributedAdaptThenCombineOptimizer(
         base, communication_type, atc=True,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression)
+        telemetry=telemetry, compression=compression, control=control)
 
 
 def DistributedAdaptWithCombineOptimizer(
@@ -560,7 +630,7 @@ def DistributedAdaptWithCombineOptimizer(
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None):
+        compression=None, control=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
     runs the collective and the update math in parallel.  ``overlap``
@@ -571,13 +641,13 @@ def DistributedAdaptWithCombineOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression)
+        telemetry=telemetry, compression=compression, control=control)
 
 
 def DistributedExactDiffusionOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None):
+        compression=None, control=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -598,7 +668,7 @@ def DistributedExactDiffusionOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, exact_diffusion=True,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression)
+        telemetry=telemetry, compression=compression, control=control)
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,7 @@ __all__ = [
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
     "one_peer_send_rank",
+    "one_peer_factory",
     "dynamic_mixing_matrix",
     "dynamic_mixing_matrices",
     "dynamic_mixing_matrices_with_liveness",
@@ -61,6 +62,14 @@ def one_peer_send_rank(topo: nx.DiGraph, rank: int, step: int) -> int:
     """
     ordered = _sorted_out_neighbors(topo)[rank]
     return ordered[step % len(ordered)]
+
+
+def one_peer_factory(topo: nx.DiGraph) -> "GeneratorFactory":
+    """The per-rank generator family for the one-peer rotation over
+    ``topo`` — the ``factory`` shape :func:`dynamic_mixing_matrices`,
+    ``compile_dynamic_schedule``, and the controller's
+    ``control.build_switchable_schedule`` consume."""
+    return lambda rank: GetDynamicOnePeerSendRecvRanks(topo, rank)
 
 
 def GetDynamicOnePeerSendRecvRanks(

@@ -1,0 +1,238 @@
+"""``bfctl`` — dry-run / replay the closed-loop controller against
+recorded telemetry.
+
+The controller's whole trust story is that its decisions are a
+DETERMINISTIC function of the recorded JSONL series (docs/control.md):
+``bfctl replay`` proves it by re-running the sensing -> policy pipeline
+over a finished run's ``<prefix><rank>.jsonl`` files and reproducing the
+decision trail the live controller wrote — byte-for-byte on the decision
+signatures (step, knob, action, value, rule).
+
+Modes::
+
+    bfctl replay /tmp/series_                    # print the trail JSON
+    bfctl replay /tmp/series_ --out /tmp/d.jsonl # write a trail file
+    bfctl replay /tmp/series_ --expect /tmp/series_decisions.jsonl
+                                                 # exit 1 unless the live
+                                                 # trail is reproduced
+    bfctl show /tmp/series_decisions.jsonl       # pretty-print a trail
+
+Replay semantics mirror the live hook exactly: the controller evaluates
+inside ``opt.step(t)`` — before the caller logs step t — so an
+evaluation at step t sees records ``<= t-1``; replay applies the same
+cutoff.  The engine is re-instantiated from the trail's
+``control_config`` head record (modes, initial mode, γ knob, config,
+probe platform, cadence) so a replay needs no knowledge of the original
+launch script; CLI flags override for dry-running hypothetical configs
+against real telemetry.
+
+Host-side only: no mesh, no device init, no tracing — a laptop can
+replay a pod's trail.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..control import policy as CTL
+from ..observability import aggregate as AG
+from ..observability import health as H
+
+__all__ = ["main", "replay"]
+
+
+def _truncated_view(view: AG.FleetView, cutoff: int) -> AG.FleetView:
+    """The fleet view as the live controller saw it at an evaluation
+    with records ``<= cutoff`` (loader gaps dropped — they are live-tail
+    artifacts, and no decision rule consumes them)."""
+    series = []
+    for rank, s in sorted(view.series.items()):
+        recs = [r for r in s.records
+                if (st := AG._step_of(r)) is not None and st <= cutoff]
+        series.append(AG.RankSeries(rank=rank, records=recs, path=s.path))
+    return AG.FleetView(series, [], expected_ranks=view.expected_ranks)
+
+
+def _engine_from(head: Optional[dict], args) -> CTL.PolicyEngine:
+    cfg_dict = dict((head or {}).get("cfg") or {})
+    cfg = CTL.ControlConfig(**cfg_dict) if cfg_dict else \
+        CTL.ControlConfig.from_env()
+    modes = (head or {}).get("modes") or []
+    if args.modes is not None:
+        modes = [m for m in args.modes.split(",") if m]
+    initial = args.initial_mode or (head or {}).get("initial_mode")
+    gamma = bool((head or {}).get("gamma")) or args.gamma
+    return CTL.PolicyEngine(cfg, modes=modes, initial_mode=initial,
+                            gamma=gamma)
+
+
+def replay(prefix: str, *, head: Optional[dict] = None,
+           engine: Optional[CTL.PolicyEngine] = None,
+           every: Optional[int] = None,
+           platform: Optional[str] = None,
+           expected_ranks: Optional[int] = None,
+           health_window: Optional[int] = None,
+           mode: str = "shadow") -> List[CTL.Decision]:
+    """Re-run the policy over a recorded run; returns the decision list.
+    ``engine`` must be freshly constructed (the replay mutates it)."""
+    if engine is None:
+        raise ValueError("replay needs a PolicyEngine")
+    head = head or {}
+    every = every or head.get("every") or engine.cfg.every
+    platform = platform or head.get("platform")
+    if expected_ranks is None:
+        expected_ranks = head.get("expected_ranks")
+    # the live run's FULL health config rides the head record — a replay
+    # must judge the telemetry with the thresholds the pod actually ran,
+    # not the replaying machine's BLUEFOG_HEALTH_* environment
+    if isinstance(head.get("health"), dict):
+        hcfg = H.HealthConfig(**head["health"])
+    else:
+        hcfg = H.HealthConfig.from_env()
+        if health_window or head.get("health_window"):
+            hcfg.window = int(health_window or head.get("health_window"))
+    # a controller fed by an edges ARTIFACT recorded the gated entries
+    # in the head record (they never ride the telemetry JSONL)
+    artifact_entries = head.get("artifact_entries")
+    full = AG.load_fleet(prefix, expected_ranks=expected_ranks)
+    steps = full.steps()
+    if not steps:
+        return []
+    out: List[CTL.Decision] = []
+    for t in range(steps[-1] + 1):
+        if t % every != every - 1:
+            continue
+        view = _truncated_view(full, t - 1)
+        report = H.evaluate(view, hcfg)
+        edges = artifact_entries
+        if edges is None:
+            latest = view.latest_edges()
+            if latest:
+                rec_platform = latest.get("platform")
+                # the same foreign-matrix guard the live controller
+                # applies: entries probed on a different backend than
+                # the run's are not a link model
+                if not (rec_platform is not None and platform is not None
+                        and rec_platform != platform):
+                    edges = latest["entries"]
+        for d in engine.evaluate(view, report, t, edges=edges):
+            d.mode = mode
+            out.append(d)
+    return out
+
+
+def _cmd_replay(args) -> int:
+    expect_head, expect = (None, None)
+    config_from = args.config_from
+    if config_from is None:
+        candidate = (args.expect if args.expect
+                     else args.prefix + "decisions.jsonl")
+        config_from = candidate
+    head, recorded = CTL.read_decisions(config_from)
+    if args.expect:
+        expect_head, expect = CTL.read_decisions(args.expect)
+        if head is None:
+            head = expect_head
+    engine = _engine_from(head, args)
+    decisions = replay(
+        args.prefix, head=head, engine=engine, every=args.every,
+        platform=args.platform, expected_ranks=args.ranks,
+        health_window=args.health_window, mode=args.mode)
+    if args.out:
+        desc = engine.describe()
+        desc["every"] = args.every or (head or {}).get("every") \
+            or engine.cfg.every
+        desc["platform"] = args.platform or (head or {}).get("platform")
+        CTL.write_config_record(args.out, desc, extra={"replayed": True})
+        for d in decisions:
+            CTL.write_decision(args.out, d)
+    result = {
+        "prefix": args.prefix,
+        "n": len(decisions),
+        "decisions": [d.asdict() for d in decisions],
+    }
+    rc = 0
+    if args.expect is not None:
+        want = [(r.get("step"), r.get("knob"), r.get("action"),
+                 r.get("value"), r.get("rule")) for r in (expect or [])]
+        got = [d.signature() for d in decisions]
+        result["expect"] = args.expect
+        result["match"] = (got == [tuple(w) for w in want])
+        if not result["match"]:
+            result["expected"] = want
+            rc = 1
+    print(json.dumps(result))
+    return rc
+
+
+def _cmd_show(args) -> int:
+    head, decisions = CTL.read_decisions(args.path)
+    if head:
+        print(f"config: modes={head.get('modes')} "
+              f"initial={head.get('initial_mode')} "
+              f"gamma={head.get('gamma')} every={head.get('every')} "
+              f"platform={head.get('platform')}")
+    if not decisions:
+        print("(no decisions)")
+        return 0
+    for d in decisions:
+        tag = "applied" if d.get("applied") else (
+            "would" if d.get("mode") == "shadow" else "skipped")
+        print(f"step {str(d.get('step', '-')):>6}  {d.get('knob')}:"
+              f"{d.get('action')} {d.get('prev')} -> {d.get('value')}  "
+              f"[{d.get('rule')}] ({tag})")
+        if d.get("reason"):
+            print(f"        {d['reason']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bfctl",
+        description="dry-run / replay the closed-loop controller over "
+                    "recorded telemetry (docs/control.md)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser(
+        "replay",
+        help="re-run the policy over a recorded run's JSONL series")
+    rp.add_argument("prefix",
+                    help="metrics prefix: reads <prefix><rank>.jsonl")
+    rp.add_argument("--expect", default=None, metavar="PATH",
+                    help="live decision trail to reproduce: exit 1 on "
+                         "any signature mismatch")
+    rp.add_argument("--config-from", default=None, metavar="PATH",
+                    help="decision trail whose control_config head "
+                         "seeds the engine (default: --expect, else "
+                         "<prefix>decisions.jsonl)")
+    rp.add_argument("--out", default=None, metavar="PATH",
+                    help="write the replayed trail to this JSONL")
+    rp.add_argument("--every", type=int, default=None,
+                    help="evaluation cadence override (steps)")
+    rp.add_argument("--mode", choices=("shadow", "on"), default="shadow",
+                    help="mode stamped on replayed decisions (replay "
+                         "never actuates; default shadow)")
+    rp.add_argument("--modes", default=None,
+                    help="comma-separated schedule mode names override")
+    rp.add_argument("--initial-mode", default=None)
+    rp.add_argument("--gamma", action="store_true",
+                    help="enable the gamma knob when no config record "
+                         "says so")
+    rp.add_argument("--platform", default=None,
+                    help="platform the run's probes priced (guards "
+                         "in-series edge records)")
+    rp.add_argument("--ranks", type=int, default=None)
+    rp.add_argument("--health-window", type=int, default=None)
+    rp.set_defaults(fn=_cmd_replay)
+
+    sh = sub.add_parser("show", help="pretty-print a decision trail")
+    sh.add_argument("path")
+    sh.set_defaults(fn=_cmd_show)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,7 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
-           "render_edge_heatmap"]
+           "render_edge_heatmap", "render_decisions"]
 
 _TICKS = "▁▂▃▄▅▆▇█"
 _SEV_TAG = {"critical": "CRIT", "warn": "warn", "info": "info"}
@@ -100,11 +100,17 @@ def _strict_json(obj):
 def build_report(prefix: str, *, window: Optional[int] = None,
                  expected_ranks: Optional[int] = None,
                  verdicts_path: Optional[str] = None,
+                 decisions_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
     same dict `make health-smoke` asserts on).  Returns
-    ``(view, health_report, report_dict)``."""
+    ``(view, health_report, report_dict)``.
+
+    ``decisions_path``: the closed-loop controller's decision trail
+    (default discovery: ``<prefix>decisions.jsonl`` — the path
+    ``control.Controller`` writes) — its decisions render as the
+    dashboard's decisions panel and ride the ``--json`` report."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -169,7 +175,31 @@ def build_report(prefix: str, *, window: Optional[int] = None,
         "edges": view.latest_edges(),
         "gaps": [g.asdict() for g in view.gaps],
     }
+    out["decisions"] = _decisions_block(prefix, decisions_path)
     return view, report, _strict_json(out)
+
+
+def _decisions_block(prefix: str,
+                     decisions_path: Optional[str]) -> Optional[dict]:
+    """The controller's decision trail as a report block: counts by
+    ``knob:action`` plus the most recent records — None when no trail
+    exists (a run without a controller stays noise-free)."""
+    from ..control import DECISIONS_SUFFIX, read_decisions
+    path = decisions_path or prefix + DECISIONS_SUFFIX
+    config, decisions = read_decisions(path)
+    if config is None and not decisions:
+        return None
+    counts = {}
+    for d in decisions:
+        key = f"{d.get('knob')}:{d.get('action')}"
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "path": path,
+        "mode": decisions[-1].get("mode") if decisions else None,
+        "total": len(decisions),
+        "counts": counts,
+        "recent": decisions[-8:],
+    }
 
 
 def render_edge_heatmap(edges: dict, *, top: int = 0) -> str:
@@ -209,6 +239,23 @@ def render_edge_heatmap(edges: dict, *, top: int = 0) -> str:
     worst = sorted(lat.items(), key=lambda kv: -(kv[1] or 0))
     for (s, d), v in worst[:max(3, top)]:
         lines.append(f"  slow: {s}->{d}  {_fmt(v)}µs")
+    return "\n".join(lines)
+
+
+def render_decisions(block: dict, *, limit: int = 6) -> str:
+    """The controller decisions panel: the newest trail entries, one
+    line each — shadow entries marked ``would`` (logged, not actuated)."""
+    lines = [f"decisions ({block['total']} total, "
+             f"mode {block.get('mode') or '-'}):"]
+    for d in block.get("recent", [])[-limit:]:
+        tag = "applied" if d.get("applied") else (
+            "would" if d.get("mode") == "shadow" else "skipped")
+        # str() everything: the reader is tolerant by contract, so a
+        # malformed record must render as '-', never crash the frame
+        lines.append(
+            f"  step {str(d.get('step', '-')):>5}  "
+            f"{d.get('knob')}:{d.get('action')}"
+            f" -> {d.get('value')}  [{d.get('rule')}] ({tag})")
     return "\n".join(lines)
 
 
@@ -295,6 +342,9 @@ def main(argv=None) -> int:
     p.add_argument("--verdicts", default=None, metavar="PATH",
                    help="append HealthReports to this verdict JSONL "
                         "(the controller feed)")
+    p.add_argument("--decisions", default=None, metavar="PATH",
+                   help="controller decision trail to render (default: "
+                        "<prefix>decisions.jsonl when it exists)")
     p.add_argument("--edges", action="store_true",
                    help="render the measured edge-cost heatmap (the comm "
                         "profiler's newest 'edges' record) under the "
@@ -312,11 +362,15 @@ def main(argv=None) -> int:
     def frame():
         view, report, out = build_report(
             args.prefix, window=args.window, expected_ranks=args.ranks,
-            verdicts_path=args.verdicts, cache=cache)
+            verdicts_path=args.verdicts, decisions_path=args.decisions,
+            cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
             print(render_dashboard(view, report))
+            if out.get("decisions"):
+                print()
+                print(render_decisions(out["decisions"]))
             if args.edges:
                 edges = out.get("edges")
                 if edges:

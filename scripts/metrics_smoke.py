@@ -29,6 +29,18 @@ synchronous step and measurably positive for the delayed-mix pipeline;
 and a two-rank trace merge with a known injected clock skew must recover
 the offset, pair the gossip flow events, and validate.
 
+``--control`` (``make control-smoke``) adds the closed-loop controller
+gate (docs/control.md): a real training loop over a switchable schedule
+whose static mode is a DEAD exchange (identity mixing), with a slow edge
+injected into the probe via ``BLUEFOG_EDGE_PROBE_DELAY_US`` — the
+controller must raise ``consensus_stall``, switch to the one-peer
+dynamic schedule, contract consensus, then re-arm onto the
+cost-reweighted mode; and the docs/compression.md γ ≫ ω seeded run must
+get its γ backoff.  Both interventions must land in the decision JSONL
+AND in ``bfmonitor --once --json``, with zero step recompiles across
+the episode, and ``bfctl replay`` must reproduce the exact decision
+trail from the recorded telemetry.
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -197,6 +209,136 @@ def health_legs(n, tmp):
     }
 
 
+CONTROL_STEPS, GAMMA_STEPS = 28, 60
+
+
+def control_legs(n, tmp):
+    """The ``make control-smoke`` gate: seeded anomalies -> exactly the
+    documented interventions, landed in the decision JSONL, the
+    bfmonitor report, and reproduced by ``bfctl replay``."""
+    import subprocess
+    import time as _time
+    from bluefog_tpu import control as CTLMOD
+    from bluefog_tpu.observability import commprof as CPROF
+    from bluefog_tpu.observability import metrics as MET
+
+    MET.enable()
+
+    def run(prefix, opt, ctl, steps, params):
+        grads = jax.tree.map(jnp.zeros_like, params)
+        state = opt.init(params)
+        p, series = params, []
+        for t in range(steps):
+            p, state, snap = opt.step(p, grads, state, t)
+            EX.log_step(t, snap)
+            series.append(float(np.asarray(snap.consensus_dist).mean()))
+        return series
+
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)}
+    cfg = CTLMOD.ControlConfig(every=4, cooldown=4, rearm_after=2)
+
+    # -- leg A: dead static exchange + env-injected slow edge -----------
+    from bluefog_tpu.context import ctx
+    edges = CPROF.topology_edges(ctx().compiled_topology)
+    seed = edges[len(edges) // 2]
+    os.environ["BLUEFOG_EDGE_PROBE_DELAY_US"] = \
+        f"{seed[0]}-{seed[1]}:20000"
+    try:
+        mat = CPROF.probe_edges(sizes=(4096,), repeats=2, inner=2,
+                                export=False)
+    finally:
+        del os.environ["BLUEFOG_EDGE_PROBE_DELAY_US"]
+    if mat.slowest_edge() != seed:
+        fail(f"edge probe ranked {mat.slowest_edge()} slowest, seeded "
+             f"slow edge was {seed}")
+    usable, why = CPROF.matrix_is_usable(mat)
+    if not usable:
+        fail(f"live probe matrix unusable: {why}")
+
+    sched_prefix = os.path.join(tmp, "ctl_sched_")
+    sw = CTLMOD.build_switchable_schedule(static_matrix=np.eye(n),
+                                          cost_matrix=mat)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True, sched=sw.sched, control=True)
+    EX.metrics_start(sched_prefix, rank=0)
+    ctl = CTLMOD.Controller(opt, schedule=sw, prefix=sched_prefix,
+                            mode="on", initial_mode="static", config=cfg)
+    builds0 = MET.registry.counter("bf_step_cache_total").value(
+        result="build")
+    CPROF.export_edge_matrix(mat)      # staged: rides the first record
+    series = run(sched_prefix, opt, ctl, CONTROL_STEPS, params)
+    EX.metrics_end()
+    builds = MET.registry.counter("bf_step_cache_total").value(
+        result="build") - builds0
+    if builds > 1:
+        fail(f"controller episode recompiled the step: {builds} builds "
+             f"(expected the single warmup build)")
+    sched_sigs = [(d.knob, d.action, d.value, d.rule)
+                  for d in ctl.decisions]
+    if ("schedule", "switch", "dynamic", "consensus_stall") \
+            not in sched_sigs:
+        fail(f"consensus stall did not switch the schedule: {sched_sigs}")
+    if ("schedule", "rearm", "cost", "rearm") not in sched_sigs:
+        fail(f"slow edge did not re-arm onto the cost mode: {sched_sigs}")
+    if not series[-1] < 1e-3 * series[0]:
+        fail(f"switched schedule did not contract consensus: "
+             f"{series[0]} -> {series[-1]}")
+
+    # -- leg B: the γ >> ω seeded run (docs/compression.md) -------------
+    gamma_prefix = os.path.join(tmp, "ctl_gamma_")
+    opt2 = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True,
+        compression="choco:topk:0.1:gamma=0.5", control=True)
+    EX.metrics_start(gamma_prefix, rank=0)
+    ctl2 = CTLMOD.Controller(
+        opt2, prefix=gamma_prefix, mode="on",
+        config=CTLMOD.ControlConfig(every=4, cooldown=8, rearm_after=2))
+    gseries = run(gamma_prefix, opt2, ctl2, GAMMA_STEPS, params)
+    EX.metrics_end()
+    gamma_sigs = [(d.knob, d.action) for d in ctl2.decisions]
+    if ("gamma", "backoff") not in gamma_sigs:
+        fail(f"gamma >> omega run raised no backoff: {gamma_sigs}")
+    if not (np.isfinite(gseries).all() and gseries[-1] < gseries[0]):
+        fail(f"controlled gamma run did not stay contracting: "
+             f"{gseries[0]} -> {gseries[-1]}")
+
+    # -- decision JSONL schema + bfmonitor report -----------------------
+    for prefix, want in ((sched_prefix, "schedule:switch"),
+                         (gamma_prefix, "gamma:backoff")):
+        trail = prefix + CTLMOD.DECISIONS_SUFFIX
+        try:
+            EX.validate_jsonl(trail)
+        except ValueError as e:
+            fail(f"decision trail schema violation: {e}")
+        _, out = bfmonitor_json(prefix)
+        block = out.get("decisions")
+        if not block or want not in block.get("counts", {}):
+            fail(f"bfmonitor report missing {want!r} decision: {block}")
+
+    # -- bfctl replay reproduces both trails ----------------------------
+    for prefix in (sched_prefix, gamma_prefix):
+        trail = prefix + CTLMOD.DECISIONS_SUFFIX
+        r = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.run.ctl", "replay",
+             prefix, "--expect", trail],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            fail(f"bfctl replay did not reproduce {trail}: "
+                 f"{r.stdout[-300:]} {r.stderr[-300:]}")
+
+    return {
+        "seeded_edge": list(seed),
+        "schedule_decisions": [list(s) for s in sched_sigs],
+        "gamma_decisions": [[d.step, d.action, d.value]
+                            for d in ctl2.decisions],
+        "sched_consensus": [round(series[0], 4), round(series[-1], 6)],
+        "gamma_consensus": [round(gseries[0], 4), round(gseries[-1], 6)],
+        "episode_builds": int(builds),
+    }
+
+
 OVERLAP_SYNC_MAX, OVERLAP_PIPE_MIN = 0.2, 0.25
 TRACE_SKEW_US, TRACE_ROUNDS = 250000.0, 8
 TRACE_TOL_US = 30000.0     # sleep() oversleep drift accumulates per round
@@ -341,6 +483,7 @@ def main():
     do_compress = "--compress" in sys.argv
     do_health = "--health" in sys.argv
     do_profile = "--profile" in sys.argv
+    do_control = "--control" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -418,6 +561,12 @@ def main():
         EX.metrics_end()           # release the sink for the probe legs
         profile_out = profile_legs(n, tmp)
 
+    # -- closed-loop controller gate (--control / make control-smoke) ---
+    control_out = None
+    if do_control:
+        EX.metrics_end()           # release the sink for the episode legs
+        control_out = control_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -448,6 +597,8 @@ def main():
         out["health"] = health_out
     if profile_out:
         out["profile"] = profile_out
+    if control_out:
+        out["control"] = control_out
     print(json.dumps(out))
 
 

@@ -1,0 +1,550 @@
+"""Closed-loop adaptive controller (PR 9, ``bluefog_tpu/control/``).
+
+Covers the acceptance surface end to end on the virtual mesh:
+
+* clean-run silence — the 20-step reference fleet (the health engine's
+  zero-false-alarm calibration run) produces ZERO interventions in
+  ``on`` mode and an EMPTY decision trail in ``shadow`` mode;
+* each seeded anomaly maps to exactly its documented intervention —
+  a dead static exchange raises ``consensus_stall`` and the controller
+  switches to the one-peer dynamic schedule (then re-arms to the
+  cost-reweighted mode while the measured slow edge persists), and the
+  docs/compression.md "γ ≫ ω diverges" seeded run gets its γ backoff
+  BEFORE the uncontrolled divergence step;
+* a full controller episode (schedule switch + γ backoff + re-arm)
+  triggers zero STEP recompiles — every actuated knob is traced data;
+* hysteresis / per-knob cooldowns, shadow-vs-on decision-trail parity,
+  the stale/foreign edge-matrix guard (``commprof.matrix_is_usable``),
+  the ``validate_jsonl`` decisions schema, and ``bfctl replay``
+  reproducing a live trail from the recorded telemetry.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import control as CTL
+from bluefog_tpu.control import policy as POL
+from bluefog_tpu.observability import aggregate as AGG
+from bluefog_tpu.observability import commprof as CPROF
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.observability import health as H
+from bluefog_tpu.observability import metrics as MET
+from bluefog_tpu.run import ctl as BFCTL
+from bluefog_tpu.run import monitor as MON
+
+
+def global_params(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)}
+
+
+def run_loop(opt, params, steps, log=True):
+    """Consensus-only loop (lr 0): the step IS the exchange.  Returns
+    the per-step mean consensus distances."""
+    grads = jax.tree.map(jnp.zeros_like, params)
+    state = opt.init(params)
+    p, out = params, []
+    for t in range(steps):
+        p, state, snap = opt.step(p, grads, state, t)
+        if log:
+            EX.log_step(t, snap)
+        out.append(float(np.asarray(snap.consensus_dist).mean()))
+    return out
+
+
+@pytest.fixture()
+def sink(tmp_path, bf_ctx):
+    """Open metrics JSONL sink + registry; yields the series prefix."""
+    prefix = str(tmp_path / "series_")
+    MET.enable()
+    EX.metrics_start(prefix, rank=0)
+    yield prefix
+    if EX.metrics_active():
+        EX.metrics_end()
+
+
+# ---------------------------------------------------------------------------
+# Switchable schedule: the zero-recompile actuation channel
+# ---------------------------------------------------------------------------
+
+def test_switchable_schedule_modes_and_mapping(bf_ctx):
+    n = bf.size()
+    W = np.asarray(bf_ctx.compiled_topology.weight_matrix)
+    sw = CTL.build_switchable_schedule()
+    assert sw.mode_names == ("static", "dynamic")
+    T = sw.base_period
+    assert sw.sched.period == 2 * T
+    # static mode rows are the compiled matrix, every step
+    np.testing.assert_allclose(sw.matrices_for("static"),
+                               np.repeat(W[None], T, 0))
+    # dynamic mode rows are the one-peer schedule's matrices
+    from bluefog_tpu.parallel import dynamic as DYN
+    digraph = bf.load_topology()
+    factory = lambda r: DYN.GetDynamicOnePeerSendRecvRanks(digraph, r)
+    np.testing.assert_allclose(sw.matrices_for("dynamic"),
+                               DYN.dynamic_mixing_matrices(factory, n, T))
+    # the virtual step selects mode rows: vstep % period lands in the
+    # mode's block for every (step, mode)
+    for mode in range(2):
+        for step in (0, 1, T, 7 * T + 3):
+            v = sw.virtual_step(step, mode)
+            assert v % sw.sched.period == mode * T + step % T
+
+
+def test_cost_mode_downweights_slow_edge(bf_ctx):
+    n = bf.size()
+    edges = CPROF.topology_edges()
+    seed = edges[len(edges) // 2]
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=1, inner=2,
+                            inject_delay_s={seed: 0.02}, export=False)
+    W = np.asarray(bf_ctx.compiled_topology.weight_matrix)
+    Wc = CTL.reweight_matrix_by_cost(W, mat)
+    # column-stochasticity (mass conservation) preserved exactly
+    np.testing.assert_allclose(Wc.sum(axis=0), np.ones(n), atol=1e-12)
+    # the seeded slow edge lost weight relative to its column peers
+    s, d = seed
+    assert Wc[s, d] < W[s, d]
+    sw = CTL.build_switchable_schedule(cost_matrix=mat)
+    assert sw.mode_names == ("static", "dynamic", "cost")
+
+
+def test_static_mode_matches_plain_topology_step(bf_ctx):
+    """Mode 0 of a switchable schedule is the SAME mix as the plain
+    static-topology optimizer — switching in the controller's schedule
+    must not change the healthy-path numerics."""
+    n = bf.size()
+    params = global_params(n)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    sw = CTL.build_switchable_schedule()
+    plain = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    switched = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), sched=sw.sched)
+    p1, _ = plain.step(params, grads, plain.init(params), 0)
+    p2, _ = switched.step(params, grads, switched.init(params),
+                          sw.virtual_step(0, sw.mode_index("static")))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Clean-run silence (the zero-false-intervention calibration)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["on", "shadow"])
+def test_clean_run_zero_interventions(sink, mode):
+    n = bf.size()
+    sw = CTL.build_switchable_schedule()
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True, sched=sw.sched,
+        control=(mode == "on"))
+    ctl = CTL.Controller(opt, schedule=sw, prefix=sink, mode=mode,
+                         config=CTL.ControlConfig(every=4, cooldown=4))
+    cds = run_loop(opt, global_params(n), 20)
+    assert ctl.decisions == []
+    assert not os.path.exists(sink + CTL.DECISIONS_SUFFIX)
+    assert cds[-1] < cds[0]            # the reference run still contracts
+
+
+# ---------------------------------------------------------------------------
+# Seeded anomalies -> documented interventions
+# ---------------------------------------------------------------------------
+
+def _stall_run(prefix, mode, steps=28, artifact_path=None):
+    """Dead static exchange (identity mixing) + measured slow edge:
+    the consensus_stall -> dynamic -> cost episode.  The matrix feeds
+    the controller in-series (staged onto the first record) by default,
+    or via a gated ``edges_artifact`` when ``artifact_path`` is set."""
+    n = bf.size()
+    edges = CPROF.topology_edges()
+    seed = edges[len(edges) // 2]
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=1, inner=2,
+                            inject_delay_s={seed: 0.02}, export=False)
+    sw = CTL.build_switchable_schedule(static_matrix=np.eye(n),
+                                       cost_matrix=mat)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True, sched=sw.sched,
+        control=(mode == "on"))
+    if artifact_path is not None:
+        mat.save(artifact_path)
+    ctl = CTL.Controller(
+        opt, schedule=sw, prefix=prefix, mode=mode, initial_mode="static",
+        edges_artifact=artifact_path,
+        config=CTL.ControlConfig(every=4, cooldown=4, rearm_after=2))
+    if artifact_path is None:
+        CPROF.export_edge_matrix(mat)  # staged: rides the first record
+    cds = run_loop(opt, global_params(n), steps)
+    return ctl, cds, seed
+
+
+def test_stall_switches_schedule_then_rearms_to_cost(sink):
+    ctl, cds, seed = _stall_run(sink, "on")
+    sigs = [(d.knob, d.action, d.value, d.rule) for d in ctl.decisions]
+    assert sigs == [
+        ("schedule", "switch", "dynamic", "consensus_stall"),
+        ("schedule", "rearm", "cost", "rearm"),
+    ]
+    assert all(d.applied for d in ctl.decisions)
+    # the intervention worked: the dead exchange was flat, the switched
+    # schedule contracts to consensus
+    switch_step = ctl.decisions[0].step
+    assert cds[switch_step] == pytest.approx(cds[0])
+    assert cds[-1] < 1e-3 * cds[0]
+    # trail on disk + the bfmonitor panel both carry the episode
+    EX.metrics_end()
+    path = sink + CTL.DECISIONS_SUFFIX
+    head, recs = CTL.read_decisions(path)
+    assert head["modes"] == ["static", "dynamic", "cost"]
+    assert [r["action"] for r in recs] == ["switch", "rearm"]
+    _, _, out = MON.build_report(sink)
+    assert out["decisions"]["total"] == 2
+    assert out["decisions"]["counts"] == {"schedule:switch": 1,
+                                          "schedule:rearm": 1}
+
+
+def test_shadow_logs_but_never_actuates(sink):
+    ctl, cds, _ = _stall_run(sink, "shadow")
+    # same first decision as the on-mode run, logged not applied
+    assert ctl.decisions
+    first = ctl.decisions[0]
+    assert (first.knob, first.action, first.value) == (
+        "schedule", "switch", "dynamic")
+    assert first.mode == "shadow" and not first.applied
+    # the system itself never moved: the dead exchange stayed dead
+    assert cds[-1] == pytest.approx(cds[0])
+    assert ctl.mode_name == "static"
+
+
+def test_gamma_backoff_intervenes_before_divergence(sink):
+    """docs/compression.md "γ stability": choco:topk:0.1 at γ=0.5
+    contracts for a few dozen steps and then DIVERGES.  The controller
+    must back γ off before the uncontrolled divergence step, and the
+    controlled run must keep contracting."""
+    n = bf.size()
+    steps = 80
+    params = global_params(n)
+    # uncontrolled: find the divergence step (consensus exceeds start)
+    opt0 = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True,
+        compression="choco:topk:0.1:gamma=0.5")
+    cds0 = run_loop(opt0, params, steps, log=False)
+    t_div = next((t for t in range(1, steps) if cds0[t] > cds0[0]), None)
+    assert t_div is not None, "seeded gamma >> omega run did not diverge"
+    # controlled: same seeded run with the gamma knob plumbed
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True,
+        compression="choco:topk:0.1:gamma=0.5", control=True)
+    ctl = CTL.Controller(
+        opt, prefix=sink, mode="on",
+        config=CTL.ControlConfig(every=4, cooldown=8, rearm_after=2))
+    cds = run_loop(opt, params, steps)
+    backoffs = [d for d in ctl.decisions if d.action == "backoff"]
+    assert backoffs, "no gamma backoff fired"
+    assert backoffs[0].knob == "gamma" and backoffs[0].applied
+    assert backoffs[0].step < t_div
+    # the intervention held the run stable: still contracted, no blowup
+    assert cds[-1] < 0.01 * cds[0]
+    assert max(cds) <= max(cds0[0] * 1.5, cds[0])
+
+
+# ---------------------------------------------------------------------------
+# Zero recompiles across a full episode
+# ---------------------------------------------------------------------------
+
+def _builds():
+    return MET.registry.counter("bf_step_cache_total").value(result="build")
+
+
+def test_full_episode_zero_step_recompiles(sink):
+    """Schedule switch + γ backoff + re-arm — every intervention is
+    traced data; the step cache never rebuilds after warmup."""
+    n = bf.size()
+    params = global_params(n)
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    # -- schedule episode ---------------------------------------------------
+    sw = CTL.build_switchable_schedule(
+        cost_matrix=CPROF.probe_edges(sizes=(4096,), repeats=1, inner=2,
+                                      export=False))
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), sched=sw.sched, control=True)
+    act = CTL.Actuator(opt, schedule=sw, mode="on")
+    opt.attach_controller(act)
+    state = opt.init(params)
+    p, state = opt.step(params, grads, state, 0)      # warmup build
+    before = _builds()
+    for mode in ("dynamic", "cost", "static"):
+        act.apply(POL.Decision(step=0, knob="schedule", action="switch",
+                               value=mode, prev=act.mode_name,
+                               rule="test", reason=""))
+        p, state = opt.step(p, grads, state, 1)
+    assert _builds() == before
+
+    # -- gamma episode ------------------------------------------------------
+    opt2 = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression="choco:int8", control=True)
+    act2 = CTL.Actuator(opt2, mode="on")
+    opt2.attach_controller(act2)
+    state2 = opt2.init(params)
+    p2, state2 = opt2.step(params, grads, state2, 0)  # warmup build
+    before = _builds()
+    # backoff -> steps -> re-arm: values are traced, never a rebuild
+    ref_p, ref_s = opt2.step(p2, grads, state2, 1)
+    act2.apply(POL.Decision(step=1, knob="gamma", action="backoff",
+                            value=0.25, prev=1.0, rule="test", reason=""))
+    low_p, low_s = opt2.step(p2, grads, state2, 1)
+    act2.apply(POL.Decision(step=2, knob="gamma", action="rearm",
+                            value=1.0, prev=0.25, rule="test", reason=""))
+    rearm_p, _ = opt2.step(p2, grads, state2, 1)
+    assert _builds() == before
+    # the knob genuinely acts: a backed-off gamma mixes differently,
+    # re-arming restores the full-rate result exactly
+    assert not np.allclose(np.asarray(ref_p["w"]), np.asarray(low_p["w"]))
+    np.testing.assert_array_equal(np.asarray(ref_p["w"]),
+                                  np.asarray(rearm_p["w"]))
+
+
+# ---------------------------------------------------------------------------
+# Hysteresis / cooldown (engine level, synthetic feeds)
+# ---------------------------------------------------------------------------
+
+def _fake_view(records_by_rank):
+    series = [AGG.RankSeries(rank=r, records=recs)
+              for r, recs in records_by_rank.items()]
+    return AGG.FleetView(series, [])
+
+
+def _report(step, *rules):
+    verdicts = [H.Verdict(rule=r, severity="warn", message=r)
+                for r in rules]
+    return H.HealthReport(step_lo=max(0, step - 7), step_hi=step,
+                          ranks=1, verdicts=verdicts)
+
+
+def test_cooldown_limits_decision_rate():
+    eng = POL.PolicyEngine(
+        POL.ControlConfig(cooldown=16, rearm_after=2),
+        modes=("static", "dynamic"), gamma=False)
+    view = _fake_view({0: [{"step": 0, "rank": 0}]})
+    d1 = eng.evaluate(view, _report(7, "consensus_stall"), 7)
+    assert [d.action for d in d1] == ["switch"]
+    # the verdict persists inside the cooldown window: no second decision
+    assert eng.evaluate(view, _report(15, "consensus_stall"), 15) == []
+    # already in dynamic mode after cooldown: still nothing to do
+    assert eng.evaluate(view, _report(31, "consensus_stall"), 31) == []
+
+
+def test_rearm_needs_healthy_streak_and_low_margin():
+    eng = POL.PolicyEngine(
+        POL.ControlConfig(cooldown=4, rearm_after=2, margin_window=8),
+        modes=("static", "dynamic"), gamma=True)
+    stall = _fake_view({0: [{"step": 0, "rank": 0}]})
+    assert eng.evaluate(stall, _report(3, "consensus_stall"), 3)
+    # margin high + not contracting: gamma backs off (hysteresis upper)
+    hot = _fake_view({0: [
+        {"step": s, "rank": 0, "residual_norm": 0.9, "param_norm": 1.0}
+        for s in range(8, 12)]})
+    d = eng.evaluate(hot, _report(11), 11)
+    assert [x.knob for x in d] == ["gamma"]
+    assert eng.gamma_scale == 0.5
+    # healthy but streak too short -> no re-arm yet; margin must also be
+    # BELOW the distinct residual_low floor (hysteresis lower)
+    cool = _fake_view({0: [
+        {"step": s, "rank": 0, "residual_norm": 0.05, "param_norm": 1.0}
+        for s in range(12, 16)]})
+    assert eng.evaluate(cool, _report(15), 15) == []      # streak == 1
+    mid = _fake_view({0: [
+        {"step": s, "rank": 0, "residual_norm": 0.3, "param_norm": 1.0}
+        for s in range(16, 20)]})
+    # streak reaches 2: the SCHEDULE re-arms, but gamma stays backed off
+    # — margin 0.3 sits inside the hysteresis band (low 0.1, high 0.5)
+    out = eng.evaluate(mid, _report(19), 19)
+    assert [(x.knob, x.action) for x in out] == [("schedule", "rearm")]
+    assert eng.gamma_scale == 0.5
+    out = eng.evaluate(cool, _report(23), 23)             # margin < low
+    assert [(x.knob, x.action) for x in out] == [("gamma", "rearm")]
+    assert eng.gamma_scale == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Sensing-artifact guard
+# ---------------------------------------------------------------------------
+
+def test_matrix_is_usable_guards_platform_and_age(tmp_path, bf_ctx):
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=1, inner=1,
+                            export=False)
+    ok, _ = CPROF.matrix_is_usable(mat)
+    assert ok
+    foreign = CPROF.EdgeCostMatrix(n=mat.n, entries=mat.entries,
+                                   platform="tpu")
+    ok, why = CPROF.matrix_is_usable(foreign)
+    assert not ok and "tpu" in why
+    anon = CPROF.EdgeCostMatrix(n=mat.n, entries=mat.entries)
+    ok, why = CPROF.matrix_is_usable(anon)
+    assert not ok and "no platform" in why
+    # a stale artifact (mtime before the run epoch) is refused
+    path = str(tmp_path / "edges.json")
+    mat.save(path)
+    old = os.path.getmtime(path) - 3600
+    os.utime(path, (old, old))
+    ok, why = CPROF.matrix_is_usable(mat, path=path)
+    assert not ok and "predates" in why
+    os.utime(path)
+    ok, _ = CPROF.matrix_is_usable(mat, path=path)
+    assert ok
+
+
+def test_controller_refuses_foreign_artifact(sink, tmp_path):
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=1, inner=1,
+                            export=False)
+    doctored = CPROF.EdgeCostMatrix(n=mat.n, entries=mat.entries,
+                                    platform="tpu")
+    path = str(tmp_path / "edges.json")
+    doctored.save(path)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), telemetry=True, control=True)
+    ctl = CTL.Controller(opt, prefix=sink, mode="on",
+                         edges_artifact=path)
+    before = MET.registry.counter(
+        "bf_control_refused_matrix_total").value()
+    assert ctl._artifact() is None
+    assert MET.registry.counter(
+        "bf_control_refused_matrix_total").value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Decision trail schema + replay
+# ---------------------------------------------------------------------------
+
+def test_validate_jsonl_accepts_decision_trail(tmp_path):
+    path = str(tmp_path / "decisions.jsonl")
+    POL.write_config_record(path, {"modes": ["static"], "gamma": False})
+    d = POL.Decision(step=7, knob="schedule", action="switch",
+                     value="dynamic", prev="static",
+                     rule="consensus_stall", reason="r", mode="on",
+                     applied=True)
+    rec = POL.write_decision(path, d)
+    # unknown fields must be tolerated (forward compatibility)
+    rec2 = dict(rec)
+    rec2["future_field"] = {"nested": 1}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec2) + "\n")
+    records = EX.validate_jsonl(path)
+    assert [r.get("kind") for r in records] == [
+        "control_config", "decision", "decision"]
+    # ...but a malformed decision is rejected
+    bad = dict(rec)
+    bad["mode"] = "maybe"
+    with open(path, "a") as f:
+        f.write(json.dumps(bad) + "\n")
+    with pytest.raises(ValueError, match="mode"):
+        EX.validate_jsonl(path)
+
+
+def test_shadow_and_on_trails_match_on_recorded_telemetry(sink):
+    """The parity contract: over the SAME recorded telemetry the policy
+    emits identical decision signatures whether it actuates or only
+    shadows — mode/applied are the only differences."""
+    ctl, _, _ = _stall_run(sink, "on")
+    EX.metrics_end()
+    live = [d.signature() for d in ctl.decisions]
+    assert live
+    head, _ = CTL.read_decisions(sink + CTL.DECISIONS_SUFFIX)
+    for mode in ("shadow", "on"):
+        eng = POL.PolicyEngine(
+            POL.ControlConfig(**head["cfg"]), modes=head["modes"],
+            initial_mode=head["initial_mode"], gamma=head["gamma"])
+        replayed = BFCTL.replay(sink, head=head, engine=eng, mode=mode)
+        assert [d.signature() for d in replayed] == live
+
+
+def test_apply_refuses_unplumbed_gamma_knob(bf_ctx):
+    """An optimizer built WITHOUT control plumbing must never log a
+    gamma intervention as applied — the traced program ignores the knob,
+    and an applied:true trail entry would be a lie."""
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression="choco:int8", control=False)
+    act = CTL.Actuator(opt, mode="on")
+    d = POL.Decision(step=0, knob="gamma", action="backoff", value=0.5,
+                     prev=1.0, rule="t", reason="")
+    assert act.apply(d) is False
+    plumbed = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), compression="choco:int8", control=True)
+    act2 = CTL.Actuator(plumbed, mode="on")
+    assert act2.apply(d) is True
+    assert plumbed.control_knobs["gamma_scale"] == 0.5
+
+
+def test_replay_survives_midfile_corruption(sink):
+    """series_gap alerts are loader I/O artifacts, invisible to a
+    replay over the finished files — the engine ignores them, so a
+    corrupted-but-tolerated series still replays to the live trail."""
+    _stall_run(sink, "on")
+    EX.metrics_end()
+    path = sink + "0.jsonl"
+    with open(path) as f:
+        lines = f.readlines()
+    lines.insert(len(lines) // 2, "{not json garbage\n")
+    with open(path, "w") as f:
+        f.writelines(lines)
+    trail = sink + CTL.DECISIONS_SUFFIX
+    assert BFCTL.main(["replay", sink, "--expect", trail]) == 0
+
+
+def test_artifact_driven_decisions_replay(sink, tmp_path):
+    """A controller fed by an edges ARTIFACT records the gated entries
+    in the trail's head record, so the cost re-arm stays replayable even
+    though the entries never rode the telemetry JSONL."""
+    ctl, _, _ = _stall_run(sink, "on",
+                           artifact_path=str(tmp_path / "edges.json"))
+    EX.metrics_end()
+    sigs = [(d.knob, d.action, d.value) for d in ctl.decisions]
+    assert ("schedule", "rearm", "cost") in sigs
+    trail = sink + CTL.DECISIONS_SUFFIX
+    head, _ = CTL.read_decisions(trail)
+    assert head.get("artifact_entries")
+    assert BFCTL.main(["replay", sink, "--expect", trail]) == 0
+
+
+def test_rotation_preserves_head_record(tmp_path, monkeypatch):
+    """A size-rotated decision trail must re-emit its control_config
+    head record — the fresh file would otherwise orphan every later
+    decision from the engine identity replay needs."""
+    monkeypatch.setenv(EX.MAX_MB_ENV, "0.0002")     # ~200 bytes
+    path = str(tmp_path / "decisions.jsonl")
+    head = {"modes": ["static"], "initial_mode": "static", "gamma": False}
+    for step in range(4):
+        POL.write_decision(
+            path, POL.Decision(step=step, knob="schedule", action="switch",
+                               value="dynamic", prev="static", rule="t",
+                               reason="x" * 120, mode="on", applied=True),
+            header=head)
+    assert os.path.exists(path + ".1")              # rotation happened
+    config, decisions = CTL.read_decisions(path)
+    assert config is not None and config["modes"] == ["static"]
+    assert decisions                                # and decisions follow
+
+
+def test_bfctl_replay_reproduces_live_trail(sink, capsys):
+    ctl, _, _ = _stall_run(sink, "on")
+    EX.metrics_end()
+    trail = sink + CTL.DECISIONS_SUFFIX
+    assert BFCTL.main(["replay", sink, "--expect", trail]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["match"] and out["n"] == len(ctl.decisions)
+    # a doctored trail must NOT be reproduced (exit 1)
+    head, recs = CTL.read_decisions(trail)
+    recs[0]["value"] = "static"
+    with open(trail, "w") as f:
+        f.write(json.dumps(head) + "\n")
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert BFCTL.main(["replay", sink, "--expect", trail]) == 1
