@@ -20,7 +20,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
-        profile-smoke control-smoke
+        profile-smoke control-smoke serve-smoke bench-serve
 
 test:
 	$(PYTEST) tests/
@@ -168,6 +168,23 @@ profile-smoke:
 # `bfctl replay` reproducing the exact trail from the recorded telemetry.
 control-smoke:
 	python scripts/metrics_smoke.py --control
+
+# Serving-tier smoke (docs/serving.md): a clean publisher + 2-replica +
+# router episode must answer every request inside the staleness bound
+# with zero refusals/failovers and a schema-valid serving trail; a
+# starved replica (dedicated feed, publisher killed) must age past
+# BLUEFOG_SERVE_MAX_STALENESS and be shunned after exactly one stale
+# failover; a chaos-killed SERVING rank must trigger exactly one dead
+# failover with zero failed requests — all asserted through the real
+# `bfmonitor --once --json` "serving" block.
+serve-smoke:
+	python scripts/metrics_smoke.py --serve
+
+# Serving-tier bench (docs/serving.md): the end-to-end scenario on the
+# virtual mesh — one JSON line with requests/sec, staleness p50/p95/p99
+# (training steps), fold latency, and the zero-failover invariant.
+bench-serve:
+	python bench.py --serve
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

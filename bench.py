@@ -643,6 +643,121 @@ def profile_edges_main():
     print(json.dumps(out))
 
 
+def serve_main():
+    """Serving-tier mode (``--serve``, docs/serving.md): run the
+    end-to-end decentralized serving scenario — training ranks publish
+    weights through the compressed parameter window, replica ranks fold
+    them with bounded staleness, the host router answers batched
+    inference requests — and report requests/sec plus staleness
+    percentiles (p50/p95/p99 over the staleness of the replica that
+    answered each request, in training steps) as one JSON line.
+
+    CPU virtual mesh by default (the same explicit-platform policy as
+    ``--profile-edges``): absolute requests/sec on the virtual mesh is
+    host dispatch cost, but the staleness distribution, the fold
+    latency, and the zero-failover/zero-refusal invariants are
+    platform-independent.  Knobs: ``BENCH_SERVE_STEPS`` (default 30),
+    ``BENCH_SERVE_REQUESTS`` per step (default 8),
+    ``BLUEFOG_SERVE_COMPRESS`` (wire codec, default int8 here),
+    ``BLUEFOG_SERVE_MAX_STALENESS``, ``BLUEFOG_SERVE_PUBLISH_EVERY``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+    bf_metrics.enable()
+
+    from bluefog_tpu.models.mlp import MLP
+    from bluefog_tpu.serving import (NoReplicaAvailable, ReplicaSet,
+                                     RequestRouter, WeightPublisher)
+
+    bf.init()
+    n = bf.size()
+    if n < 4:
+        print(json.dumps({"mode": "serve", "status": "skipped",
+                          "reason": f"need >= 4 ranks, mesh has {n}"}))
+        return
+    steps = int(os.environ.get("BENCH_SERVE_STEPS", "30"))
+    req_per_step = int(os.environ.get("BENCH_SERVE_REQUESTS", "8"))
+    # default cadence 2 here (not the library's 1): a bench whose
+    # staleness distribution is identically zero reports nothing about
+    # the bounded-staleness machinery; publishing every 2nd step makes
+    # the p50/p95 split visible while staying far inside the bound
+    os.environ.setdefault("BLUEFOG_SERVE_PUBLISH_EVERY", "2")
+    publishers = list(range(n // 2))
+    replicas = list(range(n // 2, n))
+    compression = os.environ.get("BLUEFOG_SERVE_COMPRESS", "int8")
+
+    model = MLP(features=(32, 32), num_outputs=10)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    step_fn = T.make_train_step(model, base,
+                                communication="neighbor_allreduce")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 4, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 4)))
+
+    pub = WeightPublisher(variables["params"], publishers, replicas,
+                          compression=compression)
+    apply_fn = lambda p, batch: model.apply({"params": p}, batch)
+    reps = ReplicaSet(pub, apply_fn)
+    router = RequestRouter(reps)
+    req = jnp.asarray(rng.normal(size=(2, 8, 8, 1)), jnp.float32)
+
+    fold_times = []
+    t_serve0 = time.perf_counter()
+    for t in range(steps):
+        variables, opt_state, loss = step_fn(
+            variables, opt_state, (x, y), jnp.int32(t))
+        pub.maybe_publish(variables["params"], t)
+        reps.refresh(t)
+        fold_times.append(reps.last_fold_s)
+        for _ in range(req_per_step):
+            try:
+                router.route(req, t)
+            except NoReplicaAvailable:
+                # a cadence/bound combination can legally refuse (e.g.
+                # BLUEFOG_SERVE_PUBLISH_EVERY > the staleness bound) —
+                # the bench reports it instead of crashing mid-loop
+                continue
+    jax.block_until_ready(variables)
+    dt = time.perf_counter() - t_serve0
+
+    samples = np.asarray(router.staleness_samples, np.float64)
+    pct = (lambda q: float(np.percentile(samples, q))) if samples.size \
+        else (lambda q: None)
+    total = int(sum(router.hits.values()))
+    out = {
+        "mode": "serve",
+        "mesh": n,
+        "platform": jax.default_backend(),
+        "publishers": publishers,
+        "replicas": replicas,
+        "compression": compression,
+        "steps": steps,
+        "requests": total,
+        "requests_per_s": round(total / dt, 1),
+        "staleness_p50": pct(50),
+        "staleness_p95": pct(95),
+        "staleness_p99": pct(99),
+        "staleness_max": float(samples.max()) if samples.size else None,
+        "max_staleness_bound": reps.max_staleness,
+        "publish_every": pub.publish_every,
+        "fold_ms_mean": round(float(np.mean(fold_times)) * 1e3, 3),
+        "failovers": len(router.failovers),
+        "refused": router.refused,
+        "final_loss": float(loss),
+        "metrics": bf_metrics.registry.snapshot(),
+    }
+    router.close()
+    reps.close()
+    print(json.dumps(out))
+
+
 def main():
     # host metrics registry on for the whole run: the final snapshot is
     # embedded in the result JSON ("metrics": fusion plan shape/padding
@@ -935,5 +1050,7 @@ if __name__ == "__main__":
         trace_only_main()
     elif "--profile-edges" in sys.argv:
         profile_edges_main()
+    elif "--serve" in sys.argv:
+        serve_main()
     else:
         main()

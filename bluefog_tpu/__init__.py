@@ -84,6 +84,7 @@ from .ops.api import (
 from . import compress
 from . import control
 from . import resilience
+from . import serving
 
 from .ops.ring_attention import (
     attention, ring_attention, ulysses_attention,

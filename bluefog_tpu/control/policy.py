@@ -464,25 +464,8 @@ def write_decision(path: str, decision: Decision,
 def read_decisions(path: str) -> Tuple[Optional[dict], List[dict]]:
     """Parse a decision trail tolerantly: ``(config_record, decisions)``
     — unknown lines are skipped, a missing file reads as empty (the
-    monitor's discovery probe must never raise)."""
-    config = None
-    decisions: List[dict] = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if not isinstance(rec, dict):
-                    continue
-                if rec.get("kind") == "control_config" and config is None:
-                    config = rec
-                elif rec.get("kind") == "decision":
-                    decisions.append(rec)
-    except OSError:
-        pass
-    return config, decisions
+    monitor's discovery probe must never raise).  One shared reader
+    serves every sidecar trail (``observability/export.py::read_trail``;
+    the serving trail rides the same helper)."""
+    from ..observability.export import read_trail
+    return read_trail(path, "control_config", kinds=("decision",))

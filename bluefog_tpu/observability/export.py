@@ -31,7 +31,7 @@ __all__ = [
     "METRICS_ENV", "metrics_start", "metrics_end", "metrics_active",
     "metrics_path", "log_step", "telemetry_to_host", "prometheus_text",
     "validate_jsonl", "REQUIRED_JSONL_KEYS", "resolve_rotation",
-    "rotate_file", "MAX_MB_ENV", "KEEP_ENV",
+    "rotate_file", "read_trail", "MAX_MB_ENV", "KEEP_ENV",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -58,6 +58,37 @@ def resolve_rotation(max_mb: Optional[float] = None,
     if keep is None:
         keep = int(os.environ.get(KEEP_ENV, str(DEFAULT_KEEP)))
     return int(max_mb * (1 << 20)), max(1, keep)
+
+
+def read_trail(path: str, config_kind: str, kinds=None):
+    """Tolerant sidecar-trail reader shared by the controller's decision
+    trail and the serving trail: ``(config_record_or_None, records)``.
+
+    Unparseable or non-object lines are skipped, a missing file reads as
+    empty (a monitor's discovery probe must never raise), and the FIRST
+    ``config_kind`` record wins as the head.  ``kinds`` (optional tuple)
+    keeps only records of those kinds; None keeps every non-config
+    record."""
+    config, records = None, []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("kind") == config_kind and config is None:
+                    config = rec
+                elif kinds is None or rec.get("kind") in kinds:
+                    records.append(rec)
+    except OSError:
+        pass
+    return config, records
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -323,10 +354,17 @@ _EDGE_KEYS = ("src", "dst", "bytes", "latency_us", "gbps")
 # "control_config" line the trail's replayable head record.  Lines of
 # these kinds replace the telemetry-record required keys (they carry no
 # "rank" — decisions are fleet-scoped) but keep the numeric-finiteness
-# and unknown-field-tolerance contracts.
+# and unknown-field-tolerance contracts.  The serving trail
+# (serving/router.py, ``<prefix>serving.jsonl``) follows the same
+# pattern: a "serve_config" head record, periodic "serve" records
+# (per-replica staleness + request rate), and "serve_failover" events.
 _KIND_REQUIRED = {
     "decision": ("step", "t_us", "knob", "action", "mode", "applied"),
     "control_config": ("t_us",),
+    "serve": ("step", "t_us", "requests_per_s"),
+    "serve_failover": ("step", "t_us", "replica_from", "replica_to",
+                       "reason"),
+    "serve_config": ("t_us",),
 }
 
 _DECISION_STR_KEYS = ("knob", "action", "mode")
@@ -350,10 +388,64 @@ def _check_decision(path, lineno, rec):
             f"{path}:{lineno}: decision field 'step' is not numeric")
 
 
+def _check_serve(path, lineno, rec):
+    """Serving-trail record shapes (serving/router.py): ``serve``
+    carries per-replica staleness + the request rate; ``serve_failover``
+    one sticky-target switch.  Unknown fields stay tolerated."""
+    kind = rec["kind"]
+    if kind == "serve":
+        rps = rec["requests_per_s"]
+        if isinstance(rps, bool) or not isinstance(rps, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: 'requests_per_s' is not numeric")
+        for field in ("hits", "serve_staleness"):
+            v = rec.get(field)
+            if v is None:
+                continue
+            if not isinstance(v, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: {field!r} must be an object "
+                    f"(replica -> value)")
+            for k, x in v.items():
+                if isinstance(x, bool) or not isinstance(x, (int, float)):
+                    raise ValueError(
+                        f"{path}:{lineno}: {field}[{k!r}] is not numeric")
+    elif kind == "serve_failover":
+        if not isinstance(rec["reason"], str):
+            raise ValueError(
+                f"{path}:{lineno}: failover 'reason' must be a string")
+        for field in ("replica_from", "replica_to"):
+            v = rec[field]
+            # replica_to None = no surviving candidate (total outage)
+            if field == "replica_to" and v is None:
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: failover {field!r} is not numeric")
+
+
 def _check_structured(path, lineno, rec, check):
     """Shape checks for the documented structured fields: ``phases``
     (PR 7), ``step_wall_us`` (PR 7), ``edges`` and ``overlap_efficiency``
-    (PR 8).  ``counters`` stays free-form (registry snapshot)."""
+    (PR 8), ``serve_staleness`` (PR 11 — also valid staged onto a
+    telemetry record).  ``counters`` stays free-form (registry
+    snapshot)."""
+    stale = rec.get("serve_staleness")
+    if stale is not None and rec.get("kind") not in ("serve",):
+        # on a telemetry record: a per-replica map or an [N] list
+        if isinstance(stale, dict):
+            vals = stale.values()
+        elif isinstance(stale, list):
+            vals = stale
+        else:
+            raise ValueError(
+                f"{path}:{lineno}: 'serve_staleness' must be an object "
+                f"or list")
+        for x in vals:
+            if isinstance(x, bool) or not isinstance(x, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: 'serve_staleness' entry is not "
+                    f"numeric")
     phases = rec.get("phases")
     if phases is not None:
         if not isinstance(phases, dict):
@@ -399,9 +491,11 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     """Parse a metrics JSONL file, enforcing the schema: every line is a
     JSON object carrying ``required`` keys, every numeric field finite,
     and the documented structured fields (``phases``, ``step_wall_us``,
-    ``edges``, ``overlap_efficiency``) well-shaped.  Controller-trail
-    lines (``kind: decision`` / ``control_config``, control/policy.py)
-    validate against their own required keys and shape instead.  Fields
+    ``edges``, ``overlap_efficiency``, ``serve_staleness``) well-shaped.
+    Controller-trail lines (``kind: decision`` / ``control_config``,
+    control/policy.py) and serving-trail lines (``kind: serve`` /
+    ``serve_failover`` / ``serve_config``, serving/router.py) validate
+    against their own required keys and shape instead.  Fields
     the schema does not know are tolerated (forward compatibility is
     part of the contract and regression-tested).  Returns the records;
     raises ValueError on violations (the ``make metrics-smoke`` /
@@ -428,6 +522,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 raise ValueError(f"{path}:{lineno}: missing keys {missing}")
             if kind == "decision":
                 _check_decision(path, lineno, rec)
+            elif kind in ("serve", "serve_failover"):
+                _check_serve(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

@@ -289,7 +289,8 @@ def windows_exist() -> bool:
 def win_create(tensor, name: str, zero_init: bool = False,
                fuse: Optional[bool] = None,
                double_buffer: Optional[bool] = None,
-               compression=None) -> bool:
+               compression=None, topo: Optional[CompiledTopology] = None
+               ) -> bool:
     """Create a window: per-in-neighbor device buffers + versions + P
     (reference mpi_ops.py:998, mpi_controller.cc:793-866).
 
@@ -311,6 +312,14 @@ def win_create(tensor, name: str, zero_init: bool = False,
     buffers and ``win_update``'s local fold stay full precision
     (docs/compression.md).
 
+    ``topo`` (default: the context topology) lets a window live on its
+    OWN compiled graph — e.g. the serving tier's publisher->replica
+    parameter window (``bluefog_tpu/serving/``) moves weights along a
+    dedicated bipartite graph while training gossip keeps the context
+    topology.  The graph must span the full mesh (``topo.size ==
+    bf.size()``); its edges define the buffer slot layout exactly as the
+    context topology would.
+
     The topology is snapshotted at creation; like the reference
     (operations.cc:1286-1311), changing the topology while windows exist is
     refused by ``bf.set_topology``.
@@ -318,7 +327,12 @@ def win_create(tensor, name: str, zero_init: bool = False,
     if name in _windows:
         return False  # duplicate name (reference returns False, mpi_ops.py:1021)
     cx = ctx()
-    topo = cx.compiled_topology
+    if topo is None:
+        topo = cx.compiled_topology
+    elif topo.size != cx.size:
+        raise ValueError(
+            f"window topology is over {topo.size} ranks but the mesh has "
+            f"{cx.size}; a dedicated window graph must span the full mesh")
     tensor = jax.tree.map(jnp.asarray, tensor)
     for leaf in jax.tree.leaves(tensor):
         if leaf.shape[0] != cx.size:
@@ -848,12 +862,27 @@ def win_update(name: str,
     return w.external(tensor_new)
 
 
-def win_update_then_collect(name: str, require_mutex: bool = True):
+def win_update_then_collect(name: str, require_mutex: bool = True,
+                            alive=None):
     """``win_update`` with self/neighbor weights 1.0 and reset=True — the
-    push-sum collect step (mpi_ops.py:1048-1064)."""
+    push-sum collect step (mpi_ops.py:1048-1064).
+
+    ``alive`` (optional [N] mask): dead in-neighbors are DROPPED from the
+    sum — unlike :func:`win_update`'s averaging fold, collect is a sum,
+    so a dead row's undelivered mass must vanish rather than move to the
+    self weight (inflating ``t`` by the lost weight would double-count).
+    The associated-P scalar rides the identical masked weights, so
+    push-sum's ``x / P`` de-biasing stays exact under the mask.  The
+    mask composes with window wire compression (``win_create(
+    compression=)``) — the buffers being dropped hold decoded full-
+    precision values either way."""
     w = _window(name)
     U = (w.topo.weight_matrix != 0).astype(np.float64)
     np.fill_diagonal(U, 0.0)
+    if alive is not None:
+        # pre-masked here (NOT via win_update(alive=), whose averaging
+        # semantics move the lost mass onto the self weight)
+        U = U * np.asarray(alive, np.float64).reshape(-1)[:, None]
     return win_update(name, self_weight=1.0, neighbor_weights=U, reset=True,
                       require_mutex=require_mutex)
 

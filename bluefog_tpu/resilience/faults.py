@@ -72,6 +72,19 @@ class CompiledFaultPlan:
         t = min(step, self.horizon - 1)
         return int((self.alive[t] == 0).sum())
 
+    def alive_at(self, step: int) -> np.ndarray:
+        """Host-side [N] liveness row at ``step`` (clamped to the
+        horizon) — the mask host-side consumers (the serving router,
+        ``win_update(alive=)`` callers, report code) feed per step
+        without instantiating device tables."""
+        return self.alive[min(step, self.horizon - 1)]
+
+    def active_at(self, step: int) -> np.ndarray:
+        """Host-side [N] participation row at ``step`` (stragglers are
+        alive but intermittently active — e.g. a publisher that only
+        ships weights on its active steps)."""
+        return self.active[min(step, self.horizon - 1)]
+
 
 def at_step(tables: Dict, step):
     """Index the device tables with a traced step (clamped to the horizon).

@@ -34,7 +34,7 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
-           "render_edge_heatmap", "render_decisions"]
+           "render_edge_heatmap", "render_decisions", "render_serving"]
 
 _TICKS = "▁▂▃▄▅▆▇█"
 _SEV_TAG = {"critical": "CRIT", "warn": "warn", "info": "info"}
@@ -101,6 +101,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  expected_ranks: Optional[int] = None,
                  verdicts_path: Optional[str] = None,
                  decisions_path: Optional[str] = None,
+                 serving_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -110,7 +111,12 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     ``decisions_path``: the closed-loop controller's decision trail
     (default discovery: ``<prefix>decisions.jsonl`` — the path
     ``control.Controller`` writes) — its decisions render as the
-    dashboard's decisions panel and ride the ``--json`` report."""
+    dashboard's decisions panel and ride the ``--json`` report.
+    ``serving_path``: the serving tier's trail (default discovery:
+    ``<prefix>serving.jsonl``, ``serving/router.py``) — replica
+    staleness, request rate, and failover events become the
+    ``"serving"`` block (a controller endpoint) and the ``--serving``
+    panel."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -176,6 +182,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
         "gaps": [g.asdict() for g in view.gaps],
     }
     out["decisions"] = _decisions_block(prefix, decisions_path)
+    out["serving"] = _serving_block(prefix, serving_path)
     return view, report, _strict_json(out)
 
 
@@ -200,6 +207,87 @@ def _decisions_block(prefix: str,
         "counts": counts,
         "recent": decisions[-8:],
     }
+
+
+def _serving_block(prefix: str,
+                   serving_path: Optional[str]) -> Optional[dict]:
+    """The serving tier's trail as a report block: per-replica staleness
+    (latest + the trailing series the panel sparklines), router hit
+    counts, request rate, and failover events — None when no trail
+    exists (a run without a serving tier stays noise-free)."""
+    from ..serving import SERVING_SUFFIX, read_serving_trail
+    path = serving_path or prefix + SERVING_SUFFIX
+    config, records = read_serving_trail(path)
+    if config is None and not records:
+        return None
+    serves = [r for r in records if r.get("kind") == "serve"]
+    failovers = [r for r in records if r.get("kind") == "serve_failover"]
+    replicas = [str(r) for r in (config or {}).get("replicas", [])]
+    if not replicas and serves:
+        # rank order, not lexicographic: '10' must not sort before '2'
+        replicas = sorted((serves[-1].get("serve_staleness") or {}).keys(),
+                          key=lambda k: (0, int(k)) if k.isdigit()
+                          else (1, k))
+    staleness = {}
+    for rep in replicas:
+        series = [s["serve_staleness"][rep] for s in serves
+                  if isinstance(s.get("serve_staleness"), dict)
+                  and rep in s["serve_staleness"]]
+        staleness[rep] = {
+            "last": series[-1] if series else None,
+            "series": series[-24:],
+        }
+    latest = serves[-1] if serves else {}
+    return {
+        "path": path,
+        "window": (config or {}).get("window"),
+        "max_staleness": (config or {}).get("max_staleness"),
+        "replicas": replicas,
+        "step": latest.get("step"),
+        "requests_per_s": latest.get("requests_per_s"),
+        "hits": latest.get("hits"),
+        "refused": latest.get("refused"),
+        "current": latest.get("current"),
+        "staleness": staleness,
+        "failovers": {
+            "total": len(failovers),
+            "recent": failovers[-4:],
+        },
+    }
+
+
+def render_serving(block: dict, *, width: int = 12) -> str:
+    """The serving panel (``--serving``): per-replica staleness
+    sparklines against the bound, router hit counts, failover alerts."""
+    bound = block.get("max_staleness")
+    lines = [f"serving ({block.get('window') or '-'}):  "
+             f"step {block.get('step', '-')}  "
+             f"{_fmt(block.get('requests_per_s'))} req/s  "
+             f"bound {bound if bound is not None else '-'} steps  "
+             f"refused {block.get('refused', 0)}"]
+    hits = block.get("hits") or {}
+    for rep in block.get("replicas", []):
+        st = block.get("staleness", {}).get(rep, {})
+        series = [s for s in st.get("series", [])
+                  if isinstance(s, (int, float))]
+        last = st.get("last")
+        over = (bound is not None and isinstance(last, (int, float))
+                and (last > bound or last < 0))
+        tag = "STALE" if over else (
+            "serving" if str(block.get("current")) == rep else "-")
+        lines.append(
+            f"  replica {rep:>3}  stale {_fmt(float(last)) if isinstance(last, (int, float)) else '-':>6} "
+            f"{sparkline(series, width):<{width}} "
+            f"hits {hits.get(rep, 0):>6}  [{tag}]")
+    fo = block.get("failovers") or {}
+    if fo.get("total"):
+        lines.append(f"  failovers: {fo['total']}")
+        for ev in fo.get("recent", []):
+            lines.append(
+                f"    step {str(ev.get('step', '-')):>5}  "
+                f"{ev.get('replica_from')} -> {ev.get('replica_to')}  "
+                f"({ev.get('reason')})")
+    return "\n".join(lines)
 
 
 def render_edge_heatmap(edges: dict, *, top: int = 0) -> str:
@@ -349,6 +437,13 @@ def main(argv=None) -> int:
                    help="render the measured edge-cost heatmap (the comm "
                         "profiler's newest 'edges' record) under the "
                         "dashboard")
+    p.add_argument("--serving", action="store_true",
+                   help="render the serving panel (replica staleness "
+                        "sparklines, router hit counts, failover alerts) "
+                        "from the <prefix>serving.jsonl trail")
+    p.add_argument("--serving-trail", default=None, metavar="PATH",
+                   help="serving trail to render (default: "
+                        "<prefix>serving.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -363,7 +458,7 @@ def main(argv=None) -> int:
         view, report, out = build_report(
             args.prefix, window=args.window, expected_ranks=args.ranks,
             verdicts_path=args.verdicts, decisions_path=args.decisions,
-            cache=cache)
+            serving_path=args.serving_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -371,6 +466,13 @@ def main(argv=None) -> int:
             if out.get("decisions"):
                 print()
                 print(render_decisions(out["decisions"]))
+            if args.serving:
+                if out.get("serving"):
+                    print()
+                    print(render_serving(out["serving"]))
+                else:
+                    print("\n(no serving trail yet — the router writes "
+                          "<prefix>serving.jsonl; see docs/serving.md)")
             if args.edges:
                 edges = out.get("edges")
                 if edges:

@@ -1,0 +1,56 @@
+"""Decentralized serving tier: bounded-staleness weight replication,
+request routing, chaos-tested failover.
+
+The ROADMAP's "millions of users" workload — the first end-to-end
+product surface that composes the window subsystem, wire compression,
+resilience liveness masks, and the observability stack as ONE scenario:
+
+* :mod:`~.publisher` — training ranks continuously publish weights via
+  compressed nonblocking ``win_put`` on a dedicated parameter window
+  (its own publisher->replica graph, ``win_create(topo=)``); dense
+  quantizers are wire-legal on windows, sparsifiers are rejected by the
+  window layer (docs/serving.md "Rejected combinations").
+* :mod:`~.replica`   — serving ranks fold incoming versions with
+  **bounded staleness**: per-replica version/step watermarks, folds via
+  ``win_update(alive=)`` so a dead publisher degrades to self-weight
+  instead of poisoning the fold, and a hard refusal to serve past
+  ``BLUEFOG_SERVE_MAX_STALENESS``.
+* :mod:`~.router`    — a host-side request router distributing batched
+  inference requests by liveness + staleness + measured edge cost
+  (``commprof.EdgeCostMatrix`` behind the shared ``matrix_is_usable``
+  guard), with retry-through failover of a dead serving rank and a
+  sidecar JSONL trail (``<prefix>serving.jsonl``) that ``bfmonitor
+  --serving`` renders.
+
+Entry points: ``examples/decentralized_serving.py``, ``bench.py
+--serve`` (requests/sec + staleness percentiles), ``make serve-smoke``
+(the chaos-failover CI gate).  See docs/serving.md.
+"""
+
+from .publisher import (
+    COMPRESS_ENV,
+    DEFAULT_WINDOW_NAME,
+    MAX_STALENESS_ENV,
+    PUBLISH_EVERY_ENV,
+    WeightPublisher,
+    resolve_max_staleness,
+    resolve_publish_every,
+    serving_topology,
+)
+from .replica import ReplicaDeadError, ReplicaSet, StaleReplicaError
+from .router import (
+    SERVING_SUFFIX,
+    FailoverEvent,
+    NoReplicaAvailable,
+    RequestRouter,
+    read_serving_trail,
+)
+
+__all__ = [
+    "COMPRESS_ENV", "DEFAULT_WINDOW_NAME", "MAX_STALENESS_ENV",
+    "PUBLISH_EVERY_ENV", "WeightPublisher", "resolve_max_staleness",
+    "resolve_publish_every", "serving_topology",
+    "ReplicaDeadError", "ReplicaSet", "StaleReplicaError",
+    "SERVING_SUFFIX", "FailoverEvent", "NoReplicaAvailable",
+    "RequestRouter", "read_serving_trail",
+]

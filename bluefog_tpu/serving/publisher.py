@@ -1,0 +1,247 @@
+"""Publisher half of the serving tier: training ranks ship weights.
+
+A :class:`WeightPublisher` owns the dedicated *parameter window* — a
+``win_create`` window over the model's global-view param tree, compiled on
+its own publisher->replica graph (:func:`serving_topology`, riding
+``win_create(topo=)``) so serving traffic never shares edges or buffer
+slots with training gossip.  ``publish`` moves every publisher rank's
+current weights into its replica destinations' window buffers in ONE
+compressed nonblocking ``win_put`` — dense quantizers (``int8``/``fp8``)
+are wire-legal on windows (docs/compression.md), so the parameter stream
+rides the wire at a fraction of full precision while the replica-side
+buffers stay exact-precision decodes.
+
+The publisher also keeps the host-side *version header* of the stream:
+``last_published[rank]`` is the training step each publisher rank most
+recently shipped.  Replicas derive their bounded-staleness watermarks
+from it plus the window's per-slot version counters (which tell a
+replica WHETHER fresh data arrived; the header tells it from WHICH step)
+— see ``serving/replica.py``.
+"""
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compress import compressors as _compress
+from ..context import ctx
+from ..observability import metrics as _metrics
+from ..ops import windows as _win
+from ..parallel.schedule import CompiledTopology, compile_weight_matrix
+
+__all__ = [
+    "WeightPublisher", "serving_topology",
+    "MAX_STALENESS_ENV", "PUBLISH_EVERY_ENV", "COMPRESS_ENV",
+    "DEFAULT_WINDOW_NAME",
+]
+
+MAX_STALENESS_ENV = "BLUEFOG_SERVE_MAX_STALENESS"
+PUBLISH_EVERY_ENV = "BLUEFOG_SERVE_PUBLISH_EVERY"
+COMPRESS_ENV = "BLUEFOG_SERVE_COMPRESS"
+
+DEFAULT_WINDOW_NAME = "bf_serving_params"
+
+
+def resolve_max_staleness(value: Optional[int] = None) -> int:
+    """``BLUEFOG_SERVE_MAX_STALENESS`` (steps, default 4): the bound past
+    which a replica refuses to serve and the router stops selecting it."""
+    if value is not None:
+        return int(value)
+    return int(os.environ.get(MAX_STALENESS_ENV, "4"))
+
+
+def resolve_publish_every(value: Optional[int] = None) -> int:
+    """``BLUEFOG_SERVE_PUBLISH_EVERY`` (steps, default 1): cadence of
+    :meth:`WeightPublisher.maybe_publish`."""
+    if value is not None:
+        return max(1, int(value))
+    return max(1, int(os.environ.get(PUBLISH_EVERY_ENV, "1")))
+
+
+def serving_topology(publishers: Sequence[int], replicas: Sequence[int],
+                     size: Optional[int] = None,
+                     edges: Optional[Sequence[Tuple[int, int]]] = None
+                     ) -> CompiledTopology:
+    """Compile the publisher->replica parameter-window graph.
+
+    Default: the full bipartite graph (every publisher feeds every
+    replica, weight ``1/in_degree`` per edge, diagonal 1) — any replica
+    then survives any single publisher death without a feed change.
+    ``edges`` restricts it to explicit ``(publisher, replica)`` pairs
+    (dedicated feeds; a starved replica is then a *designed* staleness
+    scenario, which the smoke gate uses).  The graph spans the full mesh
+    — non-serving ranks are isolated vertices with self weight 1, so the
+    window's SPMD programs keep the mesh shape.
+    """
+    from ..context import is_initialized
+    if size is None:
+        size = ctx().size if is_initialized() else (
+            max(list(publishers) + list(replicas)) + 1)
+    pubs, reps = list(dict.fromkeys(publishers)), list(dict.fromkeys(replicas))
+    if not pubs or not reps:
+        raise ValueError("need at least one publisher and one replica")
+    overlap = set(pubs) & set(reps)
+    if overlap:
+        raise ValueError(
+            f"ranks {sorted(overlap)} are both publisher and replica; a "
+            f"serving rank folds the window, a training rank overwrites "
+            f"it — the roles must be disjoint")
+    for r in pubs + reps:
+        if not 0 <= r < size:
+            raise ValueError(f"rank {r} outside [0, {size})")
+    if edges is None:
+        edges = [(p, r) for r in reps for p in pubs]
+    # dedupe: a repeated pair would inflate indeg while W[p, r] is
+    # assigned (not summed), silently under-weighting the fold
+    edges = list(dict.fromkeys((int(p), int(r)) for p, r in edges))
+    for p, r in edges:
+        if p not in pubs or r not in reps:
+            raise ValueError(
+                f"edge {(p, r)} does not run publisher -> replica "
+                f"(publishers {pubs}, replicas {reps})")
+    fed = {r for _, r in edges}
+    unfed = [r for r in reps if r not in fed]
+    if unfed:
+        raise ValueError(
+            f"replicas {unfed} have no publisher edge; every replica "
+            f"needs at least one feed")
+    W = np.eye(size)
+    indeg = {r: sum(1 for _, d in edges if d == r) for r in reps}
+    for p, r in edges:
+        W[p, r] = 1.0 / indeg[r]
+    return compile_weight_matrix(W)
+
+
+class WeightPublisher:
+    """Continuously publish training weights onto the parameter window.
+
+    ``params`` (the creation template) and every later ``publish`` input
+    are GLOBAL-VIEW trees (leading dim = mesh size) — the standard shape
+    every optimizer in this repo trains in.  Only publisher rows are
+    read; replica and bystander rows of the input are ignored (the put
+    merges the window's own rows back in so a publish never clobbers a
+    replica's folded serving weights — ``win_put`` replaces the whole
+    window tensor with its input).
+
+    ``compression``: wire codec spec for the window transfers (default
+    ``BLUEFOG_SERVE_COMPRESS``, off).  Dense quantizers only — the
+    window layer itself rejects sparsifiers/choco with guidance
+    (docs/compression.md, docs/serving.md "Rejected combinations").
+    """
+
+    def __init__(self, params, publishers: Sequence[int],
+                 replicas: Sequence[int], *,
+                 name: str = DEFAULT_WINDOW_NAME,
+                 compression=None,
+                 topo: Optional[CompiledTopology] = None,
+                 edges: Optional[Sequence[Tuple[int, int]]] = None,
+                 publish_every: Optional[int] = None):
+        cx = ctx()
+        self.name = name
+        self.publishers = list(dict.fromkeys(publishers))
+        self.replicas = list(dict.fromkeys(replicas))
+        self.publish_every = resolve_publish_every(publish_every)
+        if compression is None:
+            # serving default is OFF unless BLUEFOG_SERVE_COMPRESS names a
+            # codec: falling through to the training-wide
+            # BLUEFOG_COMM_COMPRESS would hand the window a sparsifier
+            # spec it must reject
+            compression = os.environ.get(COMPRESS_ENV) or False
+        self.compression = _compress.resolve_compression(compression)
+        if topo is not None and edges is not None:
+            raise ValueError(
+                "pass either topo= (a pre-compiled window graph) or "
+                "edges= (pairs for serving_topology), not both — edges "
+                "would be silently ignored")
+        self.topo = topo if topo is not None else serving_topology(
+            self.publishers, self.replicas, size=cx.size, edges=edges)
+        # a caller-supplied topo skipped serving_topology's checks: a
+        # replica with no publisher in-edge would never gain a watermark
+        # and be silently unroutable forever
+        unfed = [r for r in self.replicas
+                 if not any(p in self.publishers
+                            for p in self.topo.in_neighbor_ranks(r))]
+        if unfed:
+            raise ValueError(
+                f"replicas {unfed} have no publisher in-edge on the "
+                f"window topology; every replica needs at least one feed")
+        # False (not None) when off: the window layer's own None falls
+        # through to BLUEFOG_COMM_COMPRESS, which may name a sparsifier
+        if not _win.win_create(params, name, topo=self.topo,
+                               compression=(self.compression
+                                            if self.compression is not None
+                                            else False)):
+            raise ValueError(
+                f"window {name!r} already exists; win_free it or pick a "
+                f"distinct serving window name")
+        # the stream's version header: training step each publisher rank
+        # most recently shipped (None = never published)
+        self.last_published: Dict[int, Optional[int]] = {
+            p: None for p in self.publishers}
+        mask = np.zeros((cx.size,), np.float32)
+        mask[self.publishers] = 1.0
+        self._pub_mask = jnp.asarray(mask)
+
+    # -- publishing ---------------------------------------------------------
+
+    def _merged_input(self, params):
+        """Publisher rows from ``params``, every other row from the
+        window's current tensor — so the put's tensor replacement keeps
+        replica folds and bystander rows intact."""
+        current = _win.win_fetch(self.name)
+        def merge(new, old):
+            m = self._pub_mask.reshape(
+                (-1,) + (1,) * (new.ndim - 1)).astype(bool)
+            return jnp.where(m, jnp.asarray(new, old.dtype), old)
+        return jax.tree.map(merge, params, current)
+
+    def publish(self, params, step: int, alive=None) -> int:
+        """One compressed nonblocking ``win_put`` of every live
+        publisher's current weights; returns the op handle (``win_wait``
+        it, or let the replica-side ``refresh`` flush it).
+
+        ``alive`` (optional [N] mask): dead publishers ship nothing —
+        their out-edges drop from the put's destination matrix, so their
+        replicas' version counters stop advancing and staleness starts
+        accruing, exactly as a crashed training process would look.
+        """
+        alive_row = None if alive is None else np.asarray(
+            alive, np.float64).reshape(-1)
+        # ship with weight 1.0 on every edge: the buffer holds the
+        # publisher's VALUE, and the replica-side fold owns the
+        # 1/in_degree averaging — weighting both sides would square it
+        D = (self.topo.weight_matrix != 0).astype(np.float64)
+        np.fill_diagonal(D, 0.0)
+        if alive_row is not None:
+            D = D * alive_row[:, None]
+        handle = _win.win_put_nonblocking(
+            self._merged_input(params), self.name,
+            self_weight=1.0, dst_weights=D)
+        for p in self.publishers:
+            if alive_row is None or alive_row[p] > 0:
+                self.last_published[p] = int(step)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_publishes_total",
+                "parameter-window weight publications (serving tier)"
+            ).inc()
+        return handle
+
+    def maybe_publish(self, params, step: int, alive=None) -> Optional[int]:
+        """Cadence-gated :meth:`publish` (``BLUEFOG_SERVE_PUBLISH_EVERY``)."""
+        if step % self.publish_every == 0:
+            return self.publish(params, step, alive=alive)
+        return None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def in_publishers(self, replica: int) -> List[int]:
+        """The publisher ranks feeding ``replica`` on the window graph."""
+        return [p for p in self.topo.in_neighbor_ranks(replica)
+                if p in self.publishers]
+
+    def close(self) -> None:
+        _win.win_free(self.name)

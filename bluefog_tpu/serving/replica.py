@@ -1,0 +1,199 @@
+"""Replica half of the serving tier: bounded-staleness weight folds.
+
+A :class:`ReplicaSet` drives the serving ranks' side of the parameter
+window the :class:`~.publisher.WeightPublisher` feeds.  Each ``refresh``
+is one ``win_update(alive=)`` fold: every replica row absorbs its
+in-publisher buffers (weight ``1/in_degree`` each, self weight 0 — the
+replica *tracks* the publisher average rather than mixing toward it),
+while a dead publisher's row degrades to self weight via the liveness
+mask, so a crashed trainer's frozen buffer never poisons the fold.
+
+**Bounded staleness** is the tier's serving contract: per replica the
+set tracks a *watermark* — the training step of the OLDEST live feed the
+replica has actually folded (publisher version headers × window version
+counters) — and ``staleness = now_step - watermark``.  A replica whose
+staleness exceeds ``BLUEFOG_SERVE_MAX_STALENESS`` refuses to serve
+(:class:`StaleReplicaError`), which is what lets the router promise
+every answered request was computed on weights at most K steps old
+(docs/serving.md "The staleness model").
+
+``serve`` runs the caller's ``apply_fn`` on the replica's folded row —
+a dead replica raises :class:`ReplicaDeadError` (the connection-refused
+analog the router's failover path consumes).
+"""
+
+import math
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..observability import metrics as _metrics
+from ..ops import windows as _win
+from .publisher import WeightPublisher, resolve_max_staleness
+
+__all__ = ["ReplicaSet", "ReplicaDeadError", "StaleReplicaError"]
+
+
+class ReplicaDeadError(RuntimeError):
+    """Serving a dead replica rank — the connection-refused analog."""
+
+    def __init__(self, rank: int):
+        super().__init__(f"replica rank {rank} is down")
+        self.rank = rank
+
+
+class StaleReplicaError(RuntimeError):
+    """A replica past the staleness bound refused to serve."""
+
+    def __init__(self, rank: int, staleness: float, bound: int):
+        super().__init__(
+            f"replica rank {rank} is {staleness} steps stale "
+            f"(bound {bound}); refusing to serve")
+        self.rank = rank
+        self.staleness = staleness
+        self.bound = bound
+
+
+class ReplicaSet:
+    """The serving ranks over one publisher's parameter window.
+
+    ``apply_fn(params_row, batch)`` is the inference function — it
+    receives ONE replica's param tree (no leading mesh axis) and the
+    request batch.  ``max_staleness`` defaults to
+    ``BLUEFOG_SERVE_MAX_STALENESS`` (4 steps).
+    """
+
+    def __init__(self, publisher: WeightPublisher,
+                 apply_fn: Callable, *,
+                 max_staleness: Optional[int] = None):
+        self.publisher = publisher
+        self.apply_fn = apply_fn
+        self.max_staleness = resolve_max_staleness(max_staleness)
+        if self.max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self.max_staleness}")
+        self.replicas: List[int] = list(publisher.replicas)
+        self.name = publisher.name
+        n = publisher.topo.size
+        # fold weights: in-publisher rows 1/in_degree, replica self 0 —
+        # the masked fold moves a dead feed's mass back to self
+        U = publisher.topo.weight_matrix.copy().astype(np.float64)
+        np.fill_diagonal(U, 0.0)
+        sw = np.ones((n,), np.float64)
+        sw[self.replicas] = 0.0
+        self._U, self._sw = U, sw
+        self._in_pubs: Dict[int, List[int]] = {
+            r: publisher.in_publishers(r) for r in self.replicas}
+        # delivered[r][p]: the publisher-step of the newest put from p
+        # that replica r has folded (None = never)
+        self._delivered: Dict[int, Dict[int, Optional[int]]] = {
+            r: {p: None for p in self._in_pubs[r]} for r in self.replicas}
+        self._watermark: Dict[int, Optional[int]] = {
+            r: None for r in self.replicas}
+        self._fetched = None
+        self.last_fold_s: Optional[float] = None
+
+    # -- the fold -----------------------------------------------------------
+
+    def refresh(self, step: int, alive=None) -> Dict[int, float]:
+        """Fold pending publications into every replica row and advance
+        the staleness watermarks; returns ``{replica: staleness}``.
+
+        ``alive`` (optional [N] mask): dead PUBLISHERS degrade to
+        self-weight in the fold (``win_update(alive=)``) and stop
+        counting toward the watermark — a replica whose only live feeds
+        go silent therefore ages out of the staleness bound instead of
+        serving a frozen buffer as fresh.
+        """
+        alive_row = None if alive is None else np.asarray(
+            alive, np.float64).reshape(-1)
+        # promote any staged (un-waited) nonblocking puts: the fold must
+        # see the newest completed publication
+        _win.win_flush(self.name)
+        fresh: Dict[int, List[int]] = {}
+        for r in self.replicas:
+            vers = _win.get_win_version(self.name, r)
+            fresh[r] = [p for p in self._in_pubs[r] if vers.get(p, 0) > 0]
+            for p in fresh[r]:
+                self._delivered[r][p] = self.publisher.last_published.get(p)
+        t0 = time.perf_counter()
+        _win.win_update(self.name, self_weight=self._sw,
+                        neighbor_weights=self._U, reset=False,
+                        alive=alive_row)
+        self.last_fold_s = time.perf_counter() - t0
+        self._fetched = None
+        for r in self.replicas:
+            feeds = [p for p in self._in_pubs[r]
+                     if alive_row is None or alive_row[p] > 0]
+            if feeds:
+                marks = [self._delivered[r][p] for p in feeds]
+                if all(m is not None for m in marks):
+                    # the OLDEST live feed bounds what the fold blended in
+                    self._watermark[r] = min(marks)
+        out = self.staleness(step)
+        if _metrics.enabled():
+            _metrics.histogram(
+                "bf_serve_fold_seconds",
+                "wall time of one replica-side win_update fold").observe(
+                self.last_fold_s)
+            g = _metrics.gauge(
+                "bf_serve_staleness",
+                "replica staleness in steps (now - watermark)")
+            for r, s in out.items():
+                g.set(s if math.isfinite(s) else -1.0, replica=r)
+        return out
+
+    # -- staleness ----------------------------------------------------------
+
+    def staleness_of(self, rank: int, step: int) -> float:
+        """Steps since ``rank``'s watermark (``inf`` before any fold)."""
+        mark = self._watermark.get(rank)
+        return math.inf if mark is None else float(int(step) - mark)
+
+    def staleness(self, step: int) -> Dict[int, float]:
+        return {r: self.staleness_of(r, step) for r in self.replicas}
+
+    def can_serve(self, rank: int, step: int) -> bool:
+        return self.staleness_of(rank, step) <= self.max_staleness
+
+    # -- serving ------------------------------------------------------------
+
+    def params_of(self, rank: int):
+        """``rank``'s folded serving weights (one row of the window)."""
+        if self._fetched is None:
+            self._fetched = _win.win_fetch(self.name)
+        return jax.tree.map(lambda a: a[rank], self._fetched)
+
+    def serve(self, rank: int, batch, step: int, alive=None):
+        """Answer one request on replica ``rank``.
+
+        Raises :class:`ReplicaDeadError` when the rank is down (the
+        router's failover trigger) and :class:`StaleReplicaError` when
+        its staleness exceeds the bound — a replica never silently
+        serves weights older than the contract.
+        """
+        if rank not in self._watermark:
+            raise ValueError(f"rank {rank} is not a serving replica "
+                             f"(replicas: {self.replicas})")
+        if alive is not None and np.asarray(alive).reshape(-1)[rank] <= 0:
+            raise ReplicaDeadError(rank)
+        stale = self.staleness_of(rank, step)
+        if stale > self.max_staleness:
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_serve_stale_refusals_total",
+                    "requests a replica refused past the staleness bound"
+                ).inc(replica=str(rank))
+            raise StaleReplicaError(rank, stale, self.max_staleness)
+        out = self.apply_fn(self.params_of(rank), batch)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_requests_total",
+                "inference requests answered, by replica").inc(
+                replica=str(rank))
+        return out
+
+    def close(self) -> None:
+        self.publisher.close()

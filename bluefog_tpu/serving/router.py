@@ -1,0 +1,342 @@
+"""Host-side request router: liveness + staleness + measured edge cost.
+
+The :class:`RequestRouter` is the serving tier's front door.  Every
+request is routed to ONE replica chosen from the currently *eligible*
+set — alive (per the router's accrual-style liveness beliefs, reusing
+``resilience.LivenessConfig`` thresholds) and within the staleness bound
+— ordered sticky-first (the previous target keeps traffic while it
+stays eligible; no flapping), then by staleness, then by measured edge
+cost from the client-facing rank (a ``commprof.EdgeCostMatrix``,
+consulted only when ``matrix_is_usable`` accepts it — a synthetic or
+stale matrix must not steer production traffic), then by rank.
+
+**Failover** is the event of the sticky target changing because it had
+to: the current replica died (a :class:`~.replica.ReplicaDeadError`
+from the serve attempt — the connection-refused analog — or the
+liveness beliefs confirming a death) or aged past the staleness bound.
+The failed request is retried on the next candidate in the same
+``route`` call, so a single rank death costs ZERO failed requests once
+the death is observable; each failover lands in the serving trail as a
+``serve_failover`` record and on ``bf_serve_failovers_total``.
+
+**The serving trail** is a sidecar JSONL at ``<prefix>serving.jsonl``
+(same pattern as the controller's decision trail): a ``serve_config``
+head record, periodic ``serve`` records (``serve_staleness`` per
+replica, ``requests_per_s``, cumulative hit counts, fold latency), and
+``serve_failover`` events — the machine-readable feed ``bfmonitor
+--serving`` renders and ``validate_jsonl`` gates.
+"""
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import export as _export
+from ..observability import metrics as _metrics
+from ..resilience import LivenessConfig
+from .replica import ReplicaDeadError, ReplicaSet, StaleReplicaError
+
+__all__ = ["RequestRouter", "NoReplicaAvailable", "FailoverEvent",
+           "SERVING_SUFFIX", "read_serving_trail"]
+
+SERVING_SUFFIX = "serving.jsonl"
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is dead or past the staleness bound."""
+
+
+class FailoverEvent:
+    """One sticky-target switch, host-time-stamped for the trail."""
+
+    __slots__ = ("step", "replica_from", "replica_to", "reason")
+
+    def __init__(self, step: int, replica_from: int,
+                 replica_to: Optional[int], reason: str):
+        self.step = step
+        self.replica_from = replica_from
+        self.replica_to = replica_to
+        self.reason = reason
+
+    def asdict(self) -> dict:
+        return {"step": self.step, "replica_from": self.replica_from,
+                "replica_to": self.replica_to, "reason": self.reason}
+
+
+class _Trail:
+    """Append-only serving JSONL with the shared size-based rotation
+    (``BLUEFOG_METRICS_MAX_MB`` / ``BLUEFOG_METRICS_KEEP``).  The
+    ``serve_config`` head record is re-written after every rotation —
+    like the decision trail's header — so a rotated trail never orphans
+    its records from the tier's identity (replicas, bound)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.t0 = time.perf_counter()
+        self.max_bytes, self.keep = _export.resolve_rotation()
+        self._bytes = 0
+        self._head_line = None
+        self.f = open(path, "w")
+
+    def write(self, record: dict) -> dict:
+        record = dict(record)
+        record.setdefault("t_us",
+                          int((time.perf_counter() - self.t0) * 1e6))
+        line = json.dumps(record) + "\n"
+        if record.get("kind") == "serve_config" and self._head_line is None:
+            self._head_line = line
+        if (self.max_bytes and self._bytes
+                and self._bytes + len(line) > self.max_bytes):
+            self.f.close()
+            _export.rotate_file(self.path, self.keep)
+            self.f = open(self.path, "w")
+            self._bytes = 0
+            if self._head_line and line != self._head_line:
+                self.f.write(self._head_line)
+                self._bytes += len(self._head_line)
+        self.f.write(line)
+        self.f.flush()
+        self._bytes += len(line)
+        return record
+
+    def close(self) -> None:
+        try:
+            self.f.close()
+        except Exception:
+            pass
+
+
+def read_serving_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as the controller's ``read_decisions``, via the shared
+    sidecar-trail helper (a monitor frame must render a partial or
+    damaged trail, never crash on it)."""
+    return _export.read_trail(path, "serve_config")
+
+
+class RequestRouter:
+    """Distribute inference requests across a :class:`ReplicaSet`.
+
+    ``prefix``: metrics prefix — the serving trail opens at
+    ``<prefix>serving.jsonl`` (or pass ``trail_path`` directly; None
+    keeps the router trail-less).  ``cost_matrix``: a measured
+    :class:`~..observability.commprof.EdgeCostMatrix`; consulted as the
+    final tie-break from ``client_rank`` to each replica, and only when
+    ``matrix_is_usable`` accepts it (refusals count on
+    ``bf_serve_refused_matrix_total``).  ``liveness``: suspect/confirm
+    thresholds for the router's host-side death beliefs (defaults to
+    ``resilience.LivenessConfig()``).
+    """
+
+    def __init__(self, replicas: ReplicaSet, *,
+                 prefix: Optional[str] = None,
+                 trail_path: Optional[str] = None,
+                 cost_matrix=None, client_rank: int = 0,
+                 liveness: Optional[LivenessConfig] = None):
+        self.replicas = replicas
+        self.liveness = liveness or LivenessConfig()
+        self.client_rank = int(client_rank)
+        self.current: Optional[int] = None
+        self.hits: Dict[int, int] = {r: 0 for r in replicas.replicas}
+        self.refused = 0
+        self.failovers: List[FailoverEvent] = []
+        self.staleness_samples: List[float] = []
+        # accrual beliefs: last step each replica was observed alive
+        # (everyone starts alive, like membership.init_state); -inf is
+        # the hard-confirmed state a connection error forces.  Beliefs
+        # age against the newest OBSERVATION, not the request step — a
+        # router nobody feeds liveness data stays optimistic instead of
+        # confirming the whole fleet dead by timeout.
+        self._last_ok: Dict[int, float] = {r: 0.0 for r in replicas.replicas}
+        self._last_obs: float = 0.0
+        self._cost = self._resolve_cost(cost_matrix)
+        self._requests_window = 0
+        self._window_t0 = time.perf_counter()
+        path = trail_path or (prefix + SERVING_SUFFIX if prefix else None)
+        self.trail = _Trail(path) if path else None
+        if self.trail:
+            self.trail.write({
+                "kind": "serve_config",
+                "replicas": list(replicas.replicas),
+                "publishers": list(replicas.publisher.publishers),
+                "max_staleness": replicas.max_staleness,
+                "client_rank": self.client_rank,
+                "window": replicas.name,
+            })
+
+    def _resolve_cost(self, matrix) -> Dict[int, float]:
+        """Replica -> one-way latency from the client rank, from a
+        USABLE measured matrix only."""
+        if matrix is None:
+            return {}
+        from ..observability import commprof as _cprof
+        ok, why = _cprof.matrix_is_usable(matrix)
+        if not ok:
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_serve_refused_matrix_total",
+                    "edge-cost matrices the router refused to consult"
+                ).inc()
+            return {}
+        out = {}
+        for r in self.replicas.replicas:
+            lat = matrix.latency_us(self.client_rank, r)
+            if lat is None:
+                lat = matrix.latency_us(r, self.client_rank)
+            if lat is not None:
+                out[r] = float(lat)
+        return out
+
+    # -- liveness beliefs ---------------------------------------------------
+
+    def observe(self, alive, step: int) -> None:
+        """Feed one liveness observation (e.g. a fault plan's
+        ``alive_at`` row, or ``membership`` beliefs collapsed to a
+        mask).  A replica unseen for ``confirm_after`` steps is
+        confirmed dead and leaves the candidate set."""
+        row = np.asarray(alive).reshape(-1)
+        self._last_obs = max(self._last_obs, float(step))
+        for r in self.replicas.replicas:
+            if row[r] > 0:
+                self._last_ok[r] = float(step)
+
+    def confirmed_dead(self, rank: int, step: int) -> bool:
+        return (self._last_obs - self._last_ok[rank]
+                ) > self.liveness.confirm_after
+
+    def _mark_dead(self, rank: int) -> None:
+        # a connection error is instant confirmation — no accrual wait
+        self._last_ok[rank] = -math.inf
+
+    # -- selection ----------------------------------------------------------
+
+    def _candidates(self, step: int) -> List[int]:
+        """Eligible replicas, best first: sticky current, then
+        (staleness, measured cost, rank)."""
+        elig = [r for r in self.replicas.replicas
+                if not self.confirmed_dead(r, step)
+                and self.replicas.can_serve(r, step)]
+        # unmeasured edges sort LAST (inf), not first: an edge the probe
+        # never priced must not beat a measured one by defaulting cheap
+        elig.sort(key=lambda r: (self.replicas.staleness_of(r, step),
+                                 self._cost.get(r, math.inf), r))
+        if self.current in elig:
+            elig.remove(self.current)
+            elig.insert(0, self.current)
+        return elig
+
+    def _failover(self, step: int, frm: int, to: Optional[int],
+                  reason: str) -> None:
+        ev = FailoverEvent(step, frm, to, reason)
+        self.failovers.append(ev)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_failovers_total",
+                "sticky serving-target switches forced by death or "
+                "staleness").inc(reason=reason)
+        if self.trail:
+            self.trail.write({"kind": "serve_failover", **ev.asdict()})
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, batch, step: int, alive=None):
+        """Answer one request: returns ``(output, replica_rank)``.
+
+        The request is retried down the candidate order on a dead
+        target; a staleness breach of the sticky target re-routes
+        BEFORE any attempt (the bound is checked, not discovered).
+        Raises :class:`NoReplicaAvailable` (and counts
+        ``bf_serve_unroutable_total``) when no replica is eligible.
+        """
+        if alive is not None:
+            self.observe(alive, step)
+        prev = self.current
+        cands = self._candidates(step)
+        # failover events are emitted AFTER the retry loop resolves, so
+        # replica_to names the replica that actually took the traffic
+        # (recording the pre-attempt selection could name a dead one)
+        pending: List[tuple] = []
+        if prev is not None and prev not in cands:
+            # sticky target became ineligible between requests
+            pending.append((prev, "dead" if self.confirmed_dead(prev, step)
+                            else "stale"))
+            self.current = None
+        for r in cands:
+            try:
+                out = self.replicas.serve(r, batch, step, alive=alive)
+            except ReplicaDeadError:
+                self._mark_dead(r)
+                if r == self.current:
+                    # only the STICKY target's death is a failover — a
+                    # dead never-used candidate just leaves the set
+                    pending.append((r, "dead"))
+                    self.current = None
+                continue
+            except StaleReplicaError:
+                # raced a watermark change; the next candidate is already
+                # ordered fresher
+                continue
+            for frm, reason in pending:
+                self._failover(step, frm, r, reason)
+            self.current = r
+            self.hits[r] += 1
+            self._requests_window += 1
+            self.staleness_samples.append(
+                self.replicas.staleness_of(r, step))
+            return out, r
+        for frm, reason in pending:
+            self._failover(step, frm, None, reason)   # total outage
+        self.refused += 1
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_unroutable_total",
+                "requests refused: no live replica within the "
+                "staleness bound").inc()
+        raise NoReplicaAvailable(
+            f"no replica eligible at step {step}: staleness "
+            f"{self.replicas.staleness(step)} (bound "
+            f"{self.replicas.max_staleness})")
+
+    # -- reporting ----------------------------------------------------------
+
+    def requests_per_s(self) -> float:
+        """Request rate since the previous :meth:`log` call."""
+        dt = time.perf_counter() - self._window_t0
+        return self._requests_window / dt if dt > 0 else 0.0
+
+    def log(self, step: int) -> Optional[dict]:
+        """Append one periodic ``serve`` record to the trail (and reset
+        the requests/sec window).  Returns the record written."""
+        rps = self.requests_per_s()
+        stale = self.replicas.staleness(step)
+        record = {
+            "kind": "serve",
+            "step": int(step),
+            "serve_staleness": {
+                str(r): (s if math.isfinite(s) else -1.0)
+                for r, s in stale.items()},
+            "requests_per_s": round(rps, 3),
+            "hits": {str(r): h for r, h in self.hits.items()},
+            "refused": self.refused,
+            "failovers": len(self.failovers),
+            "current": self.current,
+        }
+        if self.replicas.last_fold_s is not None:
+            record["fold_s"] = round(self.replicas.last_fold_s, 6)
+        self._requests_window = 0
+        self._window_t0 = time.perf_counter()
+        if _metrics.enabled():
+            _metrics.gauge(
+                "bf_serve_requests_per_s",
+                "request rate over the last reporting window").set(rps)
+        if self.trail:
+            return self.trail.write(record)
+        return record
+
+    def close(self) -> None:
+        if self.trail:
+            self.trail.close()

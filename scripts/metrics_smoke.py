@@ -41,6 +41,19 @@ AND in ``bfmonitor --once --json``, with zero step recompiles across
 the episode, and ``bfctl replay`` must reproduce the exact decision
 trail from the recorded telemetry.
 
+``--serve`` (``make serve-smoke``) adds the serving-tier gate
+(docs/serving.md): (A) a clean publisher + 2-replica + router run must
+answer every request within the staleness bound with zero refusals and
+zero failovers, land a schema-valid serving trail, and surface in the
+real ``bfmonitor --once --json`` ``"serving"`` block; (B) with
+dedicated publisher->replica feeds, killing one publisher must age
+exactly its replica past ``BLUEFOG_SERVE_MAX_STALENESS`` — the router
+fails over ONCE (reason ``stale``) and never routes to the stale
+replica again; (C) a chaos-killed SERVING rank (fault plan
+``rank_down`` mid-traffic) must trigger exactly one failover (reason
+``dead``) with zero failed requests — every request is answered by the
+survivor — asserted through the real ``bfmonitor`` subprocess.
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -339,6 +352,150 @@ def control_legs(n, tmp):
     }
 
 
+SERVE_STEPS, SERVE_REQS, SERVE_BOUND = 14, 4, 3
+
+
+def serve_legs(n, tmp):
+    """The ``make serve-smoke`` gate: clean serving within the bound,
+    staleness enforcement on a starved replica, and chaos failover of a
+    serving rank — each asserted end to end (trail schema + the real
+    ``bfmonitor --once --json`` serving block)."""
+    from bluefog_tpu.resilience import FaultPlan
+    from bluefog_tpu.serving import (NoReplicaAvailable, ReplicaSet,
+                                     RequestRouter, WeightPublisher,
+                                     serving_topology)
+
+    pubs, reps = [0, 1], [n - 2, n - 1]
+    rng = np.random.default_rng(11)
+    apply_fn = lambda p, x: x @ p["w"] + p["b"]
+    req = jnp.ones((2, 4), jnp.float32)
+
+    def mk_params():
+        return {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+                "b": jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)}
+
+    def run_tier(prefix, *, edges=None, pub_alive=None, plan=None,
+                 name="bf_serve_smoke"):
+        """One serving episode: consensus training + publish + refresh +
+        route, logging the main series AND the serving trail.  Returns
+        the router (trail closed, window freed)."""
+        params = mk_params()
+        grads = jax.tree.map(jnp.zeros_like, params)
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0),
+                                                       telemetry=True)
+        state = opt.init(params)
+        pub = WeightPublisher(params, pubs, reps, name=name,
+                              compression="int8", edges=edges)
+        rs = ReplicaSet(pub, apply_fn, max_staleness=SERVE_BOUND)
+        router = RequestRouter(rs, prefix=prefix)
+        EX.metrics_start(prefix, rank=0)
+        try:
+            for t in range(SERVE_STEPS):
+                params, state, snap = opt.step(params, grads, state, t)
+                alive = (None if plan is None
+                         else plan.alive_at(t).astype(np.float64))
+                pa = alive if pub_alive is None else pub_alive(t)
+                pub.publish(params, t, alive=pa)
+                rs.refresh(t, alive=pa if alive is None else alive)
+                for _ in range(SERVE_REQS):
+                    try:
+                        out, r = router.route(req, t, alive=alive)
+                    except NoReplicaAvailable:
+                        continue          # counted on router.refused
+                    s = rs.staleness_of(r, t)
+                    if s > SERVE_BOUND:
+                        fail(f"request served by replica {r} at "
+                             f"staleness {s} > bound {SERVE_BOUND}")
+                router.log(t)
+                EX.log_step(t, snap)
+        finally:
+            EX.metrics_end()
+            router.close()
+            rs.close()
+        return router
+
+    # -- leg A: clean run — everything fresh, nothing refused -----------
+    clean_prefix = os.path.join(tmp, "serve_clean_")
+    router = run_tier(clean_prefix)
+    if router.refused or router.failovers:
+        fail(f"clean serving run refused {router.refused} requests / "
+             f"raised {len(router.failovers)} failovers")
+    if sum(router.hits.values()) != SERVE_STEPS * SERVE_REQS:
+        fail(f"clean run dropped requests: {router.hits}")
+    if max(router.staleness_samples) > SERVE_BOUND:
+        fail(f"clean run staleness violation: "
+             f"{max(router.staleness_samples)}")
+    trail = clean_prefix + "serving.jsonl"
+    try:
+        EX.validate_jsonl(trail)
+    except ValueError as e:
+        fail(f"serving trail schema violation: {e}")
+    _, out = bfmonitor_json(clean_prefix)
+    block = out.get("serving")
+    if not block or block["failovers"]["total"] != 0:
+        fail(f"bfmonitor serving block wrong on the clean run: {block}")
+    if not block.get("requests_per_s") or block["requests_per_s"] <= 0:
+        fail(f"bfmonitor serving block has no request rate: {block}")
+    clean_rps = block["requests_per_s"]
+
+    # -- leg B: starved replica ages past the bound and is shunned ------
+    # dedicated feeds: pub0 -> repA, pub1 -> repB; pub0 dies at step 4,
+    # so repA (the initial sticky target by rank order) goes stale
+    stale_prefix = os.path.join(tmp, "serve_stale_")
+    rep_a, rep_b = reps
+    kill_at = 4
+    dead_mask = np.ones(n); dead_mask[pubs[0]] = 0.0
+    router = run_tier(
+        stale_prefix, name="bf_serve_stale",
+        edges=[(pubs[0], rep_a), (pubs[1], rep_b)],
+        pub_alive=lambda t: dead_mask if t >= kill_at else None)
+    sigs = [(f.reason, f.replica_from, f.replica_to)
+            for f in router.failovers]
+    if sigs != [("stale", rep_a, rep_b)]:
+        fail(f"starved replica did not fail over exactly once to the "
+             f"fresh one: {sigs}")
+    if router.refused:
+        fail(f"starved-replica run refused {router.refused} requests "
+             f"(the fresh replica should have answered)")
+    # after the breach every request lands on the fresh replica
+    expected_a = (kill_at + SERVE_BOUND) * SERVE_REQS
+    if router.hits[rep_a] > expected_a or router.hits[rep_b] == 0:
+        fail(f"router kept routing to the stale replica: {router.hits}")
+
+    # -- leg C: chaos-killed serving rank, zero failed requests ---------
+    chaos_prefix = os.path.join(tmp, "serve_chaos_")
+    plan = FaultPlan(size=n, horizon=SERVE_STEPS).rank_down(
+        rep_a, at=kill_at).compile()
+    router = run_tier(chaos_prefix, name="bf_serve_chaos", plan=plan)
+    sigs = [(f.reason, f.replica_from, f.replica_to)
+            for f in router.failovers]
+    if sigs != [("dead", rep_a, rep_b)]:
+        fail(f"chaos kill did not fail over exactly once: {sigs}")
+    if router.refused:
+        fail(f"chaos run failed requests: refused={router.refused}")
+    if sum(router.hits.values()) != SERVE_STEPS * SERVE_REQS:
+        fail(f"chaos run dropped requests: {router.hits} "
+             f"(want {SERVE_STEPS * SERVE_REQS} total)")
+    if any(f.step < kill_at for f in router.failovers):
+        fail(f"failover before the kill step: {sigs}")
+    _, out = bfmonitor_json(chaos_prefix)
+    block = out.get("serving")
+    if not block or block["failovers"]["total"] != 1:
+        fail(f"bfmonitor missed the chaos failover: {block}")
+    ev = block["failovers"]["recent"][-1]
+    if ev["replica_from"] != rep_a or ev["replica_to"] != rep_b:
+        fail(f"bfmonitor failover event wrong: {ev}")
+
+    return {
+        "clean_requests": SERVE_STEPS * SERVE_REQS,
+        "clean_rps": clean_rps,
+        "stale_failover": ["stale", rep_a, rep_b],
+        "chaos_failover": ["dead", rep_a, rep_b],
+        "chaos_kill_step": kill_at,
+        "bound": SERVE_BOUND,
+    }
+
+
 OVERLAP_SYNC_MAX, OVERLAP_PIPE_MIN = 0.2, 0.25
 TRACE_SKEW_US, TRACE_ROUNDS = 250000.0, 8
 TRACE_TOL_US = 30000.0     # sleep() oversleep drift accumulates per round
@@ -484,6 +641,7 @@ def main():
     do_health = "--health" in sys.argv
     do_profile = "--profile" in sys.argv
     do_control = "--control" in sys.argv
+    do_serve = "--serve" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -567,6 +725,12 @@ def main():
         EX.metrics_end()           # release the sink for the episode legs
         control_out = control_legs(n, tmp)
 
+    # -- serving-tier gate (--serve / make serve-smoke) -----------------
+    serve_out = None
+    if do_serve:
+        EX.metrics_end()           # release the sink for the tier legs
+        serve_out = serve_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -599,6 +763,8 @@ def main():
         out["profile"] = profile_out
     if control_out:
         out["control"] = control_out
+    if serve_out:
+        out["serve"] = serve_out
     print(json.dumps(out))
 
 

@@ -35,6 +35,7 @@ run lm-ulysses         examples/long_context_lm.py --seq-len 256 --steps 3 --dim
 run lm-remat           examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --remat
 run lm-gqa             examples/long_context_lm.py --seq-len 256 --steps 3 --dim 64 --layers 1 --heads 4 --kv-heads 2
 run chaos-killrank     examples/chaos_training.py --steps 30 --dim 8
+run serving-failover   examples/decentralized_serving.py --steps 16 --requests 4 --kill-step 7 --prefix /tmp/bf_serving_example_
 
 # The two notebooks execute for real (reference parity: the notebooks are
 # its interactive-mode showcase, examples/interactive_bluefog.ipynb).

@@ -1,0 +1,628 @@
+"""Serving-tier tests (PR 11, ``bluefog_tpu/serving/``, docs/serving.md).
+
+Closed-form style like the window suite: exact fold values against host
+references, staleness watermarks stepped by hand, router failover /
+refusal state machines driven through seeded scenarios, the serving
+trail's JSONL schema (incl. the unknown-field tolerance contract), the
+``bfmonitor`` serving block, and the off-switchable standard — a live
+serving tier leaves the training step's lowered StableHLO byte-identical.
+"""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.serving import (
+    NoReplicaAvailable,
+    ReplicaDeadError,
+    ReplicaSet,
+    RequestRouter,
+    StaleReplicaError,
+    WeightPublisher,
+    read_serving_trail,
+    serving_topology,
+)
+
+from conftest import N_DEVICES as N
+
+PUBS, REPS = [0, 1], [N - 2, N - 1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_windows():
+    yield
+    bf.win_free()
+
+
+def make_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(N, 4, 3)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(N, 3)), jnp.float32)}
+
+
+def linear_apply(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def make_tier(params=None, *, compression=None, edges=None,
+              max_staleness=3, prefix=None, **router_kw):
+    params = params if params is not None else make_params()
+    pub = WeightPublisher(params, PUBS, REPS, compression=compression,
+                          edges=edges)
+    rs = ReplicaSet(pub, linear_apply, max_staleness=max_staleness)
+    router = RequestRouter(rs, prefix=prefix, **router_kw)
+    return pub, rs, router
+
+
+# ---------------------------------------------------------------------------
+# Topology + fold numerics
+# ---------------------------------------------------------------------------
+
+def test_serving_topology_bipartite_weights(bf_ctx):
+    topo = serving_topology(PUBS, REPS, size=N)
+    W = topo.weight_matrix
+    for r in REPS:
+        assert sorted(topo.in_neighbor_ranks(r)) == sorted(PUBS)
+        np.testing.assert_allclose(W[PUBS, r], 1.0 / len(PUBS))
+    # non-serving ranks are isolated vertices
+    for i in range(N):
+        if i not in PUBS and i not in REPS:
+            assert topo.in_neighbor_ranks(i) == []
+            assert topo.out_neighbor_ranks(i) == []
+
+
+def test_serving_topology_duplicate_edges_deduped(bf_ctx):
+    """A repeated (pub, rep) pair must not under-weight the fold (indeg
+    counted twice while W assigned once would halve the served weights)."""
+    topo = serving_topology([0], [2], size=N, edges=[(0, 2), (0, 2)])
+    np.testing.assert_allclose(topo.weight_matrix[0, 2], 1.0)
+
+
+def test_serving_topology_validation(bf_ctx):
+    with pytest.raises(ValueError, match="disjoint"):
+        serving_topology([0, 1], [1, 2], size=N)
+    with pytest.raises(ValueError, match="no publisher edge"):
+        serving_topology([0], [2, 3], size=N, edges=[(0, 2)])
+    with pytest.raises(ValueError, match="publisher -> replica"):
+        serving_topology([0], [2], size=N, edges=[(2, 0)])
+
+
+def test_publisher_rejects_topo_edges_conflict_and_unfed_topo(bf_ctx):
+    """topo= and edges= are mutually exclusive (edges would be silently
+    dropped), and a caller topo that leaves a replica feedless is
+    rejected instead of making it silently unroutable forever."""
+    params = make_params()
+    topo = serving_topology(PUBS, REPS, size=N)
+    with pytest.raises(ValueError, match="not both"):
+        WeightPublisher(params, PUBS, REPS, topo=topo,
+                        edges=[(PUBS[0], REPS[0])])
+    # a topo feeding only one of the two replicas
+    partial = serving_topology(PUBS, [REPS[0]], size=N)
+    with pytest.raises(ValueError, match="no publisher in-edge"):
+        WeightPublisher(params, PUBS, REPS, topo=partial)
+
+
+def test_publish_fold_is_exact_publisher_average(bf_ctx):
+    """Uncompressed publish -> refresh makes every replica row the exact
+    mean of its publishers' rows, publisher rows untouched."""
+    params = make_params()
+    pub, rs, _ = make_tier(params)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    for leaf in ("w", "b"):
+        want = np.asarray(params[leaf])[PUBS].mean(axis=0)
+        for r in REPS:
+            np.testing.assert_array_equal(
+                np.asarray(rs.params_of(r)[leaf]), want)
+    rs.close()
+
+
+def test_compressed_window_fold_within_quantizer_tolerance(bf_ctx):
+    params = make_params()
+    pub, rs, _ = make_tier(params, compression="int8")
+    pub.publish(params, 0)
+    rs.refresh(0)
+    for r in REPS:
+        got = np.asarray(rs.params_of(r)["w"])
+        want = np.asarray(params["w"])[PUBS].mean(axis=0)
+        # per-bucket int8 scale: |err| <= scale = max|x| / 127
+        tol = np.abs(np.asarray(params["w"])[PUBS]).max() / 127 + 1e-6
+        assert np.abs(got - want).max() <= tol
+    rs.close()
+
+
+def test_sparsifier_window_rejected_with_guidance(bf_ctx):
+    with pytest.raises(ValueError, match="dense quantizing"):
+        make_tier(compression="topk:0.1")
+
+
+def test_dead_publisher_degrades_to_self_weight(bf_ctx):
+    """A dead publisher's mass moves to the replica's self weight: the
+    fold blends the live feed with the replica's PREVIOUS fold instead
+    of folding the dead rank's frozen buffer at full weight."""
+    params = make_params()
+    pub, rs, _ = make_tier(params)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    prev = np.asarray(rs.params_of(REPS[0])["w"])
+    p2 = jax.tree.map(lambda a: a + 1.0, params)
+    alive = np.ones(N)
+    alive[PUBS[0]] = 0.0
+    pub.publish(p2, 1, alive=alive)
+    rs.refresh(1, alive=alive)
+    got = np.asarray(rs.params_of(REPS[0])["w"])
+    want = 0.5 * np.asarray(p2["w"][PUBS[1]]) + 0.5 * prev
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    rs.close()
+
+
+# ---------------------------------------------------------------------------
+# Staleness watermarks
+# ---------------------------------------------------------------------------
+
+def test_staleness_watermark_lifecycle(bf_ctx):
+    params = make_params()
+    pub, rs, _ = make_tier(params, max_staleness=2)
+    r = REPS[0]
+    # before any fold: infinitely stale, refuses to serve
+    assert rs.staleness_of(r, 0) == math.inf
+    assert not rs.can_serve(r, 0)
+    with pytest.raises(StaleReplicaError):
+        rs.serve(r, jnp.ones((1, 4)), 0)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    assert rs.staleness_of(r, 0) == 0.0
+    # publisher goes quiet: staleness accrues step by step
+    for t in range(1, 4):
+        rs.refresh(t)
+        assert rs.staleness_of(r, t) == float(t)
+    assert not rs.can_serve(r, 3)          # 3 > bound 2
+    # a fresh publication resets the watermark
+    pub.publish(params, 4)
+    rs.refresh(4)
+    assert rs.staleness_of(r, 4) == 0.0
+    assert rs.can_serve(r, 4)
+    rs.close()
+
+
+def test_watermark_is_oldest_live_feed(bf_ctx):
+    """With two feeds the watermark tracks the OLDEST live one — the
+    fold blended that step's data in, so staleness must not report the
+    newer feed's age."""
+    params = make_params()
+    pub, rs, _ = make_tier(params)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    # only publisher 1 ships at step 3
+    alive = np.ones(N)
+    alive[PUBS[0]] = 0.0
+    pub.publish(params, 3, alive=alive)
+    rs.refresh(3)                  # no alive mask: both feeds count
+    assert rs.staleness_of(REPS[0], 3) == 3.0     # oldest feed is step 0
+    # with the dead feed masked out, only the live feed bounds staleness
+    rs.refresh(3, alive=alive)
+    assert rs.staleness_of(REPS[0], 3) == 0.0
+    rs.close()
+
+
+def test_serve_runs_apply_fn_on_replica_row(bf_ctx):
+    params = make_params()
+    pub, rs, _ = make_tier(params)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    x = jnp.ones((2, 4), jnp.float32)
+    out = rs.serve(REPS[0], x, 0)
+    want = linear_apply(rs.params_of(REPS[0]), x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    with pytest.raises(ValueError, match="not a serving replica"):
+        rs.serve(PUBS[0], x, 0)
+    alive = np.ones(N)
+    alive[REPS[0]] = 0.0
+    with pytest.raises(ReplicaDeadError):
+        rs.serve(REPS[0], x, 0, alive=alive)
+    rs.close()
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+def test_router_sticky_and_stale_shunning(bf_ctx, tmp_path):
+    """Dedicated feeds; the starved replica's breach causes exactly one
+    'stale' failover and it is never routed to again."""
+    params = make_params()
+    rep_a, rep_b = REPS
+    pub, rs, router = make_tier(
+        params, max_staleness=2,
+        edges=[(PUBS[0], rep_a), (PUBS[1], rep_b)],
+        prefix=str(tmp_path / "t_"))
+    x = jnp.ones((1, 4), jnp.float32)
+    dead = np.ones(N)
+    dead[PUBS[0]] = 0.0
+    routed = []
+    for t in range(8):
+        pub.publish(params, t, alive=dead if t >= 2 else None)
+        rs.refresh(t, alive=dead if t >= 2 else None)
+        for _ in range(2):
+            _, r = router.route(x, t)
+            routed.append((t, r))
+            assert rs.staleness_of(r, t) <= rs.max_staleness
+    # sticky on rep_a until the breach (staleness > 2 from step 4), then
+    # rep_b forever
+    assert all(r == rep_a for t, r in routed if t < 4)
+    assert all(r == rep_b for t, r in routed if t >= 4)
+    assert [(f.reason, f.replica_from, f.replica_to)
+            for f in router.failovers] == [("stale", rep_a, rep_b)]
+    assert router.refused == 0
+    router.close()
+    rs.close()
+
+
+def test_router_dead_replica_single_failover_zero_failures(bf_ctx):
+    params = make_params()
+    rep_a, rep_b = REPS
+    pub, rs, router = make_tier(params)
+    x = jnp.ones((1, 4), jnp.float32)
+    alive = np.ones(N)
+    served = []
+    for t in range(6):
+        if t == 3:
+            alive[rep_a] = 0.0
+        pub.publish(params, t)
+        rs.refresh(t)
+        _, r = router.route(x, t, alive=alive)
+        served.append(r)
+    assert served[:3] == [rep_a] * 3 and served[3:] == [rep_b] * 3
+    assert [(f.step, f.reason, f.replica_from, f.replica_to)
+            for f in router.failovers] == [(3, "dead", rep_a, rep_b)]
+    assert router.refused == 0
+    assert sum(router.hits.values()) == 6
+    # the confirmed-dead replica never re-enters the candidate set
+    assert router.confirmed_dead(rep_a, 5)
+    assert rep_a not in router._candidates(5)
+    rs.close()
+
+
+def test_dead_nonsticky_candidate_is_not_a_failover(bf_ctx):
+    """A dead replica that never carried traffic leaves the candidate
+    set silently: failover events count STICKY-target switches only."""
+    params = make_params()
+    rep_a, rep_b = REPS
+    pub, rs, router = make_tier(params)
+    x = jnp.ones((1, 4), jnp.float32)
+    alive = np.ones(N)
+    alive[rep_a] = 0.0           # the first-ordered candidate is dead
+    pub.publish(params, 0)
+    rs.refresh(0)
+    _, r = router.route(x, 0, alive=alive)   # retried onto rep_b
+    assert r == rep_b
+    assert router.failovers == []            # no sticky target switched
+    assert router.refused == 0
+    # rep_a stays out of the candidate set (hard-confirmed by the error)
+    assert rep_a not in router._candidates(0)
+    rs.close()
+
+
+def test_unmeasured_cost_edge_sorts_last(bf_ctx):
+    """A replica the probe never priced must not beat a measured one by
+    defaulting cheap: unmeasured edges sort last at equal staleness."""
+    from bluefog_tpu.observability.commprof import EdgeCostMatrix
+    rep_a, rep_b = REPS
+    # only the HIGHER-ranked replica is measured (expensive, but known)
+    matrix = EdgeCostMatrix(
+        n=N, platform=jax.default_backend(),
+        entries=[{"src": 0, "dst": rep_b, "bytes": 4096, "rounds": 1,
+                  "inner": 1, "latency_us": 900.0, "gbps": 1.0}])
+    params = make_params()
+    pub, rs, router = make_tier(params, cost_matrix=matrix, client_rank=0)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    _, r = router.route(jnp.ones((1, 4)), 0)
+    assert r == rep_b            # measured 900us beats unmeasured inf
+    rs.close()
+
+
+def test_trail_rotation_rewrites_head_record(bf_ctx, tmp_path,
+                                             monkeypatch):
+    """A rotated serving trail must still open with its serve_config
+    head (like the decision trail) — the monitor block reads replicas
+    and the bound from it."""
+    monkeypatch.setenv("BLUEFOG_METRICS_MAX_MB", "0.0005")  # ~500 bytes
+    prefix = str(tmp_path / "rot_")
+    params = make_params()
+    pub, rs, router = make_tier(params, prefix=prefix)
+    x = jnp.ones((1, 4), jnp.float32)
+    for t in range(30):          # far past the cap: several rotations
+        pub.publish(params, t)
+        rs.refresh(t)
+        router.route(x, t)
+        router.log(t)
+    router.close()
+    rs.close()
+    config, recs = read_serving_trail(prefix + "serving.jsonl")
+    assert config is not None and config["replicas"] == REPS
+    assert recs                  # rotated live file still has records
+
+
+def test_failover_event_names_the_replica_that_served(bf_ctx):
+    """replica_to is resolved AFTER the retry loop: a stale sticky
+    target whose would-be successor turns out dead must record the
+    outage (replica_to None), not the dead candidate it never reached."""
+    params = make_params()
+    rep_a, rep_b = REPS
+    pub, rs, router = make_tier(
+        params, max_staleness=1,
+        edges=[(PUBS[0], rep_a), (PUBS[1], rep_b)])
+    x = jnp.ones((1, 4), jnp.float32)
+    starve_a = np.ones(N)
+    starve_a[PUBS[0]] = 0.0
+    pub.publish(params, 0)
+    rs.refresh(0)
+    _, r = router.route(x, 0)
+    assert r == rep_a                       # sticky on rep_a
+    # rep_a starves past the bound while rep_b dies (unconfirmed)
+    for t in (1, 2):
+        pub.publish(params, t, alive=starve_a)
+        rs.refresh(t, alive=starve_a)
+    dead_b = np.ones(N)
+    dead_b[rep_b] = 0.0
+    with pytest.raises(NoReplicaAvailable):
+        router.route(x, 2, alive=dead_b)
+    assert [(f.reason, f.replica_from, f.replica_to)
+            for f in router.failovers] == [("stale", rep_a, None)]
+    rs.close()
+
+
+def test_router_refuses_when_nothing_eligible(bf_ctx):
+    params = make_params()
+    pub, rs, router = make_tier(params, max_staleness=1)
+    x = jnp.ones((1, 4), jnp.float32)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    router.route(x, 0)
+    for t in range(1, 4):
+        rs.refresh(t)              # nobody publishes: everyone ages out
+    with pytest.raises(NoReplicaAvailable):
+        router.route(x, 3)
+    assert router.refused == 1
+    rs.close()
+
+
+def test_router_cost_tiebreak_and_matrix_guard(bf_ctx):
+    """A USABLE measured matrix orders equal-staleness replicas by edge
+    cost from the client rank; a foreign-platform matrix is refused and
+    rank order prevails."""
+    from bluefog_tpu.observability.commprof import EdgeCostMatrix
+    rep_a, rep_b = REPS
+
+    def entry(src, dst, lat):
+        return {"src": src, "dst": dst, "bytes": 4096, "rounds": 1,
+                "inner": 1, "latency_us": lat, "gbps": 1.0}
+
+    live = jax.default_backend()
+    # rep_b is the cheap edge from client rank 0
+    usable = EdgeCostMatrix(
+        n=N, platform=live,
+        entries=[entry(0, rep_a, 900.0), entry(0, rep_b, 10.0)])
+    params = make_params()
+    pub, rs, router = make_tier(params, cost_matrix=usable, client_rank=0)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    _, r = router.route(jnp.ones((1, 4)), 0)
+    assert r == rep_b
+    rs.close()
+    bf.win_free()
+
+    foreign = EdgeCostMatrix(
+        n=N, platform="tpu" if live != "tpu" else "cpu",
+        entries=usable.entries)
+    pub2, rs2, router2 = make_tier(make_params(), cost_matrix=foreign,
+                                   client_rank=0)
+    assert router2._cost == {}     # refused: not a usable link model
+    pub2.publish(make_params(), 0)
+    rs2.refresh(0)
+    _, r = router2.route(jnp.ones((1, 4)), 0)
+    assert r == rep_a              # rank order fallback
+    rs2.close()
+
+
+# ---------------------------------------------------------------------------
+# win_update_then_collect x compression x liveness (satellite: the three
+# features composed in ONE call — previously only tested pairwise)
+# ---------------------------------------------------------------------------
+
+def test_collect_with_compression_and_liveness_mask(bf_ctx):
+    """Push-sum collect over a COMPRESSED window with a liveness mask:
+    the dead in-neighbor's buffer is dropped from the sum (not
+    mass-moved to self — collect is a sum), live buffers keep their
+    quantized-decode values exactly, and only read slots reset."""
+    import networkx as nx
+    bf.set_topology(bf.RingGraph(N))
+    x = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.float32)[:, None], (N, 3)) + 1.0
+    bf.win_create(x, "c", zero_init=True, compression="int8")
+    bf.win_put(x, "c")
+    dead = (0 + 1) % N                     # an in-neighbor of rank... all
+    alive = np.ones(N)
+    alive[dead] = 0.0
+    out = np.asarray(bf.win_update_then_collect("c", alive=alive))
+    W = nx.to_numpy_array(bf.load_topology())
+    A = (W != 0).astype(np.float64)
+    np.fill_diagonal(A, 0.0)
+    xs = np.asarray(x, np.float64)
+    # int8 decode of what each rank sent (per-leaf bucket scale)
+    scale = np.abs(xs).max(axis=1, keepdims=True) / 127.0
+    sent = np.round(xs / np.where(scale == 0, 1.0, scale)) * scale
+    for r in range(N):
+        contrib = sum(sent[s] for s in range(N)
+                      if A[s, r] and alive[s] > 0)
+        np.testing.assert_allclose(out[r], xs[r] + contrib,
+                                   rtol=1e-5, atol=1e-5)
+    # dead rank's buffer survived the reset=True collect: once it comes
+    # back alive, a second collect still sees the old delivery
+    out2 = np.asarray(bf.win_update_then_collect("c"))
+    for r in range(N):
+        if A[dead, r]:
+            np.testing.assert_allclose(out2[r], out[r] + sent[dead],
+                                       rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Serving trail schema + monitor block
+# ---------------------------------------------------------------------------
+
+def run_small_episode(prefix, steps=5):
+    params = make_params()
+    pub, rs, router = make_tier(params, prefix=prefix)
+    x = jnp.ones((1, 4), jnp.float32)
+    for t in range(steps):
+        pub.publish(params, t)
+        rs.refresh(t)
+        router.route(x, t)
+        router.log(t)
+    router.close()
+    rs.close()
+    return router
+
+
+def test_serving_trail_schema_validates(bf_ctx, tmp_path):
+    from bluefog_tpu.observability import export as EX
+    prefix = str(tmp_path / "s_")
+    run_small_episode(prefix)
+    trail = prefix + "serving.jsonl"
+    records = EX.validate_jsonl(trail)
+    kinds = [r.get("kind") for r in records]
+    assert kinds[0] == "serve_config" and kinds.count("serve") == 5
+    config, recs = read_serving_trail(trail)
+    assert config["replicas"] == REPS
+    assert all(r["requests_per_s"] >= 0 for r in recs)
+
+
+def test_serving_trail_unknown_fields_tolerated(bf_ctx, tmp_path):
+    """Forward compatibility: a NEW writer's extra fields must never
+    break an old validator (the PR 8 contract, extended to serving)."""
+    from bluefog_tpu.observability import export as EX
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "serve", "step": 0, "t_us": 1, "requests_per_s": 2.0,
+            "serve_staleness": {"4": 0.0}, "hits": {"4": 3},
+            "future_field": {"nested": True}}) + "\n")
+        f.write(json.dumps({
+            "kind": "serve_failover", "step": 1, "t_us": 2,
+            "replica_from": 4, "replica_to": 5, "reason": "dead",
+            "new_diag": "x"}) + "\n")
+        # replica_to None = total outage, still valid
+        f.write(json.dumps({
+            "kind": "serve_failover", "step": 2, "t_us": 3,
+            "replica_from": 5, "replica_to": None,
+            "reason": "stale"}) + "\n")
+    assert len(EX.validate_jsonl(path)) == 3
+
+
+@pytest.mark.parametrize("bad, msg", [
+    ({"kind": "serve", "step": 0, "t_us": 1}, "missing keys"),
+    ({"kind": "serve", "step": 0, "t_us": 1, "requests_per_s": "fast"},
+     "not numeric"),
+    ({"kind": "serve", "step": 0, "t_us": 1, "requests_per_s": 1.0,
+      "serve_staleness": [0.0]}, "must be an object"),
+    ({"kind": "serve_failover", "step": 0, "t_us": 1, "replica_from": 4,
+      "replica_to": 5, "reason": 7}, "must be a string"),
+])
+def test_serving_trail_schema_rejects_malformed(tmp_path, bad, msg):
+    from bluefog_tpu.observability import export as EX
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(bad) + "\n")
+    with pytest.raises(ValueError, match=msg):
+        EX.validate_jsonl(path)
+
+
+def test_monitor_serving_block_and_panel(bf_ctx, tmp_path):
+    from bluefog_tpu.observability import export as EX
+    from bluefog_tpu.run import monitor as MON
+    prefix = str(tmp_path / "m_")
+    # a main series so the fleet view is non-empty
+    EX.metrics_start(prefix, rank=0)
+    for t in range(5):
+        EX.log_step(t, {"consensus_dist": 0.5 / (t + 1)})
+    EX.metrics_end()
+    run_small_episode(prefix)
+    _, _, out = MON.build_report(prefix)
+    block = out["serving"]
+    assert block["replicas"] == [str(r) for r in REPS]
+    assert block["failovers"]["total"] == 0
+    assert block["requests_per_s"] > 0
+    assert block["staleness"][str(REPS[0])]["last"] == 0.0
+    panel = MON.render_serving(block)
+    assert "replica" in panel and str(REPS[0]) in panel
+    # a prefix with no trail stays noise-free
+    _, _, out2 = MON.build_report(str(tmp_path / "none_"))
+    assert out2["serving"] is None
+
+
+# ---------------------------------------------------------------------------
+# Off-switchable standard + compile stability
+# ---------------------------------------------------------------------------
+
+def test_training_step_hlo_identical_with_serving_tier_live(bf_ctx):
+    """The serving tier rides its own window programs: a live tier
+    (window created, weights published, folds running) must leave the
+    TRAINING step's lowered StableHLO byte-identical — the subsystem's
+    inertness proof (the repo's off-switchable standard)."""
+    import optax
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    from bluefog_tpu.utils import trace_metrics as TM
+
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    x = jnp.zeros((N, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((N, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    mk = lambda: T.make_train_step(model, base, donate=False)
+
+    text_off, _ = TM.lower_text(mk(), *args)
+
+    params = make_params()
+    pub, rs, router = make_tier(params, compression="int8")
+    pub.publish(params, 0)
+    rs.refresh(0)
+    router.route(jnp.ones((1, 4)), 0)
+    try:
+        text_on, _ = TM.lower_text(mk(), *args)
+    finally:
+        rs.close()
+    assert text_on == text_off
+
+
+def test_publish_refresh_cycles_compile_once(bf_ctx):
+    """Steady-state serving reuses ONE put kernel and ONE fold kernel:
+    repeated publish/refresh cycles add zero window-program compiles."""
+    from bluefog_tpu.ops import windows as W
+    params = make_params()
+    pub, rs, router = make_tier(params)
+    x = jnp.ones((1, 4), jnp.float32)
+    pub.publish(params, 0)
+    rs.refresh(0)
+    router.route(x, 0)
+    push0 = W._push_fn.cache_info().misses
+    upd0 = W._update_fn.cache_info().misses
+    alive = np.ones(N)
+    for t in range(1, 6):
+        if t == 3:
+            alive[PUBS[0]] = 0.0   # a mid-run death is traced data
+        pub.publish(params, t, alive=alive)
+        rs.refresh(t, alive=alive)
+        router.route(x, t, alive=alive)
+    assert W._push_fn.cache_info().misses == push0
+    assert W._update_fn.cache_info().misses == upd0
+    rs.close()
