@@ -12,6 +12,8 @@
 #   make test_torch         # torch frontend
 #   make examples           # smoke-run every example (run_all_examples.sh)
 #   make bench              # headline benchmark (real TPU if available)
+#   make lint               # pre-PR gate: bflint AST contract rules +
+#                           # StableHLO trace-hazard pass (docs/static_analysis.md)
 
 NUM_DEVICES ?= 8
 PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
@@ -20,7 +22,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
-        profile-smoke control-smoke serve-smoke bench-serve
+        profile-smoke control-smoke serve-smoke bench-serve lint
 
 test:
 	$(PYTEST) tests/
@@ -185,6 +187,18 @@ serve-smoke:
 # (training steps), fold latency, and the zero-failover invariant.
 bench-serve:
 	python bench.py --serve
+
+# Pre-PR lint gate (docs/static_analysis.md): one bflint invocation runs
+# the AST contract rules (env-doc sync, JSONL kinds, bf_* metric names,
+# host-time-in-trace, step-cache-key knob coverage, import-time env
+# reads) AND, under --trace, the StableHLO trace-hazard pass over the
+# canonical bench-trace step configs (donation aliasing, wire dtype
+# upcasts, fusion-plan collective budget) on the virtual CPU mesh.
+# Exits non-zero on ANY unsuppressed finding; the shipped baseline
+# (bluefog_tpu/analysis/baseline.toml) is empty — fix findings, don't
+# suppress them.  Also enforced in tier-1 by tests/test_lint_clean.py.
+lint:
+	python -m bluefog_tpu.analysis.cli --trace
 
 # compile+run every Pallas kernel on the real chip (interpret mode does
 # not enforce TPU tiling — see docs/performance.md, round-2 lesson)

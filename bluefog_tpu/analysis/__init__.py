@@ -1,0 +1,29 @@
+"""Project-invariant static analysis (``bflint``).
+
+Two halves (docs/static_analysis.md):
+
+* :mod:`.astrules` — AST contract rules over the package source: env-var
+  doc sync, JSONL kind sync, metric-name registration, host-time-in-
+  trace, step-cache-key knob coverage, import-time env reads.
+* :mod:`.tracehazards` — StableHLO trace-hazard pass over the lowered
+  canonical step programs: dropped buffer donation, wire dtype upcasts,
+  collective count vs the fusion-plan budget.  (Imported lazily — it
+  pulls in jax; the AST half stays import-light so ``bflint`` can pin
+  the CPU platform before any backend initializes.)
+
+Findings filter through the checked-in ``analysis/baseline.toml``
+(seeded empty — fix findings, do not suppress them) and gate
+``make lint`` and ``tests/test_lint_clean.py``.
+"""
+
+from .astrules import (ALL_RULES, documented_metric_names,
+                       emitted_metric_names, jsonl_kind_sets,
+                       run_ast_rules)
+from .baseline import BaselineError, load_baseline
+from .findings import Finding, format_json, format_text, summary_line
+
+__all__ = [
+    "ALL_RULES", "Finding", "run_ast_rules", "jsonl_kind_sets",
+    "emitted_metric_names", "documented_metric_names", "load_baseline",
+    "BaselineError", "format_text", "format_json", "summary_line",
+]

@@ -1,0 +1,821 @@
+"""AST contract rules: the project's load-bearing conventions as
+machine-checked invariants (stdlib ``ast`` only, no new dependencies).
+
+Eleven PRs of review hardening kept re-finding the same drift classes by
+hand; each rule below is one of those classes, named and enforced:
+
+``env-doc-drift``
+    Every ``BLUEFOG_*`` environment variable the code reads must appear
+    in ``docs/env_variable.md``, and every documented name must still be
+    read somewhere — catching both the undocumented knob and the stale
+    doc row.  Dynamic prefix reads (``_ENV_PREFIX + name`` in the health
+    and control threshold tables) count as reading every documented name
+    under that prefix.
+``jsonl-kind-drift``
+    Every record ``kind`` the observability/serving/control exporters
+    write must be accepted by ``export.validate_jsonl`` (its
+    ``_KIND_REQUIRED`` table), and every accepted kind must still have a
+    writer.  Both sets are DERIVED here, never hand-listed, so the
+    validator and the exporters cannot drift silently.
+``metric-name-drift``
+    Every ``bf_*`` counter/gauge/histogram name emitted must appear (by
+    exact name — wildcard prose does not count) in ``docs/``, and a name
+    must be registered with ONE metric kind everywhere it is used (the
+    registry raises on kind aliasing at runtime; this catches it before
+    any process runs).
+``host-time-in-trace``
+    ``time.*`` clocks, ``datetime.now``, ``np.random.*``, and stdlib
+    ``random.*`` must be unreachable from functions that get traced
+    (passed to ``jax.jit``/``shard_map``/``pmap``, or the step functions
+    the ``optim/strategies.py`` builders return): a host-time read inside
+    a traced function freezes the first call's value into the compiled
+    program — the recompile/replay hazard class.
+``knob-outside-cache-key``
+    Keyword knobs (parameters with defaults) on the strategy/optimizer/
+    train-step factories must either be parameters of
+    ``optim/_plumbing.step_cache_key`` or be named in the factory
+    module's ``_STEP_KEY_EXEMPT_KNOBS`` annotation (traced data, pinned
+    at construction, or keyed via the context ids) — a knob that shapes
+    the compiled program but joins neither silently serves stale
+    programs.
+``import-time-env-read``
+    ``os.environ``/``os.getenv`` reads at module import time freeze
+    configuration before ``bfrun``/``bf.init()`` can set it; every env
+    read must happen inside a function.
+
+All rules run against a repo root (defaulting to this checkout) so the
+analyzer's own tests can run them hermetically on synthetic trees.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+__all__ = ["ALL_RULES", "run_ast_rules", "jsonl_kind_sets",
+           "emitted_metric_names", "documented_metric_names",
+           "default_repo_root"]
+
+ALL_RULES = (
+    "env-doc-drift",
+    "jsonl-kind-drift",
+    "metric-name-drift",
+    "host-time-in-trace",
+    "knob-outside-cache-key",
+    "import-time-env-read",
+)
+
+_ENV_NAME = re.compile(r"^BLUEFOG_[A-Z0-9_]*$")
+_DOC_ENV_TOKEN = re.compile(r"BLUEFOG_[A-Z0-9_]+")
+_DOC_METRIC_TOKEN = re.compile(r"\bbf_[a-z0-9_]+")
+
+# modules whose JSONL writers must agree with validate_jsonl
+_JSONL_EXPORTER_DIRS = ("observability", "serving", "control")
+
+# a factory is a function shaped like the step/state builders: a
+# build-ish name AND at least two of the canonical knob names in its
+# signature (one alone — e.g. a helper taking `compression` — is not a
+# factory and carries no cache-key obligation)
+_FACTORY_NAME = re.compile(r"^(make_|create_)|(_step|_init|__init__)$")
+_KNOB_MARKERS = frozenset({
+    "fuse", "fusion_bucket_bytes", "overlap", "telemetry", "compression",
+    "control"})
+# step_cache_key spells some knobs differently from the factories
+_KNOB_ALIASES = {"fusion_bucket_bytes": "bucket_bytes",
+                 "backend": "nar_backend",
+                 "axis_name": "gossip_axis"}
+
+# host-time hazards (see module docstring).  jax.random is fine — it is
+# traced, keyed, and replayable; these are not.
+_TIME_FUNCS = frozenset({
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "process_time", "process_time_ns"})
+_DATETIME_HAZARDS = frozenset({
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today"})
+_JIT_ENTRY_NAMES = frozenset({"jit", "pmap", "pjit", "shard_map"})
+
+
+def default_repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# per-module fact extraction
+# ---------------------------------------------------------------------------
+
+class _ModuleFacts:
+    """Everything the rules need from one parsed file."""
+
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.consts: Dict[str, str] = {}       # module-level str constants
+        self.import_map: Dict[str, str] = {}   # local name -> dotted module
+        self.env_reads: List[Tuple[str, bool, int, bool]] = []
+        #                 (name-or-prefix, is_prefix, line, module_level)
+        self.env_literals: Set[str] = set()    # exact BLUEFOG_* constants
+        self.env_literal_prefixes: Set[str] = set()
+        self.metric_calls: List[Tuple[str, str, int]] = []  # (kind, name, ln)
+        self.kind_emits: List[Tuple[str, int]] = []
+        self.exempt_knobs: Set[str] = set()    # _STEP_KEY_EXEMPT_KNOBS
+        self.functions: Dict[str, ast.FunctionDef] = {}  # name -> def (any)
+
+
+def _dotted(node) -> Optional[List[str]]:
+    """Attribute/Name chain as a name list, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _resolve_str(node, consts: Dict[str, str]
+                 ) -> Optional[Tuple[str, bool]]:
+    """``(value, is_prefix)`` of a string-ish expression: a literal, a
+    module constant, ``PREFIX + x``, or an f-string with a literal head."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id], False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve_str(node.left, consts)
+        if left is not None:
+            return left[0], True
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if (isinstance(head, ast.Constant)
+                and isinstance(head.value, str)):
+            return head.value, True
+    return None
+
+
+def _is_os_environ(node, facts: _ModuleFacts) -> bool:
+    """``os.environ`` (or a bare ``environ`` imported from os)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        root = _dotted(node)
+        return bool(root) and facts.import_map.get(root[0]) == "os"
+    if isinstance(node, ast.Name):
+        return facts.import_map.get(node.id) == "os.environ"
+    return False
+
+
+def _collect_imports(facts: _ModuleFacts) -> None:
+    for node in ast.walk(facts.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                facts.import_map[local] = (a.name if a.asname
+                                           else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                facts.import_map[a.asname or a.name] = (
+                    f"{node.module}.{a.name}")
+
+
+def _collect_consts(facts: _ModuleFacts) -> None:
+    for stmt in facts.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            facts.consts[stmt.targets[0].id] = stmt.value.value
+
+
+def _collect_exempt_knobs(facts: _ModuleFacts) -> None:
+    for stmt in facts.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_STEP_KEY_EXEMPT_KNOBS"):
+            for n in ast.walk(stmt.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    facts.exempt_knobs.add(n.value)
+
+
+def _walk_scoped(node, in_func, visit) -> None:
+    """Walk recording whether each node sits inside a function BODY
+    (decorators and default expressions evaluate at import time and stay
+    module-level)."""
+    for child in ast.iter_child_nodes(node):
+        child_in = in_func
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if child in node.body:
+                child_in = True
+        elif isinstance(node, ast.Lambda) and child is node.body:
+            child_in = True
+        visit(child, child_in)
+        _walk_scoped(child, child_in, visit)
+
+
+def _collect_env_and_metrics(facts: _ModuleFacts) -> None:
+    consts = facts.consts
+
+    def note_env(value_prefix, lineno, module_level):
+        name, is_prefix = value_prefix
+        if not name.startswith("BLUEFOG_"):
+            return
+        facts.env_reads.append((name, is_prefix, lineno, module_level))
+
+    def visit(node, in_func):
+        module_level = not in_func
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _ENV_NAME.match(node.value):
+                if node.value.endswith("_"):
+                    facts.env_literal_prefixes.add(node.value)
+                else:
+                    facts.env_literals.add(node.value)
+        if isinstance(node, ast.Call):
+            func = node.func
+            # os.getenv(...) / os.environ.get/pop/setdefault(...)
+            if isinstance(func, ast.Attribute):
+                recv = func.value
+                if (func.attr == "getenv"
+                        and isinstance(recv, ast.Name)
+                        and facts.import_map.get(recv.id) == "os"):
+                    if node.args:
+                        r = _resolve_str(node.args[0], consts)
+                        if r:
+                            note_env(r, node.lineno, module_level)
+                            return
+                    if module_level:
+                        facts.env_reads.append(
+                            ("<os.getenv>", True, node.lineno, True))
+                elif (func.attr in ("get", "pop", "setdefault")
+                        and _is_os_environ(recv, facts)):
+                    if node.args:
+                        r = _resolve_str(node.args[0], consts)
+                        if r:
+                            note_env(r, node.lineno, module_level)
+                            return
+                    if module_level:
+                        facts.env_reads.append(
+                            ("<os.environ>", True, node.lineno, True))
+                elif func.attr == "get" and node.args:
+                    # env-dict forwarding reads (`env.get("BLUEFOG_X")`):
+                    # count BLUEFOG names only — a generic .get is not an
+                    # env read, but launcher env dicts are
+                    r = _resolve_str(node.args[0], consts)
+                    if r and r[0].startswith("BLUEFOG_"):
+                        note_env(r, node.lineno, False)
+            elif (isinstance(func, ast.Name)
+                    and facts.import_map.get(func.id) == "os.getenv"):
+                # `from os import getenv` — same read, bare-name spelling
+                if node.args:
+                    r = _resolve_str(node.args[0], consts)
+                    if r:
+                        note_env(r, node.lineno, module_level)
+                        return
+                if module_level:
+                    facts.env_reads.append(
+                        ("<os.getenv>", True, node.lineno, True))
+            # metric registrations: counter/gauge/histogram("bf_...")
+            mkind = None
+            if isinstance(func, ast.Attribute) and func.attr in (
+                    "counter", "gauge", "histogram"):
+                mkind = func.attr
+            elif isinstance(func, ast.Name) and func.id in (
+                    "counter", "gauge", "histogram"):
+                mkind = func.id
+            if (mkind and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("bf_")):
+                facts.metric_calls.append(
+                    (mkind, node.args[0].value, node.lineno))
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load):
+            if _is_os_environ(node.value, facts):
+                r = _resolve_str(node.slice, consts)
+                if r:
+                    note_env(r, node.lineno, module_level)
+                elif module_level:
+                    facts.env_reads.append(
+                        ("<os.environ>", True, node.lineno, True))
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "kind"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    facts.kind_emits.append((v.value, node.lineno))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and tgt.slice.value == "kind"
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    facts.kind_emits.append(
+                        (node.value.value, node.lineno))
+
+    _walk_scoped(facts.tree, False, visit)
+
+
+def _collect_functions(facts: _ModuleFacts) -> None:
+    for node in ast.walk(facts.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            facts.functions.setdefault(node.name, node)
+
+
+def _parse_file(root: str, relpath: str) -> Optional[_ModuleFacts]:
+    path = os.path.join(root, relpath)
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=relpath)
+    except (OSError, SyntaxError):
+        return None
+    facts = _ModuleFacts(relpath, tree)
+    _collect_imports(facts)
+    _collect_consts(facts)
+    _collect_exempt_knobs(facts)
+    _collect_env_and_metrics(facts)
+    _collect_functions(facts)
+    return facts
+
+
+def _package_files(root: str) -> List[str]:
+    out = []
+    pkg = os.path.join(root, "bluefog_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return sorted(out)
+
+
+def _extra_env_files(root: str) -> List[str]:
+    """bench.py + scripts/: read-scope for the stale-doc direction (a
+    documented var whose only reader is the bench harness is not stale)."""
+    out = []
+    if os.path.exists(os.path.join(root, "bench.py")):
+        out.append("bench.py")
+    scripts = os.path.join(root, "scripts")
+    for dirpath, _dirs, files in os.walk(scripts):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: env-doc-drift + import-time-env-read
+# ---------------------------------------------------------------------------
+
+def _doc_env_names(root: str) -> Tuple[Set[str], Set[str], Dict[str, int]]:
+    """(exact documented names, documented prefixes, name -> first line)."""
+    path = os.path.join(root, "docs", "env_variable.md")
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    first_line: Dict[str, int] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for tok in _DOC_ENV_TOKEN.findall(line):
+                    first_line.setdefault(tok, lineno)
+                    if tok.endswith("_"):
+                        prefixes.add(tok)
+                    else:
+                        exact.add(tok)
+    except OSError:
+        pass
+    return exact, prefixes, first_line
+
+
+def _rule_env_doc_drift(root, pkg_facts, extra_facts) -> List[Finding]:
+    documented, doc_prefixes, doc_lines = _doc_env_names(root)
+    findings: List[Finding] = []
+    read_names: Set[str] = set()
+    read_prefixes: Set[str] = set()
+    use_names: Set[str] = set()
+    use_prefixes: Set[str] = set()
+    for facts in pkg_facts + extra_facts:
+        use_names |= facts.env_literals
+        use_prefixes |= facts.env_literal_prefixes
+        for name, is_prefix, _ln, _ml in facts.env_reads:
+            if name.startswith("<"):
+                continue
+            (read_prefixes if is_prefix or name.endswith("_")
+             else read_names).add(name)
+    # direction A: every strict read in the package (and bench.py) must
+    # be documented
+    for facts in pkg_facts + [f for f in extra_facts
+                              if f.relpath == "bench.py"]:
+        for name, is_prefix, lineno, _ml in facts.env_reads:
+            if name.startswith("<"):
+                continue
+            if is_prefix or name.endswith("_"):
+                if not any(d.startswith(name) for d in documented):
+                    findings.append(Finding(
+                        "env-doc-drift", "error", facts.relpath, lineno,
+                        f"dynamic env read with prefix {name!r} matches "
+                        f"no documented BLUEFOG_* name in "
+                        f"docs/env_variable.md"))
+            elif name not in documented:
+                findings.append(Finding(
+                    "env-doc-drift", "error", facts.relpath, lineno,
+                    f"env var {name!r} is read here but not documented "
+                    f"in docs/env_variable.md"))
+    # direction B: every documented name must still be used in code
+    for name in sorted(documented):
+        used = (name in use_names or name in read_names
+                or any(name.startswith(p)
+                       for p in read_prefixes | use_prefixes))
+        if not used:
+            findings.append(Finding(
+                "env-doc-drift", "warn", "docs/env_variable.md",
+                doc_lines.get(name, 1),
+                f"documented env var {name!r} is read nowhere in "
+                f"bluefog_tpu/, bench.py, or scripts/ — stale doc row?"))
+    for prefix in sorted(doc_prefixes):
+        covered = (prefix in read_prefixes or prefix in use_prefixes
+                   or any(n.startswith(prefix)
+                          for n in use_names | read_names))
+        if not covered:
+            findings.append(Finding(
+                "env-doc-drift", "warn", "docs/env_variable.md",
+                doc_lines.get(prefix, 1),
+                f"documented env prefix {prefix!r} matches no code read"))
+    return findings
+
+
+def _rule_import_time_env_read(pkg_facts) -> List[Finding]:
+    findings = []
+    for facts in pkg_facts:
+        for name, _is_prefix, lineno, module_level in facts.env_reads:
+            if module_level:
+                shown = name if not name.startswith("<") else "environment"
+                findings.append(Finding(
+                    "import-time-env-read", "error", facts.relpath, lineno,
+                    f"{shown} is read at module import time — this "
+                    f"freezes config before bfrun/bf.init() can set it; "
+                    f"move the read inside a function"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: jsonl-kind-drift
+# ---------------------------------------------------------------------------
+
+def _accepted_kinds(pkg_facts) -> Tuple[Set[str], str, Dict[str, int]]:
+    """Kinds ``validate_jsonl`` accepts, derived from the
+    ``_KIND_REQUIRED`` table in observability/export.py."""
+    accepted: Set[str] = set()
+    src = ""
+    lines: Dict[str, int] = {}
+    for facts in pkg_facts:
+        if not facts.relpath.replace(os.sep, "/").endswith(
+                "observability/export.py"):
+            continue
+        src = facts.relpath
+        for stmt in facts.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_KIND_REQUIRED"
+                    and isinstance(stmt.value, ast.Dict)):
+                for k in stmt.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        accepted.add(k.value)
+                        lines[k.value] = k.lineno
+    return accepted, src, lines
+
+
+def _emitted_kinds(pkg_facts) -> Dict[str, Tuple[str, int]]:
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for facts in pkg_facts:
+        parts = facts.relpath.replace(os.sep, "/").split("/")
+        if len(parts) < 3 or parts[1] not in _JSONL_EXPORTER_DIRS:
+            continue
+        for kind, lineno in facts.kind_emits:
+            emitted.setdefault(kind, (facts.relpath, lineno))
+    return emitted
+
+
+def _rule_jsonl_kind_drift(pkg_facts) -> List[Finding]:
+    accepted, validator_path, accepted_lines = _accepted_kinds(pkg_facts)
+    emitted = _emitted_kinds(pkg_facts)
+    findings = []
+    if not validator_path:
+        return findings
+    for kind, (path, lineno) in sorted(emitted.items()):
+        if kind not in accepted:
+            findings.append(Finding(
+                "jsonl-kind-drift", "error", path, lineno,
+                f"JSONL record kind {kind!r} is written here but "
+                f"validate_jsonl (_KIND_REQUIRED) does not accept it"))
+    for kind in sorted(accepted - set(emitted)):
+        findings.append(Finding(
+            "jsonl-kind-drift", "warn", validator_path,
+            accepted_lines.get(kind, 1),
+            f"validate_jsonl accepts kind {kind!r} but no exporter under "
+            f"{'/'.join(_JSONL_EXPORTER_DIRS)} writes it — stale "
+            f"validator entry?"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: metric-name-drift
+# ---------------------------------------------------------------------------
+
+def _doc_metric_names(root: str) -> Set[str]:
+    names: Set[str] = set()
+    docs = os.path.join(root, "docs")
+    try:
+        entries = sorted(os.listdir(docs))
+    except OSError:
+        return names
+    for fn in entries:
+        if not fn.endswith(".md"):
+            continue
+        try:
+            with open(os.path.join(docs, fn), encoding="utf-8") as f:
+                names.update(_DOC_METRIC_TOKEN.findall(f.read()))
+        except OSError:
+            pass
+    return names
+
+
+def _rule_metric_name_drift(root, pkg_facts) -> List[Finding]:
+    documented = _doc_metric_names(root)
+    findings = []
+    kinds_by_name: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    for facts in pkg_facts:
+        for mkind, name, lineno in facts.metric_calls:
+            kinds_by_name.setdefault(name, {}).setdefault(
+                mkind, (facts.relpath, lineno))
+            if name not in documented:
+                findings.append(Finding(
+                    "metric-name-drift", "error", facts.relpath, lineno,
+                    f"metric {name!r} ({mkind}) is emitted here but its "
+                    f"exact name appears nowhere in docs/ (wildcard "
+                    f"prose like '{name.rsplit('_', 1)[0]}_*' does not "
+                    f"count)"))
+    for name, kinds in sorted(kinds_by_name.items()):
+        if len(kinds) > 1:
+            sites = ", ".join(
+                f"{k} at {p}:{ln}" for k, (p, ln) in sorted(kinds.items()))
+            path, lineno = sorted(kinds.values())[0]
+            findings.append(Finding(
+                "metric-name-drift", "error", path, lineno,
+                f"metric {name!r} is registered with conflicting kinds "
+                f"({sites}) — the registry raises on this at runtime"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: host-time-in-trace
+# ---------------------------------------------------------------------------
+
+def _traced_functions(facts: _ModuleFacts) -> Set[ast.AST]:
+    """Function nodes whose bodies end up inside a traced program."""
+    seeds: Set[ast.AST] = set()
+
+    def name_of(node):
+        d = _dotted(node)
+        return d[-1] if d else None
+
+    for node in ast.walk(facts.tree):
+        if isinstance(node, ast.Call) and name_of(node.func) in \
+                _JIT_ENTRY_NAMES and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.Lambda,)):
+                seeds.add(arg)
+            elif isinstance(arg, ast.Name) and arg.id in facts.functions:
+                seeds.add(facts.functions[arg.id])
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if name_of(target) in _JIT_ENTRY_NAMES:
+                    seeds.add(node)
+                elif (isinstance(dec, ast.Call)
+                        and name_of(dec.func) == "partial"):
+                    for a in dec.args:
+                        if name_of(a) in _JIT_ENTRY_NAMES:
+                            seeds.add(node)
+    # optimizer step builders: the nested functions a top-level `*_step`
+    # builder closes over ARE the traced step cores, even though the
+    # jax.jit call happens a module away (optim/wrappers.py, training.py)
+    for stmt in facts.tree.body:
+        if (isinstance(stmt, ast.FunctionDef)
+                and stmt.name.endswith("_step")):
+            for inner in ast.walk(stmt):
+                if inner is not stmt and isinstance(
+                        inner, (ast.FunctionDef, ast.Lambda)):
+                    seeds.add(inner)
+
+    # transitive closure over same-module calls + nested defs
+    traced: Set[ast.AST] = set()
+    frontier = list(seeds)
+    while frontier:
+        fn = frontier.pop()
+        if fn in traced:
+            continue
+        traced.add(fn)
+        for inner in ast.walk(fn):
+            if inner is not fn and isinstance(
+                    inner, (ast.FunctionDef, ast.Lambda)):
+                if inner not in traced:
+                    frontier.append(inner)
+            if isinstance(inner, ast.Call) and isinstance(
+                    inner.func, ast.Name):
+                callee = facts.functions.get(inner.func.id)
+                if callee is not None and callee not in traced:
+                    frontier.append(callee)
+    return traced
+
+
+def _hazard_call(node: ast.Call, facts: _ModuleFacts) -> Optional[str]:
+    chain = _dotted(node.func)
+    if not chain:
+        return None
+    root_module = facts.import_map.get(chain[0])
+    if root_module is None:
+        return None
+    full = ".".join([root_module] + chain[1:])
+    if root_module == "time" and len(chain) == 2 and \
+            chain[1] in _TIME_FUNCS:
+        return full
+    if root_module in ("time.time", "time.perf_counter", "time.monotonic",
+                       "time.time_ns") and len(chain) == 1:
+        return root_module
+    if full in _DATETIME_HAZARDS or root_module in _DATETIME_HAZARDS:
+        return full
+    if full.startswith("numpy.random.") or root_module == "numpy.random":
+        return full
+    if root_module == "random" and len(chain) >= 2:
+        return full
+    if root_module.startswith("random.") and len(chain) == 1:
+        return root_module
+    return None
+
+
+def _rule_host_time_in_trace(pkg_facts) -> List[Finding]:
+    findings = []
+    for facts in pkg_facts:
+        traced = _traced_functions(facts)
+        if not traced:
+            continue
+        seen_lines: Set[int] = set()
+        for fn in traced:
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    # nested defs are traversed via their own traced entry
+                    if isinstance(node, ast.Call):
+                        hazard = _hazard_call(node, facts)
+                        if hazard and node.lineno not in seen_lines:
+                            seen_lines.add(node.lineno)
+                            findings.append(Finding(
+                                "host-time-in-trace", "error",
+                                facts.relpath, node.lineno,
+                                f"{hazard}() is reachable inside a traced "
+                                f"function — the first call's host value "
+                                f"freezes into the compiled program "
+                                f"(recompile/replay hazard); hoist it to "
+                                f"the host loop or use jax.random"))
+        _ = traced
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: knob-outside-cache-key
+# ---------------------------------------------------------------------------
+
+def _cache_key_params(pkg_facts) -> Set[str]:
+    for facts in pkg_facts:
+        if not facts.relpath.replace(os.sep, "/").endswith(
+                "optim/_plumbing.py"):
+            continue
+        fn = facts.functions.get("step_cache_key")
+        if fn is None:
+            continue
+        names = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        names.discard("cx")
+        names.discard("params")
+        return names
+    return set()
+
+
+def _rule_knob_outside_cache_key(pkg_facts) -> List[Finding]:
+    key_params = _cache_key_params(pkg_facts)
+    if not key_params:
+        return []
+    findings = []
+    for facts in pkg_facts:
+        used_exemptions: Set[str] = set()
+        for node in ast.walk(facts.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not _FACTORY_NAME.search(node.name):
+                continue
+            all_params = [a.arg for a in node.args.args
+                          + node.args.kwonlyargs]
+            if len(set(all_params) & _KNOB_MARKERS) < 2:
+                continue
+            # params with defaults = the keyword knobs
+            pos = node.args.args
+            defaulted = [a.arg for a in
+                         pos[len(pos) - len(node.args.defaults):]]
+            defaulted += [a.arg for a, d in
+                          zip(node.args.kwonlyargs, node.args.kw_defaults)
+                          if d is not None]
+            for knob in defaulted:
+                if knob in ("self", "cls"):
+                    continue
+                normalized = _KNOB_ALIASES.get(knob, knob)
+                if normalized in key_params or knob in key_params:
+                    continue
+                if knob in facts.exempt_knobs:
+                    used_exemptions.add(knob)
+                    continue
+                findings.append(Finding(
+                    "knob-outside-cache-key", "error", facts.relpath,
+                    node.lineno,
+                    f"factory {node.name}() keyword knob {knob!r} is "
+                    f"neither a step_cache_key parameter nor listed in "
+                    f"this module's _STEP_KEY_EXEMPT_KNOBS — a knob that "
+                    f"shapes the compiled step but joins neither would "
+                    f"silently serve stale programs"))
+        # stale exemptions get the baseline treatment: a name that no
+        # longer matches any factory knob silently pre-exempts whatever
+        # future knob reuses it — the exact hazard the rule exists for
+        for dead in sorted(facts.exempt_knobs - used_exemptions):
+            findings.append(Finding(
+                "knob-outside-cache-key", "warn", facts.relpath, 1,
+                f"_STEP_KEY_EXEMPT_KNOBS entry {dead!r} matches no "
+                f"keyword knob on any factory in this module — delete "
+                f"the dead exemption"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def _load_facts(root: str) -> Tuple[List[_ModuleFacts], List[_ModuleFacts]]:
+    pkg = [f for f in (_parse_file(root, p) for p in _package_files(root))
+           if f is not None]
+    extra = [f for f in (_parse_file(root, p)
+                         for p in _extra_env_files(root)) if f is not None]
+    return pkg, extra
+
+
+def run_ast_rules(repo_root: Optional[str] = None,
+                  rules: Optional[List[str]] = None
+                  ) -> Tuple[List[Finding], int]:
+    """Run the selected (default: all) AST rules over ``repo_root``.
+    Returns ``(findings, files_scanned)`` with findings sorted by
+    location for stable output."""
+    root = repo_root or default_repo_root()
+    selected = set(rules or ALL_RULES)
+    unknown = selected - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)} "
+                         f"(known: {list(ALL_RULES)})")
+    pkg_facts, extra_facts = _load_facts(root)
+    findings: List[Finding] = []
+    if "env-doc-drift" in selected:
+        findings += _rule_env_doc_drift(root, pkg_facts, extra_facts)
+    if "import-time-env-read" in selected:
+        findings += _rule_import_time_env_read(pkg_facts)
+    if "jsonl-kind-drift" in selected:
+        findings += _rule_jsonl_kind_drift(pkg_facts)
+    if "metric-name-drift" in selected:
+        findings += _rule_metric_name_drift(root, pkg_facts)
+    if "host-time-in-trace" in selected:
+        findings += _rule_host_time_in_trace(pkg_facts)
+    if "knob-outside-cache-key" in selected:
+        findings += _rule_knob_outside_cache_key(pkg_facts)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, len(pkg_facts) + len(extra_facts)
+
+
+def jsonl_kind_sets(repo_root: Optional[str] = None
+                    ) -> Tuple[Set[str], Set[str]]:
+    """``(emitted, accepted)`` record-kind sets, both analyzer-derived —
+    the cross-check test asserts equality so neither can drift."""
+    pkg_facts, _ = _load_facts(repo_root or default_repo_root())
+    accepted, _path, _lines = _accepted_kinds(pkg_facts)
+    return set(_emitted_kinds(pkg_facts)), accepted
+
+
+def emitted_metric_names(repo_root: Optional[str] = None
+                         ) -> Dict[str, Set[str]]:
+    """metric name -> set of kinds it is registered with."""
+    pkg_facts, _ = _load_facts(repo_root or default_repo_root())
+    out: Dict[str, Set[str]] = {}
+    for facts in pkg_facts:
+        for mkind, name, _ln in facts.metric_calls:
+            out.setdefault(name, set()).add(mkind)
+    return out
+
+
+def documented_metric_names(repo_root: Optional[str] = None) -> Set[str]:
+    return _doc_metric_names(repo_root or default_repo_root())

@@ -1,0 +1,146 @@
+"""Baseline suppression file for ``bflint`` findings.
+
+``analysis/baseline.toml`` is the checked-in list of findings the project
+has explicitly decided to carry (it ships EMPTY: real findings get fixed,
+not suppressed — a suppression is a documented debt, not a convenience).
+Python 3.10 has no ``tomllib``, and the hard no-new-deps constraint rules
+out a TOML package, so this module parses the small TOML subset the
+baseline format needs:
+
+.. code-block:: toml
+
+    # why this entry exists (reviewed like code)
+    [[suppress]]
+    rule = "host-time-in-trace"        # required: rule id, or "*"
+    path = "bluefog_tpu/foo/bar.py"    # required: repo-relative fnmatch glob
+    line = 120                          # optional: pin to a line
+    message = "time.time"              # optional: substring of the message
+    reason = "host callback, reviewed 2026-08-04"  # required: the why
+
+Matching: a finding is suppressed by the FIRST entry whose rule, path
+glob, optional line, and optional message substring all match.  Entries
+that never matched anything are themselves reported (a stale suppression
+hides nothing and should be deleted) — returned by :func:`apply` so the
+CLI can surface them.
+"""
+
+import fnmatch
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+__all__ = ["BaselineError", "load_baseline", "apply", "DEFAULT_PATH"]
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.toml")
+
+_KV = re.compile(r"^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(.+?)\s*$")
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — always fatal: a suppression that fails
+    to parse must not silently suppress nothing (or everything)."""
+
+
+def _parse_value(raw: str, path: str, lineno: int):
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        body = raw[1:-1]
+        # the only escapes the format needs; anything fancier is a smell
+        return body.replace('\\"', '"').replace("\\\\", "\\")
+    if raw in ("true", "false"):
+        return raw == "true"
+    if re.fullmatch(r"-?[0-9]+", raw):
+        return int(raw)
+    raise BaselineError(
+        f"{path}:{lineno}: unsupported TOML value {raw!r} (the baseline "
+        f"subset takes quoted strings, integers, and booleans)")
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment, respecting double-quoted strings."""
+    out, in_str = [], False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        if c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out).strip()
+
+
+def load_baseline(path: str = DEFAULT_PATH) -> List[Dict]:
+    """Parse the baseline file into a list of suppression dicts.
+
+    A missing file reads as empty (the seeded state); a present but
+    malformed file raises :class:`BaselineError`."""
+    if not os.path.exists(path):
+        return []
+    entries: List[Dict] = []
+    current = None
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = _strip_comment(raw)
+            if not line:
+                continue
+            if line == "[[suppress]]":
+                current = {"_line": lineno}
+                entries.append(current)
+                continue
+            if line.startswith("["):
+                raise BaselineError(
+                    f"{path}:{lineno}: unknown table {line!r} (only "
+                    f"[[suppress]] entries are understood)")
+            m = _KV.match(line)
+            if not m:
+                raise BaselineError(
+                    f"{path}:{lineno}: unparseable line {line!r}")
+            if current is None:
+                raise BaselineError(
+                    f"{path}:{lineno}: key outside a [[suppress]] table")
+            current[m.group(1)] = _parse_value(m.group(2), path, lineno)
+    for e in entries:
+        for req in ("rule", "path", "reason"):
+            if req not in e:
+                raise BaselineError(
+                    f"{path}:{e['_line']}: [[suppress]] entry missing "
+                    f"required key {req!r}")
+    return entries
+
+
+def _matches(entry: Dict, finding: Finding) -> bool:
+    if entry["rule"] not in ("*", finding.rule):
+        return False
+    if not fnmatch.fnmatch(finding.path, entry["path"]):
+        return False
+    if "line" in entry and entry["line"] != finding.line:
+        return False
+    if "message" in entry and entry["message"] not in finding.message:
+        return False
+    return True
+
+
+def apply(findings: List[Finding], entries: List[Dict]
+          ) -> Tuple[List[Finding], int, List[Dict]]:
+    """``(kept, suppressed_count, stale_entries)``: filter findings
+    through the baseline; entries that matched nothing come back as
+    stale (the CLI reports them so dead suppressions get deleted)."""
+    kept: List[Finding] = []
+    used = [False] * len(entries)
+    suppressed = 0
+    for f in findings:
+        hit = False
+        for i, e in enumerate(entries):
+            if _matches(e, f):
+                used[i] = True
+                hit = True
+                break
+        if hit:
+            suppressed += 1
+        else:
+            kept.append(f)
+    stale = [e for i, e in enumerate(entries) if not used[i]]
+    return kept, suppressed, stale

@@ -1,0 +1,119 @@
+"""``bflint`` — the project-invariant linter (docs/static_analysis.md).
+
+Modes::
+
+    bflint                  # AST contract rules over the checkout
+    bflint --trace          # + StableHLO trace-hazard pass (canonical
+                            #   bench-trace configs on the virtual mesh)
+    bflint --json           # machine output (one JSON object)
+    bflint --rules a,b      # run a rule subset
+    bflint --baseline PATH  # non-default suppression file
+
+Exit status: 0 iff zero unsuppressed findings AND zero stale baseline
+entries — the ``make lint`` pre-PR gate.  Human output is one line per
+finding plus a bfmonitor-style summary; ``--json`` carries the same
+fields (rule, severity, file, line, message) so CI logs and humans read
+the same report.
+"""
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import astrules, baseline as baseline_mod
+from .findings import Finding, format_json, format_text, summary_line
+
+__all__ = ["main"]
+
+
+def _force_virtual_mesh() -> None:
+    """The trace pass lowers the canonical train steps, which needs a
+    multi-device mesh; mirror ``bench.py --trace-only``'s CPU forcing —
+    this must happen before the first backend use."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bflint",
+        description="project-invariant static analysis: AST contract "
+                    "rules + StableHLO trace-hazard pass "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also run the trace-hazard pass over the "
+                         "canonical bench-trace step configs")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine output: one JSON object with findings "
+                         "(rule, severity, file, line, message)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated AST rule subset "
+                         f"(known: {', '.join(astrules.ALL_RULES)})")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_PATH,
+                    help="suppression file (default: the checked-in "
+                         "analysis/baseline.toml)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    args = ap.parse_args(argv)
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    trace_report = None
+    try:
+        findings, n_files = astrules.run_ast_rules(args.root, rules)
+    except ValueError as e:
+        print(f"bflint: {e}", file=sys.stderr)
+        return 2
+    rules_run = list(rules or astrules.ALL_RULES)
+    if args.trace:
+        _force_virtual_mesh()
+        from . import tracehazards
+        trace_findings, trace_report = \
+            tracehazards.run_canonical_trace_checks()
+        if "skipped" in trace_report:
+            # a gate that silently skips its trace half still exits 0 —
+            # the exact silence this tool exists to break; fail loudly
+            trace_findings = list(trace_findings) + [Finding(
+                "trace-pass-skipped", "error", "<trace>", 0,
+                f"trace-hazard pass did not run: "
+                f"{trace_report['skipped']} — check XLA_FLAGS "
+                f"--xla_force_host_platform_device_count (an existing "
+                f"=1 flag wins over bflint's default of 8)")]
+        findings = findings + trace_findings
+        rules_run += list(tracehazards.TRACE_RULES)
+
+    try:
+        entries = baseline_mod.load_baseline(args.baseline)
+    except baseline_mod.BaselineError as e:
+        print(f"bflint: {e}", file=sys.stderr)
+        return 2
+    kept, suppressed, stale = baseline_mod.apply(findings, entries)
+    for e in stale:
+        kept.append(Finding(
+            "stale-suppression", "warn", os.path.relpath(args.baseline),
+            e["_line"],
+            f"baseline entry (rule={e['rule']!r}, path={e['path']!r}) "
+            f"matched no finding — delete the dead suppression"))
+
+    if args.as_json:
+        import json
+        payload = json.loads(format_json(kept, suppressed, rules_run))
+        if trace_report is not None:
+            payload["trace"] = trace_report
+        payload["files"] = n_files
+        print(json.dumps(payload))
+    else:
+        if kept:
+            print(format_text(kept))
+        print(summary_line(kept, n_files, len(rules_run), suppressed))
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Finding model shared by the AST contract rules and the trace-hazard
+pass: one structured record per violation, with a stable rule id the
+baseline file and the ``--json`` output key off.
+
+Severities: ``error`` findings gate ``make lint`` / CI; ``warn`` findings
+gate too (the pre-PR bar is zero findings of any severity on a clean
+tree) but signal doc-side staleness rather than a live code hazard.
+"""
+
+import json
+from typing import Iterable, List, NamedTuple, Optional
+
+__all__ = ["Finding", "format_text", "format_json", "summary_line",
+           "SEVERITIES"]
+
+SEVERITIES = ("error", "warn")
+
+
+class Finding(NamedTuple):
+    """One rule violation.
+
+    ``path`` is repo-relative for file findings; trace-hazard findings
+    use a ``<trace:config>`` pseudo-path (there is no source line for a
+    property of a lowered program) with ``line`` 0.
+    """
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+
+    def asdict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "file": self.path, "line": self.line,
+                "message": self.message}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.severity}] "
+                f"{self.rule}: {self.message}")
+
+
+def format_text(findings: Iterable[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def format_json(findings: Iterable[Finding], suppressed: int = 0,
+                rules_run: Optional[List[str]] = None) -> str:
+    """Machine output (one JSON object): the same fields a human reads,
+    so CI logs and the terminal report never drift apart."""
+    fl = [f.asdict() for f in findings]
+    return json.dumps({
+        "findings": fl,
+        "counts": {sev: sum(1 for f in fl if f["severity"] == sev)
+                   for sev in SEVERITIES},
+        "suppressed": suppressed,
+        "rules": rules_run or [],
+        "ok": not fl,
+    })
+
+
+def summary_line(findings: List[Finding], files: int, rules: int,
+                 suppressed: int = 0) -> str:
+    """bfmonitor-style one-liner: the human-scan summary CI logs end on."""
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    verdict = "clean" if not findings else (
+        f"{n_err} error(s), {n_warn} warn(s)")
+    return (f"bflint: {rules} rule(s) over {files} file(s): {verdict}"
+            f" ({suppressed} baseline-suppressed)")

@@ -1,0 +1,234 @@
+"""StableHLO trace-hazard pass: program-level invariants the AST cannot
+see, checked on the LOWERED step (``bflint --trace``).
+
+The AST rules catch drift in source conventions; three hazard classes
+only exist in the lowered program:
+
+``trace-donation-dropped``
+    A step built with ``donate=True`` whose inputs lost their
+    input→output aliasing (``tf.aliasing_output`` arg attributes in the
+    StableHLO signature).  XLA then keeps both the argument and the
+    result buffers live — a silent 2× HBM cost on the largest arrays in
+    the job.  jax only warns on stderr, once, where nobody looks.
+``trace-wire-upcast``
+    A ``collective_permute`` whose operand is produced by a WIDENING
+    ``stablehlo.convert`` (e.g. i8 → f32 dequantize *before* the send):
+    the wire then moves the wide dtype and the compression win silently
+    evaporates.  The legal shape is send-then-dequantize — the convert
+    consumes the permute's result, never feeds it.
+``trace-collective-budget``
+    The step's ``collective_permute`` count must equal the fusion plan's
+    budget (``buckets × offsets × wire arrays per bucket``) — an extra
+    permute means a leaf escaped the flat-buffer path (per-leaf traffic
+    snuck back in); a missing one means an exchange silently dropped.
+
+All three run over the text :func:`~..utils.trace_metrics.lower_text`
+produces, so the pass is CPU-only and backend-free like the rest of the
+trace-metrics evidence.  :func:`run_canonical_trace_checks` applies them
+to the canonical ``bench.py --trace-only`` configs (the fused f32 and
+fused+int8 train steps, built ``donate=True``), which is what
+``make lint`` and ``tests/test_lint_clean.py`` gate on.
+"""
+
+import re
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+__all__ = ["TRACE_RULES", "check_donation", "find_wire_upcasts",
+           "check_collective_budget", "analyze_trace",
+           "run_canonical_trace_checks"]
+
+TRACE_RULES = ("trace-donation-dropped", "trace-wire-upcast",
+               "trace-collective-budget")
+
+# donation has three dialect spellings: `tf.aliasing_output` when jax
+# resolves the alias at trace time (unsharded args), `jax.buffer_donor`
+# when the decision defers to compile (sharded/global-view args — the
+# canonical train steps), and the compiled HLO's `input_output_alias`
+# entries.  A DROPPED donation erases the attribute entirely (jax only
+# warns on stderr), which is what the counter-vs-expected check catches.
+_ALIASED = re.compile(r"tf\.aliasing_output")
+_DONOR = re.compile(r"jax\.buffer_donor")
+_HLO_ALIAS = re.compile(r"\b(?:may|must)-alias\b")
+# `%0 = stablehlo.convert %arg1 : (tensor<1x16xi8>) -> tensor<1x16xf32>`
+_CONVERT = re.compile(
+    r"%([A-Za-z0-9_.#]+)\s*=\s*stablehlo\.convert\s+%[A-Za-z0-9_.#]+\s*:"
+    r"\s*\(tensor<([^>]*)>\)\s*->\s*tensor<([^>]*)>")
+# `%1 = "stablehlo.collective_permute"(%0) <{...}>` (generic form) or
+# `stablehlo.collective_permute %0, ...` (pretty form)
+_PERMUTE_OPERAND = re.compile(
+    r"\"?stablehlo\.collective_permute\"?[ (]+%([A-Za-z0-9_.#]+)")
+
+
+def _tensor_dtype_bytes(spec: str) -> int:
+    """Per-element width of a ``AxBxDT`` tensor spec (0 when unknown)."""
+    from ..utils.trace_metrics import _dtype_nbytes
+    return _dtype_nbytes(spec.strip().split("x")[-1].strip()) or 0
+
+
+def donation_marks(text: str) -> int:
+    """Count of donation/alias marks in a lowered (StableHLO) or
+    compiled (HLO) program text, whichever dialect ``text`` is in."""
+    stablehlo = len(_ALIASED.findall(text)) + len(_DONOR.findall(text))
+    hlo = len(_HLO_ALIAS.findall(text))
+    return max(stablehlo, hlo)
+
+
+def check_donation(text: str, label: str,
+                   expected_aliased: int) -> List[Finding]:
+    """``expected_aliased``: the donated input leaves the builder knows
+    it passed (the text alone cannot show a donation XLA dropped — the
+    attribute is simply absent, which is exactly the silence this rule
+    exists to break)."""
+    aliased = donation_marks(text)
+    if aliased >= expected_aliased:
+        return []
+    return [Finding(
+        "trace-donation-dropped", "error", f"<trace:{label}>", 0,
+        f"step was built donate=True over {expected_aliased} input "
+        f"leaves but only {aliased} carry a donation/alias mark "
+        f"(tf.aliasing_output / jax.buffer_donor / input_output_alias) "
+        f"in the lowered program — XLA keeps both buffers live for "
+        f"every dropped donation (silent 2x HBM on the biggest arrays)")]
+
+
+def find_wire_upcasts(text: str, label: str) -> List[Finding]:
+    findings: List[Finding] = []
+    widening: Dict[str, Tuple[str, str]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if "func.func" in line:
+            # SSA names are function-scoped; never match a convert from
+            # another function's region
+            widening.clear()
+            continue
+        m = _CONVERT.search(line)
+        if m:
+            name, src_spec, dst_spec = m.groups()
+            if (_tensor_dtype_bytes(dst_spec)
+                    > _tensor_dtype_bytes(src_spec) > 0):
+                widening[name] = (src_spec.split("x")[-1],
+                                  dst_spec.split("x")[-1])
+            continue
+        if "collective_permute" in line:
+            p = _PERMUTE_OPERAND.search(line)
+            if p and p.group(1) in widening:
+                src_dt, dst_dt = widening[p.group(1)]
+                findings.append(Finding(
+                    "trace-wire-upcast", "error", f"<trace:{label}>",
+                    lineno,
+                    f"collective_permute operand %{p.group(1)} is "
+                    f"produced by a widening convert {src_dt} -> "
+                    f"{dst_dt}: the wire moves the wide dtype "
+                    f"(dequantize-before-send) — move the convert to "
+                    f"the receive side"))
+    return findings
+
+
+def check_collective_budget(text: str, label: str,
+                            expected: int) -> List[Finding]:
+    from ..utils.trace_metrics import count_collectives_in_text
+    got = count_collectives_in_text(text)["ppermute"]
+    if got == expected:
+        return []
+    direction = ("a pytree leaf escaped the fusion plan (per-leaf "
+                 "traffic is back)" if got > expected
+                 else "an exchange silently dropped out of the step")
+    return [Finding(
+        "trace-collective-budget", "error", f"<trace:{label}>", 0,
+        f"lowered step has {got} collective_permute(s), fusion plan "
+        f"budgets {expected} (buckets x offsets x wire arrays) — "
+        f"{direction}")]
+
+
+def analyze_trace(text: str, label: str, *, expected_aliased: int = 0,
+                  expected_ppermutes: int = None) -> List[Finding]:
+    """All three checks over one lowered program (test entry point for
+    constructed violation programs)."""
+    findings = []
+    if expected_aliased:
+        findings += check_donation(text, label, expected_aliased)
+    findings += find_wire_upcasts(text, label)
+    if expected_ppermutes is not None:
+        findings += check_collective_budget(text, label,
+                                            expected_ppermutes)
+    return findings
+
+
+# wire arrays each codec moves per fusion bucket per offset: the payload
+# alone uncompressed; payload + per-bucket scales under int8 (the
+# canonical compressed config — matches bench.py --trace-only)
+_CANONICAL_CONFIGS = (
+    ("fused", None, 1),
+    ("fused_int8", "int8", 2),
+)
+
+
+def run_canonical_trace_checks(depth: int = 8
+                               ) -> Tuple[List[Finding], Dict]:
+    """Lower the canonical bench-trace train steps (fused f32, fused
+    int8 — both ``donate=True``) and run every trace check.  Returns
+    ``(findings, report)``; report carries the measured counts for
+    ``--json`` output.  Needs an initialized context (or initializes the
+    default one) on a mesh of >= 2 devices."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    from .. import context as _ctx
+    from .. import training as T
+    from ..models.mlp import MLP
+    from ..ops import fusion as fusion_mod
+    from ..utils import trace_metrics as TM
+
+    if _ctx.is_initialized():
+        cx = _ctx.ctx()
+    elif len(jax.devices()) < 2:
+        # guard BEFORE bf.init(): a 1-device backend cannot host the
+        # exchange topology at all — report the skip instead of crashing
+        return [], {"mesh": len(jax.devices()),
+                    "skipped": "backend has a single device — no "
+                               "exchange to lower"}
+    else:
+        cx = bf.init()
+    n = cx.size
+    report: Dict[str, Dict] = {"mesh": n}
+    if n < 2:
+        report["skipped"] = "mesh has a single device — no exchange"
+        return [], report
+    model = MLP(features=(32,) * depth, num_outputs=10)
+    base = optax.sgd(0.01, momentum=0.9)
+    offsets = len(cx.compiled_topology.offsets)
+    x = jnp.zeros((n, 4, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 4), jnp.int32)
+    findings: List[Finding] = []
+    for label, spec, arrays in _CANONICAL_CONFIGS:
+        variables, opt_state = T.create_train_state(
+            model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+            fuse=True, overlap=False, compression=spec)
+        step = T.make_train_step(
+            model, base, communication="neighbor_allreduce", fuse=True,
+            overlap=False, telemetry=False, compression=spec,
+            donate=True)
+        text, trace_s = TM.lower_text(
+            step, variables, opt_state, (x, y), jnp.int32(0))
+        per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+        plan = fusion_mod.plan_for(per_rank)
+        expected_pp = plan.n_buckets * offsets * arrays
+        donated = (len(jax.tree.leaves(variables))
+                   + len(jax.tree.leaves(opt_state)))
+        fs = analyze_trace(text, label, expected_aliased=donated,
+                           expected_ppermutes=expected_pp)
+        findings += fs
+        report[label] = {
+            "ppermute": TM.count_collectives_in_text(text)["ppermute"],
+            "expected_ppermute": expected_pp,
+            "donated_leaves": donated,
+            "aliased_outputs": donation_marks(text),
+            "buckets": plan.n_buckets,
+            "offsets": offsets,
+            "trace_s": round(trace_s, 3),
+            "findings": len(fs),
+        }
+    return findings, report

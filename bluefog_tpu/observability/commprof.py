@@ -545,8 +545,8 @@ def measure_overlap(full_fn, pruned_fn, comm_fn, args,
     same arguments).  Returns None when the exchange is too small to
     price (< 20 µs — nothing to hide).  ``stage=True`` stages the
     ``overlap_efficiency`` field for the next ``export.log_step`` record,
-    mirrors ``bf_overlap_*`` gauges, and emits ``overlap/*`` timeline
-    counter lanes."""
+    mirrors the ``bf_overlap{field=efficiency|hidden_s|exposed_s}``
+    gauge, and emits ``overlap/*`` timeline counter lanes."""
     if comm_args is None:
         comm_args = args
     t_comm, t_full, t_pruned = _time_interleaved(

@@ -365,6 +365,12 @@ _KIND_REQUIRED = {
     "serve_failover": ("step", "t_us", "replica_from", "replica_to",
                        "reason"),
     "serve_config": ("t_us",),
+    # health verdict trail (observability/health.py write_verdicts): one
+    # "report" summary line per evaluation window, then one "verdict"
+    # line per finding.  The trail shares this module's rotation policy
+    # and must validate with the same tool (bflint: jsonl-kind-drift).
+    "report": ("t_us", "step_lo", "step_hi", "ok"),
+    "verdict": ("t_us", "rule", "severity", "message"),
 }
 
 _DECISION_STR_KEYS = ("knob", "action", "mode")
@@ -493,9 +499,12 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     and the documented structured fields (``phases``, ``step_wall_us``,
     ``edges``, ``overlap_efficiency``, ``serve_staleness``) well-shaped.
     Controller-trail lines (``kind: decision`` / ``control_config``,
-    control/policy.py) and serving-trail lines (``kind: serve`` /
-    ``serve_failover`` / ``serve_config``, serving/router.py) validate
-    against their own required keys and shape instead.  Fields
+    control/policy.py), serving-trail lines (``kind: serve`` /
+    ``serve_failover`` / ``serve_config``, serving/router.py), and
+    health-verdict-trail lines (``kind: report`` / ``verdict``,
+    health.py) validate against their own required keys and shape
+    instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
+    keeps ``_KIND_REQUIRED`` in lockstep with every exporter.  Fields
     the schema does not know are tolerated (forward compatibility is
     part of the contract and regression-tested).  Returns the records;
     raises ValueError on violations (the ``make metrics-smoke`` /

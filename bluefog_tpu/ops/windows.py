@@ -43,6 +43,12 @@ from . import api as _api
 from . import fusion as _fusion
 from .api import _register_handle, synchronize
 
+# bflint knob-outside-cache-key: ``double_buffer`` resolves once at
+# window creation and lives on the window object, which owns its compiled
+# fold programs — window identity keys them, there is no shared step
+# cache to serve a stale program from.
+_STEP_KEY_EXEMPT_KNOBS = frozenset({"double_buffer"})
+
 __all__ = [
     "win_create", "win_free", "win_update", "win_update_then_collect",
     "win_put", "win_put_nonblocking", "win_get", "win_get_nonblocking",

@@ -39,6 +39,20 @@ from ..ops import collectives as C
 from ..ops import fusion as F
 from ..parallel.schedule import CompiledTopology, DynamicSchedule
 
+# bflint knob-outside-cache-key: builder knobs the cache key covers
+# through other identities, or that pin the returned closure's recurrence
+# at build time.  topo/machine_topo/machine_axes are keyed as
+# ``id(cx._compiled)`` / ``id(cx._compiled_machine)`` / mesh identity in
+# step_cache_key; ``sched`` is traced data (the step index selects the
+# edge set); accumulate_steps/exact_diffusion/degraded shape the
+# recurrence of the closure a builder call RETURNS — the wrapper that
+# jits it keys the owning instance, and a new builder call is a new
+# closure.
+_STEP_KEY_EXEMPT_KNOBS = frozenset({
+    "topo", "machine_topo", "machine_axes", "sched",
+    "accumulate_steps", "exact_diffusion", "degraded",
+})
+
 
 class CommunicationType(Enum):
     """Reference parity: optimizers.py CommunicationType."""

@@ -61,6 +61,17 @@ __all__ = [
 
 CommunicationType = S.CommunicationType
 
+# bflint knob-outside-cache-key: per-INSTANCE constants.  The step cache
+# lives on the optimizer instance (``self._step_cache``), so a knob fixed
+# in ``__init__`` for the instance's lifetime is keyed by instance
+# identity and must not churn the tuple; ``sched`` is traced data (the
+# step index selects the edge set), ``window_prefix`` names the window
+# (identity, not program shape).
+_STEP_KEY_EXEMPT_KNOBS = frozenset({
+    "atc", "gradient_allreduce", "exact_diffusion",
+    "num_steps_per_communication", "sched", "window_prefix",
+})
+
 
 class _JittedStrategyOptimizer:
     """Shared machinery: vmapped base state over ranks, one jitted SPMD step."""

@@ -38,6 +38,12 @@ __all__ = ["transformer_tp_rules", "shard_params", "make_tp_lm_train_step",
            "sharded_neighbor_mix", "sharded_delayed_mix",
            "hybrid_inflight_state"]
 
+# bflint knob-outside-cache-key: these builders return a fresh jitted
+# step per call (no shared step cache); ``topo`` is keyed by context
+# identity where a cache exists, ``sched`` is traced data, ``donate`` is
+# build-structural.
+_STEP_KEY_EXEMPT_KNOBS = frozenset({"topo", "sched", "donate"})
+
 # (path regex, PartitionSpec factory given tp axis name); first match wins
 _TP_RULES = [
     (r"qkv/kernel$",      lambda tp: P(None, None, tp, None)),  # [D,3,H,hd]

@@ -50,6 +50,13 @@ from . import membership as _mem
 
 __all__ = ["ChaosHarness", "ChaosReport"]
 
+# bflint knob-outside-cache-key: ChaosHarness pins its episode
+# configuration (fault plan, base optimizer, liveness config, loss,
+# topology) at construction and builds its programs once per instance —
+# instance identity keys them; fault flips themselves are traced data
+# (the whole point of the seeded fault tables).
+_STEP_KEY_EXEMPT_KNOBS = frozenset({"base_opt", "cfg", "loss_fn", "topo"})
+
 
 @dataclass
 class ChaosReport:

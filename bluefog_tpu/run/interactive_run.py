@@ -33,7 +33,11 @@ import sys
 import traceback
 from typing import List, Optional
 
-_PIDFILE = os.environ.get("BLUEFOG_IBFRUN_PIDFILE",
+def _pidfile() -> str:
+    """Resolved per call, not at import: an import-time read would freeze
+    the path before a launcher could set ``BLUEFOG_IBFRUN_PIDFILE``
+    (bflint: import-time-env-read)."""
+    return os.environ.get("BLUEFOG_IBFRUN_PIDFILE",
                           "/tmp/bluefog_ibfrun.pids")
 
 
@@ -270,7 +274,7 @@ def driver_main(args, hosts) -> int:
         control_addr = f"127.0.0.1:{port_str}"
 
     procs = _launch_engines(args, hosts, control_addr)
-    with open(_PIDFILE, "w") as f:
+    with open(_pidfile(), "w") as f:
         # "host pid ssh_port pattern" per line: ibfrun stop must reach
         # remote engines over ssh (the local pid is only the ssh client)
         for p, host, local in procs:
@@ -345,8 +349,8 @@ def driver_main(args, hosts) -> int:
             except subprocess.TimeoutExpired:
                 p.terminate()
         server.close()
-        if os.path.exists(_PIDFILE):
-            os.unlink(_PIDFILE)
+        if os.path.exists(_pidfile()):
+            os.unlink(_pidfile())
     return 0
 
 
@@ -401,12 +405,12 @@ def _drain(pending, interrupter=None) -> None:
 
 
 def stop_main() -> int:
-    if not os.path.exists(_PIDFILE):
+    if not os.path.exists(_pidfile()):
         print("ibfrun: no running cluster (no pidfile)")
         return 0
     from . import network_util
     n = 0
-    with open(_PIDFILE) as f:
+    with open(_pidfile()) as f:
         for line in f:
             if not line.strip():
                 continue
@@ -425,7 +429,7 @@ def stop_main() -> int:
             else:
                 _remote_signal(host, pattern.strip(), "TERM",
                                None if ssh_port == "-" else int(ssh_port))
-    os.unlink(_PIDFILE)
+    os.unlink(_pidfile())
     print(f"ibfrun: stopped {n} engine(s)")
     return 0
 

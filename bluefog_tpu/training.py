@@ -37,6 +37,19 @@ from .parallel.schedule import DynamicSchedule
 __all__ = ["create_train_state", "make_train_step", "cross_entropy_loss",
            "replicate_to_ranks", "make_lm_train_step", "run_steps"]
 
+# bflint knob-outside-cache-key: factory knobs that deliberately do NOT
+# join _plumbing.step_cache_key.  make_train_step/create_train_state
+# return a FRESH jitted callable / state layout per call — there is no
+# shared step cache a stale program could be served from — so build-
+# structural arguments (communication mode, loss, donation, vma check,
+# local-step count, train flag, attention flavor) pin at construction;
+# `sched` stays traced data (the step index selects the edge set inside
+# one compiled program, docs/topology.md "Dynamic schedules").
+_STEP_KEY_EXEMPT_KNOBS = frozenset({
+    "loss_fn", "communication", "atc", "sched",
+    "num_steps_per_communication", "donate", "check_vma", "train",
+})
+
 
 def cross_entropy_loss(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

@@ -1,0 +1,551 @@
+"""Analyzer unit tests (``bluefog_tpu/analysis/``, docs/static_analysis.md).
+
+Every AST rule gets a POSITIVE fixture (a synthetic offending snippet in
+a throwaway mini-repo must be caught) and a NEGATIVE fixture (the
+idiomatic existing pattern must pass) — the rules run hermetically over
+any repo root, so these tests cannot be broken by unrelated tree
+changes.  The trace-hazard checks get constructed violating programs
+(dropped donation, dequantize-before-send, budget overrun) plus their
+clean twins.  Baseline suppression round-trips, including the
+stale-entry report.  The "whole tree is clean" gate lives in
+tests/test_lint_clean.py.
+"""
+
+import textwrap
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu.analysis import astrules, baseline as baseline_mod
+from bluefog_tpu.analysis import tracehazards as TH
+from bluefog_tpu.analysis.findings import Finding, format_json, summary_line
+
+
+# ---------------------------------------------------------------------------
+# mini-repo scaffolding
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, files, env_doc="", docs=None):
+    """Lay out a throwaway repo: ``files`` maps repo-relative paths to
+    source (dedented); docs/env_variable.md gets ``env_doc``."""
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "env_variable.md").write_text(env_doc)
+    for name, content in (docs or {}).items():
+        (tmp_path / "docs" / name).write_text(content)
+    return str(tmp_path)
+
+
+def _run(root, rule):
+    findings, _n = astrules.run_ast_rules(root, [rule])
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# env-doc-drift
+# ---------------------------------------------------------------------------
+
+def test_env_doc_drift_catches_undocumented_read(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            def knob():
+                return os.environ.get("BLUEFOG_SECRET_KNOB")
+        """}, env_doc="| `BLUEFOG_METRICS` | unset | sink |\n")
+    findings = _run(root, "env-doc-drift")
+    assert any(f.rule == "env-doc-drift" and f.severity == "error"
+               and "BLUEFOG_SECRET_KNOB" in f.message
+               and f.path == "bluefog_tpu/mod.py" for f in findings)
+    # ...and the documented-but-unread name is the warn direction
+    assert any(f.severity == "warn" and "BLUEFOG_METRICS" in f.message
+               and f.path == "docs/env_variable.md" for f in findings)
+
+
+def test_env_doc_drift_passes_documented_and_prefix_reads(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            _PREFIX = "BLUEFOG_FAM_"
+            def knob(name):
+                a = os.environ.get("BLUEFOG_METRICS")
+                b = os.environ.get(_PREFIX + name.upper())
+                return a, b
+        """},
+        env_doc="`BLUEFOG_METRICS` and `BLUEFOG_FAM_ALPHA` and the "
+                "`BLUEFOG_FAM_*` family\n")
+    assert _run(root, "env-doc-drift") == []
+
+
+def test_env_doc_drift_resolves_module_constants(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            KNOB_ENV = "BLUEFOG_VIA_CONST"
+            def knob():
+                return os.environ.get(KNOB_ENV)
+        """}, env_doc="")
+    findings = _run(root, "env-doc-drift")
+    assert any("BLUEFOG_VIA_CONST" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# import-time-env-read
+# ---------------------------------------------------------------------------
+
+def test_import_time_env_read_caught(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            FROZEN = os.environ.get("BLUEFOG_METRICS", "")
+        """}, env_doc="`BLUEFOG_METRICS`\n")
+    findings = _run(root, "import-time-env-read")
+    assert len(findings) == 1
+    assert findings[0].severity == "error"
+    # dedented fixture keeps its leading blank line: the read is line 3
+    assert findings[0].line == 3
+
+
+def test_import_time_env_read_inside_function_passes(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            def resolve():
+                return os.environ.get("BLUEFOG_METRICS", "")
+        """}, env_doc="`BLUEFOG_METRICS`\n")
+    assert _run(root, "import-time-env-read") == []
+
+
+def test_from_import_getenv_caught_by_both_env_rules(tmp_path):
+    # `from os import getenv` is the same read in a bare-name spelling —
+    # it must not slip past either rule (code-review hardening)
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            from os import getenv
+            FROZEN = getenv("BLUEFOG_BARE_NAME_KNOB")
+        """}, env_doc="")
+    assert any("BLUEFOG_BARE_NAME_KNOB" in f.message
+               for f in _run(root, "env-doc-drift"))
+    assert len(_run(root, "import-time-env-read")) == 1
+
+
+def test_import_time_env_read_in_default_arg_caught(tmp_path):
+    # default expressions evaluate at import — the same freeze
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            def resolve(path=os.environ.get("BLUEFOG_METRICS", "")):
+                return path
+        """}, env_doc="`BLUEFOG_METRICS`\n")
+    assert len(_run(root, "import-time-env-read")) == 1
+
+
+# ---------------------------------------------------------------------------
+# jsonl-kind-drift
+# ---------------------------------------------------------------------------
+
+_EXPORT_STUB = """
+    _KIND_REQUIRED = {
+        "decision": ("step", "t_us"),
+        "ghost": ("t_us",),
+    }
+    def validate_jsonl(path):
+        return []
+"""
+
+
+def test_jsonl_kind_drift_both_directions(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/observability/export.py": _EXPORT_STUB,
+        "bluefog_tpu/serving/writer.py": """
+            def publish(trail):
+                trail.write({"kind": "mystery", "t_us": 0})
+        """}, env_doc="")
+    findings = _run(root, "jsonl-kind-drift")
+    assert any(f.severity == "error" and "mystery" in f.message
+               and f.path == "bluefog_tpu/serving/writer.py"
+               for f in findings)
+    assert any(f.severity == "warn" and "ghost" in f.message
+               and f.path.endswith("export.py") for f in findings)
+
+
+def test_jsonl_kind_drift_in_sync_passes(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/observability/export.py": """
+            _KIND_REQUIRED = {"decision": ("step", "t_us")}
+        """,
+        "bluefog_tpu/control/writer.py": """
+            def log(rec):
+                rec["kind"] = "decision"
+                return rec
+        """}, env_doc="")
+    assert _run(root, "jsonl-kind-drift") == []
+
+
+def test_jsonl_kind_reads_are_not_emits(tmp_path):
+    # `rec.get("kind") == "x"` and membership tests must not register as
+    # writers — only dict literals / subscript-assignments do
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/observability/export.py": """
+            _KIND_REQUIRED = {"decision": ("t_us",)}
+        """,
+        "bluefog_tpu/observability/reader.py": """
+            def head(rec):
+                return rec.get("kind") == "unknown_kind"
+        """}, env_doc="")
+    findings = _run(root, "jsonl-kind-drift")
+    assert not any("unknown_kind" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# metric-name-drift
+# ---------------------------------------------------------------------------
+
+def test_metric_name_drift_undocumented(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            from .observability import metrics as _metrics
+            def hit():
+                _metrics.counter("bf_ghosts_total", "undocumented").inc()
+        """}, env_doc="", docs={"observability.md": "`bf_known_total`\n"})
+    findings = _run(root, "metric-name-drift")
+    assert len(findings) == 1
+    assert "bf_ghosts_total" in findings[0].message
+
+
+def test_metric_name_drift_documented_passes(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            from .observability import metrics as _metrics
+            def hit():
+                _metrics.counter("bf_known_total", "fine").inc()
+        """}, env_doc="", docs={"observability.md": "`bf_known_total`\n"})
+    assert _run(root, "metric-name-drift") == []
+
+
+def test_metric_name_drift_kind_conflict(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/a.py": """
+            from .observability import metrics as _metrics
+            def one():
+                _metrics.counter("bf_twice", "as counter").inc()
+        """,
+        "bluefog_tpu/b.py": """
+            from .observability import metrics as _metrics
+            def two():
+                _metrics.gauge("bf_twice", "as gauge").set(1.0)
+        """}, env_doc="", docs={"observability.md": "`bf_twice`\n"})
+    findings = _run(root, "metric-name-drift")
+    assert len(findings) == 1
+    assert "conflicting kinds" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# host-time-in-trace
+# ---------------------------------------------------------------------------
+
+def test_host_time_in_jitted_function_caught(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import time
+            import jax
+            def fn(x):
+                return x * time.time()
+            step = jax.jit(fn)
+        """}, env_doc="")
+    findings = _run(root, "host-time-in-trace")
+    assert len(findings) == 1
+    assert "time.time" in findings[0].message
+
+
+def test_np_random_in_step_builder_closure_caught(tmp_path):
+    # the optim/strategies.py shape: a `*_step` builder returns a traced
+    # closure; np.random inside it freezes one sample into the program
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/optim/strategies2.py": """
+            import numpy as np
+            def noisy_step(base):
+                def step_fn(params, grads, state, step=0):
+                    return params + np.random.normal()
+                return step_fn
+        """}, env_doc="")
+    findings = _run(root, "host-time-in-trace")
+    assert len(findings) == 1
+    assert "numpy.random" in findings[0].message
+
+
+def test_host_time_on_host_loop_passes(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import time
+            import jax
+            def traced(x):
+                return x + 1
+            def host_loop(xs):
+                t0 = time.perf_counter()
+                out = [jax.jit(traced)(x) for x in xs]
+                return out, time.perf_counter() - t0
+        """}, env_doc="")
+    assert _run(root, "host-time-in-trace") == []
+
+
+def test_hazard_reached_through_helper_call_caught(tmp_path):
+    # one intra-module call hop: traced fn -> helper -> time.time
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import time
+            import jax
+            def helper():
+                return time.time()
+            def fn(x):
+                return x * helper()
+            step = jax.jit(fn)
+        """}, env_doc="")
+    assert len(_run(root, "host-time-in-trace")) == 1
+
+
+def test_jax_random_is_not_a_hazard(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import jax
+            def fn(key, x):
+                return x + jax.random.normal(key, x.shape)
+            step = jax.jit(fn)
+        """}, env_doc="")
+    assert _run(root, "host-time-in-trace") == []
+
+
+# ---------------------------------------------------------------------------
+# knob-outside-cache-key
+# ---------------------------------------------------------------------------
+
+_PLUMBING_STUB = """
+    def step_cache_key(cx, params, nar_backend, fuse, bucket_bytes,
+                       overlap=False, telemetry=False, compression=None,
+                       gossip_axis=None, control=False):
+        return (nar_backend, fuse, bucket_bytes, overlap, telemetry,
+                compression, gossip_axis, control)
+"""
+
+
+def test_knob_outside_cache_key_caught(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/optim/_plumbing.py": _PLUMBING_STUB,
+        "bluefog_tpu/factory.py": """
+            def make_widget_step(base, fuse=None, telemetry=None,
+                                 shiny_new_knob=False):
+                def step_fn(p, g, s, i):
+                    return p
+                return step_fn
+        """}, env_doc="")
+    findings = _run(root, "knob-outside-cache-key")
+    assert len(findings) == 1
+    assert "shiny_new_knob" in findings[0].message
+
+
+def test_knob_exemption_annotation_passes(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/optim/_plumbing.py": _PLUMBING_STUB,
+        "bluefog_tpu/factory.py": """
+            _STEP_KEY_EXEMPT_KNOBS = frozenset({"shiny_new_knob"})
+            def make_widget_step(base, fuse=None, telemetry=None,
+                                 shiny_new_knob=False):
+                def step_fn(p, g, s, i):
+                    return p
+                return step_fn
+        """}, env_doc="")
+    assert _run(root, "knob-outside-cache-key") == []
+
+
+def test_knob_stale_exemption_reported(tmp_path):
+    # an exemption matching no factory knob silently pre-exempts
+    # whatever future knob reuses the name — reported like a stale
+    # baseline suppression
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/optim/_plumbing.py": _PLUMBING_STUB,
+        "bluefog_tpu/factory.py": """
+            _STEP_KEY_EXEMPT_KNOBS = frozenset({"renamed_away"})
+            def make_widget_step(base, fuse=None, telemetry=None):
+                def step_fn(p, g, s, i):
+                    return p
+                return step_fn
+        """}, env_doc="")
+    findings = _run(root, "knob-outside-cache-key")
+    assert len(findings) == 1
+    assert findings[0].severity == "warn"
+    assert "renamed_away" in findings[0].message
+
+
+def test_knob_rule_ignores_non_factories(tmp_path):
+    # one knob-ish param alone (a helper, not a factory) carries no
+    # cache-key obligation
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/optim/_plumbing.py": _PLUMBING_STUB,
+        "bluefog_tpu/helper.py": """
+            def check_supported_step(compression, strict=False):
+                return compression is not None or strict
+        """}, env_doc="")
+    assert _run(root, "knob-outside-cache-key") == []
+
+
+# ---------------------------------------------------------------------------
+# baseline suppression
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import os
+            def knob():
+                return os.environ.get("BLUEFOG_SECRET_KNOB")
+        """}, env_doc="")
+    findings = _run(root, "env-doc-drift")
+    assert findings
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(
+        '# reviewed suppression\n'
+        '[[suppress]]\n'
+        'rule = "env-doc-drift"\n'
+        'path = "bluefog_tpu/mod.py"\n'
+        'message = "BLUEFOG_SECRET_KNOB"\n'
+        'reason = "fixture debt, 2026-08-04"\n')
+    entries = baseline_mod.load_baseline(str(bl))
+    kept, suppressed, stale = baseline_mod.apply(findings, entries)
+    assert kept == [] and suppressed == len(findings) and stale == []
+
+
+def test_baseline_stale_entry_reported(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(
+        '[[suppress]]\n'
+        'rule = "metric-name-drift"\n'
+        'path = "bluefog_tpu/nowhere.py"\n'
+        'reason = "matches nothing"\n')
+    entries = baseline_mod.load_baseline(str(bl))
+    kept, suppressed, stale = baseline_mod.apply([], entries)
+    assert suppressed == 0 and len(stale) == 1
+
+
+def test_baseline_missing_required_key_is_fatal(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\nrule = "env-doc-drift"\n')
+    with pytest.raises(baseline_mod.BaselineError):
+        baseline_mod.load_baseline(str(bl))
+
+
+def test_baseline_missing_file_reads_empty(tmp_path):
+    assert baseline_mod.load_baseline(str(tmp_path / "nope.toml")) == []
+
+
+def test_unknown_rule_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        astrules.run_ast_rules(str(tmp_path), ["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# findings output model
+# ---------------------------------------------------------------------------
+
+def test_json_output_carries_all_fields():
+    import json
+    f = Finding("env-doc-drift", "error", "a.py", 3, "boom")
+    payload = json.loads(format_json([f], suppressed=2,
+                                     rules_run=["env-doc-drift"]))
+    assert payload["findings"] == [
+        {"rule": "env-doc-drift", "severity": "error", "file": "a.py",
+         "line": 3, "message": "boom"}]
+    assert payload["counts"] == {"error": 1, "warn": 0}
+    assert payload["suppressed"] == 2 and payload["ok"] is False
+
+
+def test_summary_line_shapes():
+    assert "clean" in summary_line([], files=10, rules=6)
+    f = Finding("x", "error", "a.py", 1, "m")
+    w = Finding("y", "warn", "a.py", 2, "m")
+    line = summary_line([f, w], files=10, rules=6, suppressed=1)
+    assert "1 error(s), 1 warn(s)" in line and "1 baseline-suppressed" in line
+
+
+# ---------------------------------------------------------------------------
+# trace-hazard checks on constructed programs
+# ---------------------------------------------------------------------------
+
+def _ring_pairs(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # the package's compat shim publishes jax.shard_map on old jaxlibs
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm_fallback
+        return sm_fallback(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs)
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def test_trace_flags_constructed_dropped_donation():
+    # output dtype differs from the donated input -> jax silently drops
+    # the donation (stderr warning only); the checker must flag it
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bad = jax.jit(lambda x: x.astype(jnp.bfloat16),
+                      donate_argnums=(0,))
+        text = bad.lower(jnp.zeros((8,), jnp.float32)).as_text()
+    findings = TH.check_donation(text, "constructed", expected_aliased=1)
+    assert len(findings) == 1
+    assert findings[0].rule == "trace-donation-dropped"
+
+    good = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    text = good.lower(jnp.zeros((8,), jnp.float32)).as_text()
+    assert TH.check_donation(text, "ok", expected_aliased=1) == []
+
+
+def test_trace_flags_constructed_wire_upcast(bf_ctx):
+    from jax.sharding import PartitionSpec as P
+    mesh = bf_ctx.mesh
+    n = bf_ctx.size
+    pairs = _ring_pairs(n)
+
+    def dequant_before_send(x):          # the hazard: wire moves f32
+        y = x.astype(jnp.float32)
+        return jax.lax.ppermute(y, bf_ctx.rank_axis, pairs)
+
+    def send_then_dequant(x):            # the legal shape: wire moves i8
+        y = jax.lax.ppermute(x, bf_ctx.rank_axis, pairs)
+        return y.astype(jnp.float32)
+
+    x = jnp.zeros((n, 16), jnp.int8)
+    spec = P(bf_ctx.rank_axis)
+    bad = jax.jit(_shard_map(dequant_before_send, mesh, spec, spec))
+    findings = TH.find_wire_upcasts(bad.lower(x).as_text(), "constructed")
+    assert len(findings) == 1
+    assert findings[0].rule == "trace-wire-upcast"
+    assert "i8" in findings[0].message and "f32" in findings[0].message
+
+    good = jax.jit(_shard_map(send_then_dequant, mesh, spec, spec))
+    assert TH.find_wire_upcasts(good.lower(x).as_text(), "ok") == []
+
+
+def test_trace_collective_budget(bf_ctx):
+    from jax.sharding import PartitionSpec as P
+    n = bf_ctx.size
+    pairs = _ring_pairs(n)
+
+    def two_permutes(x):                 # a "leaf escaped the plan"
+        a = jax.lax.ppermute(x, bf_ctx.rank_axis, pairs)
+        b = jax.lax.ppermute(x * 2, bf_ctx.rank_axis, pairs)
+        return a + b
+
+    spec = P(bf_ctx.rank_axis)
+    fn = jax.jit(_shard_map(two_permutes, bf_ctx.mesh, spec, spec))
+    text = fn.lower(jnp.zeros((n, 16), jnp.float32)).as_text()
+    findings = TH.check_collective_budget(text, "constructed", expected=1)
+    assert len(findings) == 1
+    assert findings[0].rule == "trace-collective-budget"
+    assert TH.check_collective_budget(text, "ok", expected=2) == []

@@ -1,0 +1,95 @@
+"""The lint gate: the WHOLE tree must be bflint-clean with an EMPTY
+baseline (docs/static_analysis.md).
+
+This is the tier-1 enforcement point for every contract the analyzer
+knows: reintroducing an undocumented ``BLUEFOG_*`` var, an unvalidated
+JSONL kind, an undocumented ``bf_*`` metric, a host-time read in traced
+code, a cache-key-less knob, an import-time env read — or breaking a
+lowered-program invariant (donation aliasing, wire dtypes, the
+fusion-plan collective budget) — fails the fast suite, not a reviewer's
+memory.
+"""
+
+import subprocess
+import sys
+
+from bluefog_tpu.analysis import (jsonl_kind_sets, load_baseline,
+                                  run_ast_rules)
+from bluefog_tpu.analysis import baseline as baseline_mod
+from bluefog_tpu.analysis.tracehazards import run_canonical_trace_checks
+
+
+def _render(findings):
+    return "\n".join(f.render() for f in findings)
+
+
+def test_ast_rules_clean_on_tree():
+    """Every AST contract rule, zero findings, no suppressions needed."""
+    findings, n_files = run_ast_rules()
+    assert n_files > 90, "analyzer lost sight of the package"
+    assert not findings, (
+        f"bflint found new contract drift — fix it (or, for reviewed "
+        f"debt, add a baseline entry with a reason):\n{_render(findings)}")
+
+
+def test_shipped_baseline_is_empty():
+    """The checked-in baseline carries no suppressions: findings get
+    fixed, not suppressed.  A future entry needs a documented reason AND
+    a conscious edit of this test."""
+    assert load_baseline(baseline_mod.DEFAULT_PATH) == []
+
+
+def test_jsonl_kinds_validator_and_exporters_cannot_drift():
+    """Cross-check (both sides analyzer-derived, never hand-listed): the
+    record kinds validate_jsonl accepts == the kinds the
+    observability/serving/control exporters can emit."""
+    emitted, accepted = jsonl_kind_sets()
+    assert emitted, "analyzer found no JSONL exporters — scan broken?"
+    assert emitted == accepted, (
+        f"validate_jsonl and the exporters drifted: "
+        f"emitted-but-unaccepted={sorted(emitted - accepted)}, "
+        f"accepted-but-unemitted={sorted(accepted - emitted)}")
+
+
+def test_trace_hazard_pass_clean_on_canonical_configs():
+    """The fused f32 and fused int8 bench-trace steps (donate=True) keep
+    full donation aliasing, narrow wire dtypes, and exactly the
+    fusion-plan collective budget."""
+    findings, report = run_canonical_trace_checks()
+    assert "skipped" not in report, report
+    assert not findings, _render(findings)
+    for label in ("fused", "fused_int8"):
+        entry = report[label]
+        assert entry["ppermute"] == entry["expected_ppermute"]
+        assert entry["aliased_outputs"] >= entry["donated_leaves"]
+
+
+def test_bflint_cli_exit_zero_and_summary():
+    """The exact invocation `make lint` runs (minus --trace, covered
+    in-process above): exit 0 and the bfmonitor-style summary line."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.analysis.cli"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bflint:" in proc.stdout and "clean" in proc.stdout
+
+
+def test_bflint_trace_refuses_to_skip_silently():
+    """`bflint --trace` on a 1-device backend (an ambient
+    XLA_FLAGS=...device_count=1 wins over bflint's default of 8) must
+    exit NON-zero with a trace-pass-skipped finding — a lint gate whose
+    trace half silently never ran is the exact silence the tool exists
+    to break."""
+    import json
+    import os
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.analysis.cli",
+         "--trace", "--json"],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert any(f["rule"] == "trace-pass-skipped"
+               for f in payload["findings"]), payload
