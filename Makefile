@@ -22,7 +22,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
-        profile-smoke control-smoke serve-smoke bench-serve lint
+        profile-smoke control-smoke serve-smoke elastic-smoke \
+        bench-serve lint
 
 test:
 	$(PYTEST) tests/
@@ -181,6 +182,19 @@ control-smoke:
 # `bfmonitor --once --json` "serving" block.
 serve-smoke:
 	python scripts/metrics_smoke.py --serve
+
+# Elastic-membership smoke (docs/resilience.md "Elastic membership"): a
+# scale-up chaos plan must admit a capacity rank mid-run (announced ->
+# syncing -> active, exactly one admission event), the regenerated
+# mixing matrix must pass the repair stochasticity invariants at every
+# step, consensus must re-contract after the admission, and the
+# membership JSONL trail must validate and surface in the real
+# `bfmonitor --once --json` "membership" block; a scale-down plan
+# mirrors it with exactly one departure, and the whole episode (plus a
+# churn plan swapped onto the same harness) reuses ONE compiled step
+# program — zero recompiles after warmup.
+elastic-smoke:
+	python scripts/metrics_smoke.py --elastic
 
 # Serving-tier bench (docs/serving.md): the end-to-end scenario on the
 # virtual mesh — one JSON line with requests/sec, staleness p50/p95/p99

@@ -95,7 +95,7 @@ from .ops.windows import (
     win_put, win_put_nonblocking, win_get, win_get_nonblocking,
     win_accumulate, win_accumulate_nonblocking,
     win_poll, win_wait, win_flush, win_mutex, win_lock, win_fetch,
-    win_publish,
+    win_publish, win_bootstrap_rank,
     get_current_created_window_names, get_win_version,
     win_associated_p, turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,

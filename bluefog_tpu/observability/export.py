@@ -31,7 +31,8 @@ __all__ = [
     "METRICS_ENV", "metrics_start", "metrics_end", "metrics_active",
     "metrics_path", "log_step", "telemetry_to_host", "prometheus_text",
     "validate_jsonl", "REQUIRED_JSONL_KEYS", "resolve_rotation",
-    "rotate_file", "read_trail", "MAX_MB_ENV", "KEEP_ENV",
+    "rotate_file", "read_trail", "Trail", "MAX_MB_ENV", "KEEP_ENV",
+    "MEMBERSHIP_SUFFIX", "MembershipTrail", "read_membership_trail",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -89,6 +90,97 @@ def read_trail(path: str, config_kind: str, kinds=None):
     except OSError:
         pass
     return config, records
+
+
+class Trail:
+    """Append-only sidecar JSONL with the shared size-based rotation
+    (``BLUEFOG_METRICS_MAX_MB`` / ``BLUEFOG_METRICS_KEEP``) — the writer
+    half of :func:`read_trail`, shared by the controller's decision
+    trail, the serving trail (``serving/router.py``), and the
+    elastic-membership trail (:class:`MembershipTrail`).
+
+    ``head_kind``: the config-record kind whose first occurrence is
+    re-written after every rotation, so a rotated trail never orphans
+    its records from the run's identity."""
+
+    def __init__(self, path: str, head_kind: Optional[str] = None):
+        self.path = path
+        self.head_kind = head_kind
+        self.t0 = time.perf_counter()
+        self.max_bytes, self.keep = resolve_rotation()
+        self._bytes = 0
+        self._head_line = None
+        self.f = open(path, "w")
+
+    def write(self, record: dict) -> dict:
+        record = dict(record)
+        record.setdefault("t_us",
+                          int((time.perf_counter() - self.t0) * 1e6))
+        line = json.dumps(record) + "\n"
+        if (self.head_kind is not None and self._head_line is None
+                and record.get("kind") == self.head_kind):
+            self._head_line = line
+        if (self.max_bytes and self._bytes
+                and self._bytes + len(line) > self.max_bytes):
+            self.f.close()
+            rotate_file(self.path, self.keep)
+            self.f = open(self.path, "w")
+            self._bytes = 0
+            if self._head_line and line != self._head_line:
+                self.f.write(self._head_line)
+                self._bytes += len(self._head_line)
+        self.f.write(line)
+        self.f.flush()
+        self._bytes += len(line)
+        return record
+
+    def close(self) -> None:
+        try:
+            self.f.close()
+        except Exception:
+            pass
+
+
+# -- elastic-membership trail (resilience/membership.py's reporting sink) ----
+
+MEMBERSHIP_SUFFIX = "membership.jsonl"
+
+
+class MembershipTrail(Trail):
+    """Sidecar JSONL for elastic-membership runs
+    (``<prefix>membership.jsonl``): a ``membership_config`` head record
+    (fleet size + pre-allocated capacity ranks), one periodic
+    ``membership`` state record per logged step, and one
+    ``membership_event`` line per state transition — the
+    machine-readable feed ``bfmonitor --membership`` renders and
+    ``validate_jsonl`` gates (docs/resilience.md "Elastic membership")."""
+
+    def __init__(self, path: str, *, size: int, capacity=()):
+        super().__init__(path, head_kind="membership_config")
+        self.write({"kind": "membership_config", "size": int(size),
+                    "capacity": [int(r) for r in capacity]})
+
+    def write_state(self, step: int, states: Dict[int, str],
+                    counts: Dict[str, int]) -> dict:
+        return self.write({
+            "kind": "membership", "step": int(step),
+            "states": {str(r): s for r, s in sorted(states.items())},
+            "active": int(counts.get("active", 0)),
+            "syncing": int(counts.get("syncing", 0)),
+            "alive": int(counts.get("active", 0)
+                         + counts.get("syncing", 0)
+                         + counts.get("announced", 0)),
+        })
+
+    def write_event(self, step: int, rank: int, transition: str) -> dict:
+        return self.write({"kind": "membership_event", "step": int(step),
+                           "rank": int(rank), "transition": transition})
+
+
+def read_membership_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as ``read_decisions`` / ``read_serving_trail``."""
+    return read_trail(path, "membership_config")
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -365,6 +457,17 @@ _KIND_REQUIRED = {
     "serve_failover": ("step", "t_us", "replica_from", "replica_to",
                        "reason"),
     "serve_config": ("t_us",),
+    # serving autoscaling events (serving/router.py admit/retire — the
+    # elastic-membership hook): one line per replica entering/leaving
+    # the active serving set
+    "serve_admit": ("step", "t_us", "replica"),
+    "serve_retire": ("step", "t_us", "replica"),
+    # elastic-membership trail (MembershipTrail above, fed by
+    # resilience/membership.py's ElasticMembership): a config head, one
+    # periodic per-step state record, one event line per transition
+    "membership_config": ("t_us",),
+    "membership": ("step", "t_us", "active", "syncing"),
+    "membership_event": ("step", "t_us", "rank", "transition"),
     # health verdict trail (observability/health.py write_verdicts): one
     # "report" summary line per evaluation window, then one "verdict"
     # line per finding.  The trail shares this module's rotation policy
@@ -428,6 +531,43 @@ def _check_serve(path, lineno, rec):
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError(
                     f"{path}:{lineno}: failover {field!r} is not numeric")
+    elif kind in ("serve_admit", "serve_retire"):
+        v = rec["replica"]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: {kind} 'replica' is not numeric")
+
+
+def _check_membership(path, lineno, rec):
+    """Membership-trail record shapes (MembershipTrail): ``membership``
+    carries the per-rank state map + counts, ``membership_event`` one
+    state transition.  Unknown fields stay tolerated."""
+    kind = rec["kind"]
+    if kind == "membership":
+        states = rec.get("states")
+        if states is not None:
+            if not isinstance(states, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: 'states' must be an object "
+                    f"(rank -> state)")
+            for k, v in states.items():
+                if not isinstance(v, str):
+                    raise ValueError(
+                        f"{path}:{lineno}: states[{k!r}] is not a string")
+        for field in ("active", "syncing"):
+            v = rec[field]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: membership {field!r} is not numeric")
+    elif kind == "membership_event":
+        if not isinstance(rec["transition"], str):
+            raise ValueError(
+                f"{path}:{lineno}: membership_event 'transition' must be "
+                f"a string")
+        v = rec["rank"]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: membership_event 'rank' is not numeric")
 
 
 def _check_structured(path, lineno, rec, check):
@@ -500,7 +640,10 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     ``edges``, ``overlap_efficiency``, ``serve_staleness``) well-shaped.
     Controller-trail lines (``kind: decision`` / ``control_config``,
     control/policy.py), serving-trail lines (``kind: serve`` /
-    ``serve_failover`` / ``serve_config``, serving/router.py), and
+    ``serve_failover`` / ``serve_admit`` / ``serve_retire`` /
+    ``serve_config``, serving/router.py), membership-trail lines
+    (``kind: membership`` / ``membership_event`` /
+    ``membership_config``, the :class:`MembershipTrail` above), and
     health-verdict-trail lines (``kind: report`` / ``verdict``,
     health.py) validate against their own required keys and shape
     instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
@@ -531,8 +674,11 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 raise ValueError(f"{path}:{lineno}: missing keys {missing}")
             if kind == "decision":
                 _check_decision(path, lineno, rec)
-            elif kind in ("serve", "serve_failover"):
+            elif kind in ("serve", "serve_failover", "serve_admit",
+                          "serve_retire"):
                 _check_serve(path, lineno, rec)
+            elif kind in ("membership", "membership_event"):
+                _check_membership(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

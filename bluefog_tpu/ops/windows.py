@@ -54,6 +54,7 @@ __all__ = [
     "win_put", "win_put_nonblocking", "win_get", "win_get_nonblocking",
     "win_accumulate", "win_accumulate_nonblocking",
     "win_poll", "win_wait", "win_flush", "win_mutex", "win_lock",
+    "win_bootstrap_rank",
     "get_current_created_window_names", "get_win_version",
     "win_associated_p", "win_associated_p_vector",
     "turn_on_win_ops_with_associated_p",
@@ -891,6 +892,48 @@ def win_update_then_collect(name: str, require_mutex: bool = True,
         U = U * np.asarray(alive, np.float64).reshape(-1)[:, None]
     return win_update(name, self_weight=1.0, neighbor_weights=U, reset=True,
                       require_mutex=require_mutex)
+
+
+def win_bootstrap_rank(name: str, rank: int, *, self_weight: float = 0.0,
+                       alive=None):
+    """One joiner catch-up round: pull ``rank``'s live in-neighbor window
+    tensors (a ``win_get`` restricted to its in-edges) and fold ONLY its
+    row toward their average — every other rank's tensor, buffers, and
+    versions stay untouched.
+
+    This is the windows half of the elastic-membership admission
+    protocol (docs/resilience.md "Elastic membership"): a joining rank's
+    slot already exists in every window (windows are global-view over
+    the full mesh — capacity ranks are pre-allocated by construction),
+    so bootstrap is just different weight matrices flowing through the
+    window's one compiled get/update program — zero recompiles per
+    joiner, per fold.
+
+    ``self_weight`` is the fraction of the joiner's own (stale) value
+    kept; 0.0 = adopt the in-neighbor average outright.  ``alive``
+    (optional [N] mask) drops dead feeds; a joiner with NO live
+    in-neighbor keeps its value (bounded staleness, never garbage).
+    Returns the window's global-view tensor after the fold
+    (:func:`win_fetch` shape)."""
+    w = _window(name)
+    n = w.topo.size
+    if not 0 <= rank < n:
+        raise ValueError(f"rank {rank} outside [0, {n})")
+    if not 0.0 <= self_weight <= 1.0:
+        raise ValueError(f"self_weight must be in [0, 1], got {self_weight}")
+    alive_row = (np.ones(n) if alive is None
+                 else np.asarray(alive, np.float64).reshape(-1))
+    srcs = [s for s in w.topo.in_neighbor_ranks(rank) if alive_row[s] > 0]
+    if not srcs:
+        return win_fetch(name)
+    G = np.zeros((n, n))
+    G[srcs, rank] = 1.0
+    win_get(name, src_weights=G)
+    U = np.zeros((n, n))
+    U[srcs, rank] = (1.0 - self_weight) / len(srcs)
+    sw = np.ones(n)
+    sw[rank] = self_weight
+    return win_update(name, self_weight=sw, neighbor_weights=U)
 
 
 def win_publish(name: str, tensor) -> None:

@@ -1082,6 +1082,14 @@ def with_degraded_guard(step_fn: Callable, local_step_fn: Callable):
     rank-local values.  Per-EDGE degradation belongs in the mixing matrix
     (``repair.repair_matrix_traced``), not here.
 
+    Elastic membership rides the same guard: a joiner that is announced
+    or syncing but not yet admitted
+    (``resilience.membership.ElasticMembership.degraded``) runs the
+    local branch — it trains on its bootstrapped parameters without
+    issuing exchanges — until the fleet-uniform admission step flips the
+    flag, with zero recompiles (docs/resilience.md "Elastic
+    membership").
+
     Telemetry: build BOTH branches with the same ``telemetry`` flag (the
     local branch via ``local_sgd_like_step(..., degraded=True)`` or
     ``delayed_local_step(..., telemetry=True)``) so the cond outputs

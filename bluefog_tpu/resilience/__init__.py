@@ -10,7 +10,12 @@ flowing through the same compiled program.  Four layers:
   links, value corruption); injectable into any step with zero recompiles.
 * :mod:`~bluefog_tpu.resilience.membership` — per-rank liveness beliefs as
   device-resident state, maintained by heartbeat gossip over the topology's
-  own edges, with suspect/confirm staleness thresholds.
+  own edges, with suspect/confirm staleness thresholds — plus the
+  elastic-membership protocol (``ElasticMembership``: announced →
+  syncing → active join state machine, window-subsystem parameter
+  bootstrap via ``bootstrap_join``) that lets ranks ARRIVE at runtime
+  with zero recompiles (capacity ranks pre-allocated in the fault
+  tables).
 * :mod:`~bluefog_tpu.resilience.repair` — mixing-matrix surgery: masking +
   diagonal absorption (column-stochastic families), Hastings re-weighting
   (doubly-stochastic families), disconnection fallback rings, and
@@ -23,10 +28,12 @@ See ``docs/resilience.md`` and ``examples/chaos_training.py``.
 """
 
 from .faults import (FaultEvent, FaultPlan, CompiledFaultPlan, empty_plan,
-                     random_plan)
+                     random_plan, scale_up_plan, scale_down_plan,
+                     churn_plan, resolve_sync_steps)
 from .membership import (LivenessConfig, init_state, gossip_step,
                          gossip_last_heard, belief_alive, belief_suspect,
-                         confirmed_dead_votes)
+                         confirmed_dead_votes, ElasticMembership,
+                         bootstrap_join)
 from .repair import (repair_matrix, repair_matrix_traced, repair_topology,
                      hastings_matrix, fallback_ring_matrix, spectral_gap,
                      liveness_masked_matrices, liveness_masked_schedule,
@@ -35,9 +42,11 @@ from .harness import ChaosHarness, ChaosReport
 
 __all__ = [
     "FaultEvent", "FaultPlan", "CompiledFaultPlan", "empty_plan",
-    "random_plan",
+    "random_plan", "scale_up_plan", "scale_down_plan", "churn_plan",
+    "resolve_sync_steps",
     "LivenessConfig", "init_state", "gossip_step", "gossip_last_heard",
     "belief_alive", "belief_suspect", "confirmed_dead_votes",
+    "ElasticMembership", "bootstrap_join",
     "repair_matrix", "repair_matrix_traced", "repair_topology",
     "hastings_matrix", "fallback_ring_matrix", "spectral_gap",
     "liveness_masked_matrices", "liveness_masked_schedule",

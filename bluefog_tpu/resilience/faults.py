@@ -2,20 +2,33 @@
 
 A :class:`FaultPlan` is a host-side description of what goes wrong and when:
 rank death at step k, straggler slow-down, flaky-link drops, value
-corruption.  :meth:`FaultPlan.compile` lowers it to fixed-shape per-step
-tables ([T, N] / [T, N, N]); jitted programs index the tables with the
-*traced* step, so injecting, editing, or clearing a fault between steps never
-changes program shape and never recompiles (asserted in
-``tests/test_resilience.py::test_fault_plans_do_not_recompile``).
+corruption — and, for elastic membership, ranks *arriving*: a
+``rank_join`` pre-allocates a capacity slot that is dead until its join
+step, heartbeats through a bounded *syncing* window (parameter
+bootstrap), then turns fully active; ``rank_leave`` is the orderly
+departure mirror.  :meth:`FaultPlan.compile` lowers everything to
+fixed-shape per-step tables ([T, N] / [T, N, N]); jitted programs index
+the tables with the *traced* step, so injecting, editing, or clearing a
+fault — or admitting and removing a rank — between steps never changes
+program shape and never recompiles (asserted in
+``tests/test_resilience.py::test_fault_plans_do_not_recompile`` and
+``tests/test_elastic.py::test_elastic_episode_zero_recompiles``).
 
 Conventions:
 
-* ``alive[t, i]``     1.0 while rank i is up at step t, 0.0 once down.
+* ``alive[t, i]``     1.0 while rank i is up at step t, 0.0 once down
+                      (capacity ranks are 0.0 before their join step).
 * ``active[t, i]``    1.0 when rank i participates at step t.  Stragglers
                       are alive but *intermittently* active: a factor-k
                       straggler only joins every k-th step, so its peers see
                       stale, late contributions — the SPMD analog of a slow
                       MPI rank (a dead rank is never active).
+* ``sync[t, i]``      1.0 while rank i is in its *syncing* window after a
+                      join: alive (heartbeats flow, liveness spreads) but
+                      not yet active (it bootstraps parameters and
+                      contributes zero mixing weight) — the middle state
+                      of the announced → syncing → active admission
+                      protocol (docs/resilience.md "Elastic membership").
 * ``link_ok[t, i, j]`` 1.0 when the i->j edge delivers at step t.
 * ``corrupt[t, i]``   multiplicative scale on rank i's *outgoing* value at
                       step t (1.0 = clean; ``nan`` models bit-rot — the
@@ -25,24 +38,43 @@ Beyond the horizon T the plan holds its LAST state (tables are indexed with
 ``min(step, T-1)``): a rank that dies stays dead, transient faults end.
 """
 
+import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 __all__ = ["FaultEvent", "FaultPlan", "CompiledFaultPlan", "empty_plan",
-           "random_plan"]
+           "random_plan", "scale_up_plan", "scale_down_plan", "churn_plan",
+           "resolve_sync_steps", "SYNC_STEPS_ENV"]
+
+SYNC_STEPS_ENV = "BLUEFOG_ELASTIC_SYNC_STEPS"
+
+
+def resolve_sync_steps(value: Optional[int] = None) -> int:
+    """``BLUEFOG_ELASTIC_SYNC_STEPS`` (default 2): length of a joiner's
+    syncing window — alive-but-inactive steps between its join step and
+    full activation, during which it bootstraps parameters and
+    contributes no mixing weight."""
+    if value is not None:
+        sync = int(value)
+    else:
+        sync = int(os.environ.get(SYNC_STEPS_ENV, "2"))
+    if sync < 0:
+        raise ValueError(f"sync_steps must be >= 0, got {sync}")
+    return sync
 
 
 @dataclass(frozen=True)
 class FaultEvent:
     """One fault.  ``until`` is exclusive; ``None`` = rest of the run."""
-    kind: str                      # rank_down | straggler | flaky_link | corrupt
+    kind: str   # rank_down | straggler | flaky_link | corrupt | rank_join | rank_leave
     rank: int
     step: int
     until: Optional[int] = None
     peer: Optional[int] = None     # flaky_link destination
-    factor: float = 1.0            # straggler period / corruption scale
+    factor: float = 1.0            # straggler period / corruption scale /
+                                   # join sync-window length
 
 
 @dataclass(frozen=True)
@@ -54,6 +86,7 @@ class CompiledFaultPlan:
     active: np.ndarray       # [T, N] float32
     link_ok: np.ndarray      # [T, N, N] float32
     corrupt: np.ndarray      # [T, N] float32
+    sync: np.ndarray         # [T, N] float32 (joiner syncing windows)
     events: Tuple[FaultEvent, ...] = ()
 
     def tables(self) -> Dict[str, "np.ndarray"]:
@@ -61,12 +94,19 @@ class CompiledFaultPlan:
 
         Every plan of the same ``(size, horizon)`` produces identically
         shaped tables — swap plans freely between calls of one compiled
-        program."""
-        import jax.numpy as jnp
-        return {"alive": jnp.asarray(self.alive),
-                "active": jnp.asarray(self.active),
-                "link_ok": jnp.asarray(self.link_ok),
-                "corrupt": jnp.asarray(self.corrupt)}
+        program.  The device upload is CACHED per plan instance: calling
+        this every step of a loop hands back the same arrays instead of
+        re-uploading fresh device buffers each time."""
+        cached = self.__dict__.get("_tables")
+        if cached is None:
+            import jax.numpy as jnp
+            cached = {"alive": jnp.asarray(self.alive),
+                      "active": jnp.asarray(self.active),
+                      "link_ok": jnp.asarray(self.link_ok),
+                      "corrupt": jnp.asarray(self.corrupt),
+                      "sync": jnp.asarray(self.sync)}
+            object.__setattr__(self, "_tables", cached)
+        return cached
 
     def num_dead_at(self, step: int) -> int:
         t = min(step, self.horizon - 1)
@@ -85,17 +125,31 @@ class CompiledFaultPlan:
         ships weights on its active steps)."""
         return self.active[min(step, self.horizon - 1)]
 
+    def sync_at(self, step: int) -> np.ndarray:
+        """Host-side [N] syncing row at ``step`` — 1.0 for joiners in
+        their bootstrap window (alive, zero mixing weight)."""
+        return self.sync[min(step, self.horizon - 1)]
+
+    @property
+    def capacity_ranks(self) -> Tuple[int, ...]:
+        """Ranks pre-allocated as elastic capacity (they carry a
+        ``rank_join`` event and are dead before its step — a join at the
+        horizon reserves the slot without ever admitting it)."""
+        return tuple(sorted({ev.rank for ev in self.events
+                             if ev.kind == "rank_join"}))
+
 
 def at_step(tables: Dict, step):
     """Index the device tables with a traced step (clamped to the horizon).
 
-    Returns ``(alive[N], active[N], link_ok[N, N], corrupt[N])`` for the
-    step — all traced values; use inside jit."""
+    Returns ``(alive[N], active[N], link_ok[N, N], corrupt[N], sync[N])``
+    for the step — all traced values; use inside jit."""
     import jax.numpy as jnp
     t = jnp.minimum(jnp.asarray(step, jnp.int32),
                     tables["alive"].shape[0] - 1)
     return (tables["alive"][t], tables["active"][t],
-            tables["link_ok"][t], tables["corrupt"][t])
+            tables["link_ok"][t], tables["corrupt"][t],
+            tables["sync"][t])
 
 
 class FaultPlan:
@@ -162,6 +216,37 @@ class FaultPlan:
             FaultEvent("corrupt", rank, at, until, factor=float(scale)))
         return self
 
+    def rank_join(self, rank: int, at: int,
+                  sync_steps: Optional[int] = None,
+                  until: Optional[int] = None) -> "FaultPlan":
+        """Elastic admission: ``rank`` is a pre-allocated capacity slot —
+        dead before step ``at``, *syncing* (alive, heartbeating, zero
+        mixing weight — the parameter-bootstrap window) for
+        ``sync_steps`` steps (default ``BLUEFOG_ELASTIC_SYNC_STEPS``),
+        fully active from ``at + sync_steps`` until ``until`` (exclusive;
+        ``None`` = rest of the run).
+
+        ``at >= horizon`` reserves the capacity slot without ever
+        admitting it (the tables keep their fixed shape, so a later plan
+        that does admit it swaps in with zero recompiles)."""
+        if not 0 <= rank < self.size:
+            raise ValueError(f"rank {rank} outside [0, {self.size})")
+        if at < 0:
+            raise ValueError(f"join step {at} must be >= 0")
+        self.events.append(FaultEvent(
+            "rank_join", rank, at, until,
+            factor=float(resolve_sync_steps(sync_steps))))
+        return self
+
+    def rank_leave(self, rank: int, at: int) -> "FaultPlan":
+        """Orderly departure (elastic scale-down): same lowering as
+        :meth:`rank_down` — the rank stops participating at ``at``,
+        permanently — but recorded as a distinct event kind so
+        membership observers report a *departure*, not a failure."""
+        self._check(rank, at)
+        self.events.append(FaultEvent("rank_leave", rank, at, None))
+        return self
+
     # -- lowering -----------------------------------------------------------
 
     def _window(self, ev: FaultEvent) -> Tuple[int, int]:
@@ -175,9 +260,10 @@ class FaultPlan:
         active = np.ones((T, N), np.float32)
         link_ok = np.ones((T, N, N), np.float32)
         corrupt = np.ones((T, N), np.float32)
+        sync = np.zeros((T, N), np.float32)
         for ev in self.events:
             lo, hi = self._window(ev)
-            if ev.kind == "rank_down":
+            if ev.kind in ("rank_down", "rank_leave"):
                 alive[lo:hi, ev.rank] = 0.0
             elif ev.kind == "straggler":
                 k = int(ev.factor)
@@ -188,35 +274,70 @@ class FaultPlan:
                 link_ok[lo:hi, ev.rank, ev.peer] = 0.0
             elif ev.kind == "corrupt":
                 corrupt[lo:hi, ev.rank] = ev.factor
+            elif ev.kind == "rank_join":
+                # capacity pre-allocation: dead before the join step,
+                # syncing (alive, inactive) through the bootstrap
+                # window, active after — and dead again past `until`
+                alive[:lo, ev.rank] = 0.0
+                alive[hi:, ev.rank] = 0.0
+                s_hi = min(lo + int(ev.factor), hi)
+                sync[lo:s_hi, ev.rank] = 1.0
+                active[:s_hi, ev.rank] = 0.0
             else:  # pragma: no cover — builders gate the kinds
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
-        active *= alive  # dead ranks are never active
+        active *= alive           # dead ranks are never active
+        sync *= alive             # ...and never syncing
+        active *= (1.0 - sync)    # syncing ranks carry no mixing weight
         return CompiledFaultPlan(size=N, horizon=T, alive=alive,
                                  active=active, link_ok=link_ok,
-                                 corrupt=corrupt, events=tuple(self.events))
+                                 corrupt=corrupt, sync=sync,
+                                 events=tuple(self.events))
 
 
 def empty_plan(size: int, horizon: int) -> CompiledFaultPlan:
-    """A fault-free plan (same table shapes: swap in for a clean run
-    without recompiling)."""
+    """A fault-free plan, returned **compiled** (same table shapes: swap
+    in for a clean run without recompiling).  Note the deliberate API
+    asymmetry with :func:`random_plan`, which returns the *builder* so
+    callers can stack more events — pass ``compiled=True`` there for the
+    symmetric behavior."""
     return FaultPlan(size, horizon).compile()
 
 
 def random_plan(size: int, horizon: int, seed: int = 0,
                 p_down: float = 0.1, p_straggler: float = 0.1,
                 p_flaky: float = 0.05, p_corrupt: float = 0.05,
-                max_dead: Optional[int] = None) -> FaultPlan:
+                max_dead: Optional[int] = None,
+                p_join: float = 0.0, capacity: int = 0,
+                sync_steps: Optional[int] = None, compiled: bool = False
+                ) -> Union[FaultPlan, CompiledFaultPlan]:
     """A seeded random scenario — same seed, same faults, every run.
 
     Per-rank Bernoulli draws decide which faults appear; onset steps,
     durations, and factors are drawn uniformly.  ``max_dead`` caps the
-    number of permanently-dead ranks (default: minority, ``(size-1)//2``),
-    so survivors always hold a quorum."""
+    number of permanently-dead ranks (default: minority of the non-capacity
+    base, ``(size-capacity-1)//2``), so survivors always hold a quorum.
+
+    Churn (elastic membership): the LAST ``capacity`` ranks are
+    pre-allocated capacity slots — dead at step 0, each joining with
+    probability ``p_join`` at a random step in the first half of the run
+    (``rank_join`` with a ``sync_steps`` bootstrap window; a slot that
+    does not join stays reserved via a join at the horizon), and each
+    admitted joiner later leaving with probability ``p_down``
+    (``rank_leave``) — so one seeded plan covers scale-up, scale-down,
+    and full churn.  Base faults never land on capacity ranks.
+
+    Returns the :class:`FaultPlan` builder (stack more events, then
+    ``.compile()``); ``compiled=True`` returns the
+    :class:`CompiledFaultPlan` directly — the same shape
+    :func:`empty_plan` returns."""
+    if not 0 <= capacity < size:
+        raise ValueError(f"capacity must be in [0, {size}), got {capacity}")
     rng = np.random.default_rng(seed)
     plan = FaultPlan(size, horizon, seed=seed)
-    cap = (size - 1) // 2 if max_dead is None else max_dead
+    base = size - capacity
+    cap = (base - 1) // 2 if max_dead is None else max_dead
     dead = 0
-    for r in range(size):
+    for r in range(base):
         if dead < cap and rng.random() < p_down:
             plan.rank_down(r, at=int(rng.integers(1, max(2, horizon // 2))))
             dead += 1
@@ -228,6 +349,16 @@ def random_plan(size: int, horizon: int, seed: int = 0,
             at = int(rng.integers(0, horizon))
             plan.corrupt(r, at=at, until=at + 1,
                          scale=float(rng.choice([np.nan, 1e3, -1e2])))
+    k = resolve_sync_steps(sync_steps)
+    for r in range(base, size):
+        if rng.random() < p_join:
+            at = int(rng.integers(1, max(2, horizon // 2)))
+            plan.rank_join(r, at=at, sync_steps=k)
+            if rng.random() < p_down:
+                leave_lo = min(at + k + 1, horizon - 1)
+                plan.rank_leave(r, at=int(rng.integers(leave_lo, horizon)))
+        else:
+            plan.rank_join(r, at=horizon, sync_steps=k)  # reserved slot
     n_links = int(p_flaky * size * size)
     for _ in range(n_links):
         s, d = rng.integers(0, size, 2)
@@ -236,4 +367,51 @@ def random_plan(size: int, horizon: int, seed: int = 0,
         at = int(rng.integers(0, horizon))
         plan.flaky_link(int(s), int(d), at=at,
                         until=at + int(rng.integers(1, 4)))
+    return plan.compile() if compiled else plan
+
+
+def _rank_steps(spec: Union[Dict[int, int], Sequence[Tuple[int, int]]]
+                ) -> List[Tuple[int, int]]:
+    if isinstance(spec, dict):
+        return [(int(r), int(t)) for r, t in sorted(spec.items())]
+    return [(int(r), int(t)) for r, t in spec]
+
+
+def scale_up_plan(size: int, horizon: int,
+                  joins: Union[Dict[int, int], Sequence[Tuple[int, int]]],
+                  sync_steps: Optional[int] = None) -> FaultPlan:
+    """Elastic scale-up scenario: each ``rank: join_step`` entry is a
+    pre-allocated capacity rank admitted mid-run (``rank_join`` with the
+    default sync window).  The chaos harness runs it like any plan —
+    admission is traced data."""
+    plan = FaultPlan(size, horizon)
+    for r, at in _rank_steps(joins):
+        plan.rank_join(r, at=at, sync_steps=sync_steps)
+    return plan
+
+
+def scale_down_plan(size: int, horizon: int,
+                    leaves: Union[Dict[int, int], Sequence[Tuple[int, int]]]
+                    ) -> FaultPlan:
+    """Elastic scale-down scenario: each ``rank: leave_step`` entry is an
+    orderly mid-run departure (``rank_leave``)."""
+    plan = FaultPlan(size, horizon)
+    for r, at in _rank_steps(leaves):
+        plan.rank_leave(r, at=at)
+    return plan
+
+
+def churn_plan(size: int, horizon: int,
+               episodes: Sequence[Tuple[int, int, int]],
+               sync_steps: Optional[int] = None) -> FaultPlan:
+    """Full churn: each ``(rank, join_at, leave_at)`` episode admits a
+    capacity rank and later removes it (``rank_join(..., until=leave_at)``
+    — the bounded-engagement form)."""
+    plan = FaultPlan(size, horizon)
+    for r, join_at, leave_at in episodes:
+        if leave_at <= join_at:
+            raise ValueError(
+                f"churn episode for rank {r}: leave step {leave_at} must "
+                f"be after join step {join_at}")
+        plan.rank_join(r, at=join_at, sync_steps=sync_steps, until=leave_at)
     return plan

@@ -66,8 +66,12 @@ class ChaosReport:
     dead_votes: np.ndarray        # [T, N] confirmed-dead votes per step
     mixing_matrices: np.ndarray   # [T, N, N] effective repaired W per step
     alive_steps: np.ndarray       # [T, N] plan liveness at each run step
+    sync_steps: np.ndarray        # [T, N] plan syncing windows (joiners)
     params_final: object          # global-view parameter tree
     events: List[str] = field(default_factory=list)
+    # elastic-membership audit log: (step, rank, new_state) transitions
+    # the host directory observed (membership.ElasticMembership)
+    membership_transitions: List[tuple] = field(default_factory=list)
 
     @property
     def alive_final(self) -> np.ndarray:
@@ -80,11 +84,25 @@ class ChaosReport:
         n_alive = int(self.alive_final.sum())
         return np.nonzero(self.dead_votes[-1] > n_alive // 2)[0]
 
+    @property
+    def admitted(self) -> List[int]:
+        """Ranks the membership directory observed turning active
+        (elastic admissions, in transition order)."""
+        return [r for _, r, s in self.membership_transitions
+                if s == _mem.STATE_ACTIVE]
+
+    @property
+    def departed(self) -> List[int]:
+        """Ranks the membership directory observed leaving."""
+        return [r for _, r, s in self.membership_transitions
+                if s == _mem.STATE_LEFT]
+
     def check_matrix_invariants(self, step: int = -1, atol: float = 1e-5):
         """Assert the step's effective matrix is column-stochastic,
-        non-negative, and carries zero weight to/from ranks dead AT THAT
+        non-negative, carries zero weight to/from ranks dead AT THAT
         STEP (a rank that dies mid-run legitimately mixes before its
-        death)."""
+        death), and that syncing joiners receive (their catch-up fold)
+        but contribute nothing until admitted."""
         W = self.mixing_matrices[step]
         np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=atol,
                                    err_msg="columns must sum to 1")
@@ -97,6 +115,10 @@ class ChaosReport:
             off_row = np.delete(W[r, :], r)
             assert np.allclose(off_row, 0.0, atol=atol), \
                 f"dead rank {r} still contributes weight"
+        for r in np.nonzero(self.sync_steps[step] > 0)[0]:
+            off_row = np.delete(W[r, :], r)
+            assert np.allclose(off_row, 0.0, atol=atol), \
+                f"syncing rank {r} contributes weight before admission"
 
     def assert_bounded(self, max_consensus_error: float,
                        settle_frac: float = 0.5):
@@ -206,15 +228,20 @@ class ChaosHarness:
         spec = P(axis)
 
         def shard_fn(p_s, opt_s, lh_s, batch_s, step, alive, active,
-                     link_ok, corrupt, gprev_s, fprev_s, rprev_s):
+                     link_ok, corrupt, sync, gprev_s, fprev_s, rprev_s):
             x = jax.tree.map(lambda a: a[0], p_s)
             st = jax.tree.map(lambda a: a[0], opt_s)
             b = jax.tree.map(lambda a: a[0], batch_s)
             row = lh_s[0]
             idx = lax.axis_index(axis)
 
-            # 1. membership gossip over the live edges
-            row = _mem.gossip_last_heard(row, axis, topo, step, active,
+            # 1. membership gossip over the live edges.  Heartbeats flow
+            #    for active AND syncing ranks: a joiner in its bootstrap
+            #    window announces itself through the gossip (that is how
+            #    the fleet's beliefs re-admit it) while still carrying
+            #    zero mixing weight below.
+            heartbeat = jnp.maximum(active, sync)
+            row = _mem.gossip_last_heard(row, axis, topo, step, heartbeat,
                                          link_ok)
             stale = jnp.asarray(step, jnp.int32) - row
             trusted = (stale <= cfg.suspect_after)     # fresh enough to mix
@@ -312,15 +339,46 @@ class ChaosHarness:
             updates, st_new = base_opt.update(grads, st, mixed)
             x_new = optax.apply_updates(mixed, updates)
 
-            # 6. freeze inactive ranks (dead or straggling this step); their
-            #    effective receive column is identity — they keep their value
+            # 5b. syncing-joiner catch-up fold (elastic admission): a
+            #     rank in its bootstrap window adopts the average of its
+            #     ACTIVE trusted in-neighbors outright — no self term
+            #     (its own value is whatever the capacity slot held),
+            #     no gradient step — so it converges to the fleet
+            #     average BEFORE it contributes mixing weight.  No live
+            #     feed => keep own value (bounded staleness).
+            neigh_mass = neigh_col.sum()
+            cat_col = jnp.where(
+                neigh_mass > 0,
+                neigh_col / jnp.maximum(neigh_mass, 1e-20),
+                jnp.zeros_like(neigh_col))
+            cat_self = jnp.where(neigh_mass > 0, 0.0, 1.0)
+            catch_bufs = [jnp.tensordot(
+                cat_col.astype(l.dtype),
+                jnp.where(jnp.isfinite(g), g, 0), axes=1)
+                + cat_self.astype(l.dtype) * l
+                for g, l in zip(mix_bufs_in, x_bufs)]
+            if fuse:
+                x_catch = _fusion.unflatten(fplan, catch_bufs)
+            else:
+                x_catch = jax.tree.unflatten(jax.tree.structure(x),
+                                             catch_bufs)
+
+            # 6. freeze inactive ranks (dead or straggling this step) —
+            #    their effective receive column is identity, they keep
+            #    their value — except syncing joiners, which take the
+            #    catch-up fold (their column is the normalized pull)
             me_active = active[idx] > 0
+            me_sync = sync[idx] > 0
             x_new = jax.tree.map(
-                lambda new, old: jnp.where(me_active, new, old), x_new, x)
+                lambda new, catch, old: jnp.where(
+                    me_active, new, jnp.where(me_sync, catch, old)),
+                x_new, x_catch, x)
             st_new = jax.tree.map(
                 lambda new, old: jnp.where(me_active, new, old), st_new, st)
+            sync_col = cat_col.at[idx].set(cat_self)
+            ident_col = jnp.zeros_like(col).at[idx].set(1.0)
             col = jnp.where(me_active, col,
-                            jnp.zeros_like(col).at[idx].set(1.0))
+                            jnp.where(me_sync, sync_col, ident_col))
 
             votes = confirmed_dead.astype(jnp.int32)          # my view
             # residual reset for inactive ranks: a frozen/degraded rank's
@@ -338,18 +396,19 @@ class ChaosHarness:
 
         def stepper(params, opt_state, last_heard, batch, step, tables,
                     carried):
-            alive, active, link_ok, corrupt = _faults.at_step(tables, step)
+            (alive, active, link_ok, corrupt,
+             sync) = _faults.at_step(tables, step)
             gprev, fprev, rprev = carried
             (p2, o2, lh2, loss_r, cols, votes, gnew,
              fnew, rnew) = jax.shard_map(
                 shard_fn, mesh=cx.mesh,
                 in_specs=(spec, spec, spec, spec, P(), P(), P(), P(), P(),
-                          spec, spec, spec),
+                          P(), spec, spec, spec),
                 out_specs=(spec, spec, spec, spec, spec, spec, spec, spec,
                            spec),
             )(params, opt_state, last_heard, batch,
               jnp.asarray(step, jnp.int32), alive, active, link_ok, corrupt,
-              gprev, fprev, rprev)
+              sync, gprev, fprev, rprev)
             # survivor metrics (active-weighted)
             wsum = jnp.maximum(active.sum(), 1.0)
             loss_mean = (loss_r * active).sum() / wsum
@@ -399,14 +458,31 @@ class ChaosHarness:
     # -- driver --------------------------------------------------------------
 
     def run(self, params0, *, steps: int, batches=None,
-            opt_state=None) -> ChaosReport:
+            opt_state=None, membership_trail=None) -> ChaosReport:
         """Run ``steps`` chaos steps from global-view ``params0`` [N, ...].
 
         ``batches``: optional callable ``step -> global batch`` (defaults
         to seeded per-rank quadratic targets held constant).  Returns a
         :class:`ChaosReport`; fault onsets and majority-confirmed deaths
-        are recorded on the timeline as host activities."""
+        are recorded on the timeline as host activities.
+
+        Elastic membership: when the plan carries ``rank_join`` /
+        ``rank_leave`` events, a host-side
+        :class:`~bluefog_tpu.resilience.membership.ElasticMembership`
+        directory observes the run — plan onsets announce/depart, the
+        gossiped ``last_heard`` table drives announced → syncing →
+        active — and its transitions land in
+        ``report.membership_transitions`` (+ the ``bf_membership_*``
+        gauges).  ``membership_trail``: metrics prefix (or explicit
+        path) for the sidecar ``<prefix>membership.jsonl`` trail
+        ``bfmonitor --membership`` renders."""
+        from ..observability import export as _export
         from ..ops import api as _api
+        if isinstance(self.plan, _faults.FaultPlan):
+            # plans injected between runs may be builders; compiling here
+            # keeps the swap-a-plan idiom uniform (same table shapes,
+            # same compiled step)
+            self.plan = self.plan.compile()
         if self._step_fn is None:
             self._step_fn = self._build_step()
         n = self.plan.size
@@ -439,9 +515,59 @@ class ChaosHarness:
         _tl.record_resilience_event("chaos_run_start",
                                     f"{steps} steps, {n} ranks")
         carried = self._initial_carried(params)
+
+        # elastic-membership directory: capacity ranks come from the
+        # plan's join events; the plan announces/departs (ground truth
+        # onsets, like fault onsets above), the gossiped last_heard
+        # table drives the announced -> syncing -> active observation
+        elastic_events = [ev for ev in getattr(self.plan, "events", [])
+                          if ev.kind in ("rank_join", "rank_leave")]
+        directory = _mem.ElasticMembership(
+            n, capacity=getattr(self.plan, "capacity_ranks", ()),
+            cfg=self.cfg)
+        trail = None
+        if membership_trail:
+            path = (membership_trail
+                    if membership_trail.endswith(".jsonl")
+                    else membership_trail + _export.MEMBERSHIP_SUFFIX)
+            trail = _export.MembershipTrail(
+                path, size=n,
+                capacity=[r for r, s in directory.states.items()
+                          if s == _mem.STATE_INACTIVE])
+
+        def note_transitions(trs, t):
+            for (ts, r, s) in trs:
+                msg = f"rank {r} membership -> {s} at step {ts}"
+                events.append(msg)
+                _tl.record_resilience_event("membership", msg)
+                if trail is not None:
+                    trail.write_event(ts, r, s)
+            if trail is not None:
+                trail.write_state(t, directory.states, directory.counts())
+
         losses, cons, votes_t, mats = [], [], [], []
         announced = set()
         for t in range(steps):
+            trs = []
+            for ev in elastic_events:
+                if (ev.kind == "rank_join" and ev.step == t
+                        and ev.step < self.plan.horizon):
+                    # a join at the horizon is a RESERVED capacity slot
+                    # (never admitted) — the tables clamp to the last
+                    # row, where the rank is still dead
+                    tr = directory.announce(ev.rank, t)
+                    if tr:
+                        trs.append(tr)
+                if ev.kind == "rank_leave" and ev.step == t:
+                    tr = directory.leave(ev.rank, t)
+                    if tr:
+                        trs.append(tr)
+                if (ev.kind == "rank_join"
+                        and t == ev.step + int(ev.factor)):
+                    # the plan's sync window elapsed: the traced tables
+                    # activate the joiner this step — report bootstrap
+                    # completion so the observer can confirm admission
+                    directory.mark_synced(ev.rank)
             (params, opt_state, state, loss, ce, W_eff,
              votes, carried) = self._step_fn(params, opt_state, state,
                                              batch_of(t), t, tables,
@@ -451,6 +577,10 @@ class ChaosHarness:
             votes_np = np.asarray(votes)
             votes_t.append(votes_np)
             mats.append(np.asarray(W_eff))
+            if elastic_events or directory.transitions:
+                trs += directory.observe(np.asarray(state), t)
+            if trs or trail is not None:
+                note_transitions(trs, t)
             n_alive = int(self.plan.alive[min(t, self.plan.horizon - 1)]
                           .sum())
             if _metrics.enabled():
@@ -474,14 +604,19 @@ class ChaosHarness:
                     _tl.record_resilience_event("repair", msg)
         _tl.record_resilience_event("chaos_run_end",
                                     f"final consensus error {cons[-1]:.3g}")
+        if trail is not None:
+            trail.close()
+        clamp = lambda t: min(t, self.plan.horizon - 1)
         return ChaosReport(
             losses=np.asarray(losses),
             consensus_errors=np.asarray(cons),
             dead_votes=np.stack(votes_t),
             mixing_matrices=np.stack(mats),
             alive_steps=np.stack(
-                [self.plan.alive[min(t, self.plan.horizon - 1)]
-                 for t in range(steps)]),
+                [self.plan.alive[clamp(t)] for t in range(steps)]),
+            sync_steps=np.stack(
+                [self.plan.sync[clamp(t)] for t in range(steps)]),
             params_final=params,
             events=events,
+            membership_transitions=list(directory.transitions),
         )

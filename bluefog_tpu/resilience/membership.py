@@ -1,4 +1,5 @@
-"""Per-rank liveness/health masks as device-resident state.
+"""Per-rank liveness/health masks as device-resident state — and the
+elastic-membership protocol built on top of them.
 
 There is no failure detector oracle in a decentralized system: each rank can
 only *infer* peer health from what arrives over its in-edges.  The state here
@@ -21,10 +22,22 @@ accrual-detector split):
 
 Everything is traced data — the tables ride inside jitted programs, so
 liveness transitions never recompile.
+
+**Elastic membership** (docs/resilience.md "Elastic membership"): ranks
+also *arrive* at runtime.  :class:`ElasticMembership` is the host-side
+join/leave state machine — per rank ``inactive`` (a pre-allocated
+capacity slot) → ``announced`` (the rank declared itself and started
+heartbeating) → ``syncing`` (a quorum of active ranks heard it; it
+bootstraps parameters over the window subsystem, :func:`bootstrap_join`)
+→ ``active`` (it contributes mixing weight) → ``left``.  The machine is
+an *observer* driven by the same ``last_heard`` gossip: admission itself
+is traced data (capacity ranks pre-allocated in the fault tables, the
+repaired mixing matrix flowing as numbers), so growth never recompiles.
 """
 
 import functools
-from typing import Dict, Optional, Tuple
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,11 +45,43 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..observability import metrics as _metrics
 from ..parallel.schedule import CompiledTopology
+from .faults import SYNC_STEPS_ENV, resolve_sync_steps
 
 __all__ = ["LivenessConfig", "init_state", "gossip_last_heard",
            "gossip_step", "belief_alive", "belief_suspect",
-           "confirmed_dead_votes"]
+           "confirmed_dead_votes",
+           "ElasticMembership", "bootstrap_join",
+           "STATE_INACTIVE", "STATE_ANNOUNCED", "STATE_SYNCING",
+           "STATE_ACTIVE", "STATE_LEFT",
+           "resolve_sync_steps", "resolve_bootstrap_folds",
+           "resolve_bootstrap_tol", "SYNC_STEPS_ENV",
+           "BOOTSTRAP_FOLDS_ENV", "BOOTSTRAP_TOL_ENV"]
+
+BOOTSTRAP_FOLDS_ENV = "BLUEFOG_ELASTIC_BOOTSTRAP_FOLDS"
+BOOTSTRAP_TOL_ENV = "BLUEFOG_ELASTIC_BOOTSTRAP_TOL"
+
+
+def resolve_bootstrap_folds(value: Optional[int] = None) -> int:
+    """``BLUEFOG_ELASTIC_BOOTSTRAP_FOLDS`` (default 2): cap on the
+    ``win_get`` + catch-up-fold rounds a joiner runs while syncing."""
+    folds = int(os.environ.get(BOOTSTRAP_FOLDS_ENV, "2")
+                if value is None else value)
+    if folds < 1:
+        raise ValueError(f"bootstrap folds must be >= 1, got {folds}")
+    return folds
+
+
+def resolve_bootstrap_tol(value: Optional[float] = None) -> float:
+    """``BLUEFOG_ELASTIC_BOOTSTRAP_TOL`` (default 1e-6): relative
+    movement of the joiner's row below which :func:`bootstrap_join`
+    stops folding early (the row converged to the neighbor average)."""
+    tol = float(os.environ.get(BOOTSTRAP_TOL_ENV, "1e-6")
+                if value is None else value)
+    if tol < 0:
+        raise ValueError(f"bootstrap tol must be >= 0, got {tol}")
+    return tol
 
 
 class LivenessConfig:
@@ -170,3 +215,249 @@ def confirmed_dead_votes(last_heard, step, cfg: LivenessConfig):
     st = _staleness(last_heard, step)
     dead_view = (st > cfg.confirm_after)          # [viewer, peer]
     return dead_view.sum(axis=0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership: the join/leave state machine (host-side observer)
+# ---------------------------------------------------------------------------
+
+STATE_INACTIVE = "inactive"     # pre-allocated capacity slot, not joined
+STATE_ANNOUNCED = "announced"   # declared itself; heartbeats started
+STATE_SYNCING = "syncing"       # heard by a quorum; bootstrapping params
+STATE_ACTIVE = "active"         # contributes mixing weight
+STATE_LEFT = "left"             # departed (orderly) or confirmed dead
+
+_ALIVE_STATES = (STATE_ANNOUNCED, STATE_SYNCING, STATE_ACTIVE)
+
+
+class ElasticMembership:
+    """Host-side elastic-membership directory: per-rank join/leave state
+    machine driven by the liveness gossip.
+
+    The machine OBSERVES — the traced data (fault tables, repaired
+    mixing matrices, liveness masks) executes admission; this directory
+    turns the same ``last_heard`` table into auditable state
+    transitions, the masks host-side consumers feed to
+    :func:`~bluefog_tpu.resilience.repair.repair_matrix` /
+    ``win_update(alive=)`` / ``bf.weights_override``, and the
+    ``bf_membership_*`` gauges + membership JSONL trail ``bfmonitor
+    --membership`` renders.
+
+    Transitions:
+
+    * ``announce(rank, step)`` — inactive/left → announced (the rank's
+      own declaration; in a chaos run, the plan's ``rank_join`` onset).
+    * announced → syncing: :meth:`observe` sees a quorum of active
+      ranks heard the joiner within ``suspect_after`` steps (heartbeat
+      dissemination reached the fleet).
+    * syncing → active: the caller reported bootstrap completion
+      (:meth:`mark_synced` — e.g. :func:`bootstrap_join` converged)
+      and the quorum still holds.
+    * any alive state → left: ``leave(rank, step)`` (orderly), or
+      :meth:`observe` counts a quorum of confirmed-dead votes
+      (staleness beyond ``confirm_after`` — failure-as-departure).
+    """
+
+    def __init__(self, size: int, *, capacity: Iterable[int] = (),
+                 cfg: Optional[LivenessConfig] = None,
+                 quorum: Optional[int] = None):
+        self.size = int(size)
+        self.cfg = cfg or LivenessConfig()
+        cap = set(int(r) for r in capacity)
+        for r in cap:
+            if not 0 <= r < self.size:
+                raise ValueError(f"capacity rank {r} outside "
+                                 f"[0, {self.size})")
+        self.states: Dict[int, str] = {
+            r: (STATE_INACTIVE if r in cap else STATE_ACTIVE)
+            for r in range(self.size)}
+        self.quorum = quorum              # None = majority of active ranks
+        self._synced: set = set()
+        self._announced_at: Dict[int, int] = {}
+        # (step, rank, new_state) — the audit log the chaos report and
+        # the membership JSONL trail bank
+        self.transitions: List[Tuple[int, int, str]] = []
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _quorum(self) -> int:
+        n_active = sum(1 for s in self.states.values()
+                       if s == STATE_ACTIVE)
+        return self.quorum if self.quorum else n_active // 2 + 1
+
+    def _set(self, rank: int, state: str, step: int) -> Tuple[int, int, str]:
+        self.states[rank] = state
+        tr = (int(step), int(rank), state)
+        self.transitions.append(tr)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_membership_transitions_total",
+                "elastic-membership state transitions, by target state"
+            ).inc(state=state)
+        self._export_gauges()
+        return tr
+
+    def _export_gauges(self) -> None:
+        if not _metrics.enabled():
+            return
+        counts = self.counts()
+        _metrics.gauge(
+            "bf_membership_active_ranks",
+            "ranks in the elastic-membership active state").set(
+            float(counts[STATE_ACTIVE]))
+        _metrics.gauge(
+            "bf_membership_syncing_ranks",
+            "joiners currently in their parameter-bootstrap window").set(
+            float(counts[STATE_SYNCING]))
+
+    # -- explicit transitions -----------------------------------------------
+
+    def announce(self, rank: int, step: int) -> Optional[Tuple]:
+        """A capacity (or departed) rank declares itself; its heartbeats
+        start flowing.  No-op for ranks already alive."""
+        if self.states[rank] in (STATE_INACTIVE, STATE_LEFT):
+            self._synced.discard(rank)
+            self._announced_at[rank] = int(step)
+            return self._set(rank, STATE_ANNOUNCED, step)
+        return None
+
+    def leave(self, rank: int, step: int) -> Optional[Tuple]:
+        """Orderly departure (elastic scale-down)."""
+        if self.states[rank] in _ALIVE_STATES:
+            self._synced.discard(rank)
+            return self._set(rank, STATE_LEFT, step)
+        return None
+
+    def mark_synced(self, rank: int) -> None:
+        """Report parameter-bootstrap completion for a syncing/announced
+        joiner (e.g. :func:`bootstrap_join` converged, or the fault
+        plan's sync window elapsed) — activation still waits for the
+        gossip quorum in :meth:`observe`."""
+        self._synced.add(rank)
+
+    # -- the gossip-driven drive --------------------------------------------
+
+    def observe(self, last_heard, step: int) -> List[Tuple[int, int, str]]:
+        """Advance the machine from one ``last_heard`` snapshot (the
+        global-view [N, N] table; row j = viewer j).  Returns the
+        transitions this observation caused."""
+        lh = np.asarray(last_heard)
+        if lh.shape != (self.size, self.size):
+            raise ValueError(f"last_heard must be "
+                             f"[{self.size}, {self.size}], got {lh.shape}")
+        out: List[Tuple[int, int, str]] = []
+        viewers = [v for v, s in self.states.items() if s == STATE_ACTIVE]
+        q = self._quorum()
+        stale = int(step) - lh                       # [viewer, peer]
+        for r in range(self.size):
+            state = self.states[r]
+            if state not in _ALIVE_STATES:
+                continue
+            heard = sum(1 for v in viewers if v != r
+                        and stale[v, r] <= self.cfg.suspect_after)
+            dead_votes = sum(1 for v in viewers if v != r
+                             and stale[v, r] > self.cfg.confirm_after)
+            others = sum(1 for v in viewers if v != r)
+            if state == STATE_ANNOUNCED and heard >= min(q, max(others, 1)):
+                out.append(self._set(r, STATE_SYNCING, step))
+                state = STATE_SYNCING
+            if (state == STATE_SYNCING and r in self._synced
+                    and heard >= min(q, max(others, 1))):
+                out.append(self._set(r, STATE_ACTIVE, step))
+                continue
+            if (state == STATE_ACTIVE and others
+                    and dead_votes >= min(q, others)):
+                # failure-as-departure: the fleet confirmed it dead
+                self._synced.discard(r)
+                out.append(self._set(r, STATE_LEFT, step))
+            elif state in (STATE_ANNOUNCED, STATE_SYNCING) and others:
+                # a joiner that dies (or whose heartbeats never spread)
+                # MID-admission must also depart, or it would report as
+                # announced/syncing forever and its alive_mask bit would
+                # keep a dead rank's buffer in every fold.  It departs
+                # once silent for confirm_after steps measured from the
+                # freshest heartbeat any active viewer holds — or from
+                # its announcement, so a never-heard joiner gets the
+                # same grace before the directory gives up on it.
+                freshest = max(int(lh[v, r]) for v in viewers if v != r)
+                basis = max(freshest, self._announced_at.get(r, 0))
+                if int(step) - basis > self.cfg.confirm_after:
+                    self._synced.discard(r)
+                    out.append(self._set(r, STATE_LEFT, step))
+        return out
+
+    # -- masks and summaries ------------------------------------------------
+
+    def state_of(self, rank: int) -> str:
+        return self.states[rank]
+
+    def alive_mask(self) -> np.ndarray:
+        """[N] float32 — 1.0 for announced/syncing/active ranks (feed to
+        ``win_update(alive=)`` / the serving router's ``observe``)."""
+        return np.asarray([1.0 if self.states[r] in _ALIVE_STATES else 0.0
+                           for r in range(self.size)], np.float32)
+
+    def active_mask(self) -> np.ndarray:
+        """[N] float32 — 1.0 only for fully-active ranks (feed to
+        :func:`~bluefog_tpu.resilience.repair.repair_matrix`: the mixing
+        matrix regenerates over exactly these)."""
+        return np.asarray([1.0 if self.states[r] == STATE_ACTIVE else 0.0
+                           for r in range(self.size)], np.float32)
+
+    def degraded(self, rank: int) -> bool:
+        """True while ``rank`` must run the skip-comm local branch
+        (``optim.strategies.with_degraded_guard``): a joiner that is not
+        yet active trains locally and exchanges nothing."""
+        return self.states[rank] != STATE_ACTIVE
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in (STATE_INACTIVE, STATE_ANNOUNCED,
+                              STATE_SYNCING, STATE_ACTIVE, STATE_LEFT)}
+        for s in self.states.values():
+            out[s] += 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Parameter bootstrap over the window subsystem
+# ---------------------------------------------------------------------------
+
+def bootstrap_join(window_name: str, rank: int, *, alive=None,
+                   folds: Optional[int] = None,
+                   tol: Optional[float] = None,
+                   self_weight: float = 0.0):
+    """Parameter bootstrap for a joiner: converge ``rank``'s window row
+    to its live in-neighbors' average before it contributes mixing
+    weight.
+
+    Each round is one ``win_get`` snapshot of the in-neighbor tensors
+    plus one bounded-staleness catch-up fold restricted to the joiner's
+    row (``ops.windows.win_bootstrap_rank`` — a ``win_update`` whose
+    weight matrices are traced data, so every joiner and every fold
+    reuses the window's one compiled program).  Stops after ``folds``
+    rounds (``BLUEFOG_ELASTIC_BOOTSTRAP_FOLDS``) or as soon as the
+    joiner's row moves less than ``tol`` relatively
+    (``BLUEFOG_ELASTIC_BOOTSTRAP_TOL``).
+
+    ``alive`` (optional [N] mask) drops dead feeds from the average —
+    the same bounded-staleness degradation as every other fold.
+    Returns ``(tree, folds_used)`` with the window's post-bootstrap
+    global-view tensor."""
+    from ..ops import windows as _win
+    folds = resolve_bootstrap_folds(folds)     # always >= 1: the loop runs
+    tol = resolve_bootstrap_tol(tol)
+    prev = None
+    out = None
+    used = 0
+    for used in range(1, folds + 1):
+        out = _win.win_bootstrap_rank(window_name, rank, alive=alive,
+                                      self_weight=self_weight)
+        row = np.concatenate([
+            np.asarray(leaf[rank], np.float64).ravel()
+            for leaf in jax.tree.leaves(out)])
+        if prev is not None:
+            denom = max(float(np.linalg.norm(prev)), 1e-12)
+            if float(np.linalg.norm(row - prev)) <= tol * denom:
+                break
+        prev = row
+    return out, used

@@ -162,6 +162,14 @@ def repair_matrix(W: np.ndarray, alive, family: str = "auto") -> np.ndarray:
     a disconnected mixing matrix has spectral gap zero and consensus never
     contracts.  Dead ranks keep identity columns; every returned matrix is
     column-stochastic with zero weight to and from the dead.
+
+    The same surgery runs in the *grow* direction (elastic membership,
+    docs/resilience.md): repair always starts from the healthy ``W``
+    over the FULL capacity, so admitting a rank is just calling this
+    with the larger ``alive`` mask — its pre-allocated edges re-enter,
+    the diagonal mass they displaced flows back, and a fallback-ring
+    repair regrows to the original family.  Exercised both ways in
+    ``tests/test_elastic.py``.
     """
     W = np.asarray(W, np.float64)
     n = W.shape[0]

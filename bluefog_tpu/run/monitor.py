@@ -34,7 +34,8 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
-           "render_edge_heatmap", "render_decisions", "render_serving"]
+           "render_edge_heatmap", "render_decisions", "render_serving",
+           "render_membership"]
 
 _TICKS = "▁▂▃▄▅▆▇█"
 _SEV_TAG = {"critical": "CRIT", "warn": "warn", "info": "info"}
@@ -102,6 +103,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  verdicts_path: Optional[str] = None,
                  decisions_path: Optional[str] = None,
                  serving_path: Optional[str] = None,
+                 membership_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -116,7 +118,11 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     ``<prefix>serving.jsonl``, ``serving/router.py``) — replica
     staleness, request rate, and failover events become the
     ``"serving"`` block (a controller endpoint) and the ``--serving``
-    panel."""
+    panel.  ``membership_path``: the elastic-membership trail (default
+    discovery: ``<prefix>membership.jsonl``,
+    ``observability/export.py::MembershipTrail``) — per-rank membership
+    states, active/syncing counts, and join/leave transitions become
+    the ``"membership"`` block and the ``--membership`` panel."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -183,6 +189,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     }
     out["decisions"] = _decisions_block(prefix, decisions_path)
     out["serving"] = _serving_block(prefix, serving_path)
+    out["membership"] = _membership_block(prefix, membership_path)
     return view, report, _strict_json(out)
 
 
@@ -254,6 +261,72 @@ def _serving_block(prefix: str,
             "recent": failovers[-4:],
         },
     }
+
+
+def _membership_block(prefix: str,
+                      membership_path: Optional[str]) -> Optional[dict]:
+    """The elastic-membership trail as a report block: the latest
+    per-rank state map, active/syncing count series (the panel
+    sparklines them), and the recent join/leave transitions — None when
+    no trail exists (a run without elasticity stays noise-free)."""
+    from ..observability.export import (MEMBERSHIP_SUFFIX,
+                                        read_membership_trail)
+    path = membership_path or prefix + MEMBERSHIP_SUFFIX
+    config, records = read_membership_trail(path)
+    if config is None and not records:
+        return None
+    states = [r for r in records if r.get("kind") == "membership"]
+    events = [r for r in records if r.get("kind") == "membership_event"]
+    latest = states[-1] if states else {}
+    series = {k: [s.get(k) for s in states
+                  if isinstance(s.get(k), (int, float))]
+              for k in ("active", "syncing")}
+    return {
+        "path": path,
+        "size": (config or {}).get("size"),
+        "capacity": (config or {}).get("capacity"),
+        "step": latest.get("step"),
+        "states": latest.get("states"),
+        "active": latest.get("active"),
+        "syncing": latest.get("syncing"),
+        "active_series": series["active"][-24:],
+        "syncing_series": series["syncing"][-24:],
+        "events": {
+            "total": len(events),
+            "recent": events[-6:],
+        },
+    }
+
+
+def render_membership(block: dict, *, width: int = 12) -> str:
+    """The elastic-membership panel (``--membership``): fleet-size
+    sparkline (active ranks over time), capacity usage, the latest
+    per-rank states, and recent join/leave transitions."""
+    cap = block.get("capacity") or []
+    lines = [f"membership:  step {block.get('step', '-')}  "
+             f"active {block.get('active', '-')}"
+             f"/{block.get('size', '-')}  "
+             f"syncing {block.get('syncing', '-')}  "
+             f"capacity {len(cap)} slot{'s' if len(cap) != 1 else ''}"]
+    series = [s for s in block.get("active_series", [])
+              if isinstance(s, (int, float))]
+    if series:
+        lines.append(f"  active ranks {sparkline(series, width)}")
+    states = block.get("states") or {}
+    off = {r: s for r, s in states.items() if s != "active"}
+    if off:
+        lines.append("  non-active: " + ", ".join(
+            f"{r}={s}" for r, s in sorted(
+                off.items(), key=lambda kv: (0, int(kv[0]))
+                if kv[0].isdigit() else (1, kv[0]))))
+    ev = block.get("events") or {}
+    if ev.get("total"):
+        lines.append(f"  transitions: {ev['total']}")
+        for e in ev.get("recent", []):
+            lines.append(
+                f"    step {str(e.get('step', '-')):>5}  rank "
+                f"{e.get('rank')} -> {e.get('transition')}")
+    return "\n".join(lines)
 
 
 def render_serving(block: dict, *, width: int = 12) -> str:
@@ -444,6 +517,14 @@ def main(argv=None) -> int:
     p.add_argument("--serving-trail", default=None, metavar="PATH",
                    help="serving trail to render (default: "
                         "<prefix>serving.jsonl when it exists)")
+    p.add_argument("--membership", action="store_true",
+                   help="render the elastic-membership panel (fleet-size "
+                        "sparkline, per-rank states, join/leave "
+                        "transitions) from the <prefix>membership.jsonl "
+                        "trail")
+    p.add_argument("--membership-trail", default=None, metavar="PATH",
+                   help="membership trail to render (default: "
+                        "<prefix>membership.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -458,7 +539,8 @@ def main(argv=None) -> int:
         view, report, out = build_report(
             args.prefix, window=args.window, expected_ranks=args.ranks,
             verdicts_path=args.verdicts, decisions_path=args.decisions,
-            serving_path=args.serving_trail, cache=cache)
+            serving_path=args.serving_trail,
+            membership_path=args.membership_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -466,6 +548,14 @@ def main(argv=None) -> int:
             if out.get("decisions"):
                 print()
                 print(render_decisions(out["decisions"]))
+            if args.membership:
+                if out.get("membership"):
+                    print()
+                    print(render_membership(out["membership"]))
+                else:
+                    print("\n(no membership trail yet — elastic runs "
+                          "write <prefix>membership.jsonl; see "
+                          "docs/resilience.md)")
             if args.serving:
                 if out.get("serving"):
                     print()

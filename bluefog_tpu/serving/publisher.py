@@ -138,11 +138,26 @@ class WeightPublisher:
                  compression=None,
                  topo: Optional[CompiledTopology] = None,
                  edges: Optional[Sequence[Tuple[int, int]]] = None,
-                 publish_every: Optional[int] = None):
+                 publish_every: Optional[int] = None,
+                 standby: Sequence[int] = ()):
         cx = ctx()
         self.name = name
         self.publishers = list(dict.fromkeys(publishers))
         self.replicas = list(dict.fromkeys(replicas))
+        # standby replicas (elastic autoscaling, docs/serving.md
+        # "Replica autoscaling"): pre-allocated in the window topology —
+        # their buffer slots, fold rows, and edges exist from creation,
+        # so admitting one later (ReplicaSet.admit / RequestRouter.admit)
+        # is pure host bookkeeping on the SAME compiled window programs,
+        # zero recompiles.  They fold publications like any replica
+        # (staying warm) but serve no traffic until admitted.
+        self.standby = [r for r in dict.fromkeys(standby)
+                        if r not in self.replicas]
+        overlap = set(self.standby) & set(self.publishers)
+        if overlap:
+            raise ValueError(
+                f"standby ranks {sorted(overlap)} are also publishers; "
+                f"standby replicas must be replica-side capacity")
         self.publish_every = resolve_publish_every(publish_every)
         if compression is None:
             # serving default is OFF unless BLUEFOG_SERVE_COMPRESS names a
@@ -157,11 +172,13 @@ class WeightPublisher:
                 "edges= (pairs for serving_topology), not both — edges "
                 "would be silently ignored")
         self.topo = topo if topo is not None else serving_topology(
-            self.publishers, self.replicas, size=cx.size, edges=edges)
+            self.publishers, self.replicas + self.standby, size=cx.size,
+            edges=edges)
         # a caller-supplied topo skipped serving_topology's checks: a
         # replica with no publisher in-edge would never gain a watermark
-        # and be silently unroutable forever
-        unfed = [r for r in self.replicas
+        # and be silently unroutable forever (standby included: a
+        # feedless capacity slot could never be admitted warm)
+        unfed = [r for r in self.replicas + self.standby
                  if not any(p in self.publishers
                             for p in self.topo.in_neighbor_ranks(r))]
         if unfed:

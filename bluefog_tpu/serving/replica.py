@@ -75,25 +75,75 @@ class ReplicaSet:
             raise ValueError(
                 f"max_staleness must be >= 0, got {self.max_staleness}")
         self.replicas: List[int] = list(publisher.replicas)
+        # standby capacity replicas (pre-allocated in the window
+        # topology): they FOLD like any replica — staying warm with
+        # watermarks tracked — but serve nothing until admit() moves
+        # them into the active set (docs/serving.md "Replica
+        # autoscaling")
+        self.standby: List[int] = list(getattr(publisher, "standby", ()))
         self.name = publisher.name
         n = publisher.topo.size
+        tracked = self.replicas + self.standby
         # fold weights: in-publisher rows 1/in_degree, replica self 0 —
         # the masked fold moves a dead feed's mass back to self
         U = publisher.topo.weight_matrix.copy().astype(np.float64)
         np.fill_diagonal(U, 0.0)
         sw = np.ones((n,), np.float64)
-        sw[self.replicas] = 0.0
+        sw[tracked] = 0.0
         self._U, self._sw = U, sw
         self._in_pubs: Dict[int, List[int]] = {
-            r: publisher.in_publishers(r) for r in self.replicas}
+            r: publisher.in_publishers(r) for r in tracked}
         # delivered[r][p]: the publisher-step of the newest put from p
         # that replica r has folded (None = never)
         self._delivered: Dict[int, Dict[int, Optional[int]]] = {
-            r: {p: None for p in self._in_pubs[r]} for r in self.replicas}
+            r: {p: None for p in self._in_pubs[r]} for r in tracked}
         self._watermark: Dict[int, Optional[int]] = {
-            r: None for r in self.replicas}
+            r: None for r in tracked}
         self._fetched = None
         self.last_fold_s: Optional[float] = None
+
+    # -- elastic admission (autoscaling hook) -------------------------------
+
+    def admit(self, rank: int) -> bool:
+        """Activate a pre-allocated standby replica (elastic
+        scale-up).  Its window row, fold weights, and buffer slots have
+        existed since ``win_create`` — admission is host bookkeeping on
+        the same compiled programs, zero recompiles.  A standby that
+        kept folding is warm (within the staleness bound immediately);
+        a cold one stays unroutable until fresh folds land — the
+        syncing half of the admission protocol.  Returns False when the
+        rank is already active."""
+        if rank in self.replicas:
+            return False
+        if rank not in self.standby:
+            raise ValueError(
+                f"rank {rank} is not a standby replica of window "
+                f"{self.name!r} (standby: {self.standby}) — capacity "
+                f"must be pre-allocated at WeightPublisher(standby=)")
+        self.standby.remove(rank)
+        self.replicas.append(rank)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_admissions_total",
+                "standby replicas admitted into the serving set").inc()
+        return True
+
+    def retire(self, rank: int) -> None:
+        """Orderly scale-down: move an active replica back to standby.
+        Its row keeps folding (warm for re-admission); it just stops
+        being servable."""
+        if rank not in self.replicas:
+            raise ValueError(f"rank {rank} is not an active serving "
+                             f"replica (replicas: {self.replicas})")
+        if len(self.replicas) == 1:
+            raise ValueError("cannot retire the last serving replica")
+        self.replicas.remove(rank)
+        self.standby.append(rank)
+        if _metrics.enabled():
+            _metrics.counter(
+                "bf_serve_retirements_total",
+                "replicas retired from the serving set back to standby"
+            ).inc()
 
     # -- the fold -----------------------------------------------------------
 
@@ -112,8 +162,9 @@ class ReplicaSet:
         # promote any staged (un-waited) nonblocking puts: the fold must
         # see the newest completed publication
         _win.win_flush(self.name)
+        tracked = self.replicas + self.standby
         fresh: Dict[int, List[int]] = {}
-        for r in self.replicas:
+        for r in tracked:
             vers = _win.get_win_version(self.name, r)
             fresh[r] = [p for p in self._in_pubs[r] if vers.get(p, 0) > 0]
             for p in fresh[r]:
@@ -124,7 +175,7 @@ class ReplicaSet:
                         alive=alive_row)
         self.last_fold_s = time.perf_counter() - t0
         self._fetched = None
-        for r in self.replicas:
+        for r in tracked:
             feeds = [p for p in self._in_pubs[r]
                      if alive_row is None or alive_row[p] > 0]
             if feeds:
@@ -174,7 +225,11 @@ class ReplicaSet:
         its staleness exceeds the bound — a replica never silently
         serves weights older than the contract.
         """
-        if rank not in self._watermark:
+        if rank not in self.replicas:
+            if rank in self.standby:
+                raise ValueError(
+                    f"rank {rank} is a standby replica not yet admitted "
+                    f"(call ReplicaSet.admit / RequestRouter.admit first)")
             raise ValueError(f"rank {rank} is not a serving replica "
                              f"(replicas: {self.replicas})")
         if alive is not None and np.asarray(alive).reshape(-1)[rank] <= 0:

@@ -27,9 +27,7 @@ replica, ``requests_per_s``, cumulative hit counts, fold latency), and
 --serving`` renders and ``validate_jsonl`` gates.
 """
 
-import json
 import math
-import os
 import time
 from typing import Dict, List, Optional
 
@@ -67,47 +65,12 @@ class FailoverEvent:
                 "replica_to": self.replica_to, "reason": self.reason}
 
 
-class _Trail:
-    """Append-only serving JSONL with the shared size-based rotation
-    (``BLUEFOG_METRICS_MAX_MB`` / ``BLUEFOG_METRICS_KEEP``).  The
-    ``serve_config`` head record is re-written after every rotation —
-    like the decision trail's header — so a rotated trail never orphans
-    its records from the tier's identity (replicas, bound)."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self.t0 = time.perf_counter()
-        self.max_bytes, self.keep = _export.resolve_rotation()
-        self._bytes = 0
-        self._head_line = None
-        self.f = open(path, "w")
-
-    def write(self, record: dict) -> dict:
-        record = dict(record)
-        record.setdefault("t_us",
-                          int((time.perf_counter() - self.t0) * 1e6))
-        line = json.dumps(record) + "\n"
-        if record.get("kind") == "serve_config" and self._head_line is None:
-            self._head_line = line
-        if (self.max_bytes and self._bytes
-                and self._bytes + len(line) > self.max_bytes):
-            self.f.close()
-            _export.rotate_file(self.path, self.keep)
-            self.f = open(self.path, "w")
-            self._bytes = 0
-            if self._head_line and line != self._head_line:
-                self.f.write(self._head_line)
-                self._bytes += len(self._head_line)
-        self.f.write(line)
-        self.f.flush()
-        self._bytes += len(line)
-        return record
-
-    def close(self) -> None:
-        try:
-            self.f.close()
-        except Exception:
-            pass
+def _serving_trail(path: str) -> "_export.Trail":
+    """The serving JSONL rides the shared sidecar-trail writer
+    (``observability.export.Trail``: size-based rotation, the
+    ``serve_config`` head record re-written after every rotation so a
+    rotated trail never orphans its records from the tier's identity)."""
+    return _export.Trail(path, head_kind="serve_config")
 
 
 def read_serving_trail(path: str):
@@ -157,7 +120,7 @@ class RequestRouter:
         self._requests_window = 0
         self._window_t0 = time.perf_counter()
         path = trail_path or (prefix + SERVING_SUFFIX if prefix else None)
-        self.trail = _Trail(path) if path else None
+        self.trail = _serving_trail(path) if path else None
         if self.trail:
             self.trail.write({
                 "kind": "serve_config",
@@ -171,6 +134,7 @@ class RequestRouter:
     def _resolve_cost(self, matrix) -> Dict[int, float]:
         """Replica -> one-way latency from the client rank, from a
         USABLE measured matrix only."""
+        self._matrix = None
         if matrix is None:
             return {}
         from ..observability import commprof as _cprof
@@ -182,14 +146,68 @@ class RequestRouter:
                     "edge-cost matrices the router refused to consult"
                 ).inc()
             return {}
+        # kept for replicas admitted later (elastic autoscaling): a new
+        # replica's edge must be priced from the same accepted matrix
+        self._matrix = matrix
         out = {}
         for r in self.replicas.replicas:
-            lat = matrix.latency_us(self.client_rank, r)
-            if lat is None:
-                lat = matrix.latency_us(r, self.client_rank)
+            lat = self._edge_cost(matrix, r)
             if lat is not None:
-                out[r] = float(lat)
+                out[r] = lat
         return out
+
+    def _edge_cost(self, matrix, rank: int) -> Optional[float]:
+        lat = matrix.latency_us(self.client_rank, rank)
+        if lat is None:
+            lat = matrix.latency_us(rank, self.client_rank)
+        return None if lat is None else float(lat)
+
+    # -- elastic admission (autoscaling hook) -------------------------------
+
+    def admit(self, rank: int, step: int) -> None:
+        """Admit a freshly-joined replica into the routing set — the
+        serving tier's elastic-membership hook (docs/serving.md
+        "Replica autoscaling").  Activates the standby rank on the
+        :class:`~.replica.ReplicaSet` (pre-allocated window slots: zero
+        recompiles), registers it with the router's liveness beliefs,
+        hit counters, and measured edge costs, and records a
+        ``serve_admit`` trail event.  The new replica joins the
+        candidate order immediately; it WINS traffic only once its
+        folded staleness enters the bound — the syncing → active half
+        of the admission protocol happens in the folds."""
+        if rank in self.replicas.standby:
+            self.replicas.admit(rank)
+        elif rank not in self.replicas.replicas:
+            raise ValueError(
+                f"rank {rank} is neither active nor standby on this "
+                f"ReplicaSet (replicas {self.replicas.replicas}, "
+                f"standby {self.replicas.standby})")
+        self.hits.setdefault(rank, 0)
+        # an admission is a liveness observation FOR THIS RANK only: it
+        # must not advance the global observation clock (_last_obs), or
+        # admitting capacity would age every replica nobody explicitly
+        # feeds liveness data for into confirmed-dead — the router stays
+        # optimistic about unobserved ranks by design (see __init__)
+        self._last_ok[rank] = max(float(step), self._last_obs)
+        if self._matrix is not None and rank not in self._cost:
+            lat = self._edge_cost(self._matrix, rank)
+            if lat is not None:
+                self._cost[rank] = lat
+        if self.trail:
+            self.trail.write({"kind": "serve_admit", "step": int(step),
+                              "replica": int(rank)})
+
+    def retire(self, rank: int, step: int) -> None:
+        """Orderly scale-down: move ``rank`` back to standby and out of
+        the candidate set, recording a ``serve_retire`` trail event.
+        Unlike a death there is no failover noise — the next ``route``
+        simply re-picks among the remaining replicas."""
+        self.replicas.retire(rank)
+        if self.current == rank:
+            self.current = None
+        if self.trail:
+            self.trail.write({"kind": "serve_retire", "step": int(step),
+                              "replica": int(rank)})
 
     # -- liveness beliefs ---------------------------------------------------
 

@@ -54,6 +54,17 @@ replica again; (C) a chaos-killed SERVING rank (fault plan
 ``dead``) with zero failed requests — every request is answered by the
 survivor — asserted through the real ``bfmonitor`` subprocess.
 
+``--elastic`` (``make elastic-smoke``) adds the elastic-membership gate
+(docs/resilience.md "Elastic membership"): (A) a scale-up chaos plan
+must admit a capacity rank mid-run — announced → syncing → active with
+EXACTLY one admission event, the regenerated mixing matrix passing the
+repair stochasticity invariants at every step, consensus re-contracting
+after the admission, and the membership trail landing schema-valid and
+rendered by the real ``bfmonitor --once --json`` ``"membership"``
+block; (B) a scale-down plan mirrors it with exactly one departure;
+(C) the whole episode — plus a churn plan swapped onto the SAME harness
+— reuses one compiled step program (zero recompiles after warmup).
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -352,6 +363,105 @@ def control_legs(n, tmp):
     }
 
 
+ELASTIC_STEPS, ELASTIC_JOIN, ELASTIC_SYNC = 36, 12, 2
+
+
+def elastic_legs(n, tmp):
+    """The ``make elastic-smoke`` gate: scale-up admits a capacity rank
+    (one admission event, invariants at every step, consensus
+    re-contracts, trail + bfmonitor round-trip), scale-down mirrors it,
+    and the episode runs on one compiled step program."""
+    from bluefog_tpu.observability import metrics as MET
+    from bluefog_tpu.resilience import (ChaosHarness, LivenessConfig,
+                                        churn_plan, empty_plan,
+                                        scale_down_plan, scale_up_plan)
+
+    MET.enable()
+    joiner = n - 1
+    rng = np.random.default_rng(2)
+    p0 = rng.normal(size=(n, 4)).astype(np.float32)
+
+    # -- leg A: scale-up — a capacity rank arrives mid-run --------------
+    up_prefix = os.path.join(tmp, "elastic_up_")
+    plan = scale_up_plan(n, ELASTIC_STEPS, {joiner: ELASTIC_JOIN},
+                         sync_steps=ELASTIC_SYNC)
+    h = ChaosHarness(plan, cfg=LivenessConfig(2, 4))
+    rep = h.run(p0, steps=ELASTIC_STEPS, membership_trail=up_prefix)
+    if rep.admitted != [joiner]:
+        fail(f"scale-up admitted {rep.admitted}, expected exactly "
+             f"[{joiner}]")
+    admissions = [t for t, r, s in rep.membership_transitions
+                  if s == "active"]
+    if len(admissions) != 1:
+        fail(f"expected exactly one admission event, got "
+             f"{rep.membership_transitions}")
+    for t in range(ELASTIC_STEPS):
+        try:
+            rep.check_matrix_invariants(step=t)
+        except AssertionError as e:
+            fail(f"matrix invariant violated at step {t}: {e}")
+    if not np.isfinite(rep.consensus_errors).all():
+        fail(f"scale-up consensus went non-finite: {rep.consensus_errors}")
+    post = rep.consensus_errors[admissions[0]:]
+    if not post[-1] < post[0]:
+        fail(f"consensus did not re-contract after the admission: "
+             f"{post[0]} -> {post[-1]}")
+
+    # replay the consensus series into a main JSONL so the real
+    # bfmonitor renders fleet + membership together
+    EX.metrics_start(up_prefix, rank=0)
+    for t in range(ELASTIC_STEPS):
+        EX.log_step(t, extra={
+            "consensus_dist": float(rep.consensus_errors[t])})
+    EX.metrics_end()
+    trail = up_prefix + EX.MEMBERSHIP_SUFFIX
+    try:
+        EX.validate_jsonl(trail)
+    except ValueError as e:
+        fail(f"membership trail schema violation: {e}")
+    _, out = bfmonitor_json(up_prefix)
+    block = out.get("membership")
+    if not block or block.get("active") != n:
+        fail(f"bfmonitor membership block wrong after scale-up: {block}")
+    if block["events"]["total"] < 3:       # announced, syncing, active
+        fail(f"bfmonitor missed membership transitions: {block['events']}")
+
+    # -- leg B: scale-down mirrors it -----------------------------------
+    down_prefix = os.path.join(tmp, "elastic_down_")
+    h.plan = scale_down_plan(n, ELASTIC_STEPS, {joiner: ELASTIC_JOIN})
+    rep2 = h.run(p0, steps=ELASTIC_STEPS, membership_trail=down_prefix)
+    if rep2.departed != [joiner] or rep2.admitted:
+        fail(f"scale-down saw departures {rep2.departed} / admissions "
+             f"{rep2.admitted}, expected exactly one departure of "
+             f"{joiner}")
+    for t in range(ELASTIC_STEPS):
+        try:
+            rep2.check_matrix_invariants(step=t)
+        except AssertionError as e:
+            fail(f"scale-down invariant violated at step {t}: {e}")
+
+    # -- leg C: churn on the SAME harness, zero recompiles --------------
+    h.plan = churn_plan(n, ELASTIC_STEPS,
+                        [(joiner, 8, 26)], sync_steps=ELASTIC_SYNC)
+    h.run(p0, steps=ELASTIC_STEPS)
+    h.plan = empty_plan(n, ELASTIC_STEPS)
+    h.run(p0, steps=4)
+    builds = h._step_fn._cache_size()
+    if builds != 1:
+        fail(f"elastic episode recompiled the chaos step: cache size "
+             f"{builds} (expected the single warmup build)")
+
+    return {
+        "joiner": joiner,
+        "transitions": [[t, r, s]
+                        for t, r, s in rep.membership_transitions],
+        "consensus_at_admission": round(float(post[0]), 6),
+        "consensus_final": round(float(post[-1]), 6),
+        "departure_step": int(rep2.membership_transitions[-1][0]),
+        "episode_builds": builds,
+    }
+
+
 SERVE_STEPS, SERVE_REQS, SERVE_BOUND = 14, 4, 3
 
 
@@ -642,6 +752,7 @@ def main():
     do_profile = "--profile" in sys.argv
     do_control = "--control" in sys.argv
     do_serve = "--serve" in sys.argv
+    do_elastic = "--elastic" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -731,6 +842,12 @@ def main():
         EX.metrics_end()           # release the sink for the tier legs
         serve_out = serve_legs(n, tmp)
 
+    # -- elastic-membership gate (--elastic / make elastic-smoke) -------
+    elastic_out = None
+    if do_elastic:
+        EX.metrics_end()           # release the sink for the chaos legs
+        elastic_out = elastic_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -765,6 +882,8 @@ def main():
         out["control"] = control_out
     if serve_out:
         out["serve"] = serve_out
+    if elastic_out:
+        out["elastic"] = elastic_out
     print(json.dumps(out))
 
 

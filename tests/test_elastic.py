@@ -1,0 +1,649 @@
+"""Elastic membership: ranks join and leave at runtime, zero recompiles.
+
+Covers the full admission stack (docs/resilience.md "Elastic
+membership"): rank_join/rank_leave fault-table lowering with the
+syncing window, per-instance device-table caching, churn random plans,
+the grow direction of the repair invariants, the ElasticMembership
+state machine over the liveness gossip, joiner parameter bootstrap over
+the window subsystem, chaos episodes that admit and remove a capacity
+rank mid-run (matrix invariants at every step, one compiled step
+program across plan swaps), StableHLO byte identity of the train step
+with the elastic machinery live, the serving tier's standby-replica
+autoscaling hook, and the membership JSONL trail + bfmonitor panel.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bluefog_tpu as bf
+from bluefog_tpu.parallel import topology as T
+from bluefog_tpu.resilience import (
+    ChaosHarness, ElasticMembership, FaultPlan, LivenessConfig,
+    bootstrap_join, churn_plan, empty_plan, fallback_ring_matrix,
+    random_plan, repair_matrix, scale_down_plan, scale_up_plan,
+    spectral_gap,
+)
+from bluefog_tpu.resilience import membership as M
+from bluefog_tpu.observability import export as EX
+
+N = 8
+
+
+# ---------------------------------------------------------------------------
+# Fault-table lowering of join/leave
+# ---------------------------------------------------------------------------
+
+def test_rank_join_lowering_semantics():
+    c = FaultPlan(N, 20).rank_join(7, at=6, sync_steps=2).compile()
+    # dead before the join step
+    assert c.alive[:6, 7].sum() == 0 and c.alive[6:, 7].all()
+    # syncing window: alive, heartbeating, zero mixing weight
+    assert c.sync[6, 7] == 1 and c.sync[7, 7] == 1 and c.sync[8, 7] == 0
+    assert c.active[:8, 7].sum() == 0 and c.active[8:, 7].all()
+    assert c.capacity_ranks == (7,)
+    np.testing.assert_array_equal(c.sync_at(7), c.sync[7])
+    # other ranks untouched
+    assert c.alive[:, :7].all() and c.active[:, :7].all()
+    assert c.sync[:, :7].sum() == 0
+
+
+def test_rank_join_bounded_engagement_and_leave():
+    c = (FaultPlan(N, 30)
+         .rank_join(6, at=5, sync_steps=1, until=20)
+         .rank_leave(2, at=10)
+         .compile())
+    # bounded engagement: joins, serves, leaves again
+    assert c.alive[4, 6] == 0 and c.alive[5, 6] == 1 and c.alive[20, 6] == 0
+    assert c.sync[5, 6] == 1 and c.active[6, 6] == 1
+    # orderly leave lowers like rank_down but keeps its own event kind
+    assert c.alive[9, 2] == 1 and c.alive[10:, 2].sum() == 0
+    kinds = {ev.kind for ev in c.events}
+    assert kinds == {"rank_join", "rank_leave"}
+
+
+def test_rank_join_at_horizon_reserves_slot():
+    c = FaultPlan(N, 12).rank_join(7, at=12).compile()
+    assert c.alive[:, 7].sum() == 0 and c.active[:, 7].sum() == 0
+    assert c.capacity_ranks == (7,)
+
+
+def test_join_validation():
+    with pytest.raises(ValueError):
+        FaultPlan(N, 10).rank_join(N, at=0)
+    with pytest.raises(ValueError):
+        FaultPlan(N, 10).rank_join(0, at=-1)
+    with pytest.raises(ValueError):
+        FaultPlan(N, 10).rank_join(0, at=2, sync_steps=-1)
+    with pytest.raises(ValueError):
+        churn_plan(N, 10, [(7, 5, 5)])
+
+
+def test_tables_cached_per_plan_instance():
+    c = FaultPlan(N, 10).rank_down(2, at=3).compile()
+    t1 = c.tables()
+    t2 = c.tables()
+    assert t1 is t2                       # no per-call device re-upload
+    assert t1["alive"] is t2["alive"]
+    assert set(t1) == {"alive", "active", "link_ok", "corrupt", "sync"}
+    # distinct plans keep distinct uploads
+    assert empty_plan(N, 10).tables() is not t1
+
+
+def test_random_plan_churn_params():
+    a = random_plan(N, 30, seed=5, p_join=1.0, capacity=2, compiled=True)
+    b = random_plan(N, 30, seed=5, p_join=1.0, capacity=2, compiled=True)
+    np.testing.assert_array_equal(a.alive, b.alive)
+    np.testing.assert_array_equal(a.sync, b.sync)
+    assert set(a.capacity_ranks) == {6, 7}
+    # capacity ranks start dead and join in the first half
+    assert a.alive[0, 6] == 0 and a.alive[0, 7] == 0
+    joins = [ev for ev in a.events if ev.kind == "rank_join"]
+    assert all(ev.step < 30 for ev in joins)
+    # base faults never land on capacity ranks
+    assert all(ev.rank < 6 for ev in a.events
+               if ev.kind in ("rank_down", "straggler", "corrupt"))
+    # table invariants: sync implies alive and not active
+    assert (a.sync * a.active).sum() == 0
+    assert (a.sync <= a.alive).all()
+    # compiled= knob fixes the empty_plan/random_plan asymmetry
+    assert isinstance(random_plan(N, 30, capacity=1), FaultPlan)
+
+
+def test_scale_plan_builders():
+    up = scale_up_plan(N, 20, {7: 6}, sync_steps=2).compile()
+    assert up.alive[5, 7] == 0 and up.sync[6, 7] == 1 and up.active[8, 7] == 1
+    down = scale_down_plan(N, 20, {3: 9}).compile()
+    assert down.alive[8, 3] == 1 and down.alive[9:, 3].sum() == 0
+    ch = churn_plan(N, 20, [(7, 4, 15)], sync_steps=1).compile()
+    assert ch.alive[3, 7] == 0 and ch.active[5, 7] == 1
+    assert ch.alive[15:, 7].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# Repair invariants in the grow direction
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,graph", [
+    ("exp2", lambda: T.ExponentialTwoGraph(N)),
+    ("mesh2d", lambda: T.MeshGrid2DGraph(N)),
+    ("ring", lambda: T.RingGraph(N)),
+])
+def test_repair_grow_direction_invariants(name, graph):
+    """Admission is repair with a larger alive mask: the capacity rank's
+    pre-allocated edges re-enter and every transition state passes the
+    stochasticity/gap invariants."""
+    W = T.mixing_matrix(graph())
+    alive = np.ones(N, bool)
+    alive[7] = False                       # capacity rank not yet joined
+    R_small = repair_matrix(W, alive)
+    np.testing.assert_allclose(R_small.sum(axis=0), 1.0, atol=1e-12)
+    assert spectral_gap(R_small, alive) > 1e-6
+    # grow: the join step re-runs repair with the full mask
+    R_grown = repair_matrix(W, np.ones(N, bool))
+    np.testing.assert_allclose(R_grown, W)  # full fleet = healthy matrix
+    np.testing.assert_allclose(R_grown.sum(axis=0), 1.0, atol=1e-12)
+    assert spectral_gap(R_grown) > 1e-6
+    # the grown matrix re-opens edges the shrunken one had severed
+    assert (np.abs(R_grown[:, 7]) > 0).sum() > 1
+    assert np.allclose(np.delete(R_small[:, 7], 7), 0.0)
+
+
+def test_fallback_ring_regrows_to_original_family():
+    W = T.mixing_matrix(T.StarGraph(N, center_rank=0))
+    alive = np.asarray([0] + [1] * (N - 1), bool)
+    R = repair_matrix(W, alive)            # center dead -> fallback ring
+    np.testing.assert_array_equal(R, fallback_ring_matrix(N, alive))
+    # the center rejoining regrows the star outright
+    np.testing.assert_allclose(repair_matrix(W, np.ones(N, bool)), W)
+
+
+# ---------------------------------------------------------------------------
+# The join state machine
+# ---------------------------------------------------------------------------
+
+def _fresh_lh(step, joiner=None, joiner_heard_at=0):
+    lh = np.full((N, N), step, int)
+    if joiner is not None:
+        lh[:, joiner] = joiner_heard_at
+        lh[joiner, :] = joiner_heard_at
+    return lh
+
+
+def test_membership_state_machine_full_episode():
+    d = ElasticMembership(N, capacity=[7], cfg=LivenessConfig(2, 4))
+    assert d.state_of(7) == M.STATE_INACTIVE
+    assert d.state_of(0) == M.STATE_ACTIVE
+    assert d.counts()[M.STATE_ACTIVE] == N - 1
+
+    # announced, but nobody heard it yet
+    d.announce(7, 10)
+    assert d.observe(_fresh_lh(10, joiner=7), 10) == []
+    assert d.state_of(7) == M.STATE_ANNOUNCED
+    # quorum heard the heartbeats -> syncing
+    trs = d.observe(_fresh_lh(11, joiner=7, joiner_heard_at=11), 11)
+    assert [t[2] for t in trs] == [M.STATE_SYNCING]
+    # bootstrap completion + quorum -> active
+    d.mark_synced(7)
+    trs = d.observe(_fresh_lh(12, joiner=7, joiner_heard_at=12), 12)
+    assert [t[2] for t in trs] == [M.STATE_ACTIVE]
+    assert d.active_mask()[7] == 1 and d.degraded(7) is False
+    # silence past confirm_after -> failure-as-departure
+    trs = d.observe(_fresh_lh(30, joiner=7, joiner_heard_at=12), 30)
+    assert [(t[1], t[2]) for t in trs] == [(7, M.STATE_LEFT)]
+    assert [t[2] for t in d.transitions] == [
+        M.STATE_ANNOUNCED, M.STATE_SYNCING, M.STATE_ACTIVE, M.STATE_LEFT]
+
+
+def test_membership_masks_and_orderly_leave():
+    d = ElasticMembership(N, capacity=[6, 7])
+    assert d.alive_mask().tolist() == [1, 1, 1, 1, 1, 1, 0, 0]
+    d.announce(6, 3)
+    # announced ranks are alive (heartbeating) but degraded (no mixing)
+    assert d.alive_mask()[6] == 1 and d.active_mask()[6] == 0
+    assert d.degraded(6) is True
+    d.leave(2, 5)
+    assert d.state_of(2) == M.STATE_LEFT
+    assert d.active_mask()[2] == 0
+    # no-ops: leaving the departed, announcing the active
+    assert d.leave(2, 6) is None
+    assert d.announce(0, 6) is None
+
+
+def test_membership_joiner_dying_mid_admission_departs():
+    """A joiner that goes silent while announced/syncing must depart
+    (after the confirm_after grace) instead of reporting as syncing
+    forever with its alive-mask bit stuck on."""
+    d = ElasticMembership(N, capacity=[7], cfg=LivenessConfig(2, 4))
+    d.announce(7, 8)
+    # heard once at step 8, then silence (it died right after joining)
+    lh = _fresh_lh(8, joiner=7, joiner_heard_at=8)
+    trs = d.observe(lh, 8)
+    assert [t[2] for t in trs] == [M.STATE_SYNCING]
+    # within the grace window it stays syncing...
+    assert d.observe(_fresh_lh(11, joiner=7, joiner_heard_at=8), 11) == []
+    # ...then departs once silent past confirm_after
+    trs = d.observe(_fresh_lh(13, joiner=7, joiner_heard_at=8), 13)
+    assert [(t[1], t[2]) for t in trs] == [(7, M.STATE_LEFT)]
+    assert d.alive_mask()[7] == 0
+
+
+def test_membership_announced_never_heard_gets_grace_then_departs():
+    d = ElasticMembership(N, capacity=[7], cfg=LivenessConfig(2, 4))
+    d.announce(7, 10)
+    lh = _fresh_lh(10, joiner=7, joiner_heard_at=0)
+    # not instantly departed: the announcement starts the grace window
+    assert d.observe(lh, 10) == []
+    assert d.observe(_fresh_lh(14, joiner=7, joiner_heard_at=0), 14) == []
+    trs = d.observe(_fresh_lh(15, joiner=7, joiner_heard_at=0), 15)
+    assert [(t[1], t[2]) for t in trs] == [(7, M.STATE_LEFT)]
+
+
+def test_membership_validation():
+    with pytest.raises(ValueError):
+        ElasticMembership(N, capacity=[N])
+    d = ElasticMembership(N)
+    with pytest.raises(ValueError):
+        d.observe(np.zeros((N + 1, N + 1)), 0)
+
+
+# ---------------------------------------------------------------------------
+# Window-subsystem parameter bootstrap
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_win_bootstrap_rank_adopts_live_neighbor_average(bf_ctx):
+    x = {"w": jnp.arange(float(N)).reshape(N, 1) + 1.0,
+         "b": jnp.arange(float(N)).reshape(N, 1) * 10.0}
+    assert bf.win_create(x, "elastic.boot")
+    try:
+        topo = bf_ctx.compiled_topology
+        joiner = 7
+        srcs = topo.in_neighbor_ranks(joiner)
+        alive = np.ones(N)
+        alive[srcs[0]] = 0.0               # one dead feed drops out
+        live = [s for s in srcs if alive[s] > 0]
+        out = bf.win_bootstrap_rank("elastic.boot", joiner, alive=alive)
+        for key in ("w", "b"):
+            want = np.mean([np.asarray(x[key])[s] for s in live], axis=0)
+            np.testing.assert_allclose(np.asarray(out[key])[joiner], want,
+                                       rtol=1e-6)
+            # nobody else moved
+            others = [r for r in range(N) if r != joiner]
+            np.testing.assert_allclose(
+                np.asarray(out[key])[others], np.asarray(x[key])[others],
+                rtol=1e-6)
+    finally:
+        bf.win_free()
+
+
+@pytest.mark.chaos
+def test_bootstrap_join_converges_and_stops_early(bf_ctx):
+    x = jnp.arange(float(N)).reshape(N, 1)
+    assert bf.win_create(x, "elastic.boot2")
+    try:
+        out, used = bootstrap_join("elastic.boot2", 7, folds=4)
+        # static neighbor values: one fold reaches the average, the
+        # second detects convergence, the rest are skipped
+        assert used == 2
+        srcs = bf_ctx.compiled_topology.in_neighbor_ranks(7)
+        want = np.mean([float(s) for s in srcs])
+        np.testing.assert_allclose(float(np.asarray(out)[7, 0]), want,
+                                   rtol=1e-6)
+    finally:
+        bf.win_free()
+
+
+@pytest.mark.chaos
+def test_win_bootstrap_rank_no_live_feed_keeps_value(bf_ctx):
+    x = jnp.arange(float(N)).reshape(N, 1)
+    assert bf.win_create(x, "elastic.boot3")
+    try:
+        out = bf.win_bootstrap_rank("elastic.boot3", 7,
+                                    alive=np.zeros(N))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    finally:
+        bf.win_free()
+
+
+def test_bootstrap_knob_resolvers(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_ELASTIC_BOOTSTRAP_FOLDS", "5")
+    monkeypatch.setenv("BLUEFOG_ELASTIC_BOOTSTRAP_TOL", "0.25")
+    monkeypatch.setenv("BLUEFOG_ELASTIC_SYNC_STEPS", "3")
+    assert M.resolve_bootstrap_folds() == 5
+    assert M.resolve_bootstrap_tol() == 0.25
+    assert M.resolve_sync_steps() == 3
+    assert M.resolve_bootstrap_folds(2) == 2
+    with pytest.raises(ValueError):
+        M.resolve_bootstrap_folds(0)
+    with pytest.raises(ValueError):
+        M.resolve_sync_steps(-1)
+
+
+# ---------------------------------------------------------------------------
+# Chaos episodes: admit and remove a capacity rank mid-run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_scale_up_admits_capacity_rank(bf_ctx):
+    """A capacity rank joins mid-run: announced -> syncing (window
+    bootstrap via the traced catch-up fold) -> active mixing; the
+    effective matrix passes the stochasticity invariants at EVERY step
+    and consensus stays bounded through the admission."""
+    joiner, join_at, sync = 7, 12, 2
+    plan = scale_up_plan(N, 40, {joiner: join_at}, sync_steps=sync)
+    h = ChaosHarness(plan, cfg=LivenessConfig(2, 4))
+    rng = np.random.default_rng(0)
+    rep = h.run(rng.normal(size=(N, 4)).astype(np.float32), steps=40)
+    # exactly one admission, for the joiner
+    assert rep.admitted == [joiner]
+    states = [s for _, r, s in rep.membership_transitions if r == joiner]
+    assert states[:1] == [M.STATE_ANNOUNCED]
+    assert states.index(M.STATE_SYNCING) < states.index(M.STATE_ACTIVE)
+    # invariants at every step, including the syncing-window ones
+    for t in range(40):
+        rep.check_matrix_invariants(step=t)
+    # while syncing the joiner received (catch-up) but contributed 0
+    W_sync = rep.mixing_matrices[join_at]
+    assert np.delete(W_sync[joiner, :], joiner).sum() == 0
+    assert np.delete(W_sync[:, joiner], joiner).sum() > 0
+    # after activation its edges carry weight again
+    W_act = rep.mixing_matrices[-1]
+    assert np.delete(W_act[joiner, :], joiner).sum() > 0
+    rep.assert_bounded(max_consensus_error=4.0)
+    # the bootstrapped joiner lands near the fleet: full-fleet consensus
+    # error right after admission is finite and small vs the initial spread
+    post = rep.consensus_errors[join_at + sync:]
+    assert np.isfinite(post).all()
+    assert post[-1] <= rep.consensus_errors[0]
+
+
+@pytest.mark.chaos
+def test_chaos_scale_down_departs_cleanly(bf_ctx):
+    plan = scale_down_plan(N, 30, {5: 10})
+    h = ChaosHarness(plan, cfg=LivenessConfig(2, 4))
+    rep = h.run(np.zeros((N, 4), np.float32), steps=30)
+    assert rep.departed == [5]
+    assert rep.admitted == []
+    for t in range(30):
+        rep.check_matrix_invariants(step=t)
+    rep.assert_bounded(max_consensus_error=2.0)
+
+
+@pytest.mark.chaos
+def test_chaos_churn_join_then_leave(bf_ctx):
+    """Full churn episode: join -> sync -> active -> leave in one run,
+    transitions observed in order, invariants at every step."""
+    plan = churn_plan(N, 40, [(7, 8, 25)], sync_steps=2)
+    h = ChaosHarness(plan, cfg=LivenessConfig(2, 4))
+    rep = h.run(np.zeros((N, 4), np.float32), steps=40)
+    states = [s for _, r, s in rep.membership_transitions if r == 7]
+    assert states == [M.STATE_ANNOUNCED, M.STATE_SYNCING,
+                      M.STATE_ACTIVE, M.STATE_LEFT]
+    for t in range(40):
+        rep.check_matrix_invariants(step=t)
+    rep.assert_bounded(max_consensus_error=2.0)
+
+
+@pytest.mark.chaos
+def test_elastic_episode_zero_recompiles(bf_ctx):
+    """Acceptance: a full join -> sync -> active -> leave episode reuses
+    ONE compiled step program — admission and departure are traced data,
+    and swapping churn plans never rebuilds."""
+    h = ChaosHarness(empty_plan(N, 12))
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    assert h._step_fn._cache_size() == 1
+    h.plan = churn_plan(N, 12, [(7, 2, 9)], sync_steps=2)   # churn episode
+    h.run(np.zeros((N, 3), np.float32), steps=12)
+    h.plan = scale_up_plan(N, 12, {6: 4})                   # different joiner
+    h.run(np.zeros((N, 3), np.float32), steps=6)
+    h.plan = empty_plan(N, 12)                              # clear
+    h.run(np.zeros((N, 3), np.float32), steps=3)
+    assert h._step_fn._cache_size() == 1
+
+
+@pytest.mark.chaos
+def test_membership_trail_written_by_harness(bf_ctx, tmp_path):
+    prefix = str(tmp_path / "mem_")
+    plan = scale_up_plan(N, 24, {7: 8}, sync_steps=2)
+    h = ChaosHarness(plan, cfg=LivenessConfig(2, 4))
+    h.run(np.zeros((N, 4), np.float32), steps=24,
+          membership_trail=prefix)
+    path = prefix + EX.MEMBERSHIP_SUFFIX
+    records = EX.validate_jsonl(path)
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "membership_config"
+    config, recs = EX.read_membership_trail(path)
+    assert config["capacity"] == [7]
+    events = [r for r in recs if r["kind"] == "membership_event"]
+    assert [e["transition"] for e in events if e["rank"] == 7] == [
+        M.STATE_ANNOUNCED, M.STATE_SYNCING, M.STATE_ACTIVE]
+    # one periodic state record per step
+    states = [r for r in recs if r["kind"] == "membership"]
+    assert len(states) == 24
+    assert states[-1]["active"] == N
+
+
+# ---------------------------------------------------------------------------
+# Off-switchable standard: byte-identical train step
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_train_step_hlo_identical_with_elastic_machinery_live(bf_ctx,
+                                                              tmp_path):
+    """The elastic protocol is host-side bookkeeping + its own window
+    programs: with a directory observing, a bootstrap window folding,
+    and a membership trail open, the TRAINING step's lowered StableHLO
+    must stay byte-identical (the repo's off-switchable standard)."""
+    import optax
+    from bluefog_tpu import training as TR
+    from bluefog_tpu.models.mlp import MLP
+    from bluefog_tpu.utils import trace_metrics as TM
+
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = TR.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    x = jnp.zeros((N, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((N, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    mk = lambda: TR.make_train_step(model, base, donate=False)
+
+    text_off, _ = TM.lower_text(mk(), *args)
+
+    directory = ElasticMembership(N, capacity=[7])
+    directory.announce(7, 0)
+    trail = EX.MembershipTrail(str(tmp_path / "t.jsonl"), size=N,
+                               capacity=[7])
+    trail.write_event(0, 7, M.STATE_ANNOUNCED)
+    w = jnp.zeros((N, 4), jnp.float32)
+    assert bf.win_create(w, "elastic.hlo")
+    try:
+        bf.win_bootstrap_rank("elastic.hlo", 7)
+        text_on, _ = TM.lower_text(mk(), *args)
+    finally:
+        bf.win_free()
+        trail.close()
+    assert text_on == text_off
+
+
+# ---------------------------------------------------------------------------
+# Serving autoscaling hook
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_serving_standby_admission_through_protocol(bf_ctx, tmp_path):
+    """A standby replica is pre-allocated (window slots exist, its row
+    folds and stays warm), unservable until admitted, and — once
+    admitted through the router — takes traffic when the sticky target
+    dies, with a serve_admit record in the trail and zero new window
+    compiles."""
+    from bluefog_tpu.ops import windows as W
+    from bluefog_tpu.serving import (ReplicaSet, RequestRouter,
+                                     WeightPublisher, read_serving_trail)
+    n = N
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32)}
+    pub = WeightPublisher(params, [0, 1], [5], standby=[7],
+                          name="bf_el_admit")
+    rs = ReplicaSet(pub, lambda p, b: b @ p["w"], max_staleness=3)
+    prefix = str(tmp_path / "adm_")
+    router = RequestRouter(rs, prefix=prefix)
+    x = jnp.ones((2, 4), jnp.float32)
+    try:
+        pub.publish(params, 0)
+        rs.refresh(0)
+        out, r = router.route(x, 0)
+        assert r == 5
+        # standby: folding (warm watermark) but not servable
+        assert rs.staleness_of(7, 0) == 0.0
+        with pytest.raises(ValueError, match="standby"):
+            rs.serve(7, x, 0)
+        push0 = W._push_fn.cache_info().misses
+        upd0 = W._update_fn.cache_info().misses
+        router.admit(7, 1)
+        assert 7 in rs.replicas and 7 not in rs.standby
+        assert rs.can_serve(7, 1)          # warm standby: instantly in-bound
+        # sticky target dies -> failover lands on the admitted replica
+        alive = np.ones(n)
+        alive[5] = 0.0
+        out, r = router.route(x, 1, alive=alive)
+        assert r == 7
+        assert [f.reason for f in router.failovers] == ["dead"]
+        # admission was pure bookkeeping on the precompiled programs
+        pub.publish(params, 2, alive=alive)
+        rs.refresh(2, alive=alive)
+        assert W._push_fn.cache_info().misses == push0
+        assert W._update_fn.cache_info().misses == upd0
+        # orderly scale-down
+        router.retire(7, 3)
+        assert 7 in rs.standby
+        with pytest.raises(Exception):
+            router.route(x, 3, alive=alive)   # nobody left to serve
+    finally:
+        router.close()
+        rs.close()
+    cfg, recs = read_serving_trail(prefix + "serving.jsonl")
+    kinds = [rec["kind"] for rec in recs]
+    assert "serve_admit" in kinds and "serve_retire" in kinds
+    admit = next(rec for rec in recs if rec["kind"] == "serve_admit")
+    assert admit["replica"] == 7 and admit["step"] == 1
+    EX.validate_jsonl(prefix + "serving.jsonl")
+
+
+@pytest.mark.chaos
+def test_router_admit_does_not_age_unobserved_replicas(bf_ctx):
+    """admit() is a liveness observation for the NEW rank only: on a
+    router nobody feeds alive= data (deliberately optimistic), admitting
+    capacity at a late step must not confirm the existing replicas dead."""
+    from bluefog_tpu.serving import (ReplicaSet, RequestRouter,
+                                     WeightPublisher)
+    params = {"w": jnp.zeros((N, 4, 3), jnp.float32)}
+    pub = WeightPublisher(params, [0, 1], [5], standby=[7],
+                          name="bf_el_age")
+    rs = ReplicaSet(pub, lambda p, b: b @ p["w"], max_staleness=4)
+    router = RequestRouter(rs)
+    x = jnp.ones((1, 4), jnp.float32)
+    try:
+        pub.publish(params, 0)
+        rs.refresh(0)
+        router.admit(7, 500)
+        assert not router.confirmed_dead(5, 500)
+        out, r = router.route(x, 1)
+        assert r in (5, 7) and not router.refused
+    finally:
+        rs.close()
+
+
+@pytest.mark.chaos
+def test_serving_standby_validation(bf_ctx):
+    from bluefog_tpu.serving import ReplicaSet, WeightPublisher
+    params = {"w": jnp.zeros((N, 2), jnp.float32)}
+    with pytest.raises(ValueError, match="standby"):
+        WeightPublisher(params, [0, 1], [5], standby=[1],
+                        name="bf_el_bad")
+    pub = WeightPublisher(params, [0, 1], [5], standby=[7],
+                          name="bf_el_ok")
+    rs = ReplicaSet(pub, lambda p, b: b)
+    try:
+        with pytest.raises(ValueError):
+            rs.admit(3)                     # never pre-allocated
+        assert rs.admit(5) is False         # already active
+        rs.admit(7)
+        with pytest.raises(ValueError):
+            rs.retire(3)
+        rs.retire(7)
+        with pytest.raises(ValueError, match="last"):
+            rs.retire(5)
+    finally:
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# Trail schema + monitor panel
+# ---------------------------------------------------------------------------
+
+def test_membership_trail_schema_negative(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"kind": "membership_event", "step": 1, "t_us": 2, "rank": 7}
+    ) + "\n")
+    with pytest.raises(ValueError, match="transition"):
+        EX.validate_jsonl(str(bad))
+    bad.write_text(json.dumps(
+        {"kind": "membership", "step": 1, "t_us": 2, "active": 3,
+         "syncing": 0, "states": {"7": 1}}) + "\n")
+    with pytest.raises(ValueError, match="states"):
+        EX.validate_jsonl(str(bad))
+    bad.write_text(json.dumps(
+        {"kind": "serve_admit", "step": 1, "t_us": 2,
+         "replica": "seven"}) + "\n")
+    with pytest.raises(ValueError, match="replica"):
+        EX.validate_jsonl(str(bad))
+    # unknown fields stay tolerated (forward compatibility)
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps(
+        {"kind": "membership_event", "step": 1, "t_us": 2, "rank": 7,
+         "transition": "active", "novel_field": 1}) + "\n")
+    assert len(EX.validate_jsonl(str(ok))) == 1
+
+
+def test_monitor_membership_block_and_panel(tmp_path):
+    from bluefog_tpu.run import monitor as MON
+    prefix = str(tmp_path / "mon_")
+    trail = EX.MembershipTrail(prefix + EX.MEMBERSHIP_SUFFIX, size=N,
+                               capacity=[7])
+    states = {r: ("inactive" if r == 7 else "active") for r in range(N)}
+    trail.write_state(0, states, {"active": 7, "syncing": 0})
+    trail.write_event(3, 7, "announced")
+    states[7] = "syncing"
+    trail.write_state(3, states, {"active": 7, "syncing": 1})
+    trail.close()
+    _, _, out = MON.build_report(prefix)
+    blk = out["membership"]
+    assert blk["size"] == N and blk["capacity"] == [7]
+    assert blk["active"] == 7 and blk["syncing"] == 1
+    assert blk["events"]["total"] == 1
+    panel = MON.render_membership(blk)
+    assert "syncing" in panel and "7 -> announced" in panel
+    # a prefix with no trail stays noise-free
+    _, _, out2 = MON.build_report(str(tmp_path / "none_"))
+    assert out2["membership"] is None
+
+
+def test_trail_rotation_rewrites_membership_head(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_METRICS_MAX_MB", "0.0005")   # ~500 bytes
+    monkeypatch.setenv("BLUEFOG_METRICS_KEEP", "2")
+    path = str(tmp_path / "rot.jsonl")
+    trail = EX.MembershipTrail(path, size=N, capacity=[7])
+    for t in range(40):
+        trail.write_event(t, 7, "announced")
+    trail.close()
+    config, recs = EX.read_membership_trail(path)
+    assert config is not None and config["size"] == N   # head re-written
+    assert os.path.exists(path + ".1")
