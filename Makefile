@@ -23,7 +23,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
-        bench-serve lint
+        ckpt-smoke bench-serve bench-ckpt lint
 
 test:
 	$(PYTEST) tests/
@@ -196,11 +196,39 @@ serve-smoke:
 elastic-smoke:
 	python scripts/metrics_smoke.py --elastic
 
+# Durable-fleet-state smoke (docs/checkpoint.md): a real int8+fused
+# training loop checkpoints on cadence; a kill mid-save (shards, no
+# manifest) must be invisible, a shard torn AFTER publish (checksum
+# mismatch, replicas torn too) must make restore fall back to the
+# previous durable manifest and resume BIT-EXACT vs the uninterrupted
+# run, and a deleted local shard must restore from its neighbor
+# replica — all verified through the real `bfmonitor --once --json`
+# "checkpoint" block with a schema-valid ckpt trail.
+ckpt-smoke:
+	python scripts/metrics_smoke.py --ckpt
+
 # Serving-tier bench (docs/serving.md): the end-to-end scenario on the
 # virtual mesh — one JSON line with requests/sec, staleness p50/p95/p99
 # (training steps), fold latency, and the zero-failover invariant.
 bench-serve:
 	python bench.py --serve
+
+# Checkpoint-cost bench (docs/checkpoint.md): step-time p50/p95 with the
+# async snapshot pipeline off vs on, save/restore GB/s, snapshot bytes —
+# one JSON line, GATED: the copy-on-save double buffer must keep p95
+# step inflation under 2x (checkpointing pressure degrades to a longer
+# effective cadence via skipped saves, never to a stalled step loop).
+bench-ckpt:
+	python bench.py --ckpt | python -c "import json,sys; \
+	d=json.load(sys.stdin); print(json.dumps(d)); \
+	print('ckpt: step p95 %.2fms -> %.2fms (%.2fx) | save %.3f GB/s | ' \
+	      'restore %.3f GB/s | %d saves (%d skipped) | snapshot %.1f MB' \
+	      % (d['step_p95_ms']['off'], d['step_p95_ms']['on'], \
+	         d['p95_inflation'], d['save_gbps'], d['restore_gbps'], \
+	         d['saves'], d['saves_skipped'], d['snapshot_mb'])); \
+	assert d['p95_inflation'] < 2.0, \
+	       'async snapshot inflated p95 step time %.2fx >= 2x' % d['p95_inflation']; \
+	assert d['saves'] >= 1 and d['restored_step'] > 0"
 
 # Pre-PR lint gate (docs/static_analysis.md): one bflint invocation runs
 # the AST contract rules (env-doc sync, JSONL kinds, bf_* metric names,

@@ -758,6 +758,109 @@ def serve_main():
     print(json.dumps(out))
 
 
+def ckpt_main():
+    """Durable-fleet-state mode (``--ckpt`` / ``make bench-ckpt``,
+    docs/checkpoint.md): measure what async checkpointing costs the
+    step loop and what the storage protocol moves.
+
+    Runs the same int8+fused training loop twice — checkpointer OFF,
+    then ON with an async cadence — and reports p50/p95 step wall
+    times for both, the p95 inflation ratio (the copy-on-save double
+    buffer must keep it bounded: the gate in the Makefile asserts
+    < 2x), save/restore throughput in GB/s, and the snapshot byte
+    size.  CPU virtual mesh by default (absolute step times are host
+    dispatch cost; the INFLATION ratio and the protocol throughput are
+    what transfer).  Knobs: ``BENCH_CKPT_STEPS`` (default 40),
+    ``BENCH_CKPT_EVERY`` (default 4), ``BENCH_CKPT_PARAM_KB``
+    per-rank parameter size (default 512).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+    bf_metrics.enable()
+
+    import tempfile
+
+    from bluefog_tpu import checkpoint as CK
+
+    bf.init()
+    n = bf.size()
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", "40"))
+    every = int(os.environ.get("BENCH_CKPT_EVERY", "4"))
+    param_kb = int(os.environ.get("BENCH_CKPT_PARAM_KB", "512"))
+    # one [n, F] f32 leaf of ~param_kb KiB per rank plus a small second
+    # leaf so fusion has something to bucket
+    feat = max(1, param_kb * 1024 // 4)
+    rng = np.random.default_rng(0)
+    params0 = {"w": jnp.asarray(rng.normal(size=(n, feat)), jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(n, 32)), jnp.float32)}
+    grads = jax.tree.map(lambda a: a * 0.01, params0)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), fuse=True, compression="int8")
+
+    def run(ck):
+        st = opt.init(params0)
+        p = params0
+        times = []
+        for t in range(steps):
+            t0 = time.perf_counter()
+            p, st = opt.step(p, grads, st, step=t)
+            jax.block_until_ready(jax.tree.leaves(p)[0])
+            if ck is not None:
+                ck.maybe_save(t + 1, lambda: CK.fleet_state_dict(
+                    t + 1, {"params": p, "opt_state": st},
+                    windows=False, counters=False))
+            times.append(time.perf_counter() - t0)
+        if ck is not None:
+            ck.wait()
+        return times[2:]          # drop warmup builds
+
+    def pcts(ts):
+        s = sorted(ts)
+        return (s[len(s) // 2], s[min(len(s) - 1, int(len(s) * 0.95))])
+
+    off_times = run(None)
+    ckdir = tempfile.mkdtemp(prefix="bf_bench_ckpt_")
+    ck = CK.FleetCheckpointer(ckdir, every=every, keep=2, replicas=1,
+                              async_commit=True, size=n)
+    on_times = run(ck)
+    saves = bf_metrics.registry.counter("bf_ckpt_saves_total").value()
+    skipped = bf_metrics.registry.counter(
+        "bf_ckpt_save_skipped_total").value()
+    save_s = bf_metrics.registry.gauge("bf_ckpt_save_seconds").value()
+    nbytes = bf_metrics.registry.gauge("bf_ckpt_bytes").value()
+    ck.close()
+    t0 = time.perf_counter()
+    restored = CK.restore_latest(ckdir)
+    restore_s = time.perf_counter() - t0
+    off_p50, off_p95 = pcts(off_times)
+    on_p50, on_p95 = pcts(on_times)
+    out = {
+        "mode": "ckpt",
+        "mesh": n,
+        "steps": steps,
+        "every": every,
+        "snapshot_mb": round(nbytes / (1 << 20), 3),
+        "step_p50_ms": {"off": round(off_p50 * 1e3, 3),
+                        "on": round(on_p50 * 1e3, 3)},
+        "step_p95_ms": {"off": round(off_p95 * 1e3, 3),
+                        "on": round(on_p95 * 1e3, 3)},
+        "p95_inflation": round(on_p95 / max(off_p95, 1e-9), 3),
+        "saves": int(saves),
+        "saves_skipped": int(skipped),
+        "save_gbps": round(nbytes / max(save_s, 1e-9) / (1 << 30), 3),
+        "restore_gbps": round(nbytes / max(restore_s, 1e-9) / (1 << 30),
+                              3),
+        "restored_step": restored.step,
+        "metrics": bf_metrics.registry.snapshot(),
+    }
+    print(json.dumps(out))
+
+
 def main():
     # host metrics registry on for the whole run: the final snapshot is
     # embedded in the result JSON ("metrics": fusion plan shape/padding
@@ -1052,5 +1155,7 @@ if __name__ == "__main__":
         profile_edges_main()
     elif "--serve" in sys.argv:
         serve_main()
+    elif "--ckpt" in sys.argv:
+        ckpt_main()
     else:
         main()

@@ -81,6 +81,7 @@ from .ops.api import (
     set_weights_override, clear_weights_override, weights_override,
 )
 
+from . import checkpoint
 from . import compress
 from . import control
 from . import resilience

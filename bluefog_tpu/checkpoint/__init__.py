@@ -1,0 +1,75 @@
+"""Durable fleet state: crash-consistent decentralized checkpointing.
+
+The resilience stack (PRs 1/13) survives rank death and elastic churn
+*at runtime*; this subsystem survives the failure production actually
+hits most — a full-fleet preemption or restart.  Four legs
+(docs/checkpoint.md):
+
+* **Complete capture** (``state.py``): :func:`fleet_state_dict` /
+  :func:`load_fleet_state` compose a versioned snapshot of ALL runtime
+  state — the donated train state with its carried compression/overlap
+  buffers, both window buffers, the fault-plan step index and
+  membership directory, controller decision state, RNG keys, serving
+  watermarks, and a metrics snapshot — so a resumed run is bit-exact
+  versus never stopping.
+* **Crash consistency** (``snapshot.py``): :class:`FleetCheckpointer`
+  saves off the critical path (host copy-on-save + background commit),
+  committed by write-shards → fsync → atomically-publish-manifest with
+  per-shard checksums: a kill mid-save always restores the previous
+  complete checkpoint.
+* **Neighbor redundancy** (``redundancy.py``): each rank's shard is
+  replicated to ``k`` out-neighbors of the mixing topology; a lost
+  local shard restores from a replica.
+* **Elastic restore** (``restore.py``): restore onto N′ ≠ N — shrink
+  merges orphans by consensus-average (the departure path), grow
+  bootstraps new ranks from checkpointed in-neighbors, and the repair
+  invariants are asserted on the regenerated mixing matrix.
+
+The reference framework punts here (``torch.save`` on rank 0 +
+``broadcast_parameters``, SURVEY §5.4) — this is capability beyond the
+paper, and the last leg of the fault-tolerance + autoscaling north star.
+"""
+
+from .compat import (Checkpointer, restore_checkpoint,  # noqa: F401
+                     save_checkpoint)
+from .redundancy import (out_neighbors, push_replicas,  # noqa: F401
+                         replica_holders, replica_holders_by_name,
+                         replica_name)
+from .restore import (ElasticRestore, RestoredFleet,  # noqa: F401
+                      TornCheckpointError, check_restore_matrix,
+                      elastic_restore, restore_latest)
+from .snapshot import (ASYNC_ENV, DIR_ENV, EVERY_ENV,  # noqa: F401
+                       GLOBAL_SHARD, KEEP_ENV, MANIFEST_NAME,
+                       REPLICAS_ENV, FleetCheckpointer, durable_manifests,
+                       file_crc32, load_manifest, resolve_async,
+                       resolve_every, resolve_keep, resolve_replicas,
+                       shard_name, split_shards, step_dir_name,
+                       write_shard)
+from .state import (FLEET_STATE_VERSION, FleetRestore,  # noqa: F401
+                    apply_controller_state, apply_serving_state,
+                    controller_state, fleet_state_dict, flat_arrays,
+                    load_fleet_state, membership_state, plan_state,
+                    restore_membership, restore_plan, serving_state)
+
+__all__ = [
+    # capture
+    "FLEET_STATE_VERSION", "fleet_state_dict", "load_fleet_state",
+    "FleetRestore", "flat_arrays", "membership_state",
+    "restore_membership", "plan_state", "restore_plan",
+    "controller_state", "apply_controller_state", "serving_state",
+    "apply_serving_state",
+    # crash-consistent snapshots
+    "FleetCheckpointer", "MANIFEST_NAME", "GLOBAL_SHARD", "shard_name",
+    "step_dir_name", "write_shard", "file_crc32", "durable_manifests",
+    "load_manifest", "split_shards", "DIR_ENV", "EVERY_ENV", "KEEP_ENV",
+    "REPLICAS_ENV", "ASYNC_ENV", "resolve_every", "resolve_keep",
+    "resolve_replicas", "resolve_async",
+    # redundancy
+    "out_neighbors", "push_replicas", "replica_holders",
+    "replica_holders_by_name", "replica_name",
+    # restore
+    "RestoredFleet", "restore_latest", "elastic_restore", "ElasticRestore",
+    "check_restore_matrix", "TornCheckpointError",
+    # single-tree compat (utils/checkpoint.py's historical surface)
+    "Checkpointer", "save_checkpoint", "restore_checkpoint",
+]

@@ -1,0 +1,98 @@
+"""Single-tree orbax checkpointing (the pre-subsystem surface).
+
+This is the API ``utils/checkpoint.py`` has always exported, kept
+verbatim for callers that checkpoint one pytree through orbax
+(``examples/resnet.py``, the plain-state tests).  It is a *partial*
+capture: orbax writes whatever tree you hand it, and a decentralized
+run's state does not live in one tree — ranks hold divergent params,
+the opt state carries compression/overlap buffers, windows double-
+buffer, and the fault-plan/membership/controller state is host-side.
+For the complete, crash-consistent, per-rank-sharded capture use the
+subsystem proper: :func:`~.state.fleet_state_dict` +
+:class:`~.snapshot.FleetCheckpointer` (docs/checkpoint.md).
+"""
+
+import os
+from typing import Any, Optional
+
+__all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint"]
+
+
+class Checkpointer:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    State is any pytree of jax/numpy arrays (shardings are preserved and
+    restored).  Python scalars/ints ride along as pytree leaves.
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False,
+             wait: bool = True) -> bool:
+        """Write ``state`` for ``step``; async under the hood.  ``wait``
+        blocks until the write is durable (set False to overlap with the
+        next training steps and call ``wait_until_finished`` later)."""
+        ok = self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=force)
+        if wait:
+            self._mgr.wait_until_finished()
+        return ok
+
+    def restore(self, step: Optional[int] = None, template: Any = None):
+        """Restore ``step`` (default: latest).  ``template``: a pytree of
+        like-shaped (possibly sharded) arrays — supply it to restore
+        directly onto the right devices/shardings."""
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        if template is not None:
+            args = self._ocp.args.StandardRestore(template)
+            return self._mgr.restore(step, args=args)
+        try:
+            return self._mgr.restore(step)
+        except KeyError:
+            # older orbax (<0.9) cannot infer the handler for an argless
+            # restore of a StandardSave item; an explicit template-less
+            # StandardRestore names the handler and restores as numpy
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore())
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def save_checkpoint(directory: str, step: int, state: Any) -> None:
+    """One-shot convenience (reference users called torch.save on rank 0)."""
+    with Checkpointer(directory) as ckpt:
+        ckpt.save(step, state)
+
+
+def restore_checkpoint(directory: str, step: Optional[int] = None,
+                       template: Any = None):
+    """One-shot convenience; returns the restored pytree."""
+    with Checkpointer(directory) as ckpt:
+        return ckpt.restore(step, template)

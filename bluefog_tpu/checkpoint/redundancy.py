@@ -1,0 +1,117 @@
+"""Neighbor redundancy: each rank's shard survives its host's disk.
+
+A fleet checkpoint is only as durable as its weakest local directory —
+on a real pod each rank writes its own shard to its own storage, and a
+preempted host takes that shard with it.  The repair is the same move
+the runtime makes everywhere else: trust your OUT-NEIGHBORS.  Each
+rank's shard is additionally replicated to ``k`` of its out-neighbors
+in the compiled mixing topology (the manifest records who holds what),
+so a lost or torn local shard restores from a neighbor replica with the
+checksum re-verified (``checkpoint/restore.py``).
+
+Transport: durable byte-copies under
+``<step_dir>/replicas/rank-<r>.held-by-<n>.npz`` (fsynced, renamed into
+place) — on a shared filesystem this directly models "neighbor n's
+directory holds r's shard", and an object store mounts the same way.
+The window subsystem was considered and rejected as the replica wire:
+window payloads ride the f32 gossip path (optionally quantized), which
+re-encodes mixed-dtype shard leaves — a replica that is not
+byte-faithful to its primary cannot share its checksum and silently
+breaks the bit-exact-resume contract.  Replication is a file-transport
+problem; the mixing topology only decides WHO holds the copy
+(docs/checkpoint.md "Neighbor redundancy").
+"""
+
+import os
+import shutil
+from typing import Dict, List
+
+import numpy as np
+
+from . import snapshot as _snap
+
+__all__ = ["out_neighbors", "replica_name", "push_replicas",
+           "replica_holders", "replica_holders_by_name"]
+
+
+def out_neighbors(topology, rank: int, size: int) -> List[int]:
+    """Out-neighbors of ``rank`` under a mixing matrix (``W[src, dst]``
+    != 0 convention, ``parallel/topology.py``) — the ranks that already
+    receive its gossip every step, and therefore the natural replica
+    holders.  Falls back to the ring successor when no matrix is
+    available (a fleet of one holds no replicas)."""
+    if topology is not None:
+        W = np.asarray(topology, np.float64)
+        nbrs = [int(j) for j in np.nonzero(W[int(rank)])[0]
+                if int(j) != int(rank)]
+        if nbrs:
+            return nbrs
+    if size <= 1:
+        return []
+    return [(int(rank) + 1) % int(size)]
+
+
+def replica_name(rank: int, holder: int) -> str:
+    return f"rank-{int(rank)}.held-by-{int(holder)}.npz"
+
+
+def _copy_durable(primary: str, step_dir: str, rel: str) -> None:
+    tmp = os.path.join(step_dir, rel + ".tmp")
+    with open(primary, "rb") as src, open(tmp, "wb") as dst:
+        shutil.copyfileobj(src, dst)
+        dst.flush()
+        os.fsync(dst.fileno())
+    os.replace(tmp, os.path.join(step_dir, rel))
+
+
+def push_replicas(step_dir: str, size: int, *, k: int = 1,
+                  topology=None) -> Dict[str, List[str]]:
+    """Replicate every primary shard under ``step_dir`` to ``k``
+    out-neighbors.  Returns the manifest's ``replicas`` map:
+    ``{primary shard name: [relative replica paths]}``.  Replica files
+    are durable byte-copies (fsynced before rename), so a replica's
+    checksum IS the primary's — restore verifies both against the same
+    manifest entry.
+
+    The ``global`` shard (RNG keys, unsharded leaves) is replicated
+    too, to the writer rank's (rank 0's) out-neighbors — without it a
+    torn ``global.npz`` would abandon the whole manifest no matter how
+    many rank-shard replicas survive."""
+    rdir = os.path.join(step_dir, "replicas")
+    os.makedirs(rdir, exist_ok=True)
+    out: Dict[str, List[str]] = {}
+    for r in range(int(size)):
+        primary = os.path.join(step_dir, _snap.shard_name(r))
+        if not os.path.exists(primary):
+            continue
+        holders = out_neighbors(topology, r, size)[:max(0, int(k))]
+        paths = []
+        for h in holders:
+            rel = os.path.join("replicas", replica_name(r, h))
+            _copy_durable(primary, step_dir, rel)
+            paths.append(rel)
+        if paths:
+            out[_snap.shard_name(r)] = paths
+    gprimary = os.path.join(step_dir, _snap.GLOBAL_SHARD)
+    if os.path.exists(gprimary):
+        paths = []
+        for h in out_neighbors(topology, 0, size)[:max(0, int(k))]:
+            rel = os.path.join("replicas", f"global.held-by-{h}.npz")
+            _copy_durable(gprimary, step_dir, rel)
+            paths.append(rel)
+        if paths:
+            out[_snap.GLOBAL_SHARD] = paths
+    return out
+
+
+def replica_holders(manifest: dict, rank) -> List[str]:
+    """The relative replica paths the manifest records for ``rank``'s
+    shard — ``rank=None`` for the global shard (empty when redundancy
+    was off)."""
+    name = _snap.GLOBAL_SHARD if rank is None else _snap.shard_name(rank)
+    return replica_holders_by_name(manifest, name)
+
+
+def replica_holders_by_name(manifest: dict, name: str) -> List[str]:
+    """The replica paths for one primary shard by manifest name."""
+    return list(manifest.get("replicas", {}).get(name, ()))

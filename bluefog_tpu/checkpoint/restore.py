@@ -1,0 +1,427 @@
+"""Restore: newest durable manifest, shard verification, elastic resize.
+
+Three escalation levels, each one failure class deeper:
+
+1. **Clean restore** (:func:`restore_latest`): newest manifest, every
+   shard CRC-verified, arrays re-stacked to the global view.
+2. **Repair**: a missing/torn shard (checksum mismatch) restores from a
+   neighbor replica recorded in the manifest (byte-copy — same CRC);
+   the repaired primary is optionally written back.  A manifest with an
+   unrecoverable shard is abandoned entirely and the previous durable
+   manifest is used — a kill mid-save can never produce a Franken-state
+   mixing two checkpoints.
+3. **Elastic restore** (:func:`elastic_restore`): the fleet comes back
+   at N′ ≠ N.  Shrink merges the orphaned ranks' shards into the
+   survivors by consensus-average (the PR 13 departure path: orphans
+   are departures; the global parameter average is preserved exactly).
+   Grow admits the new ranks through the bootstrap protocol with the
+   checkpointed ranks as trusted in-neighbors: each new rank's state is
+   the renormalized in-neighbor average under the regenerated mixing
+   matrix.  Either way the regenerated matrix must pass the repair
+   invariants — column (and, for symmetric families, row)
+   stochasticity and a positive spectral gap — before the restore is
+   handed back (:func:`check_restore_matrix`).
+"""
+
+import io
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from . import redundancy as _red
+from . import snapshot as _snap
+from . import state as _state
+
+__all__ = ["RestoredFleet", "restore_latest", "elastic_restore",
+           "ElasticRestore", "check_restore_matrix", "TornCheckpointError"]
+
+
+class TornCheckpointError(RuntimeError):
+    """No durable manifest could be fully verified (all candidates had
+    unrecoverable shards)."""
+
+
+class RestoredFleet:
+    """A verified snapshot read back from disk: flat ``{tree path:
+    array}`` arrays (feed to :func:`~.state.load_fleet_state`), the
+    manifest meta, and the repair audit."""
+
+    __slots__ = ("arrays", "meta", "step", "manifest_path", "repaired",
+                 "fell_back")
+
+    def __init__(self, arrays, meta, step, manifest_path, repaired,
+                 fell_back):
+        self.arrays = arrays
+        self.meta = meta
+        self.step = step
+        self.manifest_path = manifest_path
+        self.repaired = repaired          # [(rank, replica_path)]
+        self.fell_back = fell_back        # manifests abandoned on the way
+
+    # load_fleet_state accepts this directly via flat_arrays()
+    def __getitem__(self, key):
+        return {"arrays": self.arrays, "meta": self.meta}[key]
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+
+def _event(trail, step, event, **kw):
+    if trail is not None:
+        trail.write_event(step, event, **kw)
+
+
+def _count(name: str, help_: str, n: float = 1.0) -> None:
+    if _metrics.enabled():
+        _metrics.counter(name, help_).inc(n)
+
+
+def _read_verified(path: str, want: int) -> Optional[bytes]:
+    """The file's bytes when it exists and its CRC32 matches — one disk
+    read serves both the checksum pass and the np.load that follows."""
+    import zlib
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    return data if zlib.crc32(data) == want else None
+
+
+def _verified_shard(sdir: str, name: str, entry: dict, manifest: dict,
+                    *, repair: bool, trail, step: int
+                    ) -> Tuple[Optional[bytes], Optional[str]]:
+    """Locate a readable copy of one shard: the primary when its CRC
+    matches, else the first intact neighbor replica (optionally copied
+    back over the primary).  Returns ``(payload_bytes, replica_used)``
+    or ``(None, None)`` when unrecoverable."""
+    primary = os.path.join(sdir, name)
+    want = int(entry["crc32"])
+    data = _read_verified(primary, want)
+    if data is not None:
+        return data, None
+    _count("bf_ckpt_torn_shards_total",
+           "primary shards found missing or checksum-torn at restore")
+    _event(trail, step, "torn_shard",
+           rank=entry.get("rank"), detail=name)
+    for rel in _red.replica_holders_by_name(manifest, name):
+        data = _read_verified(os.path.join(sdir, rel), want)
+        if data is not None:
+            if repair:
+                try:
+                    tmp = primary + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                    os.replace(tmp, primary)
+                except OSError:
+                    pass
+            _count("bf_ckpt_replica_repairs_total",
+                   "shards restored from a neighbor replica")
+            _event(trail, step, "replica_repair",
+                   rank=entry.get("rank"), detail=rel)
+            return data, rel
+    return None, None
+
+
+def _load_verified(manifest_path: str, *, repair: bool, trail
+                   ) -> Optional[Tuple[Dict[str, np.ndarray], dict, list]]:
+    """Load + verify every shard a manifest names; None when any shard
+    is unrecoverable (the caller falls back to an older manifest)."""
+    manifest = _snap.load_manifest(manifest_path)
+    if manifest is None:
+        return None
+    sdir = os.path.dirname(manifest_path)
+    step = int(manifest["step"])
+    size = int(manifest["size"])
+    per_rank: List[Optional[Dict[str, np.ndarray]]] = [None] * size
+    global_payload: Dict[str, np.ndarray] = {}
+    repaired = []
+    for name, entry in manifest["shards"].items():
+        data, replica = _verified_shard(sdir, name, entry, manifest,
+                                        repair=repair, trail=trail,
+                                        step=step)
+        if data is None:
+            return None
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            payload = {k: np.array(z[k]) for k in z.files}
+        if replica is not None:
+            repaired.append((entry.get("rank"), replica))
+        if entry.get("rank") is None:
+            global_payload.update(payload)
+        else:
+            per_rank[int(entry["rank"])] = payload
+    arrays: Dict[str, np.ndarray] = {}
+    live = [p for p in per_rank if p is not None]
+    if live:
+        keys = sorted(live[0])
+        for p in live:
+            if sorted(p) != keys:
+                return None          # shards from different layouts
+        for k in keys:
+            arrays[k] = np.stack([p[k] for p in per_rank
+                                  if p is not None])
+    arrays.update(global_payload)
+    return arrays, manifest, repaired
+
+
+def restore_latest(directory: str, *, repair: bool = True,
+                   trail=None) -> RestoredFleet:
+    """Restore the newest durable checkpoint under ``directory``.
+
+    Walks manifests newest → oldest; per manifest, every shard is
+    CRC-verified with neighbor-replica fallback.  A manifest with an
+    unrecoverable shard is abandoned (the kill-mid-save guarantee:
+    restore always lands on a COMPLETE checkpoint).  Raises
+    :class:`TornCheckpointError` when nothing survives and
+    ``FileNotFoundError`` when no manifest was ever published."""
+    manifests = _snap.durable_manifests(directory)
+    if not manifests:
+        raise FileNotFoundError(
+            f"no durable checkpoint manifest under {directory}")
+    fell_back = []
+    for step, mpath in reversed(manifests):
+        loaded = _load_verified(mpath, repair=repair, trail=trail)
+        if loaded is None:
+            fell_back.append(mpath)
+            _event(trail, step, "manifest_fallback",
+                   detail=os.path.basename(os.path.dirname(mpath)))
+            continue
+        arrays, manifest, repaired = loaded
+        _count("bf_ckpt_restores_total",
+               "fleet restores served from a durable manifest")
+        _event(trail, int(manifest["step"]), "restore",
+               detail=os.path.basename(os.path.dirname(mpath)))
+        return RestoredFleet(arrays, manifest.get("meta", {}),
+                             int(manifest["step"]), mpath, repaired,
+                             fell_back)
+    raise TornCheckpointError(
+        f"every durable manifest under {directory} had an unrecoverable "
+        f"shard: {fell_back}")
+
+
+# ---------------------------------------------------------------------------
+# Elastic restore (N' != N)
+# ---------------------------------------------------------------------------
+
+def check_restore_matrix(W: np.ndarray, *, gap_floor: float = 1e-9,
+                         atol: float = 1e-8) -> Dict[str, float]:
+    """The repair invariants, asserted on a regenerated mixing matrix:
+    non-negative entries, every column summing to 1 (mass
+    conservation), rows too when the family is symmetric, and a
+    spectral gap above ``gap_floor`` (consensus must contract on the
+    restored fleet).  Returns the measured invariants; raises
+    ``ValueError`` on violation."""
+    from ..resilience.repair import spectral_gap
+    W = np.asarray(W, np.float64)
+    if W.ndim != 2 or W.shape[0] != W.shape[1]:
+        raise ValueError(f"mixing matrix must be square, got {W.shape}")
+    if (W < -atol).any():
+        raise ValueError("regenerated mixing matrix has negative entries")
+    col = W.sum(axis=0)
+    if not np.allclose(col, 1.0, atol=atol):
+        raise ValueError(
+            f"regenerated mixing matrix is not column-stochastic "
+            f"(column sums {col})")
+    symmetric = bool(np.allclose(W, W.T, atol=1e-12))
+    row = W.sum(axis=1)
+    if symmetric and not np.allclose(row, 1.0, atol=atol):
+        raise ValueError(
+            f"symmetric-family matrix is not row-stochastic "
+            f"(row sums {row})")
+    gap = spectral_gap(W)
+    if not gap > gap_floor:
+        raise ValueError(
+            f"regenerated mixing matrix spectral gap {gap} <= floor "
+            f"{gap_floor}: consensus would not contract")
+    return {"spectral_gap": float(gap), "symmetric": float(symmetric),
+            "col_err": float(np.abs(col - 1.0).max()),
+            "row_err": float(np.abs(row - 1.0).max())}
+
+
+class ElasticRestore:
+    """An N→N′ restore: resized flat arrays, the regenerated verified
+    mixing matrix, a membership directory narrating the resize, and the
+    measured invariants."""
+
+    __slots__ = ("arrays", "meta", "step", "old_size", "new_size",
+                 "matrix", "membership", "invariants", "base")
+
+    def __init__(self, arrays, meta, step, old_size, new_size, matrix,
+                 membership, invariants, base):
+        self.arrays = arrays
+        self.meta = meta
+        self.step = step
+        self.old_size = old_size
+        self.new_size = new_size
+        self.matrix = matrix
+        self.membership = membership
+        self.invariants = invariants
+        self.base = base                  # the verified RestoredFleet
+
+    def __getitem__(self, key):
+        return {"arrays": self.arrays, "meta": self.meta}[key]
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+
+def _default_matrix(size: int) -> np.ndarray:
+    from ..parallel.topology import ExponentialTwoGraph, mixing_matrix
+    if size == 1:
+        return np.ones((1, 1))
+    return np.asarray(mixing_matrix(ExponentialTwoGraph(int(size))),
+                      np.float64)
+
+
+def elastic_restore(directory: str, new_size: int, *,
+                    topology_matrix=None, gap_floor: float = 1e-9,
+                    repair: bool = True, trail=None) -> ElasticRestore:
+    """Restore the newest durable checkpoint onto a fleet of
+    ``new_size`` ranks.
+
+    **Shrink** (N′ < N): ranks N′.. are orphans — their shards merge
+    into every survivor by consensus-average, ``x_r ← (1−α)·x_r +
+    α·mean(orphans)`` with ``α = (N−N′)/N``, which preserves the global
+    parameter average exactly (the quantity decentralized averaging
+    conserves).  The membership directory records them as departures —
+    the same path a runtime ``rank_leave`` takes.
+
+    **Grow** (N′ > N): new ranks bootstrap from their trusted
+    in-neighbors — the CHECKPOINTED ranks feeding them under the
+    regenerated matrix, weights renormalized over those feeds (a new
+    rank fed only by other new ranks falls back to the checkpointed
+    fleet mean).  The directory records an announce → sync → activate
+    admission per new rank.
+
+    ``topology_matrix``: the N′-sized mixing matrix of the restored run
+    (default: the exponential-2 family regenerated at N′).  The repair
+    invariants are asserted on it before anything is returned.  Float
+    (inexact-dtype) leaves merge; integer leaves (step counters,
+    versions) take the survivor/neighbor values unaveraged."""
+    new_size = int(new_size)
+    if new_size < 1:
+        raise ValueError(f"new_size must be >= 1, got {new_size}")
+    base = restore_latest(directory, repair=repair, trail=trail)
+    old_size = int(base.meta.get("size")
+                   or _infer_size(base.arrays))
+    W = (np.asarray(topology_matrix, np.float64)
+         if topology_matrix is not None else _default_matrix(new_size))
+    if W.shape != (new_size, new_size):
+        raise ValueError(
+            f"topology_matrix must be [{new_size}, {new_size}], "
+            f"got {W.shape}")
+    invariants = check_restore_matrix(W, gap_floor=gap_floor)
+
+    from ..resilience.membership import ElasticMembership
+    # grown ranks start as pre-allocated capacity slots so the restore
+    # narrates their admission through the real announce/sync protocol
+    membership = ElasticMembership(
+        max(old_size, new_size),
+        capacity=range(old_size, new_size) if new_size > old_size else ())
+    step = base.step
+    arrays: Dict[str, np.ndarray] = {}
+    if new_size == old_size:
+        arrays = dict(base.arrays)
+    elif new_size < old_size:
+        alpha = (old_size - new_size) / float(old_size)
+        for r in range(new_size, old_size):
+            membership.leave(r, step)
+        for key, v in base.arrays.items():
+            if key.startswith(_state.WINDOWS_PREFIX):
+                continue      # see _is_sharded: windows recreate fresh
+            if _is_sharded(v, old_size, key):
+                keep = v[:new_size]
+                if np.issubdtype(v.dtype, np.inexact):
+                    orphan_mean = v[new_size:].mean(axis=0)
+                    merged = ((1.0 - alpha) * keep.astype(np.float64)
+                              + alpha * orphan_mean.astype(np.float64))
+                    arrays[key] = merged.astype(v.dtype)
+                else:
+                    arrays[key] = keep
+            else:
+                arrays[key] = v
+        _event(trail, step, "elastic_restore",
+               detail=f"shrink {old_size}->{new_size}")
+    else:
+        # grow: per new rank, its checkpointed in-neighbors under W'
+        feeds = {}
+        for r in range(old_size, new_size):
+            col = W[:, r].copy()
+            col[r] = 0.0
+            trusted = [(i, col[i]) for i in range(old_size)
+                       if col[i] > 0]
+            feeds[r] = trusted
+            membership.admit_restored(r, step)
+        for key, v in base.arrays.items():
+            if key.startswith(_state.WINDOWS_PREFIX):
+                continue      # see _is_sharded: windows recreate fresh
+            if _is_sharded(v, old_size, key):
+                rows = [v[r] for r in range(old_size)]
+                ckpt_mean = v.astype(np.float64).mean(axis=0) \
+                    if np.issubdtype(v.dtype, np.inexact) else None
+                for r in range(old_size, new_size):
+                    trusted = feeds[r]
+                    if np.issubdtype(v.dtype, np.inexact):
+                        if trusted:
+                            tot = sum(w for _, w in trusted)
+                            boot = sum(
+                                v[i].astype(np.float64) * (w / tot)
+                                for i, w in trusted)
+                        else:
+                            boot = ckpt_mean
+                        rows.append(boot.astype(v.dtype))
+                    else:
+                        src = trusted[0][0] if trusted else 0
+                        rows.append(v[src])
+                arrays[key] = np.stack(rows)
+            else:
+                arrays[key] = v
+        _event(trail, step, "elastic_restore",
+               detail=f"grow {old_size}->{new_size}")
+    meta = dict(base.meta)
+    meta["size"] = new_size
+    meta["topology"] = W.tolist()
+    if new_size != old_size:
+        # old-fleet-sized host sections must not survive the resize:
+        # the recorded fault tables re-lower to [T, N], the membership
+        # directory and serving watermarks are keyed by old ranks —
+        # feeding any of them to the N' fleet gives shape mismatches or
+        # silently wrong masks.  The resize-narrated directory is
+        # `er.membership`; plans/watermarks re-derive on the new fleet.
+        for stale in ("plan", "membership", "serving"):
+            meta.pop(stale, None)
+        if "sections" in meta:
+            meta["sections"] = [s for s in meta["sections"]
+                                if s not in ("plan", "membership",
+                                             "serving", "windows")]
+    return ElasticRestore(arrays, meta, step, old_size, new_size, W,
+                          membership, invariants, base)
+
+
+def _infer_size(arrays: Dict[str, np.ndarray]) -> int:
+    dims: Dict[int, int] = {}
+    for v in arrays.values():
+        if v.ndim >= 1:
+            dims[v.shape[0]] = dims.get(v.shape[0], 0) + 1
+    if not dims:
+        raise ValueError("restored checkpoint has no array leaves")
+    return max(dims, key=lambda d: dims[d])
+
+
+def _is_sharded(v: np.ndarray, size: int, key: str) -> bool:
+    """Sharded = per-rank leaf.  Window state is deliberately EXCLUDED
+    from elastic resizing: window buffer shapes are functions of the
+    old topology's in-degree and would not match the restored fleet's
+    windows — windows are bounded-staleness caches, recreated fresh by
+    ``win_create`` on the new fleet (docs/checkpoint.md)."""
+    if key.startswith(_state.WINDOWS_PREFIX):
+        return False
+    return v.ndim >= 1 and v.shape[0] == size

@@ -1,0 +1,445 @@
+"""Crash-consistent snapshot writer: shards → fsync → atomic manifest.
+
+The durability contract (docs/checkpoint.md "Commit protocol"): a
+checkpoint EXISTS only once its ``manifest.json`` does.  A save writes
+every shard to a temp name, fsyncs, renames into place, then publishes
+the manifest with one atomic ``os.replace`` — so a kill at ANY point
+mid-save leaves either the previous complete checkpoint (no new
+manifest) or the new complete one (manifest published after every shard
+it names is durable).  Per-shard CRC32 checksums ride the manifest:
+a shard torn AFTER publish (disk loss, truncation) is detected at
+restore time and repaired from a neighbor replica
+(``checkpoint/redundancy.py``) or, failing that, the restore falls back
+to the previous durable manifest.
+
+The :class:`FleetCheckpointer` keeps saves off the critical path with a
+host-side copy-on-save double buffer: :func:`~.state.fleet_state_dict`
+already hands over host COPIES (the donated device buffers keep
+stepping immediately), and the shard/fsync/publish work drains on a
+single background thread.  At most one commit is in flight; a cadence
+tick that lands while one is still draining is SKIPPED (counted,
+trailed) rather than queued — checkpoint pressure must degrade to a
+longer interval, never to an unbounded host-memory queue of snapshots.
+
+Directory layout::
+
+    <dir>/step-00000012/rank-0.npz ... rank-7.npz   per-rank shards
+    <dir>/step-00000012/global.npz                  unsharded leaves
+    <dir>/step-00000012/replicas/rank-3.held-by-5.npz
+    <dir>/step-00000012/manifest.json               published LAST
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import export as _export
+from ..observability import metrics as _metrics
+from . import state as _state
+
+__all__ = ["FleetCheckpointer", "MANIFEST_NAME", "GLOBAL_SHARD",
+           "shard_name", "step_dir_name", "write_shard", "file_crc32",
+           "durable_manifests", "load_manifest", "split_shards",
+           "DIR_ENV", "EVERY_ENV", "KEEP_ENV", "REPLICAS_ENV", "ASYNC_ENV",
+           "resolve_every", "resolve_keep", "resolve_replicas",
+           "resolve_async"]
+
+MANIFEST_NAME = "manifest.json"
+GLOBAL_SHARD = "global.npz"
+
+DIR_ENV = "BLUEFOG_CKPT_DIR"
+EVERY_ENV = "BLUEFOG_CKPT_EVERY"
+KEEP_ENV = "BLUEFOG_CKPT_KEEP"
+REPLICAS_ENV = "BLUEFOG_CKPT_REPLICAS"
+ASYNC_ENV = "BLUEFOG_CKPT_ASYNC"
+
+
+def resolve_every(value: Optional[int] = None) -> int:
+    """``BLUEFOG_CKPT_EVERY`` (default 0 = no cadence): save every k-th
+    step via :meth:`FleetCheckpointer.maybe_save`."""
+    every = int(os.environ.get(EVERY_ENV, "0") if value is None else value)
+    if every < 0:
+        raise ValueError(f"ckpt cadence must be >= 0, got {every}")
+    return every
+
+
+def resolve_keep(value: Optional[int] = None) -> int:
+    """``BLUEFOG_CKPT_KEEP`` (default 2): durable checkpoints retained.
+    Two is the crash-consistency floor — the newest may be the one a
+    torn shard invalidates."""
+    keep = int(os.environ.get(KEEP_ENV, "2") if value is None else value)
+    if keep < 1:
+        raise ValueError(f"ckpt keep must be >= 1, got {keep}")
+    return keep
+
+
+def resolve_replicas(value: Optional[int] = None) -> int:
+    """``BLUEFOG_CKPT_REPLICAS`` (default 1): out-neighbors holding a
+    copy of each rank's shard (0 disables redundancy)."""
+    k = int(os.environ.get(REPLICAS_ENV, "1") if value is None else value)
+    if k < 0:
+        raise ValueError(f"ckpt replicas must be >= 0, got {k}")
+    return k
+
+
+def resolve_async(value: Optional[bool] = None) -> bool:
+    """``BLUEFOG_CKPT_ASYNC`` (default on): commit on the background
+    thread.  Off = synchronous saves (deterministic tests, debugging)."""
+    if value is not None:
+        return bool(value)
+    return os.environ.get(ASYNC_ENV, "1").lower() not in ("0", "false", "off")
+
+
+def step_dir_name(step: int) -> str:
+    return f"step-{int(step):08d}"
+
+
+def shard_name(rank: int) -> str:
+    return f"rank-{int(rank)}.npz"
+
+
+def write_shard(path: str, named: Dict[str, np.ndarray]
+                ) -> Tuple[int, int]:
+    """Write one ``.npz`` shard durably: temp name, fsync, rename.
+    Returns ``(crc32, bytes)`` of the final file content — the checksum
+    is computed over the very bytes that hit the disk (read back after
+    the fsync), which is exactly what restore will verify."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **named)
+        f.flush()
+        os.fsync(f.fileno())
+    crc = file_crc32(tmp)
+    nbytes = os.path.getsize(tmp)
+    os.replace(tmp, path)
+    return crc, nbytes
+
+
+def file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def split_shards(state: Dict[str, Any], size: Optional[int] = None
+                 ) -> Tuple[List[Dict[str, np.ndarray]],
+                            Dict[str, np.ndarray], int]:
+    """Split a snapshot's arrays into per-rank + global shard payloads.
+
+    A leaf whose leading dimension equals the fleet size is per-rank
+    state (the global-view convention — every train/window/compression
+    leaf): shard r gets ``leaf[r]``.  Everything else (RNG key data,
+    odd-shaped user leaves) rides the shared ``global`` shard.  Returns
+    ``(per_rank_payloads, global_payload, size)``."""
+    flat = _state.flat_arrays(state)
+    if size is None:
+        size = state.get("meta", {}).get("size")
+    if size is None:
+        # infer: the most common leading dim across non-scalar leaves
+        dims: Dict[int, int] = {}
+        for v in flat.values():
+            if v.ndim >= 1:
+                dims[v.shape[0]] = dims.get(v.shape[0], 0) + 1
+        if not dims:
+            raise ValueError("snapshot has no array leaves to shard")
+        size = max(dims, key=lambda d: dims[d])
+    size = int(size)
+    per_rank: List[Dict[str, np.ndarray]] = [dict() for _ in range(size)]
+    global_payload: Dict[str, np.ndarray] = {}
+    for key, v in flat.items():
+        if v.ndim >= 1 and v.shape[0] == size:
+            for r in range(size):
+                per_rank[r][key] = v[r]
+        else:
+            global_payload[key] = v
+    return per_rank, global_payload, size
+
+
+def load_manifest(path: str) -> Optional[dict]:
+    """Parse one manifest; None when missing/unreadable/truncated (a
+    torn manifest write never published — it does not exist)."""
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or "shards" not in m or "step" not in m:
+        return None
+    return m
+
+
+def durable_manifests(directory: str) -> List[Tuple[int, str]]:
+    """Every published checkpoint under ``directory``, oldest first:
+    ``[(step, manifest_path)]``.  Unpublished step dirs (killed
+    mid-save) simply have no manifest and are invisible here."""
+    out = []
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    for name in entries:
+        if not name.startswith("step-"):
+            continue
+        path = os.path.join(directory, name, MANIFEST_NAME)
+        m = load_manifest(path)
+        if m is not None:
+            out.append((int(m["step"]), path))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+class FleetCheckpointer:
+    """Durable-fleet-state writer: cadence, copy-on-save double buffer,
+    background commit, neighbor redundancy, retention, and the
+    ``ckpt``/``ckpt_event`` trail + ``bf_ckpt_*`` gauges.
+
+    >>> ckpt = FleetCheckpointer("/path/run1", every=100)
+    >>> for t in range(steps):
+    ...     params, st, loss = step(params, st, batch, t)
+    ...     ckpt.maybe_save(t + 1, lambda: checkpoint.fleet_state_dict(
+    ...         t + 1, {"params": params, "opt_state": st}))
+    >>> ckpt.close()
+    """
+
+    def __init__(self, directory: Optional[str] = None, *,
+                 every: Optional[int] = None, keep: Optional[int] = None,
+                 replicas: Optional[int] = None,
+                 async_commit: Optional[bool] = None,
+                 trail_path: Optional[str] = None,
+                 size: Optional[int] = None):
+        if directory is None:
+            directory = os.environ.get(DIR_ENV)
+        if not directory:
+            raise ValueError(
+                "no checkpoint directory: pass directory= or set "
+                "BLUEFOG_CKPT_DIR")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.every = resolve_every(every)
+        self.keep = resolve_keep(keep)
+        self.replicas = resolve_replicas(replicas)
+        self.async_commit = resolve_async(async_commit)
+        self.size = size
+        self.last_durable: Optional[int] = None
+        existing = durable_manifests(self.directory)
+        if existing:
+            self.last_durable = existing[-1][0]
+        self._pending: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._trail = None
+        self._owns_trail = False
+        if trail_path is None:
+            prefix = os.environ.get(_export.METRICS_ENV)
+            if prefix:
+                trail_path = prefix + _export.CKPT_SUFFIX
+        if trail_path:
+            self._trail = _export.CkptTrail(
+                trail_path, directory=self.directory, every=self.every,
+                keep=self.keep, replicas=self.replicas,
+                size=size if size is not None else -1)
+            self._owns_trail = True
+
+    # -- trail/metrics plumbing ---------------------------------------------
+
+    @property
+    def trail(self):
+        """The open :class:`~..observability.export.CkptTrail` (or None)
+        — pass it to ``restore_latest(trail=...)`` so restore/repair
+        events land on the same sidecar the saves write."""
+        return self._trail
+
+    def _event(self, step: int, event: str, *, rank=None, detail=None):
+        # CkptTrail.write is internally locked: the step loop, the
+        # background committer, and restore callers share this sidecar
+        if self._trail is not None:
+            self._trail.write_event(step, event, rank=rank, detail=detail)
+
+    def _counter(self, name: str, help_: str):
+        if _metrics.enabled():
+            _metrics.counter(name, help_).inc()
+
+    # -- cadence + async front door -----------------------------------------
+
+    def maybe_save(self, step: int, state_or_fn) -> bool:
+        """Cadence gate: save when ``step`` hits the ``every`` grid
+        (``every`` 0 = never).  ``state_or_fn``: a snapshot dict or a
+        zero-arg callable building one (preferred — capture cost is
+        paid only on cadence steps)."""
+        if not self.every or int(step) % self.every != 0:
+            return False
+        return self.save(step, state_or_fn)
+
+    def save(self, step: int, state_or_fn) -> bool:
+        """Snapshot now.  Async mode hands the host copies to the
+        background committer and returns immediately; a save requested
+        while one is still draining is SKIPPED (counted + trailed).
+        Returns True when a commit was started (or completed)."""
+        with self._lock:
+            if self._pending is not None and self._pending.is_alive():
+                self._counter("bf_ckpt_save_skipped_total",
+                              "cadence saves skipped because the "
+                              "previous commit was still draining")
+                self._event(step, "save_skipped",
+                            detail="previous commit still draining")
+                return False
+            self._pending = None
+        state = state_or_fn() if callable(state_or_fn) else state_or_fn
+        self._event(step, "save_begin")
+        if not self.async_commit:
+            self._commit(int(step), state)
+            return True
+        t = threading.Thread(target=self._commit_guarded,
+                             args=(int(step), state),
+                             name=f"bf-ckpt-{step}", daemon=True)
+        with self._lock:
+            self._pending = t
+        t.start()
+        return True
+
+    def _commit_guarded(self, step: int, state: Dict[str, Any]) -> None:
+        """The background-thread entry: a commit that fails (full disk,
+        lost mount, permissions) must be VISIBLE — the caller's save()
+        already returned True, so without this the trail would show
+        save_begin with no save_commit, no counter would move, and the
+        operator would discover the stale checkpoint only at restore
+        time.  Synchronous saves propagate instead (the caller is
+        there to see the exception)."""
+        try:
+            self._commit(step, state)
+        except Exception as e:          # noqa: BLE001 — alert, don't die
+            self._counter("bf_ckpt_save_failed_total",
+                          "background checkpoint commits that raised "
+                          "(disk full, lost mount, permissions)")
+            self._event(step, "save_failed", detail=repr(e)[:200])
+
+    def wait(self) -> None:
+        """Block until the in-flight commit (if any) is durable."""
+        with self._lock:
+            t = self._pending
+        if t is not None:
+            t.join()
+
+    def close(self) -> None:
+        self.wait()
+        if self._trail is not None and self._owns_trail:
+            self._trail.close()
+            self._trail = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- the commit protocol ------------------------------------------------
+
+    def _commit(self, step: int, state: Dict[str, Any]) -> str:
+        """Write shards → fsync → replicate → atomically publish the
+        manifest → prune retention.  Runs on the background thread in
+        async mode; any kill before the final ``os.replace`` leaves the
+        previous checkpoint as the newest durable one."""
+        t0 = time.perf_counter()
+        per_rank, global_payload, size = split_shards(state, self.size)
+        sdir = os.path.join(self.directory, step_dir_name(step))
+        os.makedirs(sdir, exist_ok=True)
+        shards: Dict[str, dict] = {}
+        total = 0
+        for r, payload in enumerate(per_rank):
+            name = shard_name(r)
+            crc, nbytes = write_shard(os.path.join(sdir, name), payload)
+            shards[name] = {"crc32": crc, "bytes": nbytes, "rank": r}
+            total += nbytes
+        if global_payload:
+            crc, nbytes = write_shard(os.path.join(sdir, GLOBAL_SHARD),
+                                      global_payload)
+            shards[GLOBAL_SHARD] = {"crc32": crc, "bytes": nbytes,
+                                    "rank": None}
+            total += nbytes
+        replica_map: Dict[str, List[str]] = {}
+        if self.replicas:
+            from . import redundancy as _red
+            replica_map = _red.push_replicas(
+                sdir, size, k=self.replicas,
+                topology=state.get("meta", {}).get("topology"))
+        manifest = {
+            "version": _state.FLEET_STATE_VERSION,
+            "step": int(step),
+            "size": int(size),
+            "bytes": int(total),
+            "shards": shards,
+            "replicas": replica_map,
+            "meta": state.get("meta", {}),
+        }
+        tmp = os.path.join(sdir, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # THE publish: durable shards first, one atomic rename after
+        os.replace(tmp, os.path.join(sdir, MANIFEST_NAME))
+        _fsync_dir(sdir)
+        _fsync_dir(self.directory)
+        self.last_durable = int(step)
+        save_s = time.perf_counter() - t0
+        self._prune()
+        if _metrics.enabled():
+            _metrics.gauge("bf_ckpt_save_seconds",
+                           "wall seconds of the last durable fleet "
+                           "checkpoint commit").set(save_s)
+            _metrics.gauge("bf_ckpt_bytes",
+                           "total shard bytes of the last durable fleet "
+                           "checkpoint").set(float(total))
+            _metrics.gauge("bf_ckpt_last_durable_step",
+                           "step index of the newest durable fleet "
+                           "checkpoint manifest").set(float(step))
+            _metrics.counter("bf_ckpt_saves_total",
+                             "durable fleet checkpoint commits").inc()
+        if self._trail is not None:
+            self._trail.write_save(step, durable_step=step, nbytes=total,
+                                   save_s=save_s, shards=len(shards))
+            self._event(step, "save_commit")
+        return os.path.join(sdir, MANIFEST_NAME)
+
+    def _prune(self) -> None:
+        """Retention: keep the newest ``keep`` durable checkpoints; also
+        sweep unpublished (torn) step dirs older than the newest durable
+        one — they can never become durable."""
+        durable = durable_manifests(self.directory)
+        for _, mpath in durable[:-self.keep]:
+            shutil.rmtree(os.path.dirname(mpath), ignore_errors=True)
+        if not durable:
+            return
+        newest = os.path.dirname(durable[-1][1])
+        try:
+            entries = sorted(os.listdir(self.directory))
+        except OSError:
+            return
+        for name in entries:
+            path = os.path.join(self.directory, name)
+            if (name.startswith("step-") and path < newest
+                    and not os.path.exists(
+                        os.path.join(path, MANIFEST_NAME))):
+                shutil.rmtree(path, ignore_errors=True)

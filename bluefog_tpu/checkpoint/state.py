@@ -1,0 +1,498 @@
+"""Complete fleet-state capture: everything a decentralized run carries.
+
+The reference framework's supported durable-state pattern is
+``torch.save`` on rank 0 plus ``broadcast_parameters`` (SURVEY.md §5.4) —
+which only works because its optimizers carry no cross-step runtime
+state.  Twelve PRs of runtime machinery changed that here: a mid-run
+fleet also holds per-bucket error-feedback residuals and CHOCO estimates
+(``compress/exchange.py``), overlapped in-flight flat buffers
+(``strategies.delayed_*``), both window double buffers
+(``win_state_dict``), the fault-plan/membership step index and the
+:class:`~..resilience.membership.ElasticMembership` directory, the
+controller's decision state (``SwitchableSchedule`` mode remap, CHOCO
+``gamma_scale``, per-knob cooldowns), RNG keys, serving watermarks, and
+the host metrics counters.  :func:`fleet_state_dict` composes ALL of it
+into one versioned snapshot so a resumed run is bit-exact versus never
+stopping, with every knob on — and :func:`load_fleet_state` reapplies
+each section to a freshly constructed run.
+
+Layout contract: the snapshot separates **arrays** (a nested pytree of
+host-copied numpy arrays — the shardable payload ``checkpoint/snapshot``
+writes per rank) from **meta** (a JSON-able dict — the manifest-resident
+description: step index, fault-plan events, membership directory,
+controller knobs, counters).  Array leaves whose leading dimension is
+the fleet size are per-rank shards; everything else (RNG key data)
+rides the shared ``global`` shard.  Restore is template-driven, exactly
+like ``load_win_state_dict``: the snapshot carries data, not structure —
+the resuming process builds the same optimizer/windows first and the
+leaves flow back in by tree path.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FLEET_STATE_VERSION", "fleet_state_dict", "load_fleet_state",
+           "FleetRestore", "flat_arrays", "membership_state",
+           "restore_membership", "plan_state", "restore_plan",
+           "controller_state", "apply_controller_state",
+           "serving_state", "apply_serving_state"]
+
+FLEET_STATE_VERSION = 1
+
+# tree-path prefixes of the arrays sections (the shard keys the manifest
+# records; restore matches templates against these)
+TRAIN_PREFIX = "['train']"
+WINDOWS_PREFIX = "['windows']"
+RNG_PREFIX = "['rng']"
+
+# the CHOCO γ-scale leaf the controller plumbing re-injects into the
+# carried compression state every step (optim/wrappers.py
+# ``_with_control_state``): present in a STEPPED opt state, absent from
+# an init-fresh one — optional on both sides of the template match, its
+# value recorded in (and restored from) the "control" meta section
+_INJECTED_GAMMA = "['compress']['gamma_scale']"
+
+
+def _keystr(path) -> str:
+    import jax
+    return jax.tree_util.keystr(path)
+
+
+def flat_arrays(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Flatten a snapshot's ``arrays`` section (or hand back an
+    already-flat ``{tree path: array}`` dict, the form
+    ``restore.restore_latest`` returns)."""
+    import jax
+    arrays = state.get("arrays", {})
+    if arrays and all(isinstance(k, str) and k.startswith("[")
+                      for k in arrays):
+        return dict(arrays)
+    flat, _ = jax.tree_util.tree_flatten_with_path(arrays)
+    return {_keystr(p): np.asarray(v) for p, v in flat}
+
+
+def _host_copy(tree):
+    """Device -> host COPIES (the copy-on-save boundary): the donated
+    device buffers keep stepping while the writer drains these."""
+    import jax
+    return jax.tree.map(lambda a: np.array(a, copy=True), tree)
+
+
+# ---------------------------------------------------------------------------
+# Section serializers (host dicts, JSON-able)
+# ---------------------------------------------------------------------------
+
+def membership_state(m) -> Dict[str, Any]:
+    """JSON-able snapshot of an :class:`ElasticMembership` directory."""
+    return {
+        "size": int(m.size),
+        "suspect_after": int(m.cfg.suspect_after),
+        "confirm_after": int(m.cfg.confirm_after),
+        "quorum": m.quorum,
+        "states": {str(r): s for r, s in sorted(m.states.items())},
+        "synced": sorted(int(r) for r in m._synced),
+        "announced_at": {str(r): int(s)
+                         for r, s in sorted(m._announced_at.items())},
+        "transitions": [[int(t), int(r), s] for t, r, s in m.transitions],
+    }
+
+
+def restore_membership(meta: Dict[str, Any]):
+    """Rebuild the :class:`ElasticMembership` directory a snapshot
+    recorded — states, sync marks, announcement times, and the audit
+    log, so the resumed observer continues mid-admission."""
+    from ..resilience.membership import ElasticMembership, LivenessConfig
+    m = ElasticMembership(
+        int(meta["size"]),
+        cfg=LivenessConfig(int(meta["suspect_after"]),
+                           int(meta["confirm_after"])),
+        quorum=meta.get("quorum"))
+    m.states = {int(r): s for r, s in meta["states"].items()}
+    m._synced = set(int(r) for r in meta.get("synced", ()))
+    m._announced_at = {int(r): int(s)
+                       for r, s in meta.get("announced_at", {}).items()}
+    m.transitions = [(int(t), int(r), s)
+                     for t, r, s in meta.get("transitions", ())]
+    return m
+
+
+def plan_state(plan, plan_step: int) -> Dict[str, Any]:
+    """JSON-able snapshot of a :class:`CompiledFaultPlan` — its event
+    list plus the step index the run had advanced the tables to.  The
+    tables themselves are deterministic from the events, so restore
+    re-lowers instead of shipping [T, N, N] float tables."""
+    return {
+        "size": int(plan.size),
+        "horizon": int(plan.horizon),
+        "step": int(plan_step),
+        "events": [{"kind": ev.kind, "rank": int(ev.rank),
+                    "step": int(ev.step),
+                    "until": None if ev.until is None else int(ev.until),
+                    "peer": None if ev.peer is None else int(ev.peer),
+                    "factor": float(ev.factor)}
+                   for ev in plan.events],
+    }
+
+
+def restore_plan(meta: Dict[str, Any]):
+    """Re-lower the fault plan a snapshot recorded.  Returns
+    ``(CompiledFaultPlan, plan_step)`` — the resumed run indexes the
+    tables from ``plan_step``, so mid-episode faults/joins continue
+    exactly where the killed run left them."""
+    from ..resilience.faults import FaultEvent, FaultPlan
+    plan = FaultPlan(int(meta["size"]), int(meta["horizon"]))
+    plan.events = [FaultEvent(kind=e["kind"], rank=int(e["rank"]),
+                              step=int(e["step"]), until=e.get("until"),
+                              peer=e.get("peer"),
+                              factor=float(e.get("factor", 1.0)))
+                   for e in meta.get("events", ())]
+    return plan.compile(), int(meta.get("step", 0))
+
+
+def controller_state(controller) -> Dict[str, Any]:
+    """JSON-able snapshot of an :class:`~..control.actuate.Actuator` (or
+    full ``Controller``): the schedule mode, the γ scale riding
+    ``opt.control_knobs``, and — when a sensing engine is attached — the
+    PolicyEngine's hysteresis state (cooldowns, healthy streak,
+    deviation flag), so a restored controller neither re-fires a
+    decision inside a cooldown nor forgets it had intervened."""
+    out: Dict[str, Any] = {
+        "sched_mode": int(getattr(controller, "sched_mode", 0)),
+        "mode_name": getattr(controller, "mode_name", None),
+        "gamma_scale": float(getattr(controller, "gamma_scale", 1.0)),
+    }
+    engine = getattr(controller, "engine", None)
+    if engine is not None:
+        out["engine"] = {
+            "sched_mode": engine.sched_mode,
+            "base_mode": engine.base_mode,
+            "gamma_scale": float(engine.gamma_scale),
+            "healthy_streak": int(engine._healthy_streak),
+            "deviated": bool(engine._deviated),
+            "cooldowns": {k: int(v) for k, v in engine._last_step.items()},
+        }
+    return out
+
+
+def apply_controller_state(controller, meta: Dict[str, Any]) -> None:
+    """Reapply :func:`controller_state` onto a freshly built actuator/
+    controller (same schedule stack).  The knobs are traced data, so
+    this never recompiles the step."""
+    controller.sched_mode = int(meta.get("sched_mode", 0))
+    gamma = float(meta.get("gamma_scale", 1.0))
+    knobs = getattr(getattr(controller, "opt", None), "control_knobs", None)
+    if knobs is not None:
+        knobs["gamma_scale"] = gamma
+    engine = getattr(controller, "engine", None)
+    saved = meta.get("engine")
+    if engine is not None and saved is not None:
+        engine.sched_mode = saved.get("sched_mode", engine.sched_mode)
+        engine.base_mode = saved.get("base_mode", engine.base_mode)
+        engine.gamma_scale = float(saved.get("gamma_scale", 1.0))
+        engine._healthy_streak = int(saved.get("healthy_streak", 0))
+        engine._deviated = bool(saved.get("deviated", False))
+        engine._last_step = {k: int(v)
+                             for k, v in saved.get("cooldowns", {}).items()}
+
+
+def serving_state(replicas) -> Dict[str, Any]:
+    """JSON-able snapshot of a serving :class:`ReplicaSet`'s host state:
+    per-replica staleness watermarks plus the publisher's
+    ``last_published`` stream headers — what a restarted serving tier
+    needs to keep refusing requests past the staleness bound instead of
+    optimistically serving pre-crash weights as fresh."""
+    marks = getattr(replicas, "_watermark", {}) or {}
+    pub = getattr(replicas, "publisher", None)
+    last_pub = dict(getattr(pub, "last_published", {}) or {})
+    return {
+        "watermark": {str(r): v for r, v in marks.items()},
+        "last_published": {str(r): v for r, v in last_pub.items()},
+    }
+
+
+def apply_serving_state(replicas, meta: Dict[str, Any]) -> None:
+    """Reapply :func:`serving_state` onto a freshly built ReplicaSet
+    (same publisher/window layout)."""
+    marks = meta.get("watermark", {})
+    if hasattr(replicas, "_watermark"):
+        for r in list(replicas._watermark):
+            if str(r) in marks:
+                replicas._watermark[r] = marks[str(r)]
+    pub = getattr(replicas, "publisher", None)
+    if pub is not None and hasattr(pub, "last_published"):
+        for r, v in meta.get("last_published", {}).items():
+            pub.last_published[int(r)] = v
+
+
+def _rng_sections(rng) -> Tuple[Dict[str, np.ndarray], Dict[str, str]]:
+    """Split a PRNG key (or flat dict of keys) into host key-data arrays
+    plus the impl names needed to rebuild typed keys."""
+    import jax
+    if rng is None:
+        return {}, {}
+    if not isinstance(rng, dict):
+        rng = {"key": rng}
+    data, impls = {}, {}
+    for name, key in rng.items():
+        if jax.dtypes.issubdtype(getattr(key, "dtype", None),
+                                 jax.dtypes.prng_key):
+            impls[name] = str(jax.random.key_impl(key))
+            data[name] = np.array(jax.random.key_data(key), copy=True)
+        else:
+            # old-style uint32 raw key: plain array round-trip
+            data[name] = np.array(key, copy=True)
+    return data, impls
+
+
+def _restore_rng(data: Dict[str, np.ndarray], impls: Dict[str, str]):
+    import jax
+    out = {}
+    for name, arr in data.items():
+        impl = impls.get(name)
+        if impl is not None:
+            out[name] = jax.random.wrap_key_data(
+                np.asarray(arr, np.uint32), impl=impl)
+        else:
+            out[name] = np.asarray(arr)
+    if set(out) == {"key"}:
+        return out["key"]
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# The composed snapshot
+# ---------------------------------------------------------------------------
+
+def fleet_state_dict(step: int, train=None, *, rng=None,
+                     windows: Optional[bool] = None,
+                     plan=None, plan_step: Optional[int] = None,
+                     membership=None, controller=None, replicas=None,
+                     counters: bool = True, topology: bool = True,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Compose the versioned, manifest-described fleet snapshot.
+
+    ``step``: the number of COMPLETED steps — the resumed run executes
+    step index ``step`` next.  ``train``: the donated train state
+    pytree in global view (e.g. ``{"variables": ..., "opt_state": ...}``
+    — the opt state brings the carried EF residuals / CHOCO estimates /
+    overlap in-flight buffers along for free, they are ordinary leaves).
+    ``rng``: a PRNG key or ``{name: key}`` dict.  ``windows``: ``None``
+    auto-captures :func:`win_state_dict` when windows exist (BOTH
+    buffers of every double-buffered window), ``False`` skips,
+    ``True`` requires.  ``plan``/``plan_step``: the live
+    :class:`CompiledFaultPlan` and the step its tables had reached
+    (default ``step``).  ``membership`` / ``controller`` / ``replicas``:
+    the host-side directories whose decision state must survive the
+    restart.  ``counters`` records the metrics-registry snapshot;
+    ``topology`` records the compiled mixing matrix (the elastic-restore
+    and neighbor-replica fan-outs read it from the manifest).
+
+    Returns ``{"version", "arrays", "meta"}`` — every array leaf a HOST
+    COPY (safe to write while the donated device buffers keep stepping).
+    """
+    from ..context import ctx, is_initialized
+
+    arrays: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {"step": int(step)}
+    if train is not None:
+        arrays["train"] = _host_copy(train)
+    if windows is None or windows is True:
+        from ..ops import windows as _win
+        if _win.windows_exist():
+            arrays["windows"] = _host_copy(_win.win_state_dict())
+        elif windows is True:
+            raise ValueError(
+                "windows=True but no windows are registered "
+                "(win_create first, or pass windows=False)")
+    rng_data, rng_impls = _rng_sections(rng)
+    if rng_data:
+        arrays["rng"] = rng_data
+        meta["rng_impl"] = rng_impls
+    if is_initialized():
+        cx = ctx()
+        meta["size"] = int(cx.size)
+        if topology:
+            meta["topology"] = np.asarray(
+                cx.compiled_topology.weight_matrix, np.float64).tolist()
+    if plan is not None:
+        meta["plan"] = plan_state(plan, step if plan_step is None
+                                  else plan_step)
+    if membership is not None:
+        meta["membership"] = membership_state(membership)
+    if controller is not None:
+        meta["control"] = controller_state(controller)
+    if replicas is not None:
+        meta["serving"] = serving_state(replicas)
+    if counters:
+        from ..observability import metrics as _metrics
+        meta["counters"] = _metrics.registry.snapshot()
+    if extra:
+        meta["extra"] = dict(extra)
+    meta["sections"] = sorted(arrays) + sorted(
+        k for k in ("plan", "membership", "control", "serving")
+        if k in meta)
+    return {"version": FLEET_STATE_VERSION, "arrays": arrays, "meta": meta}
+
+
+class FleetRestore:
+    """What :func:`load_fleet_state` hands back: the re-deviced train
+    tree, the resume step, and the rebuilt host directories."""
+
+    __slots__ = ("train", "step", "rng", "membership", "plan", "plan_step",
+                 "meta")
+
+    def __init__(self, train, step, rng, membership, plan, plan_step, meta):
+        self.train = train
+        self.step = step
+        self.rng = rng
+        self.membership = membership
+        self.plan = plan
+        self.plan_step = plan_step
+        self.meta = meta
+
+
+def _device_put_leaves(template, leaves: List[np.ndarray]):
+    import jax
+    import jax.numpy as jnp
+    from ..context import is_initialized
+    from ..ops import api as _api
+    sharding = _api.rank_sharding() if is_initialized() else None
+    out = []
+    for t, leaf in zip(jax.tree.leaves(template), leaves):
+        a = jnp.asarray(np.asarray(leaf), dtype=getattr(t, "dtype", None))
+        if sharding is not None and a.ndim >= 1:
+            a = jax.device_put(a, sharding)
+        out.append(a)
+    return jax.tree.unflatten(jax.tree.structure(template), out)
+
+
+def load_fleet_state(state: Dict[str, Any], *, train_template=None,
+                     optimizer=None, controller=None,
+                     windows: str = "auto",
+                     strict: bool = True) -> FleetRestore:
+    """Reapply a :func:`fleet_state_dict` snapshot (or the flat-arrays
+    form ``restore.restore_latest`` returns).
+
+    ``train_template``: a like-structured pytree (the freshly built
+    ``{"variables", "opt_state"}``) the train leaves flow back into —
+    required when the snapshot carries a train section (the snapshot
+    stores data by tree path, not structure).  ``optimizer`` /
+    ``controller``: reapply the γ scale and schedule-mode knobs
+    (traced data — reapplying never recompiles).  ``windows``:
+    ``"auto"`` restores the window section into registered windows when
+    both exist, ``"require"`` raises when either side is missing,
+    ``"skip"`` leaves windows alone.
+
+    Returns a :class:`FleetRestore`; ``strict=True`` raises on a train
+    template/snapshot leaf mismatch instead of silently resuming with
+    half-restored state."""
+    import jax
+    flat = flat_arrays(state)
+    meta = dict(state.get("meta", {}))
+    step = int(meta.get("step", 0))
+
+    train = None
+    train_keys = {k: v for k, v in flat.items()
+                  if k.startswith(TRAIN_PREFIX)}
+    if train_keys:
+        if train_template is None:
+            if strict:
+                raise ValueError(
+                    "snapshot carries a train section: pass "
+                    "train_template= (the freshly built train-state "
+                    "pytree) so the leaves can flow back in by tree path")
+        else:
+            tpl_flat, _ = jax.tree_util.tree_flatten_with_path(
+                train_template)
+            leaves = []
+            for p, t in tpl_flat:
+                key = TRAIN_PREFIX + _keystr(p)
+                if key not in train_keys:
+                    if key.endswith(_INJECTED_GAMMA):
+                        # the controller's per-step-injected γ leaf: a
+                        # stepped template carries it, an init-fresh
+                        # snapshot may not — synthesize from the
+                        # recorded knob (same thing the optimizer's
+                        # _with_control_state does every step)
+                        gamma = float(meta.get("control", {})
+                                      .get("gamma_scale", 1.0))
+                        leaves.append(np.full(
+                            t.shape, gamma,
+                            getattr(t, "dtype", np.float32)))
+                        continue
+                    if not strict:
+                        # tolerant resume across a small layout delta:
+                        # a leaf the snapshot never saw keeps its
+                        # fresh-init template value
+                        leaves.append(np.asarray(t))
+                        continue
+                    raise ValueError(
+                        f"train template leaf {key} missing from the "
+                        f"snapshot (layout changed? rebuild the "
+                        f"optimizer with the same fuse/overlap/"
+                        f"compression knobs the snapshot ran with)")
+                leaves.append(train_keys[key])
+            extra_keys = set(train_keys) - {
+                TRAIN_PREFIX + _keystr(p) for p, _ in tpl_flat}
+            # the injected γ leaf is likewise tolerated in the snapshot
+            # of a STEPPED state restored into an init-fresh template
+            extra_keys = {k for k in extra_keys
+                          if not k.endswith(_INJECTED_GAMMA)}
+            if extra_keys and strict:
+                raise ValueError(
+                    f"snapshot train leaves not in the template: "
+                    f"{sorted(extra_keys)[:4]}")
+            train = _device_put_leaves(train_template, leaves)
+
+    win_keys = {k for k in flat if k.startswith(WINDOWS_PREFIX)}
+    if windows not in ("auto", "require", "skip"):
+        raise ValueError(f"windows must be auto|require|skip, "
+                         f"got {windows!r}")
+    if win_keys and windows != "skip":
+        from ..ops import windows as _win
+        if not _win.windows_exist():
+            if windows == "require" or strict:
+                raise ValueError(
+                    "snapshot carries window state but no windows are "
+                    "registered — win_create the same windows first "
+                    "(or pass windows='skip')")
+        else:
+            tpl = _win.win_state_dict()
+            tpl_flat, tdef = jax.tree_util.tree_flatten_with_path(tpl)
+            leaves = []
+            ok = True
+            for p, t in tpl_flat:
+                key = WINDOWS_PREFIX + _keystr(p)
+                if key not in flat:
+                    if windows == "require" or strict:
+                        raise ValueError(
+                            f"window snapshot missing leaf {key} "
+                            f"(window layout changed?)")
+                    ok = False
+                    break
+                leaves.append(flat[key])
+            if ok:
+                _win.load_win_state_dict(
+                    jax.tree.unflatten(tdef, leaves))
+    elif windows == "require" and not win_keys:
+        raise ValueError("windows='require' but the snapshot has no "
+                         "window section")
+
+    rng_keys = {k[len(RNG_PREFIX):].strip("[']\""): v
+                for k, v in flat.items() if k.startswith(RNG_PREFIX)}
+    rng = _restore_rng(rng_keys, meta.get("rng_impl", {}))
+
+    membership = (restore_membership(meta["membership"])
+                  if "membership" in meta else None)
+    plan, plan_step = (restore_plan(meta["plan"])
+                       if "plan" in meta else (None, None))
+    if controller is not None and "control" in meta:
+        apply_controller_state(controller, meta["control"])
+    elif optimizer is not None and "control" in meta:
+        knobs = getattr(optimizer, "control_knobs", None)
+        if knobs is not None:
+            knobs["gamma_scale"] = float(
+                meta["control"].get("gamma_scale", 1.0))
+    return FleetRestore(train, step, rng, membership, plan, plan_step, meta)

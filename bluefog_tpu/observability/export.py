@@ -33,6 +33,7 @@ __all__ = [
     "validate_jsonl", "REQUIRED_JSONL_KEYS", "resolve_rotation",
     "rotate_file", "read_trail", "Trail", "MAX_MB_ENV", "KEEP_ENV",
     "MEMBERSHIP_SUFFIX", "MembershipTrail", "read_membership_trail",
+    "CKPT_SUFFIX", "CkptTrail", "read_ckpt_trail",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -181,6 +182,67 @@ def read_membership_trail(path: str):
     """Tolerant reader: ``(config_record_or_None, records)`` — the same
     contract as ``read_decisions`` / ``read_serving_trail``."""
     return read_trail(path, "membership_config")
+
+
+# -- durable-fleet-state trail (checkpoint/ subsystem's reporting sink) ------
+
+CKPT_SUFFIX = "ckpt.jsonl"
+
+
+class CkptTrail(Trail):
+    """Sidecar JSONL for the durable-fleet-state subsystem
+    (``<prefix>ckpt.jsonl``): a ``ckpt_config`` head record (directory,
+    cadence, retention, replica fan-out), one ``ckpt`` record per
+    durable save (last durable step, bytes, wall seconds), and one
+    ``ckpt_event`` line per protocol event (``save_begin`` /
+    ``save_commit`` / ``save_skipped`` / ``torn_shard`` /
+    ``replica_repair`` / ``manifest_fallback`` / ``restore`` /
+    ``elastic_restore``) — the machine-readable feed ``bfmonitor
+    --checkpoint`` renders and ``validate_jsonl`` gates
+    (docs/checkpoint.md).
+
+    Unlike the other trails (single-writer by construction) this one is
+    written from several threads — the step loop (save_begin/skip
+    events), the background commit thread (ckpt records), and a restore
+    caller handed ``FleetCheckpointer.trail`` — so :meth:`write` is
+    serialized with an internal lock (the base ``Trail``'s rotation
+    bookkeeping is not thread-safe on its own)."""
+
+    def __init__(self, path: str, *, directory: str, every: int,
+                 keep: int, replicas: int, size: int):
+        import threading
+        self._wlock = threading.Lock()
+        super().__init__(path, head_kind="ckpt_config")
+        self.write({"kind": "ckpt_config", "dir": str(directory),
+                    "every": int(every), "keep": int(keep),
+                    "replicas": int(replicas), "size": int(size)})
+
+    def write(self, record: dict) -> dict:
+        with self._wlock:
+            return super().write(record)
+
+    def write_save(self, step: int, *, durable_step: int, nbytes: int,
+                   save_s: float, shards: int) -> dict:
+        return self.write({"kind": "ckpt", "step": int(step),
+                           "durable_step": int(durable_step),
+                           "bytes": int(nbytes), "save_s": float(save_s),
+                           "shards": int(shards)})
+
+    def write_event(self, step: int, event: str, *,
+                    rank: Optional[int] = None,
+                    detail: Optional[str] = None) -> dict:
+        rec = {"kind": "ckpt_event", "step": int(step), "event": str(event)}
+        if rank is not None:
+            rec["rank"] = int(rank)
+        if detail is not None:
+            rec["detail"] = str(detail)
+        return self.write(rec)
+
+
+def read_ckpt_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as the other sidecar trails."""
+    return read_trail(path, "ckpt_config")
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -468,6 +530,13 @@ _KIND_REQUIRED = {
     "membership_config": ("t_us",),
     "membership": ("step", "t_us", "active", "syncing"),
     "membership_event": ("step", "t_us", "rank", "transition"),
+    # durable-fleet-state trail (CkptTrail above, fed by the
+    # checkpoint/ subsystem's FleetCheckpointer and restore path): a
+    # config head, one "ckpt" record per durable save, one "ckpt_event"
+    # line per commit-protocol event (docs/checkpoint.md)
+    "ckpt_config": ("t_us",),
+    "ckpt": ("step", "t_us", "durable_step", "bytes", "save_s"),
+    "ckpt_event": ("step", "t_us", "event"),
     # health verdict trail (observability/health.py write_verdicts): one
     # "report" summary line per evaluation window, then one "verdict"
     # line per finding.  The trail shares this module's rotation policy
@@ -570,6 +639,33 @@ def _check_membership(path, lineno, rec):
                 f"{path}:{lineno}: membership_event 'rank' is not numeric")
 
 
+def _check_ckpt(path, lineno, rec):
+    """Checkpoint-trail record shapes (CkptTrail): ``ckpt`` carries the
+    durable-save accounting, ``ckpt_event`` one commit-protocol event.
+    Unknown fields stay tolerated."""
+    kind = rec["kind"]
+    if kind == "ckpt":
+        for field in ("durable_step", "bytes", "save_s"):
+            v = rec[field]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: ckpt {field!r} is not numeric")
+        shards = rec.get("shards")
+        if shards is not None and (isinstance(shards, bool)
+                                   or not isinstance(shards, (int, float))):
+            raise ValueError(
+                f"{path}:{lineno}: ckpt 'shards' is not numeric")
+    elif kind == "ckpt_event":
+        if not isinstance(rec["event"], str):
+            raise ValueError(
+                f"{path}:{lineno}: ckpt_event 'event' must be a string")
+        rank = rec.get("rank")
+        if rank is not None and (isinstance(rank, bool)
+                                 or not isinstance(rank, (int, float))):
+            raise ValueError(
+                f"{path}:{lineno}: ckpt_event 'rank' is not numeric")
+
+
 def _check_structured(path, lineno, rec, check):
     """Shape checks for the documented structured fields: ``phases``
     (PR 7), ``step_wall_us`` (PR 7), ``edges`` and ``overlap_efficiency``
@@ -643,7 +739,9 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     ``serve_failover`` / ``serve_admit`` / ``serve_retire`` /
     ``serve_config``, serving/router.py), membership-trail lines
     (``kind: membership`` / ``membership_event`` /
-    ``membership_config``, the :class:`MembershipTrail` above), and
+    ``membership_config``, the :class:`MembershipTrail` above),
+    checkpoint-trail lines (``kind: ckpt`` / ``ckpt_event`` /
+    ``ckpt_config``, the :class:`CkptTrail` above), and
     health-verdict-trail lines (``kind: report`` / ``verdict``,
     health.py) validate against their own required keys and shape
     instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
@@ -679,6 +777,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 _check_serve(path, lineno, rec)
             elif kind in ("membership", "membership_event"):
                 _check_membership(path, lineno, rec)
+            elif kind in ("ckpt", "ckpt_event"):
+                _check_ckpt(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

@@ -1024,8 +1024,11 @@ def win_state_dict() -> Dict[str, Dict[str, jax.Array]]:
 
     The reference cannot checkpoint async training mid-flight (its window
     memory lives in MPI RMA buffers, SURVEY.md §5.4); here the window state
-    is ordinary arrays, so push-sum runs resume exactly
-    (``utils/checkpoint.py`` + this pair of functions).
+    is ordinary arrays, so push-sum runs resume exactly.  The durable-
+    fleet-state subsystem captures this snapshot automatically
+    (``checkpoint.fleet_state_dict`` — its ``windows`` section) and
+    restores it through :func:`load_win_state_dict`; the pair also works
+    standalone with any single-tree checkpointer (docs/checkpoint.md).
     """
     # COPIES, not references: window ops donate the state arrays on TPU
     # (in-place updates), so a live view would be deleted under an

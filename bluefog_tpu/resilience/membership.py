@@ -335,6 +335,26 @@ class ElasticMembership:
         gossip quorum in :meth:`observe`."""
         self._synced.add(rank)
 
+    def admit_restored(self, rank: int, step: int
+                       ) -> List[Tuple[int, int, str]]:
+        """Offline admission: narrate the full announced → syncing →
+        active transition for a rank whose parameter bootstrap happened
+        from CHECKPOINTED shards rather than the live window gossip
+        (``checkpoint/restore.py``'s elastic grow path).  The quorum
+        machine is deliberately not consulted — during a restore there
+        is no fleet to gossip with; the trusted in-neighbors are the
+        checkpoint itself.  Returns the transitions recorded."""
+        out = []
+        tr = self.announce(rank, step)
+        if tr is not None:
+            out.append(tr)
+        self.mark_synced(rank)
+        if self.states[rank] == STATE_ANNOUNCED:
+            out.append(self._set(rank, STATE_SYNCING, step))
+        if self.states[rank] == STATE_SYNCING:
+            out.append(self._set(rank, STATE_ACTIVE, step))
+        return out
+
     # -- the gossip-driven drive --------------------------------------------
 
     def observe(self, last_heard, step: int) -> List[Tuple[int, int, str]]:

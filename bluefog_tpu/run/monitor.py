@@ -34,6 +34,7 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
+           "render_checkpoint",
            "render_edge_heatmap", "render_decisions", "render_serving",
            "render_membership"]
 
@@ -104,6 +105,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  decisions_path: Optional[str] = None,
                  serving_path: Optional[str] = None,
                  membership_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -122,7 +124,13 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     discovery: ``<prefix>membership.jsonl``,
     ``observability/export.py::MembershipTrail``) — per-rank membership
     states, active/syncing counts, and join/leave transitions become
-    the ``"membership"`` block and the ``--membership`` panel."""
+    the ``"membership"`` block and the ``--membership`` panel.
+    ``checkpoint_path``: the durable-fleet-state trail (default
+    discovery: ``<prefix>ckpt.jsonl``,
+    ``observability/export.py::CkptTrail``) — last durable step, save
+    seconds/bytes, and commit-protocol events (torn shards, replica
+    repairs, restores) become the ``"checkpoint"`` block and the
+    ``--checkpoint`` panel."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -190,6 +198,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     out["decisions"] = _decisions_block(prefix, decisions_path)
     out["serving"] = _serving_block(prefix, serving_path)
     out["membership"] = _membership_block(prefix, membership_path)
+    out["checkpoint"] = _checkpoint_block(prefix, checkpoint_path)
     return view, report, _strict_json(out)
 
 
@@ -296,6 +305,81 @@ def _membership_block(prefix: str,
             "recent": events[-6:],
         },
     }
+
+
+def _checkpoint_block(prefix: str,
+                      checkpoint_path: Optional[str]) -> Optional[dict]:
+    """The durable-fleet-state trail as a report block: the newest
+    durable step, save accounting, and the commit-protocol event tally
+    (torn shards, replica repairs, restores, skipped saves) — None when
+    no trail exists (a run without checkpointing stays noise-free)."""
+    from ..observability.export import CKPT_SUFFIX, read_ckpt_trail
+    path = checkpoint_path or prefix + CKPT_SUFFIX
+    config, records = read_ckpt_trail(path)
+    if config is None and not records:
+        return None
+    saves = [r for r in records if r.get("kind") == "ckpt"]
+    events = [r for r in records if r.get("kind") == "ckpt_event"]
+    counts = {}
+    for e in events:
+        key = e.get("event")
+        counts[key] = counts.get(key, 0) + 1
+    latest = saves[-1] if saves else {}
+    return {
+        "path": path,
+        "dir": (config or {}).get("dir"),
+        "every": (config or {}).get("every"),
+        "keep": (config or {}).get("keep"),
+        "replicas": (config or {}).get("replicas"),
+        "last_durable_step": latest.get("durable_step"),
+        "bytes": latest.get("bytes"),
+        "save_s": latest.get("save_s"),
+        "shards": latest.get("shards"),
+        "saves": len(saves),
+        "save_s_series": [s.get("save_s") for s in saves
+                          if isinstance(s.get("save_s"),
+                                        (int, float))][-24:],
+        "torn_shards": counts.get("torn_shard", 0),
+        "replica_repairs": counts.get("replica_repair", 0),
+        "restores": (counts.get("restore", 0)
+                     + counts.get("elastic_restore", 0)),
+        "skipped": counts.get("save_skipped", 0),
+        "events": {
+            "total": len(events),
+            "counts": counts,
+            "recent": events[-6:],
+        },
+    }
+
+
+def render_checkpoint(block: dict, *, width: int = 12) -> str:
+    """Terminal panel for the checkpoint block: durability headline,
+    save-time sparkline, and protocol-event alerts."""
+    lines = [f"checkpoint  dir={block.get('dir')}  "
+             f"every={block.get('every')}  keep={block.get('keep')}  "
+             f"replicas={block.get('replicas')}"]
+    spark = sparkline(block.get("save_s_series") or [], width=width)
+    lines.append(
+        f"  durable step {block.get('last_durable_step')}  "
+        f"saves {block.get('saves')}  "
+        f"last {_fmt(block.get('save_s'), 's')} / "
+        f"{_fmt(block.get('bytes'), 'B')}  {spark}")
+    alerts = []
+    if block.get("torn_shards"):
+        alerts.append(f"torn shards: {block['torn_shards']}")
+    if block.get("replica_repairs"):
+        alerts.append(f"replica repairs: {block['replica_repairs']}")
+    if block.get("restores"):
+        alerts.append(f"restores: {block['restores']}")
+    if block.get("skipped"):
+        alerts.append(f"skipped saves: {block['skipped']}")
+    if alerts:
+        lines.append("  ⚠ " + "; ".join(alerts))
+    for e in (block.get("events") or {}).get("recent", []):
+        lines.append(f"    step {e.get('step')}: {e.get('event')}"
+                     + (f" ({e.get('detail')})" if e.get("detail")
+                        else ""))
+    return "\n".join(lines)
 
 
 def render_membership(block: dict, *, width: int = 12) -> str:
@@ -525,6 +609,14 @@ def main(argv=None) -> int:
     p.add_argument("--membership-trail", default=None, metavar="PATH",
                    help="membership trail to render (default: "
                         "<prefix>membership.jsonl when it exists)")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="render the durable-fleet-state panel (last "
+                        "durable step, save-time sparkline, torn-shard/"
+                        "replica-repair alerts) from the "
+                        "<prefix>ckpt.jsonl trail")
+    p.add_argument("--checkpoint-trail", default=None, metavar="PATH",
+                   help="checkpoint trail to render (default: "
+                        "<prefix>ckpt.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -540,7 +632,8 @@ def main(argv=None) -> int:
             args.prefix, window=args.window, expected_ranks=args.ranks,
             verdicts_path=args.verdicts, decisions_path=args.decisions,
             serving_path=args.serving_trail,
-            membership_path=args.membership_trail, cache=cache)
+            membership_path=args.membership_trail,
+            checkpoint_path=args.checkpoint_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -563,6 +656,14 @@ def main(argv=None) -> int:
                 else:
                     print("\n(no serving trail yet — the router writes "
                           "<prefix>serving.jsonl; see docs/serving.md)")
+            if args.checkpoint:
+                if out.get("checkpoint"):
+                    print()
+                    print(render_checkpoint(out["checkpoint"]))
+                else:
+                    print("\n(no checkpoint trail yet — the "
+                          "FleetCheckpointer writes <prefix>ckpt.jsonl; "
+                          "see docs/checkpoint.md)")
             if args.edges:
                 edges = out.get("edges")
                 if edges:

@@ -1,13 +1,24 @@
-"""Checkpoint / resume (orbax-backed).
+"""Checkpoint / resume — compat shim over ``bluefog_tpu/checkpoint/``.
 
-The reference has no in-framework checkpointing — its supported pattern is
-vanilla torch ``save``/``load`` on rank 0 plus the state *distribution*
-helpers ``broadcast_parameters`` / ``broadcast_optimizer_state``
-(bluefog/torch/utility.py:26-218, SURVEY.md §5.4; examples checkpoint on
-rank 0 in examples/pytorch_resnet.py).  The TPU-native equivalent is
-simpler — one controller owns the global state — and stronger: orbax
-handles async multi-host-safe writes of sharded arrays, so the same API
-works from a laptop CPU mesh to a multi-host pod.
+The reference has no in-framework checkpointing — its supported pattern
+is vanilla torch ``save``/``load`` on rank 0 plus the state
+*distribution* helpers ``broadcast_parameters`` /
+``broadcast_optimizer_state`` (bluefog/torch/utility.py:26-218,
+SURVEY.md §5.4).  An earlier revision of this module claimed the
+TPU-native equivalent is simpler because "one controller owns the
+global state" — that was wrong for exactly the reason this framework
+exists: decentralized ranks hold DIVERGENT parameters (plus per-rank
+error-feedback residuals, CHOCO estimates, and in-flight overlap
+buffers), which is why the real subsystem's manifest records one shard
+per rank instead of one global tree.
+
+This module keeps its historical public API (:class:`Checkpointer`,
+:func:`save_checkpoint`, :func:`restore_checkpoint` — orbax-backed
+single-tree save/restore) as a thin delegation to
+``bluefog_tpu.checkpoint.compat``.  New code should use the subsystem
+proper — ``checkpoint.fleet_state_dict`` +
+``checkpoint.FleetCheckpointer`` for crash-consistent, neighbor-
+replicated, elastically-restorable fleet snapshots (docs/checkpoint.md).
 
     ckpt = bf.utils.checkpoint.Checkpointer("/tmp/run1", max_to_keep=3)
     ckpt.save(step, {"variables": variables, "opt_state": opt_state})
@@ -16,89 +27,7 @@ works from a laptop CPU mesh to a multi-host pod.
     step0 = ckpt.latest_step()
 """
 
-import os
-from typing import Any, Optional
-
-import jax
+from ..checkpoint.compat import (Checkpointer,  # noqa: F401
+                                 restore_checkpoint, save_checkpoint)
 
 __all__ = ["Checkpointer", "save_checkpoint", "restore_checkpoint"]
-
-
-class Checkpointer:
-    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
-
-    State is any pytree of jax/numpy arrays (shardings are preserved and
-    restored).  Python scalars/ints ride along as pytree leaves.
-    """
-
-    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
-        import orbax.checkpoint as ocp
-        self._ocp = ocp
-        self.directory = os.path.abspath(directory)
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True),
-        )
-
-    def save(self, step: int, state: Any, *, force: bool = False,
-             wait: bool = True) -> bool:
-        """Write ``state`` for ``step``; async under the hood.  ``wait``
-        blocks until the write is durable (set False to overlap with the
-        next training steps and call ``wait_until_finished`` later)."""
-        ok = self._mgr.save(
-            int(step), args=self._ocp.args.StandardSave(state), force=force)
-        if wait:
-            self._mgr.wait_until_finished()
-        return ok
-
-    def restore(self, step: Optional[int] = None, template: Any = None):
-        """Restore ``step`` (default: latest).  ``template``: a pytree of
-        like-shaped (possibly sharded) arrays — supply it to restore
-        directly onto the right devices/shardings."""
-        step = self.latest_step() if step is None else int(step)
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
-        if template is not None:
-            args = self._ocp.args.StandardRestore(template)
-            return self._mgr.restore(step, args=args)
-        try:
-            return self._mgr.restore(step)
-        except KeyError:
-            # older orbax (<0.9) cannot infer the handler for an argless
-            # restore of a StandardSave item; an explicit template-less
-            # StandardRestore names the handler and restores as numpy
-            return self._mgr.restore(
-                step, args=self._ocp.args.StandardRestore())
-
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def all_steps(self):
-        return sorted(self._mgr.all_steps())
-
-    def wait_until_finished(self):
-        self._mgr.wait_until_finished()
-
-    def close(self):
-        self._mgr.close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-
-def save_checkpoint(directory: str, step: int, state: Any) -> None:
-    """One-shot convenience (reference users called torch.save on rank 0)."""
-    with Checkpointer(directory) as ckpt:
-        ckpt.save(step, state)
-
-
-def restore_checkpoint(directory: str, step: Optional[int] = None,
-                       template: Any = None):
-    """One-shot convenience; returns the restored pytree."""
-    with Checkpointer(directory) as ckpt:
-        return ckpt.restore(step, template)

@@ -65,6 +65,16 @@ block; (B) a scale-down plan mirrors it with exactly one departure;
 (C) the whole episode — plus a churn plan swapped onto the SAME harness
 — reuses one compiled step program (zero recompiles after warmup).
 
+``--ckpt`` (``make ckpt-smoke``) adds the durable-fleet-state gate
+(docs/checkpoint.md): a real int8+fused training loop checkpoints on
+cadence through the FleetCheckpointer; a kill mid-save (shards, no
+manifest) is invisible, a shard torn AFTER publish (checksum mismatch,
+replicas torn too) makes restore fall back to the previous durable
+manifest and resume BIT-EXACT versus the uninterrupted run; a deleted
+local shard restores from its neighbor replica; and the whole episode
+is verified through the real ``bfmonitor --once --json``
+``"checkpoint"`` block with a schema-valid ckpt trail.
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -462,6 +472,153 @@ def elastic_legs(n, tmp):
     }
 
 
+CKPT_STEPS, CKPT_SPLIT = 12, 8
+
+
+def ckpt_legs(n, tmp):
+    """The ``make ckpt-smoke`` gate (docs/checkpoint.md): (A) a real
+    int8+fused training loop checkpoints on cadence through the
+    FleetCheckpointer; a kill mid-save (shards without a manifest) is
+    invisible, and a shard torn AFTER publish (checksum mismatch, its
+    replicas torn too) makes restore fall back to the previous durable
+    manifest and resume BIT-EXACT versus the uninterrupted run; (B) a
+    deleted local shard restores from its neighbor replica; (C) the
+    whole episode is verified through the real ``bfmonitor --once
+    --json`` ``"checkpoint"`` block."""
+    import glob
+    import shutil
+    from bluefog_tpu import checkpoint as CK
+    from bluefog_tpu.observability import metrics as MET
+
+    MET.enable()
+    prefix = os.path.join(tmp, "ckpt_")
+    ckdir = os.path.join(tmp, "fleet_ck")
+    rng = np.random.default_rng(3)
+    params0 = {"w": jnp.asarray(rng.normal(size=(n, 6)), jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.normal(size=(n, 6)) * 0.1, jnp.float32),
+             "b": jnp.asarray(rng.normal(size=(n, 3)) * 0.1, jnp.float32)}
+
+    def make_opt():
+        return bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.05), fuse=True, compression="int8")
+
+    EX.metrics_start(prefix, rank=0)
+    opt = make_opt()
+    st = opt.init(params0)
+    p = params0
+    ck = CK.FleetCheckpointer(
+        ckdir, every=2, keep=2, replicas=1, async_commit=True,
+        trail_path=prefix + EX.CKPT_SUFFIX, size=n)
+    snap_at_split = None
+    for t in range(CKPT_STEPS):
+        p, st = opt.step(p, grads, st, step=t)
+        state = CK.fleet_state_dict(
+            t + 1, {"params": p, "opt_state": st}, windows=False)
+        if t + 1 == CKPT_SPLIT:
+            snap_at_split = state
+        # wait() between cadence ticks: the gate must exercise every
+        # save, not skip under the async double buffer on a slow host
+        if ck.maybe_save(t + 1, state):
+            ck.wait()
+        EX.log_step(t, extra={"loss": 1.0 / (t + 1)})
+    EX.metrics_end()
+    ck.wait()
+    if ck.last_durable != CKPT_STEPS:
+        fail(f"expected durable step {CKPT_STEPS}, got {ck.last_durable}")
+    # the uninterrupted run's parameters at the final step
+    cont_p = p
+
+    # reference continuation from the split snapshot (never killed):
+    # proves the resume path itself is deterministic before any chaos
+    fr = CK.load_fleet_state(
+        snap_at_split, train_template={"params": params0,
+                                       "opt_state": opt.init(params0)})
+    ref_p, ref_st = fr.train["params"], fr.train["opt_state"]
+    for t in range(CKPT_SPLIT, CKPT_STEPS):
+        ref_p, ref_st = opt.step(ref_p, grads, ref_st, step=t)
+    for k in ref_p:
+        if np.asarray(ref_p[k]).tobytes() != np.asarray(
+                cont_p[k]).tobytes():
+            fail(f"reference resume drifted on {k!r} before any chaos")
+
+    # -- leg A: kill mid-save + torn newest manifest --------------------
+    # kill mid-save: a step dir with shards but no manifest
+    partial = os.path.join(ckdir, CK.step_dir_name(CKPT_STEPS + 2))
+    os.makedirs(partial)
+    CK.write_shard(os.path.join(partial, CK.shard_name(0)),
+                   {"x": np.zeros(3, np.float32)})
+    # torn after publish: newest manifest's rank-1 shard AND replicas
+    newest = os.path.join(ckdir, CK.step_dir_name(CKPT_STEPS))
+    with open(os.path.join(newest, CK.shard_name(1)), "wb") as f:
+        f.write(b"torn mid write")
+    for rep in glob.glob(os.path.join(newest, "replicas", "rank-1.*")):
+        with open(rep, "wb") as f:
+            f.write(b"torn too")
+    r = CK.restore_latest(ckdir, trail=ck.trail)
+    if r.step != CKPT_SPLIT + 2:
+        fail(f"torn newest manifest should fall back to the previous "
+             f"durable step {CKPT_SPLIT + 2}, restored {r.step}")
+    if not r.fell_back:
+        fail("restore did not record the abandoned torn manifest")
+    # bit-exact resume from the fallback manifest
+    opt2 = make_opt()
+    fr2 = CK.load_fleet_state(
+        r, train_template={"params": params0,
+                           "opt_state": opt2.init(params0)})
+    r_p, r_st = fr2.train["params"], fr2.train["opt_state"]
+    for t in range(fr2.step, CKPT_STEPS):
+        r_p, r_st = opt2.step(r_p, grads, r_st, step=t)
+    for k in cont_p:
+        if np.asarray(r_p[k]).tobytes() != np.asarray(
+                cont_p[k]).tobytes():
+            fail(f"post-fallback resume not bit-exact on {k!r}")
+
+    # -- leg B: deleted local shard -> neighbor replica -----------------
+    shutil.rmtree(os.path.join(ckdir, CK.step_dir_name(CKPT_STEPS)))
+    durable = os.path.join(ckdir, CK.step_dir_name(CKPT_SPLIT + 2))
+    os.remove(os.path.join(durable, CK.shard_name(2)))
+    repairs0 = MET.counter("bf_ckpt_replica_repairs_total").value()
+    r2 = CK.restore_latest(ckdir, trail=ck.trail)
+    if r2.step != CKPT_SPLIT + 2 or not r2.repaired:
+        fail(f"deleted shard not repaired from a replica: step "
+             f"{r2.step}, repaired {r2.repaired}")
+    if MET.counter("bf_ckpt_replica_repairs_total").value() <= repairs0:
+        fail("bf_ckpt_replica_repairs_total did not count the repair")
+    for key in r.arrays:
+        if r.arrays[key].tobytes() != r2.arrays[key].tobytes():
+            fail(f"replica-repaired restore differs from the intact "
+                 f"one on {key}")
+    ck.close()
+
+    # -- leg C: the real bfmonitor renders the episode ------------------
+    _, out = bfmonitor_json(prefix, "--checkpoint")
+    block = out.get("checkpoint")
+    if not block:
+        fail("bfmonitor --once --json has no checkpoint block")
+    if block.get("last_durable_step") != CKPT_STEPS:
+        fail(f"bfmonitor checkpoint block durable step "
+             f"{block.get('last_durable_step')} != {CKPT_STEPS}")
+    if not block.get("torn_shards") or not block.get("replica_repairs"):
+        fail(f"bfmonitor checkpoint block missed the chaos events: "
+             f"{block}")
+    if block.get("restores", 0) < 2:
+        fail(f"bfmonitor checkpoint block missed the restores: {block}")
+    try:
+        EX.validate_jsonl(prefix + EX.CKPT_SUFFIX)
+    except ValueError as e:
+        fail(f"ckpt trail schema violation: {e}")
+    return {
+        "durable_step": ck.last_durable,
+        "fallback_step": r.step,
+        "repaired": [[rk, pth] for rk, pth in r2.repaired],
+        "saves": int(MET.counter("bf_ckpt_saves_total").value()),
+        "torn": int(MET.counter("bf_ckpt_torn_shards_total").value()),
+        "repairs": int(
+            MET.counter("bf_ckpt_replica_repairs_total").value()),
+    }
+
+
 SERVE_STEPS, SERVE_REQS, SERVE_BOUND = 14, 4, 3
 
 
@@ -753,6 +910,7 @@ def main():
     do_control = "--control" in sys.argv
     do_serve = "--serve" in sys.argv
     do_elastic = "--elastic" in sys.argv
+    do_ckpt = "--ckpt" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -848,6 +1006,12 @@ def main():
         EX.metrics_end()           # release the sink for the chaos legs
         elastic_out = elastic_legs(n, tmp)
 
+    # -- durable-fleet-state gate (--ckpt / make ckpt-smoke) ------------
+    ckpt_out = None
+    if do_ckpt:
+        EX.metrics_end()           # release the sink for the ckpt legs
+        ckpt_out = ckpt_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -884,6 +1048,8 @@ def main():
         out["serve"] = serve_out
     if elastic_out:
         out["elastic"] = elastic_out
+    if ckpt_out:
+        out["ckpt"] = ckpt_out
     print(json.dumps(out))
 
 
