@@ -12,6 +12,11 @@
 #   make test_torch         # torch frontend
 #   make examples           # smoke-run every example (run_all_examples.sh)
 #   make bench              # headline benchmark (real TPU if available)
+#   make bench-kernel       # gated trace check: single-kernel gossip hot
+#                           # path (one pallas_call/bucket, wire bytes) —
+#                           # next to bench-compress in the gate family
+#   make bench-hw           # hardened hardware bench: probe first, retry
+#                           # init with fresh processes, bank diagnosis
 #   make lint               # pre-PR gate: bflint AST contract rules +
 #                           # StableHLO trace-hazard pass (docs/static_analysis.md)
 
@@ -20,7 +25,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
-        bench-trace bench-overlap bench-compress bench-hybrid hwcheck \
+        bench-trace bench-overlap bench-compress bench-hybrid \
+        bench-kernel bench-hw hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
         ckpt-smoke bench-serve bench-ckpt lint
@@ -126,6 +132,48 @@ bench-hybrid:
 	assert h['fsdp2_int8']['ppermute_bytes_per_step'] * 2 \
 	       <= h['fsdp2']['ppermute_bytes_per_step'], \
 	       'int8 on top of fsdp=2 did not multiply the reduction'"
+
+# Single-kernel gossip evidence (CPU, docs/performance.md "Single-kernel
+# gossip"; sits next to bench-compress in the trace-gate family):
+# bench-trace JSON with the "kernel" block — the canonical fused-int8
+# train step under BLUEFOG_GOSSIP_KERNEL, GATED on the HLO-op-count and
+# wire-byte invariants: the TPU-export lowering runs exactly ONE
+# pallas_call per fusion bucket with ZERO standalone collective-permutes
+# and zero widening wire converts; the any-backend emulate transport
+# keeps the exact permute budget (buckets x offsets x 2 wire arrays) and
+# moves the SAME wire bytes as the chain; and the knob-off lowering is
+# byte-identical across env spellings (the off path is the frozen chain).
+bench-kernel:
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); k=d['kernel']; p=k['pallas']; e=k['emulate']; \
+	print(json.dumps(d)); \
+	assert 'skipped' not in p, 'kernel lowering skipped: %s' % p.get('skipped'); \
+	print('kernel: %d pallas_call(s) for %d bucket(s) | %d ppermutes | ' \
+	      '%d wire upcasts | emulate %d/%d ppermutes, %d wire bytes ' \
+	      '(chain %d) | off identical: %s' \
+	      % (p['pallas_calls'], p['buckets'], p['ppermute'], \
+	         p['wire_upcasts'], e['ppermute'], e['expected_ppermute'], \
+	         e['ppermute_bytes_per_step'], \
+	         e['chain_ppermute_bytes_per_step'], \
+	         k['off']['identical_to_env_off'])); \
+	assert p['pallas_calls'] == p['buckets'] and p['ppermute'] == 0, \
+	       'hot path is not one pallas_call per bucket'; \
+	assert p['wire_upcasts'] == 0, 'widening convert feeds the wire'; \
+	assert e['ppermute'] == e['expected_ppermute'], 'emulate permute budget'; \
+	assert e['ppermute_bytes_per_step'] == e['chain_ppermute_bytes_per_step'], \
+	       'emulate wire bytes drifted from the chain'; \
+	assert k['off']['identical_to_env_off'], 'knob-off lowering not inert'"
+
+# Hardened hardware bench path (docs/performance.md "Re-earning the
+# hardware number"): BENCH_r02-r05 all died in backend init with nothing
+# banked.  bench-hw runs the transport diagnosis probe FIRST, then
+# retries `python bench.py` with FRESH processes up to
+# BENCH_INIT_ATTEMPTS times (backoff BENCH_INIT_BACKOFF seconds, x2 per
+# attempt), and ALWAYS banks the structured "diagnosis" JSON — a dead
+# window ends with banked evidence, never an empty round.  Run under the
+# kernel knob for the on/off delta: BLUEFOG_GOSSIP_KERNEL=1 make bench-hw
+bench-hw:
+	bash scripts/bench_hw.sh
 
 # Observability smoke (<=60s, CPU): 5-step telemetry-on loop — validates
 # the JSONL schema (BLUEFOG_METRICS sink) and that consensus distance is

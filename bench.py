@@ -422,6 +422,11 @@ def trace_only_main():
     """
     # force the virtual CPU mesh BEFORE any backend initializes
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # ambient BLUEFOG_GOSSIP_KERNEL must not leak into the canonical
+    # chain legs (docs tell operators to export it for `make bench-hw`;
+    # a Mosaic kernel cannot lower for the CPU backend) — the "kernel"
+    # block below builds its modes explicitly
+    os.environ.pop("BLUEFOG_GOSSIP_KERNEL", None)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -553,6 +558,70 @@ def trace_only_main():
                 hybrid_report[lbl]["ppermute_bytes_per_step"], 1), 2)
             for lbl in ("fsdp2", "fsdp2_int8")}
 
+    # Single-kernel gossip evidence (docs/performance.md "Single-kernel
+    # gossip"): the canonical fused-int8 config under BLUEFOG_GOSSIP_
+    # KERNEL.  Three legs: (1) the REAL kernel step lowered for the TPU
+    # platform via jax.export (Mosaic serializes at lowering time — no
+    # device needed) must run exactly ONE pallas_call per fusion bucket
+    # with ZERO standalone collective_permutes and zero widening wire
+    # converts; (2) the any-backend "emulate" transport must keep the
+    # wire-byte invariant (permute payloads at wire dtype, budget =
+    # buckets x offsets x 2 arrays); (3) the knob OFF must lower the
+    # byte-identical chain (hash equality across env spellings).  The
+    # `make bench-kernel` gate asserts all three.
+    import hashlib
+
+    from bluefog_tpu.analysis import tracehazards as TH
+
+    kernel_report = {}
+    kvars, kstate = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    kargs = (kvars, kstate, (x, y), jnp.int32(0))
+
+    def _int8_step(gossip_kernel, donate=False):
+        return T.make_train_step(
+            model, base, communication="neighbor_allreduce", fuse=True,
+            compression="int8", gossip_kernel=gossip_kernel,
+            donate=donate)
+
+    off_text, _ = TM.lower_text(_int8_step(None), *kargs)
+    prev = os.environ.get("BLUEFOG_GOSSIP_KERNEL")
+    try:
+        os.environ["BLUEFOG_GOSSIP_KERNEL"] = "0"
+        off0_text, _ = TM.lower_text(_int8_step(None), *kargs)
+    finally:
+        if prev is None:
+            os.environ.pop("BLUEFOG_GOSSIP_KERNEL", None)
+        else:
+            os.environ["BLUEFOG_GOSSIP_KERNEL"] = prev
+    kernel_report["off"] = {
+        "stablehlo_sha256": hashlib.sha256(off_text.encode()).hexdigest(),
+        "identical_to_env_off": off_text == off0_text,
+        "ppermute": TM.count_collectives_in_text(off_text)["ppermute"],
+    }
+    try:
+        ktext = TH.export_kernel_step_text(
+            _int8_step("pallas", donate=True), *kargs)
+        kernel_report["pallas"] = {
+            "pallas_calls": TH.count_pallas_calls_in_text(ktext),
+            "buckets": plan.n_buckets,
+            "ppermute": TM.count_collectives_in_text(ktext)["ppermute"],
+            "wire_upcasts": len(TH.find_wire_upcasts(ktext, "kernel",
+                                                     kernel=True)),
+        }
+    except Exception as e:  # noqa: BLE001 — banked, gated non-zero below
+        kernel_report["pallas"] = {
+            "skipped": f"{type(e).__name__}: {e}"}
+    em = TM.collective_counts(_int8_step("emulate"), *kargs)
+    kernel_report["emulate"] = {
+        "ppermute": em["ppermute"],
+        "expected_ppermute": plan.n_buckets * offsets * 2,
+        "ppermute_bytes_per_step": em["ppermute_bytes"],
+        "chain_ppermute_bytes_per_step":
+            compress_report["int8"]["ppermute_bytes_per_step"],
+    }
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -576,6 +645,7 @@ def trace_only_main():
             for lbl in ("int8", "topk")},
         "hybrid": hybrid_report,
         "hybrid_bytes_drop": hybrid_drop,
+        "kernel": kernel_report,
         # final host-registry snapshot: comm-volume, fusion-plan shape and
         # cache stats travel WITH the perf number in the BENCH_*.json
         "metrics": bf_metrics.registry.snapshot(),

@@ -21,23 +21,31 @@ only exist in the lowered program:
     budget (``buckets × offsets × wire arrays per bucket``) — an extra
     permute means a leaf escaped the flat-buffer path (per-leaf traffic
     snuck back in); a missing one means an exchange silently dropped.
+    Under ``BLUEFOG_GOSSIP_KERNEL`` the hot path has NO standalone
+    permutes at all — the RDMA lives inside the fused kernel — so the
+    budget flips: ``pallas_call`` EXECUTIONS (``tpu_custom_call``
+    custom-calls, counted through the call graph because XLA dedupes
+    identical kernel wrappers into one shared function) must equal the
+    bucket count and the permute count must be ZERO.
 
 All three run over the text :func:`~..utils.trace_metrics.lower_text`
 produces, so the pass is CPU-only and backend-free like the rest of the
 trace-metrics evidence.  :func:`run_canonical_trace_checks` applies them
 to the canonical ``bench.py --trace-only`` configs (the fused f32 and
-fused+int8 train steps, built ``donate=True``), which is what
+fused+int8 train steps, built ``donate=True``), plus — lowered for the
+TPU platform via ``jax.export`` (Mosaic serialization needs no device)
+— the fused-int8 step with the gossip kernel ON, which is what
 ``make lint`` and ``tests/test_lint_clean.py`` gate on.
 """
 
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .findings import Finding
 
 __all__ = ["TRACE_RULES", "check_donation", "find_wire_upcasts",
-           "check_collective_budget", "analyze_trace",
-           "run_canonical_trace_checks"]
+           "count_pallas_calls_in_text", "check_collective_budget",
+           "analyze_trace", "run_canonical_trace_checks"]
 
 TRACE_RULES = ("trace-donation-dropped", "trace-wire-upcast",
                "trace-collective-budget")
@@ -93,14 +101,103 @@ def check_donation(text: str, label: str,
         f"every dropped donation (silent 2x HBM on the biggest arrays)")]
 
 
-def find_wire_upcasts(text: str, label: str) -> List[Finding]:
+# pallas kernels lower to `stablehlo.custom_call @tpu_custom_call` with
+# the Mosaic module serialized in backend_config; interpret-mode
+# lowerings instead inline the body into private functions jax names
+# after the kernel (`*_gossip_kernel*` / `*kernel*`) — converts in THERE
+# are the kernel's in-register decode, not a wire upcast
+_PALLAS_CALL = re.compile(r"stablehlo\.custom_call\s+@tpu_custom_call")
+# jax.export prints `func.func public @main`; jit lowerings print bare
+# `func.func @main` and `func.func private @helper` — all three shapes
+# must parse or the call-graph walk loses its roots
+_FUNC_DEF = re.compile(
+    r"func\.func\s+(?:(?P<vis>private|public)\s+)?@(?P<name>[\w$.\-]+)")
+_CALLSITE = re.compile(r"\bcall\s+@([\w$.\-]+)")
+_KERNEL_FN = re.compile(r"kernel")
+
+
+def count_pallas_calls_in_text(text: str) -> int:
+    """Number of pallas_call EXECUTIONS the program performs: direct
+    ``tpu_custom_call`` occurrences plus call-graph multiplicity — XLA
+    dedupes identical kernel wrapper functions (two same-shape buckets
+    share one ``func.func`` containing the custom-call, invoked twice),
+    so a flat text count under-reports the per-step kernel launches the
+    budget rule is about."""
+    funcs: Dict[str, Dict] = {}
+    current = None
+    roots: List[str] = []
+    for line in text.splitlines():
+        m = _FUNC_DEF.search(line)
+        if m:
+            current = m.group("name")
+            funcs[current] = {"direct": 0, "calls": []}
+            if m.group("vis") != "private":
+                roots.append(current)
+            continue
+        if current is None:
+            continue
+        if _PALLAS_CALL.search(line):
+            funcs[current]["direct"] += 1
+        for c in _CALLSITE.findall(line):
+            funcs[current]["calls"].append(c)
+
+    memo: Dict[str, int] = {}
+
+    def execs(name: str, stack=()) -> int:
+        if name in memo:
+            return memo[name]
+        if name not in funcs or name in stack:
+            return 0
+        f = funcs[name]
+        total = f["direct"] + sum(execs(c, stack + (name,))
+                                  for c in f["calls"])
+        memo[name] = total
+        return total
+
+    if not roots:
+        roots = [n for n in funcs if n == "main"] or list(funcs)[:1]
+    return sum(execs(r) for r in roots)
+
+
+def _kernel_body_functions(text: str) -> set:
+    """Names of functions that ARE a pallas kernel body: interpret-mode
+    lowerings inline the kernel into private functions named after it
+    (the real Mosaic lowering serializes the body invisibly instead)."""
+    out = set()
+    for line in text.splitlines():
+        m = _FUNC_DEF.search(line)
+        if m and _KERNEL_FN.search(m.group("name")):
+            out.add(m.group("name"))
+    return out
+
+
+def find_wire_upcasts(text: str, label: str,
+                      kernel: bool = False) -> List[Finding]:
+    """``kernel=True`` (a trace KNOWN to carry a gossip-kernel lowering,
+    e.g. the ``fused_int8_kernel`` canonical config): converts inside a
+    kernel-body function (interpret-mode lowerings inline the body into
+    functions named after the kernel) are the kernel's in-register
+    decode and are skipped.  The exemption is scoped to kernel-mode
+    traces ONLY — on a plain trace a user function that merely has
+    "kernel" in its name keeps the full check (the name is not
+    evidence)."""
     findings: List[Finding] = []
     widening: Dict[str, Tuple[str, str]] = {}
+    kernel_fns = _kernel_body_functions(text) if kernel else set()
+    in_kernel_body = False
     for lineno, line in enumerate(text.splitlines(), 1):
-        if "func.func" in line:
+        m_fn = _FUNC_DEF.search(line)
+        if m_fn:
             # SSA names are function-scoped; never match a convert from
             # another function's region
             widening.clear()
+            in_kernel_body = m_fn.group("name") in kernel_fns
+            continue
+        if "func.func" in line:
+            widening.clear()
+            in_kernel_body = False
+            continue
+        if in_kernel_body:
             continue
         m = _CONVERT.search(line)
         if m:
@@ -125,33 +222,71 @@ def find_wire_upcasts(text: str, label: str) -> List[Finding]:
     return findings
 
 
-def check_collective_budget(text: str, label: str,
-                            expected: int) -> List[Finding]:
+def check_collective_budget(text: str, label: str, expected: int,
+                            kernel: bool = False,
+                            expected_pallas_calls: Optional[int] = None
+                            ) -> List[Finding]:
+    """``kernel=False``: the classic budget — permute count must equal
+    ``expected`` (buckets × offsets × wire arrays).  ``kernel=True``
+    (the ``BLUEFOG_GOSSIP_KERNEL`` hot path): ``expected`` standalone
+    permutes are still allowed for NON-gossip traffic (0 on the
+    canonical configs), and ``expected_pallas_calls`` (the bucket
+    count) pallas_call executions must be present — a missing kernel
+    means a bucket silently fell back to the chain."""
     from ..utils.trace_metrics import count_collectives_in_text
     got = count_collectives_in_text(text)["ppermute"]
-    if got == expected:
-        return []
-    direction = ("a pytree leaf escaped the fusion plan (per-leaf "
-                 "traffic is back)" if got > expected
-                 else "an exchange silently dropped out of the step")
-    return [Finding(
-        "trace-collective-budget", "error", f"<trace:{label}>", 0,
-        f"lowered step has {got} collective_permute(s), fusion plan "
-        f"budgets {expected} (buckets x offsets x wire arrays) — "
-        f"{direction}")]
+    findings: List[Finding] = []
+    if got != expected:
+        if kernel:
+            direction = ("a bucket fell back to the ppermute chain — "
+                         "the fused kernel is not carrying the wire"
+                         if got > expected
+                         else "an exchange silently dropped out of the "
+                              "step")
+            budget_desc = "kernel-mode permute budget"
+        else:
+            direction = ("a pytree leaf escaped the fusion plan "
+                         "(per-leaf traffic is back)" if got > expected
+                         else "an exchange silently dropped out of the "
+                              "step")
+            budget_desc = "fusion plan budgets"
+        findings.append(Finding(
+            "trace-collective-budget", "error", f"<trace:{label}>", 0,
+            f"lowered step has {got} collective_permute(s), "
+            f"{budget_desc} {expected} — {direction}"))
+    if kernel and expected_pallas_calls is not None:
+        calls = count_pallas_calls_in_text(text)
+        if calls != expected_pallas_calls:
+            direction = ("an extra kernel launch appeared (a bucket "
+                         "split the hot path in two)"
+                         if calls > expected_pallas_calls
+                         else "a bucket's exchange lost its fused "
+                              "kernel (chain fallback or dropped "
+                              "exchange)")
+            findings.append(Finding(
+                "trace-collective-budget", "error", f"<trace:{label}>",
+                0,
+                f"lowered kernel-mode step executes {calls} "
+                f"pallas_call(s), budget is {expected_pallas_calls} "
+                f"(one per fusion bucket) — {direction}"))
+    return findings
 
 
 def analyze_trace(text: str, label: str, *, expected_aliased: int = 0,
-                  expected_ppermutes: int = None) -> List[Finding]:
+                  expected_ppermutes: int = None, kernel: bool = False,
+                  expected_pallas_calls: int = None) -> List[Finding]:
     """All three checks over one lowered program (test entry point for
-    constructed violation programs)."""
+    constructed violation programs).  ``kernel``/``expected_pallas_
+    calls``: the gossip-kernel budget flavor (see
+    :func:`check_collective_budget`)."""
     findings = []
     if expected_aliased:
         findings += check_donation(text, label, expected_aliased)
-    findings += find_wire_upcasts(text, label)
-    if expected_ppermutes is not None:
-        findings += check_collective_budget(text, label,
-                                            expected_ppermutes)
+    findings += find_wire_upcasts(text, label, kernel=kernel)
+    if expected_ppermutes is not None or expected_pallas_calls is not None:
+        findings += check_collective_budget(
+            text, label, expected_ppermutes or 0, kernel=kernel,
+            expected_pallas_calls=expected_pallas_calls)
     return findings
 
 
@@ -162,6 +297,16 @@ _CANONICAL_CONFIGS = (
     ("fused", None, 1),
     ("fused_int8", "int8", 2),
 )
+
+
+def export_kernel_step_text(step, *args) -> str:
+    """Lower a gossip-kernel train step for the TPU platform from any
+    host via ``jax.export`` — Mosaic kernel serialization happens at
+    lowering time and needs no TPU device, so the one-pallas_call-per-
+    bucket invariant is checkable on the CPU CI mesh (the CPU lowering
+    path itself refuses non-interpret pallas calls)."""
+    from jax import export as _export
+    return _export.export(step, platforms=["tpu"])(*args).mlir_module()
 
 
 def run_canonical_trace_checks(depth: int = 8
@@ -207,10 +352,14 @@ def run_canonical_trace_checks(depth: int = 8
         variables, opt_state = T.create_train_state(
             model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
             fuse=True, overlap=False, compression=spec)
+        # gossip_kernel pinned OFF: the chain configs' budgets are the
+        # ppermute counts, and an ambient BLUEFOG_GOSSIP_KERNEL (docs
+        # tell operators to export it for `make bench-hw`) would flip
+        # them to a Mosaic lowering the CPU path refuses
         step = T.make_train_step(
             model, base, communication="neighbor_allreduce", fuse=True,
             overlap=False, telemetry=False, compression=spec,
-            donate=True)
+            gossip_kernel=False, donate=True)
         text, trace_s = TM.lower_text(
             step, variables, opt_state, (x, y), jnp.int32(0))
         per_rank = jax.tree.map(lambda a: a[0], variables["params"])
@@ -231,4 +380,43 @@ def run_canonical_trace_checks(depth: int = 8
             "trace_s": round(trace_s, 3),
             "findings": len(fs),
         }
+
+    # the gossip-kernel config (BLUEFOG_GOSSIP_KERNEL=1 + int8): lowered
+    # for TPU via jax.export (Mosaic needs no device at lowering time) —
+    # the per-bucket hot path must be exactly one pallas_call with ZERO
+    # standalone collective_permutes and zero widening wire converts
+    label = "fused_int8_kernel"
+    try:
+        variables, opt_state = T.create_train_state(
+            model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+            fuse=True, overlap=False, compression="int8")
+        step = T.make_train_step(
+            model, base, communication="neighbor_allreduce", fuse=True,
+            overlap=False, telemetry=False, compression="int8",
+            gossip_kernel="pallas", donate=True)
+        text = export_kernel_step_text(
+            step, variables, opt_state,
+            (jnp.zeros((n, 4, 8, 8, 1), jnp.float32),
+             jnp.zeros((n, 4), jnp.int32)), jnp.int32(0))
+    except Exception as e:          # noqa: BLE001 — an un-lowerable
+        # kernel config must FAIL the lint pass loudly, not print clean
+        findings.append(Finding(
+            "trace-pass-skipped", "error", f"<trace:{label}>", 0,
+            f"gossip-kernel canonical config failed to lower via "
+            f"jax.export(platforms=['tpu']): {type(e).__name__}: {e}"))
+        report[label] = {"skipped": f"{type(e).__name__}: {e}"}
+        return findings, report
+    per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+    plan = fusion_mod.plan_for(per_rank)
+    fs = analyze_trace(text, label, expected_ppermutes=0, kernel=True,
+                       expected_pallas_calls=plan.n_buckets)
+    findings += fs
+    report[label] = {
+        "ppermute": TM.count_collectives_in_text(text)["ppermute"],
+        "pallas_calls": count_pallas_calls_in_text(text),
+        "expected_pallas_calls": plan.n_buckets,
+        "buckets": plan.n_buckets,
+        "offsets": offsets,
+        "findings": len(fs),
+    }
     return findings, report

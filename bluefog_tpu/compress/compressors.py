@@ -58,6 +58,8 @@ __all__ = [
     "COMPRESS_ENV", "CompressionConfig", "Compressor",
     "resolve_compression", "get_compressor", "available_compressors",
     "register_compressor",
+    "int8_encode", "int8_decode", "fp8_encode", "fp8_decode",
+    "KERNEL_CODECS", "kernel_codec",
 ]
 
 COMPRESS_ENV = "BLUEFOG_COMM_COMPRESS"
@@ -169,6 +171,81 @@ def _parse_spec(spec: str) -> CompressionConfig:
 
 
 # ---------------------------------------------------------------------------
+# Kernel-callable codec bodies
+# ---------------------------------------------------------------------------
+#
+# The dense quantizers' encode/decode math lives in these module-level
+# functions so BOTH entries share one body: the wire classes below (the
+# ``compressed_mix`` chain) and the single-kernel gossip path
+# (``ops/pallas_kernels.py``), which runs the same jnp ops on values
+# loaded from VMEM refs inside the fused kernel.  One body means the
+# fused kernel is bit-exact against the chain by construction — same ops
+# in the same order, not a re-derivation that could drift.
+#
+# ``noise`` is the stochastic-rounding uniform draw.  The chain computes
+# it inside ``compress`` from ``rank_key``; the kernel path precomputes
+# the SAME draw outside the kernel (the noise depends only on the key and
+# the bucket's element count, never on the data) and feeds it in as an
+# operand, so the kernel needs no in-kernel threefry.
+
+KERNEL_CODECS = ("int8", "fp8")
+
+
+def kernel_codec(cfg: Optional["CompressionConfig"]) -> Optional[str]:
+    """The fused-gossip-kernel codec a config maps to, or ``None`` when
+    the config is outside the kernel's wire format (sparsifiers ship
+    ragged values+indices; choco is a different exchange discipline;
+    identity has no codec win to fuse)."""
+    if cfg is None or cfg.choco:
+        return None
+    return cfg.name if cfg.name in KERNEL_CODECS else None
+
+
+def int8_encode(f, noise=None):
+    """Quantize one flat f32 array: ``(int8 payload, f32 scale scalar)``.
+    ``noise`` (same shape, U[0,1); an array, or a zero-arg thunk so the
+    chain's draw keeps its historical trace position after the divide —
+    byte-identity of the off path is checked to the byte) selects
+    stochastic rounding; ``None`` falls back to round-to-nearest (the
+    window path, which has no step index to derive a key from)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(f)), jnp.float32(1e-30)) / 127.0
+    t = f / scale
+    u = noise() if callable(noise) else noise
+    if u is not None:
+        q = jnp.floor(t + u)
+    else:
+        q = jnp.round(t)
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scale
+
+
+def int8_decode(q, scale):
+    """Inverse of :func:`int8_encode` (f32 result; the caller casts to
+    the bucket dtype — receivers re-materialize at decode width exactly
+    once, in-register on the kernel path).  ``scale``: a scalar, or a
+    zero-arg thunk evaluated after the payload convert (the chain's
+    historical trace order, kept to the byte)."""
+    f = q.astype(jnp.float32)
+    s = scale() if callable(scale) else scale
+    return f * s
+
+
+_FP8_MAX = 448.0
+
+
+def fp8_encode(f):
+    """float8_e4m3fn cast with one f32 scale (bucket max lands at the
+    format's max normal, 448)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(f)), jnp.float32(1e-30)) / _FP8_MAX
+    return (f / scale).astype(jnp.float8_e4m3fn), scale
+
+
+def fp8_decode(q, scale):
+    f = q.astype(jnp.float32)
+    s = scale() if callable(scale) else scale
+    return f * s
+
+
+# ---------------------------------------------------------------------------
 # Compressors
 # ---------------------------------------------------------------------------
 
@@ -224,17 +301,13 @@ class Int8Compressor(Compressor):
 
     def compress(self, buf, shared_key, rank_key):
         f = buf.astype(jnp.float32).reshape(-1)
-        scale = jnp.maximum(jnp.max(jnp.abs(f)), jnp.float32(1e-30)) / 127.0
-        t = f / scale
-        if rank_key is not None:
-            q = jnp.floor(t + jax.random.uniform(rank_key, t.shape))
-        else:
-            q = jnp.round(t)
-        q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+        noise = ((lambda: jax.random.uniform(rank_key, f.shape))
+                 if rank_key is not None else None)
+        q, scale = int8_encode(f, noise)
         return {"q": q, "scale": scale.reshape(1)}
 
     def decompress(self, wire, shared_key, shape, dtype):
-        f = wire["q"].astype(jnp.float32) * wire["scale"][0]
+        f = int8_decode(wire["q"], lambda: wire["scale"][0])
         return f.astype(dtype).reshape(shape)
 
     def wire_nbytes(self, nelems, dtype):
@@ -246,7 +319,7 @@ class Fp8Compressor(Compressor):
     bucket max lands at the format's max normal, 448)."""
 
     name = "fp8"
-    _MAX = 448.0
+    _MAX = _FP8_MAX
 
     def __init__(self):
         if not hasattr(jnp, "float8_e4m3fn"):
@@ -256,13 +329,11 @@ class Fp8Compressor(Compressor):
 
     def compress(self, buf, shared_key, rank_key):
         f = buf.astype(jnp.float32).reshape(-1)
-        scale = jnp.maximum(jnp.max(jnp.abs(f)),
-                            jnp.float32(1e-30)) / self._MAX
-        return {"q": (f / scale).astype(jnp.float8_e4m3fn),
-                "scale": scale.reshape(1)}
+        q, scale = fp8_encode(f)
+        return {"q": q, "scale": scale.reshape(1)}
 
     def decompress(self, wire, shared_key, shape, dtype):
-        f = wire["q"].astype(jnp.float32) * wire["scale"][0]
+        f = fp8_decode(wire["q"], lambda: wire["scale"][0])
         return f.astype(dtype).reshape(shape)
 
     def wire_nbytes(self, nelems, dtype):

@@ -44,10 +44,12 @@ under dynamic schedules) is traced data — compression never adds a
 recompile.
 """
 
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..ops import fusion as F
@@ -58,6 +60,7 @@ from . import compressors as CP
 __all__ = [
     "stateful", "init_state", "sharded_state_layout", "reset_state",
     "compressed_mix", "wire_stats", "check_supported",
+    "GOSSIP_KERNEL_ENV", "resolve_gossip_kernel", "effective_gossip_kernel",
 ]
 
 # base PRNG seed for the shared (step, bucket) keys; any constant works —
@@ -106,6 +109,115 @@ def check_supported(cfg: Optional[CP.CompressionConfig], *,
                 "the CHOCO mix x + gamma*(s - x_hat) has no single "
                 "in-flight self weight to pipeline; use a direct spec "
                 "('int8', 'topk:...') under overlap")
+
+
+# ---------------------------------------------------------------------------
+# Single-kernel gossip knob (BLUEFOG_GOSSIP_KERNEL)
+# ---------------------------------------------------------------------------
+
+GOSSIP_KERNEL_ENV = "BLUEFOG_GOSSIP_KERNEL"
+
+_KERNEL_ON_VALUES = ("1", "on", "true", "pallas")
+
+
+def resolve_gossip_kernel(value=None) -> Optional[str]:
+    """Resolve the single-kernel gossip knob to a transport mode or
+    ``None`` (off).  Explicit argument wins, else ``BLUEFOG_GOSSIP_KERNEL``
+    (default off).  Modes: ``"pallas"`` (the Mosaic kernel, real TPU;
+    spelled ``1``/``on``/``pallas``), ``"interpret"`` (the same kernel
+    under the TPU-simulating interpreter — CPU test mesh, jaxlib >= 0.5),
+    ``"emulate"`` (the kernel body's math over a ppermute transport — any
+    backend; the CI bit-exactness harness).  Resolved when the step is
+    BUILT, like every comm knob, and joins ``step_cache_key``."""
+    if isinstance(value, bool):
+        return "pallas" if value else None
+    if value is None:
+        value = os.environ.get(GOSSIP_KERNEL_ENV, "")
+    if not isinstance(value, str):
+        raise TypeError(
+            f"gossip_kernel must be a mode string, bool, or None, got "
+            f"{type(value).__name__}")
+    v = value.strip().lower()
+    if v in ("", "0", "none", "off", "false"):
+        return None
+    if v in _KERNEL_ON_VALUES:
+        return "pallas"
+    if v in ("interpret", "emulate"):
+        return v
+    raise ValueError(
+        f"unknown gossip-kernel mode {value!r}: expected off "
+        f"(''/'0'/'none'/'off'), '1'/'pallas' (Mosaic kernel), "
+        f"'interpret' (TPU-simulating interpreter), or 'emulate' "
+        f"(ppermute transport, any backend)")
+
+
+def effective_gossip_kernel(value, cfg: Optional[CP.CompressionConfig], *,
+                            comm_value: str, fuse: bool = True
+                            ) -> Tuple[Optional[str], bool]:
+    """Resolve + validate the gossip-kernel knob against the build's
+    compression config and communication mode: ``(kernel_mode_or_None,
+    interleave)``.
+
+    The fused kernel is the COMPRESSED neighbor-gossip hot path, so it
+    needs a dense quantizer (int8/fp8 — the only codecs with a
+    fixed-shape wire the kernel can RDMA) on ``neighbor.allreduce``
+    mixing over fused buckets.  The rules, matching ``check_supported``'s
+    raise-with-guidance convention:
+
+    * env-resolved knob on a build it cannot apply to (no compression, or
+      a non-gossip comm mode) is INERT — except that with fused gossip
+      and no codec it still turns on bucket INTERLEAVING (small buckets'
+      exchanges issue first), the half of the optimization that needs no
+      codec;
+    * an EXPLICIT ``gossip_kernel=`` argument in those combos raises (a
+      named request that cannot be honored must not silently no-op);
+    * a sparsifier / choco / unfused build under the knob raises either
+      way — these are misconfigurations worth surfacing, not composition
+      gaps to paper over (docs/performance.md lists the rejected combos).
+    """
+    kernel = resolve_gossip_kernel(value)
+    if kernel is None:
+        return None, False
+    explicit = value is not None
+    if comm_value != "neighbor.allreduce":
+        if explicit:
+            raise ValueError(
+                f"the gossip kernel fuses neighbor_allreduce gossip only "
+                f"(got {comm_value!r}): allreduce ships via all_gather, "
+                f"hierarchical has a two-level mix — drop gossip_kernel= "
+                f"for this communication mode")
+        return None, False
+    if not fuse:
+        raise ValueError(
+            "the gossip kernel operates on fused flat buckets "
+            "(one pallas_call per bucket); fuse=False / "
+            "BLUEFOG_COMM_FUSION=0 leaves it nothing to fuse — enable "
+            "comm fusion or drop BLUEFOG_GOSSIP_KERNEL")
+    if cfg is None:
+        if explicit:
+            raise ValueError(
+                "gossip_kernel= needs a dense-quantizer compression "
+                "config ('int8' or 'fp8'): the kernel IS the compressed "
+                "hot path (quantize-on-store, wire RDMA, decode-on-load); "
+                "without a codec use the dense pallas backend "
+                "(BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas) instead")
+        # the env knob still buys the issue-order half of the win
+        return None, True
+    if cfg.choco:
+        raise ValueError(
+            "CHOCO-under-kernel is deferred: the difference-gossip "
+            "recurrence carries replica estimates (x_hat, s_hat) the "
+            "kernel does not yet fold in-register — use a direct dense "
+            "spec ('int8'/'fp8') with BLUEFOG_GOSSIP_KERNEL, or drop the "
+            "knob for choco (docs/performance.md \"Single-kernel "
+            "gossip\", composition table)")
+    if CP.kernel_codec(cfg) is None:
+        raise ValueError(
+            f"the gossip kernel's wire format is dense quantization: "
+            f"spec {cfg.spec!r} has no kernel codec (sparsifiers ship "
+            f"ragged values+indices; identity has no codec work to fuse) "
+            f"— use 'int8' or 'fp8', or drop BLUEFOG_GOSSIP_KERNEL")
+    return kernel, True
 
 
 def _zero_state_bufs(tree, fuse: bool, bucket_bytes: Optional[int],
@@ -217,6 +329,133 @@ def _neighbor_terms(axis_name, topo, sched, step, dtype, idx):
     return self_w, terms
 
 
+def _weight_tables(axis_name, topo, sched, step, dtype):
+    """Full ``(self_w [N], recv_w [K, N])`` weight tables in ``dtype``
+    for the KERNEL transports — the kernel body reads its per-rank
+    scalars as ``table[my_id]`` in-kernel.  The casts mirror
+    :func:`_neighbor_terms` (numpy source -> ``dtype`` in one conversion
+    for static topologies; f32 gather -> ``astype`` for dynamic
+    schedules), so the values are bitwise the chain's."""
+    if sched is not None:
+        t = jnp.asarray(step) % sched.period
+        self_w = jnp.asarray(sched.self_weights)[t].astype(dtype)
+        recv_w = jnp.asarray(sched.recv_weights)[t].astype(dtype)
+        return self_w, recv_w
+    self_w = jnp.asarray(topo.self_weights, dtype)
+    if not topo.shifts:
+        # edgeless topology (size-1 gossip axis): no rows to stack — the
+        # kernel entry's no-exchange branch consumes only self_w
+        return self_w, jnp.zeros((0, topo.size), dtype)
+    recv_np = np.stack([np.asarray(shift.recv_weights, np.float64)
+                        for shift in topo.shifts])
+    return self_w, jnp.asarray(recv_np, dtype)
+
+
+def _emulated_bucket_gossip(buf, residual, codec: str, rkey,
+                            axis_name, topo, sched, step, idx):
+    """The ``"emulate"`` transport: the fused kernel's body — shared
+    codec bodies (``compressors.int8_encode``/...), wire-dtype exchange,
+    self-true mix, in-loop EF residual — executed as plain jnp with
+    ``lax.ppermute`` standing in for the RDMA, so it runs on ANY
+    backend (the bit-exactness and compile-count harness for hosts
+    whose jaxlib has no Mosaic TPU interpreter).
+
+    The expressions deliberately mirror the chain's direct-mode bucket
+    body OP FOR OP — same ``_neighbor_terms`` scalars, same wire-dict
+    ``tree.map`` permute, same thunked scale slice and noise draw
+    position — because the contract is checked at the BIT level and
+    XLA's fusion decisions (FMA formation around the mix's
+    multiply-adds) key on the local op patterns: a mathematically equal
+    but structurally different program was measured to drift by an ulp
+    on the CPU backend."""
+    t_val = buf + residual
+    f = t_val.astype(jnp.float32).reshape(-1)
+    if codec == "int8":
+        q, scale = CP.int8_encode(
+            f, lambda: jax.random.uniform(rkey, f.shape))
+        decode = CP.int8_decode
+    else:
+        q, scale = CP.fp8_encode(f)
+        decode = CP.fp8_decode
+    wire = {"q": q, "scale": scale.reshape(1)}
+    d_own = decode(wire["q"],
+                   lambda: wire["scale"][0]).astype(buf.dtype).reshape(
+                       buf.shape)
+    self_w, terms = _neighbor_terms(axis_name, topo, sched, step,
+                                    buf.dtype, idx)
+    out = self_w * buf
+    for pairs, w in terms:
+        arrived = jax.tree.map(
+            lambda a, pairs=pairs: lax.ppermute(a, axis_name, pairs), wire)
+        dec = decode(arrived["q"],
+                     lambda arrived=arrived: arrived["scale"][0])
+        out = out + w * dec.astype(buf.dtype).reshape(buf.shape)
+    return out, t_val - d_own
+
+
+def _kernel_mix(plan, tree, bufs, state, cfg: CP.CompressionConfig,
+                kernel: str, axis_name, topo, sched, step,
+                wire_bytes: int, raw_bytes: int):
+    """The single-kernel gossip execution of one compressed exchange:
+    one :func:`~..ops.pallas_kernels.fused_compressed_gossip` call per
+    fusion bucket (codec + RDMA + mix + EF residual fused), issued in
+    :func:`~..ops.fusion.interleave_order` (small buckets first, so
+    their short exchanges hide under the large buckets' work).  Reached
+    only for validated builds (``effective_gossip_kernel``): direct
+    dense-quantizer specs over fused neighbor gossip.  Bit-exact vs the
+    chain below — the kernel runs the same codec bodies on the same
+    values in the same order (asserted across schedules and dtypes in
+    tests/test_gossip_kernel.py)."""
+    from ..ops import pallas_kernels as PK
+    if plan is None or state is None or "residual" not in state:
+        raise ValueError(
+            "kernel gossip needs fused buckets and a carried EF residual "
+            "(stateful dense quantizer) — builder validation should have "
+            "rejected this configuration")
+    idx = lax.axis_index(axis_name)
+    size = sched.size if sched is not None else topo.size
+    offsets = (tuple(sched.offsets) if sched is not None
+               else tuple(topo.offsets))
+    mixed: List[Any] = [None] * len(bufs)
+    res_out: List[Any] = [None] * len(bufs)
+    tables: Dict[Any, Any] = {}
+    for b in F.interleave_order(plan):
+        buf = bufs[b]
+        skey = _shared_key(step, b)
+        rkey = jax.random.fold_in(skey, idx)
+        if kernel == "emulate":
+            mixed[b], res_out[b] = _emulated_bucket_gossip(
+                buf, state["residual"][b], cfg.name, rkey,
+                axis_name, topo, sched, step, idx)
+            continue
+        # the chain draws this inside compress(); same key, same shape,
+        # same draw — precomputed because the kernel has no threefry
+        noise = (jax.random.uniform(rkey, (int(buf.size),))
+                 if cfg.name == "int8" else None)
+        dt = jnp.dtype(buf.dtype)
+        if dt not in tables:
+            tables[dt] = _weight_tables(axis_name, topo, sched, step,
+                                        buf.dtype)
+        self_w, recv_w = tables[dt]
+        mixed[b], res_out[b] = PK.fused_compressed_gossip(
+            buf, state["residual"][b], noise, self_w, recv_w,
+            axis_name=axis_name, size=size, offsets=offsets,
+            codec=cfg.name, mode=kernel)
+    # diag accumulates in PLAN order like the chain's bucket loop, so the
+    # telemetry residual norm is bitwise unchanged by the issue order
+    res_norm2 = jnp.float32(0.0)
+    for r in res_out:
+        r32 = r.astype(jnp.float32)
+        res_norm2 = res_norm2 + jnp.sum(r32 * r32)
+    new_state = {"residual": tuple(res_out)}
+    if "gamma_scale" in state:
+        new_state["gamma_scale"] = state["gamma_scale"]
+    diag = {"residual_norm": jnp.sqrt(res_norm2),
+            "wire_bytes": float(wire_bytes),
+            "ratio": float(raw_bytes) / float(max(wire_bytes, 1))}
+    return F.restore(plan, tree, mixed), new_state, diag
+
+
 def _note_metrics(cfg, wire_bytes: int, raw_bytes: int) -> None:
     if not _metrics.enabled():
         return
@@ -235,7 +474,7 @@ def _note_metrics(cfg, wire_bytes: int, raw_bytes: int) -> None:
 def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
                    mode: str, axis_name, topo=None, sched=None, step=0,
                    fuse: bool = True, bucket_bytes: Optional[int] = None,
-                   leaf_groups=None):
+                   leaf_groups=None, kernel: Optional[str] = None):
     """One compressed exchange of ``tree`` (per-rank, inside shard_map).
 
     ``mode``: ``"neighbor"`` (weighted gossip over ``topo``/``sched``) or
@@ -246,12 +485,29 @@ def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
     ``ops/fusion.py::shard_groups``): partitions the buckets so
     inner-axis-replicated leaves never share codec statistics with
     cell-varying shard data — their mixed value must be identical on
-    every cell."""
+    every cell.
+
+    ``kernel`` (a mode from :func:`resolve_gossip_kernel`, validated by
+    :func:`effective_gossip_kernel`): run the whole per-bucket hot path
+    — quantize, exchange, decode, mix, EF residual — as ONE fused
+    kernel per bucket (``ops/pallas_kernels.fused_compressed_gossip``)
+    instead of the ~4-op chain below; bit-exact vs the chain.  ``None``
+    (the default) is the chain — byte-identical StableHLO to the
+    pre-kernel lowering, the standing off-path contract."""
     comp = CP.get_compressor(cfg)
     plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes,
                               leaf_groups=leaf_groups)
     wire_bytes, raw_bytes = wire_stats(cfg, bufs)
     _note_metrics(cfg, wire_bytes, raw_bytes)
+    if kernel is not None:
+        if mode != "neighbor" or cfg.choco:
+            raise ValueError(
+                "kernel gossip applies to direct neighbor mixing only — "
+                "builder validation (effective_gossip_kernel) should "
+                "have rejected this configuration")
+        return _kernel_mix(plan, tree, bufs, state, cfg, kernel,
+                           axis_name, topo, sched, step,
+                           wire_bytes, raw_bytes)
     idx = lax.axis_index(axis_name)
     res_norm2 = jnp.float32(0.0)
     mixed: List[jax.Array] = []

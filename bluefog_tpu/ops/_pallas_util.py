@@ -2,7 +2,7 @@
 
 import jax
 
-__all__ = ["out_struct"]
+__all__ = ["out_struct", "collective_id", "register_collective_family"]
 
 
 def out_struct(shape, dtype, *operands):
@@ -15,3 +15,65 @@ def out_struct(shape, dtype, *operands):
         return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
     except TypeError:      # older JAX without the vma kwarg
         return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Collective-id registry
+# ---------------------------------------------------------------------------
+#
+# Mosaic keys the global barrier semaphore a collective kernel grabs with
+# ``get_barrier_semaphore()`` on the ``collective_id`` compiler param: two
+# kernels compiled with the SAME id share one semaphore, so if both are in
+# flight concurrently their neighbor barriers alias — rank A's signal for
+# kernel 1 satisfies rank B's wait in kernel 2 and the RDMA lands in a
+# scratch buffer that may not exist yet.  Every kernel FAMILY that can be
+# live at the same time therefore needs its own id, assigned here from one
+# table instead of hardcoded at each pallas_call site.
+#
+# The assignment is STATIC (not first-come-first-served): every rank of an
+# SPMD program must compile the same kernel with the same id, and a
+# registry filled in call order could diverge across processes that build
+# programs in different orders.  ``gossip`` keeps the historical id 7 (the
+# value ``_run_exchange`` shipped with) so the dense kernel's lowered
+# bytes are unchanged.
+_COLLECTIVE_FAMILIES = {
+    "gossip": 7,              # dense fused exchange (_run_exchange)
+    "windows": 8,             # reserved for a future window-op kernel
+    "compressed_gossip": 9,   # single-kernel codec gossip
+}
+
+
+def collective_id(family: str) -> int:
+    """Barrier-semaphore id for a kernel family (KeyError-free: unknown
+    families raise with the known set, so a typo fails at build time
+    instead of silently aliasing an existing semaphore)."""
+    try:
+        return _COLLECTIVE_FAMILIES[family]
+    except KeyError:
+        raise ValueError(
+            f"unknown pallas collective family {family!r} "
+            f"(known: {', '.join(sorted(_COLLECTIVE_FAMILIES))}); register "
+            f"new families with register_collective_family") from None
+
+
+def register_collective_family(family: str, cid: int = None) -> int:
+    """Add a kernel family.  ``cid`` defaults to the next free id;
+    an explicit id must not collide with an existing family's (the
+    aliasing this registry exists to prevent)."""
+    family = str(family)
+    if family in _COLLECTIVE_FAMILIES:
+        existing = _COLLECTIVE_FAMILIES[family]
+        if cid is not None and int(cid) != existing:
+            raise ValueError(
+                f"collective family {family!r} is already id {existing}; "
+                f"cannot re-register as {cid}")
+        return existing
+    if cid is None:
+        cid = max(_COLLECTIVE_FAMILIES.values()) + 1
+    cid = int(cid)
+    if cid in _COLLECTIVE_FAMILIES.values():
+        owner = next(k for k, v in _COLLECTIVE_FAMILIES.items() if v == cid)
+        raise ValueError(
+            f"collective id {cid} already belongs to family {owner!r}")
+    _COLLECTIVE_FAMILIES[family] = cid
+    return cid

@@ -60,6 +60,7 @@ __all__ = [
     "plan_bytes",
     "gossip_wire_bytes",
     "bucket_probe_sizes",
+    "interleave_order",
     "plan_for",
     "shard_shape",
     "shard_groups",
@@ -265,6 +266,25 @@ def bucket_probe_sizes(plan: FusionPlan,
              for b in plan.buckets}
     sizes.add(min(4096, cap))
     return tuple(sorted(s for s in sizes if s > 0))
+
+
+def interleave_order(plan: FusionPlan) -> Tuple[int, ...]:
+    """Bucket ISSUE order for the single-kernel gossip path: ascending
+    padded wire bytes, ties broken by plan position (stable).
+
+    Rationale (docs/performance.md "Single-kernel gossip"): each bucket's
+    exchange is one kernel whose RDMA time scales with its bytes, and XLA
+    schedules program order when dataflow allows — issuing the SMALL
+    buckets' kernels first puts their short exchanges in flight while the
+    large buckets are still encoding/launching, so the small transfers
+    hide entirely under the big buckets' compute instead of queueing
+    behind it.  Results are always restored in plan position, so the
+    order is invisible to callers; the default (non-kernel) paths keep
+    strict plan order — their lowering is byte-frozen by the off-path
+    identity contract."""
+    sizes = [(b.padded * jnp.dtype(b.dtype).itemsize, i)
+             for i, b in enumerate(plan.buckets)]
+    return tuple(i for _, i in sorted(sizes))
 
 
 def shard_shape(shape: Tuple[int, ...], spec,
@@ -528,7 +548,8 @@ def zero_buffers(plan: FusionPlan,
 
 def fused_tree_map(fn: Callable, tree, *,
                    max_bucket_bytes: Optional[int] = None,
-                   pad_to: int = 1, leaf_groups=None):
+                   pad_to: int = 1, leaf_groups=None,
+                   interleave: bool = False):
     """Apply an elementwise-linear, shape/dtype-preserving collective once
     per fusion bucket instead of once per leaf.
 
@@ -537,17 +558,25 @@ def fused_tree_map(fn: Callable, tree, *,
     per-step collective count from ``leaves x offsets`` to
     ``buckets x offsets``.  ``fn`` must preserve shape and dtype (every
     collective this layer fuses does); violations raise at trace time
-    rather than silently corrupting the unflatten."""
+    rather than silently corrupting the unflatten.
+
+    ``interleave`` (the ``BLUEFOG_GOSSIP_KERNEL`` issue-order hint,
+    default off — the off path's trace is byte-frozen): apply ``fn`` to
+    the buckets in :func:`interleave_order` (small first) so short
+    exchanges launch ahead of the large buckets' work; results land in
+    plan position either way."""
     plan = plan_for(tree, max_bucket_bytes=max_bucket_bytes, pad_to=pad_to,
                     leading_dims=0, leaf_groups=leaf_groups)
     bufs = flatten(plan, tree)
-    out = []
-    for spec, buf in zip(plan.buckets, bufs):
+    order = interleave_order(plan) if interleave else range(len(bufs))
+    out: List[Optional[jax.Array]] = [None] * len(bufs)
+    for b in order:
+        buf = bufs[b]
         o = fn(buf)
         if tuple(o.shape) != tuple(buf.shape) or o.dtype != buf.dtype:
             raise ValueError(
                 f"fused collective changed the buffer signature "
                 f"({buf.shape}/{buf.dtype} -> {o.shape}/{o.dtype}); "
                 f"fusion requires shape- and dtype-preserving ops")
-        out.append(o)
+        out[b] = o
     return unflatten(plan, out)

@@ -30,11 +30,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..parallel.schedule import CompiledTopology, DynamicSchedule
+from ._pallas_util import collective_id
 
 __all__ = [
     "fused_neighbor_allreduce", "fused_dynamic_neighbor_allreduce",
     "fused_neighbor_allreduce_flat", "fused_dynamic_neighbor_allreduce_flat",
-    "FLAT_TILE",
+    "fused_compressed_gossip", "FLAT_TILE", "GOSSIP_TILE",
 ]
 
 _LANE = 128
@@ -136,7 +137,8 @@ def _run_exchange(x2d, self_w, recv_w, size, offsets, axis_name, interpret):
             pltpu.SemaphoreType.DMA((K,)),
             pltpu.SemaphoreType.DMA((K,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
+        compiler_params=pltpu.CompilerParams(
+            collective_id=collective_id("gossip")),
         interpret=pltpu.InterpretParams() if interpret else False,
     )(x2d, self_w, recv_w)
 
@@ -231,3 +233,236 @@ def fused_dynamic_neighbor_allreduce(x, axis_name, sched: DynamicSchedule,
     self_w, recv_w = _sched_tables(sched, step)
     return _fused_exchange(x, axis_name, sched.size, sched.offsets,
                            self_w, recv_w, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Single-kernel compressed gossip: codec + RDMA + mix in one pallas_call
+# ---------------------------------------------------------------------------
+#
+# The compressed exchange chain (``compress/exchange.py::compressed_mix``)
+# is quantize -> ppermute -> dequantize -> weighted mix: four HLO stages
+# that each round-trip the bucket through HBM, and every receiver
+# re-materializes the wire payload at decode width.  This kernel is the
+# whole chain per bucket: the EF-corrected iterate ``t = x + e`` is
+# quantized ON STORE into a VMEM wire buffer (int8 / fp8 payload + one
+# f32 scale), the WIRE ENCODING rides K concurrent RDMAs (one per
+# circulant offset, each on its own ICI link — the same concurrency as
+# ``_exchange_kernel`` above, at 1/4 the bytes), receivers decode ON LOAD
+# from the recv scratch, and ``self_w*x + sum_k w_k*D(recv_k)`` plus the
+# error-feedback residual ``t - D(C(t))`` accumulate in-register.  The
+# bucket crosses HBM exactly twice (read x/e, write out/e') no matter how
+# many neighbors decode it.
+#
+# The codec math is ``compress/compressors.py``'s kernel-callable bodies
+# (``int8_encode``/``int8_decode``/``fp8_*``) — the SAME functions the
+# chain's wire classes call, so the kernel is bit-exact against the chain
+# by construction; stochastic-rounding noise is precomputed outside (it
+# depends only on the rank key and the element count, never the data) and
+# fed in as an operand.
+#
+# ``mode`` selects the transport:
+#   "pallas"     the Mosaic kernel on real TPU meshes
+#   "interpret"  the same kernel under the TPU-simulating interpreter
+#                (CPU test mesh; jaxlib >= 0.5)
+#   "emulate"    the same body math with ``lax.ppermute`` standing in for
+#                the RDMA — runs on ANY backend (the bit-exactness and
+#                compile-count harness for hosts without the Mosaic
+#                interpreter; wire dtype on the permutes is still the
+#                codec's, so trace-level wire-byte evidence holds too)
+
+# int8 VMEM tiles are (32, 128); padding buckets to this element multiple
+# keeps the f32 operands (8-row tiles) AND the 8-bit wire buffers exactly
+# tile-aligned, so the kernel reshapes and never pads internally.
+_WIRE_SUBLANE = 32
+GOSSIP_TILE = _WIRE_SUBLANE * _LANE
+
+
+def _codec_encode(codec: str, t32, noise):
+    from ..compress import compressors as CP
+    if codec == "int8":
+        return CP.int8_encode(t32, noise)
+    if codec == "fp8":
+        return CP.fp8_encode(t32)
+    raise ValueError(f"unknown kernel codec {codec!r}")
+
+
+def _codec_decode(codec: str, q, scale):
+    from ..compress import compressors as CP
+    if codec == "int8":
+        return CP.int8_decode(q, scale)
+    if codec == "fp8":
+        return CP.fp8_decode(q, scale)
+    raise ValueError(f"unknown kernel codec {codec!r}")
+
+
+def _wire_dtype(codec: str):
+    return jnp.int8 if codec == "int8" else jnp.float8_e4m3fn
+
+
+def _compressed_gossip_kernel(size: int, offsets, axis_name: str,
+                              codec: str, has_noise: bool):
+    """Kernel body: encode on store, K concurrent wire RDMAs, decode on
+    load, mix + EF residual in-register.
+
+    refs: x [R, 128], res [R, 128], (noise [R, 128] f32,) self_w [N],
+    recv_w [K, N] -> out [R, 128], res_out [R, 128];
+    scratch: wire_q [R, 128] wire-dtype, wire_s [1, 128] f32,
+    recv_q [K, R, 128], recv_s [K, 1, 128], send/recv DMA semaphore
+    arrays [2, K] (payload row 0, scale row 1)."""
+    K = len(offsets)
+
+    def kernel(*refs):
+        if has_noise:
+            (x_ref, res_ref, noise_ref, self_w_ref, recv_w_ref,
+             out_ref, res_out_ref,
+             wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems) = refs
+        else:
+            (x_ref, res_ref, self_w_ref, recv_w_ref,
+             out_ref, res_out_ref,
+             wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems) = refs
+            noise_ref = None
+        my_id = lax.axis_index(axis_name)
+
+        # quantize-on-store: the EF-corrected iterate enters the wire
+        # buffer at wire width — nothing wider ever leaves the chip
+        t = x_ref[...] + res_ref[...]
+        q, scale = _codec_encode(
+            codec, t.astype(jnp.float32),
+            noise_ref[...] if noise_ref is not None else None)
+        wire_q[...] = q
+        wire_s[...] = jnp.full((1, _LANE), scale, jnp.float32)
+
+        # neighbor barrier (same recipe as _exchange_kernel): all peers'
+        # recv scratch must exist before any RDMA lands
+        barrier_sem = pltpu.get_barrier_semaphore()
+        for k in range(K):
+            dst = lax.rem(my_id + offsets[k], size)
+            pltpu.semaphore_signal(barrier_sem, inc=1, device_id=dst,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier_sem, K)
+
+        # all K offsets' wire payloads in flight together — each rides a
+        # distinct ICI link; the scale scalar rides its own tiny copy
+        copies = []
+        for k in range(K):
+            dst = lax.rem(my_id + offsets[k], size)
+            c_q = pltpu.make_async_remote_copy(
+                src_ref=wire_q, dst_ref=recv_q.at[k],
+                send_sem=send_sems.at[0, k], recv_sem=recv_sems.at[0, k],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            c_s = pltpu.make_async_remote_copy(
+                src_ref=wire_s, dst_ref=recv_s.at[k],
+                send_sem=send_sems.at[1, k], recv_sem=recv_sems.at[1, k],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            c_q.start()
+            c_s.start()
+            copies.append((c_q, c_s))
+
+        # own reconstruction + EF residual while the wire flies: the
+        # residual update t - D(C(t)) never waits on the interconnect
+        d_own = _codec_decode(codec, q, scale).astype(x_ref.dtype)
+        res_out_ref[...] = t - d_own
+        acc = self_w_ref[my_id] * x_ref[...]
+        for k in range(K):
+            c_q, c_s = copies[k]
+            c_q.wait()
+            c_s.wait()
+            dec = _codec_decode(codec, recv_q[k],
+                                recv_s[k][0, 0]).astype(x_ref.dtype)
+            acc = acc + recv_w_ref[k, my_id] * dec
+        out_ref[...] = acc
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _run_compressed_exchange(x2d, res2d, noise2d, self_w, recv_w,
+                             size, offsets, axis_name, codec, interpret):
+    K = len(offsets)
+    has_noise = noise2d is not None
+    kernel = _compressed_gossip_kernel(size, offsets, axis_name, codec,
+                                       has_noise)
+    wire_dt = _wire_dtype(codec)
+    n_in = 5 if has_noise else 4
+    args = ((x2d, res2d, noise2d, self_w, recv_w) if has_noise
+            else (x2d, res2d, self_w, recv_w))
+    return pl.pallas_call(
+        kernel,
+        out_shape=(_struct_vma(x2d.shape, x2d.dtype, axis_name),
+                   _struct_vma(x2d.shape, x2d.dtype, axis_name)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[
+            pltpu.VMEM(x2d.shape, wire_dt),
+            pltpu.VMEM((1, _LANE), jnp.float32),
+            pltpu.VMEM((K,) + x2d.shape, wire_dt),
+            pltpu.VMEM((K, 1, _LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, K)),
+            pltpu.SemaphoreType.DMA((2, K)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=collective_id("compressed_gossip")),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*args)
+
+
+def fused_compressed_gossip(buf, residual, noise, self_w, recv_w, *,
+                            axis_name, size: int, offsets, codec: str,
+                            mode: str):
+    """One bucket's compressed gossip as a single fused kernel (call
+    inside shard_map, per rank).
+
+    ``buf``/``residual``: the 1-D fusion bucket and its carried
+    error-feedback residual (any float dtype).  ``noise``: the
+    stochastic-rounding uniform draw, 1-D f32 of ``buf.size`` (int8
+    only; ``None`` otherwise) — the chain's exact draw, precomputed
+    because the kernel has no in-kernel threefry.  ``self_w [N]`` /
+    ``recv_w [K, N]``: per-rank weight tables already cast to
+    ``buf.dtype`` with the chain's conversions
+    (``compress/exchange.py::_weight_tables``).  Partial non-rotation
+    offsets of irregular static graphs ship one redundant tile (same
+    semantics as the dense kernel above); the chain's ppermute delivers
+    zeros there instead — both sides multiply by the same zero weight.
+
+    ``mode``: ``"pallas"`` (Mosaic, real TPU) or ``"interpret"`` (the
+    TPU-simulating interpreter on the CPU test mesh; jaxlib >= 0.5).
+    The any-backend ``"emulate"`` transport lives with the chain it
+    mirrors (``compress/exchange.py::_emulated_bucket_gossip``).
+
+    Returns ``(mixed, residual_new)`` with ``buf``'s shape/dtype."""
+    if mode not in ("pallas", "interpret"):
+        raise ValueError(f"unknown gossip-kernel transport {mode!r}")
+    if buf.ndim != 1:
+        raise ValueError(
+            f"fused compressed gossip expects 1-D flat buckets, got shape "
+            f"{tuple(buf.shape)}")
+    if not offsets:
+        # size-1 mesh / edgeless topology: no exchange, but the chain
+        # still encodes (the EF residual is the codec error)
+        t = buf + residual
+        q, scale = _codec_encode(
+            codec, t.astype(jnp.float32),
+            noise.reshape(-1) if noise is not None else None)
+        d_own = _codec_decode(codec, q, scale).astype(buf.dtype)
+        return self_w[lax.axis_index(axis_name)] * buf, t - d_own
+    # pad to whole (32, 128) wire tiles; zeros are inert through the
+    # whole body (|0| never raises the scale max, 0 quantizes to 0,
+    # decodes to 0, mixes to 0, residual 0) and are sliced away below
+    n = int(buf.shape[0])
+    pad = (-n) % GOSSIP_TILE
+    if pad:
+        buf_p = jnp.pad(buf, (0, pad))
+        res_p = jnp.pad(residual, (0, pad))
+        noise_p = jnp.pad(noise, (0, pad)) if noise is not None else None
+    else:
+        buf_p, res_p, noise_p = buf, residual, noise
+    shape2d = (-1, _LANE)
+    out2d, res2d = _run_compressed_exchange(
+        buf_p.reshape(shape2d), res_p.reshape(shape2d),
+        noise_p.reshape(shape2d) if noise_p is not None else None,
+        self_w, recv_w, size, tuple(int(o) for o in offsets), axis_name,
+        codec, mode == "interpret")
+    return out2d.reshape(-1)[:n], res2d.reshape(-1)[:n]

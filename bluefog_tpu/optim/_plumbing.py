@@ -16,7 +16,8 @@ from jax.sharding import PartitionSpec as P
 def step_cache_key(cx, params, nar_backend: str, fuse: bool,
                    bucket_bytes: int, overlap: bool = False,
                    telemetry: bool = False, compression=None,
-                   gossip_axis=None, control: bool = False):
+                   gossip_axis=None, control: bool = False,
+                   gossip_kernel=None):
     """Everything that changes the COMPILED step program: mesh/topology
     identity, the exchange backend, the fusion knobs (they reshape the
     collective schedule), the overlap mode (it reshapes the carried state
@@ -27,7 +28,10 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
     axis of a larger mesh — a different axis is a different collective
     schedule), the control gate (``BLUEFOG_CONTROL=on`` threads the γ
     knob through the carried state — the gate itself is keyed; every
-    value the controller later actuates is traced data), and the
+    value the controller later actuates is traced data), the gossip-
+    kernel mode (``BLUEFOG_GOSSIP_KERNEL`` — it replaces the codec/
+    permute/mix chain with one pallas_call per bucket, and its
+    interleave hint reorders bucket issue), and the
     parameter tree structure.  One home for the tuple so the wrappers
     and any future cache agree on what invalidates a step — a knob
     resolved at build time but missing here would silently serve a stale
@@ -43,6 +47,7 @@ def step_cache_key(cx, params, nar_backend: str, fuse: bool,
             None if compression is None else compression.spec,
             gossip_axis,
             bool(control),
+            gossip_kernel,
             jax.tree.structure(params))
 
 

@@ -73,7 +73,9 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  fusion_bucket_bytes: Optional[int] = None,
                  compression: Optional[CP.CompressionConfig] = None,
                  comp_state=None,
-                 fusion_groups=None):
+                 fusion_groups=None,
+                 gossip_kernel: Optional[str] = None,
+                 interleave: bool = False):
     """Apply the configured averaging to ``params``.
 
     ``axis_name`` is the GOSSIP axis — it need not be the whole mesh.
@@ -109,6 +111,13 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     ``fusion_groups`` (``ops/fusion.py::shard_groups``, hybrid path):
     per-leaf bucket-partition keys — sharded and replicated leaves must
     not share codec statistics on a 2-level mesh.
+
+    ``gossip_kernel`` (a resolved mode from ``CX.effective_gossip_
+    kernel``, builders validate): run the compressed neighbor exchange
+    as ONE fused kernel per bucket instead of the codec/permute/mix
+    chain.  ``interleave`` (its codec-free companion): issue small
+    buckets' collectives first on the fused paths.  Both default off —
+    the default lowering is byte-frozen by the off-path contract.
     """
     if compression is not None:
         if comm_type == CommunicationType.empty:
@@ -119,7 +128,8 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
             params, comp_state, compression, mode=mode,
             axis_name=axis_name, topo=topo, sched=sched, step=step,
             fuse=F.fusion_enabled(fuse),
-            bucket_bytes=fusion_bucket_bytes, leaf_groups=fusion_groups)
+            bucket_bytes=fusion_bucket_bytes, leaf_groups=fusion_groups,
+            kernel=gossip_kernel)
     if comm_type == CommunicationType.empty:
         return params
     do_fuse = F.fusion_enabled(fuse)
@@ -164,7 +174,8 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     if do_fuse:
         return F.fused_tree_map(fn, params,
                                 max_bucket_bytes=fusion_bucket_bytes,
-                                pad_to=pad_to, leaf_groups=fusion_groups)
+                                pad_to=pad_to, leaf_groups=fusion_groups,
+                                interleave=interleave)
     return jax.tree.map(fn, params)
 
 
@@ -177,7 +188,8 @@ def _null_comp_diag():
 def _communicate_c(params, comm_type, axis_name, topo, sched, step,
                    machine_axes, machine_topo, nar_backend, fuse,
                    fusion_bucket_bytes, cfg, comp_state,
-                   fusion_groups=None):
+                   fusion_groups=None, gossip_kernel=None,
+                   interleave=False):
     """:func:`_communicate` with a UNIFORM ``(tree, comp_state', diag)``
     return, so the strategy bodies need no per-site branching: ``cfg is
     None`` takes the exact uncompressed path (byte-identical StableHLO)
@@ -186,12 +198,14 @@ def _communicate_c(params, comm_type, axis_name, topo, sched, step,
         tree = _communicate(params, comm_type, axis_name, topo, sched,
                             step, machine_axes, machine_topo, nar_backend,
                             fuse, fusion_bucket_bytes,
-                            fusion_groups=fusion_groups)
+                            fusion_groups=fusion_groups,
+                            interleave=interleave)
         return tree, None, None
     return _communicate(params, comm_type, axis_name, topo, sched, step,
                         machine_axes, machine_topo, nar_backend, fuse,
                         fusion_bucket_bytes, cfg, comp_state,
-                        fusion_groups=fusion_groups)
+                        fusion_groups=fusion_groups,
+                        gossip_kernel=gossip_kernel, interleave=interleave)
 
 
 def _comp_snap_kwargs(diag):
@@ -390,7 +404,7 @@ def consensus_step(base: optax.GradientTransformation,
                    topo=None, sched=None, machine_axes=None,
                    machine_topo=None, nar_backend=None, fuse=None,
                    fusion_bucket_bytes=None, telemetry: bool = False,
-                   compression=None):
+                   compression=None, gossip_kernel=None):
     """Consensus/CTA/AWC family (reference _DistributedReduceOptimizer,
     optimizers.py:297-482): average the *weights*, apply the local update
     computed from gradients at the pre-average point.  Only the exchange
@@ -405,11 +419,18 @@ def consensus_step(base: optax.GradientTransformation,
     ``compression`` (spec string or config, ``compress/``): compress the
     exchange wire.  Stateful configs (lossy / choco) change the state
     layout to ``{"base": ..., "compress": ...}`` — create it with
-    :func:`compress_wrap_init`."""
+    :func:`compress_wrap_init`.
+
+    ``gossip_kernel`` (mode string/bool, default ``BLUEFOG_GOSSIP_
+    KERNEL``, off): fuse the compressed neighbor exchange into one
+    kernel per bucket (``compress/exchange.py``); needs a dense
+    quantizer spec."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -420,7 +441,8 @@ def consensus_step(base: optax.GradientTransformation,
         averaged, cs_new, diag = _communicate_c(
             params, comm_type, axis_name, topo, sched, step,
             machine_axes, machine_topo, nar_backend, fuse,
-            fusion_bucket_bytes, cfg, cs)
+            fusion_bucket_bytes, cfg, cs,
+            gossip_kernel=gossip_kernel, interleave=interleave)
         updates, st_new = base.update(grads, st, averaged)
         new_params = optax.apply_updates(averaged, updates)
         out_state = ({"base": st_new, "compress": cs_new}
@@ -445,7 +467,8 @@ def atc_step(base: optax.GradientTransformation,
              comm_type: CommunicationType, axis_name,
              topo=None, sched=None, machine_axes=None, machine_topo=None,
              nar_backend=None, fuse=None, fusion_bucket_bytes=None,
-             telemetry: bool = False, compression=None):
+             telemetry: bool = False, compression=None,
+             gossip_kernel=None):
     """Adapt-then-combine (reference _DistributedAdaptThenCombineOptimizer,
     optimizers.py:485-841): local update first, then average the updated
     weights.  The reference re-implements each torch optimizer's math inside
@@ -453,11 +476,14 @@ def atc_step(base: optax.GradientTransformation,
     function, so ATC is just the other composition order.  Only the
     exchange is fused (``fuse``); the optimizer state stays per-leaf.
     ``telemetry`` as in :func:`consensus_step`; ``compression`` as in
-    :func:`consensus_step` (the ADAPTED iterate's wire is compressed)."""
+    :func:`consensus_step` (the ADAPTED iterate's wire is compressed);
+    ``gossip_kernel`` as in :func:`consensus_step`."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -470,7 +496,8 @@ def atc_step(base: optax.GradientTransformation,
         combined, cs_new, diag = _communicate_c(
             adapted, comm_type, axis_name, topo, sched, step,
             machine_axes, machine_topo, nar_backend, fuse,
-            fusion_bucket_bytes, cfg, cs)
+            fusion_bucket_bytes, cfg, cs,
+            gossip_kernel=gossip_kernel, interleave=interleave)
         out_state = ({"base": st_new, "compress": cs_new}
                      if comp_stateful else st_new)
         if telemetry:
@@ -494,7 +521,7 @@ def exact_diffusion_step(base: optax.GradientTransformation,
                          topo=None, sched=None, machine_axes=None,
                          machine_topo=None, nar_backend=None, fuse=None,
                          fusion_bucket_bytes=None, telemetry: bool = False,
-                         compression=None):
+                         compression=None, gossip_kernel=None):
     """Exact-Diffusion (a.k.a. D2): the bias-corrected diffusion recursion
     from the reference authors' own line of work (Yuan/Ying et al.; no
     reference-code counterpart — a beyond-parity strategy):
@@ -513,11 +540,14 @@ def exact_diffusion_step(base: optax.GradientTransformation,
     the first step reduces to plain ATC — the standard initialization).
     Only the phi exchange is fused (``fuse``); psi_prev stays per-leaf.
     ``compression`` compresses the PHI exchange (stateful configs add a
-    ``"compress"`` key; :func:`exact_diffusion_init` carries it)."""
+    ``"compress"`` key; :func:`exact_diffusion_init` carries it);
+    ``gossip_kernel`` as in :func:`consensus_step` (the phi wire)."""
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, sched=sched)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -529,7 +559,8 @@ def exact_diffusion_step(base: optax.GradientTransformation,
             phi, comm_type, axis_name, topo, sched, step,
             machine_axes, machine_topo, nar_backend, fuse,
             fusion_bucket_bytes, cfg,
-            opt_state["compress"] if comp_stateful else None)
+            opt_state["compress"] if comp_stateful else None,
+            gossip_kernel=gossip_kernel, interleave=interleave)
         state_new = {"base": base_new, "psi_prev": psi}
         if comp_stateful:
             state_new["compress"] = cs_new
@@ -706,7 +737,8 @@ def _inflight_unpack(bufs, template, fuse: bool,
 def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
                     machine_axes, machine_topo, nar_backend,
                     fuse, bucket_bytes, compression=None, comp_state=None,
-                    fusion_groups=None):
+                    fusion_groups=None, gossip_kernel=None,
+                    interleave=False):
     """Run the exchange on ``x`` and return the in-flight state the NEXT
     step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t.
 
@@ -719,7 +751,8 @@ def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
     full, cs_new, diag = _communicate_c(
         x, comm_type, axis_name, topo, sched, step, machine_axes,
         machine_topo, nar_backend, fuse, bucket_bytes, compression,
-        comp_state, fusion_groups=fusion_groups)
+        comp_state, fusion_groups=fusion_groups,
+        gossip_kernel=gossip_kernel, interleave=interleave)
     d = _mix_self_weight(comm_type, axis_name, topo, sched, step)
     neigh = jax.tree.map(lambda f, l: f - d.astype(l.dtype) * l, full, x)
     infl = {"bufs": _inflight_pack(neigh, fuse, bucket_bytes,
@@ -792,7 +825,7 @@ def delayed_consensus_step(base: optax.GradientTransformation,
                            topo=None, sched=None, machine_axes=None,
                            machine_topo=None, nar_backend=None, fuse=None,
                            fusion_bucket_bytes=None, telemetry: bool = False,
-                           compression=None):
+                           compression=None, gossip_kernel=None):
     """Overlapped consensus/CTA/AWC: fold the previous step's mix, adapt at
     the folded point (gradients at the pre-fold parameters, matching
     :func:`consensus_step`'s composition), and launch this step's exchange
@@ -806,7 +839,10 @@ def delayed_consensus_step(base: optax.GradientTransformation,
     create it with :func:`delayed_init` using the same fusion knobs.
     ``compression`` (direct specs only): the launch's wire is compressed;
     the carried buffers hold the decompressed neighbor part and the EF
-    residual rides the state (``delayed_init(compression=...)``)."""
+    residual rides the state (``delayed_init(compression=...)``).
+    ``gossip_kernel`` as in :func:`consensus_step` (the launch's wire —
+    the kernel-fused exchange composes with the pipeline: the carried
+    buffers hold the kernel's decoded neighbor part)."""
     _check_overlap_comm(comm_type, sched)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
@@ -814,6 +850,8 @@ def delayed_consensus_step(base: optax.GradientTransformation,
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, sched=sched,
                        overlap=True)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -824,7 +862,9 @@ def delayed_consensus_step(base: optax.GradientTransformation,
                                  sched, step, machine_axes, machine_topo,
                                  nar_backend, fuse, bucket, cfg,
                                  opt_state.get("compress")
-                                 if comp_stateful else None)
+                                 if comp_stateful else None,
+                                 gossip_kernel=gossip_kernel,
+                                 interleave=interleave)
         infl_new, cs_new, diag = (launch if cfg is not None
                                   else (launch, None, None))
         state_new = {"base": base_new, "inflight": infl_new}
@@ -847,7 +887,7 @@ def delayed_atc_step(base: optax.GradientTransformation,
                      topo=None, sched=None, machine_axes=None,
                      machine_topo=None, nar_backend=None, fuse=None,
                      fusion_bucket_bytes=None, telemetry: bool = False,
-                     compression=None):
+                     compression=None, gossip_kernel=None):
     """Overlapped adapt-then-combine: local adapt, fold the PREVIOUS
     adapted iterate's exchange, launch this one's.  The launch value is
     the adapted iterate, so the collective sits at the program tail; the
@@ -855,8 +895,9 @@ def delayed_atc_step(base: optax.GradientTransformation,
     result never blocks a step's critical path.
 
     Recurrence (after the step-0 warmup): ``z_t = adapt(x_t, g(x_t));
-    x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})``.  ``compression`` as in
-    :func:`delayed_consensus_step` (the adapted iterate's wire)."""
+    x_{t+1} = d_{t-1} z_t + N_{t-1}(z_{t-1})``.  ``compression`` and
+    ``gossip_kernel`` as in :func:`delayed_consensus_step` (the adapted
+    iterate's wire)."""
     _check_overlap_comm(comm_type, sched)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
@@ -864,6 +905,8 @@ def delayed_atc_step(base: optax.GradientTransformation,
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, sched=sched,
                        overlap=True)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -875,7 +918,9 @@ def delayed_atc_step(base: optax.GradientTransformation,
                                  sched, step, machine_axes, machine_topo,
                                  nar_backend, fuse, bucket, cfg,
                                  opt_state.get("compress")
-                                 if comp_stateful else None)
+                                 if comp_stateful else None,
+                                 gossip_kernel=gossip_kernel,
+                                 interleave=interleave)
         infl_new, cs_new, diag = (launch if cfg is not None
                                   else (launch, None, None))
         state_new = {"base": base_new, "inflight": infl_new}
@@ -899,7 +944,7 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
                                  machine_topo=None, nar_backend=None,
                                  fuse=None, fusion_bucket_bytes=None,
                                  telemetry: bool = False,
-                                 compression=None):
+                                 compression=None, gossip_kernel=None):
     """Overlapped exact-diffusion (the gradient-tracking-family member):
     the psi/phi bias correction runs exactly as in
     :func:`exact_diffusion_step`, but the combine of phi is the delayed
@@ -908,14 +953,16 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
     :func:`exact_diffusion_topology` first).  Warmup: step 0 reduces to
     the plain local adapt (phi_0 folds against the zero buffer).
     State adds ``psi_prev`` (:func:`delayed_init` with
-    ``exact_diffusion=True``).  ``compression`` as in
-    :func:`delayed_consensus_step` (the phi iterate's wire)."""
+    ``exact_diffusion=True``).  ``compression`` and ``gossip_kernel``
+    as in :func:`delayed_consensus_step` (the phi iterate's wire)."""
     _check_overlap_comm(comm_type, None)
     nar_backend = nar_backend or _api._nar_backend()
     fuse = F.fusion_enabled(fuse)
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
     cfg = CP.resolve_compression(compression)
     CX.check_supported(cfg, comm_value=comm_type.value, overlap=True)
+    gossip_kernel, interleave = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value=comm_type.value, fuse=fuse)
     comp_stateful = CX.stateful(cfg)
 
     def step_fn(params, grads, opt_state, step=0):
@@ -928,7 +975,9 @@ def delayed_exact_diffusion_step(base: optax.GradientTransformation,
                                  None, step, machine_axes, machine_topo,
                                  nar_backend, fuse, bucket, cfg,
                                  opt_state.get("compress")
-                                 if comp_stateful else None)
+                                 if comp_stateful else None,
+                                 gossip_kernel=gossip_kernel,
+                                 interleave=interleave)
         infl_new, cs_new, diag = (launch if cfg is not None
                                   else (launch, None, None))
         state_new = {"base": base_new, "psi_prev": psi,

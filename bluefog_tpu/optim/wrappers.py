@@ -88,7 +88,8 @@ class _JittedStrategyOptimizer:
                  overlap: Optional[bool] = None,
                  telemetry: Optional[bool] = None,
                  compression=None,
-                 control: Optional[bool] = None):
+                 control: Optional[bool] = None,
+                 gossip_kernel=None):
         self.base = base
         self.comm_type = comm_type
         self.atc = atc
@@ -105,6 +106,19 @@ class _JittedStrategyOptimizer:
                         else comm_type.value),
             sched=sched, overlap=S.overlap_enabled(overlap))
         self._comp_stateful = _cx.stateful(self.compression)
+        # single-kernel gossip (BLUEFOG_GOSSIP_KERNEL, compress/exchange.
+        # py): validated HERE so a bad combo (sparsifier spec, unfused
+        # build, explicit knob without a codec) fails at construction;
+        # the raw knob re-resolves at every step build like fuse, and
+        # the resolved mode joins the step-cache key.  The state layout
+        # is UNCHANGED by the kernel (the EF residual buffers are the
+        # same buckets), so the knob composes with checkpoints.
+        self.gossip_kernel = gossip_kernel
+        _cx.effective_gossip_kernel(
+            gossip_kernel, self.compression,
+            comm_value=("allreduce" if gradient_allreduce
+                        else comm_type.value),
+            fuse=_fusion.fusion_enabled(fuse))
         # in-graph telemetry gate (observability/ingraph.py): None =
         # resolve from BLUEFOG_TELEMETRY at step-build time, like the
         # fusion knobs; the resolved value joins the step-cache key.  With
@@ -231,6 +245,11 @@ class _JittedStrategyOptimizer:
             bucket_bytes = _fusion.resolve_max_bucket_bytes(
                 self.fusion_bucket_bytes)
         cfg = self.compression
+        gk_mode, _ = _cx.effective_gossip_kernel(
+            self.gossip_kernel, cfg,
+            comm_value=("allreduce" if self.gradient_allreduce
+                        else self.comm_type.value),
+            fuse=fuse)
         if self.overlap:
             if self.exact_diffusion:
                 if self.comm_type == CommunicationType.neighbor_allreduce:
@@ -240,7 +259,7 @@ class _JittedStrategyOptimizer:
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
                     fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
-                    compression=cfg)
+                    compression=cfg, gossip_kernel=self.gossip_kernel)
             else:
                 builder = (S.delayed_atc_step if self.atc
                            else S.delayed_consensus_step)
@@ -250,7 +269,7 @@ class _JittedStrategyOptimizer:
                     machine_axes=(cx.machine_axis, cx.local_axis),
                     machine_topo=machine_topo, fuse=fuse,
                     fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
-                    compression=cfg)
+                    compression=cfg, gossip_kernel=self.gossip_kernel)
         elif self.gradient_allreduce:
             step_core = S.gradient_allreduce_step(
                 self.base, cx.rank_axis, accumulate_steps=self.k,
@@ -271,7 +290,7 @@ class _JittedStrategyOptimizer:
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
                 fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
-                compression=cfg)
+                compression=cfg, gossip_kernel=self.gossip_kernel)
         else:
             builder = S.atc_step if self.atc else S.consensus_step
             step_core = builder(
@@ -280,7 +299,7 @@ class _JittedStrategyOptimizer:
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, fuse=fuse,
                 fusion_bucket_bytes=bucket_bytes, telemetry=telemetry,
-                compression=cfg)
+                compression=cfg, gossip_kernel=self.gossip_kernel)
         if not (self.gradient_allreduce or self.exact_diffusion
                 or self.overlap):
             # grad-allreduce accumulates internally; exact-diffusion and
@@ -313,14 +332,15 @@ class _JittedStrategyOptimizer:
             p2, g2, st2 = (pl.reshape_in(params), pl.reshape_in(grads),
                            pl.reshape_in(opt_state))
             n_out = 3 if telemetry else 2
-            # check_vma off under the pallas backend (same exemption as
-            # ops/api.py / training.py: the fused kernel's outputs carry
-            # no varying-manual-axes tags)
+            # check_vma off under the pallas backend AND the gossip
+            # kernel (same exemption as ops/api.py / training.py: a
+            # pallas kernel's outputs carry no varying-manual-axes tags)
             out = jax.shard_map(
                 shard_fn, mesh=pl.mesh,
                 in_specs=(pl.spec, pl.spec, pl.spec, P()),
                 out_specs=(pl.spec,) * n_out,
-                check_vma=not _api._nar_backend().startswith("pallas"),
+                check_vma=not (_api._nar_backend().startswith("pallas")
+                               or gk_mode in ("pallas", "interpret")),
             )(p2, g2, st2, step_idx)
             return tuple(pl.reshape_out(o) for o in out)
 
@@ -347,7 +367,9 @@ class _JittedStrategyOptimizer:
         key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
                              self.overlap, telemetry, self.compression,
                              gossip_axis=cx.rank_axis,
-                             control=self._control)
+                             control=self._control,
+                             gossip_kernel=_cx.resolve_gossip_kernel(
+                                 self.gossip_kernel))
         return fuse, bucket, telemetry, key
 
     # -- closed-loop controller hook (control/) ------------------------------
@@ -462,15 +484,22 @@ class _JittedStrategyOptimizer:
         cfg = self.compression
         stateful = self._comp_stateful
         backend = _api._nar_backend()
+        gk_mode, gk_interleave = _cx.effective_gossip_kernel(
+            self.gossip_kernel, cfg,
+            comm_value=("allreduce" if self.gradient_allreduce
+                        else self.comm_type.value),
+            fuse=fuse)
         pl = mesh_plumbing(cx, hierarchical)
-        check_vma = not backend.startswith("pallas")
+        check_vma = not (backend.startswith("pallas")
+                         or gk_mode in ("pallas", "interpret"))
 
         def core(tree_s, cs_s, si):
             out = S._communicate_c(
                 pl.unwrap(tree_s), comm_type, cx.rank_axis, topo,
                 self.sched, si, (cx.machine_axis, cx.local_axis),
                 machine_topo, backend, fuse, bucket_bytes, cfg,
-                pl.unwrap(cs_s) if stateful else None)
+                pl.unwrap(cs_s) if stateful else None,
+                gossip_kernel=gk_mode, interleave=gk_interleave)
             return pl.rewrap(out[0])
 
         if stateful:
@@ -578,7 +607,8 @@ def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
                                           sched: Optional[DynamicSchedule] = None,
                                           fuse=None, fusion_bucket_bytes=None,
                                           overlap=None, telemetry=None,
-                                          compression=None, control=None):
+                                          compression=None, control=None,
+                                          gossip_kernel=None):
     """CTA with (possibly dynamic) neighbor averaging — the flagship
     decentralized optimizer (optimizers.py:1326).
 
@@ -602,7 +632,8 @@ def DistributedNeighborAllreduceOptimizer(base, num_steps_per_communication=1,
         base, CommunicationType.neighbor_allreduce,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression, control=control)
+        telemetry=telemetry, compression=compression, control=control,
+        gossip_kernel=gossip_kernel)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
@@ -624,7 +655,7 @@ def DistributedAdaptThenCombineOptimizer(
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None, control=None):
+        compression=None, control=None, gossip_kernel=None):
     """ATC: local update inside the step, then communicate the adapted
     weights (optimizers.py:1426; internal :485-841).  ``overlap``: the
     combine of the adapted iterate lands one step later (staleness-1
@@ -633,7 +664,8 @@ def DistributedAdaptThenCombineOptimizer(
         base, communication_type, atc=True,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression, control=control)
+        telemetry=telemetry, compression=compression, control=control,
+        gossip_kernel=gossip_kernel)
 
 
 def DistributedAdaptWithCombineOptimizer(
@@ -641,7 +673,7 @@ def DistributedAdaptWithCombineOptimizer(
         num_steps_per_communication=1,
         sched: Optional[DynamicSchedule] = None,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None, control=None):
+        compression=None, control=None, gossip_kernel=None):
     """AWC: update and communication computed concurrently
     (optimizers.py:1497).  Same fixed point as consensus/CTA; XLA already
     runs the collective and the update math in parallel.  ``overlap``
@@ -652,13 +684,14 @@ def DistributedAdaptWithCombineOptimizer(
         base, communication_type, atc=False,
         num_steps_per_communication=num_steps_per_communication, sched=sched,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression, control=control)
+        telemetry=telemetry, compression=compression, control=control,
+        gossip_kernel=gossip_kernel)
 
 
 def DistributedExactDiffusionOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         fuse=None, fusion_bucket_bytes=None, overlap=None, telemetry=None,
-        compression=None, control=None):
+        compression=None, control=None, gossip_kernel=None):
     """Exact-Diffusion / D2 (beyond-reference; the bias-corrected
     diffusion from the BlueFog authors' research line): ATC with the
     psi-correction, so constant-step-size decentralized training reaches
@@ -679,7 +712,8 @@ def DistributedExactDiffusionOptimizer(
     return _JittedStrategyOptimizer(
         base, communication_type, exact_diffusion=True,
         fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes, overlap=overlap,
-        telemetry=telemetry, compression=compression, control=control)
+        telemetry=telemetry, compression=compression, control=control,
+        gossip_kernel=gossip_kernel)
 
 
 # ---------------------------------------------------------------------------

@@ -135,7 +135,8 @@ def make_train_step(model,
                     fusion_bucket_bytes: Optional[int] = None,
                     overlap: Optional[bool] = None,
                     telemetry: Optional[bool] = None,
-                    compression=None):
+                    compression=None,
+                    gossip_kernel=None):
     """Build the jitted global train step.
 
     ``communication``: one of ``neighbor_allreduce`` (default, decentralized
@@ -172,6 +173,16 @@ def make_train_step(model,
     compression=...)``.  ``None``/off lowers to byte-identical StableHLO
     versus the pre-compression step (asserted by
     ``tests/test_compress.py``).
+
+    ``gossip_kernel`` (default ``BLUEFOG_GOSSIP_KERNEL``, off): run the
+    compressed neighbor exchange as ONE fused Pallas kernel per fusion
+    bucket — quantize-on-store, concurrent wire RDMAs to all neighbors,
+    decode-on-load, in-register mix + EF residual (``docs/performance.md``
+    "Single-kernel gossip").  Needs a dense-quantizer ``compression``
+    (``int8``/``fp8``) and fused buckets; modes ``"pallas"`` (TPU),
+    ``"interpret"`` (CPU test mesh, jaxlib >= 0.5), ``"emulate"``
+    (ppermute transport, any backend).  Bit-exact vs the chain; off
+    lowers byte-identical StableHLO.
 
     ``telemetry`` (default ``BLUEFOG_TELEMETRY``, off): compute traced
     training-health aggregates INSIDE the step — consensus distance
@@ -225,6 +236,12 @@ def make_train_step(model,
         compression,
         comm_value="allreduce" if grad_ar else comm_type.value,
         sched=sched, overlap=overlap)
+    # validated here for fail-fast + the check_vma decision below; the
+    # strategy builders re-derive the same (mode, interleave) pair from
+    # the raw knob
+    gk_mode, _ = _cx.effective_gossip_kernel(
+        gossip_kernel, compression,
+        comm_value="allreduce" if grad_ar else comm_type.value, fuse=fuse)
     if overlap:
         if communication not in ("neighbor_allreduce", "allreduce",
                                  "exact_diffusion"):
@@ -249,7 +266,8 @@ def make_train_step(model,
             getattr(model, "contains_pallas", False)
             or getattr(getattr(model, "block_cls", None),
                        "contains_pallas", False))
-        check_vma = not (nar_backend.startswith("pallas") or model_pallas)
+        check_vma = not (nar_backend.startswith("pallas") or model_pallas
+                         or gk_mode in ("pallas", "interpret"))
     if overlap:
         if exact_diffusion:
             core = S.delayed_exact_diffusion_step(
@@ -258,7 +276,8 @@ def make_train_step(model,
                 machine_axes=(cx.machine_axis, cx.local_axis),
                 machine_topo=machine_topo, nar_backend=nar_backend,
                 fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-                telemetry=telemetry, compression=compression)
+                telemetry=telemetry, compression=compression,
+                gossip_kernel=gossip_kernel)
         else:
             builder = S.delayed_atc_step if atc else S.delayed_consensus_step
             core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
@@ -267,7 +286,8 @@ def make_train_step(model,
                            machine_topo=machine_topo,
                            nar_backend=nar_backend, fuse=fuse,
                            fusion_bucket_bytes=fusion_bucket_bytes,
-                           telemetry=telemetry, compression=compression)
+                           telemetry=telemetry, compression=compression,
+                           gossip_kernel=gossip_kernel)
     elif grad_ar:
         if num_steps_per_communication > 1:
             raise ValueError(
@@ -291,7 +311,8 @@ def make_train_step(model,
             machine_axes=(cx.machine_axis, cx.local_axis),
             machine_topo=machine_topo, nar_backend=nar_backend,
             fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-            telemetry=telemetry, compression=compression)
+            telemetry=telemetry, compression=compression,
+            gossip_kernel=gossip_kernel)
     else:
         builder = S.atc_step if atc else S.consensus_step
         core = builder(base_opt, comm_type, cx.rank_axis, topo=topo,
@@ -299,7 +320,8 @@ def make_train_step(model,
                        machine_axes=(cx.machine_axis, cx.local_axis),
                        machine_topo=machine_topo, nar_backend=nar_backend,
                        fuse=fuse, fusion_bucket_bytes=fusion_bucket_bytes,
-                       telemetry=telemetry, compression=compression)
+                       telemetry=telemetry, compression=compression,
+                       gossip_kernel=gossip_kernel)
     if not (exact_diffusion or overlap):
         tel_axis = S._telemetry_axis(
             comm_type, cx.rank_axis, (cx.machine_axis, cx.local_axis))

@@ -1,0 +1,110 @@
+#!/usr/bin/env bash
+# Hardened hardware bench path (`make bench-hw`): BENCH_r02–r05 all died
+# in backend init and banked nothing.  This wrapper makes a dead window
+# end with EVIDENCE and a flaky one end with a NUMBER:
+#
+#   1. DIAGNOSIS FIRST.  Before any bench attempt, run the transport
+#      probe (scripts/_probe.sh — a real compile+execute, because the
+#      r2→r3 outage answered device enumeration while hanging every
+#      compute RPC) and bank a structured probe record to the log.  A
+#      dead probe still proceeds to ONE bench attempt — bench.py's own
+#      watchdog banks the full "diagnosis" JSON (init exception, env,
+#      fresh-process device probe, driver-log tail) that the probe alone
+#      cannot produce.
+#   2. RETRY WITH FRESH PROCESSES.  Up to BENCH_INIT_ATTEMPTS (default
+#      3) full `python bench.py` runs — a new process per attempt, never
+#      a thread-level retry inside a wedged runtime (a stuck native RPC
+#      cannot be interrupted; bench.py's internal re-exec is disabled
+#      here via BENCH_MAX_ATTEMPTS=1 so THIS script owns the retry
+#      ladder and each rung starts clean).  Exponential backoff between
+#      attempts (BENCH_INIT_BACKOFF seconds, default 60, doubling) so a
+#      minutes-scale transport outage window can pass.
+#   3. ALWAYS BANK.  Every attempt's last JSON line is appended to
+#      BENCH_HW_OUT (default BENCH_HW.json) with attempt provenance; the
+#      first line carrying a "value" ends the ladder (success).  If all
+#      attempts skip, the LAST skip record — with its "diagnosis" block
+#      — is still banked, so the next alive accelerator window starts
+#      from evidence, not from "unreachable" with nothing attached.
+#
+# Usage: `make bench-hw`, or with the kernel knob for the on/off delta:
+#   BLUEFOG_GOSSIP_KERNEL=1 make bench-hw
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${BENCH_HW_OUT:-BENCH_HW.json}
+ATTEMPTS=${BENCH_INIT_ATTEMPTS:-3}
+BACKOFF=${BENCH_INIT_BACKOFF:-60}
+STAGE_BUDGET=${BENCH_HW_STAGE_BUDGET:-3300}
+LOG=${BENCH_HW_LOG:-bench_hw.log}
+# interpreter for the JSON record checks only — overridable so harnesses
+# that shim `python` on PATH (tests/test_hw_queue.py's fake transport)
+# can point the VALIDATION at a real interpreter while the shim still
+# intercepts the bench invocation itself
+JSON_PY=${BENCH_HW_PYTHON:-python}
+
+. scripts/_probe.sh
+
+stamp() { date -u +%FT%TZ; }
+
+echo "$(stamp) bench-hw start: attempts=$ATTEMPTS backoff=${BACKOFF}s" \
+    | tee -a "$LOG"
+
+# 1. diagnosis probe first — banked whether it passes or not
+if probe; then
+    PROBE_STATUS=alive
+else
+    PROBE_STATUS=dead
+fi
+echo "$(stamp) transport probe: $PROBE_STATUS" | tee -a "$LOG"
+
+for attempt in $(seq 1 "$ATTEMPTS"); do
+    echo "$(stamp) bench attempt $attempt/$ATTEMPTS (fresh process)" \
+        | tee -a "$LOG"
+    # BENCH_MAX_ATTEMPTS=1: this script owns the retry ladder — the
+    # in-process re-exec would double-retry and burn the window
+    line=$(timeout -k 30 "$STAGE_BUDGET" \
+        env BENCH_MAX_ATTEMPTS=1 python bench.py 2>>"$LOG" | tail -n 1)
+    # only a line that PARSES as JSON is banked as the record: a SIGKILL
+    # mid-print (or a stray last stdout line) must not corrupt the
+    # evidence file's one-JSON-per-line contract — the raw fragment goes
+    # to the log instead
+    if [ -n "$line" ] && printf '%s' "$line" | \
+            "$JSON_PY" -c 'import json,sys; json.loads(sys.stdin.read())' \
+            2>/dev/null; then
+        echo "{\"bench_hw_attempt\": $attempt, \"probe\": \"$PROBE_STATUS\"," \
+             "\"ts\": \"$(stamp)\", \"record\": $line}" >> "$OUT"
+        echo "$(stamp) attempt $attempt banked: $line" | tee -a "$LOG"
+    else
+        echo "{\"bench_hw_attempt\": $attempt, \"probe\": \"$PROBE_STATUS\"," \
+             "\"ts\": \"$(stamp)\", \"record\": null," \
+             "\"note\": \"no parseable JSON line (killed at ${STAGE_BUDGET}s stage budget?)\"}" \
+             >> "$OUT"
+        echo "$(stamp) attempt $attempt produced no parseable JSON line:" \
+             "$line" | tee -a "$LOG"
+        line=""
+    fi
+    # success = a measured value: the TOP-LEVEL "value" key (skip records
+    # carry none by the bench.py contract; a substring match would let a
+    # diagnosis block's driver-log tail containing '"value"' end the
+    # ladder as a false success)
+    if [ -n "$line" ] && printf '%s' "$line" | "$JSON_PY" -c \
+            'import json,sys; sys.exit(0 if "value" in json.loads(sys.stdin.read()) else 1)' \
+            2>/dev/null; then
+        echo "$(stamp) measured value banked on attempt $attempt" \
+            | tee -a "$LOG"
+        exit 0
+    fi
+    if [ "$attempt" -lt "$ATTEMPTS" ]; then
+        echo "$(stamp) attempt $attempt skipped/failed; backoff ${BACKOFF}s" \
+            | tee -a "$LOG"
+        sleep "$BACKOFF"
+        BACKOFF=$((BACKOFF * 2))
+        # re-probe between attempts: the log shows whether the transport
+        # came back before the retry or the retry hit a dead window too
+        if probe; then PROBE_STATUS=alive; else PROBE_STATUS=dead; fi
+        echo "$(stamp) transport re-probe: $PROBE_STATUS" | tee -a "$LOG"
+    fi
+done
+echo "$(stamp) bench-hw: no measured value in $ATTEMPTS attempt(s); last" \
+     "skip record (with diagnosis) banked in $OUT" | tee -a "$LOG"
+exit 1

@@ -6,6 +6,10 @@
 # Each stage gets its OWN wall budget, probes the transport first, and
 # is independently re-runnable.  Exit 9 = transport died mid-queue;
 # hw_watch.sh resumes watching and re-fires on the next alive window.
+# For the bench alone (no tier-1/tier-3 stages), `make bench-hw`
+# (scripts/bench_hw.sh) is the hardened retry-with-backoff ladder that
+# always banks the skip diagnosis — run it with BLUEFOG_GOSSIP_KERNEL=1
+# vs unset for the single-kernel-gossip on/off delta.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 LOG=${1:-hw_queue_r5.log}

@@ -1,0 +1,798 @@
+"""Single-kernel gossip (``BLUEFOG_GOSSIP_KERNEL``): Pallas fused
+compress + permute + mix with bucket interleaving.
+
+Covers the ISSUE-15 acceptance surface:
+
+* knob resolution (off/on/interpret/emulate spellings, env vs explicit)
+  and build-time validation with guidance (sparsifier / choco / unfused /
+  codec-less / non-gossip combos; env-resolved knob inert where it
+  cannot apply, explicit argument raising);
+* the collective-id registry (``ops/_pallas_util.py``): distinct
+  barrier-semaphore ids per kernel family, gossip keeping its historical
+  id, collision-rejecting registration;
+* bucket interleaving (``ops/fusion.py::interleave_order``): ascending
+  padded wire bytes, results restored in plan position;
+* BIT-exactness of the kernel gossip vs the ``compressed_mix`` chain —
+  params AND carried EF residuals — over multi-step runs on ragged
+  mixed-dtype trees, for int8 and fp8, across static and dynamic
+  schedules, under overlap and ATC/exact-diffusion, via the any-backend
+  ``emulate`` transport (and the real kernel under the Mosaic
+  interpreter where jaxlib provides it);
+* zero step recompiles across dynamic-schedule advances and fault
+  (degraded-guard) flips, knob in the step-cache key;
+* knob-off StableHLO byte identity (the standing off-path contract);
+* the trace invariants on THIS host: the real kernel step lowered for
+  the TPU platform via ``jax.export`` (Mosaic serializes at lowering
+  time, no device needed) runs exactly ONE pallas_call per fusion
+  bucket, zero standalone collective_permutes, zero widening wire
+  converts — including call-graph counting when XLA dedupes same-shape
+  bucket kernels into one shared function;
+* the bflint kernel-mode budget / wire-upcast fixtures (both ways).
+"""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import training as T
+from bluefog_tpu.analysis import tracehazards as TH
+from bluefog_tpu.compress import compressors as CP
+from bluefog_tpu.compress import exchange as CX
+from bluefog_tpu.ops import _pallas_util as PU
+from bluefog_tpu.ops import fusion as F
+from bluefog_tpu.optim import strategies as S
+from bluefog_tpu.optim._plumbing import step_cache_key
+from bluefog_tpu.utils import trace_metrics as TM
+from conftest import JAX_PRE_05
+
+CT = S.CommunicationType
+
+
+def ragged_tree(n, rng):
+    """Global-view [N, ...] tree: ragged shapes, mixed dtypes, a scalar
+    leaf and a zero-size leaf — the fusion layer's worst customers."""
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 33, 7)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(n, 40)), jnp.bfloat16),
+        "s": jnp.asarray(rng.normal(size=(n,)), jnp.float32),
+        "e": jnp.zeros((n, 0), jnp.float32),
+    }
+
+
+def grads_like(tree, rng):
+    return jax.tree.map(
+        lambda a: jnp.asarray(rng.normal(size=a.shape) * 0.01, a.dtype),
+        tree)
+
+
+def to_global_tree(tree):
+    """Rank-shard a global-view tree like the steppers' outputs: keeps
+    the compile-count asserts about STEADY STATE (host-layout first
+    inputs add one warmup compile that has nothing to do with the
+    kernel; same helper as tests/test_overlap.py)."""
+    from bluefog_tpu.ops import api as _api
+    return jax.tree.map(_api.to_global, tree)
+
+
+def assert_trees_bitwise_equal(a, b, what=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        na, nb = np.asarray(la), np.asarray(lb)
+        assert na.dtype == nb.dtype and na.shape == nb.shape, what
+        assert (na == nb).all(), (
+            what, na.dtype,
+            np.abs(na.astype(np.float64) - nb.astype(np.float64)).max())
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution + validation
+# ---------------------------------------------------------------------------
+
+def test_resolve_gossip_kernel_values(monkeypatch):
+    monkeypatch.delenv(CX.GOSSIP_KERNEL_ENV, raising=False)
+    assert CX.resolve_gossip_kernel(None) is None
+    for off in ("", "0", "none", "off", "False", False):
+        assert CX.resolve_gossip_kernel(off) is None
+    for on in ("1", "on", "pallas", "TRUE", True):
+        assert CX.resolve_gossip_kernel(on) == "pallas"
+    assert CX.resolve_gossip_kernel("interpret") == "interpret"
+    assert CX.resolve_gossip_kernel("Emulate") == "emulate"
+    monkeypatch.setenv(CX.GOSSIP_KERNEL_ENV, "emulate")
+    assert CX.resolve_gossip_kernel(None) == "emulate"
+    assert CX.resolve_gossip_kernel("off") is None   # explicit beats env
+    with pytest.raises(ValueError, match="gossip-kernel mode"):
+        CX.resolve_gossip_kernel("mosaic")
+    with pytest.raises(TypeError):
+        CX.resolve_gossip_kernel(3.5)
+
+
+def test_effective_gossip_kernel_env_inert_combos(monkeypatch):
+    monkeypatch.setenv(CX.GOSSIP_KERNEL_ENV, "1")
+    int8 = CP.resolve_compression("int8")
+    # fully applicable: kernel + interleave
+    assert CX.effective_gossip_kernel(
+        None, int8, comm_value="neighbor.allreduce") == ("pallas", True)
+    # no codec on fused gossip: interleave-only (the codec-free half)
+    assert CX.effective_gossip_kernel(
+        None, None, comm_value="neighbor.allreduce") == (None, True)
+    # non-gossip comm: fully inert
+    assert CX.effective_gossip_kernel(
+        None, int8, comm_value="allreduce") == (None, False)
+    assert CX.effective_gossip_kernel(
+        None, None, comm_value="empty") == (None, False)
+
+
+def test_effective_gossip_kernel_explicit_raises():
+    int8 = CP.resolve_compression("int8")
+    with pytest.raises(ValueError, match="dense-quantizer"):
+        CX.effective_gossip_kernel(
+            "pallas", None, comm_value="neighbor.allreduce")
+    with pytest.raises(ValueError, match="neighbor_allreduce gossip only"):
+        CX.effective_gossip_kernel("pallas", int8, comm_value="allreduce")
+    with pytest.raises(ValueError, match="fused flat buckets"):
+        CX.effective_gossip_kernel(
+            "pallas", int8, comm_value="neighbor.allreduce", fuse=False)
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("topk:0.1", "no kernel codec"),
+    ("randomk:0.5", "no kernel codec"),
+    ("identity", "no kernel codec"),
+    ("choco:int8:gamma=0.5", "CHOCO-under-kernel is deferred"),
+])
+def test_effective_gossip_kernel_rejects_codecs(spec, msg, monkeypatch):
+    cfg = CP.resolve_compression(spec)
+    # both spellings raise: these are misconfigurations, not inert combos
+    for value in ("pallas", None):
+        if value is None:
+            monkeypatch.setenv(CX.GOSSIP_KERNEL_ENV, "1")
+        with pytest.raises(ValueError, match=msg):
+            CX.effective_gossip_kernel(
+                value, cfg, comm_value="neighbor.allreduce")
+
+
+def test_builders_validate_gossip_kernel(bf_ctx):
+    with pytest.raises(ValueError, match="no kernel codec"):
+        bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), compression="topk:0.1", gossip_kernel="emulate")
+    with pytest.raises(ValueError, match="dense-quantizer"):
+        bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), gossip_kernel="pallas")
+    from bluefog_tpu.models.mlp import MLP
+    with pytest.raises(ValueError, match="CHOCO-under-kernel"):
+        T.make_train_step(MLP(features=(8,), num_outputs=4), optax.sgd(0.1),
+                          compression="choco:int8:gamma=0.5",
+                          gossip_kernel="emulate")
+
+
+def test_kernel_codec_mapping():
+    assert CP.kernel_codec(CP.resolve_compression("int8")) == "int8"
+    assert CP.kernel_codec(CP.resolve_compression("topk:0.5")) is None
+    assert CP.kernel_codec(
+        CP.resolve_compression("choco:int8:gamma=0.5")) is None
+    assert CP.kernel_codec(None) is None
+
+
+# ---------------------------------------------------------------------------
+# Collective-id registry
+# ---------------------------------------------------------------------------
+
+def test_collective_id_registry():
+    # gossip keeps its historical id: the dense kernel's lowered bytes
+    # (and any cross-process compile-cache entries) must not churn
+    assert PU.collective_id("gossip") == 7
+    ids = {PU.collective_id(f)
+           for f in ("gossip", "windows", "compressed_gossip")}
+    assert len(ids) == 3, "kernel families alias a barrier semaphore"
+    with pytest.raises(ValueError, match="unknown pallas collective"):
+        PU.collective_id("nope")
+
+
+def test_collective_id_registration_rules():
+    cid = PU.register_collective_family("_test_family")
+    assert PU.collective_id("_test_family") == cid
+    # idempotent re-register; conflicting id rejected
+    assert PU.register_collective_family("_test_family") == cid
+    with pytest.raises(ValueError, match="already id"):
+        PU.register_collective_family("_test_family", cid + 1)
+    with pytest.raises(ValueError, match="already belongs"):
+        PU.register_collective_family("_test_family2",
+                                      PU.collective_id("gossip"))
+    PU._COLLECTIVE_FAMILIES.pop("_test_family", None)
+
+
+# ---------------------------------------------------------------------------
+# Bucket interleaving
+# ---------------------------------------------------------------------------
+
+def test_interleave_order_small_first():
+    tree = {"big": jnp.zeros((3000,), jnp.float32),
+            "mid": jnp.zeros((40,), jnp.bfloat16),
+            "small": jnp.zeros((8,), jnp.float32)}
+    plan = F.plan_for(tree, max_bucket_bytes=4096)
+    order = F.interleave_order(plan)
+    sizes = [plan.buckets[i].padded * jnp.dtype(plan.buckets[i].dtype).itemsize
+             for i in order]
+    assert sizes == sorted(sizes)
+    assert set(order) == set(range(plan.n_buckets))
+
+
+def test_fused_tree_map_interleave_restores_plan_positions():
+    rng = np.random.default_rng(0)
+    tree = {"big": jnp.asarray(rng.normal(size=(3000,)), jnp.float32),
+            "small": jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(40,)), jnp.bfloat16)}
+    fn = lambda b: b * 2.0
+    plain = F.fused_tree_map(fn, tree, max_bucket_bytes=4096)
+    inter = F.fused_tree_map(fn, tree, max_bucket_bytes=4096,
+                             interleave=True)
+    assert_trees_bitwise_equal(plain, inter, "interleave changed values")
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: kernel gossip vs the compressed_mix chain
+# ---------------------------------------------------------------------------
+
+def _run_pair(make_opt, params, grads, steps=4):
+    """Step the knob-off chain and the kernel-path optimizer in lockstep;
+    assert params AND the carried EF residuals stay bitwise identical."""
+    params, grads = to_global_tree(params), to_global_tree(grads)
+    opt_ref = make_opt(None)
+    opt_k = make_opt("emulate")
+    st_r = to_global_tree(opt_ref.init(params))
+    st_k = to_global_tree(opt_k.init(params))
+    p_r, p_k = params, params
+    for t in range(steps):
+        p_r, st_r = opt_ref.step(p_r, grads, st_r, step=t)[:2]
+        p_k, st_k = opt_k.step(p_k, grads, st_k, step=t)[:2]
+    assert_trees_bitwise_equal(p_r, p_k, "params diverged")
+    assert_trees_bitwise_equal(st_r["compress"], st_k["compress"],
+                               "EF residuals diverged")
+    return opt_k
+
+
+@pytest.mark.parametrize("spec", ["int8", "fp8"])
+def test_emulate_bitexact_static(bf_ctx, spec):
+    rng = np.random.default_rng(0)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression=spec, gossip_kernel=gk), params, grads)
+
+
+def test_emulate_bitexact_multibucket_interleaved(bf_ctx):
+    """Small bucket cap -> several buckets per dtype: the kernel path
+    issues them in interleave order, values land in plan position."""
+    rng = np.random.default_rng(1)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression="int8", fusion_bucket_bytes=512,
+        gossip_kernel=gk), params, grads)
+
+
+def test_emulate_bitexact_dynamic_zero_recompiles(bf_ctx):
+    rng = np.random.default_rng(2)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    G = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(G, r), bf.size())
+    opt_k = _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), sched=sched, compression="int8", gossip_kernel=gk),
+        params, grads, steps=sched.period + 2)
+    # schedule advances are traced data on the kernel path too
+    assert len(opt_k._step_cache) == 1
+    assert next(iter(opt_k._step_cache.values()))._cache_size() == 1
+
+
+def test_emulate_bitexact_overlap(bf_ctx):
+    rng = np.random.default_rng(3)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), overlap=True, compression="int8",
+        gossip_kernel=gk), params, grads, steps=5)
+    _run_pair(lambda gk: bf.DistributedAdaptThenCombineOptimizer(
+        optax.sgd(0.05), overlap=True, compression="int8",
+        gossip_kernel=gk), params, grads, steps=5)
+
+
+def test_emulate_bitexact_atc_and_exact_diffusion(bf_ctx):
+    rng = np.random.default_rng(4)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    _run_pair(lambda gk: bf.DistributedAdaptThenCombineOptimizer(
+        optax.sgd(0.05), compression="int8", gossip_kernel=gk),
+        params, grads)
+    # exact-diffusion needs a symmetric topology
+    prev = bf.load_topology()
+    try:
+        bf.set_topology(bf.SymmetricExponentialGraph(bf.size()))
+        _run_pair(lambda gk: bf.DistributedExactDiffusionOptimizer(
+            optax.sgd(0.05), compression="int8", gossip_kernel=gk),
+            params, grads)
+    finally:
+        bf.set_topology(prev)
+
+
+def test_degraded_guard_flip_zero_recompiles(bf_ctx):
+    """Fault flips under the kernel path are traced data: the degraded
+    branch (local step + EF reset) and the kernel branch share one
+    compiled program."""
+    cx = bf_ctx
+    base = optax.sgd(0.05)
+    cfg = CP.resolve_compression("int8")
+    delayed = S.delayed_consensus_step(
+        base, CT.neighbor_allreduce, cx.rank_axis,
+        topo=cx.compiled_topology, nar_backend="xla", fuse=True,
+        compression=cfg, gossip_kernel="emulate")
+    guarded = S.with_degraded_guard(delayed, S.delayed_local_step(base))
+    spec = P(cx.rank_axis)
+
+    def stepper(p, g, st, step, degraded):
+        def shard_fn(ps, gs, sts, si, dg):
+            p_new, st_new = guarded(
+                jax.tree.map(lambda a: a[0], ps),
+                jax.tree.map(lambda a: a[0], gs),
+                jax.tree.map(lambda a: a[0], sts), si, dg)
+            lead = lambda t: jax.tree.map(lambda a: a[None], t)
+            return lead(p_new), lead(st_new)
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, spec, P(), P()), out_specs=(spec, spec),
+        )(p, g, st, step, degraded)
+
+    fn = jax.jit(stepper)
+    rng = np.random.default_rng(5)
+    params = to_global_tree(ragged_tree(bf.size(), rng))
+    grads = to_global_tree(grads_like(params, rng))
+    state = to_global_tree(jax.vmap(lambda pp: S.delayed_init(
+        base, pp, fuse=True, compression=cfg))(params))
+    p = params
+    for t, dg in enumerate([False, True, False, True, False]):
+        p, state = fn(p, grads, state, jnp.int32(t), jnp.asarray(dg))
+        if dg:
+            # the degraded branch resets the EF residuals
+            for b in jax.tree.leaves(state["compress"]):
+                assert np.abs(np.asarray(b)).sum() == 0
+    assert fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# Knob-off inertness + cache key
+# ---------------------------------------------------------------------------
+
+def test_kernel_off_is_hlo_identical(bf_ctx, monkeypatch):
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    x = jnp.zeros((n, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    monkeypatch.delenv(CX.GOSSIP_KERNEL_ENV, raising=False)
+    t_default, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False),
+        *args)
+    monkeypatch.setenv(CX.GOSSIP_KERNEL_ENV, "0")
+    t_env_off, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False),
+        *args)
+    t_off, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False,
+                          gossip_kernel="off"), *args)
+    assert t_default == t_env_off == t_off
+    # on a single-bucket plan the emulate transport's trace COINCIDES
+    # with the chain (it mirrors the bucket body op for op — that is the
+    # bit-exactness mechanism); on a multi-bucket plan the interleaved
+    # issue order makes it a different program with identical values
+    t_em, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False,
+                          gossip_kernel="emulate"), *args)
+    assert t_em == t_off
+    vb, ob = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8", fusion_bucket_bytes=512)
+    margs = (vb, ob, (x, y), jnp.int32(0))
+    t_multi_off, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False,
+                          fusion_bucket_bytes=512), *margs)
+    t_multi_em, _ = TM.lower_text(
+        T.make_train_step(model, base, compression="int8", donate=False,
+                          fusion_bucket_bytes=512, gossip_kernel="emulate"),
+        *margs)
+    assert t_multi_em != t_multi_off
+
+
+def test_gossip_kernel_joins_step_cache_key(bf_ctx):
+    cx = bf_ctx
+    params = {"w": jnp.zeros((bf.size(), 3), jnp.float32)}
+    k_off = step_cache_key(cx, params, "xla", True, 1 << 20)
+    k_on = step_cache_key(cx, params, "xla", True, 1 << 20,
+                          gossip_kernel="pallas")
+    k_em = step_cache_key(cx, params, "xla", True, 1 << 20,
+                          gossip_kernel="emulate")
+    assert len({k_off, k_on, k_em}) == 3
+
+
+def test_wrapper_keys_on_resolved_mode(bf_ctx):
+    rng = np.random.default_rng(6)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression="int8", gossip_kernel="emulate")
+    st = opt.init(params)
+    opt.step(params, grads, st, step=0)
+    key = next(iter(opt._step_cache))
+    assert "emulate" in key
+
+
+# ---------------------------------------------------------------------------
+# Trace invariants: one pallas_call per bucket, zero permutes, no wire
+# upcasts (real kernel, lowered for TPU via jax.export on this host)
+# ---------------------------------------------------------------------------
+
+def _export_text(step, *args):
+    try:
+        return TH.export_kernel_step_text(step, *args)
+    except ImportError:
+        pytest.skip("jax.export unavailable on this jax")
+
+
+def test_export_one_pallas_call_per_bucket(bf_ctx):
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    model = MLP(features=(8, 8), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    step = T.make_train_step(model, base, compression="int8",
+                             gossip_kernel="pallas", donate=True)
+    x = jnp.zeros((n, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 2), jnp.int32)
+    text = _export_text(step, variables, opt_state, (x, y), jnp.int32(0))
+    per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+    plan = F.plan_for(per_rank)
+    assert TH.count_pallas_calls_in_text(text) == plan.n_buckets
+    assert TM.count_collectives_in_text(text)["ppermute"] == 0
+    assert TH.find_wire_upcasts(text, "kernel") == []
+
+
+def test_export_multibucket_call_graph_count(bf_ctx):
+    """Same-shape buckets dedupe into ONE shared kernel function called
+    K times — the counter must count executions through the call graph,
+    not text occurrences."""
+    cx = bf_ctx
+    rng = np.random.default_rng(7)
+    n = bf.size()
+    tree = {"w1": jnp.asarray(rng.normal(size=(n, 3000)), jnp.float32),
+            "w2": jnp.asarray(rng.normal(size=(n, 129)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(n, 40)), jnp.bfloat16)}
+    cfg = CP.resolve_compression("int8")
+    spec = P(cx.rank_axis)
+
+    def prog(tg):
+        def shard(ts):
+            t1 = jax.tree.map(lambda a: a[0], ts)
+            state = CX.init_state(cfg, t1, bucket_bytes=4096)
+            mixed, ns, _ = CX.compressed_mix(
+                t1, state, cfg, mode="neighbor", axis_name=cx.rank_axis,
+                topo=cx.compiled_topology, step=0, fuse=True,
+                bucket_bytes=4096, kernel="pallas")
+            return jax.tree.map(lambda a: a[None], mixed)
+        return jax.shard_map(shard, mesh=cx.mesh, in_specs=spec,
+                             out_specs=spec, check_vma=False)(tg)
+
+    try:
+        from jax import export as jexport
+    except ImportError:
+        pytest.skip("jax.export unavailable")
+    text = jexport.export(jax.jit(prog), platforms=["tpu"])(tree)\
+        .mlir_module()
+    plan = F.plan_for(jax.tree.map(lambda a: a[0], tree),
+                      max_bucket_bytes=4096)
+    assert plan.n_buckets == 3
+    # two f32 buckets pad to the same (32, 128) kernel -> the TEXT holds
+    # only 2 custom-calls, but 3 executions
+    assert len(re.findall(r"custom_call @tpu_custom_call", text)) < 3
+    assert TH.count_pallas_calls_in_text(text) == 3
+    assert TM.count_collectives_in_text(text)["ppermute"] == 0
+
+
+def test_emulate_wire_budget(bf_ctx):
+    """The emulate transport keeps the chain's wire: permute budget =
+    buckets x offsets x 2 arrays, payload at wire dtype (the
+    make bench-kernel wire-byte invariant in miniature)."""
+    from bluefog_tpu.models.mlp import MLP
+    n = bf.size()
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression="int8")
+    x = jnp.zeros((n, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((n, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    chain = TM.collective_counts(
+        T.make_train_step(model, base, compression="int8", donate=False),
+        *args)
+    em = TM.collective_counts(
+        T.make_train_step(model, base, compression="int8", donate=False,
+                          gossip_kernel="emulate"), *args)
+    per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+    plan = F.plan_for(per_rank)
+    offsets = len(bf.context.ctx().compiled_topology.offsets)
+    assert em["ppermute"] == plan.n_buckets * offsets * 2
+    assert em["ppermute"] == chain["ppermute"]
+    assert em["ppermute_bytes"] == chain["ppermute_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# bflint kernel-mode rules: fixtures both ways
+# ---------------------------------------------------------------------------
+
+_KERNEL_OK = """\
+module {
+  func.func @main(%arg0: tensor<32x128xf32>) -> tensor<32x128xf32> {
+    %0 = call @wrapped_kernel(%arg0) : (tensor<32x128xf32>) -> tensor<32x128xf32>
+    return %0 : tensor<32x128xf32>
+  }
+  func.func private @wrapped_kernel(%arg0: tensor<32x128xf32>) -> tensor<32x128xf32> {
+    %0 = stablehlo.custom_call @tpu_custom_call(%arg0) {backend_config = ""} : (tensor<32x128xf32>) -> tensor<32x128xf32>
+    return %0 : tensor<32x128xf32>
+  }
+}
+"""
+
+_KERNEL_FALLBACK = """\
+module {
+  func.func @main(%arg0: tensor<32x128xf32>, %arg1: tensor<32x128xi8>) -> tensor<32x128xf32> {
+    %0 = stablehlo.custom_call @tpu_custom_call(%arg0) {backend_config = ""} : (tensor<32x128xf32>) -> tensor<32x128xf32>
+    %1 = "stablehlo.collective_permute"(%arg1) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>}> : (tensor<32x128xi8>) -> tensor<32x128xi8>
+    %2 = stablehlo.convert %1 : (tensor<32x128xi8>) -> tensor<32x128xf32>
+    %3 = stablehlo.add %0, %2 : tensor<32x128xf32>
+    return %3 : tensor<32x128xf32>
+  }
+}
+"""
+
+
+def test_budget_rule_kernel_mode_clean():
+    assert TH.analyze_trace(_KERNEL_OK, "fx", expected_ppermutes=0,
+                            kernel=True, expected_pallas_calls=1) == []
+
+
+def test_budget_rule_kernel_mode_missing_kernel():
+    fs = TH.analyze_trace(_KERNEL_OK, "fx", expected_ppermutes=0,
+                          kernel=True, expected_pallas_calls=2)
+    assert len(fs) == 1 and fs[0].rule == "trace-collective-budget"
+    assert "fused kernel" in fs[0].message
+
+
+def test_budget_rule_kernel_mode_chain_fallback():
+    fs = TH.analyze_trace(_KERNEL_FALLBACK, "fx", expected_ppermutes=0,
+                          kernel=True, expected_pallas_calls=1)
+    assert [f.rule for f in fs] == ["trace-collective-budget"]
+    assert "fell back to the ppermute chain" in fs[0].message
+
+
+def test_budget_rule_classic_mode_unchanged():
+    text = _KERNEL_FALLBACK
+    assert TH.check_collective_budget(text, "fx", 1) == []
+    fs = TH.check_collective_budget(text, "fx", 0)
+    assert len(fs) == 1 and "fusion plan budgets" in fs[0].message
+
+
+_UPCAST_IN_KERNEL_BODY = """\
+module {
+  func.func @main(%arg0: tensor<16xi8>) -> tensor<16xf32> {
+    %0 = call @gossip_codec_kernel_body(%arg0) : (tensor<16xi8>) -> tensor<16xf32>
+    return %0 : tensor<16xf32>
+  }
+  func.func private @gossip_codec_kernel_body(%arg0: tensor<16xi8>) -> tensor<16xf32> {
+    %0 = stablehlo.convert %arg0 : (tensor<16xi8>) -> tensor<16xf32>
+    %1 = "stablehlo.collective_permute"(%0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>}> : (tensor<16xf32>) -> tensor<16xf32>
+    return %1 : tensor<16xf32>
+  }
+}
+"""
+
+
+def test_wire_upcast_skips_kernel_body_kernel_traces_only():
+    """On a KERNEL-mode trace, a widening convert feeding a permute
+    inside an (interpret-mode inlined) kernel body function is the
+    kernel's in-register decode — skipped; the identical pattern outside
+    a kernel-named function still flags.  On a PLAIN trace the exemption
+    never applies: a user function that merely has "kernel" in its name
+    keeps the full wire-upcast check (review hardening — the name alone
+    is not evidence of a pallas body)."""
+    assert TH.find_wire_upcasts(_UPCAST_IN_KERNEL_BODY, "fx",
+                                kernel=True) == []
+    outside = _UPCAST_IN_KERNEL_BODY.replace("gossip_codec_kernel_body",
+                                             "plain_exchange_fn")
+    fs = TH.find_wire_upcasts(outside, "fx", kernel=True)
+    assert len(fs) == 1 and fs[0].rule == "trace-wire-upcast"
+    # plain trace: same 'kernel'-named function, exemption OFF
+    fs = TH.find_wire_upcasts(_UPCAST_IN_KERNEL_BODY, "fx")
+    assert len(fs) == 1 and fs[0].rule == "trace-wire-upcast"
+
+
+def test_count_pallas_calls_public_main_roots():
+    """jax.export prints ``func.func public @main`` — the call-graph
+    walk must root there (review hardening: a regex that only knew
+    bare/private spellings dropped main's call sites and fell back to
+    an arbitrary first private function)."""
+    text = """\
+module {
+  func.func public @main(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = call @wrapped_kernel(%arg0) : (tensor<8xf32>) -> tensor<8xf32>
+    %1 = call @wrapped_kernel(%0) : (tensor<8xf32>) -> tensor<8xf32>
+    return %1 : tensor<8xf32>
+  }
+  func.func private @decoy(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    return %arg0 : tensor<8xf32>
+  }
+  func.func private @wrapped_kernel(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = stablehlo.custom_call @tpu_custom_call(%arg0) {backend_config = ""} : (tensor<8xf32>) -> tensor<8xf32>
+    return %0 : tensor<8xf32>
+  }
+}
+"""
+    assert TH.count_pallas_calls_in_text(text) == 2
+    # a decoy private function printed FIRST must not become the root
+    reordered = text.replace("public @main", "@main")
+    assert TH.count_pallas_calls_in_text(reordered) == 2
+
+
+def test_weight_tables_edgeless_topology():
+    """A size-1 gossip axis compiles an edgeless topology (no shifts):
+    the kernel path's weight tables must come out empty instead of
+    crashing np.stack, so the kernel entry's no-exchange branch is
+    reachable (review hardening)."""
+    class _FakeTopo:
+        shifts = ()
+        offsets = ()
+        size = 1
+        self_weights = np.ones((1,), np.float64)
+
+    self_w, recv_w = CX._weight_tables("rank", _FakeTopo(), None, 0,
+                                       jnp.float32)
+    assert self_w.shape == (1,) and recv_w.shape == (0, 1)
+
+
+def test_kernel_entry_no_exchange_branch(bf_ctx):
+    """offsets=() (edgeless topology): the kernel entry still encodes —
+    the EF residual is the codec error — and mixes with the self weight
+    only, matching the chain's no-terms bucket body bit for bit."""
+    from bluefog_tpu.ops import pallas_kernels as PK
+    cx = bf_ctx
+    n = bf.size()
+    rng = np.random.default_rng(11)
+    xg = jnp.asarray(rng.normal(size=(n, 64)), jnp.float32)
+    self_w = jnp.full((n,), 0.5, jnp.float32)
+    spec = P(cx.rank_axis)
+
+    def prog(x):
+        def shard(xs):
+            buf = xs[0]
+            res = jnp.zeros_like(buf)
+            noise = jnp.zeros((buf.size,), jnp.float32)
+            out, r = PK.fused_compressed_gossip(
+                buf, res, noise, self_w, jnp.zeros((0, n), jnp.float32),
+                axis_name=cx.rank_axis, size=n, offsets=(), codec="int8",
+                mode="pallas")
+            return out[None], r[None]
+        return jax.shard_map(shard, mesh=cx.mesh, in_specs=spec,
+                             out_specs=(spec, spec), check_vma=False)(x)
+
+    out, res = jax.jit(prog)(xg)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(xg) * 0.5)
+    # residual = t - D(C(t)) with deterministic zero noise: bounded by
+    # one quantization step of the per-rank scale
+    scales = np.abs(np.asarray(xg)).max(axis=1, keepdims=True) / 127.0
+    assert (np.abs(np.asarray(res)) <= scales + 1e-7).all()
+
+
+def test_canonical_trace_checks_include_kernel_config(bf_ctx):
+    findings, report = TH.run_canonical_trace_checks(depth=2)
+    assert findings == []
+    k = report["fused_int8_kernel"]
+    assert k["pallas_calls"] == k["expected_pallas_calls"] == k["buckets"]
+    assert k["ppermute"] == 0
+
+
+def test_canonical_trace_checks_ignore_ambient_knob(bf_ctx, monkeypatch):
+    """The docs tell operators to export BLUEFOG_GOSSIP_KERNEL for
+    `make bench-hw`; the lint pass's CHAIN configs must pin the knob off
+    (an ambient knob would flip them to a Mosaic lowering the CPU path
+    refuses) — review hardening."""
+    monkeypatch.setenv(CX.GOSSIP_KERNEL_ENV, "1")
+    findings, report = TH.run_canonical_trace_checks(depth=2)
+    assert findings == []
+    assert report["fused_int8"]["ppermute"] == \
+        report["fused_int8"]["expected_ppermute"]
+
+
+# ---------------------------------------------------------------------------
+# Real kernel under the Mosaic TPU interpreter (jaxlib >= 0.5)
+# ---------------------------------------------------------------------------
+
+needs_interpreter = pytest.mark.skipif(
+    JAX_PRE_05,
+    reason="the fused gossip kernel needs the Mosaic TPU-simulating "
+           "interpreter; jaxlib<0.5 has no CPU lowering for its DMA "
+           "semaphores (same gate as test_pallas_kernels)")
+
+
+@needs_interpreter
+@pytest.mark.parametrize("spec", ["int8", "fp8"])
+def test_interpret_kernel_bitexact_static(bf_ctx, spec):
+    rng = np.random.default_rng(8)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    opt_ref = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression=spec)
+    opt_k = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression=spec, gossip_kernel="interpret")
+    st_r, st_k = opt_ref.init(params), opt_k.init(params)
+    p_r, p_k = params, params
+    for t in range(3):
+        p_r, st_r = opt_ref.step(p_r, grads, st_r, step=t)[:2]
+        p_k, st_k = opt_k.step(p_k, grads, st_k, step=t)[:2]
+    assert_trees_bitwise_equal(p_r, p_k, "interpret kernel params")
+    assert_trees_bitwise_equal(st_r["compress"], st_k["compress"],
+                               "interpret kernel residuals")
+
+
+@needs_interpreter
+def test_interpret_kernel_bitexact_dynamic(bf_ctx):
+    rng = np.random.default_rng(9)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    G = bf.load_topology()
+    sched = bf.compile_dynamic_schedule(
+        lambda r: bf.GetDynamicOnePeerSendRecvRanks(G, r), bf.size())
+    opt_ref = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), sched=sched, compression="int8")
+    opt_k = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), sched=sched, compression="int8",
+        gossip_kernel="interpret")
+    st_r, st_k = opt_ref.init(params), opt_k.init(params)
+    p_r, p_k = params, params
+    for t in range(sched.period + 1):
+        p_r, st_r = opt_ref.step(p_r, grads, st_r, step=t)[:2]
+        p_k, st_k = opt_k.step(p_k, grads, st_k, step=t)[:2]
+    assert_trees_bitwise_equal(p_r, p_k, "interpret dynamic params")
+    assert len(opt_k._step_cache) == 1
+
+
+# ---------------------------------------------------------------------------
+# Kernel entry validation
+# ---------------------------------------------------------------------------
+
+def test_fused_compressed_gossip_rejects_bad_inputs():
+    from bluefog_tpu.ops import pallas_kernels as PK
+    buf2d = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="1-D flat buckets"):
+        PK.fused_compressed_gossip(
+            buf2d, buf2d, None, jnp.zeros((8,)), jnp.zeros((1, 8)),
+            axis_name="rank", size=8, offsets=(1,), codec="int8",
+            mode="pallas")
+    buf = jnp.zeros((8,), jnp.float32)
+    with pytest.raises(ValueError, match="transport"):
+        PK.fused_compressed_gossip(
+            buf, buf, None, jnp.zeros((8,)), jnp.zeros((1, 8)),
+            axis_name="rank", size=8, offsets=(1,), codec="int8",
+            mode="emulate")

@@ -138,3 +138,84 @@ def test_watcher_refires_after_mid_queue_death(fake):
     assert "0 stage(s) failed" in qlog
     v = json.loads((tmp / "FUSED_VERDICT.json").read_text())
     assert v["speedup"] == pytest.approx(1.04)
+
+
+# ---------------------------------------------------------------------------
+# bench_hw.sh: the hardened hardware bench ladder (make bench-hw)
+# ---------------------------------------------------------------------------
+
+BENCH_HW = os.path.join(REPO, "scripts", "bench_hw.sh")
+
+
+def _run_bench_hw(env, tmp, attempts="2", backoff="1"):
+    env = dict(env,
+               BENCH_HW_OUT=str(tmp / "BENCH_HW.json"),
+               BENCH_HW_LOG=str(tmp / "bench_hw.log"),
+               BENCH_INIT_ATTEMPTS=attempts,
+               BENCH_INIT_BACKOFF=backoff,
+               # the PATH shim intercepts `python`; the record-validation
+               # helper must use a real interpreter
+               BENCH_HW_PYTHON=sys.executable)
+    r = subprocess.run(["bash", BENCH_HW], env=env, capture_output=True,
+                       text=True, timeout=180)
+    records = []
+    out_path = tmp / "BENCH_HW.json"
+    if out_path.exists():
+        records = [json.loads(line)
+                   for line in out_path.read_text().splitlines()]
+    return r, records
+
+
+def test_bench_hw_banks_value_and_stops(fake):
+    """An alive window ends the ladder on the first measured value."""
+    state, env, tmp = fake
+    (state / "bench.py.behavior").write_text("bench ok 1650")
+    r, records = _run_bench_hw(env, tmp)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert len(records) == 1
+    assert records[0]["bench_hw_attempt"] == 1
+    assert records[0]["probe"] == "alive"
+    assert records[0]["record"]["value"] == pytest.approx(1650.0)
+
+
+def test_bench_hw_all_skips_bank_diagnosis_and_fail(fake):
+    """A dead window retries with fresh processes and still banks every
+    skip record (the structured diagnosis evidence), exiting non-zero —
+    never an empty round (the BENCH_r02-r05 failure mode)."""
+    state, env, tmp = fake
+    (state / "bench.py.behavior").write_text("bench fail")
+    r, records = _run_bench_hw(env, tmp)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert [rec["bench_hw_attempt"] for rec in records] == [1, 2]
+    for rec in records:
+        assert rec["record"]["status"] == "skipped"
+        assert "value" not in rec["record"]
+    log = (tmp / "bench_hw.log").read_text()
+    assert "backoff 1s" in log and "transport re-probe" in log
+    # the ladder owns the retries: each attempt ran BENCH_MAX_ATTEMPTS=1
+
+
+def test_bench_hw_probe_dead_still_attempts(fake):
+    """A dead probe is banked but does NOT veto the bench attempt —
+    bench.py's own watchdog produces the full diagnosis JSON the probe
+    cannot."""
+    state, env, tmp = fake
+    (state / "alive").unlink()
+    (state / "bench.py.behavior").write_text("bench fail")
+    r, records = _run_bench_hw(env, tmp, attempts="1")
+    assert r.returncode == 1
+    assert records and records[0]["probe"] == "dead"
+    assert records[0]["record"]["status"] == "skipped"
+
+
+def test_bench_hw_killed_attempt_banks_null_record(fake):
+    """A bench killed at the stage budget (or printing garbage) banks a
+    parseable record:null line — never a corrupt fragment in the
+    evidence JSONL."""
+    state, env, tmp = fake
+    (state / "bench.py.behavior").write_text("hang")
+    env = dict(env, BENCH_HW_STAGE_BUDGET="3")
+    r, records = _run_bench_hw(env, tmp, attempts="1")
+    assert r.returncode == 1
+    assert len(records) == 1 and records[0]["record"] is None
+    assert "no parseable JSON" in records[0]["note"]
