@@ -29,7 +29,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         bench-kernel bench-hw hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
-        ckpt-smoke bench-serve bench-ckpt lint
+        ckpt-smoke async-smoke bench-serve bench-ckpt lint
 
 test:
 	$(PYTEST) tests/
@@ -254,6 +254,17 @@ elastic-smoke:
 # "checkpoint" block with a schema-valid ckpt trail.
 ckpt-smoke:
 	python scripts/metrics_smoke.py --ckpt
+
+# Asynchronous-training smoke (docs/async.md): a push-sum fleet on
+# heterogeneous cadences (no cross-rank step barrier) must keep the
+# conserved de-biased mean equal to the NumPy reference at EVERY tick,
+# survive one mid-run death and one join (bootstrap_rank pulls the
+# joiner to the fleet average), refuse a cadence past
+# BLUEFOG_ASYNC_MAX_STALENESS, run the whole episode on ONE compiled
+# step program, and round-trip the async trail through validate_jsonl
+# and the real `bfmonitor --once --json` "async" block.
+async-smoke:
+	python scripts/metrics_smoke.py --async
 
 # Serving-tier bench (docs/serving.md): the end-to-end scenario on the
 # virtual mesh — one JSON line with requests/sec, staleness p50/p95/p99

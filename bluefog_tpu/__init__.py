@@ -81,6 +81,7 @@ from .ops.api import (
     set_weights_override, clear_weights_override, weights_override,
 )
 
+from . import async_train
 from . import checkpoint
 from . import compress
 from . import control
@@ -98,6 +99,7 @@ from .ops.windows import (
     win_poll, win_wait, win_flush, win_mutex, win_lock, win_fetch,
     win_publish, win_bootstrap_rank,
     get_current_created_window_names, get_win_version,
+    win_version_vector,
     win_associated_p, turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,
     win_state_dict, load_win_state_dict,

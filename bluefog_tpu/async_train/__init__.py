@@ -1,0 +1,23 @@
+"""Asynchronous training subsystem: push-sum / win-put gossip SGD with
+no cross-rank step barrier.
+
+Each rank steps at its own cadence (:class:`CadenceScheduler`);
+neighbor state arrives through the nonblocking one-sided windows
+(``ops/windows.py``), and push-sum's associated-P scalar keeps the
+fleet average unbiased under asymmetric staleness.  See docs/async.md
+for the cadence model, the staleness bound, the de-bias math, and the
+composition table (compression, elastic membership, chaos fault plans,
+durable checkpoints).
+"""
+
+from .cadence import (CadenceScheduler, resolve_max_staleness,
+                      resolve_periods)
+from .steps import (AsyncPushSumOptimizer, AsyncWinPutOptimizer,
+                    conserved_debiased_mean, push_sum_step, win_put_step)
+
+__all__ = [
+    "CadenceScheduler", "resolve_periods", "resolve_max_staleness",
+    "win_put_step", "push_sum_step",
+    "AsyncWinPutOptimizer", "AsyncPushSumOptimizer",
+    "conserved_debiased_mean",
+]

@@ -1,0 +1,178 @@
+"""Host-side cadence control for the asynchronous optimizer family.
+
+Asynchrony in this package is DATA, not control flow: every rank's tick
+runs the same compiled programs, and which ranks actually fire is a
+host-built mask the :class:`CadenceScheduler` derives from per-rank
+*periods* — rank ``i`` with period ``k_i`` fires on ticks where
+``t % k_i == k_i - 1`` (the same convention as the sync wrappers'
+``num_steps_per_communication``).  Period 1 everywhere IS the
+synchronous optimizer, bit for bit.
+
+The scheduler closes the loop with the health engine
+(``observability/health.py``): a ``straggler`` verdict carries
+``value = median_step / fleet_median`` — exactly the slowdown ratio —
+so :meth:`CadenceScheduler.observe` throttles that rank to
+``period = ceil(ratio)``, letting it adapt/gossip less often while the
+fast ranks keep stepping.  The throttle is bounded: a period beyond
+``BLUEFOG_ASYNC_MAX_STALENESS`` is REFUSED (clamped, counted in
+``bf_async_refusals_total``) because the staleness a period-``k`` rank
+imposes on its out-neighbors' buffers is exactly ``k`` folds
+(docs/async.md "Staleness bound").
+"""
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+
+__all__ = ["CadenceScheduler", "resolve_periods", "resolve_max_staleness",
+           "MAX_STALENESS_ENV", "PERIODS_ENV"]
+
+MAX_STALENESS_ENV = "BLUEFOG_ASYNC_MAX_STALENESS"
+PERIODS_ENV = "BLUEFOG_ASYNC_PERIODS"
+DEFAULT_MAX_STALENESS = 8
+
+
+def resolve_max_staleness(max_staleness: Optional[int] = None) -> int:
+    """Explicit argument wins, else ``BLUEFOG_ASYNC_MAX_STALENESS``
+    (default 8 — the worst un-folded delivery count any rank may impose
+    on a neighbor's buffers)."""
+    if max_staleness is not None:
+        return int(max_staleness)
+    return int(os.environ.get(MAX_STALENESS_ENV,
+                              str(DEFAULT_MAX_STALENESS)))
+
+
+def resolve_periods(size: int, periods=None) -> np.ndarray:
+    """[N] int64 period vector: explicit argument wins, else
+    ``BLUEFOG_ASYNC_PERIODS`` (comma list — one entry per rank, or a
+    single value broadcast to the fleet), else all ones (synchronous
+    cadence)."""
+    if periods is None:
+        raw = os.environ.get(PERIODS_ENV, "")
+        if raw.strip():
+            vals = [int(v) for v in raw.split(",") if v.strip()]
+            periods = vals * size if len(vals) == 1 else vals
+    if periods is None:
+        return np.ones(size, dtype=np.int64)
+    arr = np.asarray(periods, dtype=np.int64).reshape(-1)
+    if arr.shape[0] != size:
+        raise ValueError(
+            f"periods has {arr.shape[0]} entries for a fleet of {size}")
+    if (arr < 1).any():
+        raise ValueError(f"periods must be >= 1, got {arr.tolist()}")
+    return arr
+
+
+class CadenceScheduler:
+    """Per-rank step cadence with bounded-staleness refusal.
+
+    ``periods[i] == k`` makes rank ``i`` fire (adapt + gossip) every
+    ``k``-th tick; between fires its window buffers keep accumulating
+    neighbor pushes (bounded staleness, ``ops/windows.py`` versions are
+    the observable).  All methods are host-side numpy — the masks they
+    produce flow into the compiled window programs as traced data, so
+    period changes NEVER recompile (asserted in
+    tests/test_async_train.py).
+    """
+
+    def __init__(self, size: int, periods=None, base_period: int = 1,
+                 max_staleness: Optional[int] = None):
+        self.size = int(size)
+        self.base_period = int(base_period)
+        self.max_staleness = resolve_max_staleness(max_staleness)
+        self.periods = resolve_periods(self.size, periods)
+        self.refusals = 0
+        # ranks THIS scheduler throttled (observe()): only these are
+        # restored to base_period when their straggler verdict clears —
+        # user-pinned heterogeneous cadences stay untouched
+        self._throttled = set()
+
+    # -- mask production ------------------------------------------------------
+
+    def active(self, step: int) -> np.ndarray:
+        """[N] bool: which ranks fire at tick ``step`` (the
+        ``t % k == k - 1`` convention of the sync wrappers'
+        ``_should_communicate``)."""
+        return (int(step) % self.periods) == (self.periods - 1)
+
+    def staleness_bound(self) -> int:
+        """Worst-case un-folded deliveries any buffer can accumulate:
+        the largest period in the fleet."""
+        return int(self.periods.max())
+
+    # -- period control -------------------------------------------------------
+
+    def set_period(self, rank: int, period: int) -> int:
+        """Set rank's period, refusing past the staleness cap: a request
+        beyond ``max_staleness`` is counted (``bf_async_refusals_total``)
+        and CLAMPED to the cap — the rank is throttled as far as the
+        bound allows, never further.  Returns the period applied."""
+        if not 0 <= rank < self.size:
+            raise ValueError(f"rank {rank} outside [0, {self.size})")
+        period = int(period)
+        if period < 1:
+            raise ValueError(f"period must be >= 1, got {period}")
+        if period > self.max_staleness:
+            self.refusals += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_async_refusals_total",
+                    "cadence periods refused by the bounded-staleness "
+                    "cap (BLUEFOG_ASYNC_MAX_STALENESS)").inc()
+            period = self.max_staleness
+        self.periods[rank] = period
+        if _metrics.enabled():
+            _metrics.gauge("bf_async_period",
+                           "per-rank cadence period (ticks between "
+                           "fires)").set(float(period), rank=str(rank))
+        return period
+
+    def observe(self, report) -> Dict[int, int]:
+        """Consume a health report (``health.evaluate`` output): every
+        ``straggler`` verdict's slowdown ratio (``value``) becomes that
+        rank's period; ranks this scheduler throttled earlier whose
+        verdicts cleared return to ``base_period``.  Returns the
+        ``{rank: period}`` changes applied."""
+        changes = {}
+        flagged = set()
+        for v in report.by_rule("straggler"):
+            rank = getattr(v, "rank", None)
+            if rank is None:
+                continue
+            flagged.add(rank)
+            want = max(self.base_period,
+                       int(np.ceil(float(v.value))))
+            if want != int(self.periods[rank]):
+                changes[rank] = self.set_period(rank, want)
+            self._throttled.add(rank)
+        for rank in sorted(self._throttled - flagged):
+            self._throttled.discard(rank)
+            if int(self.periods[rank]) != self.base_period:
+                changes[rank] = self.set_period(rank, self.base_period)
+        return changes
+
+    # -- checkpointing --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot (``checkpoint.fleet_state_dict``'s
+        ``async_cadence`` meta section): enough to resume mid-asynchrony
+        with the same masks from the same tick."""
+        return {"size": self.size, "base_period": self.base_period,
+                "max_staleness": self.max_staleness,
+                "periods": [int(p) for p in self.periods],
+                "refusals": int(self.refusals),
+                "throttled": sorted(int(r) for r in self._throttled)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if int(state["size"]) != self.size:
+            raise ValueError(
+                f"cadence snapshot is for fleet size {state['size']}, "
+                f"scheduler has {self.size}")
+        self.base_period = int(state["base_period"])
+        self.max_staleness = int(state["max_staleness"])
+        self.periods = np.asarray(state["periods"], np.int64).reshape(-1)
+        self.refusals = int(state.get("refusals", 0))
+        self._throttled = set(int(r) for r in state.get("throttled", ()))

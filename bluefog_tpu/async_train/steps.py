@@ -1,0 +1,461 @@
+"""Asynchronous optimizer family: per-rank cadence, no step barrier.
+
+The sync window optimizers (``optim/wrappers.py`` win-put / push-sum)
+advance every rank in lockstep.  Here each rank steps at its OWN period
+(:class:`~.cadence.CadenceScheduler`): a tick where rank ``i`` is
+inactive leaves its parameters, optimizer state, window tensor, and
+push row untouched while its in-neighbor buffers keep ACCUMULATING
+deliveries — bounded staleness, observable as the window version
+counters (``ops.windows.win_version_vector``).  All of that asynchrony
+is expressed as host-built numpy mask/weight matrices flowing into the
+window kernels and ONE jitted masked-adapt program as traced data — so
+cadence changes, straggler throttles, fault flips, and elastic joins
+never recompile (compile-count asserted in tests/test_async_train.py).
+
+Push-sum keeps the average unbiased under this asymmetric staleness:
+the window holds the biased iterate ``x`` with the associated-P scalar
+riding EVERY op at identical weights (``_push_fn`` / ``_update_fn``),
+so the conservation invariant
+
+    (sum_i x_i + undelivered buffer mass)
+    / (sum_i P_i + buffered P)  ==  mean(x_init)
+
+holds exactly at every tick whatever the cadences do —
+:func:`conserved_debiased_mean` is the assertable form
+(``make async-smoke`` checks it each step).  Period 1 everywhere
+reproduces the synchronous optimizers bit for bit; see docs/async.md
+for the cadence model, the staleness bound, and the de-bias math.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import timeline as _tl
+from ..compress import compressors as _cp
+from ..context import ctx
+from ..observability import ingraph as IG
+from ..observability import metrics as _metrics
+from ..observability import phases as _ph
+from ..ops import api as _api
+from ..ops import fusion as _fusion
+from ..ops import windows as W
+from ..optim import strategies as S
+from ..optim._plumbing import mesh_plumbing, step_cache_key
+from ..utils.compile_cache import note_step_cache
+from .cadence import CadenceScheduler
+
+__all__ = ["win_put_step", "push_sum_step", "AsyncWinPutOptimizer",
+           "AsyncPushSumOptimizer", "conserved_debiased_mean"]
+
+# bflint knob-outside-cache-key: per-INSTANCE constants.  The step cache
+# lives on the optimizer instance, so knobs fixed in __init__ for the
+# instance's lifetime are keyed by instance identity; ``window_prefix``
+# names the window (identity, not program shape); ``periods`` /
+# ``scheduler`` produce the per-tick masks — traced DATA by design (the
+# whole point of this package is that cadence never recompiles); and
+# ``trail`` is a host-side JSONL sink.
+_STEP_KEY_EXEMPT_KNOBS = frozenset({
+    "window_prefix", "periods", "scheduler", "trail",
+})
+
+
+def conserved_debiased_mean(name: str):
+    """The push-sum conservation observable, host-side: per-element
+    ``(sum_ranks tensor + undelivered buffer mass) / (sum P + buffered
+    P)`` over one window's state snapshot — EXACTLY the initial
+    parameter mean at every tick of a clean (no-death) async run,
+    whatever the cadences (mass in flight is still mass).  The per-step
+    unbiasedness assertion of ``make async-smoke`` and the async tests.
+    Call it between steps (no nonblocking op staged).  Returns the
+    window's creation tree with the rank axis dropped."""
+    w = W._window(name)
+    n = w.topo.size
+    denom = float(np.asarray(w.p).sum() + np.asarray(w.p_buffers).sum())
+
+    def leaf_mass(t, b):
+        # t: [N, *shape]; b: [N, slots, *shape] (padded slots are zero;
+        # fused windows carry one flat leaf — the math is shape-blind)
+        t = np.asarray(t)
+        b = np.asarray(b)
+        return (t.sum(axis=0) + b.sum(axis=(0, 1))) / denom
+
+    mean = jax.tree.map(leaf_mass, w.tensor, w.buffers)
+    # broadcast back to the global view and unpack to the creation tree
+    ext = w.external(jax.tree.map(
+        lambda m: jnp.broadcast_to(jnp.asarray(m), (n,) + m.shape), mean))
+    return jax.tree.map(lambda a: np.asarray(a[0]), ext)
+
+
+class _AsyncWindowBase:
+    """Shared machinery for the async win-put / push-sum wrappers: one
+    window for the whole parameter pytree (like the sync
+    ``_WindowOptimizerBase``), a :class:`CadenceScheduler` producing the
+    per-tick active masks, and ONE jitted masked-adapt program —
+    inactive ranks pass their params and optimizer state through a
+    ``jnp.where`` select inside the same compiled step, so a cadence
+    flip is a different mask value, never a different program."""
+
+    _instance_counter = [0]   # default names stay unique AND deterministic
+
+    def __init__(self, base, window_prefix: Optional[str] = None,
+                 periods=None, scheduler: Optional[CadenceScheduler] = None,
+                 telemetry: Optional[bool] = None, compression=None,
+                 trail=None):
+        self.base = base
+        if window_prefix is None:
+            window_prefix = f"async_opt{self._instance_counter[0]}"
+            self._instance_counter[0] += 1
+        self._name = window_prefix + ".params"
+        self._created = False
+        self.telemetry = telemetry
+        # wire compression rides win_create (the window owns the wire
+        # format), exactly like the sync window family
+        self.compression = _cp.resolve_compression(compression)
+        self.trail = trail
+        if scheduler is None:
+            scheduler = CadenceScheduler(ctx().size, periods=periods)
+        elif periods is not None:
+            raise ValueError("pass periods= or scheduler=, not both")
+        self.scheduler = scheduler
+        self._step_cache = {}
+
+    @property
+    def periods(self) -> np.ndarray:
+        return self.scheduler.periods
+
+    @property
+    def window_name(self) -> str:
+        return self._name
+
+    def _require_init(self):
+        if not self._created:
+            raise RuntimeError(
+                "async optimizer used before init(); call "
+                "state = opt.init(params) first to create the windows")
+
+    def init(self, params, zero_init: bool = False):
+        if not W.win_create(params, self._name, zero_init=zero_init,
+                            compression=self.compression):
+            raise ValueError(f"Cannot allocate window for {self._name}")
+        self._created = True
+        cx = ctx()
+        A = (cx.compiled_topology.weight_matrix != 0).astype(np.float64)
+        np.fill_diagonal(A, 0.0)
+        self._adj = A
+        return jax.vmap(self.base.init)(params)
+
+    def free(self):
+        if self._name in W.get_current_created_window_names():
+            W.win_free(self._name)
+        self._created = False
+
+    def _alive_vec(self, alive) -> np.ndarray:
+        n = self.scheduler.size
+        if alive is None:
+            return np.ones(n)
+        return np.asarray(alive, np.float64).reshape(-1)
+
+    def _exec_config(self, params):
+        """The step-cache key — same tuple home as the sync wrappers
+        (``optim/_plumbing.step_cache_key``), so whatever invalidates a
+        sync step invalidates an async one.  Cadence, liveness, and
+        straggler throttles are deliberately ABSENT: they are traced
+        data."""
+        cx = ctx()
+        fuse = _fusion.fusion_enabled(None)
+        bucket = _fusion.resolve_max_bucket_bytes(None)
+        telemetry = IG.telemetry_enabled(self.telemetry)
+        key = step_cache_key(cx, params, _api._nar_backend(), fuse, bucket,
+                             False, telemetry, self.compression,
+                             gossip_axis=cx.rank_axis)
+        return telemetry, key
+
+    def _build(self, telemetry: bool):
+        """One jitted masked local-adapt program: ``adapt_in`` is the
+        tree active ranks adapt (post-fold average / biased iterate),
+        ``keep`` the rows inactive ranks keep verbatim.  The optimizer
+        state is donated on TPU (same guard as the window kernels —
+        donation on host platforms only warns)."""
+        cx = ctx()
+        pl = mesh_plumbing(cx, False)
+        core = S.local_sgd_like_step(self.base, telemetry=telemetry,
+                                     axis_name=cx.rank_axis)
+
+        def stepper(keep, adapt_in, grads, opt_state, step_idx, active):
+            def shard_fn(pk, pa, g, st, si, act):
+                gate = pl.unwrap(act) != 0
+                sel = lambda new, old: jax.tree.map(
+                    lambda n, o: jnp.where(gate, n, o), new, old)
+                out = core(pl.unwrap(pa), pl.unwrap(g), pl.unwrap(st), si)
+                if telemetry:
+                    p_new, st_new, snap = out
+                else:
+                    p_new, st_new = out
+                p_out = sel(p_new, pl.unwrap(pk))
+                st_out = sel(st_new, pl.unwrap(st))
+                if telemetry:
+                    return (pl.rewrap(p_out), pl.rewrap(st_out),
+                            pl.rewrap(snap))
+                return pl.rewrap(p_out), pl.rewrap(st_out)
+
+            n_out = 3 if telemetry else 2
+            out = jax.shard_map(
+                shard_fn, mesh=pl.mesh,
+                in_specs=(pl.spec, pl.spec, pl.spec, pl.spec, P(),
+                          pl.spec),
+                out_specs=(pl.spec,) * n_out,
+                check_vma=not _api._nar_backend().startswith("pallas"),
+            )(pl.reshape_in(keep), pl.reshape_in(adapt_in),
+              pl.reshape_in(grads), pl.reshape_in(opt_state), step_idx,
+              pl.reshape_in(active))
+            return tuple(pl.reshape_out(o) for o in out)
+
+        donate = (3,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(stepper, donate_argnums=donate)
+
+    def _masked_adapt(self, keep, adapt_in, grads, opt_state, step,
+                      active):
+        telemetry, key = self._exec_config(keep)
+        hit = key in self._step_cache
+        note_step_cache(hit)
+        if not hit:
+            self._step_cache[key] = self._build(telemetry)
+        act = jnp.asarray(np.asarray(active, np.int32))
+        with _ph.step_phase("compute"):
+            return self._step_cache[key](keep, adapt_in, grads, opt_state,
+                                         jnp.asarray(step, jnp.int32), act)
+
+    def _observe_staleness(self):
+        """Pre-fold effective-staleness vector, only when someone is
+        listening (one device sync)."""
+        if _metrics.enabled() or self.trail is not None:
+            return W.win_version_vector(self._name)
+        return None
+
+    def _note(self, step, active, stale, p=None):
+        """Metrics + trail after the fold.  ``stale`` is the PRE-fold
+        version vector: for firing ranks it is exactly the deliveries
+        the fold just consumed."""
+        sched = self.scheduler
+        fired = np.flatnonzero(active)
+        stale_max = (float(np.max(stale[fired])) if stale is not None
+                     and fired.size else 0.0)
+        if _metrics.enabled():
+            steps = _metrics.counter(
+                "bf_async_steps_total",
+                "asynchronous optimizer fires per rank")
+            for r in fired:
+                steps.inc(rank=str(int(r)))
+            if stale is not None and fired.size:
+                hist = _metrics.histogram(
+                    "bf_async_staleness_steps",
+                    "un-folded deliveries consumed per fold (effective "
+                    "staleness)", buckets=(0, 1, 2, 4, 8, 16, 32))
+                for r in fired:
+                    hist.observe(float(stale[r]))
+            if p is not None:
+                _metrics.gauge(
+                    "bf_async_p_drift",
+                    "push-sum associated-P spread (max - min) across "
+                    "the fleet").set(float(p.max() - p.min()))
+            per = _metrics.gauge(
+                "bf_async_period",
+                "per-rank cadence period (ticks between fires)")
+            for r in range(sched.size):
+                per.set(float(sched.periods[r]), rank=str(r))
+        if self.trail is not None:
+            self.trail.write_step(
+                int(step), active=int(len(fired)),
+                staleness_max=stale_max,
+                p_min=(float(p.min()) if p is not None else None),
+                p_max=(float(p.max()) if p is not None else None),
+                periods=sched.periods, refusals=sched.refusals)
+
+
+class AsyncWinPutOptimizer(_AsyncWindowBase):
+    """Asynchronous win-put flavor: active ranks put their params to
+    live out-neighbors and fold their buffers with the averaging
+    ``win_update``; inactive ranks neither push (their rows of the put
+    matrix are zero — no delivery, no version bump) nor fold (their
+    columns of the fold matrix are zero — ``_update_fn`` leaves
+    zero-weight columns' buffers and versions untouched, so deliveries
+    keep accumulating until their next fire).  A dead neighbor's
+    buffer mass degrades to the self weight through the shared
+    ``win_update(alive=)`` contract — the same staleness fold serving
+    uses (docs/windows.md)."""
+
+    def step(self, params, grads, opt_state, step: int = 0, alive=None):
+        self._require_init()
+        alive_v = self._alive_vec(alive)
+        active = self.scheduler.active(step) & (alive_v > 0)
+        stale = self._observe_staleness()
+        fire = active.astype(np.float64)
+        # rows: only firing sources put; columns: dead destinations get
+        # nothing (their buffers would never be read)
+        D = self._adj * fire[:, None] * (alive_v > 0)[None, :]
+        tok = _tl.op_start_us()
+        with _ph.step_phase("exchange"):
+            W.win_wait(W.win_put_nonblocking(params, self._name,
+                                             dst_weights=D))
+        _tl.record_gossip_round(step, tok)
+        with _ph.step_phase("fold"):
+            sw, U = self._fold_weights(active)
+            averaged = W.win_update(self._name, self_weight=sw,
+                                    neighbor_weights=U, require_mutex=True,
+                                    alive=alive_v)
+        out = self._masked_adapt(params, averaged, grads, opt_state, step,
+                                 active)
+        self._note(step, active, stale)
+        return out
+
+    def _fold_weights(self, active):
+        """Uniform ``1/(in_degree+1)`` averaging weights with inactive
+        DESTINATIONS gated off (zero column + self weight 1 keeps their
+        tensor, buffers, and versions untouched).  Dead-row handling is
+        NOT here — it rides ``win_update(alive=)``, which moves a dead
+        in-neighbor's weight onto the self weight (the shared
+        serving/training staleness-fold contract)."""
+        n = self._adj.shape[0]
+        indeg = self._adj.sum(axis=0)
+        col = 1.0 / (indeg + 1.0)
+        U = self._adj * col[None, :]
+        fire = active.astype(np.float64)
+        U = U * fire[None, :]
+        sw = np.where(active, col, 1.0)
+        return sw, U
+
+
+class AsyncPushSumOptimizer(_AsyncWindowBase):
+    """Asynchronous gradient-push: the window holds the biased iterate
+    ``x`` with the associated-P scalar riding every op; user-visible
+    params are the de-biased ``x / P``.  Per tick: masked local adapt
+    on the biased iterate, self-scaled push-accumulate from firing
+    ranks (per-source ``alpha = 1/(live_out_degree+1)`` keeps each
+    source's outgoing mass at exactly 1 even as deaths shrink its edge
+    set), then a per-destination-gated SUM collect — firing ranks
+    consume their accumulated buffers (``reset=True``), idle ranks'
+    buffers keep growing.  Dead in-neighbor rows are DROPPED from the
+    collect (``win_update_then_collect(alive=)`` semantics — a sum must
+    not move lost mass to the self weight); P rides the identical
+    weights, so the de-bias stays exact under the mask (the PR 11
+    masked-weights invariant, extended to the training path)."""
+
+    def init(self, params):
+        W.turn_on_win_ops_with_associated_p()
+        return super().init(params, zero_init=True)
+
+    def _debias(self, tree):
+        p = W.win_associated_p_vector(self._name)  # [N] device, no sync
+        return jax.tree.map(
+            lambda leaf: leaf / p.reshape(
+                (-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype), tree)
+
+    def _push_weights(self, active, alive_v):
+        """(self_weight [N], dst_weights [N,N]) for this tick: firing
+        sources push ``alpha_i`` to each LIVE out-neighbor and keep
+        ``alpha_i`` (row sum exactly 1 — mass conservation); idle and
+        dead sources have zero rows (no delivery, no version bump) and
+        self weight 1 (tensor preserved)."""
+        A = self._adj * (alive_v > 0)[None, :]
+        outdeg = A.sum(axis=1)
+        alpha = 1.0 / (outdeg + 1.0)
+        fire = active.astype(np.float64)
+        D = A * alpha[:, None] * fire[:, None]
+        sw = np.where(active, alpha, 1.0)
+        return sw, D
+
+    def _collect_weights(self, active, alive_v):
+        """SUM-collect weights: firing destinations take every live
+        in-neighbor buffer at weight 1 (self weight 1, ``reset=True``
+        zeroes exactly the slots read); idle destinations' columns are
+        zero — ``_update_fn`` gates the reset/version-clear on
+        ``weight != 0``, so their buffers keep accumulating.  Dead rows
+        are pre-masked out (dropped, not self-shifted: sum semantics)."""
+        fire = active.astype(np.float64)
+        U = self._adj * (alive_v > 0)[:, None] * fire[None, :]
+        sw = np.ones(self._adj.shape[0])
+        return sw, U
+
+    def step(self, params, grads, opt_state, step: int = 0, alive=None):
+        self._require_init()
+        alive_v = self._alive_vec(alive)
+        active = self.scheduler.active(step) & (alive_v > 0)
+        # the biased iterate lives in the window; `params` is the
+        # de-biased view; gradients are taken at the de-biased point
+        # (stochastic gradient-push), adapt applies to the biased one
+        biased = W.win_fetch(self._name)
+        out = self._masked_adapt(biased, biased, grads, opt_state, step,
+                                 active)
+        adapted, opt_state = out[0], out[1]
+        stale = self._observe_staleness()
+        sw, D = self._push_weights(active, alive_v)
+        tok = _tl.op_start_us()
+        with _ph.step_phase("exchange"):
+            # win_accumulate publishes `adapted * sw` as the new window
+            # tensor (idle rows: sw 1, value unchanged) and delivers the
+            # weighted rows — one staged program, committed by win_wait
+            W.win_wait(W.win_accumulate_nonblocking(
+                adapted, self._name, self_weight=sw, dst_weights=D,
+                require_mutex=True))
+        _tl.record_gossip_round(step, tok)
+        with _ph.step_phase("fold"):
+            sw2, U = self._collect_weights(active, alive_v)
+            collected = W.win_update(self._name, self_weight=sw2,
+                                     neighbor_weights=U, reset=True,
+                                     require_mutex=True)
+        p = (np.asarray(W.win_associated_p_vector(self._name))
+             if (_metrics.enabled() or self.trail is not None) else None)
+        self._note(step, active, stale, p=p)
+        result = self._debias(collected)
+        if len(out) == 3:
+            return result, opt_state, out[2]
+        return result, opt_state
+
+    def bootstrap_rank(self, rank: int, alive=None):
+        """Admit an (elastic) joiner mid-asynchrony: one
+        ``win_bootstrap_rank`` fold with ``reset=True`` — the pulled
+        slots must not re-enter the next SUM collect as phantom mass —
+        after which the joiner's ``x / P`` sits at the live de-biased
+        average (``win_get`` moves P with the same weights; no extra
+        plumbing).  Give the rank period 1 until its next health
+        review."""
+        self._require_init()
+        out = W.win_bootstrap_rank(self._name, rank,
+                                   alive=self._alive_vec(alive),
+                                   reset=True)
+        self.scheduler.set_period(rank, self.scheduler.base_period)
+        return self._debias(out)
+
+
+def win_put_step(base, window_prefix: Optional[str] = None, periods=None,
+                 scheduler: Optional[CadenceScheduler] = None,
+                 telemetry: Optional[bool] = None, compression=None,
+                 trail=None) -> AsyncWinPutOptimizer:
+    """Asynchronous win-put optimizer factory (the async mirror of
+    ``DistributedWinPutOptimizer``): each rank fires at its own period
+    (``periods`` [N] / ``scheduler`` / ``BLUEFOG_ASYNC_PERIODS``; all
+    ones = the synchronous optimizer bit for bit).  ``step(params,
+    grads, state, step=t, alive=mask)`` — see docs/async.md."""
+    return AsyncWinPutOptimizer(base, window_prefix=window_prefix,
+                                periods=periods, scheduler=scheduler,
+                                telemetry=telemetry,
+                                compression=compression, trail=trail)
+
+
+def push_sum_step(base, window_prefix: Optional[str] = None, periods=None,
+                  scheduler: Optional[CadenceScheduler] = None,
+                  telemetry: Optional[bool] = None, compression=None,
+                  trail=None) -> AsyncPushSumOptimizer:
+    """Asynchronous push-sum optimizer factory (the async mirror of
+    ``DistributedPushSumOptimizer``): unbiased average under per-rank
+    cadences via the associated-P scalar.  ``step(params, grads, state,
+    step=t, alive=mask)`` returns the de-biased view — see
+    docs/async.md for the conservation invariant and staleness bound."""
+    return AsyncPushSumOptimizer(base, window_prefix=window_prefix,
+                                 periods=periods, scheduler=scheduler,
+                                 telemetry=telemetry,
+                                 compression=compression, trail=trail)

@@ -47,8 +47,9 @@ from .snapshot import (ASYNC_ENV, DIR_ENV, EVERY_ENV,  # noqa: F401
                        write_shard)
 from .state import (FLEET_STATE_VERSION, FleetRestore,  # noqa: F401
                     apply_controller_state, apply_serving_state,
-                    controller_state, fleet_state_dict, flat_arrays,
-                    load_fleet_state, membership_state, plan_state,
+                    async_cadence_state, controller_state,
+                    fleet_state_dict, flat_arrays, load_fleet_state,
+                    membership_state, plan_state, restore_async_cadence,
                     restore_membership, restore_plan, serving_state)
 
 __all__ = [
@@ -57,7 +58,7 @@ __all__ = [
     "FleetRestore", "flat_arrays", "membership_state",
     "restore_membership", "plan_state", "restore_plan",
     "controller_state", "apply_controller_state", "serving_state",
-    "apply_serving_state",
+    "apply_serving_state", "async_cadence_state", "restore_async_cadence",
     # crash-consistent snapshots
     "FleetCheckpointer", "MANIFEST_NAME", "GLOBAL_SHARD", "shard_name",
     "step_dir_name", "write_shard", "file_crc32", "durable_manifests",

@@ -35,6 +35,7 @@ import numpy as np
 __all__ = ["FLEET_STATE_VERSION", "fleet_state_dict", "load_fleet_state",
            "FleetRestore", "flat_arrays", "membership_state",
            "restore_membership", "plan_state", "restore_plan",
+           "async_cadence_state", "restore_async_cadence",
            "controller_state", "apply_controller_state",
            "serving_state", "apply_serving_state"]
 
@@ -115,6 +116,29 @@ def restore_membership(meta: Dict[str, Any]):
     m.transitions = [(int(t), int(r), s)
                      for t, r, s in meta.get("transitions", ())]
     return m
+
+
+def async_cadence_state(scheduler) -> Dict[str, Any]:
+    """JSON-able snapshot of an async-training
+    :class:`~..async_train.CadenceScheduler` — the period vector,
+    staleness cap, refusal count, and throttle set.  Together with the
+    auto-captured window section (which already carries the push-sum
+    associated-P scalars and BOTH buffers of every double-buffered
+    window), this is everything a mid-asynchrony resume needs to be
+    bit-exact (docs/async.md "Checkpointing")."""
+    return scheduler.state_dict()
+
+
+def restore_async_cadence(meta: Dict[str, Any]):
+    """Rebuild the :class:`CadenceScheduler` a snapshot recorded —
+    periods, cap, refusals, throttles — so the resumed run fires the
+    same ranks at the same ticks."""
+    from ..async_train import CadenceScheduler
+    sched = CadenceScheduler(int(meta["size"]),
+                             base_period=int(meta["base_period"]),
+                             max_staleness=int(meta["max_staleness"]))
+    sched.load_state_dict(meta)
+    return sched
 
 
 def plan_state(plan, plan_step: int) -> Dict[str, Any]:
@@ -268,6 +292,7 @@ def fleet_state_dict(step: int, train=None, *, rng=None,
                      windows: Optional[bool] = None,
                      plan=None, plan_step: Optional[int] = None,
                      membership=None, controller=None, replicas=None,
+                     cadence=None,
                      counters: bool = True, topology: bool = True,
                      extra: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
@@ -283,9 +308,11 @@ def fleet_state_dict(step: int, train=None, *, rng=None,
     buffers of every double-buffered window), ``False`` skips,
     ``True`` requires.  ``plan``/``plan_step``: the live
     :class:`CompiledFaultPlan` and the step its tables had reached
-    (default ``step``).  ``membership`` / ``controller`` / ``replicas``:
-    the host-side directories whose decision state must survive the
-    restart.  ``counters`` records the metrics-registry snapshot;
+    (default ``step``).  ``membership`` / ``controller`` / ``replicas`` /
+    ``cadence``: the host-side directories whose decision state must
+    survive the restart (``cadence`` is the async-training
+    :class:`~..async_train.CadenceScheduler`; the window section it
+    pairs with — push-sum P included — is auto-captured).  ``counters`` records the metrics-registry snapshot;
     ``topology`` records the compiled mixing matrix (the elastic-restore
     and neighbor-replica fan-outs read it from the manifest).
 
@@ -325,13 +352,16 @@ def fleet_state_dict(step: int, train=None, *, rng=None,
         meta["control"] = controller_state(controller)
     if replicas is not None:
         meta["serving"] = serving_state(replicas)
+    if cadence is not None:
+        meta["async_cadence"] = async_cadence_state(cadence)
     if counters:
         from ..observability import metrics as _metrics
         meta["counters"] = _metrics.registry.snapshot()
     if extra:
         meta["extra"] = dict(extra)
     meta["sections"] = sorted(arrays) + sorted(
-        k for k in ("plan", "membership", "control", "serving")
+        k for k in ("plan", "membership", "control", "serving",
+                    "async_cadence")
         if k in meta)
     return {"version": FLEET_STATE_VERSION, "arrays": arrays, "meta": meta}
 

@@ -34,6 +34,7 @@ __all__ = [
     "rotate_file", "read_trail", "Trail", "MAX_MB_ENV", "KEEP_ENV",
     "MEMBERSHIP_SUFFIX", "MembershipTrail", "read_membership_trail",
     "CKPT_SUFFIX", "CkptTrail", "read_ckpt_trail",
+    "ASYNC_SUFFIX", "AsyncTrail", "read_async_trail",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -243,6 +244,53 @@ def read_ckpt_trail(path: str):
     """Tolerant reader: ``(config_record_or_None, records)`` — the same
     contract as the other sidecar trails."""
     return read_trail(path, "ckpt_config")
+
+
+# -- async-training trail (async_train/ subsystem's reporting sink) ----------
+
+ASYNC_SUFFIX = "async.jsonl"
+
+
+class AsyncTrail(Trail):
+    """Sidecar JSONL for asynchronous push-sum/win-put training runs
+    (``<prefix>async.jsonl``): an ``async_config`` head record (fleet
+    size, per-rank cadence periods, the bounded-staleness cap), then one
+    ``async`` record per logged tick — how many ranks fired, the worst
+    un-folded delivery count observed at the fold (the effective
+    staleness, ``win_version_vector``), the push-sum P-scalar spread
+    (de-bias drift evidence), the live period vector, and the
+    scheduler's cumulative bounded-staleness refusals — the
+    machine-readable feed ``bfmonitor --async`` renders and
+    ``validate_jsonl`` gates (docs/async.md)."""
+
+    def __init__(self, path: str, *, size: int, periods=(),
+                 max_staleness: int = 0):
+        super().__init__(path, head_kind="async_config")
+        self.write({"kind": "async_config", "size": int(size),
+                    "periods": [int(p) for p in periods],
+                    "max_staleness": int(max_staleness)})
+
+    def write_step(self, step: int, *, active: int, staleness_max: float,
+                   p_min: Optional[float] = None,
+                   p_max: Optional[float] = None,
+                   periods=None, refusals: Optional[int] = None) -> dict:
+        rec = {"kind": "async", "step": int(step), "active": int(active),
+               "staleness_max": float(staleness_max)}
+        if p_min is not None:
+            rec["p_min"] = float(p_min)
+        if p_max is not None:
+            rec["p_max"] = float(p_max)
+        if periods is not None:
+            rec["periods"] = [int(p) for p in periods]
+        if refusals is not None:
+            rec["refusals"] = int(refusals)
+        return self.write(rec)
+
+
+def read_async_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as the other sidecar trails."""
+    return read_trail(path, "async_config")
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -537,6 +585,13 @@ _KIND_REQUIRED = {
     "ckpt_config": ("t_us",),
     "ckpt": ("step", "t_us", "durable_step", "bytes", "save_s"),
     "ckpt_event": ("step", "t_us", "event"),
+    # async-training trail (AsyncTrail above, fed by the
+    # async_train/ subsystem's optimizers + CadenceScheduler): a config
+    # head with the cadence vector, then one periodic record per logged
+    # tick carrying the fired-rank count, the effective-staleness
+    # watermark, and the push-sum P spread (docs/async.md)
+    "async_config": ("t_us",),
+    "async": ("step", "t_us", "active", "staleness_max"),
     # health verdict trail (observability/health.py write_verdicts): one
     # "report" summary line per evaluation window, then one "verdict"
     # line per finding.  The trail shares this module's rotation policy
@@ -666,6 +721,34 @@ def _check_ckpt(path, lineno, rec):
                 f"{path}:{lineno}: ckpt_event 'rank' is not numeric")
 
 
+def _check_async(path, lineno, rec):
+    """Async-trail record shapes (AsyncTrail): ``async`` carries the
+    per-tick cadence accounting — fired-rank count, effective-staleness
+    watermark, push-sum P spread, live period vector.  Unknown fields
+    stay tolerated."""
+    for field in ("active", "staleness_max"):
+        v = rec[field]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: async {field!r} is not numeric")
+    for field in ("p_min", "p_max", "refusals"):
+        v = rec.get(field)
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, (int, float))):
+            raise ValueError(
+                f"{path}:{lineno}: async {field!r} is not numeric")
+    periods = rec.get("periods")
+    if periods is not None:
+        if not isinstance(periods, list):
+            raise ValueError(
+                f"{path}:{lineno}: async 'periods' must be a list")
+        for x in periods:
+            if isinstance(x, bool) or not isinstance(x, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: async 'periods' entry is not "
+                    f"numeric")
+
+
 def _check_structured(path, lineno, rec, check):
     """Shape checks for the documented structured fields: ``phases``
     (PR 7), ``step_wall_us`` (PR 7), ``edges`` and ``overlap_efficiency``
@@ -741,9 +824,11 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     (``kind: membership`` / ``membership_event`` /
     ``membership_config``, the :class:`MembershipTrail` above),
     checkpoint-trail lines (``kind: ckpt`` / ``ckpt_event`` /
-    ``ckpt_config``, the :class:`CkptTrail` above), and
-    health-verdict-trail lines (``kind: report`` / ``verdict``,
-    health.py) validate against their own required keys and shape
+    ``ckpt_config``, the :class:`CkptTrail` above), async-trail lines
+    (``kind: async`` / ``async_config``, the :class:`AsyncTrail`
+    above), and health-verdict-trail lines (``kind: report`` /
+    ``verdict``, health.py) validate against their own required keys
+    and shape
     instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
     keeps ``_KIND_REQUIRED`` in lockstep with every exporter.  Fields
     the schema does not know are tolerated (forward compatibility is
@@ -779,6 +864,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 _check_membership(path, lineno, rec)
             elif kind in ("ckpt", "ckpt_event"):
                 _check_ckpt(path, lineno, rec)
+            elif kind == "async":
+                _check_async(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

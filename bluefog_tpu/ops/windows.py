@@ -56,6 +56,7 @@ __all__ = [
     "win_poll", "win_wait", "win_flush", "win_mutex", "win_lock",
     "win_bootstrap_rank",
     "get_current_created_window_names", "get_win_version",
+    "win_version_vector",
     "win_associated_p", "win_associated_p_vector",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p", "win_fetch", "win_publish",
@@ -895,7 +896,7 @@ def win_update_then_collect(name: str, require_mutex: bool = True,
 
 
 def win_bootstrap_rank(name: str, rank: int, *, self_weight: float = 0.0,
-                       alive=None):
+                       alive=None, reset: bool = False):
     """One joiner catch-up round: pull ``rank``'s live in-neighbor window
     tensors (a ``win_get`` restricted to its in-edges) and fold ONLY its
     row toward their average — every other rank's tensor, buffers, and
@@ -913,6 +914,18 @@ def win_bootstrap_rank(name: str, rank: int, *, self_weight: float = 0.0,
     kept; 0.0 = adopt the in-neighbor average outright.  ``alive``
     (optional [N] mask) drops dead feeds; a joiner with NO live
     in-neighbor keeps its value (bounded staleness, never garbage).
+
+    ``reset`` zeroes the joiner's pulled buffer slots (and their
+    versions / P buffers) after the fold.  Averaging consumers (the
+    win-put family, serving collect) can leave them — leftovers are
+    merely slightly-stale values at the next fold — but SUM-semantics
+    consumers MUST pass ``reset=True``: an async push-sum collect
+    (``async_train/``) adds buffer contents to the tensor, so a
+    bootstrap leftover would re-enter the sum as phantom mass and break
+    the conservation invariant ``sum(x)/sum(P) == const``.  Under
+    ``with_p`` the get also pulls the in-neighbors' P scalars and the
+    fold mixes them with the same weights, so the joiner lands on
+    ``x/P ~= debiased average`` with no extra plumbing.
     Returns the window's global-view tensor after the fold
     (:func:`win_fetch` shape)."""
     w = _window(name)
@@ -933,7 +946,7 @@ def win_bootstrap_rank(name: str, rank: int, *, self_weight: float = 0.0,
     U[srcs, rank] = (1.0 - self_weight) / len(srcs)
     sw = np.ones(n)
     sw[rank] = self_weight
-    return win_update(name, self_weight=sw, neighbor_weights=U)
+    return win_update(name, self_weight=sw, neighbor_weights=U, reset=reset)
 
 
 def win_publish(name: str, tensor) -> None:
@@ -1003,6 +1016,21 @@ def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
     vers = np.asarray(w.versions)
     srcs = sorted(w.topo.in_neighbor_ranks(r))
     return {src: int(vers[r, slot]) for slot, src in enumerate(srcs)}
+
+
+def win_version_vector(name: str) -> np.ndarray:
+    """[N] effective-staleness vector: per rank, the MAX write-since-read
+    counter over its in-neighbor slots — how many deliveries have
+    accumulated in some buffer without a fold reading it.  This is the
+    observable behind the async-training staleness histogram
+    (``bf_async_staleness_steps``) and the bounded-staleness refusal
+    evidence in docs/async.md: a rank gossiping every ``k`` ticks sees
+    this grow to ``k`` and snap to 0 at its fold.  Host numpy (one
+    device sync); padded slots never bump, so they read 0."""
+    w = _window(name)
+    vers = np.asarray(w.versions)
+    return vers.max(axis=1) if vers.ndim == 2 and vers.shape[1] else \
+        np.zeros(w.topo.size, dtype=vers.dtype)
 
 
 def win_associated_p_vector(name: str):

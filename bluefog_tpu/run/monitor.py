@@ -34,7 +34,7 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
-           "render_checkpoint",
+           "render_checkpoint", "render_async",
            "render_edge_heatmap", "render_decisions", "render_serving",
            "render_membership"]
 
@@ -106,6 +106,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  serving_path: Optional[str] = None,
                  membership_path: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
+                 async_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -130,7 +131,12 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     ``observability/export.py::CkptTrail``) — last durable step, save
     seconds/bytes, and commit-protocol events (torn shards, replica
     repairs, restores) become the ``"checkpoint"`` block and the
-    ``--checkpoint`` panel."""
+    ``--checkpoint`` panel.  ``async_path``: the async-training trail
+    (default discovery: ``<prefix>async.jsonl``,
+    ``observability/export.py::AsyncTrail``) — the cadence period
+    vector, fired-rank and staleness series, push-sum P spread, and
+    bounded-staleness refusals become the ``"async"`` block and the
+    ``--async`` panel."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -199,6 +205,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     out["serving"] = _serving_block(prefix, serving_path)
     out["membership"] = _membership_block(prefix, membership_path)
     out["checkpoint"] = _checkpoint_block(prefix, checkpoint_path)
+    out["async"] = _async_block(prefix, async_path)
     return view, report, _strict_json(out)
 
 
@@ -350,6 +357,70 @@ def _checkpoint_block(prefix: str,
             "recent": events[-6:],
         },
     }
+
+
+def _async_block(prefix: str, async_path: Optional[str]) -> Optional[dict]:
+    """The async-training trail as a report block: the cadence period
+    vector, fired-rank and effective-staleness series (the panel
+    sparklines them), the push-sum P spread, and the scheduler's
+    bounded-staleness refusal count — None when no trail exists (a
+    synchronous run stays noise-free)."""
+    from ..observability.export import ASYNC_SUFFIX, read_async_trail
+    path = async_path or prefix + ASYNC_SUFFIX
+    config, records = read_async_trail(path)
+    if config is None and not records:
+        return None
+    ticks = [r for r in records if r.get("kind") == "async"]
+    latest = ticks[-1] if ticks else {}
+    series = {k: [t.get(k) for t in ticks
+                  if isinstance(t.get(k), (int, float))]
+              for k in ("active", "staleness_max")}
+    return {
+        "path": path,
+        "size": (config or {}).get("size"),
+        "max_staleness": (config or {}).get("max_staleness"),
+        "step": latest.get("step"),
+        "periods": latest.get("periods") or (config or {}).get("periods"),
+        "active": latest.get("active"),
+        "staleness_max": latest.get("staleness_max"),
+        "p_min": latest.get("p_min"),
+        "p_max": latest.get("p_max"),
+        "refusals": latest.get("refusals"),
+        "ticks": len(ticks),
+        "active_series": series["active"][-24:],
+        "staleness_series": series["staleness_max"][-24:],
+    }
+
+
+def render_async(block: dict, *, width: int = 12) -> str:
+    """The async-training panel (``--async``): cadence periods, the
+    fired-ranks and effective-staleness sparklines against the
+    ``BLUEFOG_ASYNC_MAX_STALENESS`` bound, push-sum P spread, and
+    bounded-staleness refusal alerts."""
+    periods = block.get("periods")
+    lines = [f"async:  step {block.get('step', '-')}  "
+             f"fired {block.get('active', '-')}"
+             f"/{block.get('size', '-')}  "
+             f"periods {periods if periods is not None else '-'}  "
+             f"cap {block.get('max_staleness', '-')}"]
+    act = [s for s in block.get("active_series", [])
+           if isinstance(s, (int, float))]
+    if act:
+        lines.append(f"  fired ranks    {sparkline(act, width)}")
+    stale = [s for s in block.get("staleness_series", [])
+             if isinstance(s, (int, float))]
+    if stale:
+        bound = block.get("max_staleness")
+        flag = (" ⚠ at bound" if bound is not None and stale
+                and stale[-1] >= bound else "")
+        lines.append(f"  staleness max  {sparkline(stale, width)}  "
+                     f"last {stale[-1]:g}{flag}")
+    if block.get("p_min") is not None and block.get("p_max") is not None:
+        lines.append(f"  push-sum P in [{block['p_min']:.4f}, "
+                     f"{block['p_max']:.4f}]")
+    if block.get("refusals"):
+        lines.append(f"  ⚠ staleness-cap refusals: {block['refusals']}")
+    return "\n".join(lines)
 
 
 def render_checkpoint(block: dict, *, width: int = 12) -> str:
@@ -617,6 +688,14 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-trail", default=None, metavar="PATH",
                    help="checkpoint trail to render (default: "
                         "<prefix>ckpt.jsonl when it exists)")
+    p.add_argument("--async", dest="async_panel", action="store_true",
+                   help="render the asynchronous-training panel (cadence "
+                        "periods, fired-rank and staleness sparklines, "
+                        "push-sum P spread, bounded-staleness refusal "
+                        "alerts) from the <prefix>async.jsonl trail")
+    p.add_argument("--async-trail", default=None, metavar="PATH",
+                   help="async trail to render (default: "
+                        "<prefix>async.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -633,7 +712,8 @@ def main(argv=None) -> int:
             verdicts_path=args.verdicts, decisions_path=args.decisions,
             serving_path=args.serving_trail,
             membership_path=args.membership_trail,
-            checkpoint_path=args.checkpoint_trail, cache=cache)
+            checkpoint_path=args.checkpoint_trail,
+            async_path=args.async_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -664,6 +744,14 @@ def main(argv=None) -> int:
                     print("\n(no checkpoint trail yet — the "
                           "FleetCheckpointer writes <prefix>ckpt.jsonl; "
                           "see docs/checkpoint.md)")
+            if args.async_panel:
+                if out.get("async"):
+                    print()
+                    print(render_async(out["async"]))
+                else:
+                    print("\n(no async trail yet — asynchronous runs "
+                          "write <prefix>async.jsonl; see "
+                          "docs/async.md)")
             if args.edges:
                 edges = out.get("edges")
                 if edges:

@@ -75,6 +75,20 @@ local shard restores from its neighbor replica; and the whole episode
 is verified through the real ``bfmonitor --once --json``
 ``"checkpoint"`` block with a schema-valid ckpt trail.
 
+``--async`` (``make async-smoke``) adds the asynchronous-training gate
+(docs/async.md): a push-sum fleet on heterogeneous cadences (periods
+1/2/3/4 — no cross-rank step barrier) must keep the conserved de-biased
+mean equal to the NumPy reference at EVERY tick (the push-sum
+unbiasedness invariant, float32 tolerance), survive one mid-run death
+(the invariant keeps holding — dead mass is frozen, not lost) and one
+mid-run join (``bootstrap_rank`` lands the joiner nearer the fleet
+average than its frozen stale params), refuse a cadence past
+``BLUEFOG_ASYNC_MAX_STALENESS`` (clamped, counted), run the whole
+episode on ONE compiled step program, and round-trip the async trail
+through ``validate_jsonl`` and the real ``bfmonitor --once --json``
+``"async"`` block; a win-put leg on alternating cadences must contract
+the parameter spread.
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -619,6 +633,170 @@ def ckpt_legs(n, tmp):
     }
 
 
+ASYNC_KILL, ASYNC_JOIN, ASYNC_TICKS = 12, 18, 28
+
+
+def async_legs(n, tmp):
+    """The ``make async-smoke`` gate (docs/async.md): heterogeneous
+    cadences with the conserved de-biased mean asserted against the
+    NumPy reference at every tick, one mid-run death + one join, a
+    bounded-staleness refusal, zero recompiles after warmup, and the
+    async trail round-tripped through the real ``bfmonitor``."""
+    from bluefog_tpu import async_train as AT
+    from bluefog_tpu.observability import metrics as MET
+
+    MET.enable()
+    lr = 0.02
+    rng = np.random.default_rng(16)
+    params = {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)}
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(
+            rng.normal(size=p.shape) * 0.1, jnp.float32), params)
+    gnp = {k: np.asarray(v, np.float64) for k, v in grads.items()}
+    init_mean = {k: np.asarray(v, np.float64).mean(axis=0)
+                 for k, v in params.items()}
+    periods = [(1, 2, 3)[i % 3] for i in range(n)]
+    periods[-1] = 4
+    dead = n - 3
+
+    prefix = os.path.join(tmp, "async_")
+    trail = EX.AsyncTrail(prefix + EX.ASYNC_SUFFIX, size=n,
+                          periods=periods,
+                          max_staleness=AT.resolve_max_staleness())
+    opt = AT.push_sum_step(optax.sgd(lr), periods=periods, trail=trail)
+    state = opt.init(params)
+    builds0 = MET.registry.counter("bf_step_cache_total").value(
+        result="build")
+
+    def spread(tree):
+        w = np.asarray(tree["w"], np.float64)
+        return float(np.abs(w - w.mean(axis=0)).max())
+
+    def conservation_error(adapted_mass):
+        """|conserved de-biased mean - NumPy reference| over the tree,
+        scaled to the reference magnitude."""
+        got = AT.conserved_debiased_mean(opt.window_name)
+        err = 0.0
+        for k in init_mean:
+            ref = init_mean[k] - adapted_mass[k] / n
+            err = max(err, float(np.abs(
+                np.asarray(got[k], np.float64) - ref).max()
+                / max(1.0, np.abs(ref).max())))
+        return err
+
+    EX.metrics_start(prefix, rank=0)
+    p, alive = params, np.ones(n)
+    mass = {k: np.zeros_like(v) for k, v in init_mean.items()}
+    worst = 0.0
+    try:
+        for t in range(ASYNC_JOIN):
+            if t == ASYNC_KILL:
+                alive = np.ones(n)
+                alive[dead] = 0.0
+            per = opt.scheduler.periods.copy()
+            fired = ((t % per) == per - 1) & (alive > 0)
+            p, state = opt.step(p, grads, state, step=t, alive=alive)
+            for k in mass:          # mass the fired ranks just adapted out
+                mass[k] += lr * gnp[k][fired].sum(axis=0)
+            worst = max(worst, conservation_error(mass))
+            EX.log_step(t, extra={"consensus_dist": spread(p)})
+        if worst > 5e-5:
+            fail(f"push-sum conservation broke under heterogeneous "
+                 f"cadences/death: worst per-tick error {worst:.2e}")
+
+        # -- one mid-run join: bootstrap lands nearer the fleet average --
+        live = np.flatnonzero(alive)
+        before = float(np.abs(
+            np.asarray(p["w"])[dead]
+            - np.asarray(p["w"])[live].mean(axis=0)).max())
+        alive = np.ones(n)
+        boot = opt.bootstrap_rank(dead, alive=alive)
+        after = float(np.abs(
+            np.asarray(boot["w"])[dead]
+            - np.asarray(boot["w"])[live].mean(axis=0)).max())
+        if not after < before:
+            fail(f"bootstrap did not pull the joiner toward the fleet "
+                 f"average: {before:.4f} -> {after:.4f}")
+        join_spread = spread(boot)
+        for t in range(ASYNC_JOIN, ASYNC_TICKS - 4):
+            p, state = opt.step(p, grads, state, step=t, alive=alive)
+            EX.log_step(t, extra={"consensus_dist": spread(p)})
+        if not np.isfinite(spread(p)) or not spread(p) < join_spread:
+            fail(f"post-join consensus did not re-contract: "
+                 f"{join_spread:.4f} -> {spread(p):.4f}")
+
+        # -- bounded-staleness refusal: clamped and counted --------------
+        cap = opt.scheduler.max_staleness
+        applied = opt.scheduler.set_period(0, cap + 5)
+        if applied != cap or opt.scheduler.refusals != 1:
+            fail(f"staleness cap not enforced: period {cap + 5} applied "
+                 f"as {applied}, refusals {opt.scheduler.refusals}")
+        for t in range(ASYNC_TICKS - 4, ASYNC_TICKS):
+            p, state = opt.step(p, grads, state, step=t, alive=alive)
+            EX.log_step(t, extra={"consensus_dist": spread(p)})
+        if not all(np.isfinite(np.asarray(v)).all() for v in p.values()):
+            fail("post-refusal params went non-finite")
+
+        builds = MET.registry.counter("bf_step_cache_total").value(
+            result="build") - builds0
+        if builds != 1:
+            fail(f"async episode recompiled the step across cadence "
+                 f"change/death/join: {builds} builds (expected the "
+                 f"single warmup build)")
+    finally:
+        EX.metrics_end()
+        trail.close()
+        opt.free()
+
+    # -- win-put flavor: alternating cadences still contract -------------
+    wopt = AT.win_put_step(optax.sgd(0.0),
+                           periods=[1 + (i % 2) for i in range(n)])
+    wstate = wopt.init(params)
+    wp, first = params, spread(params)
+    try:
+        for t in range(8):
+            wp, wstate = wopt.step(wp, jax.tree.map(jnp.zeros_like,
+                                                    params),
+                                   wstate, step=t)
+    finally:
+        wopt.free()
+    if not spread(wp) < first:
+        fail(f"win-put async flavor did not contract the spread: "
+             f"{first:.4f} -> {spread(wp):.4f}")
+
+    # -- trail schema + the real bfmonitor round-trip ---------------------
+    snap = MET.registry.snapshot()
+    if not any(k.startswith("bf_async_steps_total{") for k in snap):
+        fail(f"bf_async_steps_total never counted a fire: "
+             f"{[k for k in snap if k.startswith('bf_async')][:4]}")
+    if MET.counter("bf_async_refusals_total").value() < 1:
+        fail("bf_async_refusals_total did not count the refusal")
+    try:
+        EX.validate_jsonl(prefix + EX.ASYNC_SUFFIX)
+    except ValueError as e:
+        fail(f"async trail schema violation: {e}")
+    _, out = bfmonitor_json(prefix, "--async")
+    block = out.get("async")
+    if not block or block.get("size") != n:
+        fail(f"bfmonitor async block wrong: {block}")
+    if block.get("ticks") != ASYNC_TICKS:
+        fail(f"bfmonitor async block saw {block.get('ticks')} ticks, "
+             f"expected {ASYNC_TICKS}")
+    if block.get("refusals") != 1 or len(block.get("periods") or []) != n:
+        fail(f"bfmonitor async block missed the refusal / periods: "
+             f"{block}")
+    return {
+        "periods": periods,
+        "conservation_worst": float(f"{worst:.3e}"),
+        "dead_rank": dead,
+        "join_pull": [round(before, 4), round(after, 4)],
+        "final_spread": round(spread(p), 5),
+        "refused_period": cap + 5,
+        "episode_builds": 1,
+    }
+
+
 SERVE_STEPS, SERVE_REQS, SERVE_BOUND = 14, 4, 3
 
 
@@ -911,6 +1089,7 @@ def main():
     do_serve = "--serve" in sys.argv
     do_elastic = "--elastic" in sys.argv
     do_ckpt = "--ckpt" in sys.argv
+    do_async = "--async" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -1012,6 +1191,12 @@ def main():
         EX.metrics_end()           # release the sink for the ckpt legs
         ckpt_out = ckpt_legs(n, tmp)
 
+    # -- asynchronous-training gate (--async / make async-smoke) --------
+    async_out = None
+    if do_async:
+        EX.metrics_end()           # release the sink for the async legs
+        async_out = async_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -1050,6 +1235,8 @@ def main():
         out["elastic"] = elastic_out
     if ckpt_out:
         out["ckpt"] = ckpt_out
+    if async_out:
+        out["async"] = async_out
     print(json.dumps(out))
 
 

@@ -1,0 +1,542 @@
+"""Asynchronous training subsystem (bluefog_tpu/async_train/): push-sum
+and win-put gossip SGD with no cross-rank step barrier.
+
+Closed-form anchors, mirroring the sync optimizer suite:
+
+* periods all 1 == the synchronous ``DistributedPushSumOptimizer`` BIT
+  FOR BIT (the async wrapper is a strict generalization);
+* under heterogeneous cadences the conserved de-biased mean — (Σx +
+  buffered mass) / (ΣP + buffered P) — equals the NumPy reference
+  ``init_mean - lr * Σ g_fired / N`` at EVERY tick (push-sum
+  unbiasedness under asymmetric staleness, docs/async.md);
+* the invariant keeps holding through a mid-run death (dead mass is
+  frozen, never lost) and re-locks after a ``bootstrap_rank`` join
+  (``reset=True`` consumes the pulled buffer slots — no phantom mass);
+* the whole episode — cadence change, death, join — runs on ONE
+  compiled step program (asynchrony is traced data);
+* the health -> CadenceScheduler loop throttles EXACTLY the seeded
+  straggler rank to ``ceil(measured slowdown)`` and restores it when
+  the verdict clears;
+* a mid-asynchrony ``fleet_state_dict`` snapshot (windows + P +
+  cadence) resumes BIT-EXACT.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bluefog_tpu as bf
+from bluefog_tpu import async_train as AT
+from bluefog_tpu import checkpoint as CK
+from bluefog_tpu.observability import aggregate as AGG
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.observability import health as H
+from bluefog_tpu.observability import metrics as MET
+
+
+@pytest.fixture(autouse=True)
+def _clean_windows():
+    yield
+    bf.win_free()
+    bf.turn_off_win_ops_with_associated_p()
+
+
+def _params(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(n, 5)), jnp.float32)}
+
+
+def _grads(params, seed=1, scale=0.1):
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape) * scale,
+                              jnp.float32), params)
+
+
+def _periods(n):
+    per = [(1, 2, 3)[i % 3] for i in range(n)]
+    per[-1] = 4
+    return per
+
+
+def _spread(tree):
+    w = np.asarray(tree["w"], np.float64)
+    return float(np.abs(w - w.mean(axis=0)).max())
+
+
+def _assert_trees_equal(a, b, msg):
+    for ka, va in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.asarray(ka).tobytes() == np.asarray(va).tobytes(), msg
+
+
+class _ConservationRef:
+    """The NumPy side of the invariant: tracks the mass the fired ranks
+    adapted out and yields the expected conserved de-biased mean."""
+
+    def __init__(self, params, grads, lr, n):
+        self.n = n
+        self.lr = lr
+        self.g = {k: np.asarray(v, np.float64) for k, v in grads.items()}
+        self.mean = {k: np.asarray(v, np.float64).mean(axis=0)
+                     for k, v in params.items()}
+        self.mass = {k: np.zeros_like(v) for k, v in self.mean.items()}
+
+    def fire(self, fired):
+        for k in self.mass:
+            self.mass[k] += self.lr * self.g[k][fired].sum(axis=0)
+
+    def error(self, opt):
+        got = AT.conserved_debiased_mean(opt.window_name)
+        err = 0.0
+        for k in self.mean:
+            ref = self.mean[k] - self.mass[k] / self.n
+            err = max(err, float(
+                np.abs(np.asarray(got[k], np.float64) - ref).max()
+                / max(1.0, np.abs(ref).max())))
+        return err
+
+
+# ---------------------------------------------------------------------------
+# cadence scheduler + knob resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_periods_arg_env_default(monkeypatch):
+    assert AT.resolve_periods(4).tolist() == [1, 1, 1, 1]
+    assert AT.resolve_periods(4, [1, 2, 3, 4]).tolist() == [1, 2, 3, 4]
+    monkeypatch.setenv("BLUEFOG_ASYNC_PERIODS", "2")
+    assert AT.resolve_periods(4).tolist() == [2, 2, 2, 2]
+    monkeypatch.setenv("BLUEFOG_ASYNC_PERIODS", "1,2,3,4")
+    assert AT.resolve_periods(4).tolist() == [1, 2, 3, 4]
+    # the explicit argument wins over the env
+    assert AT.resolve_periods(4, [3, 3, 3, 3]).tolist() == [3, 3, 3, 3]
+    monkeypatch.setenv("BLUEFOG_ASYNC_PERIODS", "1,2")
+    with pytest.raises(ValueError):
+        AT.resolve_periods(4)
+    with pytest.raises(ValueError):
+        AT.resolve_periods(4, [1, 0, 1, 1])
+
+
+def test_resolve_max_staleness_env(monkeypatch):
+    assert AT.resolve_max_staleness() == 8
+    assert AT.resolve_max_staleness(3) == 3
+    monkeypatch.setenv("BLUEFOG_ASYNC_MAX_STALENESS", "5")
+    assert AT.resolve_max_staleness() == 5
+
+
+def test_scheduler_cadence_refusal_and_state_roundtrip():
+    sched = AT.CadenceScheduler(4, periods=[1, 2, 3, 1])
+    # rank i fires at tick t iff t % k_i == k_i - 1
+    assert sched.active(0).tolist() == [True, False, False, True]
+    assert sched.active(1).tolist() == [True, True, False, True]
+    assert sched.active(2).tolist() == [True, False, True, True]
+    assert sched.staleness_bound() == 3
+    # a period past the bounded-staleness cap is refused: clamped + counted
+    cap = sched.max_staleness
+    assert sched.set_period(1, cap + 7) == cap
+    assert sched.refusals == 1
+    assert sched.set_period(1, 2) == 2
+    # round-trip through the checkpoint meta section
+    meta = CK.async_cadence_state(sched)
+    back = CK.restore_async_cadence(meta)
+    assert back.periods.tolist() == sched.periods.tolist()
+    assert back.refusals == sched.refusals
+    assert back.max_staleness == sched.max_staleness
+
+
+# ---------------------------------------------------------------------------
+# push-sum: sync equivalence + the conservation invariant
+# ---------------------------------------------------------------------------
+
+def test_period_one_push_sum_matches_sync_bit_exact(bf_ctx):
+    n = bf.size()
+    params, grads = _params(n), _grads(_params(n))
+    sync = bf.DistributedPushSumOptimizer(optax.sgd(0.05),
+                                          window_prefix="ps_sync")
+    st_s = sync.init(params)
+    a = AT.push_sum_step(optax.sgd(0.05), window_prefix="ps_async")
+    st_a = a.init(params)
+    ps, pa = params, params
+    for t in range(5):
+        ps, st_s = sync.step(ps, grads, st_s, step=t)
+        pa, st_a = a.step(pa, grads, st_a, step=t)
+        _assert_trees_equal(
+            ps, pa, f"period-1 async diverged from sync at step {t}")
+
+
+def test_heterogeneous_cadence_conserves_debiased_mean(bf_ctx):
+    n, lr = bf.size(), 0.02
+    params, grads = _params(n), _grads(_params(n))
+    per = _periods(n)
+    opt = AT.push_sum_step(optax.sgd(lr), periods=per)
+    state = opt.init(params)
+    ref = _ConservationRef(params, grads, lr, n)
+    p, first = params, _spread(params)
+    for t in range(16):
+        fired = (np.asarray(t) % opt.periods) == opt.periods - 1
+        p, state = opt.step(p, grads, state, step=t)
+        ref.fire(fired)
+        err = ref.error(opt)
+        assert err < 5e-5, (
+            f"conserved de-biased mean off by {err:.2e} at tick {t} "
+            f"(periods {per})")
+    pvec = np.asarray(bf.win_associated_p(opt.window_name))
+    assert (pvec > 0).all()
+    assert _spread(p) < first          # gossip still contracts consensus
+
+
+def test_conservation_holds_through_death(bf_ctx):
+    n, lr = bf.size(), 0.02
+    if n < 4:
+        pytest.skip("death leg needs >= 4 ranks")
+    params, grads = _params(n), _grads(_params(n))
+    opt = AT.push_sum_step(optax.sgd(lr), periods=_periods(n))
+    state = opt.init(params)
+    ref = _ConservationRef(params, grads, lr, n)
+    dead = n - 3
+    p, alive = params, np.ones(n)
+    for t in range(12):
+        if t == 6:
+            alive = np.ones(n)
+            alive[dead] = 0.0       # dead mass freezes — never destroyed
+        fired = ((np.asarray(t) % opt.periods) == opt.periods - 1) \
+            & (alive > 0)
+        p, state = opt.step(p, grads, state, step=t, alive=alive)
+        ref.fire(fired)
+        err = ref.error(opt)
+        assert err < 5e-5, (
+            f"death broke conservation at tick {t}: {err:.2e}")
+    pvec = np.asarray(bf.win_associated_p(opt.window_name))
+    assert (pvec > 0).all(), f"P went non-positive under death: {pvec}"
+    assert np.isfinite(np.asarray(p["w"])).all()
+
+
+def test_bootstrap_join_pulls_to_average_no_phantom_mass(bf_ctx):
+    n, lr = bf.size(), 0.03
+    if n < 4:
+        pytest.skip("join leg needs >= 4 ranks")
+    params, grads = _params(n), _grads(_params(n))
+    opt = AT.push_sum_step(optax.sgd(lr), periods=_periods(n))
+    state = opt.init(params)
+    dead = n - 3
+    p, alive = params, np.ones(n)
+    for t in range(8):
+        if t == 4:
+            alive = np.ones(n)
+            alive[dead] = 0.0
+        p, state = opt.step(p, grads, state, step=t, alive=alive)
+    live = np.flatnonzero(alive)
+    before = float(np.abs(np.asarray(p["w"])[dead]
+                          - np.asarray(p["w"])[live].mean(axis=0)).max())
+    opt.scheduler.set_period(dead, 3)   # stale throttle to undo on join
+    alive = np.ones(n)
+    boot = opt.bootstrap_rank(dead, alive=alive)
+    after = float(np.abs(np.asarray(boot["w"])[dead]
+                         - np.asarray(boot["w"])[live].mean(axis=0)).max())
+    assert after < before, (
+        f"bootstrap left the joiner stale: {before} -> {after}")
+    assert opt.scheduler.periods[dead] == opt.scheduler.base_period
+    # phantom-mass guard: with zero grads the conserved de-biased mean
+    # must be CONSTANT tick to tick from the post-join baseline — if the
+    # bootstrap fold had left the pulled buffer slots unconsumed
+    # (reset=False), the next SUM collect would double-count them
+    zero = jax.tree.map(jnp.zeros_like, grads)
+    base = AT.conserved_debiased_mean(opt.window_name)
+    p2 = boot
+    for t in range(8, 12):
+        p2, state = opt.step(p2, zero, state, step=t, alive=alive)
+        got = AT.conserved_debiased_mean(opt.window_name)
+        for k in base:
+            drift = float(np.abs(np.asarray(got[k], np.float64)
+                                 - np.asarray(base[k], np.float64)).max())
+            assert drift < 1e-5, (
+                f"phantom mass after the join: conserved mean drifted "
+                f"{drift:.2e} at tick {t}")
+
+
+def test_zero_recompiles_across_cadence_death_join(bf_ctx):
+    n = bf.size()
+    if n < 4:
+        pytest.skip("episode needs >= 4 ranks")
+    MET.enable()
+    params, grads = _params(n), _grads(_params(n))
+    opt = AT.push_sum_step(optax.sgd(0.02), periods=_periods(n))
+    state = opt.init(params)
+    builds = MET.registry.counter("bf_step_cache_total")
+    p = params
+    p, state = opt.step(p, grads, state, step=0)          # warmup
+    b0 = builds.value(result="build")
+    opt.scheduler.set_period(n - 1, 2)                    # cadence change
+    p, state = opt.step(p, grads, state, step=1)
+    alive = np.ones(n)
+    alive[n - 3] = 0.0                                    # fault flip
+    p, state = opt.step(p, grads, state, step=2, alive=alive)
+    alive = np.ones(n)
+    opt.bootstrap_rank(n - 3, alive=alive)                # one join
+    p, state = opt.step(p, grads, state, step=3, alive=alive)
+    grew = builds.value(result="build") - b0
+    assert grew == 0, (
+        f"cadence change / death / join recompiled the step: {grew} "
+        f"extra builds after warmup")
+
+
+# ---------------------------------------------------------------------------
+# win-put flavor
+# ---------------------------------------------------------------------------
+
+def test_winput_async_contracts_and_survives_death(bf_ctx):
+    n = bf.size()
+    if n < 4:
+        pytest.skip("death leg needs >= 4 ranks")
+    params = _params(n)
+    zero = jax.tree.map(jnp.zeros_like, params)
+    opt = AT.win_put_step(optax.sgd(0.0),
+                          periods=[1 + (i % 2) for i in range(n)])
+    state = opt.init(params)
+    p, first = params, _spread(params)
+    for t in range(6):
+        p, state = opt.step(p, zero, state, step=t)
+    mid = _spread(p)
+    assert mid < first, f"win-put async did not contract: {first}->{mid}"
+    # dead neighbor: its put rows stop, fold mass degrades to the self
+    # weight via the shared win_update(alive=) contract — params stay
+    # finite and live ranks keep contracting
+    alive = np.ones(n)
+    alive[1] = 0.0
+    for t in range(6, 12):
+        p, state = opt.step(p, zero, state, step=t, alive=alive)
+    live = np.flatnonzero(alive)
+    w = np.asarray(p["w"], np.float64)[live]
+    assert np.isfinite(w).all()
+    assert float(np.abs(w - w.mean(axis=0)).max()) < mid
+
+
+def test_winput_int8_compression_composes(bf_ctx):
+    n = bf.size()
+    params = _params(n)
+    zero = jax.tree.map(jnp.zeros_like, params)
+    opt = AT.win_put_step(optax.sgd(0.0), compression="int8",
+                          periods=[1 + (i % 2) for i in range(n)])
+    state = opt.init(params)
+    p, first = params, _spread(params)
+    for t in range(8):
+        p, state = opt.step(p, zero, state, step=t)
+    assert np.isfinite(np.asarray(p["w"])).all()
+    assert _spread(p) < first
+
+
+def test_push_sum_int8_compression_composes(bf_ctx):
+    n = bf.size()
+    params = _params(n)
+    zero = jax.tree.map(jnp.zeros_like, params)
+    opt = AT.push_sum_step(optax.sgd(0.0), compression="int8",
+                          periods=_periods(n))
+    state = opt.init(params)
+    p, first = params, _spread(params)
+    for t in range(10):
+        p, state = opt.step(p, zero, state, step=t)
+    pvec = np.asarray(bf.win_associated_p(opt.window_name))
+    assert (pvec > 0).all()
+    assert np.isfinite(np.asarray(p["w"])).all()
+    assert _spread(p) < first
+
+
+# ---------------------------------------------------------------------------
+# the health -> cadence loop (the straggler-throttle satellite)
+# ---------------------------------------------------------------------------
+
+def test_straggler_loop_throttles_exact_rank(bf_ctx, tmp_path):
+    n, lr = bf.size(), 0.02
+    if n < 4:
+        pytest.skip("straggler fleet needs >= 4 ranks")
+    seeded = 2
+    slow_us, normal_us = 21000, 5000      # 4.2x the fleet median
+
+    def replay(prefix, straggler=None):
+        for r in range(n):
+            EX.metrics_start(prefix, rank=r)
+            for t in range(10):
+                EX.log_step(t, extra={
+                    "step_wall_us": slow_us if r == straggler
+                    else normal_us})
+            EX.metrics_end()
+
+    faulty = str(tmp_path / "strag_")
+    replay(faulty, straggler=seeded)
+    report = H.evaluate(AGG.load_fleet(faulty, expected_ranks=n))
+    verdicts = report.by_rule("straggler")
+    assert [v.rank for v in verdicts] == [seeded], (
+        f"health attributed the straggler wrong: {verdicts}")
+
+    sched = AT.CadenceScheduler(n)
+    changes = sched.observe(report)
+    want = int(np.ceil(verdicts[0].value))       # ceil(4.2) = 5
+    assert changes == {seeded: want}
+    assert sched.periods[seeded] == want
+    assert all(sched.periods[r] == 1 for r in range(n) if r != seeded)
+
+    # closed loop: the throttled fleet still converges unbiased
+    params, grads = _params(n), _grads(_params(n))
+    opt = AT.push_sum_step(optax.sgd(lr), scheduler=sched)
+    state = opt.init(params)
+    ref = _ConservationRef(params, grads, lr, n)
+    p, first = params, _spread(params)
+    fires = np.zeros(n, int)
+    for t in range(want * 2):
+        fired = (np.asarray(t) % opt.periods) == opt.periods - 1
+        fires += fired
+        p, state = opt.step(p, grads, state, step=t)
+        ref.fire(fired)
+        assert ref.error(opt) < 5e-5
+    assert fires[seeded] == 2                     # throttled: 2 of 10
+    assert fires[(seeded + 1) % n] == want * 2    # full cadence
+    assert _spread(p) < first
+
+    # the verdict clears -> the rank returns to the base cadence
+    clean = str(tmp_path / "clean_")
+    replay(clean)
+    report2 = H.evaluate(AGG.load_fleet(clean, expected_ranks=n))
+    assert not report2.by_rule("straggler")
+    assert sched.observe(report2) == {seeded: 1}
+    assert sched.periods[seeded] == 1
+
+
+# ---------------------------------------------------------------------------
+# durable state: bit-exact resume mid-asynchrony
+# ---------------------------------------------------------------------------
+
+def test_fleet_state_resume_bit_exact_mid_asynchrony(bf_ctx):
+    n, lr = bf.size(), 0.03
+    params, grads = _params(n), _grads(_params(n))
+    per = _periods(n)
+    opt = AT.push_sum_step(optax.sgd(lr), window_prefix="resume_async",
+                           periods=per)
+    state = opt.init(params)
+    p = params
+    for t in range(5):
+        p, state = opt.step(p, grads, state, step=t)
+    # snapshot mid-flight: un-collected buffer mass, unequal P, periods
+    snap = CK.fleet_state_dict(5, {"params": p, "opt_state": state},
+                               cadence=opt.scheduler)
+    assert "async_cadence" in snap["meta"]["sections"]
+    assert "windows" in snap["arrays"]            # auto-captured (P rides)
+    for t in range(5, 10):
+        p, state = opt.step(p, grads, state, step=t)
+    final = jax.tree.map(np.asarray, p)
+    opt.free()
+
+    sched2 = CK.restore_async_cadence(snap["meta"]["async_cadence"])
+    assert sched2.periods.tolist() == per
+    opt2 = AT.push_sum_step(optax.sgd(lr), window_prefix="resume_async",
+                            scheduler=sched2)
+    st_tpl = opt2.init(params)
+    fr = CK.load_fleet_state(
+        snap, train_template={"params": params, "opt_state": st_tpl})
+    p2, state2 = fr.train["params"], fr.train["opt_state"]
+    for t in range(fr.step, 10):
+        p2, state2 = opt2.step(p2, grads, state2, step=t)
+    _assert_trees_equal(final, p2,
+                        "resume from the mid-asynchrony snapshot drifted")
+
+
+# ---------------------------------------------------------------------------
+# convergence: 3-cadence fleet lands in the synchronous ballpark
+# ---------------------------------------------------------------------------
+
+def test_mlp_convergence_matches_sync_ballpark(bf_ctx):
+    n = bf.size()
+    rng = np.random.default_rng(5)
+    d, hid = 6, 8
+    wt = rng.normal(size=(d, 1))
+    x = jnp.asarray(rng.normal(size=(n, 16, d)), jnp.float32)
+    y = jnp.asarray(x @ wt + 0.05 * rng.normal(size=(n, 16, 1)),
+                    jnp.float32)
+
+    def one(seed):
+        r = np.random.default_rng(seed)
+        leaf = {"w1": r.normal(size=(d, hid)) * 0.4,
+                "b1": np.zeros(hid),
+                "w2": r.normal(size=(hid, 1)) * 0.4,
+                "b2": np.zeros(1)}
+        return {k: jnp.asarray(np.broadcast_to(v, (n,) + v.shape),
+                               jnp.float32) for k, v in leaf.items()}
+
+    def loss_fn(pp, xb, yb):
+        h = jnp.tanh(xb @ pp["w1"] + pp["b1"])
+        return jnp.mean((h @ pp["w2"] + pp["b2"] - yb) ** 2)
+
+    grad_fn = jax.jit(jax.vmap(jax.value_and_grad(loss_fn)))
+
+    def run(periods, steps=30):
+        opt = AT.push_sum_step(optax.sgd(0.1), periods=periods)
+        p = one(7)
+        state = opt.init(p)
+        losses = []
+        for t in range(steps):
+            losses_t, g = grad_fn(p, x, y)
+            p, state = opt.step(p, g, state, step=t)
+            losses.append(float(np.asarray(losses_t).mean()))
+        opt.free()
+        return losses
+
+    sync = run([1] * n)
+    cadenced = run([(1, 2, 3)[i % 3] for i in range(n)])
+    assert sync[-1] < 0.5 * sync[0]
+    assert cadenced[-1] < 0.5 * cadenced[0], (
+        f"3-cadence fleet did not train: {cadenced[0]} -> {cadenced[-1]}")
+    assert cadenced[-1] < max(2.0 * sync[-1], sync[-1] + 0.05), (
+        f"3-cadence loss {cadenced[-1]} far from the sync ballpark "
+        f"{sync[-1]}")
+
+
+# ---------------------------------------------------------------------------
+# observability: trail schema + the bfmonitor block
+# ---------------------------------------------------------------------------
+
+def test_async_trail_schema_and_monitor_block(bf_ctx, tmp_path):
+    n = bf.size()
+    prefix = str(tmp_path / "at_")
+    trail = EX.AsyncTrail(prefix + EX.ASYNC_SUFFIX, size=n,
+                          periods=_periods(n),
+                          max_staleness=AT.resolve_max_staleness())
+    params, grads = _params(n), _grads(_params(n))
+    opt = AT.push_sum_step(optax.sgd(0.02), periods=_periods(n),
+                           trail=trail)
+    state = opt.init(params)
+    p = params
+    for t in range(6):
+        p, state = opt.step(p, grads, state, step=t)
+    trail.close()
+    records = EX.validate_jsonl(prefix + EX.ASYNC_SUFFIX)
+    assert len(records) == 7                      # config head + 6 ticks
+    config, ticks = EX.read_async_trail(prefix + EX.ASYNC_SUFFIX)
+    assert config["size"] == n
+    assert config["max_staleness"] == AT.resolve_max_staleness()
+    ticks = [r for r in ticks if r.get("kind") == "async"]
+    assert len(ticks) == 6
+    assert all("active" in r and "staleness_max" in r for r in ticks)
+    # push-sum ticks carry the P spread evidence
+    assert all("p_min" in r and "p_max" in r for r in ticks)
+
+    from bluefog_tpu.run.monitor import build_report, render_async
+    _, _, out = build_report(prefix)
+    block = out["async"]
+    assert block["size"] == n and block["ticks"] == 6
+    assert block["periods"] == _periods(n)
+    assert block["step"] == 5
+    panel = render_async(block)
+    assert "periods" in panel and "staleness" in panel
+
+
+def test_async_trail_schema_rejects_malformed(tmp_path):
+    path = str(tmp_path / "bad_async.jsonl")
+    with open(path, "w") as f:
+        f.write('{"kind": "async_config", "t_us": 0, "size": 4, '
+                '"periods": [1], "max_staleness": 8}\n')
+        f.write('{"kind": "async", "t_us": 1, "step": 0, '
+                '"staleness_max": 0.0}\n')     # missing "active"
+    with pytest.raises(ValueError, match="active"):
+        EX.validate_jsonl(path)
