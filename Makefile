@@ -143,9 +143,15 @@ bench-hybrid:
 # keeps the exact permute budget (buckets x offsets x 2 wire arrays) and
 # moves the SAME wire bytes as the chain; and the knob-off lowering is
 # byte-identical across env spellings (the off path is the frozen chain).
+# PR 17 adds the CHOCO leg (same invariants for the difference-gossip
+# flavor — estimates fold in-register, wire stays the inner int8
+# payload) and the hybrid (dp, fsdp) leg (one pallas_call per SHARD-plan
+# bucket, emulate moving exactly the hybrid chain's 1/fsdp wire bytes).
 bench-kernel:
 	python bench.py --trace-only | python -c "import json,sys; \
 	d=json.load(sys.stdin); k=d['kernel']; p=k['pallas']; e=k['emulate']; \
+	c=k['choco']; cp=c['pallas']; ce=c['emulate']; \
+	h=k.get('hybrid'); \
 	print(json.dumps(d)); \
 	assert 'skipped' not in p, 'kernel lowering skipped: %s' % p.get('skipped'); \
 	print('kernel: %d pallas_call(s) for %d bucket(s) | %d ppermutes | ' \
@@ -162,7 +168,36 @@ bench-kernel:
 	assert e['ppermute'] == e['expected_ppermute'], 'emulate permute budget'; \
 	assert e['ppermute_bytes_per_step'] == e['chain_ppermute_bytes_per_step'], \
 	       'emulate wire bytes drifted from the chain'; \
-	assert k['off']['identical_to_env_off'], 'knob-off lowering not inert'"
+	assert k['off']['identical_to_env_off'], 'knob-off lowering not inert'; \
+	assert 'skipped' not in cp, 'choco kernel lowering skipped: %s' % cp.get('skipped'); \
+	print('choco:  %d pallas_call(s) for %d bucket(s) | %d ppermutes | ' \
+	      '%d wire upcasts | emulate %d/%d ppermutes, %d wire bytes (chain %d)' \
+	      % (cp['pallas_calls'], cp['buckets'], cp['ppermute'], \
+	         cp['wire_upcasts'], ce['ppermute'], ce['expected_ppermute'], \
+	         ce['ppermute_bytes_per_step'], \
+	         ce['chain_ppermute_bytes_per_step'])); \
+	assert cp['pallas_calls'] == cp['buckets'] and cp['ppermute'] == 0, \
+	       'choco hot path is not one pallas_call per bucket'; \
+	assert cp['wire_upcasts'] == 0, 'choco: widening convert feeds the wire'; \
+	assert ce['ppermute'] == ce['expected_ppermute'], 'choco emulate permute budget'; \
+	assert ce['ppermute_bytes_per_step'] == ce['chain_ppermute_bytes_per_step'], \
+	       'choco emulate wire bytes drifted from the chain'; \
+	assert h is not None, 'hybrid kernel leg missing (mesh too small?)'; \
+	hp=h['pallas']; he=h['emulate']; \
+	assert 'skipped' not in hp, 'hybrid kernel lowering skipped: %s' % hp.get('skipped'); \
+	print('hybrid: %d pallas_call(s) for %d shard bucket(s) | %d ppermutes ' \
+	      '| %d wire upcasts | emulate %d ppermutes (chain %d), %d wire ' \
+	      'bytes (chain %d)' \
+	      % (hp['pallas_calls'], hp['buckets'], hp['ppermute'], \
+	         hp['wire_upcasts'], he['ppermute'], he['chain_ppermute'], \
+	         he['ppermute_bytes_per_step'], \
+	         he['chain_ppermute_bytes_per_step'])); \
+	assert hp['pallas_calls'] == hp['buckets'] and hp['ppermute'] == 0, \
+	       'hybrid hot path is not one pallas_call per shard bucket'; \
+	assert hp['wire_upcasts'] == 0, 'hybrid: widening convert feeds the wire'; \
+	assert he['ppermute'] == he['chain_ppermute'], 'hybrid emulate permute budget'; \
+	assert he['ppermute_bytes_per_step'] == he['chain_ppermute_bytes_per_step'], \
+	       'hybrid emulate wire bytes drifted from the 1/fsdp chain'"
 
 # Hardened hardware bench path (docs/performance.md "Re-earning the
 # hardware number"): BENCH_r02-r05 all died in backend init with nothing

@@ -622,6 +622,101 @@ def trace_only_main():
             compress_report["int8"]["ppermute_bytes_per_step"],
     }
 
+    # CHOCO-under-kernel leg (PR 17): the difference-gossip flavor holds
+    # the same three invariants — the replica estimates fold in-register
+    # (one pallas_call per bucket, zero permutes, no wire upcasts), the
+    # emulate transport keeps the chain's exact permute budget and wire
+    # bytes (the wire is the inner int8 delta payload, 1/4 the f32
+    # bytes), and the knob-off choco chain is untouched.
+    choco_spec = "choco:int8:gamma=0.5"
+    cvars, ccstate = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
+        compression=choco_spec)
+    ccargs = (cvars, ccstate, (x, y), jnp.int32(0))
+
+    def _choco_step(gossip_kernel, donate=False):
+        return T.make_train_step(
+            model, base, communication="neighbor_allreduce", fuse=True,
+            compression=choco_spec, gossip_kernel=gossip_kernel,
+            donate=donate)
+
+    chain_c = TM.collective_counts(_choco_step(False), *ccargs)
+    choco_report = {"chain_ppermute": chain_c["ppermute"],
+                    "chain_ppermute_bytes_per_step":
+                        chain_c["ppermute_bytes"]}
+    try:
+        ctext = TH.export_kernel_step_text(
+            _choco_step("pallas", donate=True), *ccargs)
+        choco_report["pallas"] = {
+            "pallas_calls": TH.count_pallas_calls_in_text(ctext),
+            "buckets": plan.n_buckets,
+            "ppermute": TM.count_collectives_in_text(ctext)["ppermute"],
+            "wire_upcasts": len(TH.find_wire_upcasts(ctext, "kernel",
+                                                     kernel=True)),
+        }
+    except Exception as e:  # noqa: BLE001 — banked, gated non-zero below
+        choco_report["pallas"] = {"skipped": f"{type(e).__name__}: {e}"}
+    em_c = TM.collective_counts(_choco_step("emulate"), *ccargs)
+    choco_report["emulate"] = {
+        "ppermute": em_c["ppermute"],
+        "expected_ppermute": plan.n_buckets * offsets * 2,
+        "ppermute_bytes_per_step": em_c["ppermute_bytes"],
+        "chain_ppermute_bytes_per_step": chain_c["ppermute_bytes"],
+    }
+    kernel_report["choco"] = choco_report
+
+    # Hybrid-kernel leg (PR 17): the (dp, fsdp) mixers reach the SAME
+    # bucket-kernel entry — per-cell buckets, RDMAs addressed by mesh
+    # coordinates.  Gate: one pallas_call per SHARD-plan bucket with zero
+    # permutes on the TPU-export lowering, and the emulate transport
+    # moving exactly the hybrid chain's 1/fsdp wire bytes.
+    if hybrid_report:
+        from bluefog_tpu.ops import fusion as _fusion
+        from bluefog_tpu.parallel.fsdp import fsdp_specs as _fsdp_specs
+
+        hmesh2 = dfsdp_mesh(dp=hdp, fsdp=2)
+        hyb_kernel = {}
+
+        def _hyb_step(gossip_kernel, donate=False):
+            return make_decentralized_fsdp_lm_train_step(
+                hmodel, base, hmesh2, topo=htopo, donate=donate,
+                fuse=True, compression=choco_spec,
+                gossip_kernel=gossip_kernel)
+
+        hstep_c, hplace_c = _hyb_step(False)
+        hp_c, ho_c = hplace_c(hparams)
+        hchain = TM.collective_counts(hstep_c, hp_c, ho_c, hx, hy,
+                                      jnp.int32(0))
+        hplan = _fusion.shard_plan_for(
+            hparams, _fsdp_specs(hparams, hmesh2, axis="fsdp"),
+            {"fsdp": 2})
+        try:
+            hstep_k, hplace_k = _hyb_step("pallas", donate=True)
+            hp_k, ho_k = hplace_k(hparams)
+            htext = TH.export_kernel_step_text(
+                hstep_k, hp_k, ho_k, hx, hy, jnp.int32(0))
+            hyb_kernel["pallas"] = {
+                "pallas_calls": TH.count_pallas_calls_in_text(htext),
+                "buckets": hplan.n_buckets,
+                "ppermute":
+                    TM.count_collectives_in_text(htext)["ppermute"],
+                "wire_upcasts": len(TH.find_wire_upcasts(
+                    htext, "kernel", kernel=True)),
+            }
+        except Exception as e:  # noqa: BLE001 — banked, gated below
+            hyb_kernel["pallas"] = {"skipped": f"{type(e).__name__}: {e}"}
+        hstep_e, hplace_e = _hyb_step("emulate")
+        hp_e, ho_e = hplace_e(hparams)
+        hem = TM.collective_counts(hstep_e, hp_e, ho_e, hx, hy,
+                                   jnp.int32(0))
+        hyb_kernel["emulate"] = {
+            "ppermute": hem["ppermute"],
+            "ppermute_bytes_per_step": hem["ppermute_bytes"],
+            "chain_ppermute": hchain["ppermute"],
+            "chain_ppermute_bytes_per_step": hchain["ppermute_bytes"],
+        }
+        kernel_report["hybrid"] = hyb_kernel
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",

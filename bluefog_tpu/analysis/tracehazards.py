@@ -381,42 +381,81 @@ def run_canonical_trace_checks(depth: int = 8
             "findings": len(fs),
         }
 
-    # the gossip-kernel config (BLUEFOG_GOSSIP_KERNEL=1 + int8): lowered
-    # for TPU via jax.export (Mosaic needs no device at lowering time) —
-    # the per-bucket hot path must be exactly one pallas_call with ZERO
-    # standalone collective_permutes and zero widening wire converts
-    label = "fused_int8_kernel"
-    try:
+    # the gossip-kernel configs: lowered for TPU via jax.export (Mosaic
+    # needs no device at lowering time) — each per-bucket hot path must
+    # be exactly one pallas_call with ZERO standalone collective_permutes
+    # and zero widening wire converts.  Three flavors: direct int8 (PR
+    # 15), CHOCO-under-kernel (the estimates fold in-register), and the
+    # hybrid (dp, fsdp) train step reaching the SAME bucket-kernel entry
+    # with mesh-coordinate RDMA addressing.
+    def kernel_leg(label, lower_fn):
+        try:
+            text, buckets = lower_fn()
+        except Exception as e:      # noqa: BLE001 — an un-lowerable
+            # kernel config must FAIL the lint pass loudly, not print
+            # clean
+            findings.append(Finding(
+                "trace-pass-skipped", "error", f"<trace:{label}>", 0,
+                f"gossip-kernel canonical config failed to lower via "
+                f"jax.export(platforms=['tpu']): {type(e).__name__}: {e}"))
+            report[label] = {"skipped": f"{type(e).__name__}: {e}"}
+            return
+        fs = analyze_trace(text, label, expected_ppermutes=0, kernel=True,
+                           expected_pallas_calls=buckets)
+        findings.extend(fs)
+        report[label] = {
+            "ppermute": TM.count_collectives_in_text(text)["ppermute"],
+            "pallas_calls": count_pallas_calls_in_text(text),
+            "expected_pallas_calls": buckets,
+            "buckets": buckets,
+            "offsets": offsets,
+            "findings": len(fs),
+        }
+
+    def lower_replicated(spec):
         variables, opt_state = T.create_train_state(
             model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)),
-            fuse=True, overlap=False, compression="int8")
+            fuse=True, overlap=False, compression=spec)
         step = T.make_train_step(
             model, base, communication="neighbor_allreduce", fuse=True,
-            overlap=False, telemetry=False, compression="int8",
+            overlap=False, telemetry=False, compression=spec,
             gossip_kernel="pallas", donate=True)
         text = export_kernel_step_text(
             step, variables, opt_state,
             (jnp.zeros((n, 4, 8, 8, 1), jnp.float32),
              jnp.zeros((n, 4), jnp.int32)), jnp.int32(0))
-    except Exception as e:          # noqa: BLE001 — an un-lowerable
-        # kernel config must FAIL the lint pass loudly, not print clean
-        findings.append(Finding(
-            "trace-pass-skipped", "error", f"<trace:{label}>", 0,
-            f"gossip-kernel canonical config failed to lower via "
-            f"jax.export(platforms=['tpu']): {type(e).__name__}: {e}"))
-        report[label] = {"skipped": f"{type(e).__name__}: {e}"}
-        return findings, report
-    per_rank = jax.tree.map(lambda a: a[0], variables["params"])
-    plan = fusion_mod.plan_for(per_rank)
-    fs = analyze_trace(text, label, expected_ppermutes=0, kernel=True,
-                       expected_pallas_calls=plan.n_buckets)
-    findings += fs
-    report[label] = {
-        "ppermute": TM.count_collectives_in_text(text)["ppermute"],
-        "pallas_calls": count_pallas_calls_in_text(text),
-        "expected_pallas_calls": plan.n_buckets,
-        "buckets": plan.n_buckets,
-        "offsets": offsets,
-        "findings": len(fs),
-    }
+        per_rank = jax.tree.map(lambda a: a[0], variables["params"])
+        return text, fusion_mod.plan_for(per_rank).n_buckets
+
+    kernel_leg("fused_int8_kernel", lambda: lower_replicated("int8"))
+    kernel_leg("fused_choco_kernel",
+               lambda: lower_replicated("choco:int8:gamma=0.5"))
+
+    def lower_hybrid():
+        from ..parallel import topology as topo_mod
+        from ..parallel.fsdp import (dfsdp_mesh, fsdp_specs,
+                                     make_decentralized_fsdp_lm_train_step)
+        from ..parallel.schedule import compile_topology
+        if n < 4 or n % 2:
+            raise RuntimeError(
+                f"hybrid (dp, fsdp) canonical config needs an even mesh "
+                f"of >= 4 devices, have {n}")
+        dp, fs_ = n // 2, 2
+        mesh = dfsdp_mesh(dp, fs_)
+        step, place = make_decentralized_fsdp_lm_train_step(
+            model, base, mesh,
+            topo=compile_topology(topo_mod.ExponentialGraph(dp)),
+            donate=True, fuse=True, compression="choco:int8:gamma=0.5",
+            gossip_kernel="pallas")
+        single = model.init(jax.random.key(0),
+                            jnp.zeros((1, 8, 8, 1)))["params"]
+        gp, go = place(single)
+        text = export_kernel_step_text(
+            step, gp, go, jnp.zeros((dp, 4, 8, 8, 1), jnp.float32),
+            jnp.zeros((dp, 4), jnp.int32), jnp.int32(0))
+        plan = fusion_mod.shard_plan_for(
+            single, fsdp_specs(single, mesh, axis="fsdp"), {"fsdp": fs_})
+        return text, plan.n_buckets
+
+    kernel_leg("hybrid_choco_kernel", lower_hybrid)
     return findings, report

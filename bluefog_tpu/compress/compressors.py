@@ -194,9 +194,12 @@ KERNEL_CODECS = ("int8", "fp8")
 def kernel_codec(cfg: Optional["CompressionConfig"]) -> Optional[str]:
     """The fused-gossip-kernel codec a config maps to, or ``None`` when
     the config is outside the kernel's wire format (sparsifiers ship
-    ragged values+indices; choco is a different exchange discipline;
-    identity has no codec win to fuse)."""
-    if cfg is None or cfg.choco:
+    ragged values+indices; identity has no codec win to fuse).  The
+    mapping looks THROUGH the choco wrapper: ``choco:int8`` wires the
+    same int8 payload as ``int8`` — only the in-register math around it
+    differs (``ops/pallas_kernels._choco_gossip_kernel``) — while
+    ``choco:topk`` stays ``None`` like plain ``topk``."""
+    if cfg is None:
         return None
     return cfg.name if cfg.name in KERNEL_CODECS else None
 

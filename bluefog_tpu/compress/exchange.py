@@ -171,9 +171,17 @@ def effective_gossip_kernel(value, cfg: Optional[CP.CompressionConfig], *,
       codec;
     * an EXPLICIT ``gossip_kernel=`` argument in those combos raises (a
       named request that cannot be honored must not silently no-op);
-    * a sparsifier / choco / unfused build under the knob raises either
-      way — these are misconfigurations worth surfacing, not composition
-      gaps to paper over (docs/performance.md lists the rejected combos).
+    * a sparsifier / unfused build under the knob raises either way —
+      these are misconfigurations worth surfacing, not composition gaps
+      to paper over (docs/performance.md lists the rejected combos).
+
+    CHOCO difference gossip with a dense-quantizer inner codec
+    (``choco:int8`` / ``choco:fp8``) IS kernel-supported: the replica
+    estimates fold in-register (``ops/pallas_kernels.
+    _choco_gossip_kernel``), so the look-through in
+    :func:`~.compressors.kernel_codec` accepts it and only
+    ``choco:topk``-style sparsifier wrappers fall into the no-codec
+    rejection below.
     """
     kernel = resolve_gossip_kernel(value)
     if kernel is None:
@@ -203,20 +211,14 @@ def effective_gossip_kernel(value, cfg: Optional[CP.CompressionConfig], *,
                 "(BLUEFOG_NEIGHBOR_ALLREDUCE_BACKEND=pallas) instead")
         # the env knob still buys the issue-order half of the win
         return None, True
-    if cfg.choco:
-        raise ValueError(
-            "CHOCO-under-kernel is deferred: the difference-gossip "
-            "recurrence carries replica estimates (x_hat, s_hat) the "
-            "kernel does not yet fold in-register — use a direct dense "
-            "spec ('int8'/'fp8') with BLUEFOG_GOSSIP_KERNEL, or drop the "
-            "knob for choco (docs/performance.md \"Single-kernel "
-            "gossip\", composition table)")
     if CP.kernel_codec(cfg) is None:
         raise ValueError(
             f"the gossip kernel's wire format is dense quantization: "
             f"spec {cfg.spec!r} has no kernel codec (sparsifiers ship "
-            f"ragged values+indices; identity has no codec work to fuse) "
-            f"— use 'int8' or 'fp8', or drop BLUEFOG_GOSSIP_KERNEL")
+            f"ragged values+indices — also under a choco: wrapper; "
+            f"identity has no codec work to fuse) — use 'int8'/'fp8' "
+            f"(or 'choco:int8'/'choco:fp8'), or drop "
+            f"BLUEFOG_GOSSIP_KERNEL")
     return kernel, True
 
 
@@ -393,40 +395,109 @@ def _emulated_bucket_gossip(buf, residual, codec: str, rkey,
     return out, t_val - d_own
 
 
+def _emulated_bucket_choco_gossip(buf, xhat, shat, gamma, codec: str,
+                                  rkey, axis_name, topo, sched, step,
+                                  idx):
+    """The ``"emulate"`` transport's CHOCO flavor: the
+    ``_choco_gossip_kernel`` body as plain jnp with ``lax.ppermute``
+    standing in for the RDMA.  Like :func:`_emulated_bucket_gossip`,
+    the expressions mirror the chain's choco bucket body OP FOR OP —
+    same compress/decompress calls on the same values, same thunked
+    scale slice, same gamma multiply position — because the parity
+    contract covers params AND both replica estimates at the bit level,
+    and XLA's FMA formation keys on the local op patterns.  ``gamma``
+    arrives precomputed in ``buf.dtype`` (cfg.gamma × the controller's
+    ``gamma_scale`` leaf) exactly as the kernel transports take it."""
+    delta = buf - xhat
+    f = delta.astype(jnp.float32).reshape(-1)
+    if codec == "int8":
+        q, scale = CP.int8_encode(
+            f, lambda: jax.random.uniform(rkey, f.shape))
+        decode = CP.int8_decode
+    else:
+        q, scale = CP.fp8_encode(f)
+        decode = CP.fp8_decode
+    wire = {"q": q, "scale": scale.reshape(1)}
+    d_own = decode(wire["q"],
+                   lambda: wire["scale"][0]).astype(buf.dtype).reshape(
+                       buf.shape)
+    self_w, terms = _neighbor_terms(axis_name, topo, sched, step,
+                                    buf.dtype, idx)
+    acc = self_w * d_own
+    for pairs, w in terms:
+        arrived = jax.tree.map(
+            lambda a, pairs=pairs: lax.ppermute(a, axis_name, pairs), wire)
+        dec = decode(arrived["q"],
+                     lambda arrived=arrived: arrived["scale"][0])
+        acc = acc + w * dec.astype(buf.dtype).reshape(buf.shape)
+    xhat_new = xhat + d_own
+    shat_new = shat + acc
+    return buf + gamma * (shat_new - xhat_new), xhat_new, shat_new
+
+
+def _choco_gamma(state, cfg, dtype):
+    """The traced consensus stepsize in the bucket dtype: ``cfg.gamma``
+    times the controller's ``gamma_scale`` leaf when present — the
+    chain's exact construction (same casts, same multiply position), so
+    γ backoff/re-arm actuates identically on every transport."""
+    gamma = jnp.asarray(cfg.gamma, dtype)
+    scale = state.get("gamma_scale")
+    if scale is not None:
+        gamma = gamma * jnp.asarray(scale, dtype)
+    return gamma
+
+
 def _kernel_mix(plan, tree, bufs, state, cfg: CP.CompressionConfig,
                 kernel: str, axis_name, topo, sched, step,
-                wire_bytes: int, raw_bytes: int):
+                wire_bytes: int, raw_bytes: int, mesh_axes=None):
     """The single-kernel gossip execution of one compressed exchange:
-    one :func:`~..ops.pallas_kernels.fused_compressed_gossip` call per
-    fusion bucket (codec + RDMA + mix + EF residual fused), issued in
-    :func:`~..ops.fusion.interleave_order` (small buckets first, so
-    their short exchanges hide under the large buckets' work).  Reached
-    only for validated builds (``effective_gossip_kernel``): direct
-    dense-quantizer specs over fused neighbor gossip.  Bit-exact vs the
-    chain below — the kernel runs the same codec bodies on the same
-    values in the same order (asserted across schedules and dtypes in
-    tests/test_gossip_kernel.py)."""
+    one fused kernel call per fusion bucket (codec + RDMA + mix + the
+    carried state update — EF residual for direct specs,
+    :func:`~..ops.pallas_kernels.fused_compressed_gossip`; replica
+    estimates for choco, :func:`~..ops.pallas_kernels.
+    fused_choco_gossip`), issued in :func:`~..ops.fusion.
+    interleave_order` (small buckets first, so their short exchanges
+    hide under the large buckets' work).  Reached only for validated
+    builds (``effective_gossip_kernel``): dense-quantizer wire formats
+    over fused neighbor gossip.  Bit-exact vs the chain below — the
+    kernel runs the same codec bodies on the same values in the same
+    order (asserted across schedules, dtypes, and both disciplines in
+    tests/test_gossip_kernel.py).  ``mesh_axes``: the hybrid sharded
+    path's full mesh axis tuple for RDMA device ids (``None`` on 1-D
+    gossip meshes)."""
     from ..ops import pallas_kernels as PK
-    if plan is None or state is None or "residual" not in state:
+    choco = cfg.choco
+    needed = ("xhat", "shat") if choco else ("residual",)
+    if plan is None or state is None or any(k not in state
+                                           for k in needed):
         raise ValueError(
-            "kernel gossip needs fused buckets and a carried EF residual "
-            "(stateful dense quantizer) — builder validation should have "
-            "rejected this configuration")
+            "kernel gossip needs fused buckets and the discipline's "
+            "carried state (EF residual for direct quantizers, "
+            "xhat/shat replica estimates for choco) — builder "
+            "validation should have rejected this configuration")
     idx = lax.axis_index(axis_name)
     size = sched.size if sched is not None else topo.size
     offsets = (tuple(sched.offsets) if sched is not None
                else tuple(topo.offsets))
     mixed: List[Any] = [None] * len(bufs)
-    res_out: List[Any] = [None] * len(bufs)
+    state_a: List[Any] = [None] * len(bufs)   # residual | xhat
+    state_b: List[Any] = [None] * len(bufs)   # (choco) shat
     tables: Dict[Any, Any] = {}
     for b in F.interleave_order(plan):
         buf = bufs[b]
         skey = _shared_key(step, b)
         rkey = jax.random.fold_in(skey, idx)
         if kernel == "emulate":
-            mixed[b], res_out[b] = _emulated_bucket_gossip(
-                buf, state["residual"][b], cfg.name, rkey,
-                axis_name, topo, sched, step, idx)
+            if choco:
+                mixed[b], state_a[b], state_b[b] = (
+                    _emulated_bucket_choco_gossip(
+                        buf, state["xhat"][b], state["shat"][b],
+                        _choco_gamma(state, cfg, buf.dtype), cfg.name,
+                        rkey, axis_name, topo, sched, step, idx))
+            else:
+                mixed[b], state_a[b] = _emulated_bucket_gossip(
+                    buf, state["residual"][b], cfg.name, rkey,
+                    axis_name, topo, sched, step, idx)
             continue
         # the chain draws this inside compress(); same key, same shape,
         # same draw — precomputed because the kernel has no threefry
@@ -437,17 +508,30 @@ def _kernel_mix(plan, tree, bufs, state, cfg: CP.CompressionConfig,
             tables[dt] = _weight_tables(axis_name, topo, sched, step,
                                         buf.dtype)
         self_w, recv_w = tables[dt]
-        mixed[b], res_out[b] = PK.fused_compressed_gossip(
-            buf, state["residual"][b], noise, self_w, recv_w,
-            axis_name=axis_name, size=size, offsets=offsets,
-            codec=cfg.name, mode=kernel)
+        if choco:
+            gamma = _choco_gamma(state, cfg, buf.dtype).reshape(1)
+            mixed[b], state_a[b], state_b[b] = PK.fused_choco_gossip(
+                buf, state["xhat"][b], state["shat"][b], noise, gamma,
+                self_w, recv_w, axis_name=axis_name, size=size,
+                offsets=offsets, codec=cfg.name, mode=kernel,
+                mesh_axes=mesh_axes)
+        else:
+            mixed[b], state_a[b] = PK.fused_compressed_gossip(
+                buf, state["residual"][b], noise, self_w, recv_w,
+                axis_name=axis_name, size=size, offsets=offsets,
+                codec=cfg.name, mode=kernel, mesh_axes=mesh_axes)
     # diag accumulates in PLAN order like the chain's bucket loop, so the
-    # telemetry residual norm is bitwise unchanged by the issue order
+    # telemetry residual norm is bitwise unchanged by the issue order;
+    # for choco the chain's "residual" is the estimate lag buf - xhat'
     res_norm2 = jnp.float32(0.0)
-    for r in res_out:
-        r32 = r.astype(jnp.float32)
+    for b, r in enumerate(state_a):
+        err = (bufs[b] - r) if choco else r
+        r32 = err.astype(jnp.float32)
         res_norm2 = res_norm2 + jnp.sum(r32 * r32)
-    new_state = {"residual": tuple(res_out)}
+    if choco:
+        new_state = {"xhat": tuple(state_a), "shat": tuple(state_b)}
+    else:
+        new_state = {"residual": tuple(state_a)}
     if "gamma_scale" in state:
         new_state["gamma_scale"] = state["gamma_scale"]
     diag = {"residual_norm": jnp.sqrt(res_norm2),
@@ -474,7 +558,8 @@ def _note_metrics(cfg, wire_bytes: int, raw_bytes: int) -> None:
 def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
                    mode: str, axis_name, topo=None, sched=None, step=0,
                    fuse: bool = True, bucket_bytes: Optional[int] = None,
-                   leaf_groups=None, kernel: Optional[str] = None):
+                   leaf_groups=None, kernel: Optional[str] = None,
+                   kernel_mesh_axes: Optional[Tuple[str, ...]] = None):
     """One compressed exchange of ``tree`` (per-rank, inside shard_map).
 
     ``mode``: ``"neighbor"`` (weighted gossip over ``topo``/``sched``) or
@@ -489,25 +574,32 @@ def compressed_mix(tree, state, cfg: CP.CompressionConfig, *,
 
     ``kernel`` (a mode from :func:`resolve_gossip_kernel`, validated by
     :func:`effective_gossip_kernel`): run the whole per-bucket hot path
-    — quantize, exchange, decode, mix, EF residual — as ONE fused
-    kernel per bucket (``ops/pallas_kernels.fused_compressed_gossip``)
-    instead of the ~4-op chain below; bit-exact vs the chain.  ``None``
-    (the default) is the chain — byte-identical StableHLO to the
-    pre-kernel lowering, the standing off-path contract."""
+    — quantize, exchange, decode, mix, and the carried state update
+    (EF residual, or choco's x̂/ŝ estimates) — as ONE fused kernel per
+    bucket (``ops/pallas_kernels.fused_compressed_gossip`` /
+    ``fused_choco_gossip``) instead of the ~4-op chain below; bit-exact
+    vs the chain.  ``None`` (the default) is the chain — byte-identical
+    StableHLO to the pre-kernel lowering, the standing off-path
+    contract.  ``kernel_mesh_axes``: the enclosing shard_map's full
+    ordered mesh axis tuple when it spans MORE than the gossip axis
+    (the hybrid ``(dp, fsdp)`` path) — the kernel's RDMA device ids
+    become mesh-coordinate tuples targeting the same cell in the
+    neighbor replica; ``None`` on 1-D gossip meshes."""
     comp = CP.get_compressor(cfg)
     plan, bufs = F.flat_views(tree, fuse=fuse, max_bucket_bytes=bucket_bytes,
                               leaf_groups=leaf_groups)
     wire_bytes, raw_bytes = wire_stats(cfg, bufs)
     _note_metrics(cfg, wire_bytes, raw_bytes)
     if kernel is not None:
-        if mode != "neighbor" or cfg.choco:
+        if mode != "neighbor":
             raise ValueError(
-                "kernel gossip applies to direct neighbor mixing only — "
+                "kernel gossip applies to neighbor mixing only — "
                 "builder validation (effective_gossip_kernel) should "
                 "have rejected this configuration")
         return _kernel_mix(plan, tree, bufs, state, cfg, kernel,
                            axis_name, topo, sched, step,
-                           wire_bytes, raw_bytes)
+                           wire_bytes, raw_bytes,
+                           mesh_axes=kernel_mesh_axes)
     idx = lax.axis_index(axis_name)
     res_norm2 = jnp.float32(0.0)
     mixed: List[jax.Array] = []

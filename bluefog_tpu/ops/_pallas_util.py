@@ -39,7 +39,8 @@ def out_struct(shape, dtype, *operands):
 _COLLECTIVE_FAMILIES = {
     "gossip": 7,              # dense fused exchange (_run_exchange)
     "windows": 8,             # reserved for a future window-op kernel
-    "compressed_gossip": 9,   # single-kernel codec gossip
+    "compressed_gossip": 9,   # single-kernel codec gossip (direct mode)
+    "choco_gossip": 10,       # single-kernel CHOCO difference gossip
 }
 
 

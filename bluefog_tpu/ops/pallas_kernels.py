@@ -35,7 +35,8 @@ from ._pallas_util import collective_id
 __all__ = [
     "fused_neighbor_allreduce", "fused_dynamic_neighbor_allreduce",
     "fused_neighbor_allreduce_flat", "fused_dynamic_neighbor_allreduce_flat",
-    "fused_compressed_gossip", "FLAT_TILE", "GOSSIP_TILE",
+    "fused_compressed_gossip", "fused_choco_gossip",
+    "FLAT_TILE", "GOSSIP_TILE",
 ]
 
 _LANE = 128
@@ -48,11 +49,33 @@ _SUBLANE = 8
 FLAT_TILE = _SUBLANE * _LANE
 
 
-def _struct_vma(shape, dtype, axis_name):
+def _struct_vma(shape, dtype, axes):
+    if isinstance(axes, str):
+        axes = (axes,)
     try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset({axis_name}))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
     except TypeError:  # older JAX without the vma kwarg
         return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _neighbor_device_id(my_id, offset, size, axis_name, mesh_axes):
+    """(device_id, device_id_type) of the gossip neighbor at ``offset``.
+
+    ``mesh_axes=None`` (1-D gossip mesh) keeps the historical scalar
+    LOGICAL id.  On a multi-axis mesh (the hybrid ``(dp, fsdp)`` path)
+    the RDMA must target the SAME cell in the neighbor replica, so the
+    id is the full tuple of mesh coordinates — the gossip axis rotated
+    by ``offset``, every other axis held at this rank's own coordinate —
+    with ``DeviceIdType.MESH`` (Mosaic linearizes the tuple with the
+    mesh strides of ``mesh.axis_names`` order)."""
+    if mesh_axes is None:
+        return (lax.rem(my_id + offset, size),
+                pltpu.DeviceIdType.LOGICAL)
+    coords = tuple(
+        lax.rem(my_id + offset, size) if a == axis_name
+        else lax.axis_index(a)
+        for a in mesh_axes)
+    return coords, pltpu.DeviceIdType.MESH
 
 
 def _pad_rows(x2d, rows_mult: int):
@@ -299,8 +322,47 @@ def _wire_dtype(codec: str):
     return jnp.int8 if codec == "int8" else jnp.float8_e4m3fn
 
 
+def _start_wire_exchange(my_id, size, offsets, axis_name, mesh_axes,
+                         wire_q, wire_s, recv_q, recv_s,
+                         send_sems, recv_sems):
+    """Barrier + launch of the K concurrent wire RDMAs (payload + scale
+    per offset); returns the copy handles to wait on.  Shared by the
+    direct and CHOCO flavors — the transport is identical, only the
+    in-register math around it differs."""
+    K = len(offsets)
+    # neighbor barrier (same recipe as _exchange_kernel): all peers'
+    # recv scratch must exist before any RDMA lands
+    barrier_sem = pltpu.get_barrier_semaphore()
+    for k in range(K):
+        dst, id_type = _neighbor_device_id(my_id, offsets[k], size,
+                                           axis_name, mesh_axes)
+        pltpu.semaphore_signal(barrier_sem, inc=1, device_id=dst,
+                               device_id_type=id_type)
+    pltpu.semaphore_wait(barrier_sem, K)
+
+    # all K offsets' wire payloads in flight together — each rides a
+    # distinct ICI link; the scale scalar rides its own tiny copy
+    copies = []
+    for k in range(K):
+        dst, id_type = _neighbor_device_id(my_id, offsets[k], size,
+                                           axis_name, mesh_axes)
+        c_q = pltpu.make_async_remote_copy(
+            src_ref=wire_q, dst_ref=recv_q.at[k],
+            send_sem=send_sems.at[0, k], recv_sem=recv_sems.at[0, k],
+            device_id=dst, device_id_type=id_type)
+        c_s = pltpu.make_async_remote_copy(
+            src_ref=wire_s, dst_ref=recv_s.at[k],
+            send_sem=send_sems.at[1, k], recv_sem=recv_sems.at[1, k],
+            device_id=dst, device_id_type=id_type)
+        c_q.start()
+        c_s.start()
+        copies.append((c_q, c_s))
+    return copies
+
+
 def _compressed_gossip_kernel(size: int, offsets, axis_name: str,
-                              codec: str, has_noise: bool):
+                              codec: str, has_noise: bool,
+                              mesh_axes=None):
     """Kernel body: encode on store, K concurrent wire RDMAs, decode on
     load, mix + EF residual in-register.
 
@@ -332,33 +394,9 @@ def _compressed_gossip_kernel(size: int, offsets, axis_name: str,
         wire_q[...] = q
         wire_s[...] = jnp.full((1, _LANE), scale, jnp.float32)
 
-        # neighbor barrier (same recipe as _exchange_kernel): all peers'
-        # recv scratch must exist before any RDMA lands
-        barrier_sem = pltpu.get_barrier_semaphore()
-        for k in range(K):
-            dst = lax.rem(my_id + offsets[k], size)
-            pltpu.semaphore_signal(barrier_sem, inc=1, device_id=dst,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_wait(barrier_sem, K)
-
-        # all K offsets' wire payloads in flight together — each rides a
-        # distinct ICI link; the scale scalar rides its own tiny copy
-        copies = []
-        for k in range(K):
-            dst = lax.rem(my_id + offsets[k], size)
-            c_q = pltpu.make_async_remote_copy(
-                src_ref=wire_q, dst_ref=recv_q.at[k],
-                send_sem=send_sems.at[0, k], recv_sem=recv_sems.at[0, k],
-                device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            c_s = pltpu.make_async_remote_copy(
-                src_ref=wire_s, dst_ref=recv_s.at[k],
-                send_sem=send_sems.at[1, k], recv_sem=recv_sems.at[1, k],
-                device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            c_q.start()
-            c_s.start()
-            copies.append((c_q, c_s))
+        copies = _start_wire_exchange(
+            my_id, size, offsets, axis_name, mesh_axes,
+            wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems)
 
         # own reconstruction + EF residual while the wire flies: the
         # residual update t - D(C(t)) never waits on the interconnect
@@ -377,41 +415,164 @@ def _compressed_gossip_kernel(size: int, offsets, axis_name: str,
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _choco_gossip_kernel(size: int, offsets, axis_name: str,
+                         codec: str, has_noise: bool, mesh_axes=None):
+    """CHOCO difference-gossip kernel body: the replica estimates x̂/ŝ
+    fold in-register — encode ``δ = x − x̂`` on store, RDMA the wire
+    encoding, decode neighbors' deltas on load, update the estimates
+    ``x̂' = x̂ + D(C(δ))`` / ``ŝ' = ŝ + Σ_j W[j,i]·D(C(δ_j))`` and apply
+    the mix ``x + γ·(ŝ' − x̂')`` before writeback — the bucket crosses
+    HBM exactly twice, like the direct flavor.
+
+    refs: x [R, 128], xhat [R, 128], shat [R, 128], (noise [R, 128]
+    f32,) gamma [1], self_w [N], recv_w [K, N] -> out [R, 128],
+    xhat_out [R, 128], shat_out [R, 128]; scratch as the direct flavor.
+    ``gamma`` is the traced consensus stepsize (cfg.gamma × the PR 9
+    controller's ``gamma_scale`` leaf), precomputed in ``x.dtype``
+    OUTSIDE the kernel exactly as the chain does, so backoff/re-arm
+    actuates without recompiling the kernel."""
+    K = len(offsets)
+
+    def kernel(*refs):
+        if has_noise:
+            (x_ref, xhat_ref, shat_ref, noise_ref, gamma_ref,
+             self_w_ref, recv_w_ref,
+             out_ref, xhat_out_ref, shat_out_ref,
+             wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems) = refs
+        else:
+            (x_ref, xhat_ref, shat_ref, gamma_ref,
+             self_w_ref, recv_w_ref,
+             out_ref, xhat_out_ref, shat_out_ref,
+             wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems) = refs
+            noise_ref = None
+        my_id = lax.axis_index(axis_name)
+
+        # quantize-on-store: only the compressed DELTA against the public
+        # replica estimate ever enters the wire buffer
+        delta = x_ref[...] - xhat_ref[...]
+        q, scale = _codec_encode(
+            codec, delta.astype(jnp.float32),
+            noise_ref[...] if noise_ref is not None else None)
+        wire_q[...] = q
+        wire_s[...] = jnp.full((1, _LANE), scale, jnp.float32)
+
+        copies = _start_wire_exchange(
+            my_id, size, offsets, axis_name, mesh_axes,
+            wire_q, wire_s, recv_q, recv_s, send_sems, recv_sems)
+
+        # own decoded delta while the wire flies; NOTE the self term
+        # weights D(C(δ)) (every holder applies the identical decoded
+        # delta — the CHOCO determinism contract), unlike the direct
+        # flavor whose self term is the true value
+        d_own = _codec_decode(codec, q, scale).astype(x_ref.dtype)
+        acc = self_w_ref[my_id] * d_own
+        for k in range(K):
+            c_q, c_s = copies[k]
+            c_q.wait()
+            c_s.wait()
+            dec = _codec_decode(codec, recv_q[k],
+                                recv_s[k][0, 0]).astype(x_ref.dtype)
+            acc = acc + recv_w_ref[k, my_id] * dec
+        xhat_new = xhat_ref[...] + d_own
+        shat_new = shat_ref[...] + acc
+        xhat_out_ref[...] = xhat_new
+        shat_out_ref[...] = shat_new
+        out_ref[...] = x_ref[...] + gamma_ref[0] * (shat_new - xhat_new)
+
+    return kernel
+
+
+def _wire_scratch_shapes(x2d, wire_dt, K):
+    """The wire-exchange VMEM scratch + DMA semaphores shared by the
+    direct and CHOCO runners: send wire (payload + scale row), K recv
+    slots, [2, K] semaphore arrays (payload row 0, scale row 1)."""
+    return [
+        pltpu.VMEM(x2d.shape, wire_dt),
+        pltpu.VMEM((1, _LANE), jnp.float32),
+        pltpu.VMEM((K,) + x2d.shape, wire_dt),
+        pltpu.VMEM((K, 1, _LANE), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, K)),
+        pltpu.SemaphoreType.DMA((2, K)),
+    ]
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
 def _run_compressed_exchange(x2d, res2d, noise2d, self_w, recv_w,
-                             size, offsets, axis_name, codec, interpret):
+                             size, offsets, axis_name, codec, interpret,
+                             mesh_axes=None):
     K = len(offsets)
     has_noise = noise2d is not None
     kernel = _compressed_gossip_kernel(size, offsets, axis_name, codec,
-                                       has_noise)
+                                       has_noise, mesh_axes)
     wire_dt = _wire_dtype(codec)
     n_in = 5 if has_noise else 4
     args = ((x2d, res2d, noise2d, self_w, recv_w) if has_noise
             else (x2d, res2d, self_w, recv_w))
+    vma = mesh_axes if mesh_axes is not None else axis_name
     return pl.pallas_call(
         kernel,
-        out_shape=(_struct_vma(x2d.shape, x2d.dtype, axis_name),
-                   _struct_vma(x2d.shape, x2d.dtype, axis_name)),
+        out_shape=(_struct_vma(x2d.shape, x2d.dtype, vma),
+                   _struct_vma(x2d.shape, x2d.dtype, vma)),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
         out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM)),
-        scratch_shapes=[
-            pltpu.VMEM(x2d.shape, wire_dt),
-            pltpu.VMEM((1, _LANE), jnp.float32),
-            pltpu.VMEM((K,) + x2d.shape, wire_dt),
-            pltpu.VMEM((K, 1, _LANE), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, K)),
-            pltpu.SemaphoreType.DMA((2, K)),
-        ],
+        scratch_shapes=_wire_scratch_shapes(x2d, wire_dt, K),
         compiler_params=pltpu.CompilerParams(
             collective_id=collective_id("compressed_gossip")),
         interpret=pltpu.InterpretParams() if interpret else False,
     )(*args)
 
 
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12))
+def _run_choco_exchange(x2d, xhat2d, shat2d, noise2d, gamma, self_w,
+                        recv_w, size, offsets, axis_name, codec,
+                        interpret, mesh_axes=None):
+    K = len(offsets)
+    has_noise = noise2d is not None
+    kernel = _choco_gossip_kernel(size, offsets, axis_name, codec,
+                                  has_noise, mesh_axes)
+    wire_dt = _wire_dtype(codec)
+    n_in = 7 if has_noise else 6
+    args = ((x2d, xhat2d, shat2d, noise2d, gamma, self_w, recv_w)
+            if has_noise else (x2d, xhat2d, shat2d, gamma, self_w, recv_w))
+    vma = mesh_axes if mesh_axes is not None else axis_name
+    out = _struct_vma(x2d.shape, x2d.dtype, vma)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(out, out, out),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * 3,
+        scratch_shapes=_wire_scratch_shapes(x2d, wire_dt, K),
+        compiler_params=pltpu.CompilerParams(
+            collective_id=collective_id("choco_gossip")),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*args)
+
+
+def _check_kernel_entry(buf, mode):
+    if mode not in ("pallas", "interpret"):
+        raise ValueError(f"unknown gossip-kernel transport {mode!r}")
+    if buf.ndim != 1:
+        raise ValueError(
+            f"fused compressed gossip expects 1-D flat buckets, got shape "
+            f"{tuple(buf.shape)}")
+
+
+def _pad_wire_tile(arrs, n: int):
+    """Pad each 1-D array (or None) to whole (32, 128) wire tiles; zeros
+    are inert through both kernel bodies (|0| never raises the scale
+    max, 0 quantizes to 0, decodes to 0, mixes to 0, residual/estimate
+    deltas stay 0) and the caller slices them away."""
+    pad = (-n) % GOSSIP_TILE
+    if not pad:
+        return arrs
+    return tuple(jnp.pad(a, (0, pad)) if a is not None else None
+                 for a in arrs)
+
+
 def fused_compressed_gossip(buf, residual, noise, self_w, recv_w, *,
                             axis_name, size: int, offsets, codec: str,
-                            mode: str):
+                            mode: str, mesh_axes=None):
     """One bucket's compressed gossip as a single fused kernel (call
     inside shard_map, per rank).
 
@@ -432,13 +593,14 @@ def fused_compressed_gossip(buf, residual, noise, self_w, recv_w, *,
     The any-backend ``"emulate"`` transport lives with the chain it
     mirrors (``compress/exchange.py::_emulated_bucket_gossip``).
 
+    ``mesh_axes``: ``None`` on a 1-D gossip mesh (scalar LOGICAL device
+    ids, the historical lowering); on a multi-axis mesh (the hybrid
+    ``(dp, fsdp)`` path) the full ordered tuple of mesh axis names, so
+    the RDMAs target the same cell in the neighbor replica via
+    mesh-coordinate device ids.
+
     Returns ``(mixed, residual_new)`` with ``buf``'s shape/dtype."""
-    if mode not in ("pallas", "interpret"):
-        raise ValueError(f"unknown gossip-kernel transport {mode!r}")
-    if buf.ndim != 1:
-        raise ValueError(
-            f"fused compressed gossip expects 1-D flat buckets, got shape "
-            f"{tuple(buf.shape)}")
+    _check_kernel_entry(buf, mode)
     if not offsets:
         # size-1 mesh / edgeless topology: no exchange, but the chain
         # still encodes (the EF residual is the codec error)
@@ -448,21 +610,60 @@ def fused_compressed_gossip(buf, residual, noise, self_w, recv_w, *,
             noise.reshape(-1) if noise is not None else None)
         d_own = _codec_decode(codec, q, scale).astype(buf.dtype)
         return self_w[lax.axis_index(axis_name)] * buf, t - d_own
-    # pad to whole (32, 128) wire tiles; zeros are inert through the
-    # whole body (|0| never raises the scale max, 0 quantizes to 0,
-    # decodes to 0, mixes to 0, residual 0) and are sliced away below
     n = int(buf.shape[0])
-    pad = (-n) % GOSSIP_TILE
-    if pad:
-        buf_p = jnp.pad(buf, (0, pad))
-        res_p = jnp.pad(residual, (0, pad))
-        noise_p = jnp.pad(noise, (0, pad)) if noise is not None else None
-    else:
-        buf_p, res_p, noise_p = buf, residual, noise
+    buf_p, res_p, noise_p = _pad_wire_tile((buf, residual, noise), n)
     shape2d = (-1, _LANE)
     out2d, res2d = _run_compressed_exchange(
         buf_p.reshape(shape2d), res_p.reshape(shape2d),
         noise_p.reshape(shape2d) if noise_p is not None else None,
         self_w, recv_w, size, tuple(int(o) for o in offsets), axis_name,
-        codec, mode == "interpret")
+        codec, mode == "interpret", mesh_axes)
     return out2d.reshape(-1)[:n], res2d.reshape(-1)[:n]
+
+
+def fused_choco_gossip(buf, xhat, shat, noise, gamma, self_w, recv_w, *,
+                       axis_name, size: int, offsets, codec: str,
+                       mode: str, mesh_axes=None):
+    """One bucket's CHOCO difference gossip as a single fused kernel:
+    the replica estimates fold in-register (``_choco_gossip_kernel``),
+    so the low-bandwidth discipline pays the same two HBM crossings as
+    the direct flavor.
+
+    ``xhat``/``shat``: the carried replica estimate and weighted
+    neighbor-estimate sum, 1-D like ``buf``.  ``gamma``: the traced
+    consensus stepsize already in ``buf.dtype`` with the chain's
+    construction (``cfg.gamma`` × the controller's ``gamma_scale``
+    leaf), shape ``(1,)``.  Everything else as
+    :func:`fused_compressed_gossip` — same transports, same weight
+    tables, same ``mesh_axes`` contract for hybrid meshes.
+
+    Returns ``(mixed, xhat_new, shat_new)`` with ``buf``'s
+    shape/dtype."""
+    _check_kernel_entry(buf, mode)
+    idx = lax.axis_index(axis_name)
+    if not offsets:
+        # edgeless topology: no exchange, but the estimates still
+        # advance by the own decoded delta (the chain's terms loop is
+        # simply empty)
+        delta = buf - xhat
+        q, scale = _codec_encode(
+            codec, delta.astype(jnp.float32),
+            noise.reshape(-1) if noise is not None else None)
+        d_own = _codec_decode(codec, q, scale).astype(buf.dtype)
+        acc = self_w[idx] * d_own
+        xhat_new = xhat + d_own
+        shat_new = shat + acc
+        return (buf + gamma[0] * (shat_new - xhat_new), xhat_new,
+                shat_new)
+    n = int(buf.shape[0])
+    buf_p, xhat_p, shat_p, noise_p = _pad_wire_tile(
+        (buf, xhat, shat, noise), n)
+    shape2d = (-1, _LANE)
+    out2d, xhat2d, shat2d = _run_choco_exchange(
+        buf_p.reshape(shape2d), xhat_p.reshape(shape2d),
+        shat_p.reshape(shape2d),
+        noise_p.reshape(shape2d) if noise_p is not None else None,
+        gamma, self_w, recv_w, size, tuple(int(o) for o in offsets),
+        axis_name, codec, mode == "interpret", mesh_axes)
+    return (out2d.reshape(-1)[:n], xhat2d.reshape(-1)[:n],
+            shat2d.reshape(-1)[:n])

@@ -75,7 +75,8 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
                  comp_state=None,
                  fusion_groups=None,
                  gossip_kernel: Optional[str] = None,
-                 interleave: bool = False):
+                 interleave: bool = False,
+                 kernel_mesh_axes: Optional[Tuple[str, ...]] = None):
     """Apply the configured averaging to ``params``.
 
     ``axis_name`` is the GOSSIP axis — it need not be the whole mesh.
@@ -118,6 +119,12 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
     chain.  ``interleave`` (its codec-free companion): issue small
     buckets' collectives first on the fused paths.  Both default off —
     the default lowering is byte-frozen by the off-path contract.
+    ``kernel_mesh_axes``: on a multi-axis shard_map (the hybrid
+    ``(dp, fsdp)`` path) the full ordered mesh axis tuple, so the
+    kernel's RDMAs target the neighbor replica's matching cell; the
+    replicated 1-D path leaves it ``None``.  This function is the ONE
+    bucket-kernel entry — the hybrid mixers (``parallel/tensor.py``)
+    and the replicated steppers both reach the kernel through here.
     """
     if compression is not None:
         if comm_type == CommunicationType.empty:
@@ -129,7 +136,7 @@ def _communicate(params, comm_type: CommunicationType, axis_name,
             axis_name=axis_name, topo=topo, sched=sched, step=step,
             fuse=F.fusion_enabled(fuse),
             bucket_bytes=fusion_bucket_bytes, leaf_groups=fusion_groups,
-            kernel=gossip_kernel)
+            kernel=gossip_kernel, kernel_mesh_axes=kernel_mesh_axes)
     if comm_type == CommunicationType.empty:
         return params
     do_fuse = F.fusion_enabled(fuse)
@@ -189,7 +196,7 @@ def _communicate_c(params, comm_type, axis_name, topo, sched, step,
                    machine_axes, machine_topo, nar_backend, fuse,
                    fusion_bucket_bytes, cfg, comp_state,
                    fusion_groups=None, gossip_kernel=None,
-                   interleave=False):
+                   interleave=False, kernel_mesh_axes=None):
     """:func:`_communicate` with a UNIFORM ``(tree, comp_state', diag)``
     return, so the strategy bodies need no per-site branching: ``cfg is
     None`` takes the exact uncompressed path (byte-identical StableHLO)
@@ -205,7 +212,8 @@ def _communicate_c(params, comm_type, axis_name, topo, sched, step,
                         machine_axes, machine_topo, nar_backend, fuse,
                         fusion_bucket_bytes, cfg, comp_state,
                         fusion_groups=fusion_groups,
-                        gossip_kernel=gossip_kernel, interleave=interleave)
+                        gossip_kernel=gossip_kernel, interleave=interleave,
+                        kernel_mesh_axes=kernel_mesh_axes)
 
 
 def _comp_snap_kwargs(diag):
@@ -738,7 +746,7 @@ def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
                     machine_axes, machine_topo, nar_backend,
                     fuse, bucket_bytes, compression=None, comp_state=None,
                     fusion_groups=None, gossip_kernel=None,
-                    interleave=False):
+                    interleave=False, kernel_mesh_axes=None):
     """Run the exchange on ``x`` and return the in-flight state the NEXT
     step folds: the neighbor part ``C_t(x) - d_t x`` (packed) plus d_t.
 
@@ -752,7 +760,8 @@ def _delayed_launch(x, comm_type, axis_name, topo, sched, step,
         x, comm_type, axis_name, topo, sched, step, machine_axes,
         machine_topo, nar_backend, fuse, bucket_bytes, compression,
         comp_state, fusion_groups=fusion_groups,
-        gossip_kernel=gossip_kernel, interleave=interleave)
+        gossip_kernel=gossip_kernel, interleave=interleave,
+        kernel_mesh_axes=kernel_mesh_axes)
     d = _mix_self_weight(comm_type, axis_name, topo, sched, step)
     neigh = jax.tree.map(lambda f, l: f - d.astype(l.dtype) * l, full, x)
     infl = {"bufs": _inflight_pack(neigh, fuse, bucket_bytes,

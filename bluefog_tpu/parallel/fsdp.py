@@ -179,8 +179,10 @@ def make_decentralized_fsdp_lm_train_step(
     accepts ``fuse=``/``fusion_bucket_bytes=`` (shard-shaped flat
     buckets), ``compression=`` (the codec encodes the 1/fsdp slice —
     multiplying this composition's wire win), ``overlap=`` (staleness-1
-    delayed-mix pipeline) and ``telemetry=`` (consensus over the dp
-    gossip axis only); see ``docs/hybrid_scaleout.md``.
+    delayed-mix pipeline), ``telemetry=`` (consensus over the dp
+    gossip axis only) and ``gossip_kernel=`` (one fused kernel per
+    compressed bucket per cell, RDMAs addressed by mesh coordinates);
+    see ``docs/hybrid_scaleout.md``.
 
     Returns ``(step_fn, place_fn)`` with ``step_fn(params, opt_state,
     tokens, targets, step) -> (params, opt_state, loss)``;

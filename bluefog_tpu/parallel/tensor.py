@@ -316,6 +316,17 @@ def _pick_program(entry, operands):
     return jitted
 
 
+def _kernel_smap_kwargs(gk):
+    """shard_map kwargs for a hybrid body that may run the gossip
+    kernel: the pallas_call's scratch/semaphore machinery carries no
+    varying-mesh-axes types, so vma checking must be off under the real
+    kernel transports — the same rule the replicated steppers apply
+    (``training.py``'s check_vma decision).  Off-path (``gk`` None or
+    emulate) passes NOTHING, keeping the historical call byte-frozen
+    (the 0.4.x compat shim drops the kwarg either way)."""
+    return {"check_vma": False} if gk in ("pallas", "interpret") else {}
+
+
 def _specs_key(inner_specs):
     leaves, treedef = jax.tree_util.tree_flatten(
         inner_specs, is_leaf=lambda x: isinstance(x, P))
@@ -327,7 +338,8 @@ def sharded_neighbor_mix(params, step, *, mesh: Mesh, inner_specs,
                          fuse=None, fusion_bucket_bytes=None,
                          compression=None, comp_state=None,
                          telemetry: bool = False, grads=None,
-                         old_params=None):
+                         old_params=None, gossip_kernel=None,
+                         interleave=None):
     """One mesh-axis-aware decentralized exchange of a global-view
     ``[dp, ...]`` tree on a 2-level ``(dp, fsdp)``/``(dp, tp)`` mesh —
     the hybrid comm hot path.
@@ -345,6 +357,15 @@ def sharded_neighbor_mix(params, step, *, mesh: Mesh, inner_specs,
     ``telemetry=True`` needs ``grads=``/``old_params=`` and reports
     consensus over the GOSSIP axis only, with squared aggregates psummed
     over the model-sharding axes (full-replica health per rank).
+
+    ``gossip_kernel``/``interleave`` (resolved through
+    ``CX.effective_gossip_kernel`` like the replicated builders): run
+    each cell's compressed bucket exchange as ONE fused kernel per
+    bucket — the SAME ``strategies._communicate`` bucket-kernel entry
+    the replicated path uses, with the kernel's RDMAs addressing the
+    neighbor replica's matching cell via mesh-coordinate device ids
+    (``kernel_mesh_axes``).  ``interleave=None`` takes the knob's
+    resolved companion value.
 
     With every knob off this lowers byte-identical to the pre-hybrid
     per-leaf path (asserted in ``tests/test_hybrid.py``).
@@ -365,6 +386,10 @@ def sharded_neighbor_mix(params, step, *, mesh: Mesh, inner_specs,
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
     CX.check_supported(cfg, comm_value="neighbor.allreduce", sched=sched,
                        overlap=False)
+    gk, auto_il = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value="neighbor.allreduce", fuse=fuse)
+    il = auto_il if interleave is None else bool(interleave)
+    kmesh = tuple(mesh.axis_names) if gk is not None else None
     if CX.stateful(cfg) and comp_state is None:
         raise ValueError(
             "stateful compression needs comp_state= (create it with "
@@ -422,7 +447,8 @@ def sharded_neighbor_mix(params, step, *, mesh: Mesh, inner_specs,
         local = strip_p(p_shard)
         mixed, cs_new, diag = S._communicate_c(
             local, comm, gossip_axis, topo, sched, step_s, None, None,
-            "xla", fuse, bucket, cfg, cs_l, fusion_groups=groups)
+            "xla", fuse, bucket, cfg, cs_l, fusion_groups=groups,
+            gossip_kernel=gk, interleave=il, kernel_mesh_axes=kmesh)
         outs = [wrap_p(mixed)]
         if has_cs:
             outs.append(wrap_cs(cs_new))
@@ -445,9 +471,10 @@ def sharded_neighbor_mix(params, step, *, mesh: Mesh, inner_specs,
          id(topo), id(sched), fuse, bucket,
          None if cfg is None else cfg.spec,
          None if comp_state is None
-         else jax.tree.structure(comp_state), telemetry),
+         else jax.tree.structure(comp_state), telemetry, gk, il),
         lambda: jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                              out_specs=tuple(out_specs)))
+                              out_specs=tuple(out_specs),
+                              **_kernel_smap_kwargs(gk)))
     res = list(_pick_program(entry, operands)(*operands))
     mixed = res.pop(0)
     cs_new = res.pop(0) if has_cs else None
@@ -460,12 +487,18 @@ def sharded_delayed_mix(adapted, step, inflight, *, mesh: Mesh,
                         sched=None, fuse=None, fusion_bucket_bytes=None,
                         compression=None, comp_state=None,
                         telemetry: bool = False, grads=None,
-                        old_params=None):
+                        old_params=None, gossip_kernel=None,
+                        interleave=None):
     """Overlapped (staleness-1) flavor of :func:`sharded_neighbor_mix`:
     fold the PREVIOUS step's in-flight neighbor sum into ``adapted`` and
     launch this step's exchange on it (the ``strategies.delayed_atc_step``
     pipeline, per fsdp cell over the gossip axis).  ``inflight`` is the
     carried state from :func:`hybrid_inflight_state` / the previous call.
+
+    ``gossip_kernel``/``interleave`` fuse each cell's launch leg exactly
+    as in :func:`sharded_neighbor_mix` (CHOCO stays rejected under
+    overlap by ``check_supported`` — only the EF-residual codecs ride
+    the kernel here).
 
     Returns ``(combined, inflight_new, new_comp_state, snapshot)``.
     Traced-program caching as in :func:`sharded_neighbor_mix`."""
@@ -482,6 +515,10 @@ def sharded_delayed_mix(adapted, step, inflight, *, mesh: Mesh,
     bucket = F.resolve_max_bucket_bytes(fusion_bucket_bytes)
     CX.check_supported(cfg, comm_value="neighbor.allreduce", sched=sched,
                        overlap=True)
+    gk, auto_il = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value="neighbor.allreduce", fuse=fuse)
+    il = auto_il if interleave is None else bool(interleave)
+    kmesh = tuple(mesh.axis_names) if gk is not None else None
     if CX.stateful(cfg) and comp_state is None:
         raise ValueError(
             "stateful compression needs comp_state= (create it with "
@@ -527,7 +564,8 @@ def sharded_delayed_mix(adapted, step, inflight, *, mesh: Mesh,
         combined = S._delayed_fold(local_z, infl_l, fuse, bucket, groups)
         launch = S._delayed_launch(
             local_z, comm, gossip_axis, topo, sched, step_s, None, None,
-            "xla", fuse, bucket, cfg, cs_l, fusion_groups=groups)
+            "xla", fuse, bucket, cfg, cs_l, fusion_groups=groups,
+            gossip_kernel=gk, interleave=il, kernel_mesh_axes=kmesh)
         infl_new, cs_new, diag = (launch if cfg is not None
                                   else (launch, None, None))
         outs = [wrap_p(combined),
@@ -557,9 +595,10 @@ def sharded_delayed_mix(adapted, step, inflight, *, mesh: Mesh,
          None if cfg is None else cfg.spec,
          None if comp_state is None
          else jax.tree.structure(comp_state),
-         jax.tree.structure(inflight), telemetry),
+         jax.tree.structure(inflight), telemetry, gk, il),
         lambda: jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                              out_specs=tuple(out_specs)))
+                              out_specs=tuple(out_specs),
+                              **_kernel_smap_kwargs(gk)))
     res = list(_pick_program(entry, operands)(*operands))
     combined = res.pop(0)
     infl_new = res.pop(0)
@@ -572,7 +611,8 @@ def make_decentralized_sharded_lm_train_step(
         model, base_opt: optax.GradientTransformation, mesh: Mesh,
         inner_specs_fn, topo=None, sched=None, donate: bool = True,
         fuse=None, fusion_bucket_bytes=None, overlap=None,
-        compression=None, telemetry=None, gossip_axis: str = "dp"):
+        compression=None, telemetry=None, gossip_axis: str = "dp",
+        gossip_kernel=None):
     """Shared core of the decentralized-dp x {tp, fsdp} compositions.
 
     ``inner_specs_fn(params_single) -> spec tree`` supplies the
@@ -598,6 +638,12 @@ def make_decentralized_sharded_lm_train_step(
     * ``telemetry`` — the step returns ``(params, state, loss,
       TelemetrySnapshot)`` with per-cell ``[dp, fsdp]`` fields; consensus
       pmeans over the GOSSIP axis only (squared sums over fsdp).
+    * ``gossip_kernel`` — fuse each cell's compressed bucket exchange
+      into one kernel per bucket (``BLUEFOG_GOSSIP_KERNEL`` fallback,
+      resolved/fail-fast at build via
+      ``compress.exchange.effective_gossip_kernel``); the kernel's RDMAs
+      address the neighbor replica's matching cell by mesh coordinates,
+      so wire traffic stays the compressed 1/fsdp shard slice.
 
     With every knob off the lowered StableHLO is byte-identical to the
     pre-hybrid per-leaf path, and the plain ``opt_state`` layout is
@@ -625,6 +671,11 @@ def make_decentralized_sharded_lm_train_step(
     dict_state = overlap or comp_stateful
     # snapshot: False = "off" even if the env changes before first trace
     comp_knob = cfg if cfg is not None else False
+    # resolve the kernel knob at BUILD time too: bad combos fail here,
+    # not at step 1, and later env flips can't retrace the step
+    gk_mode, gk_il = CX.effective_gossip_kernel(
+        gossip_kernel, cfg, comm_value="neighbor.allreduce", fuse=fuse)
+    gk_knob = gk_mode if gk_mode is not None else False
 
     def _dp_specs(params):
         inner = inner_specs_fn(jax.tree.map(lambda a: a[0], params))
@@ -700,7 +751,8 @@ def make_decentralized_sharded_lm_train_step(
                 inner_specs=ispecs, gossip_axis=gossip_axis, topo=topo,
                 sched=sched, fuse=fuse, fusion_bucket_bytes=bucket,
                 compression=comp_knob, comp_state=cs,
-                telemetry=telemetry, grads=grads, old_params=params)
+                telemetry=telemetry, grads=grads, old_params=params,
+                gossip_kernel=gk_knob, interleave=gk_il)
             out_state = {"base": bs_new, "inflight": infl_new}
         else:
             new_params, cs_new, snap = sharded_neighbor_mix(
@@ -708,7 +760,8 @@ def make_decentralized_sharded_lm_train_step(
                 gossip_axis=gossip_axis, topo=topo, sched=sched,
                 fuse=fuse, fusion_bucket_bytes=bucket,
                 compression=comp_knob, comp_state=cs,
-                telemetry=telemetry, grads=grads, old_params=params)
+                telemetry=telemetry, grads=grads, old_params=params,
+                gossip_kernel=gk_knob, interleave=gk_il)
             out_state = {"base": bs_new} if dict_state else bs_new
         if comp_stateful:
             out_state["compress"] = cs_new

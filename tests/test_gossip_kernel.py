@@ -144,7 +144,8 @@ def test_effective_gossip_kernel_explicit_raises():
     ("topk:0.1", "no kernel codec"),
     ("randomk:0.5", "no kernel codec"),
     ("identity", "no kernel codec"),
-    ("choco:int8:gamma=0.5", "CHOCO-under-kernel is deferred"),
+    ("choco:topk:0.1:gamma=0.5", "no kernel codec"),
+    ("choco:identity:gamma=1", "no kernel codec"),
 ])
 def test_effective_gossip_kernel_rejects_codecs(spec, msg, monkeypatch):
     cfg = CP.resolve_compression(spec)
@@ -164,18 +165,29 @@ def test_builders_validate_gossip_kernel(bf_ctx):
     with pytest.raises(ValueError, match="dense-quantizer"):
         bf.DistributedNeighborAllreduceOptimizer(
             optax.sgd(0.1), gossip_kernel="pallas")
+    # CHOCO over a dense quantizer is kernel-supported now (the estimates
+    # fold in-register) — only its sparsifier wrapping stays rejected
+    with pytest.raises(ValueError, match="no kernel codec"):
+        bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), compression="choco:topk:0.1:gamma=0.5",
+            gossip_kernel="emulate")
     from bluefog_tpu.models.mlp import MLP
-    with pytest.raises(ValueError, match="CHOCO-under-kernel"):
-        T.make_train_step(MLP(features=(8,), num_outputs=4), optax.sgd(0.1),
-                          compression="choco:int8:gamma=0.5",
-                          gossip_kernel="emulate")
+    T.make_train_step(MLP(features=(8,), num_outputs=4), optax.sgd(0.1),
+                      compression="choco:int8:gamma=0.5",
+                      gossip_kernel="emulate")
 
 
 def test_kernel_codec_mapping():
     assert CP.kernel_codec(CP.resolve_compression("int8")) == "int8"
     assert CP.kernel_codec(CP.resolve_compression("topk:0.5")) is None
+    # the mapping looks THROUGH the choco wrapper: the inner dense
+    # quantizer is the wire codec; sparsifier wrappers stay unmapped
     assert CP.kernel_codec(
-        CP.resolve_compression("choco:int8:gamma=0.5")) is None
+        CP.resolve_compression("choco:int8:gamma=0.5")) == "int8"
+    assert CP.kernel_codec(
+        CP.resolve_compression("choco:fp8:gamma=0.3")) == "fp8"
+    assert CP.kernel_codec(
+        CP.resolve_compression("choco:topk:0.1:gamma=0.5")) is None
     assert CP.kernel_codec(None) is None
 
 
@@ -187,9 +199,11 @@ def test_collective_id_registry():
     # gossip keeps its historical id: the dense kernel's lowered bytes
     # (and any cross-process compile-cache entries) must not churn
     assert PU.collective_id("gossip") == 7
+    assert PU.collective_id("choco_gossip") == 10
     ids = {PU.collective_id(f)
-           for f in ("gossip", "windows", "compressed_gossip")}
-    assert len(ids) == 3, "kernel families alias a barrier semaphore"
+           for f in ("gossip", "windows", "compressed_gossip",
+                     "choco_gossip")}
+    assert len(ids) == 4, "kernel families alias a barrier semaphore"
     with pytest.raises(ValueError, match="unknown pallas collective"):
         PU.collective_id("nope")
 
@@ -322,6 +336,121 @@ def test_emulate_bitexact_atc_and_exact_diffusion(bf_ctx):
         bf.set_topology(prev)
 
 
+@pytest.mark.parametrize("spec", ["choco:int8:gamma=0.5",
+                                  "choco:fp8:gamma=0.3"])
+def test_emulate_bitexact_choco(bf_ctx, spec):
+    """CHOCO-under-kernel: the emulate transport reproduces the chain's
+    difference-gossip recursion bit for bit — params AND the replica
+    estimates x̂/ŝ (``_run_pair`` compares the whole carried compress
+    state), from the zero-estimate warmup on."""
+    rng = np.random.default_rng(12)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression=spec, gossip_kernel=gk), params, grads)
+
+
+def test_emulate_bitexact_choco_multibucket_interleaved(bf_ctx):
+    """Small bucket cap -> several buckets per dtype: the CHOCO kernel
+    path issues them in interleave order, estimates land in plan
+    position.  (CHOCO x dynamic schedules stays rejected by
+    ``check_supported`` — constant-W requirement — so the dynamic leg
+    has no choco flavor to cover.)"""
+    rng = np.random.default_rng(13)
+    params = ragged_tree(bf.size(), rng)
+    grads = grads_like(params, rng)
+    opt_k = _run_pair(lambda gk: bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression="choco:int8:gamma=0.5",
+        fusion_bucket_bytes=512, gossip_kernel=gk),
+        params, grads, steps=5)
+    assert len(opt_k._step_cache) == 1
+    assert next(iter(opt_k._step_cache.values()))._cache_size() == 1
+
+
+def test_emulate_bitexact_choco_gamma_actuated(bf_ctx):
+    """The PR-9 controller's traced ``gamma_scale`` leaf rides INTO the
+    kernel: a mid-run γ backoff (knob write between steps) stays
+    bit-exact vs the chain and retraces nothing on either path."""
+    rng = np.random.default_rng(14)
+    params = to_global_tree(ragged_tree(bf.size(), rng))
+    grads = to_global_tree(grads_like(params, rng))
+
+    def make(gk):
+        return bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.05), compression="choco:int8:gamma=0.5",
+            gossip_kernel=gk, control=True)
+
+    opt_ref, opt_k = make(None), make("emulate")
+    st_r = to_global_tree(opt_ref.init(params))
+    st_k = to_global_tree(opt_k.init(params))
+    p_r, p_k = params, params
+    for t, scale in enumerate([1.0, 1.0, 0.5, 0.25, 1.0]):
+        opt_ref.control_knobs["gamma_scale"] = scale
+        opt_k.control_knobs["gamma_scale"] = scale
+        p_r, st_r = opt_ref.step(p_r, grads, st_r, step=t)[:2]
+        p_k, st_k = opt_k.step(p_k, grads, st_k, step=t)[:2]
+    assert_trees_bitwise_equal(p_r, p_k, "gamma-actuated params")
+    assert_trees_bitwise_equal(st_r["compress"], st_k["compress"],
+                               "gamma-actuated estimates")
+    # γ flips are traced data on the kernel path too: one program
+    assert len(opt_k._step_cache) == 1
+    assert next(iter(opt_k._step_cache.values()))._cache_size() == 1
+
+
+def test_choco_degraded_guard_resets_estimates_zero_recompiles(bf_ctx):
+    """Fault flips under the CHOCO kernel path: the degraded branch
+    zeroes x̂/ŝ (every rank restarts the warmup together), the kernel
+    branch stays bit-exact vs the chain, and both flavors of the flip
+    share one compiled program."""
+    cx = bf_ctx
+    base = optax.sgd(0.05)
+    cfg = CP.resolve_compression("choco:int8:gamma=0.5")
+    spec = P(cx.rank_axis)
+
+    def build(gk):
+        comm = S.consensus_step(
+            base, CT.neighbor_allreduce, cx.rank_axis,
+            topo=cx.compiled_topology, nar_backend="xla", fuse=True,
+            compression=cfg, gossip_kernel=gk)
+        guarded = S.with_degraded_guard(
+            comm, S.local_sgd_like_step(base, degraded=True,
+                                        compression=cfg))
+
+        def stepper(p, g, st, step, degraded):
+            def shard_fn(ps, gs, sts, si, dg):
+                p_new, st_new = guarded(
+                    jax.tree.map(lambda a: a[0], ps),
+                    jax.tree.map(lambda a: a[0], gs),
+                    jax.tree.map(lambda a: a[0], sts), si, dg)
+                lead = lambda t: jax.tree.map(lambda a: a[None], t)
+                return lead(p_new), lead(st_new)
+            return jax.shard_map(
+                shard_fn, mesh=cx.mesh,
+                in_specs=(spec, spec, spec, P(), P()),
+                out_specs=(spec, spec))(p, g, st, step, degraded)
+
+        return jax.jit(stepper)
+
+    fn_ref, fn_k = build(False), build("emulate")
+    rng = np.random.default_rng(15)
+    params = to_global_tree(ragged_tree(bf.size(), rng))
+    grads = to_global_tree(grads_like(params, rng))
+    state0 = to_global_tree(jax.vmap(lambda pp: S.compress_wrap_init(
+        base, pp, cfg, fuse=True))(params))
+    p_r, st_r = params, state0
+    p_k, st_k = params, state0
+    for t, dg in enumerate([False, True, False, True, False]):
+        p_r, st_r = fn_ref(p_r, grads, st_r, jnp.int32(t), jnp.asarray(dg))
+        p_k, st_k = fn_k(p_k, grads, st_k, jnp.int32(t), jnp.asarray(dg))
+        if dg:
+            for b in jax.tree.leaves(st_k["compress"]):
+                assert np.abs(np.asarray(b)).sum() == 0
+    assert_trees_bitwise_equal(p_r, p_k, "choco guarded params")
+    assert_trees_bitwise_equal(st_r["compress"], st_k["compress"],
+                               "choco guarded estimates")
+    assert fn_k._cache_size() == 1
+
+
 def test_degraded_guard_flip_zero_recompiles(bf_ctx):
     """Fault flips under the kernel path are traced data: the degraded
     branch (local step + EF reset) and the kernel branch share one
@@ -435,6 +564,15 @@ def test_wrapper_keys_on_resolved_mode(bf_ctx):
     opt.step(params, grads, st, step=0)
     key = next(iter(opt._step_cache))
     assert "emulate" in key
+    # choco + kernel is its own program: spec and mode both in the key
+    opt_c = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), compression="choco:int8:gamma=0.5",
+        gossip_kernel="emulate")
+    st_c = opt_c.init(params)
+    opt_c.step(params, grads, st_c, step=0)
+    key_c = next(iter(opt_c._step_cache))
+    assert "emulate" in key_c and "choco:int8:gamma=0.5" in str(key_c)
+    assert key_c != key
 
 
 # ---------------------------------------------------------------------------
@@ -708,9 +846,16 @@ def test_kernel_entry_no_exchange_branch(bf_ctx):
 def test_canonical_trace_checks_include_kernel_config(bf_ctx):
     findings, report = TH.run_canonical_trace_checks(depth=2)
     assert findings == []
-    k = report["fused_int8_kernel"]
-    assert k["pallas_calls"] == k["expected_pallas_calls"] == k["buckets"]
-    assert k["ppermute"] == 0
+    # all three kernel flavors lower for TPU and hold the invariants:
+    # direct int8, CHOCO-under-kernel, and the hybrid (dp, fsdp) step
+    # (whose RDMAs lower through mesh-coordinate device ids)
+    for leg in ("fused_int8_kernel", "fused_choco_kernel",
+                "hybrid_choco_kernel"):
+        k = report[leg]
+        assert "skipped" not in k, (leg, k)
+        assert k["pallas_calls"] == k["expected_pallas_calls"] \
+            == k["buckets"], leg
+        assert k["ppermute"] == 0, leg
 
 
 def test_canonical_trace_checks_ignore_ambient_knob(bf_ctx, monkeypatch):

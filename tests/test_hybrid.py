@@ -243,6 +243,56 @@ def test_choco_identity_gamma1_equals_plain_gossip(mesh, topo):
                                    rtol=tol, atol=tol)
 
 
+@pytest.mark.parametrize("spec", ["choco:int8:gamma=0.5",
+                                  "choco:fp8:gamma=0.3", "int8", "fp8"])
+def test_hybrid_kernel_emulate_matches_chain(mesh, topo, spec):
+    """The hybrid mixers reach the SAME bucket-kernel entry as the
+    replicated steppers: per fsdp cell, the emulate-kernel exchange is
+    bit-exact vs the chain — params AND the carried state (EF residuals
+    or CHOCO x̂/ŝ) — over a multi-step run."""
+    gtree = ragged_tree(seed=7)
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    cfg = CP.resolve_compression(spec)
+    single = jax.tree.map(lambda a: a[0], gtree)
+    cs_c = CX.sharded_state_layout(cfg, single, ispecs, mesh, fuse=True)
+    cs_k = CX.sharded_state_layout(cfg, single, ispecs, mesh, fuse=True)
+    p_c, p_k = gp, gp
+    for t in range(4):
+        p_c, cs_c, _ = sharded_neighbor_mix(
+            p_c, t, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+            compression=cfg, comp_state=cs_c, gossip_kernel=False)
+        p_k, cs_k, _ = sharded_neighbor_mix(
+            p_k, t, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+            compression=cfg, comp_state=cs_k, gossip_kernel="emulate")
+    assert_trees_bitexact(p_c, p_k)
+    assert_trees_bitexact(cs_c, cs_k)
+
+
+def test_hybrid_kernel_wire_accounting_unchanged(mesh, topo):
+    """The emulate transport keeps the hybrid chain's wire: same permute
+    count and same bytes — i.e. the compressed 1/fsdp shard slice, not a
+    reassembled replica (the composition's whole wire win)."""
+    from bluefog_tpu.utils import trace_metrics as TM
+
+    gtree = ragged_tree(seed=8)
+    gp = place_tree(gtree, mesh)
+    ispecs = inner_specs_of(gtree, mesh)
+    cfg = CP.resolve_compression("choco:int8:gamma=0.5")
+    single = jax.tree.map(lambda a: a[0], gtree)
+    cs0 = CX.sharded_state_layout(cfg, single, ispecs, mesh, fuse=True)
+
+    def counts(gk):
+        fn = lambda p, cs: sharded_neighbor_mix(
+            p, 0, mesh=mesh, inner_specs=ispecs, topo=topo, fuse=True,
+            compression=cfg, comp_state=cs, gossip_kernel=gk)[:2]
+        return TM.collective_counts(fn, gp, cs0)
+
+    chain, em = counts(False), counts("emulate")
+    assert em["ppermute"] == chain["ppermute"] > 0
+    assert em["ppermute_bytes"] == chain["ppermute_bytes"]
+
+
 @pytest.mark.parametrize("fuse", [True, False])
 def test_delayed_mix_matches_host_recurrence(mesh, topo, fuse):
     """Overlapped hybrid: warmup fold is the identity, and from step 1 on
@@ -424,6 +474,31 @@ def test_hybrid_knobs_zero_recompiles(mesh, sched, topo):
         gp, st, loss = step_c(gp, st, x, y, jnp.int32(t))
     assert step_c._cache_size() == 1
     assert np.isfinite(float(loss))
+
+
+def test_hybrid_train_step_kernel_matches_chain(mesh, topo):
+    """Builder-level gate for the kernel knob: the full fsdp train step
+    built with ``gossip_kernel="emulate"`` stays bit-exact vs the chain
+    build — params, base state and CHOCO estimates — with one compiled
+    program."""
+    model, x, y, params, inner_fn = _mlp_setup(mesh)
+    opt = optax.sgd(0.05)
+
+    def run(gk):
+        step, place = make_decentralized_sharded_lm_train_step(
+            model, opt, mesh, inner_fn, topo=topo, donate=False,
+            fuse=True, compression="choco:int8:gamma=0.5",
+            gossip_kernel=gk)
+        gp, st = place(params)
+        for t in range(3):
+            gp, st, loss = step(gp, st, x, y, jnp.int32(t))
+        assert step._cache_size() == 1
+        return gp, st
+
+    p_c, st_c = run(False)
+    p_k, st_k = run("emulate")
+    assert_trees_bitexact(p_c, p_k)
+    assert_trees_bitexact(st_c["compress"], st_k["compress"])
 
 
 # ---------------------------------------------------------------------------
