@@ -15,6 +15,9 @@
 #   make bench-kernel       # gated trace check: single-kernel gossip hot
 #                           # path (one pallas_call/bucket, wire bytes) —
 #                           # next to bench-compress in the gate family
+#   make bench-schedule     # gated trace check: synthesized exchange
+#                           # schedule beats the static ring >= 2x on the
+#                           # seeded fabric, wire budget == IR prediction
 #   make bench-hw           # hardened hardware bench: probe first, retry
 #                           # init with fresh processes, bank diagnosis
 #   make lint               # pre-PR gate: bflint AST contract rules +
@@ -26,7 +29,7 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
 .PHONY: test test_fast test_basics test_ops test_win test_optimizer \
         test_hierarchical test_torch test_attention examples bench \
         bench-trace bench-overlap bench-compress bench-hybrid \
-        bench-kernel bench-hw hwcheck \
+        bench-kernel bench-schedule bench-hw hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
         ckpt-smoke async-smoke bench-serve bench-ckpt lint
@@ -198,6 +201,38 @@ bench-kernel:
 	assert he['ppermute'] == he['chain_ppermute'], 'hybrid emulate permute budget'; \
 	assert he['ppermute_bytes_per_step'] == he['chain_ppermute_bytes_per_step'], \
 	       'hybrid emulate wire bytes drifted from the 1/fsdp chain'"
+
+# Schedule-synthesis evidence (CPU, docs/control.md "Schedule
+# synthesis"; sits next to bench-kernel in the trace-gate family):
+# bench-trace JSON with the "schedule" block — the fabric is probed with
+# a slow edge seeded via BLUEFOG_EDGE_PROBE_DELAY_US (default: 200 ms on
+# 0->1, a ring edge), control/synthesize.py emits a bottleneck-
+# minimizing schedule from the MEASURED matrix, and the gate asserts:
+# (1) synthesis ran off the measured matrix (no fallback), (2) the
+# synthesized schedule's predicted bottleneck round cost beats the
+# topology-oblivious static ring priced on the SAME matrix by >= 2x,
+# and (3) the synthesized step's traced ppermute count EXACTLY equals
+# its IR prediction (ScheduleIR.permute_budget x fusion buckets).
+bench-schedule:
+	BLUEFOG_EDGE_PROBE_DELAY_US=$${BLUEFOG_EDGE_PROBE_DELAY_US:-0-1:200000} \
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); s=d['schedule']; t=s['traced']; \
+	b=s['predicted_bottleneck_us']; \
+	print(json.dumps(d)); \
+	print('schedule: source %s | period %d, offsets %s | predicted ' \
+	      'bottleneck %.1fus vs ring %.1fus (%.2fx) | traced %d/%d ' \
+	      'ppermutes' \
+	      % (s['source'], s['period'], s['offsets'], b['synthesized'], \
+	         b['static_ring'], s['predicted_cost_ratio'], t['ppermute'], \
+	         t['expected_ppermute'])); \
+	assert s['source'] == 'synthesized', \
+	       'synthesis fell back: %s' % s.get('reason'); \
+	assert s['predicted_cost_ratio'] >= 2.0, \
+	       'synthesized schedule only %.2fx better than the ring' \
+	       % s['predicted_cost_ratio']; \
+	assert t['budget_match'], \
+	       'traced ppermutes %d != IR budget %d' \
+	       % (t['ppermute'], t['expected_ppermute'])"
 
 # Hardened hardware bench path (docs/performance.md "Re-earning the
 # hardware number"): BENCH_r02-r05 all died in backend init with nothing

@@ -717,6 +717,68 @@ def trace_only_main():
         }
         kernel_report["hybrid"] = hyb_kernel
 
+    # Schedule-synthesis evidence (docs/control.md "Schedule
+    # synthesis"): probe the fabric (BLUEFOG_EDGE_PROBE_DELAY_US seeds
+    # a known slow edge, same as `make profile-smoke`), synthesize a
+    # bottleneck-minimizing schedule from the measured matrix
+    # (control/synthesize.py), and compare its predicted bottleneck
+    # round cost against the topology-oblivious static ring priced on
+    # the SAME matrix.  Second gate: the synthesized schedule's traced
+    # ppermute count must equal its own IR prediction
+    # (`ScheduleIR.permute_budget` x buckets) — the wire budget matches
+    # the schedule's declared shape exactly.  `make bench-schedule`
+    # asserts the >= 2x cost ratio and the exact budget match.
+    from bluefog_tpu.control import synthesize as SYN
+    from bluefog_tpu.observability import commprof as commprof_mod
+    from bluefog_tpu.parallel import topology as sched_topo_mod
+    from bluefog_tpu.parallel.schedule import compile_topology as _ct
+    from bluefog_tpu.parallel.schedule_ir import (
+        compile_schedule_ir, ir_from_matrix)
+
+    ring_topo = _ct(sched_topo_mod.RingGraph(n))
+    probe_edge_set = sorted(
+        set(commprof_mod.topology_edges(cx.compiled_topology))
+        | set(commprof_mod.topology_edges(ring_topo)))
+    sched_matrix = commprof_mod.probe_edges(
+        sizes=(4096,), edges=probe_edge_set, repeats=1, inner=2,
+        export=False)
+    sched_ir, sched_source, sched_reason = SYN.synthesize_or_fallback(
+        sched_matrix, topo=cx.compiled_topology)
+    ring_ir = ir_from_matrix(ring_topo.weight_matrix, name="static_ring")
+    synth_cost = SYN.predicted_bottleneck_us(sched_ir, sched_matrix)
+    ring_cost = SYN.predicted_bottleneck_us(ring_ir, sched_matrix)
+    sstep = T.make_train_step(
+        model, base, communication="neighbor_allreduce", fuse=True,
+        donate=False, sched=compile_schedule_ir(sched_ir))
+    sentry = TM.collective_counts(
+        sstep, variables, opt_state, (x, y), jnp.int32(0))
+    sched_expected_pp = plan.n_buckets * sched_ir.permute_budget(1)
+    schedule_report = {
+        "source": sched_source,
+        "reason": sched_reason,
+        "period": sched_ir.period,
+        "fingerprint": sched_ir.fingerprint(),
+        "offsets": list(sched_ir.offsets()),
+        "rounds": [
+            {"edges": [[s, d] for s, d, _ in r.edges],
+             "predicted_us": c}
+            for r, c in zip(
+                sched_ir.rounds,
+                SYN.predicted_round_costs(sched_ir, sched_matrix))],
+        "predicted_bottleneck_us": {
+            "synthesized": synth_cost,
+            "static_ring": ring_cost,
+        },
+        "predicted_cost_ratio": round(ring_cost / max(synth_cost, 1e-9),
+                                      2),
+        "traced": {
+            "ppermute": sentry["ppermute"],
+            "expected_ppermute": sched_expected_pp,
+            "budget_match": sentry["ppermute"] == sched_expected_pp,
+            "ppermute_bytes_per_step": sentry["ppermute_bytes"],
+        },
+    }
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -741,6 +803,7 @@ def trace_only_main():
         "hybrid": hybrid_report,
         "hybrid_bytes_drop": hybrid_drop,
         "kernel": kernel_report,
+        "schedule": schedule_report,
         # final host-registry snapshot: comm-volume, fusion-plan shape and
         # cache stats travel WITH the perf number in the BENCH_*.json
         "metrics": bf_metrics.registry.snapshot(),

@@ -42,10 +42,22 @@ from .actuate import (
     reweight_matrix_by_cost,
 )
 from .controller import Controller, DECISIONS_SUFFIX
+from .synthesize import (
+    SynthesisConfig,
+    fallback_schedule_ir,
+    predicted_bottleneck_us,
+    predicted_round_costs,
+    synthesize_or_fallback,
+    synthesize_schedule,
+    write_schedule_record,
+)
 
 __all__ = [
     "CONTROL_ENV", "ControlConfig", "Decision", "PolicyEngine",
     "control_mode", "read_decisions", "slow_edge",
     "Actuator", "SwitchableSchedule", "build_switchable_schedule",
     "reweight_matrix_by_cost", "Controller", "DECISIONS_SUFFIX",
+    "SynthesisConfig", "synthesize_schedule", "synthesize_or_fallback",
+    "fallback_schedule_ir", "predicted_round_costs",
+    "predicted_bottleneck_us", "write_schedule_record",
 ]

@@ -38,6 +38,11 @@ from ..parallel.schedule import (
     DynamicSchedule,
     compile_dynamic_matrices,
 )
+from ..parallel.schedule_ir import (
+    ScheduleIR,
+    ir_from_matrices,
+    ir_from_matrix,
+)
 from . import policy as _policy
 
 __all__ = [
@@ -140,12 +145,17 @@ def build_switchable_schedule(topo=None, *,
                               period: Optional[int] = None,
                               cost_matrix=None,
                               cost_alpha: float = 1.0,
+                              synthesized: Optional[ScheduleIR] = None,
                               max_period: int = 4096
                               ) -> SwitchableSchedule:
     """Compile the controller's schedule modes into one
     :class:`SwitchableSchedule`.
 
-    Modes (in index order):
+    Every mode is built as a
+    :class:`~..parallel.schedule_ir.ScheduleIR` first — one
+    construction path for hand-built and synthesized schedules alike —
+    then tiled to the shared base period and lowered together.  Modes
+    (in index order):
 
     * ``"static"``  — ``static_matrix`` (default: ``topo``'s compiled
       weight matrix) repeated every step;
@@ -157,6 +167,10 @@ def build_switchable_schedule(topo=None, *,
       when a matrix is supplied.  Callers must gate the matrix with
       ``commprof.matrix_is_usable`` first — a synthetic or stale matrix
       must not become a link model.
+    * ``"synthesized"`` — a pre-built IR (``control.synthesize``); only
+      present when supplied.  Its period folds into the base period by
+      least common multiple, so hot-swapping between it and the
+      fallback modes stays a pure virtual-step remap.
 
     ``topo`` defaults to the current context's compiled topology."""
     if topo is None:
@@ -170,15 +184,31 @@ def build_switchable_schedule(topo=None, *,
     if period is None:
         period = _dyn.schedule_period(factory, n, max_period=max_period)
     dyn_mats = _dyn.dynamic_mixing_matrices(factory, n, period)
-    stacks = [np.repeat(W[None], period, axis=0), dyn_mats]
+    irs = [ir_from_matrix(W, name="static"),
+           ir_from_matrices(dyn_mats, name="dynamic")]
     names = ["static", "dynamic"]
     if cost_matrix is not None:
         cost_W = reweight_matrix_by_cost(W, cost_matrix, cost_alpha)
-        stacks.append(np.repeat(cost_W[None], period, axis=0))
+        irs.append(ir_from_matrix(cost_W, name="cost"))
         names.append("cost")
+    if synthesized is not None:
+        if synthesized.size != n:
+            raise ValueError(
+                f"synthesized schedule is for {synthesized.size} ranks, "
+                f"fleet has {n}")
+        irs.append(synthesized)
+        names.append("synthesized")
+    base_period = period
+    for ir in irs:
+        base_period = math.lcm(base_period, ir.period)
+    if base_period > max_period:
+        raise ValueError(
+            f"combined mode period {base_period} exceeds max_period "
+            f"{max_period}")
+    stacks = [ir.tile(base_period) for ir in irs]
     sched = compile_dynamic_matrices(np.concatenate(stacks, axis=0))
     return SwitchableSchedule(sched=sched, mode_names=tuple(names),
-                              base_period=period)
+                              base_period=base_period)
 
 
 class Actuator:

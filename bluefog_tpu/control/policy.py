@@ -289,8 +289,13 @@ class PolicyEngine:
 
     def _preferred_mode(self, edges_entries) -> str:
         """The schedule mode a HEALTHY fleet should run: the
-        cost-reweighted mode when a usable measured matrix shows a slow
-        edge worth routing around (arXiv:2309.13541), else the base."""
+        fabric-synthesized schedule when one was compiled in (it was
+        built FROM a usable measured matrix, so measured evidence is a
+        precondition of the slot existing), else the cost-reweighted
+        mode when the measured matrix shows a slow edge worth routing
+        around (arXiv:2309.13541), else the base."""
+        if "synthesized" in self.modes and edges_entries:
+            return "synthesized"
         if "cost" in self.modes and edges_entries:
             worst = slow_edge(edges_entries, self.cfg.edge_slow_factor)
             if worst is not None:
@@ -341,11 +346,17 @@ class PolicyEngine:
                     and self._cool("schedule", step)):
                 target = self._preferred_mode(edges)
                 if target != self.sched_mode:
-                    why = ("measured slow edge persists: preferring the "
-                           "cost-reweighted schedule (arXiv:2309.13541)"
-                           if target == "cost" else
-                           "consensus contracting again: restoring the "
-                           "base schedule")
+                    if target == "synthesized":
+                        why = ("measured fabric available: re-arming onto "
+                               "the synthesized bottleneck-optimal "
+                               "schedule (arXiv:2309.13541)")
+                    elif target == "cost":
+                        why = ("measured slow edge persists: preferring "
+                               "the cost-reweighted schedule "
+                               "(arXiv:2309.13541)")
+                    else:
+                        why = ("consensus contracting again: restoring "
+                               "the base schedule")
                     out.append(self._decide(
                         step, "schedule", "rearm", target, "rearm", why))
                     if target == self.base_mode:

@@ -1,0 +1,328 @@
+"""Generative schedule synthesis: bandwidth-optimal exchange schedules
+from the MEASURED fabric.
+
+The PR 9 controller *selects* among hand-built schedule modes; this
+module *generates* one.  Given a usable
+:class:`~..observability.commprof.EdgeCostMatrix` (gated through the
+same ``matrix_is_usable`` guard the controller applies to every sensing
+artifact), :func:`synthesize_schedule` emits a multi-round
+:class:`~..parallel.schedule_ir.ScheduleIR` that minimizes the
+per-round **bottleneck-edge cost** — per arXiv:2309.13541, schedules
+fitted to the measured direct-connect topology cut exchange time well
+below topology-oblivious rings — subject to the repo's matrix
+invariants (non-negativity, column-stochasticity, spectral-gap floor on
+the period product; ``schedule_ir.check_schedule_invariants``).
+
+The synthesis is deterministic greedy:
+
+1. price every measured directed edge by its largest-payload latency;
+2. keep the cheapest prefix whose union is strongly connected (the
+   minimum requirement for the period product to mix at all), then
+   extend with every edge within ``slack`` × the prefix bottleneck
+   (cheap extra edges improve the gap for free);
+3. pack the kept edges into rounds that are partial permutations —
+   at most one send and one receive per rank per round, so each round
+   is a true one-shot exchange and the round's cost is its slowest
+   edge, not a serialization artifact;
+4. weight each round by the repo's one-peer convention
+   (``1 / (in_degree + 1)``, shared with the self loop) and validate;
+   if the spectral-gap floor fails, admit the next-cheapest measured
+   edges and retry.
+
+When the matrix is refused (foreign platform, stale artifact, missing)
+or the fleet is degraded, :func:`synthesize_or_fallback` returns the
+O(1)-degree one-peer exponential family instead (arXiv:2110.13363) —
+provably convergent with zero fabric knowledge.
+
+Knobs (``BLUEFOG_SCHED_*``, docs/env_variable.md):
+``BLUEFOG_SCHED_MAX_ROUNDS``, ``BLUEFOG_SCHED_GAP_FLOOR``,
+``BLUEFOG_SCHED_SLACK``.
+"""
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import networkx as nx
+
+from ..parallel import dynamic as _dyn
+from ..parallel.schedule_ir import (
+    ScheduleIR,
+    check_schedule_invariants,
+    ir_from_matrices,
+    ir_from_one_peer,
+)
+
+__all__ = [
+    "SynthesisConfig", "synthesize_schedule", "fallback_schedule_ir",
+    "synthesize_or_fallback", "predicted_round_costs",
+    "predicted_bottleneck_us", "write_schedule_record",
+]
+
+_ENV_PREFIX = "BLUEFOG_SCHED_"
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(_ENV_PREFIX + name)
+    return int(v) if v else default
+
+
+@dataclasses.dataclass(frozen=True)
+class SynthesisConfig:
+    """Synthesizer knobs (env defaults: ``BLUEFOG_SCHED_*``).
+
+    * ``max_rounds`` — cap on the schedule period: edges that cannot be
+      packed within this many partial-permutation rounds are dropped
+      (connectivity-critical edges raise instead);
+    * ``gap_floor`` — required spectral gap of the period product;
+    * ``slack`` — edges within ``slack ×`` the connectivity bottleneck
+      latency are admitted beyond the minimal strongly-connected core.
+    """
+
+    max_rounds: int = 16
+    gap_floor: float = 1e-3
+    slack: float = 1.25
+
+    @classmethod
+    def from_env(cls) -> "SynthesisConfig":
+        return cls(
+            max_rounds=_env_int("MAX_ROUNDS", cls.max_rounds),
+            gap_floor=_env_float("GAP_FLOOR", cls.gap_floor),
+            slack=_env_float("SLACK", cls.slack),
+        )
+
+
+def _edge_latencies(matrix) -> Dict[Tuple[int, int], float]:
+    """Largest-payload latency per measured directed edge (µs)."""
+    lats: Dict[Tuple[int, int], float] = {}
+    for e in matrix.entries:
+        src, dst = int(e["src"]), int(e["dst"])
+        if src == dst:
+            continue
+        lat = matrix.latency_us(src, dst)
+        if lat is not None and np.isfinite(lat) and lat > 0:
+            lats[(src, dst)] = float(lat)
+    return lats
+
+
+def _strongly_connected(n: int, edges: Sequence[Tuple[int, int]]) -> bool:
+    g = nx.DiGraph()
+    g.add_nodes_from(range(n))
+    g.add_edges_from(edges)
+    return nx.is_strongly_connected(g)
+
+
+def _pack_rounds(n: int, edges: Sequence[Tuple[int, int]],
+                 core: frozenset, max_rounds: int
+                 ) -> List[List[Tuple[int, int]]]:
+    """First-fit edges into partial-permutation rounds (≤ 1 send and
+    ≤ 1 receive per rank per round).  Core (connectivity-critical)
+    edges that cannot be placed raise; slack edges are dropped."""
+    rounds: List[List[Tuple[int, int]]] = []
+    out_used: List[set] = []
+    in_used: List[set] = []
+    for (s, d) in edges:
+        placed = False
+        for k in range(len(rounds)):
+            if s not in out_used[k] and d not in in_used[k]:
+                rounds[k].append((s, d))
+                out_used[k].add(s)
+                in_used[k].add(d)
+                placed = True
+                break
+        if not placed:
+            if len(rounds) < max_rounds:
+                rounds.append([(s, d)])
+                out_used.append({s})
+                in_used.append({d})
+            elif (s, d) in core:
+                raise ValueError(
+                    f"cannot pack connectivity-critical edge {s}->{d} "
+                    f"within max_rounds={max_rounds}")
+    return rounds
+
+
+def synthesize_schedule(matrix, cfg: Optional[SynthesisConfig] = None,
+                        name: str = "synthesized") -> ScheduleIR:
+    """Synthesize a bottleneck-minimizing schedule from a measured
+    :class:`~..observability.commprof.EdgeCostMatrix`.
+
+    Callers must gate ``matrix`` through ``commprof.matrix_is_usable``
+    first (or use :func:`synthesize_or_fallback`, which does) — a
+    foreign-platform or stale matrix must not become a link model.
+    Raises ``ValueError`` when the measured edges cannot form a valid
+    schedule (not strongly connected, or gap floor unreachable).
+    """
+    cfg = cfg or SynthesisConfig.from_env()
+    n = int(matrix.n)
+    lats = _edge_latencies(matrix)
+    ordered = sorted(lats, key=lambda e: (lats[e], e))
+    if not _strongly_connected(n, ordered):
+        raise ValueError(
+            f"measured edges do not strongly connect all {n} ranks — "
+            "cannot synthesize a mixing schedule")
+
+    # minimal cheap prefix that strongly connects the fleet
+    lo, hi = 1, len(ordered)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _strongly_connected(n, ordered[:mid]):
+            hi = mid
+        else:
+            lo = mid + 1
+    k0 = lo
+    core = frozenset(ordered[:k0])
+    bottleneck = lats[ordered[k0 - 1]]
+    k = k0
+    while k < len(ordered) and lats[ordered[k]] <= cfg.slack * bottleneck:
+        k += 1
+
+    last_err = None
+    while True:
+        chosen = ordered[:k]
+        packed = _pack_rounds(n, chosen, core, cfg.max_rounds)
+        mats = []
+        for rnd in packed:
+            sends: List[List[int]] = [[] for _ in range(n)]
+            for s, d in rnd:
+                sends[s].append(d)
+            mats.append(_dyn.dynamic_mixing_matrix(n, sends))
+        ir = ir_from_matrices(np.stack(mats), name=name)
+        try:
+            check_schedule_invariants(ir, gap_floor=cfg.gap_floor)
+            return ir
+        except ValueError as e:
+            last_err = e
+            if k >= len(ordered):
+                raise ValueError(
+                    f"no schedule over the measured edges reaches the "
+                    f"spectral-gap floor {cfg.gap_floor:g}: {last_err}"
+                ) from None
+            k += 1  # admit the next-cheapest measured edge and retry
+
+
+def fallback_schedule_ir(topo=None, max_period: int = 4096) -> ScheduleIR:
+    """The one-peer exponential fallback over the nominal topology
+    (arXiv:2110.13363) — used whenever the measured matrix is refused
+    or the fleet is degraded.  ``topo`` defaults to the current
+    context's compiled topology."""
+    from .actuate import _digraph_of
+    if topo is None:
+        from ..context import ctx
+        topo = ctx().compiled_topology
+    return ir_from_one_peer(_digraph_of(topo), max_period=max_period,
+                            name="fallback_one_peer")
+
+
+def synthesize_or_fallback(matrix, topo=None, *,
+                           platform: Optional[str] = None,
+                           path: Optional[str] = None,
+                           cfg: Optional[SynthesisConfig] = None,
+                           degraded: bool = False
+                           ) -> Tuple[ScheduleIR, str, str]:
+    """The gated entry point: ``(ir, source, reason)``.
+
+    ``source`` is ``"synthesized"`` when the matrix passed
+    ``matrix_is_usable`` and synthesis succeeded, else ``"fallback"``
+    with ``reason`` naming the refusal (the same strings the
+    controller's artifact gate logs)."""
+    from ..observability import commprof as _commprof
+    if degraded:
+        return fallback_schedule_ir(topo), "fallback", "fleet degraded"
+    if matrix is None:
+        return fallback_schedule_ir(topo), "fallback", "no cost matrix"
+    ok, why = _commprof.matrix_is_usable(matrix, path=path,
+                                         platform=platform)
+    if not ok:
+        return fallback_schedule_ir(topo), "fallback", why
+    try:
+        return synthesize_schedule(matrix, cfg=cfg), "synthesized", ""
+    except ValueError as e:
+        return fallback_schedule_ir(topo), "fallback", str(e)
+
+
+# ---------------------------------------------------------------------------
+# Cost prediction (the bench-schedule evidence)
+# ---------------------------------------------------------------------------
+
+def predicted_round_costs(ir: ScheduleIR, matrix) -> List[float]:
+    """Per-round bottleneck-edge cost (µs) under the measured matrix.
+
+    A round's edges fire concurrently (partial permutation → one
+    ppermute family), so its cost is its SLOWEST edge; unmeasured edges
+    price at 0 (they contribute no measured evidence either way)."""
+    costs = []
+    for r in ir.rounds:
+        worst = 0.0
+        for s, d, _ in r.edges:
+            lat = matrix.latency_us(s, d)
+            if lat is not None and np.isfinite(lat):
+                worst = max(worst, float(lat))
+        costs.append(worst)
+    return costs
+
+
+def predicted_bottleneck_us(ir: ScheduleIR, matrix) -> float:
+    """The schedule's bottleneck round cost — the quantity synthesis
+    minimizes and ``make bench-schedule`` compares against the static
+    ring."""
+    costs = predicted_round_costs(ir, matrix)
+    return max(costs) if costs else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Decision-trail record
+# ---------------------------------------------------------------------------
+
+def write_schedule_record(path: str, ir: ScheduleIR, *,
+                          step: Optional[int] = None,
+                          source: str = "synthesized",
+                          reason: str = "",
+                          matrix=None) -> dict:
+    """Append one ``kind: "schedule"`` record to a decision trail.
+
+    The record carries the schedule's identity (fingerprint), shape
+    (period, offset superset, per-round edges) and — when the pricing
+    matrix is at hand — the predicted per-round costs, so a trail
+    replay can reconstruct WHY the controller armed this schedule.
+    Size-bounded by the same ``BLUEFOG_METRICS_MAX_MB`` rotation as
+    every other JSONL sink."""
+    from ..observability import export as _export
+    max_bytes, keep = _export.resolve_rotation()
+    if max_bytes:
+        try:
+            if os.path.getsize(path) >= max_bytes:
+                _export.rotate_file(path, keep)
+        except OSError:
+            pass
+    rec = {
+        "kind": "schedule",
+        "t_us": int(time.time() * 1e6),
+        "source": str(source),
+        "fingerprint": ir.fingerprint(),
+        "period": ir.period,
+        "size": ir.size,
+        "name": ir.name,
+        "offsets": list(ir.offsets()),
+        "rounds": [{"edges": [[s, d, w] for s, d, w in r.edges]}
+                   for r in ir.rounds],
+    }
+    if step is not None:
+        rec["step"] = int(step)
+    if reason:
+        rec["reason"] = str(reason)
+    if matrix is not None:
+        costs = predicted_round_costs(ir, matrix)
+        rec["round_costs_us"] = costs
+        rec["bottleneck_us"] = max(costs) if costs else 0.0
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec

@@ -598,6 +598,11 @@ _KIND_REQUIRED = {
     # and must validate with the same tool (bflint: jsonl-kind-drift).
     "report": ("t_us", "step_lo", "step_hi", "ok"),
     "verdict": ("t_us", "rule", "severity", "message"),
+    # schedule-synthesis record (control/synthesize.py
+    # write_schedule_record): the armed schedule's identity
+    # (fingerprint), shape (period, offset superset, rounds) and —
+    # when a pricing matrix was at hand — predicted per-round costs
+    "schedule": ("t_us", "source", "fingerprint", "period"),
 }
 
 _DECISION_STR_KEYS = ("knob", "action", "mode")
@@ -749,6 +754,33 @@ def _check_async(path, lineno, rec):
                     f"numeric")
 
 
+def _check_schedule(path, lineno, rec):
+    """Schedule-synthesis record shape (control/synthesize.py): the
+    armed schedule's identity and round structure.  Unknown fields stay
+    tolerated."""
+    if not isinstance(rec["source"], str):
+        raise ValueError(
+            f"{path}:{lineno}: schedule 'source' must be a string")
+    if not isinstance(rec["fingerprint"], str):
+        raise ValueError(
+            f"{path}:{lineno}: schedule 'fingerprint' must be a string")
+    v = rec["period"]
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ValueError(
+            f"{path}:{lineno}: schedule 'period' is not numeric")
+    rounds = rec.get("rounds")
+    if rounds is not None:
+        if not isinstance(rounds, list):
+            raise ValueError(
+                f"{path}:{lineno}: schedule 'rounds' must be a list")
+        for r in rounds:
+            if not isinstance(r, dict) or not isinstance(
+                    r.get("edges", []), list):
+                raise ValueError(
+                    f"{path}:{lineno}: schedule round entries must be "
+                    f"objects with an 'edges' list")
+
+
 def _check_structured(path, lineno, rec, check):
     """Shape checks for the documented structured fields: ``phases``
     (PR 7), ``step_wall_us`` (PR 7), ``edges`` and ``overlap_efficiency``
@@ -826,9 +858,10 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     checkpoint-trail lines (``kind: ckpt`` / ``ckpt_event`` /
     ``ckpt_config``, the :class:`CkptTrail` above), async-trail lines
     (``kind: async`` / ``async_config``, the :class:`AsyncTrail`
-    above), and health-verdict-trail lines (``kind: report`` /
-    ``verdict``, health.py) validate against their own required keys
-    and shape
+    above), schedule-synthesis lines (``kind: schedule``,
+    control/synthesize.py), and health-verdict-trail lines (``kind:
+    report`` / ``verdict``, health.py) validate against their own
+    required keys and shape
     instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
     keeps ``_KIND_REQUIRED`` in lockstep with every exporter.  Fields
     the schema does not know are tolerated (forward compatibility is
@@ -866,6 +899,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 _check_ckpt(path, lineno, rec)
             elif kind == "async":
                 _check_async(path, lineno, rec)
+            elif kind == "schedule":
+                _check_schedule(path, lineno, rec)
 
             def check(k, v):
                 if isinstance(v, float) and not math.isfinite(v):

@@ -1,0 +1,324 @@
+"""Schedule IR: the serializable form every exchange schedule lowers from.
+
+A *schedule* here is a periodic sequence of mixing rounds.  Each round is
+one column-stochastic matrix, but the IR stores it the way the TPU
+executes it — per-round **topology** (the directed edges that actually
+move data), the **permute offsets** those edges group into under the
+circulant decomposition (``schedule.py``), and the **weight tables**
+(edge weights + per-rank self weights).  Three properties make it the
+common construction path for every schedule in the repo:
+
+* **serializable** — ``to_json``/``from_json`` round-trip exactly (edge
+  weights ride Python floats, which serialize float64 losslessly), so a
+  synthesized schedule is an offline artifact the controller can load,
+  ``bfctl show --schedule`` can render, and a trail record can
+  fingerprint;
+* **hashable** — :meth:`ScheduleIR.fingerprint` is a content hash over
+  the canonical JSON (name excluded), giving decision trails and caches
+  a stable identity for "the same schedule";
+* **lowerable** — :func:`compile_schedule_ir` produces the repo's
+  :class:`~.schedule.DynamicSchedule` via ``compile_dynamic_matrices``,
+  and :meth:`ScheduleIR.permute_budget` predicts EXACTLY how many
+  ``ppermute`` ops that lowering traces per step (the offset superset —
+  every step pays every offset, absent edges carry zero weight), which
+  is what bflint's trace-collective-budget pass checks against the HLO.
+
+The legacy constructions (static W, the one-peer exponential family,
+the cost-reweighted W) all build through :func:`ir_from_matrix` /
+:func:`ir_from_matrices` / :func:`ir_from_one_peer` — bit-exactness with
+the pre-IR hand-built stacks is regression-tested
+(``tests/test_schedule_ir.py``).
+"""
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import dynamic as _dyn
+from .schedule import DynamicSchedule, compile_dynamic_matrices
+
+__all__ = [
+    "ScheduleRound", "ScheduleIR",
+    "ir_from_matrix", "ir_from_matrices", "ir_from_one_peer",
+    "check_matrix_invariants", "check_schedule_invariants",
+    "compile_schedule_ir",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleRound:
+    """One mixing round: directed weighted edges + per-rank self weights.
+
+    ``edges`` is a sorted tuple of ``(src, dst, weight)`` with
+    ``src != dst``; ``self_weights[i]`` is the diagonal ``W[i, i]``.
+    The matrix convention matches the rest of the repo:
+    ``W[i, j]`` = the weight receiver ``j`` applies to ``i``'s value.
+    """
+
+    edges: Tuple[Tuple[int, int, float], ...]
+    self_weights: Tuple[float, ...]
+
+    def offsets(self, size: int) -> Tuple[int, ...]:
+        """The ring offsets this round's edges decompose into."""
+        return tuple(sorted({(d - s) % size for s, d, _ in self.edges}))
+
+    def matrix(self, size: int) -> np.ndarray:
+        """This round's ``[N, N]`` mixing matrix (float64)."""
+        W = np.zeros((size, size), dtype=np.float64)
+        W[np.arange(size), np.arange(size)] = self.self_weights
+        for s, d, w in self.edges:
+            W[s, d] = w
+        return W
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ScheduleIR:
+    """A periodic exchange schedule as rounds of weighted topologies."""
+
+    size: int
+    rounds: Tuple[ScheduleRound, ...]
+    name: str = "schedule"
+
+    def __post_init__(self):
+        if not self.rounds:
+            raise ValueError("a ScheduleIR needs at least one round")
+        for r in self.rounds:
+            if len(r.self_weights) != self.size:
+                raise ValueError(
+                    f"round self_weights length {len(r.self_weights)} != "
+                    f"size {self.size}")
+
+    # -- identity -----------------------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ScheduleIR):
+            return NotImplemented
+        return self.fingerprint() == other.fingerprint()
+
+    def __hash__(self) -> int:
+        return int(self.fingerprint()[:16], 16)
+
+    def fingerprint(self) -> str:
+        """Content hash of (size, rounds) — the schedule's identity.
+
+        The ``name`` is presentation, not content: a renamed schedule
+        mixes identically, so it hashes identically."""
+        payload = json.dumps(
+            {"size": self.size, "rounds": self._rounds_payload()},
+            sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(payload.encode()).hexdigest()
+
+    # -- structure ----------------------------------------------------------
+
+    @property
+    def period(self) -> int:
+        return len(self.rounds)
+
+    def offsets(self) -> Tuple[int, ...]:
+        """The offset SUPERSET across all rounds — what the lowered
+        program traces every step (``compile_dynamic_matrices`` pays
+        every offset each step; absent edges carry zero weight)."""
+        offs = set()
+        for r in self.rounds:
+            offs.update(r.offsets(self.size))
+        return tuple(sorted(offs))
+
+    def permute_budget(self, wire_arrays: int = 1) -> int:
+        """Traced ``ppermute`` count per step per fusion bucket: one
+        permute per superset offset per wire array."""
+        return len(self.offsets()) * int(wire_arrays)
+
+    def matrices(self) -> np.ndarray:
+        """The ``[T, N, N]`` per-round mixing matrices."""
+        return np.stack([r.matrix(self.size) for r in self.rounds])
+
+    def tile(self, period: int) -> np.ndarray:
+        """The matrices tiled out to a covering period (for stacking
+        modes of different natural periods into one
+        ``SwitchableSchedule``)."""
+        if period % self.period:
+            raise ValueError(
+                f"cannot tile period-{self.period} schedule to "
+                f"{period} steps (not a multiple)")
+        return np.tile(self.matrices(), (period // self.period, 1, 1))
+
+    # -- serialization ------------------------------------------------------
+
+    def _rounds_payload(self) -> List[Dict]:
+        return [{"edges": [[s, d, w] for s, d, w in r.edges],
+                 "self_weights": list(r.self_weights)}
+                for r in self.rounds]
+
+    def asdict(self) -> Dict:
+        return {"name": self.name, "size": self.size,
+                "rounds": self._rounds_payload()}
+
+    @classmethod
+    def fromdict(cls, d: Dict) -> "ScheduleIR":
+        rounds = tuple(
+            ScheduleRound(
+                edges=tuple(sorted((int(s), int(d_), float(w))
+                                   for s, d_, w in r["edges"])),
+                self_weights=tuple(float(w) for w in r["self_weights"]))
+            for r in d["rounds"])
+        return cls(size=int(d["size"]), rounds=rounds,
+                   name=str(d.get("name", "schedule")))
+
+    def to_json(self) -> str:
+        return json.dumps(self.asdict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScheduleIR":
+        return cls.fromdict(json.loads(text))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "ScheduleIR":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Constructors: every schedule family in the repo builds through these
+# ---------------------------------------------------------------------------
+
+def _round_from_matrix(W: np.ndarray) -> ScheduleRound:
+    W = np.asarray(W, dtype=np.float64)
+    n = W.shape[0]
+    edges = []
+    for s, d in zip(*np.nonzero(W)):
+        if s != d:
+            edges.append((int(s), int(d), float(W[s, d])))
+    return ScheduleRound(edges=tuple(sorted(edges)),
+                         self_weights=tuple(float(W[i, i]) for i in range(n)))
+
+
+def ir_from_matrix(W: np.ndarray, name: str = "static") -> ScheduleIR:
+    """A single-round (period-1) schedule from one mixing matrix."""
+    W = np.asarray(W, dtype=np.float64)
+    if W.ndim != 2 or W.shape[0] != W.shape[1]:
+        raise ValueError(f"need a square matrix, got shape {W.shape}")
+    return ScheduleIR(size=W.shape[0], rounds=(_round_from_matrix(W),),
+                      name=name)
+
+
+def ir_from_matrices(mats: np.ndarray, name: str = "dynamic") -> ScheduleIR:
+    """A multi-round schedule from a ``[T, N, N]`` matrix stack."""
+    mats = np.asarray(mats, dtype=np.float64)
+    if mats.ndim != 3 or mats.shape[1] != mats.shape[2]:
+        raise ValueError(f"need a [T, N, N] stack, got shape {mats.shape}")
+    return ScheduleIR(
+        size=mats.shape[1],
+        rounds=tuple(_round_from_matrix(mats[t])
+                     for t in range(mats.shape[0])),
+        name=name)
+
+
+def ir_from_one_peer(digraph, period: Optional[int] = None,
+                     max_period: int = 4096,
+                     name: str = "one_peer") -> ScheduleIR:
+    """The O(1)-degree one-peer exponential family over ``digraph``
+    (arXiv:2110.13363) — the provably-convergent fallback schedule."""
+    size = digraph.number_of_nodes()
+    factory = _dyn.one_peer_factory(digraph)
+    if period is None:
+        period = _dyn.schedule_period(factory, size, max_period=max_period)
+    mats = _dyn.dynamic_mixing_matrices(factory, size, period)
+    return ir_from_matrices(mats, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Invariants
+# ---------------------------------------------------------------------------
+
+def check_matrix_invariants(W: np.ndarray, *,
+                            gap_floor: Optional[float] = None,
+                            atol: float = 1e-8) -> Dict[str, float]:
+    """Validate one mixing matrix against the repo's invariants.
+
+    Raises ``ValueError`` on a violation; returns measured quantities.
+
+    * non-negativity — averaging weights only;
+    * column-stochasticity — each receiver's weights sum to 1 (mass
+      conservation, the invariant every compiled topology satisfies);
+    * spectral-gap floor (optional) — ``1 - |λ₂| >= gap_floor`` so the
+      matrix actually contracts consensus distance.
+    """
+    W = np.asarray(W, dtype=np.float64)
+    if W.ndim != 2 or W.shape[0] != W.shape[1]:
+        raise ValueError(f"need a square matrix, got shape {W.shape}")
+    if (W < -atol).any():
+        i, j = np.unravel_index(int(np.argmin(W)), W.shape)
+        raise ValueError(
+            f"mixing matrix has negative weight W[{i},{j}]={W[i, j]:.3g}")
+    col = W.sum(axis=0)
+    worst = float(np.abs(col - 1.0).max())
+    if worst > atol:
+        j = int(np.argmax(np.abs(col - 1.0)))
+        raise ValueError(
+            f"mixing matrix column {j} sums to {col[j]:.6g} (not "
+            f"column-stochastic; worst deviation {worst:.3g})")
+    out = {"col_dev": worst}
+    if gap_floor is not None:
+        from ..resilience.repair import spectral_gap
+        gap = float(spectral_gap(W))
+        out["spectral_gap"] = gap
+        if gap < gap_floor:
+            raise ValueError(
+                f"spectral gap {gap:.3g} below floor {gap_floor:.3g} — "
+                f"the matrix does not contract consensus")
+    return out
+
+
+def check_schedule_invariants(ir: ScheduleIR, *,
+                              gap_floor: Optional[float] = None,
+                              atol: float = 1e-8) -> Dict[str, float]:
+    """Validate every round of a schedule, plus its period-level mixing.
+
+    Each round must be non-negative and column-stochastic.  The
+    spectral-gap floor applies to the PERIOD PRODUCT ``W_{T-1}···W_0``:
+    a single round of a multi-round schedule need not contract (a
+    one-peer round moves mass over one edge family only), but one full
+    period must.  The product of column-stochastic matrices is
+    column-stochastic, so the same gap measure applies.
+    """
+    prod = np.eye(ir.size, dtype=np.float64)
+    worst_dev = 0.0
+    for t, r in enumerate(ir.rounds):
+        W = r.matrix(ir.size)
+        try:
+            stats = check_matrix_invariants(W, gap_floor=None, atol=atol)
+        except ValueError as e:
+            raise ValueError(f"round {t}: {e}") from None
+        worst_dev = max(worst_dev, stats["col_dev"])
+        prod = prod @ W
+    out = {"col_dev": worst_dev}
+    if gap_floor is not None:
+        from ..resilience.repair import spectral_gap
+        gap = float(spectral_gap(prod))
+        out["spectral_gap"] = gap
+        if gap < gap_floor:
+            raise ValueError(
+                f"period-product spectral gap {gap:.3g} below floor "
+                f"{gap_floor:.3g} — {ir.period} round(s) do not mix")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Lowering
+# ---------------------------------------------------------------------------
+
+def compile_schedule_ir(ir: ScheduleIR) -> DynamicSchedule:
+    """Lower an IR to the executable :class:`DynamicSchedule`.
+
+    The lowered program traces ``ir.permute_budget(wire_arrays)``
+    ppermutes per step per fusion bucket — the prediction bflint's
+    trace-collective-budget pass verifies against the HLO.
+    """
+    return compile_dynamic_matrices(ir.matrices())

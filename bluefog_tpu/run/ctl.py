@@ -16,6 +16,10 @@ Modes::
                                                  # exit 1 unless the live
                                                  # trail is reproduced
     bfctl show /tmp/series_decisions.jsonl       # pretty-print a trail
+    bfctl show --schedule sched.json --edges e.json
+                                                 # render a synthesized
+                                                 # schedule's rounds +
+                                                 # predicted costs
 
 Replay semantics mirror the live hook exactly: the controller evaluates
 inside ``opt.step(t)`` — before the caller logs step t — so an
@@ -166,7 +170,76 @@ def _cmd_replay(args) -> int:
     return rc
 
 
+def _show_schedule(args) -> int:
+    """Render a synthesized schedule: rounds, offsets, and — when a
+    cost matrix is at hand — the predicted per-round bottleneck costs.
+
+    ``path`` is either a saved :class:`ScheduleIR` JSON file
+    (``ScheduleIR.save``) or a decision trail whose latest ``kind:
+    "schedule"`` record is rendered."""
+    from ..control import synthesize as SYN
+    from ..parallel.schedule_ir import ScheduleIR
+    matrix = None
+    if args.edges:
+        from ..observability.commprof import EdgeCostMatrix
+        matrix = EdgeCostMatrix.load(args.edges)
+    ir = None
+    with open(args.path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if (isinstance(doc, dict) and "rounds" in doc and "size" in doc
+            and "kind" not in doc):   # a trail record is NOT a saved IR:
+        ir = ScheduleIR.fromdict(doc)  # its rounds drop the self weights
+    else:
+        rec = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(r, dict) and r.get("kind") == "schedule":
+                rec = r
+        if rec is None:
+            print(f"no schedule record in {args.path}")
+            return 1
+        print(f"schedule {rec.get('name', '?')!r} "
+              f"source={rec.get('source')} period={rec.get('period')} "
+              f"size={rec.get('size')} offsets={rec.get('offsets')}")
+        print(f"fingerprint {rec.get('fingerprint')}")
+        if rec.get("reason"):
+            print(f"reason: {rec['reason']}")
+        costs = rec.get("round_costs_us")
+        for t, rnd in enumerate(rec.get("rounds", [])):
+            edges = " ".join(f"{s}->{d}" for s, d, _ in rnd["edges"])
+            tail = (f"  predicted {costs[t]:.1f} us"
+                    if costs and t < len(costs) else "")
+            print(f"round {t}: {edges or '(self only)'}{tail}")
+        if rec.get("bottleneck_us") is not None:
+            print(f"bottleneck: {rec['bottleneck_us']:.1f} us")
+        return 0
+    print(f"schedule {ir.name!r} period={ir.period} size={ir.size} "
+          f"offsets={list(ir.offsets())} "
+          f"permute_budget={ir.permute_budget(1)}")
+    print(f"fingerprint {ir.fingerprint()}")
+    costs = SYN.predicted_round_costs(ir, matrix) if matrix else None
+    for t, rnd in enumerate(ir.rounds):
+        edges = " ".join(f"{s}->{d}({w:.3g})" for s, d, w in rnd.edges)
+        tail = f"  predicted {costs[t]:.1f} us" if costs else ""
+        print(f"round {t}: {edges or '(self only)'}{tail}")
+    if costs:
+        print(f"bottleneck: {max(costs):.1f} us")
+    return 0
+
+
 def _cmd_show(args) -> int:
+    if args.schedule:
+        return _show_schedule(args)
     head, decisions = CTL.read_decisions(args.path)
     if head:
         print(f"config: modes={head.get('modes')} "
@@ -226,8 +299,19 @@ def main(argv=None) -> int:
     rp.add_argument("--health-window", type=int, default=None)
     rp.set_defaults(fn=_cmd_replay)
 
-    sh = sub.add_parser("show", help="pretty-print a decision trail")
+    sh = sub.add_parser(
+        "show",
+        help="pretty-print a decision trail (or, with --schedule, a "
+             "synthesized schedule)")
     sh.add_argument("path")
+    sh.add_argument("--schedule", action="store_true",
+                    help="render PATH as a schedule: a saved ScheduleIR "
+                         "JSON file, or a trail whose latest "
+                         "kind=schedule record is shown (rounds + "
+                         "predicted bottleneck cost)")
+    sh.add_argument("--edges", default=None, metavar="PATH",
+                    help="edge-cost matrix JSON pricing the rounds "
+                         "(with --schedule on a ScheduleIR file)")
     sh.set_defaults(fn=_cmd_show)
 
     args = p.parse_args(argv)

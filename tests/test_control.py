@@ -312,6 +312,58 @@ def test_full_episode_zero_step_recompiles(sink):
                                   np.asarray(rearm_p["w"]))
 
 
+def test_synthesized_hot_swap_zero_step_recompiles(sink):
+    """The PR 18 episode: a fabric-SYNTHESIZED schedule rides a
+    SwitchableSchedule slot, so arming it, falling back to the one-peer
+    dynamic mode, and re-arming are all pure virtual-step remaps —
+    zero step recompiles after warmup."""
+    from test_schedule_ir import synthetic_matrix
+    n = bf.size()
+    ir, source, _ = CTL.synthesize_or_fallback(synthetic_matrix(n=n))
+    assert source == "synthesized"
+    sw = CTL.build_switchable_schedule(synthesized=ir)
+    assert "synthesized" in sw.mode_names
+    params = global_params(n)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.0), sched=sw.sched, control=True)
+    act = CTL.Actuator(opt, schedule=sw, mode="on",
+                       initial_mode="synthesized")
+    opt.attach_controller(act)
+    state = opt.init(params)
+    p, state = opt.step(params, grads, state, 0)      # warmup build
+    before = _builds()
+    # synthesized -> fallback (dynamic) -> re-arm synthesized
+    for action, mode in (("switch", "dynamic"), ("rearm", "synthesized")):
+        assert act.apply(POL.Decision(
+            step=0, knob="schedule", action=action, value=mode,
+            prev=act.mode_name, rule="test", reason=""))
+        assert act.mode_name == mode
+        p, state = opt.step(p, grads, state, 1)
+    assert _builds() == before
+
+
+def test_policy_rearms_to_synthesized_when_fabric_measured():
+    """With a synthesized slot compiled in, a recovered fleet re-arms
+    onto it (the slot exists only because a USABLE measured matrix
+    built it) rather than the cost-reweighted or base mode."""
+    from test_schedule_ir import synthetic_matrix
+    eng = POL.PolicyEngine(
+        POL.ControlConfig(cooldown=4, rearm_after=2),
+        modes=("static", "dynamic", "cost", "synthesized"), gamma=False)
+    entries = synthetic_matrix().entries
+    assert eng._preferred_mode(entries) == "synthesized"
+    assert eng._preferred_mode(None) == "static"
+    view = _fake_view({0: [{"step": 0, "rank": 0}]})
+    d = eng.evaluate(view, _report(3, "consensus_stall"), 3, entries)
+    assert [x.value for x in d] == ["dynamic"]
+    assert eng.evaluate(view, _report(7), 7, entries) == []  # streak 1
+    out = eng.evaluate(view, _report(11), 11, entries)
+    assert [(x.knob, x.action, x.value) for x in out] == [
+        ("schedule", "rearm", "synthesized")]
+    assert "bottleneck-optimal" in out[0].reason
+
+
 # ---------------------------------------------------------------------------
 # Hysteresis / cooldown (engine level, synthetic feeds)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,346 @@
+"""Schedule IR + generative synthesizer (PR 18).
+
+The IR (``parallel/schedule_ir.py``) is the single construction path
+every exchange schedule lowers from; the synthesizer
+(``control/synthesize.py``) generates bottleneck-optimal schedules from
+the MEASURED fabric.  Covered here:
+
+* IR identity — JSON/save round-trips reproduce the fingerprint bit for
+  bit, the name is presentation (renames hash identically), content
+  changes re-hash;
+* lowering — ``compile_schedule_ir`` reproduces the IR matrices exactly
+  and its traced offset set matches ``ScheduleIR.offsets()`` /
+  ``permute_budget`` (the bflint budget contract);
+* legacy bit-exactness — the three pre-IR hand-built constructions
+  (static repeat, one-peer dynamic stack, cost-reweighted repeat) come
+  out of ``build_switchable_schedule`` BIT-IDENTICAL to the hand-built
+  arrays now that every mode routes through the IR;
+* invariants — negative weights, broken column-stochasticity, and a
+  below-floor spectral gap (per round and on the period product) raise;
+* synthesis — every emitted round is a partial permutation (≤ 1 send
+  and ≤ 1 receive per rank), the whole schedule passes the invariant
+  check at the configured gap floor, the seeded slow edge is routed
+  around, and the predicted bottleneck beats the static ring ≥ 2×
+  (the ``make bench-schedule`` acceptance bound);
+* fallback — a refused (foreign-platform / missing) matrix or a
+  degraded fleet yields the one-peer exponential family with the period
+  ``schedule_period`` computes, and disconnected measurements raise;
+* the trail record — ``write_schedule_record`` passes
+  ``validate_jsonl``, malformed records are rejected;
+* ``bfctl show --schedule`` renders both a saved IR file (with
+  ``--edges`` pricing) and the latest trail record.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import control as CTL
+from bluefog_tpu.control import synthesize as SYN
+from bluefog_tpu.observability import commprof as CPROF
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.parallel import dynamic as DYN
+from bluefog_tpu.parallel import schedule_ir as IR
+from bluefog_tpu.run import ctl as BFCTL
+
+N = 8
+SLOW_EDGE = (0, 1)
+SLOW_US = 20000.0
+
+
+def synthetic_matrix(n=N, slow=SLOW_EDGE, slow_us=SLOW_US, platform=None,
+                     ranks=None):
+    """A deterministic full-mesh cost matrix: ~10-14 µs everywhere,
+    one seeded catastrophic edge.  ``ranks`` restricts which ranks the
+    probe saw (for the disconnected-measurement case)."""
+    entries = []
+    for s in ranks or range(n):
+        for d in ranks or range(n):
+            if s == d:
+                continue
+            lat = SLOW_US if slow == (s, d) else 10.0 + (s * 7 + d * 3) % 5
+            entries.append({"src": s, "dst": d, "bytes": 4096, "rounds": 1,
+                            "inner": 2, "latency_us": lat,
+                            "gbps": 4096 * 8e-3 / lat})
+    return CPROF.EdgeCostMatrix(
+        n=n, entries=entries,
+        platform=platform if platform is not None else jax.default_backend())
+
+
+def ring_matrix(n=N):
+    W = np.zeros((n, n))
+    np.fill_diagonal(W, 0.5)
+    for i in range(n):
+        W[i, (i + 1) % n] = 0.5
+    return W
+
+
+# ---------------------------------------------------------------------------
+# IR identity + serialization
+# ---------------------------------------------------------------------------
+
+def test_ir_roundtrip_fingerprint_and_hash(tmp_path):
+    ir = IR.ir_from_matrix(ring_matrix(), name="ring")
+    # JSON round-trip is identity: same fingerprint, ==, same hash
+    back = IR.ScheduleIR.from_json(ir.to_json())
+    assert back == ir and hash(back) == hash(ir)
+    assert back.fingerprint() == ir.fingerprint()
+    np.testing.assert_array_equal(back.matrices(), ir.matrices())
+    # file round-trip too (the offline artifact path)
+    path = str(tmp_path / "sched.json")
+    ir.save(path)
+    assert IR.ScheduleIR.load(path) == ir
+    # the name is presentation, not content
+    renamed = IR.ScheduleIR(size=ir.size, rounds=ir.rounds, name="other")
+    assert renamed == ir and renamed.fingerprint() == ir.fingerprint()
+    # ...but content changes re-hash
+    other = IR.ir_from_matrix(ring_matrix() * 0.99 + 0.005)
+    assert other != ir and other.fingerprint() != ir.fingerprint()
+
+
+def test_ir_validates_shape():
+    with pytest.raises(ValueError, match="at least one round"):
+        IR.ScheduleIR(size=4, rounds=())
+    with pytest.raises(ValueError, match="self_weights"):
+        IR.ScheduleIR(size=4, rounds=(
+            IR.ScheduleRound(edges=(), self_weights=(1.0, 1.0)),))
+    with pytest.raises(ValueError, match="square"):
+        IR.ir_from_matrix(np.ones((2, 3)))
+    with pytest.raises(ValueError, match="not a multiple"):
+        IR.ir_from_matrices(np.stack([ring_matrix()] * 3)).tile(4)
+
+
+# ---------------------------------------------------------------------------
+# Lowering: matrices + the bflint permute-budget contract
+# ---------------------------------------------------------------------------
+
+def test_lowering_matches_ir_and_budget():
+    digraph = bf.topology_util.ExponentialTwoGraph(N)
+    ir = IR.ir_from_one_peer(digraph)
+    sched = IR.compile_schedule_ir(ir)
+    assert sched.period == ir.period
+    np.testing.assert_array_equal(sched.matrices, ir.matrices())
+    # the budget contract: the lowered program's offset set IS the IR's
+    # superset, so the traced ppermute count per bucket per step is
+    # exactly permute_budget(wire_arrays)
+    assert sched.offsets == ir.offsets()
+    assert ir.permute_budget(1) == len(sched.offsets)
+    assert ir.permute_budget(3) == 3 * len(sched.offsets)
+
+
+def test_offsets_are_the_superset_across_rounds():
+    n = 6
+    mats = []
+    for off in (1, 2):        # each round uses ONE distinct offset
+        W = np.zeros((n, n))
+        np.fill_diagonal(W, 0.5)
+        for i in range(n):
+            W[i, (i + off) % n] = 0.5
+        mats.append(W)
+    ir = IR.ir_from_matrices(np.stack(mats))
+    assert ir.rounds[0].offsets(n) == (1,)
+    assert ir.rounds[1].offsets(n) == (2,)
+    assert ir.offsets() == (1, 2)         # lowered program pays both
+    assert ir.permute_budget() == 2
+
+
+# ---------------------------------------------------------------------------
+# Legacy constructions: bit-exact through the IR path
+# ---------------------------------------------------------------------------
+
+def test_legacy_constructions_bit_exact(bf_ctx):
+    n = bf.size()
+    W = np.asarray(bf_ctx.compiled_topology.weight_matrix, np.float64)
+    mat = CPROF.probe_edges(sizes=(4096,), repeats=1, inner=2, export=False)
+    sw = CTL.build_switchable_schedule(cost_matrix=mat)
+    assert sw.mode_names == ("static", "dynamic", "cost")
+    T = sw.base_period
+    # the pre-IR hand-built stacks, reproduced BIT for bit (array_equal,
+    # not allclose: float64 -> float -> float64 must round-trip exactly)
+    np.testing.assert_array_equal(sw.matrices_for("static"),
+                                  np.repeat(W[None], T, 0))
+    digraph = bf.load_topology()
+    factory = DYN.one_peer_factory(digraph)
+    np.testing.assert_array_equal(
+        sw.matrices_for("dynamic"),
+        DYN.dynamic_mixing_matrices(factory, n, T))
+    Wc = CTL.reweight_matrix_by_cost(W, mat)
+    np.testing.assert_array_equal(sw.matrices_for("cost"),
+                                  np.repeat(Wc[None], T, 0))
+
+
+def test_switchable_schedule_carries_synthesized_mode(bf_ctx):
+    ir, source, _ = SYN.synthesize_or_fallback(
+        synthetic_matrix(), topo=bf_ctx.compiled_topology)
+    assert source == "synthesized"
+    sw = CTL.build_switchable_schedule(synthesized=ir)
+    assert sw.mode_names == ("static", "dynamic", "synthesized")
+    # mixed natural periods fold by lcm; the synthesized mode's rows are
+    # its IR tiled out to the shared base period, bit for bit
+    assert sw.base_period % ir.period == 0
+    np.testing.assert_array_equal(sw.matrices_for("synthesized"),
+                                  ir.tile(sw.base_period))
+    # a wrong-size IR is refused up front
+    with pytest.raises(ValueError, match="ranks"):
+        CTL.build_switchable_schedule(
+            synthesized=IR.ir_from_matrix(np.eye(3)))
+
+
+# ---------------------------------------------------------------------------
+# Invariants
+# ---------------------------------------------------------------------------
+
+def test_matrix_invariants_raise():
+    W = ring_matrix(4)
+    assert IR.check_matrix_invariants(W)["col_dev"] < 1e-12
+    bad = W.copy()
+    bad[0, 1] = -0.5
+    with pytest.raises(ValueError, match="negative"):
+        IR.check_matrix_invariants(bad)
+    with pytest.raises(ValueError, match="column"):
+        IR.check_matrix_invariants(W * 0.9)
+    # the identity mixes nothing: gap 0, below any floor
+    with pytest.raises(ValueError, match="spectral gap"):
+        IR.check_matrix_invariants(np.eye(4), gap_floor=1e-3)
+
+
+def test_schedule_invariants_cover_every_round_and_the_period_product():
+    good = IR.ir_from_matrices(np.stack([ring_matrix(4)] * 2))
+    stats = IR.check_schedule_invariants(good, gap_floor=1e-3)
+    assert stats["spectral_gap"] > 1e-3
+    # a violation names its round
+    broken = np.stack([ring_matrix(4), ring_matrix(4) * 0.9])
+    with pytest.raises(ValueError, match="round 1"):
+        IR.check_schedule_invariants(IR.ir_from_matrices(broken))
+    # per-round stochastic but the PRODUCT does not mix (all identity)
+    idle = IR.ir_from_matrices(np.stack([np.eye(4)] * 2))
+    with pytest.raises(ValueError, match="period-product"):
+        IR.check_schedule_invariants(idle, gap_floor=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Synthesis
+# ---------------------------------------------------------------------------
+
+def test_synthesized_rounds_are_valid_partial_permutations():
+    mat = synthetic_matrix()
+    ir = SYN.synthesize_schedule(mat)
+    cfg = SYN.SynthesisConfig()
+    assert 1 <= ir.period <= cfg.max_rounds
+    for r in ir.rounds:
+        sends = [s for s, _, _ in r.edges]
+        recvs = [d for _, d, _ in r.edges]
+        # a partial permutation: one shot per rank per direction, so a
+        # round's cost is its slowest edge, never a serialization chain
+        assert len(sends) == len(set(sends))
+        assert len(recvs) == len(set(recvs))
+    stats = IR.check_schedule_invariants(ir, gap_floor=cfg.gap_floor)
+    assert stats["spectral_gap"] >= cfg.gap_floor
+
+
+def test_synthesis_routes_around_the_slow_edge_and_beats_the_ring():
+    mat = synthetic_matrix()
+    ir = SYN.synthesize_schedule(mat)
+    all_edges = {(s, d) for r in ir.rounds for s, d, _ in r.edges}
+    assert SLOW_EDGE not in all_edges
+    # predicted bottleneck: the synthesized schedule prices at the fast
+    # tier; the static ring must cross the seeded slow edge
+    synth = SYN.predicted_bottleneck_us(ir, mat)
+    ring = SYN.predicted_bottleneck_us(
+        IR.ir_from_matrix(ring_matrix(), name="static_ring"), mat)
+    assert ring == pytest.approx(SLOW_US)
+    assert synth < 20.0
+    assert ring / synth >= 2.0            # the bench-schedule bound
+
+
+def test_synthesis_raises_when_measurements_cannot_connect():
+    # probe only saw ranks 0..3 of an 8-rank fleet
+    mat = synthetic_matrix(ranks=range(4))
+    with pytest.raises(ValueError, match="strongly connect"):
+        SYN.synthesize_schedule(mat)
+
+
+def test_synthesis_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_SCHED_MAX_ROUNDS", "5")
+    monkeypatch.setenv("BLUEFOG_SCHED_GAP_FLOOR", "0.01")
+    monkeypatch.setenv("BLUEFOG_SCHED_SLACK", "2.5")
+    cfg = SYN.SynthesisConfig.from_env()
+    assert (cfg.max_rounds, cfg.gap_floor, cfg.slack) == (5, 0.01, 2.5)
+
+
+# ---------------------------------------------------------------------------
+# Fallback: the one-peer exponential family behind the matrix guard
+# ---------------------------------------------------------------------------
+
+def test_fallback_on_refused_or_missing_matrix(bf_ctx):
+    topo = bf_ctx.compiled_topology
+    digraph = bf.load_topology()
+    expect = IR.ir_from_one_peer(digraph)
+    # foreign platform: the same refusal string the controller logs
+    ir, source, why = SYN.synthesize_or_fallback(
+        synthetic_matrix(platform="tpu"), topo=topo)
+    assert source == "fallback" and "tpu" in why
+    assert ir == expect
+    # the fallback period is the family's true period
+    factory = DYN.one_peer_factory(digraph)
+    assert ir.period == DYN.schedule_period(factory, bf.size())
+    # missing matrix / degraded fleet
+    ir2, source2, why2 = SYN.synthesize_or_fallback(None, topo=topo)
+    assert (source2, why2) == ("fallback", "no cost matrix")
+    ir3, source3, why3 = SYN.synthesize_or_fallback(
+        synthetic_matrix(), topo=topo, degraded=True)
+    assert (source3, why3) == ("fallback", "fleet degraded")
+    assert ir2 == expect and ir3 == expect
+    # a usable matrix synthesizes
+    ir4, source4, _ = SYN.synthesize_or_fallback(synthetic_matrix(),
+                                                 topo=topo)
+    assert source4 == "synthesized" and ir4 != expect
+
+
+# ---------------------------------------------------------------------------
+# Trail record + bfctl rendering
+# ---------------------------------------------------------------------------
+
+def test_schedule_record_validates_and_rejects_malformed(tmp_path):
+    mat = synthetic_matrix()
+    ir = SYN.synthesize_schedule(mat)
+    path = str(tmp_path / "trail.jsonl")
+    rec = SYN.write_schedule_record(path, ir, step=7, matrix=mat)
+    assert rec["fingerprint"] == ir.fingerprint()
+    assert rec["bottleneck_us"] == SYN.predicted_bottleneck_us(ir, mat)
+    records = EX.validate_jsonl(path)
+    assert [r["kind"] for r in records] == ["schedule"]
+    # a record missing its identity is rejected
+    bad = {k: v for k, v in rec.items() if k != "fingerprint"}
+    with open(path, "a") as f:
+        f.write(json.dumps(bad) + "\n")
+    with pytest.raises(ValueError, match="fingerprint"):
+        EX.validate_jsonl(path)
+
+
+def test_bfctl_show_schedule_renders_ir_and_trail(tmp_path, capsys):
+    mat = synthetic_matrix()
+    ir = SYN.synthesize_schedule(mat)
+    spath = str(tmp_path / "sched.json")
+    epath = str(tmp_path / "edges.json")
+    ir.save(spath)
+    mat.save(epath)
+    # a saved IR file, priced by --edges
+    assert BFCTL.main(["show", spath, "--schedule", "--edges", epath]) == 0
+    out = capsys.readouterr().out
+    assert ir.fingerprint() in out
+    assert "round 0:" in out and "bottleneck:" in out
+    # the latest kind=schedule trail record
+    tpath = str(tmp_path / "trail.jsonl")
+    SYN.write_schedule_record(tpath, ir, source="synthesized", matrix=mat)
+    assert BFCTL.main(["show", tpath, "--schedule"]) == 0
+    out = capsys.readouterr().out
+    assert "source=synthesized" in out and ir.fingerprint() in out
+    # no record -> exit 1
+    empty = str(tmp_path / "empty.jsonl")
+    with open(empty, "w") as f:
+        f.write(json.dumps({"kind": "decision"}) + "\n")
+    assert BFCTL.main(["show", empty, "--schedule"]) == 1
