@@ -32,7 +32,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         bench-kernel bench-schedule bench-hw hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
-        ckpt-smoke async-smoke bench-serve bench-ckpt lint
+        ckpt-smoke async-smoke plane-smoke bench-serve bench-ckpt \
+        bench-plane lint
 
 test:
 	$(PYTEST) tests/
@@ -335,6 +336,49 @@ ckpt-smoke:
 # and the real `bfmonitor --once --json` "async" block.
 async-smoke:
 	python scripts/metrics_smoke.py --async
+
+# In-band telemetry-plane smoke (docs/observability.md "In-band
+# telemetry plane"): a fact injected at one rank must propagate over
+# the fabric to every rank within the graph-diameter round bound, land
+# in a schema-valid plane trail, and round-trip through the real
+# `bfmonitor --once --json` "plane" block (per-source version/age/hop,
+# stale sources flagged against BLUEFOG_PLANE_MAX_AGE) — injection ->
+# propagation -> dashboard with no shared filesystem between ranks.
+plane-smoke:
+	python scripts/metrics_smoke.py --plane
+
+# In-band telemetry-plane gate (docs/observability.md "In-band
+# telemetry plane"; sits next to bench-kernel in the trace-gate
+# family): bench-trace JSON with the "plane" block, GATED on all four
+# acceptance invariants: (1) a new fact reaches all N ranks within the
+# topology-diameter round bound on the canonical topologies (ring and
+# one-peer exponential), (2) the plane's wire bytes per round stay
+# under 5% of the fused gossip's bytes per step (exact counts
+# reported), (3) the whole update/death/rejoin episode runs on ONE
+# compiled exchange program — zero recompiles, and (4) the plane-off
+# train-step StableHLO is byte-identical before and after a plane
+# lives in-process.
+bench-plane:
+	python bench.py --trace-only | python -c "import json,sys; \
+	d=json.load(sys.stdin); p=d['plane']; pr=p['propagation']; \
+	print(json.dumps(d)); \
+	print('plane: reach exp2 %s/%s rounds, ring %s/%s rounds | %d bytes/' \
+	      'round vs %d gossip bytes/step (%.4f) | %d compile(s) | off ' \
+	      'identical: %s' \
+	      % (pr['exp2']['rounds_to_full_reach'], pr['exp2']['diameter'], \
+	         pr['ring']['rounds_to_full_reach'], pr['ring']['diameter'], \
+	         p['wire_bytes_per_round'], \
+	         p['gossip_ppermute_bytes_per_step'], p['overhead_fraction'], \
+	         p['step_compiles'], p['off_identical'])); \
+	assert all(t['within_bound'] for t in pr.values()), \
+	       'plane propagation exceeded the diameter bound: %s' % pr; \
+	assert p['overhead_fraction'] <= 0.05, \
+	       'plane overhead %.4f > 5%% of gossip wire bytes' \
+	       % p['overhead_fraction']; \
+	assert p['step_compiles'] == 1, \
+	       '%d exchange compiles across update/death/rejoin' \
+	       % p['step_compiles']; \
+	assert p['off_identical'], 'plane-off StableHLO drifted'"
 
 # Serving-tier bench (docs/serving.md): the end-to-end scenario on the
 # virtual mesh — one JSON line with requests/sec, staleness p50/p95/p99

@@ -779,6 +779,86 @@ def trace_only_main():
         },
     }
 
+    # In-band telemetry plane evidence (docs/observability.md "In-band
+    # telemetry plane"): four gates `make bench-plane` asserts.  (a) a
+    # fact injected at one rank reaches all N ranks within the topology
+    # diameter on the canonical topologies (ring and one-peer
+    # exponential); (b) the plane's wire bytes per round are a small
+    # fixed fraction of the fused gossip's bytes per step, exact counts
+    # reported; (c) one compiled exchange program survives updates,
+    # death, and rejoin — zero recompiles; (d) the train step's
+    # StableHLO with the plane OFF is byte-identical before and after a
+    # plane lives in-process (the plane is a separate program, never a
+    # train-step edit).
+    from bluefog_tpu.observability import plane as plane_mod
+
+    def _plane_off_text():
+        step = T.make_train_step(model, base,
+                                 communication="neighbor_allreduce",
+                                 fuse=True, donate=False)
+        text, _ = TM.lower_text(step, variables, opt_state, (x, y),
+                                jnp.int32(0))
+        return text
+
+    plane_pre_text = _plane_off_text()
+
+    plane_propagation = {}
+    for tlabel, ptopo in (
+            ("exp2", cx.compiled_topology),
+            ("ring", _ct(sched_topo_mod.RingGraph(n)))):
+        bound = plane_mod.diameter(ptopo)
+        pstate = plane_mod.init_state(n)
+        ppay = np.stack([plane_mod.pack_payload(0) for _ in range(n)])
+        rounds_needed = None
+        for rnd in range(1, bound + 1):
+            pstate = plane_mod.exchange(pstate, ppay, 0, topo=ptopo)
+            versions = np.asarray(
+                pstate["table"])[:, :, plane_mod.LANE_VERSION]
+            if (versions > 0).all():
+                rounds_needed = rnd
+                break
+        plane_propagation[tlabel] = {
+            "diameter": bound,
+            "rounds_to_full_reach": rounds_needed,
+            "within_bound": (rounds_needed is not None
+                             and rounds_needed <= bound),
+        }
+
+    # churn episode on the context topology: updates, a death, an
+    # elastic rejoin at a higher step — all traced data, ONE program
+    tplane = plane_mod.TelemetryPlane(rank=0)
+    pactive = np.ones((n,), np.float32)
+    for pstep in range(3):
+        tplane.publish(np.stack([plane_mod.pack_payload(pstep)
+                                 for _ in range(n)]), pstep)
+    pactive[2] = 0.0
+    tplane.publish(np.stack([plane_mod.pack_payload(3)
+                             for _ in range(n)]), 3, active=pactive)
+    pactive[2] = 1.0
+    tplane.publish(np.stack([plane_mod.pack_payload(9)
+                             for _ in range(n)]), 9, active=pactive)
+    plane_fn = plane_mod._plane_fn(cx.rank_axis, cx.compiled_topology,
+                                   id(cx.mesh))
+    plane_compiles = plane_fn._cache_size()
+
+    plane_post_text = _plane_off_text()
+    plane_bytes = plane_mod.wire_bytes_per_round(cx.compiled_topology)
+    gossip_bytes = report["fused"]["ppermute_bytes"]
+    plane_report = {
+        "schema_version": plane_mod.SCHEMA_VERSION,
+        "wire_lanes": plane_mod.WIRE,
+        "propagation": plane_propagation,
+        "permutes_per_round":
+            plane_mod.permutes_per_round(cx.compiled_topology),
+        "wire_bytes_per_round": plane_bytes,
+        "gossip_ppermute_bytes_per_step": gossip_bytes,
+        "overhead_fraction": round(plane_bytes / max(gossip_bytes, 1), 6),
+        "step_compiles": plane_compiles,
+        "off_identical": plane_post_text == plane_pre_text,
+        "off_stablehlo_sha256":
+            hashlib.sha256(plane_post_text.encode()).hexdigest(),
+    }
+
     out = {
         "mode": "trace-only",
         "metric": "train_step_collective_counts",
@@ -804,6 +884,7 @@ def trace_only_main():
         "hybrid_bytes_drop": hybrid_drop,
         "kernel": kernel_report,
         "schedule": schedule_report,
+        "plane": plane_report,
         # final host-registry snapshot: comm-volume, fusion-plan shape and
         # cache stats travel WITH the perf number in the BENCH_*.json
         "metrics": bf_metrics.registry.snapshot(),

@@ -26,7 +26,11 @@ different backend (``platform`` mismatch) or written before this run
 started (stale mtime) is refused with a counter
 (``bf_control_refused_matrix_total``) instead of silently becoming a
 link model.  Edge records riding the telemetry JSONL carry their
-``edges_platform`` and are gated the same way.
+``edges_platform`` and are gated the same way — as are edge rows that
+arrived over the fabric: ``evaluate_plane`` senses from the in-band
+telemetry plane's local view, and its plane-gossiped matrix passes the
+identical ``matrix_is_usable`` gate with plane age as the freshness
+bound (docs/observability.md "In-band telemetry plane").
 
 Because the hook runs INSIDE ``opt.step(t)`` — before the caller logs
 step t — an evaluation at step t sees records ``<= t-1``.  ``bfctl
@@ -143,13 +147,39 @@ class Controller(_actuate.Actuator):
         logging.getLogger("bluefog").warning(
             "controller refused edge matrix: %s", why)
 
+    def _plane_edges(self, view) -> Optional[list]:
+        """Edge entries assembled from plane-gossiped rows, admitted
+        through the SAME ``matrix_is_usable`` gate as a file artifact —
+        platform must match the live backend, and the oldest live
+        source's plane age is the freshness bound (fabric rows have no
+        mtime)."""
+        from ..observability import commprof as CPROF
+        from ..observability import plane as PLANE
+        matrix = PLANE.matrix_from_view(view)
+        if matrix is None:
+            return None
+        ages = [m["age"] for m in view.per_source.values()
+                if not m["stale"]]
+        ok, why = CPROF.matrix_is_usable(
+            matrix, platform=self._live_platform(),
+            age_steps=max(ages, default=0))
+        if not ok:
+            self._refuse_matrix(why)
+            return None
+        return matrix.entries
+
     def _edges(self, view) -> Optional[list]:
         """Measured edge entries for the policy: the gated artifact
-        first, else the newest in-series record — gated on its recorded
+        first, then (on a plane-backed view) the plane-gossiped matrix,
+        else the newest in-series record — gated on its recorded
         ``edges_platform`` the same way."""
         entries = self._artifact()
         if entries is not None:
             return entries
+        if hasattr(view, "per_source"):
+            entries = self._plane_edges(view)
+            if entries is not None:
+                return entries
         latest = view.latest_edges()
         if not latest:
             return None
@@ -175,6 +205,18 @@ class Controller(_actuate.Actuator):
                              cache=self._cache)
         report = H.evaluate(view, self.health_cfg)
         self.evaluate_once(view, report, step)
+
+    def evaluate_plane(self, view, step: Optional[int] = None) -> list:
+        """One policy pass off the in-band telemetry plane's local
+        fleet view (``observability.plane.FleetViewLive``) instead of
+        JSONL files on disk — the multi-host sensing path: health is
+        evaluated over the gossiped series, and plane-borne edge rows
+        reach the policy through :meth:`_plane_edges`'s
+        ``matrix_is_usable`` gate."""
+        if step is None:
+            step = view.plane_step
+        report = H.evaluate(view, self.health_cfg)
+        return self.evaluate_once(view, report, int(step))
 
     def evaluate_once(self, view, report, step: int) -> list:
         """One explicit policy pass (the hook's body; also the entry

@@ -31,6 +31,11 @@ Cooperating pieces (docs/observability.md):
   pipeline.
 * ``tracemerge`` — ``bftrace``: merge N per-rank Chrome traces into one
   clock-aligned fleet trace with cross-rank gossip flow arrows.
+* ``plane``     — the in-band telemetry plane: a fixed-shape versioned
+  per-rank health vector gossiped over the fabric itself (newest-version
+  -wins merge, graph-diameter propagation bound), giving every rank an
+  eventually-consistent ``FleetViewLive`` with no shared filesystem and
+  no central collector.
 
 Only ``metrics`` loads eagerly (it is stdlib-only and imported from
 hot-path modules — fusion, windows, service, timeline); everything else
@@ -43,7 +48,7 @@ import importlib
 from . import metrics
 
 _LAZY = ("ingraph", "export", "phases", "aggregate", "health", "commprof",
-         "tracemerge")
+         "tracemerge", "plane")
 
 __all__ = ["metrics", *_LAZY]
 

@@ -166,7 +166,9 @@ class EdgeCostMatrix:
 def matrix_is_usable(matrix: EdgeCostMatrix, *,
                      path: Optional[str] = None,
                      platform: Optional[str] = None,
-                     run_epoch: Optional[float] = None
+                     run_epoch: Optional[float] = None,
+                     age_steps: Optional[int] = None,
+                     max_age_steps: Optional[int] = None
                      ) -> Tuple[bool, str]:
     """Gate a sensing artifact before anything ACTS on it: ``(ok,
     reason)``.
@@ -180,11 +182,18 @@ def matrix_is_usable(matrix: EdgeCostMatrix, *,
     refused too: a file left behind by a previous run describes a fleet
     that no longer exists.
 
+    A matrix that arrived OVER THE FABRIC instead of a file — the
+    telemetry plane's gossiped edge-cost rows
+    (``observability.plane.matrix_from_view``) — has no mtime; its
+    freshness is the plane age of the rows it was assembled from.  Pass
+    that as ``age_steps``: ages beyond ``max_age_steps`` (default
+    ``BLUEFOG_PLANE_MAX_AGE``) are refused exactly like a stale file.
+
     ``platform`` defaults to the live JAX backend.  This is the shared
-    guard the closed-loop controller (``control/``), ``bfctl``, and any
-    schedule optimizer must route matrices through — ``bench.py
-    --profile-edges`` documents the synthetic-matrix hazard; this
-    enforces it."""
+    guard the closed-loop controller (``control/``), ``bfctl``, the
+    serving router, and any schedule optimizer must route matrices
+    through — ``bench.py --profile-edges`` documents the
+    synthetic-matrix hazard; this enforces it."""
     if platform is None:
         import jax
         platform = jax.default_backend()
@@ -206,6 +215,14 @@ def matrix_is_usable(matrix: EdgeCostMatrix, *,
             return False, (f"artifact mtime predates this run by "
                            f"{run_epoch - mtime:.0f}s — stale link "
                            f"costs from a previous fleet")
+    if age_steps is not None:
+        if max_age_steps is None:
+            from . import plane as _plane
+            max_age_steps = _plane.resolve_max_age()
+        if age_steps > max_age_steps:
+            return False, (f"plane-gossiped rows are {age_steps} steps "
+                           f"old (bound {max_age_steps}) — stale link "
+                           f"costs from sources that stopped advancing")
     return True, "ok"
 
 

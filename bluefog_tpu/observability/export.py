@@ -35,6 +35,7 @@ __all__ = [
     "MEMBERSHIP_SUFFIX", "MembershipTrail", "read_membership_trail",
     "CKPT_SUFFIX", "CkptTrail", "read_ckpt_trail",
     "ASYNC_SUFFIX", "AsyncTrail", "read_async_trail",
+    "PLANE_SUFFIX", "PlaneTrail", "read_plane_trail",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -291,6 +292,38 @@ def read_async_trail(path: str):
     """Tolerant reader: ``(config_record_or_None, records)`` — the same
     contract as the other sidecar trails."""
     return read_trail(path, "async_config")
+
+
+# -- in-band telemetry-plane trail (observability/plane.py's sink) -----------
+
+PLANE_SUFFIX = "plane.jsonl"
+
+
+class PlaneTrail(Trail):
+    """Sidecar JSONL for the in-band telemetry plane
+    (``<prefix>plane.jsonl``): a ``plane_config`` head record (fleet
+    size, wire schema version/width, the staleness cap), then one
+    ``plane`` record per local observation — the observer's step and a
+    per-source list of ``{rank, step, version, age, hop, stale}`` merge
+    metadata.  This trail records ONE rank's eventually-consistent view
+    of the gossiped table (there is no central collector to log from);
+    ``bfmonitor --plane`` renders it and ``validate_jsonl`` gates it
+    (docs/observability.md "In-band telemetry plane")."""
+
+    def __init__(self, path: str, *, size: int, rank: int = 0,
+                 schema_version: int = 1, wire: int = 0,
+                 max_age: int = 0):
+        super().__init__(path, head_kind="plane_config")
+        self.write({"kind": "plane_config", "size": int(size),
+                    "rank": int(rank),
+                    "schema_version": int(schema_version),
+                    "wire": int(wire), "max_age": int(max_age)})
+
+
+def read_plane_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as the other sidecar trails."""
+    return read_trail(path, "plane_config")
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -592,6 +625,13 @@ _KIND_REQUIRED = {
     # watermark, and the push-sum P spread (docs/async.md)
     "async_config": ("t_us",),
     "async": ("step", "t_us", "active", "staleness_max"),
+    # in-band telemetry-plane trail (PlaneTrail above, fed by
+    # observability/plane.py's TelemetryPlane): a config head with the
+    # wire-schema identity, then one record per local observation
+    # carrying the per-source merge metadata (version/age/hop/stale) of
+    # this rank's gossiped fleet view
+    "plane_config": ("t_us",),
+    "plane": ("step", "t_us", "sources"),
     # health verdict trail (observability/health.py write_verdicts): one
     # "report" summary line per evaluation window, then one "verdict"
     # line per finding.  The trail shares this module's rotation policy
@@ -754,6 +794,32 @@ def _check_async(path, lineno, rec):
                     f"numeric")
 
 
+def _check_plane(path, lineno, rec):
+    """Plane-trail record shape (PlaneTrail): one local observation of
+    the gossiped table — a per-source list of merge metadata.  Unknown
+    fields stay tolerated."""
+    sources = rec["sources"]
+    if not isinstance(sources, list):
+        raise ValueError(
+            f"{path}:{lineno}: plane 'sources' must be a list")
+    for s in sources:
+        if not isinstance(s, dict):
+            raise ValueError(
+                f"{path}:{lineno}: plane 'sources' entries must be "
+                f"objects")
+        for field in ("rank", "step", "version", "age", "hop"):
+            v = s.get(field)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: plane source field {field!r} is "
+                    f"not numeric")
+        stale = s.get("stale")
+        if stale is not None and not isinstance(stale, bool):
+            raise ValueError(
+                f"{path}:{lineno}: plane source field 'stale' must be "
+                f"a bool")
+
+
 def _check_schedule(path, lineno, rec):
     """Schedule-synthesis record shape (control/synthesize.py): the
     armed schedule's identity and round structure.  Unknown fields stay
@@ -858,9 +924,10 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
     checkpoint-trail lines (``kind: ckpt`` / ``ckpt_event`` /
     ``ckpt_config``, the :class:`CkptTrail` above), async-trail lines
     (``kind: async`` / ``async_config``, the :class:`AsyncTrail`
-    above), schedule-synthesis lines (``kind: schedule``,
-    control/synthesize.py), and health-verdict-trail lines (``kind:
-    report`` / ``verdict``, health.py) validate against their own
+    above), plane-trail lines (``kind: plane`` / ``plane_config``, the
+    :class:`PlaneTrail` above), schedule-synthesis lines (``kind:
+    schedule``, control/synthesize.py), and health-verdict-trail lines
+    (``kind: report`` / ``verdict``, health.py) validate against their own
     required keys and shape
     instead — ``bflint``'s jsonl-kind-drift rule derives both sides and
     keeps ``_KIND_REQUIRED`` in lockstep with every exporter.  Fields
@@ -899,6 +966,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 _check_ckpt(path, lineno, rec)
             elif kind == "async":
                 _check_async(path, lineno, rec)
+            elif kind == "plane":
+                _check_plane(path, lineno, rec)
             elif kind == "schedule":
                 _check_schedule(path, lineno, rec)
 

@@ -1,0 +1,635 @@
+"""In-band telemetry plane: gossip the fleet's health over the fabric.
+
+Every sensing surface before this PR (health engine, edge profiler,
+controller, router liveness) read per-rank JSONL files off one process's
+filesystem — a centralized monitor bolted onto a decentralized system.
+This module moves that state onto the fabric itself: each rank packs a
+fixed-shape telemetry vector (step counter, heartbeat, consensus
+residual, staleness watermark, health-verdict bits, its top-k measured
+edge costs) into one f32 wire slot and disseminates it with the same
+circulant ``ppermute`` exchanges the neighbor collectives use.  A
+newest-version-wins merge per SOURCE row makes every rank's local table
+eventually consistent: a fact injected anywhere reaches all N ranks
+within graph-diameter rounds (O(log N) on the one-peer exponential
+family), with no shared filesystem and no central collector.
+
+Wire schema (``SCHEMA_VERSION`` 1) — one ``[WIRE]`` f32 row per source:
+
+====================  =====================================================
+lane                  meaning
+====================  =====================================================
+``SLOT_STEP``         source's own step counter
+``SLOT_HEARTBEAT``    source's heartbeat tick (its local step clock)
+``SLOT_CONSENSUS``    consensus residual (``UNMEASURED`` = -1 when none)
+``SLOT_STALENESS``    source's staleness watermark (async/serving lag)
+``SLOT_HEALTH``       packed health-verdict bits (:func:`pack_health_bits`)
+``SLOT_EDGE_*``       provenance (platform code, probe step) + ``EDGE_K``
+                      ``(dst, latency_us)`` pairs: the source's slowest
+                      measured out-edges
+``LANE_VERSION``      per-source version (publisher step + 1; 0 = never
+                      heard).  Strictly-greater wins on merge.
+``LANE_HOP``          hops this copy travelled from its source
+====================  =====================================================
+
+All lanes ride one f32 array, so integers are exact up to 2**24 — at
+one version per step that is ~16M steps before wraparound, checked in
+:func:`pack_payload`.
+
+Dissemination and merge are ONE jitted shard_map program per (axis,
+topology, mesh) — ``step``/``payload``/``active``/``link_ok`` are traced
+data, so plane updates, rank death, and elastic re-join never recompile
+(``_plane_fn(...)._cache_size() == 1`` is asserted in tests and ``make
+bench-plane``).  With the plane off the program is never built, so the
+train step's StableHLO is byte-identical to a plane-free process.
+
+Dead sources age out: each rank tracks ``last_heard[src]`` (the local
+step at which ``src``'s row last advanced); ages beyond
+``BLUEFOG_PLANE_MAX_AGE`` flag the source stale in
+:class:`FleetViewLive` and ``bfmonitor --plane``.  A rank that dies and
+elastically re-joins publishes at its (higher) current step, so its
+version resumes above every stale copy still circulating.
+
+Consumers (docs/observability.md "In-band telemetry plane"):
+``health.evaluate`` accepts the plane-backed :class:`FleetViewLive`
+(it IS a FleetView), the serving router takes liveness/staleness from
+:meth:`RequestRouter.observe_plane`, and the controller admits a
+plane-gossiped edge-cost row via :func:`matrix_from_view` behind the
+``commprof.matrix_is_usable`` gate.
+"""
+
+import functools
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.schedule import CompiledTopology
+from . import aggregate as AG
+from . import metrics as _metrics
+
+__all__ = [
+    "SCHEMA_VERSION", "EDGE_K", "WIDTH", "WIRE",
+    "SLOT_STEP", "SLOT_HEARTBEAT", "SLOT_CONSENSUS", "SLOT_STALENESS",
+    "SLOT_HEALTH", "SLOT_EDGE_PLATFORM", "SLOT_EDGE_STEP", "SLOT_EDGES",
+    "LANE_VERSION", "LANE_HOP",
+    "MAX_AGE_ENV", "WINDOW_ENV",
+    "resolve_max_age", "resolve_window",
+    "platform_code", "platform_name",
+    "pack_health_bits", "unpack_health_bits",
+    "top_edges", "pack_payload", "decode_row",
+    "init_state", "plane_exchange", "exchange",
+    "permutes_per_round", "wire_bytes_per_round", "diameter",
+    "snapshot", "TelemetryPlane", "FleetViewLive", "matrix_from_view",
+]
+
+SCHEMA_VERSION = 1
+
+# -- wire layout -------------------------------------------------------------
+
+EDGE_K = 4                       # slowest measured out-edges carried
+
+SLOT_STEP = 0
+SLOT_HEARTBEAT = 1
+SLOT_CONSENSUS = 2
+SLOT_STALENESS = 3
+SLOT_HEALTH = 4
+SLOT_EDGE_PLATFORM = 5
+SLOT_EDGE_STEP = 6
+SLOT_EDGES = 7                   # then EDGE_K x (dst, latency_us) pairs
+
+WIDTH = SLOT_EDGES + 2 * EDGE_K  # payload lanes a publisher fills
+LANE_VERSION = WIDTH             # appended by the exchange program
+LANE_HOP = WIDTH + 1
+WIRE = WIDTH + 2                 # full per-source wire row
+
+_F32_EXACT = float(1 << 24)      # integer lanes stay exact below this
+
+# mirrors health.UNMEASURED: "this step measured no consensus distance"
+UNMEASURED = -1.0
+
+MAX_AGE_ENV = "BLUEFOG_PLANE_MAX_AGE"
+WINDOW_ENV = "BLUEFOG_PLANE_WINDOW"
+
+
+def resolve_max_age(value: Optional[int] = None) -> int:
+    """``BLUEFOG_PLANE_MAX_AGE`` (default 8): steps since a source's row
+    last advanced before the local view flags it stale (dead sources age
+    out; ``bfmonitor --plane`` marks them)."""
+    age = int(os.environ.get(MAX_AGE_ENV, "8") if value is None else value)
+    if age < 1:
+        raise ValueError(f"plane max age must be >= 1, got {age}")
+    return age
+
+
+def resolve_window(value: Optional[int] = None) -> int:
+    """``BLUEFOG_PLANE_WINDOW`` (default 32): per-source snapshots the
+    local :class:`TelemetryPlane` history retains for the health engine's
+    trailing-window rules."""
+    win = int(os.environ.get(WINDOW_ENV, "32") if value is None else value)
+    if win < 2:
+        raise ValueError(f"plane window must be >= 2, got {win}")
+    return win
+
+
+# -- platform provenance codes ----------------------------------------------
+
+_PLATFORM_CODES = {"cpu": 1, "gpu": 2, "cuda": 2, "rocm": 2, "tpu": 3}
+_PLATFORM_NAMES = {1: "cpu", 2: "gpu", 3: "tpu"}
+
+
+def platform_code(name: Optional[str]) -> int:
+    """Platform -> wire code (0 = unknown/absent: consumers must refuse)."""
+    return _PLATFORM_CODES.get((name or "").lower(), 0)
+
+
+def platform_name(code: float) -> Optional[str]:
+    return _PLATFORM_NAMES.get(int(code))
+
+
+# -- health-verdict bits -----------------------------------------------------
+
+HEALTH_ALERT_BIT = 1             # any warn/critical verdict
+HEALTH_CRITICAL_BIT = 2
+HEALTH_CONSENSUS_BIT = 4         # consensus_stall / consensus_diverge
+HEALTH_STRAGGLER_BIT = 8
+HEALTH_DEAD_RANK_BIT = 16
+
+_HEALTH_RULE_BITS = {
+    "consensus_stall": HEALTH_CONSENSUS_BIT,
+    "consensus_diverge": HEALTH_CONSENSUS_BIT,
+    "straggler": HEALTH_STRAGGLER_BIT,
+    "dead_rank": HEALTH_DEAD_RANK_BIT,
+    "rank_silent": HEALTH_DEAD_RANK_BIT,
+}
+
+
+def pack_health_bits(report) -> int:
+    """Compress a :class:`health.HealthReport` into the wire bitfield."""
+    bits = 0
+    for v in report.alerts:
+        bits |= HEALTH_ALERT_BIT
+        if v.severity == "critical":
+            bits |= HEALTH_CRITICAL_BIT
+        bits |= _HEALTH_RULE_BITS.get(v.rule, 0)
+    return bits
+
+
+def unpack_health_bits(bits: float) -> Dict[str, bool]:
+    b = int(bits)
+    return {
+        "alert": bool(b & HEALTH_ALERT_BIT),
+        "critical": bool(b & HEALTH_CRITICAL_BIT),
+        "consensus": bool(b & HEALTH_CONSENSUS_BIT),
+        "straggler": bool(b & HEALTH_STRAGGLER_BIT),
+        "dead_rank": bool(b & HEALTH_DEAD_RANK_BIT),
+    }
+
+
+# -- payload packing ---------------------------------------------------------
+
+def top_edges(matrix, rank: int, k: int = EDGE_K
+              ) -> List[Tuple[int, float]]:
+    """``rank``'s ``k`` slowest measured out-edges from an
+    :class:`~bluefog_tpu.observability.commprof.EdgeCostMatrix` as
+    ``(dst, latency_us)`` pairs — the fixed-shape fragment the plane can
+    afford to carry (the full matrix is O(N^2))."""
+    worst: Dict[int, float] = {}
+    for e in matrix.entries:
+        if int(e["src"]) != int(rank):
+            continue
+        dst = int(e["dst"])
+        us = float(e["latency_us"])
+        if dst not in worst or us > worst[dst]:
+            worst[dst] = us
+    mine = sorted(worst.items(), key=lambda p: (-p[1], p[0]))
+    return [(d, us) for d, us in mine[:k]]
+
+
+def pack_payload(step: int, *,
+                 heartbeat: Optional[int] = None,
+                 consensus_dist: float = UNMEASURED,
+                 staleness: float = 0.0,
+                 health_bits: int = 0,
+                 edges: Optional[Sequence[Tuple[int, float]]] = None,
+                 edge_platform: Optional[str] = None,
+                 edge_step: Optional[int] = None) -> np.ndarray:
+    """One rank's ``[WIDTH]`` payload row.
+
+    ``edges`` is the :func:`top_edges` fragment; empty pairs encode
+    ``dst = -1``.  Integer lanes must stay f32-exact (< 2**24)."""
+    step = int(step)
+    if not 0 <= step < _F32_EXACT:
+        raise ValueError(f"plane step {step} outside exact f32 range")
+    row = np.zeros((WIDTH,), np.float32)
+    row[SLOT_STEP] = step
+    row[SLOT_HEARTBEAT] = step if heartbeat is None else int(heartbeat)
+    row[SLOT_CONSENSUS] = float(consensus_dist)
+    row[SLOT_STALENESS] = float(staleness)
+    row[SLOT_HEALTH] = int(health_bits)
+    row[SLOT_EDGE_PLATFORM] = platform_code(edge_platform)
+    row[SLOT_EDGE_STEP] = int(edge_step if edge_step is not None else step)
+    row[SLOT_EDGES:SLOT_EDGES + 2 * EDGE_K:2] = -1.0
+    for i, (dst, us) in enumerate(list(edges or [])[:EDGE_K]):
+        row[SLOT_EDGES + 2 * i] = int(dst)
+        row[SLOT_EDGES + 2 * i + 1] = float(us)
+    return row
+
+
+def decode_row(row, *, rank: Optional[int] = None) -> dict:
+    """One wire row back into a record dict (plus ``edges`` /
+    ``edges_platform`` when the source carried a measured fragment)."""
+    row = np.asarray(row, np.float32)
+    rec = {
+        "step": int(row[SLOT_STEP]),
+        "heartbeat": int(row[SLOT_HEARTBEAT]),
+        "consensus_dist": float(row[SLOT_CONSENSUS]),
+        "staleness": float(row[SLOT_STALENESS]),
+        "plane_health": int(row[SLOT_HEALTH]),
+        "plane_version": int(row[LANE_VERSION]),
+        "plane_hop": int(row[LANE_HOP]),
+    }
+    if rank is not None:
+        rec["rank"] = int(rank)
+    pname = platform_name(row[SLOT_EDGE_PLATFORM])
+    pairs = []
+    for i in range(EDGE_K):
+        dst = int(row[SLOT_EDGES + 2 * i])
+        if dst >= 0:
+            pairs.append((dst, float(row[SLOT_EDGES + 2 * i + 1])))
+    if pname and pairs and rank is not None:
+        rec["edges"] = [{"src": int(rank), "dst": d, "latency_us": us,
+                         "bytes": 0, "rounds": 0, "inner": 0, "gbps": 0.0}
+                        for d, us in pairs]
+        rec["edges_platform"] = pname
+        rec["edges_step"] = int(row[SLOT_EDGE_STEP])
+    return rec
+
+
+# -- state + cost model ------------------------------------------------------
+
+def init_state(size: int) -> Dict[str, jnp.ndarray]:
+    """Fresh plane state: nobody has heard anything (version 0
+    everywhere).  ``table[j]`` is rank j's local view of all N sources;
+    ``last_heard[j, s]`` the local step at which source s's row last
+    advanced in j's view."""
+    return {"table": jnp.zeros((size, size, WIRE), jnp.float32),
+            "last_heard": jnp.zeros((size, size), jnp.int32)}
+
+
+def permutes_per_round(topo: CompiledTopology) -> int:
+    """Collective-permutes one exchange round issues: exactly one per
+    circulant offset (the bflint plane-on budget and the ``bench-plane``
+    overhead gate both count from here)."""
+    return len(topo.shifts)
+
+
+def wire_bytes_per_round(topo: CompiledTopology) -> int:
+    """Bytes each rank sends per exchange round: the whole ``[N, WIRE]``
+    f32 table once per offset."""
+    return permutes_per_round(topo) * topo.size * WIRE * 4
+
+
+def diameter(topo: CompiledTopology) -> int:
+    """Hop-count diameter of the topology's edge graph — the propagation
+    bound: a fact injected anywhere is fleet-wide within this many
+    rounds (infinity encoded as ``topo.size`` when disconnected)."""
+    n = topo.size
+    adj = (np.asarray(topo.weight_matrix) != 0)
+    np.fill_diagonal(adj, True)
+    reach = np.eye(n, dtype=bool)
+    for rounds in range(1, n + 1):
+        nxt = reach @ adj
+        if nxt.all():
+            return rounds
+        if (nxt == reach).all():
+            return n                      # disconnected: never converges
+        reach = nxt
+    return n
+
+
+# -- the exchange program ----------------------------------------------------
+
+def plane_exchange(table, last_heard, axis_name, topo: CompiledTopology,
+                   step, payload, active, link_ok):
+    """One plane round for this rank: stamp own row, then per circulant
+    offset ppermute the whole table and adopt strictly-newer source rows
+    (hop + 1).  Axis-level — call inside an existing shard_map to
+    piggyback on a training exchange, or through :func:`exchange` for
+    the dedicated program.
+
+    ``table``: [N, WIRE] local view.  ``last_heard``: [N] int32.
+    ``payload``: [WIDTH] own telemetry.  ``active`` ([N]) and
+    ``link_ok`` ([N, N]) are traced masks exactly as in
+    ``resilience.membership.gossip_last_heard`` — dead senders and
+    dropped links contribute nothing, so their sources age out."""
+    from ..ops.collectives import _rotation_pairs
+    size = topo.size
+    idx = lax.axis_index(axis_name)
+    stepi = jnp.asarray(step, jnp.int32)
+    stepf = stepi.astype(jnp.float32)
+    ar = jnp.arange(size)
+
+    # own row: version = step + 1 (monotone with the step clock; 0 means
+    # "never heard").  Only a participating rank stamps — a dead rank's
+    # version freezes, which is exactly how it ages out everywhere.
+    own = jnp.concatenate([
+        jnp.asarray(payload, jnp.float32),
+        jnp.stack([stepf + 1.0, jnp.float32(0.0)])])
+    me_active = active[idx] > 0
+    newer_self = own[LANE_VERSION] > table[idx, LANE_VERSION]
+    stamp = me_active & newer_self
+    table = table.at[idx].set(jnp.where(stamp, own, table[idx]))
+    advanced = stamp & (ar == idx)
+
+    for shift in topo.shifts:
+        received = lax.ppermute(table, axis_name,
+                                _rotation_pairs(size, shift.offset))
+        src = (idx - shift.offset) % size
+        # static edge mask: ppermute rotates ALL ranks; only real edges
+        # of this offset may merge (non-destinations receive zeros)
+        has_edge = jnp.asarray(shift.recv_weights != 0)[idx]
+        valid = has_edge & (active[src] > 0) & (link_ok[src, idx] > 0)
+        newer = received[:, LANE_VERSION] > table[:, LANE_VERSION]
+        adopt = valid & newer
+        table = jnp.where(adopt[:, None],
+                          received.at[:, LANE_HOP].add(1.0), table)
+        advanced = advanced | adopt
+    last_heard = jnp.where(advanced, stepi, last_heard)
+    return table, last_heard
+
+
+@functools.lru_cache(maxsize=64)
+def _plane_fn(axis, topo: CompiledTopology, mesh_id):
+    from ..context import ctx
+    cx = ctx()
+    spec = P(cx.rank_axis)
+
+    def wrapper(table, last_heard, step, payload, active, link_ok):
+        def shard_fn(tables, lh, step_s, pay_s, active_s, link_s):
+            t, h = plane_exchange(tables[0], lh[0], axis, topo, step_s,
+                                  pay_s[0], active_s, link_s)
+            return t[None], h[None]
+        return jax.shard_map(
+            shard_fn, mesh=cx.mesh,
+            in_specs=(spec, spec, P(), spec, P(), P()),
+            out_specs=(spec, spec),
+        )(table, last_heard, step, payload, active, link_ok)
+    return jax.jit(wrapper)
+
+
+def exchange(state: Dict[str, jnp.ndarray], payload, step,
+             active=None, link_ok=None,
+             topo: Optional[CompiledTopology] = None
+             ) -> Dict[str, jnp.ndarray]:
+    """Run one plane round over the context topology (or ``topo``).
+
+    ``payload``: [N, WIDTH] — every rank's own row (a single-controller
+    SPMD program publishes for the whole virtual fleet at once).
+    ``step``/``payload``/``active``/``link_ok`` are all traced data:
+    every call reuses ONE compiled program per (axis, topo, mesh)."""
+    from ..context import ctx
+    from ..ops import api as _api
+    cx = ctx()
+    topo = topo or cx.compiled_topology
+    n = topo.size
+    if active is None:
+        active = jnp.ones((n,), jnp.float32)
+    if link_ok is None:
+        link_ok = jnp.ones((n, n), jnp.float32)
+    fn = _plane_fn(cx.rank_axis, topo, id(cx.mesh))
+    sharding = _api.rank_sharding()
+    table = jax.device_put(
+        jnp.asarray(state["table"], jnp.float32), sharding)
+    heard = jax.device_put(
+        jnp.asarray(state["last_heard"], jnp.int32), sharding)
+    pay = jax.device_put(jnp.asarray(payload, jnp.float32), sharding)
+    table, heard = fn(table, heard, jnp.asarray(step, jnp.int32), pay,
+                      jnp.asarray(active, jnp.float32),
+                      jnp.asarray(link_ok, jnp.float32))
+    return {"table": table, "last_heard": heard}
+
+
+# -- local fleet view --------------------------------------------------------
+
+def snapshot(state, step: int, *, rank: int = 0,
+             max_age: Optional[int] = None) -> List[dict]:
+    """Decode rank ``rank``'s local table into per-source record dicts
+    (sources never heard — version 0 — are omitted)."""
+    max_age = resolve_max_age(max_age)
+    table = np.asarray(state["table"])[rank]
+    heard = np.asarray(state["last_heard"])[rank]
+    now_us = int(time.time() * 1e6)
+    out = []
+    for src in range(table.shape[0]):
+        if table[src, LANE_VERSION] <= 0:
+            continue
+        rec = decode_row(table[src], rank=src)
+        age = int(step) - int(heard[src])
+        rec["plane_age"] = age
+        rec["plane_stale"] = age > max_age
+        rec["t_us"] = now_us
+        out.append(rec)
+    return out
+
+
+class FleetViewLive(AG.FleetView):
+    """A plane-backed fleet view: the health engine's FleetView surface
+    over one rank's gossiped table instead of JSONL files on disk.
+
+    ``per_source``: rank -> ``{"version", "age", "hop", "stale",
+    "step"}`` — the merge metadata ``bfmonitor --plane`` renders.
+    ``plane_step``: the observer's step at snapshot time.
+
+    Unlike a file-backed view, plane snapshots are SAMPLES of an
+    eventually-consistent table, not an append-only log — a publish
+    cadence above 1 leaves legitimate holes in each source's step
+    sequence, so loader-style ``missing_steps`` gaps are dropped (dead
+    sources are still caught by the ``dead_rank`` rule and the stale
+    flag; silent sources by ``expected_ranks`` -> ``rank_silent``)."""
+
+    def __init__(self, series, gaps, expected_ranks, per_source,
+                 plane_step: int):
+        super().__init__(series, gaps, expected_ranks=expected_ranks)
+        self.gaps = [g for g in self.gaps if g.kind != "missing_steps"]
+        self.per_source = per_source
+        self.plane_step = int(plane_step)
+
+    def alive_mask(self, confirm_after: Optional[int] = None) -> np.ndarray:
+        """[N] float32 liveness from plane age: 1.0 while a source's row
+        advanced within ``confirm_after`` steps (default: the stale
+        flag's ``BLUEFOG_PLANE_MAX_AGE``).  Feed to the serving router's
+        ``observe`` / ``repair_matrix``."""
+        n = self.expected_ranks or (max(self.per_source) + 1
+                                    if self.per_source else 0)
+        out = np.zeros((n,), np.float32)
+        for src, meta in self.per_source.items():
+            if src >= n:
+                continue
+            if confirm_after is None:
+                out[src] = 0.0 if meta["stale"] else 1.0
+            else:
+                out[src] = 1.0 if meta["age"] <= confirm_after else 0.0
+        return out
+
+    def staleness_of(self, rank: int) -> Optional[float]:
+        """A source's own reported staleness watermark (newest sample)."""
+        series = self.series_of(rank, "staleness")
+        return series[-1][1] if series else None
+
+
+def matrix_from_view(view: FleetViewLive):
+    """Assemble the plane-gossiped edge-cost rows into one
+    :class:`~bluefog_tpu.observability.commprof.EdgeCostMatrix` (None
+    when no live source carried a measured fragment or platforms
+    disagree).  Rows from stale sources are skipped; the result carries
+    the newest probe step and the common platform, so
+    ``commprof.matrix_is_usable(..., age_steps=)`` gates it exactly like
+    a file artifact."""
+    from . import commprof as CP
+    entries: Dict[Tuple[int, int], dict] = {}
+    platforms = set()
+    newest = None
+    n = view.expected_ranks or 0
+    for src in view.ranks:
+        meta = view.per_source.get(src)
+        if meta is None or meta["stale"]:
+            continue
+        by_step = view.per_rank.get(src) or {}
+        for step in sorted(by_step):
+            rec = by_step[step]
+            if not rec.get("edges"):
+                continue
+            for e in rec["edges"]:
+                entries[(int(e["src"]), int(e["dst"]))] = dict(e)
+            platforms.add(rec.get("edges_platform"))
+            es = rec.get("edges_step")
+            if es is not None:
+                newest = es if newest is None else max(newest, es)
+        n = max(n, src + 1)
+    if not entries or len(platforms) != 1:
+        return None
+    return CP.EdgeCostMatrix(n, list(entries.values()), step=newest,
+                             platform=platforms.pop())
+
+
+# -- the host-side plane object ----------------------------------------------
+
+class TelemetryPlane:
+    """One rank's handle on the in-band telemetry plane.
+
+    Owns the gossiped state, runs :func:`exchange` rounds, and keeps a
+    bounded per-source history of LOCAL snapshots so the health engine's
+    trailing-window rules see series, not just the newest sample.
+    Everything it consumes arrived over the fabric: :meth:`view` needs
+    nothing but this rank's own table."""
+
+    def __init__(self, topo: Optional[CompiledTopology] = None, *,
+                 rank: Optional[int] = None,
+                 max_age: Optional[int] = None,
+                 window: Optional[int] = None):
+        from ..context import ctx
+        cx = ctx()
+        self.topo = topo or cx.compiled_topology
+        self.size = self.topo.size
+        self.rank = cx.rank() if rank is None else int(rank)
+        self.max_age = resolve_max_age(max_age)
+        self.window = resolve_window(window)
+        self.state = init_state(self.size)
+        self.step = 0
+        self._records: Dict[int, Dict[int, dict]] = {}
+        self._trail = None
+
+    def attach_trail(self, trail) -> None:
+        """Bank a ``kind: plane`` record per observation into a
+        :class:`~bluefog_tpu.observability.export.PlaneTrail`."""
+        self._trail = trail
+
+    # -- publish / observe ---------------------------------------------------
+
+    def publish(self, payloads, step, *, active=None, link_ok=None,
+                rounds: int = 1):
+        """Stamp + disseminate: run ``rounds`` exchange rounds with the
+        fleet's ``[N, WIDTH]`` payload rows (see :func:`pack_payload`),
+        then snapshot the local view into the history."""
+        for _ in range(max(1, int(rounds))):
+            self.state = exchange(self.state, payloads, step,
+                                  active=active, link_ok=link_ok,
+                                  topo=self.topo)
+        self.observe(step)
+        return self.state
+
+    def observe(self, step) -> List[dict]:
+        """Snapshot this rank's table at ``step`` into the rolling
+        history (and the trail / registry gauges when enabled)."""
+        self.step = int(step)
+        recs = snapshot(self.state, self.step, rank=self.rank,
+                        max_age=self.max_age)
+        for rec in recs:
+            by_step = self._records.setdefault(rec["rank"], {})
+            by_step[rec["step"]] = rec
+            for old in sorted(by_step)[:-self.window]:
+                del by_step[old]
+        if _metrics.enabled():
+            live = [r for r in recs if not r["plane_stale"]]
+            _metrics.gauge(
+                "bf_plane_live_sources",
+                "plane sources whose row advanced within the max age"
+            ).set(float(len(live)))
+            _metrics.gauge(
+                "bf_plane_age_max",
+                "oldest per-source age in the local plane view (steps)"
+            ).set(float(max((r["plane_age"] for r in recs), default=0)))
+        if self._trail is not None:
+            self._trail.write({
+                "kind": "plane", "step": self.step,
+                "sources": [{
+                    "rank": r["rank"], "step": r["step"],
+                    "version": r["plane_version"], "age": r["plane_age"],
+                    "hop": r["plane_hop"], "stale": r["plane_stale"],
+                } for r in recs]})
+        return recs
+
+    # -- consumption ---------------------------------------------------------
+
+    def per_source(self) -> Dict[int, dict]:
+        meta = {}
+        for rec in snapshot(self.state, self.step, rank=self.rank,
+                            max_age=self.max_age):
+            meta[rec["rank"]] = {
+                "version": rec["plane_version"], "age": rec["plane_age"],
+                "hop": rec["plane_hop"], "stale": rec["plane_stale"],
+                "step": rec["step"],
+            }
+        return meta
+
+    def view(self, *, expected_ranks: Optional[int] = None
+             ) -> FleetViewLive:
+        """The plane-backed FleetView over this rank's local table —
+        hand it straight to ``health.evaluate`` / the router / the
+        controller."""
+        series = []
+        for src in sorted(self._records):
+            recs = [self._records[src][s]
+                    for s in sorted(self._records[src])]
+            series.append(AG.RankSeries(rank=src, records=recs))
+        return FleetViewLive(
+            series, [], expected_ranks or self.size,
+            self.per_source(), self.step)
+
+    def versions(self) -> np.ndarray:
+        """[N] per-source versions in this rank's view (0 = never
+        heard)."""
+        return np.asarray(
+            self.state["table"])[self.rank, :, LANE_VERSION].copy()
+
+    def reached(self, src: int) -> np.ndarray:
+        """[N] bool: which ranks hold a copy of ``src``'s row — the
+        propagation-bound probe ``make bench-plane`` loops on."""
+        table = np.asarray(self.state["table"])
+        return table[:, src, LANE_VERSION] > 0

@@ -34,7 +34,7 @@ from ..observability import aggregate as AG
 from ..observability import health as H
 
 __all__ = ["main", "build_report", "render_dashboard", "sparkline",
-           "render_checkpoint", "render_async",
+           "render_checkpoint", "render_async", "render_plane",
            "render_edge_heatmap", "render_decisions", "render_serving",
            "render_membership"]
 
@@ -107,6 +107,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  membership_path: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
                  async_path: Optional[str] = None,
+                 plane_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -136,7 +137,13 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     ``observability/export.py::AsyncTrail``) — the cadence period
     vector, fired-rank and staleness series, push-sum P spread, and
     bounded-staleness refusals become the ``"async"`` block and the
-    ``--async`` panel."""
+    ``--async`` panel.  ``plane_path``: the in-band telemetry plane's
+    trail (default discovery: ``<prefix>plane.jsonl``,
+    ``observability/export.py::PlaneTrail``) — ONE rank's gossiped
+    fleet view with per-source version/age/hop (stale sources flagged
+    against ``BLUEFOG_PLANE_MAX_AGE``) becomes the ``"plane"`` block
+    and the ``--plane`` panel, so the dashboard works from any single
+    rank with no shared filesystem."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -206,6 +213,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     out["membership"] = _membership_block(prefix, membership_path)
     out["checkpoint"] = _checkpoint_block(prefix, checkpoint_path)
     out["async"] = _async_block(prefix, async_path)
+    out["plane"] = _plane_block(prefix, plane_path)
     return view, report, _strict_json(out)
 
 
@@ -390,6 +398,74 @@ def _async_block(prefix: str, async_path: Optional[str]) -> Optional[dict]:
         "active_series": series["active"][-24:],
         "staleness_series": series["staleness_max"][-24:],
     }
+
+
+def _plane_block(prefix: str, plane_path: Optional[str]) -> Optional[dict]:
+    """The in-band telemetry plane's trail as a report block: the
+    newest observation's per-source merge metadata (version/age/hop,
+    stale sources flagged against ``BLUEFOG_PLANE_MAX_AGE``) plus
+    live-source and max-age series (the panel sparklines them) — None
+    when no trail exists (a plane-free run stays noise-free)."""
+    from ..observability.export import PLANE_SUFFIX, read_plane_trail
+    path = plane_path or prefix + PLANE_SUFFIX
+    config, records = read_plane_trail(path)
+    if config is None and not records:
+        return None
+    obs = [r for r in records if r.get("kind") == "plane"]
+    latest = obs[-1] if obs else {}
+    sources = latest.get("sources") or []
+    live_series, age_series = [], []
+    for o in obs:
+        srcs = o.get("sources") or []
+        live_series.append(sum(1 for s in srcs if not s.get("stale")))
+        ages = [s.get("age") for s in srcs
+                if isinstance(s.get("age"), (int, float))]
+        age_series.append(max(ages) if ages else 0)
+    return {
+        "path": path,
+        "size": (config or {}).get("size"),
+        "rank": (config or {}).get("rank"),
+        "schema_version": (config or {}).get("schema_version"),
+        "max_age": (config or {}).get("max_age"),
+        "step": latest.get("step"),
+        "observations": len(obs),
+        "sources": sources,
+        "live": sum(1 for s in sources if not s.get("stale")),
+        "stale": sum(1 for s in sources if s.get("stale")),
+        "live_series": live_series[-24:],
+        "age_max_series": age_series[-24:],
+    }
+
+
+def render_plane(block: dict, *, width: int = 12) -> str:
+    """The in-band telemetry plane panel (``--plane``): one rank's
+    gossiped fleet view — live/stale source counts, the live-source and
+    max-age sparklines, then per-source version/age/hop rows with stale
+    sources (row older than ``BLUEFOG_PLANE_MAX_AGE`` steps) flagged."""
+    lines = [f"plane (rank {block.get('rank', '-')} view):  "
+             f"step {block.get('step', '-')}  "
+             f"live {block.get('live', '-')}"
+             f"/{block.get('size', '-')}  "
+             f"stale {block.get('stale', 0)}  "
+             f"max_age {block.get('max_age', '-')}"]
+    live = [s for s in block.get("live_series", [])
+            if isinstance(s, (int, float))]
+    if live:
+        lines.append(f"  live sources  {sparkline(live, width)}")
+    ages = [s for s in block.get("age_max_series", [])
+            if isinstance(s, (int, float))]
+    if ages:
+        lines.append(f"  age max       {sparkline(ages, width)}  "
+                     f"last {ages[-1]:g}")
+    for s in block.get("sources", []):
+        tag = "STALE" if s.get("stale") else "ok"
+        lines.append(
+            f"  src {str(s.get('rank', '-')):>3}  "
+            f"step {str(s.get('step', '-')):>5}  "
+            f"v {str(s.get('version', '-')):>5}  "
+            f"age {str(s.get('age', '-')):>3}  "
+            f"hop {str(s.get('hop', '-')):>2}  [{tag}]")
+    return "\n".join(lines)
 
 
 def render_async(block: dict, *, width: int = 12) -> str:
@@ -696,6 +772,15 @@ def main(argv=None) -> int:
     p.add_argument("--async-trail", default=None, metavar="PATH",
                    help="async trail to render (default: "
                         "<prefix>async.jsonl when it exists)")
+    p.add_argument("--plane", dest="plane_panel", action="store_true",
+                   help="render the in-band telemetry plane panel (one "
+                        "rank's gossiped fleet view: per-source "
+                        "version/age/hop, stale sources flagged against "
+                        "BLUEFOG_PLANE_MAX_AGE) from the "
+                        "<prefix>plane.jsonl trail")
+    p.add_argument("--plane-trail", default=None, metavar="PATH",
+                   help="plane trail to render (default: "
+                        "<prefix>plane.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -713,7 +798,8 @@ def main(argv=None) -> int:
             serving_path=args.serving_trail,
             membership_path=args.membership_trail,
             checkpoint_path=args.checkpoint_trail,
-            async_path=args.async_trail, cache=cache)
+            async_path=args.async_trail,
+            plane_path=args.plane_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -752,6 +838,15 @@ def main(argv=None) -> int:
                     print("\n(no async trail yet — asynchronous runs "
                           "write <prefix>async.jsonl; see "
                           "docs/async.md)")
+            if args.plane_panel:
+                if out.get("plane"):
+                    print()
+                    print(render_plane(out["plane"]))
+                else:
+                    print("\n(no plane trail yet — attach a PlaneTrail "
+                          "to the TelemetryPlane; it writes "
+                          "<prefix>plane.jsonl; see "
+                          "docs/observability.md)")
             if args.edges:
                 edges = out.get("edges")
                 if edges:

@@ -93,6 +93,12 @@ class RequestRouter:
     ``bf_serve_refused_matrix_total``).  ``liveness``: suspect/confirm
     thresholds for the router's host-side death beliefs (defaults to
     ``resilience.LivenessConfig()``).
+
+    Liveness observations arrive either as explicit masks
+    (:meth:`observe`) or straight off the fabric via
+    :meth:`observe_plane` — the in-band telemetry plane's local fleet
+    view, which also refreshes the measured cost map from
+    plane-gossiped edge rows when a usable matrix can be assembled.
     """
 
     def __init__(self, replicas: ReplicaSet, *,
@@ -221,6 +227,45 @@ class RequestRouter:
         for r in self.replicas.replicas:
             if row[r] > 0:
                 self._last_ok[r] = float(step)
+
+    def observe_plane(self, view, step: Optional[int] = None) -> None:
+        """Feed liveness/staleness from the in-band telemetry plane
+        (docs/observability.md "In-band telemetry plane"): ``view`` is
+        this rank's :class:`~..observability.plane.FleetViewLive` — no
+        shared filesystem, no central collector, just the local gossiped
+        table.  Plane age within ``liveness.suspect_after`` counts as an
+        alive observation (the router's own ``confirm_after`` accrual
+        still governs death, so a briefly-quiet source is suspected, not
+        executed).  When live sources carried measured edge-cost
+        fragments, the routing cost map is refreshed from the assembled
+        plane matrix — behind the same ``matrix_is_usable`` gate as a
+        file artifact, with the plane's max source age as the freshness
+        bound."""
+        if step is None:
+            step = view.plane_step
+        self.observe(view.alive_mask(self.liveness.suspect_after), step)
+        from ..observability import commprof as _cprof
+        from ..observability import plane as _plane
+        matrix = _plane.matrix_from_view(view)
+        if matrix is None:
+            return
+        ages = [m["age"] for m in view.per_source.values()
+                if not m["stale"]]
+        ok, _why = _cprof.matrix_is_usable(
+            matrix, age_steps=max(ages, default=0))
+        if not ok:
+            if _metrics.enabled():
+                _metrics.counter(
+                    "bf_serve_refused_matrix_total",
+                    "edge-cost matrices the router refused to consult"
+                ).inc()
+            return
+        self._matrix = matrix
+        self._cost = {}
+        for r in self.replicas.replicas:
+            lat = self._edge_cost(matrix, r)
+            if lat is not None:
+                self._cost[r] = lat
 
     def confirmed_dead(self, rank: int, step: int) -> bool:
         return (self._last_obs - self._last_ok[rank]

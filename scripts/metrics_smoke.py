@@ -89,6 +89,16 @@ through ``validate_jsonl`` and the real ``bfmonitor --once --json``
 ``"async"`` block; a win-put leg on alternating cadences must contract
 the parameter spread.
 
+``--plane`` (``make plane-smoke``) adds the in-band telemetry-plane
+gate (docs/observability.md "In-band telemetry plane"): a fact injected
+at one rank (a marker value in its payload) must reach every rank over
+the fabric within the graph-diameter round bound; a rank deactivated
+mid-run must age past ``BLUEFOG_PLANE_MAX_AGE`` and be flagged stale in
+the local view, then resume at a HIGHER version on elastic rejoin; the
+whole episode must reuse ONE compiled exchange program (zero
+recompiles); and the plane trail must validate and render in the real
+``bfmonitor --once --json`` ``"plane"`` block (``--plane`` panel).
+
 ``--health`` (``make health-smoke``) adds the fleet-health CI gate
 (docs/observability.md "Fleet health & bfmonitor"): a clean 20-step
 consensus-only fleet replayed into per-rank JSONL series must make
@@ -1081,6 +1091,114 @@ def profile_legs(n, tmp):
     }
 
 
+def plane_legs(n, tmp):
+    """The ``make plane-smoke`` gate: injection -> propagation ->
+    bfmonitor round-trip over the in-band telemetry plane
+    (docs/observability.md "In-band telemetry plane").  A marker fact
+    published by one rank must reach every rank within the
+    graph-diameter round bound; a deactivated rank must age out (stale
+    in the local view), then rejoin at a HIGHER version; the episode
+    must reuse one compiled exchange program; and the plane trail must
+    validate and render in the real ``bfmonitor`` ``"plane"`` block."""
+    from bluefog_tpu.context import ctx
+    from bluefog_tpu.observability import plane as PLN
+
+    cx = ctx()
+    topo = cx.compiled_topology
+    bound = PLN.diameter(topo)
+    prefix = os.path.join(tmp, "plane_")
+    max_age = 3
+    tp = PLN.TelemetryPlane(rank=0, max_age=max_age)
+    trail = EX.PlaneTrail(prefix + EX.PLANE_SUFFIX, size=n, rank=0,
+                          schema_version=PLN.SCHEMA_VERSION,
+                          wire=PLN.WIRE, max_age=max_age)
+    tp.attach_trail(trail)
+
+    # -- injection -> propagation: rank 3's payload carries a marker
+    # value; every rank must hold the marker within the diameter bound
+    FACT, SRC = 42.0, 3
+
+    def payloads(step):
+        return np.stack([PLN.pack_payload(
+            step, consensus_dist=FACT if r == SRC else 0.0)
+            for r in range(n)])
+
+    rounds_needed = None
+    for rnd in range(1, bound + 1):
+        tp.publish(payloads(0), 0)
+        if bool(tp.reached(SRC).all()):
+            rounds_needed = rnd
+            break
+    if rounds_needed is None:
+        fail(f"plane: rank {SRC}'s fact did not reach all {n} ranks "
+             f"within the diameter bound ({bound} rounds)")
+    table = np.asarray(tp.state["table"])
+    if not (table[:, SRC, PLN.SLOT_CONSENSUS] == FACT).all():
+        fail(f"plane: marker fact corrupted in transit: "
+             f"{table[:, SRC, PLN.SLOT_CONSENSUS]}")
+
+    # -- death: rank 2 stops participating; its row must age past
+    # max_age and flag stale in the local view
+    DEAD = 2
+    active = np.ones((n,), np.float32)
+    active[DEAD] = 0.0
+    step = 0
+    for step in range(1, max_age + 2):
+        tp.publish(payloads(step), step, active=active)
+    meta = tp.per_source()
+    if not meta[DEAD]["stale"]:
+        fail(f"plane: dead rank {DEAD} not stale after {step} silent "
+             f"steps (max_age {max_age}): {meta[DEAD]}")
+    if any(meta[r]["stale"] for r in range(n) if r != DEAD):
+        fail(f"plane: live ranks flagged stale: {meta}")
+    dead_version = meta[DEAD]["version"]
+
+    # -- elastic rejoin at a higher step: the version must resume ABOVE
+    # every stale copy still circulating, and the stale flag clear
+    active[DEAD] = 1.0
+    rejoin_step = step + 5
+    tp.publish(payloads(rejoin_step), rejoin_step, active=active)
+    meta = tp.per_source()
+    if meta[DEAD]["stale"] or meta[DEAD]["version"] <= dead_version:
+        fail(f"plane: rank {DEAD} did not rejoin at a higher version: "
+             f"was {dead_version}, now {meta[DEAD]}")
+
+    # -- one compiled exchange program across the whole episode
+    compiles = PLN._plane_fn(cx.rank_axis, topo,
+                             id(cx.mesh))._cache_size()
+    if compiles != 1:
+        fail(f"plane: {compiles} exchange compiles across "
+             f"update/death/rejoin (expected 1)")
+
+    # -- trail -> validate_jsonl -> the real bfmonitor "plane" block
+    trail.close()
+    try:
+        records = EX.validate_jsonl(prefix + EX.PLANE_SUFFIX)
+    except ValueError as e:
+        fail(f"plane trail schema violation: {e}")
+    stale_seen = any(
+        s.get("rank") == DEAD and s.get("stale")
+        for r in records if r.get("kind") == "plane"
+        for s in r.get("sources", []))
+    if not stale_seen:
+        fail("plane trail never recorded the dead source as stale")
+    rc, rep = bfmonitor_json(prefix, "--plane")
+    blk = rep.get("plane")
+    if not blk or blk.get("size") != n:
+        fail(f"bfmonitor plane block missing/malformed: {blk}")
+    if blk.get("live") != n or blk.get("step") != rejoin_step:
+        fail(f"bfmonitor plane block did not show the rejoined fleet: "
+             f"{blk}")
+    return {
+        "diameter": bound,
+        "rounds_to_full_reach": rounds_needed,
+        "dead_rank": DEAD,
+        "rejoin_version": meta[DEAD]["version"],
+        "monitor_live": blk["live"],
+        "monitor_observations": blk["observations"],
+    }
+
+
 def main():
     do_compress = "--compress" in sys.argv
     do_health = "--health" in sys.argv
@@ -1090,6 +1208,7 @@ def main():
     do_elastic = "--elastic" in sys.argv
     do_ckpt = "--ckpt" in sys.argv
     do_async = "--async" in sys.argv
+    do_plane = "--plane" in sys.argv
     tmp = tempfile.mkdtemp(prefix="bf_metrics_smoke_")
     prefix = os.path.join(tmp, "series_")
     os.environ["BLUEFOG_METRICS"] = prefix
@@ -1197,6 +1316,12 @@ def main():
         EX.metrics_end()           # release the sink for the async legs
         async_out = async_legs(n, tmp)
 
+    # -- telemetry-plane gate (--plane / make plane-smoke) --------------
+    plane_out = None
+    if do_plane:
+        EX.metrics_end()           # release the sink for the plane legs
+        plane_out = plane_legs(n, tmp)
+
     bf.shutdown()                  # closes the sink
 
     # -- schema validation ----------------------------------------------
@@ -1237,6 +1362,8 @@ def main():
         out["ckpt"] = ckpt_out
     if async_out:
         out["async"] = async_out
+    if plane_out:
+        out["plane"] = plane_out
     print(json.dumps(out))
 
 

@@ -1,0 +1,422 @@
+"""In-band telemetry plane tests (PR 19, ``observability/plane.py``,
+docs/observability.md "In-band telemetry plane").
+
+Closed-form propagation bounds on real topologies: a fact injected at
+one rank reaches all N within graph-diameter rounds on the ring and the
+one-peer exponential families, and survives a mid-propagation rank
+death plus elastic re-join (the re-joined rank resumes at a HIGHER
+version than every stale copy still circulating).  The standing
+contracts ride along: one compiled exchange program across
+update/death/rejoin episodes, train-step StableHLO inertness with a
+live plane, the ``kind: plane`` trail schema through ``validate_jsonl``,
+and the consumer rewiring — ``health.evaluate`` over the plane-backed
+view, the serving router's :meth:`observe_plane`, and the controller's
+plane-gossiped edge rows behind the ``matrix_is_usable`` gate.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bluefog_tpu as bf
+from bluefog_tpu.observability import commprof as CP
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.observability import health as H
+from bluefog_tpu.observability import plane as PLN
+from bluefog_tpu.parallel import topology as tu
+from bluefog_tpu.parallel.schedule import compile_topology
+from bluefog_tpu.utils import trace_metrics as TM
+
+from conftest import N_DEVICES as N
+
+FACT = 42.0                       # the marker a source injects
+
+
+def payloads(step, *, src=None, fact=FACT, edges_rank=None, edges=None):
+    """[N, WIDTH] fleet payloads; optionally mark one source's
+    consensus lane, optionally carry an edge fragment on one rank."""
+    rows = []
+    for r in range(N):
+        kw = {}
+        if src is not None and r == src:
+            kw["consensus_dist"] = fact
+        if edges_rank is not None and r == edges_rank:
+            kw["edges"] = edges
+            kw["edge_platform"] = "cpu"
+            kw["edge_step"] = step
+        rows.append(PLN.pack_payload(step, **kw))
+    return np.stack(rows)
+
+
+def marker_holders(state, src):
+    """[N] bool: ranks whose local table holds src's marked row."""
+    table = np.asarray(state["table"])
+    return ((table[:, src, PLN.LANE_VERSION] > 0)
+            & (table[:, src, PLN.SLOT_CONSENSUS] == FACT))
+
+
+# ---------------------------------------------------------------------------
+# Wire schema
+# ---------------------------------------------------------------------------
+
+def test_payload_roundtrip_through_decode():
+    row = PLN.pack_payload(7, heartbeat=6, consensus_dist=0.25,
+                           staleness=2.0, health_bits=PLN.HEALTH_ALERT_BIT,
+                           edges=[(3, 120.0), (5, 80.0)],
+                           edge_platform="cpu", edge_step=4)
+    wire = np.concatenate([row, [9.0, 2.0]])   # version 9, hop 2
+    rec = PLN.decode_row(wire, rank=1)
+    assert rec["step"] == 7 and rec["heartbeat"] == 6
+    assert rec["consensus_dist"] == 0.25 and rec["staleness"] == 2.0
+    assert PLN.unpack_health_bits(rec["plane_health"])["alert"]
+    assert rec["plane_version"] == 9 and rec["plane_hop"] == 2
+    assert rec["edges_platform"] == "cpu" and rec["edges_step"] == 4
+    assert [(e["dst"], e["latency_us"]) for e in rec["edges"]] == [
+        (3, 120.0), (5, 80.0)]
+    # empty edge pairs encode dst = -1 and decode away entirely
+    bare = np.concatenate([PLN.pack_payload(1), [2.0, 0.0]])
+    assert "edges" not in PLN.decode_row(bare, rank=0)
+
+
+def test_pack_payload_rejects_inexact_step():
+    with pytest.raises(ValueError, match="f32"):
+        PLN.pack_payload(1 << 24)
+
+
+def test_top_edges_picks_slowest_out_edges():
+    entries = [
+        {"src": 0, "dst": 1, "bytes": 0, "rounds": 0, "inner": 0,
+         "latency_us": 20.0, "gbps": 0.0},
+        {"src": 0, "dst": 2, "bytes": 0, "rounds": 0, "inner": 0,
+         "latency_us": 90.0, "gbps": 0.0},
+        {"src": 0, "dst": 2, "bytes": 0, "rounds": 0, "inner": 0,
+         "latency_us": 30.0, "gbps": 0.0},   # same edge, faster probe
+        {"src": 1, "dst": 0, "bytes": 0, "rounds": 0, "inner": 0,
+         "latency_us": 999.0, "gbps": 0.0},  # someone else's edge
+    ]
+    mat = CP.EdgeCostMatrix(N, entries, step=3, platform="cpu")
+    # per-edge worst probe, ranked slowest first, k-truncated
+    assert PLN.top_edges(mat, 0) == [(2, 90.0), (1, 20.0)]
+    assert PLN.top_edges(mat, 0, k=1) == [(2, 90.0)]
+    assert PLN.top_edges(mat, 5) == []
+
+
+def test_diameter_closed_form():
+    assert PLN.diameter(compile_topology(tu.RingGraph(N))) == N // 2
+    assert PLN.diameter(compile_topology(tu.FullyConnectedGraph(N))) == 1
+    exp2 = compile_topology(tu.ExponentialTwoGraph(N))
+    assert PLN.diameter(exp2) <= int(np.ceil(np.log2(N)))
+
+
+# ---------------------------------------------------------------------------
+# Propagation bounds on real topologies
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("gen", [tu.RingGraph, tu.ExponentialTwoGraph],
+                         ids=["ring", "exp2"])
+def test_fact_reaches_fleet_within_diameter(bf_ctx, gen):
+    """A fact injected at one rank is fleet-wide within graph-diameter
+    exchange rounds — the plane's core eventual-consistency bound."""
+    topo = compile_topology(gen(N))
+    bound = PLN.diameter(topo)
+    src = N - 2
+    state = PLN.init_state(N)
+    rounds = None
+    for rnd in range(1, bound + 1):
+        state = PLN.exchange(state, payloads(0, src=src), 0, topo=topo)
+        if marker_holders(state, src).all():
+            rounds = rnd
+            break
+    assert rounds is not None, (
+        f"fact from rank {src} not fleet-wide after {bound} rounds: "
+        f"{marker_holders(state, src)}")
+    # the marked row arrived bit-exact, with sane merge metadata
+    table = np.asarray(state["table"])
+    assert (table[:, src, PLN.SLOT_CONSENSUS] == FACT).all()
+    assert (table[:, src, PLN.LANE_VERSION] == 1).all()
+    hops = table[:, src, PLN.LANE_HOP]
+    assert hops[src] == 0 and hops.max() <= N
+
+
+def test_newest_version_wins_merge(bf_ctx):
+    """A re-published (newer) row overtakes the old copy everywhere; an
+    older row never regresses a table."""
+    topo = compile_topology(tu.ExponentialTwoGraph(N))
+    state = PLN.init_state(N)
+    for step in range(3):
+        for _ in range(PLN.diameter(topo)):
+            state = PLN.exchange(state, payloads(step), step, topo=topo)
+        table = np.asarray(state["table"])
+        assert (table[:, :, PLN.LANE_VERSION] == step + 1).all(), (
+            f"step {step}: versions did not converge: "
+            f"{table[:, :, PLN.LANE_VERSION]}")
+        assert (table[:, :, PLN.SLOT_STEP] == step).all()
+
+
+# ---------------------------------------------------------------------------
+# Churn: mid-propagation death + elastic re-join
+# ---------------------------------------------------------------------------
+
+def test_fact_survives_mid_propagation_rank_down(bf_ctx):
+    """Kill a relay rank after the first exchange round: the fact still
+    reaches every surviving rank (the ring routes around the hole), and
+    the dead rank's own row ages out stale everywhere."""
+    topo = compile_topology(tu.RingGraph(N))
+    src, dead = 0, 1
+    tp = PLN.TelemetryPlane(topo, rank=N - 1, max_age=3)
+    active = np.ones((N,), np.float32)
+    tp.publish(payloads(0, src=src), 0, active=active)
+    active[dead] = 0.0             # rank_down mid-propagation
+    step = 0
+    while not marker_holders(tp.state, src)[active > 0].all():
+        step += 1
+        assert step <= N, "fact never routed around the dead rank"
+        tp.publish(payloads(step, src=src), step, active=active)
+    # keep stepping until the dead rank's frozen row ages out
+    for step in range(step + 1, step + tp.max_age + 2):
+        tp.publish(payloads(step, src=src), step, active=active)
+    meta = tp.per_source()
+    assert meta[dead]["stale"], meta[dead]
+    assert not any(meta[r]["stale"] for r in range(N)
+                   if r != dead and r in meta)
+    dead_version = meta[dead]["version"]
+
+    # elastic re-join at the fleet's (higher) current step: the revived
+    # rank's version resumes above every stale copy still circulating
+    active[dead] = 1.0
+    rejoin = step + 1
+    tp.publish(payloads(rejoin, src=src), rejoin, active=active,
+               rounds=PLN.diameter(topo))  # re-announce fleet-wide
+    meta = tp.per_source()
+    assert not meta[dead]["stale"]
+    assert meta[dead]["version"] == rejoin + 1 > dead_version
+
+
+def test_dead_rank_contributes_nothing(bf_ctx):
+    """An inactive rank neither stamps nor relays: facts that only it
+    could carry stay un-propagated, and its version freezes."""
+    topo = compile_topology(tu.RingGraph(N))
+    dead = 2
+    active = np.ones((N,), np.float32)
+    active[dead] = 0.0
+    state = PLN.init_state(N)
+    for step in range(3):
+        state = PLN.exchange(state, payloads(step), step,
+                             active=active, topo=topo)
+    table = np.asarray(state["table"])
+    assert (table[:, dead, PLN.LANE_VERSION] == 0).all(), (
+        "a dead rank's row should never appear anywhere")
+    assert (table[dead, dead, PLN.LANE_VERSION] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# Compile stability + train-step inertness
+# ---------------------------------------------------------------------------
+
+def test_episode_reuses_one_compiled_program(bf_ctx):
+    """Updates, death, and re-join are all traced data: the whole churn
+    episode runs on ONE compiled exchange program."""
+    cx = bf_ctx
+    topo = cx.compiled_topology
+    tp = PLN.TelemetryPlane(topo, rank=0, max_age=3)
+    active = np.ones((N,), np.float32)
+    link_ok = np.ones((N, N), np.float32)
+    for step in range(3):
+        tp.publish(payloads(step), step, active=active, link_ok=link_ok)
+    active[1] = 0.0                # death
+    link_ok[0, 2] = 0.0            # link drop
+    tp.publish(payloads(3), 3, active=active, link_ok=link_ok)
+    active[1] = 1.0                # re-join
+    tp.publish(payloads(9), 9, active=active, link_ok=link_ok)
+    fn = PLN._plane_fn(cx.rank_axis, topo, id(cx.mesh))
+    assert fn._cache_size() == 1
+
+
+def test_live_plane_leaves_train_step_hlo_identical(bf_ctx):
+    """The plane is a separate program: running a full churn episode
+    changes nothing in the training step's lowered StableHLO."""
+    from bluefog_tpu import training as T
+    from bluefog_tpu.models.mlp import MLP
+    model = MLP(features=(8,), num_outputs=4)
+    base = optax.sgd(0.05)
+    variables, opt_state = T.create_train_state(
+        model, base, jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    x = jnp.zeros((N, 2, 8, 8, 1), jnp.float32)
+    y = jnp.zeros((N, 2), jnp.int32)
+    args = (variables, opt_state, (x, y), jnp.int32(0))
+    t_before, _ = TM.lower_text(
+        T.make_train_step(model, base, donate=False), *args)
+    tp = PLN.TelemetryPlane(rank=0)
+    active = np.ones((N,), np.float32)
+    tp.publish(payloads(0), 0)
+    active[1] = 0.0
+    tp.publish(payloads(1), 1, active=active)
+    t_after, _ = TM.lower_text(
+        T.make_train_step(model, base, donate=False), *args)
+    assert t_before == t_after
+
+
+# ---------------------------------------------------------------------------
+# Trail schema
+# ---------------------------------------------------------------------------
+
+def test_plane_trail_schema_roundtrip(bf_ctx, tmp_path):
+    path = str(tmp_path / ("t_" + EX.PLANE_SUFFIX))
+    tp = PLN.TelemetryPlane(rank=0, max_age=3)
+    trail = EX.PlaneTrail(path, size=N, rank=0,
+                          schema_version=PLN.SCHEMA_VERSION,
+                          wire=PLN.WIRE, max_age=3)
+    tp.attach_trail(trail)
+    active = np.ones((N,), np.float32)
+    for step in range(3):
+        tp.publish(payloads(step), step, active=active)
+    active[2] = 0.0
+    for step in range(3, 8):
+        tp.publish(payloads(step), step, active=active)
+    trail.close()
+    records = EX.validate_jsonl(path)   # raises on any schema drift
+    assert records[0]["kind"] == "plane_config"
+    assert records[0]["size"] == N
+    assert records[0]["wire"] == PLN.WIRE
+    frames = [r for r in records if r["kind"] == "plane"]
+    assert len(frames) == 8
+    last = {s["rank"]: s for s in frames[-1]["sources"]}
+    assert len(last) == N
+    assert last[2]["stale"] and not last[0]["stale"]
+    assert last[0]["version"] == 8      # step 7 + 1
+    cfg, recs = EX.read_plane_trail(path)
+    assert cfg["kind"] == "plane_config" and len(recs) == 8
+
+
+# ---------------------------------------------------------------------------
+# Consumers: health engine, serving router, controller
+# ---------------------------------------------------------------------------
+
+def run_fleet(tp, steps, *, active=None, src=None):
+    for step in range(steps):
+        tp.publish(payloads(step, src=src), step, active=active)
+
+
+def test_health_evaluate_over_plane_view(bf_ctx):
+    """The plane-backed FleetViewLive IS a health FleetView: a clean
+    fleet raises no dead-rank alert; a frozen source does."""
+    tp = PLN.TelemetryPlane(rank=0, max_age=4, window=16)
+    run_fleet(tp, 12)
+    cfg = H.HealthConfig(window=8)
+    clean = H.evaluate(tp.view(), cfg)
+    assert not any(v.rule in ("dead_rank", "rank_silent", "no_data")
+                   for v in clean.verdicts), clean.verdicts
+
+    tp2 = PLN.TelemetryPlane(rank=0, max_age=4, window=16)
+    active = np.ones((N,), np.float32)
+    run_fleet(tp2, 2, active=active)
+    active[3] = 0.0                # rank 3 freezes at step 1
+    for step in range(2, 14):
+        tp2.publish(payloads(step), step, active=active)
+    report = H.evaluate(tp2.view(), cfg)
+    dead = [v for v in report.verdicts if v.rule == "dead_rank"]
+    assert [v.rank for v in dead] == [3], report.verdicts
+    view = tp2.view()
+    assert view.per_source[3]["stale"]
+    np.testing.assert_array_equal(
+        view.alive_mask() == 0.0,
+        np.arange(N) == 3)
+
+
+def make_tier():
+    from bluefog_tpu.serving import (ReplicaSet, RequestRouter,
+                                     WeightPublisher)
+    pubs, reps = [0, 1], [N - 2, N - 1]
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(N, 4, 3)), jnp.float32)}
+    pub = WeightPublisher(params, pubs, reps)
+    rs = ReplicaSet(pub, lambda p, x: x @ p["w"], max_staleness=3)
+    return reps, RequestRouter(rs)
+
+
+def test_router_observe_plane_costs_and_liveness(bf_ctx):
+    """observe_plane refreshes liveness AND the measured cost map from
+    plane-gossiped edge rows — behind the matrix_is_usable gate."""
+    reps, router = make_tier()
+    try:
+        tp = PLN.TelemetryPlane(rank=0, max_age=8)
+        edges = [(reps[0], 100.0), (reps[1], 20.0)]
+        for step in range(3):
+            tp.publish(payloads(step, edges_rank=0, edges=edges), step)
+        router.observe_plane(tp.view())
+        assert router._matrix is not None
+        assert router._cost == {reps[0]: 100.0, reps[1]: 20.0}
+        assert not router.confirmed_dead(reps[0], tp.view().plane_step)
+    finally:
+        bf.win_free()
+
+
+def test_router_refuses_aged_plane_matrix(bf_ctx):
+    """Rows live by a lenient plane max_age but older than
+    BLUEFOG_PLANE_MAX_AGE are refused — the fabric-borne analogue of a
+    stale artifact file."""
+    reps, router = make_tier()
+    try:
+        tp = PLN.TelemetryPlane(rank=0, max_age=64)
+        edges = [(reps[0], 100.0), (reps[1], 20.0)]
+        tp.publish(payloads(0, edges_rank=0, edges=edges), 0)
+        active = np.zeros((N,), np.float32)   # everyone goes quiet...
+        for step in range(1, 20):
+            tp.publish(payloads(step), step, active=active)
+        view = tp.view()                      # ...rows now aged >> 8
+        assert all(m["age"] > PLN.resolve_max_age()
+                   and not m["stale"] for m in view.per_source.values())
+        router.observe_plane(view)
+        assert router._matrix is None and router._cost == {}
+    finally:
+        bf.win_free()
+
+
+def test_controller_admits_plane_edges_behind_gate(bf_ctx, tmp_path):
+    """The controller's edge feed accepts plane-gossiped rows on a
+    plane-backed view — through the SAME matrix_is_usable gate (platform
+    + plane age) as a file artifact — and evaluate_plane runs a full
+    policy pass off the gossiped view without touching disk."""
+    from bluefog_tpu import control as CTL
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    ctl = CTL.Controller(opt, prefix=str(tmp_path / "ctl_"),
+                         mode="shadow", attach=False)
+    tp = PLN.TelemetryPlane(rank=0, max_age=8)
+    edges = [(1, 55.0), (2, 33.0)]
+    for step in range(3):
+        tp.publish(payloads(step, edges_rank=0, edges=edges), step)
+    view = tp.view()
+    entries = ctl._plane_edges(view)
+    assert entries is not None
+    assert {(e["src"], e["dst"]) for e in entries} == {(0, 1), (0, 2)}
+    assert ctl._edges(view) == entries    # no artifact: plane rows win
+    assert ctl.evaluate_plane(view) == [] # clean fleet: zero decisions
+
+    # an aged view is refused, not consumed
+    active = np.zeros((N,), np.float32)
+    tp2 = PLN.TelemetryPlane(rank=0, max_age=64)
+    tp2.publish(payloads(0, edges_rank=0, edges=edges), 0)
+    for step in range(1, 20):
+        tp2.publish(payloads(step), step, active=active)
+    assert ctl._plane_edges(tp2.view()) is None
+
+
+def test_matrix_from_view_platform_and_staleness_rules(bf_ctx):
+    """matrix_from_view skips stale sources and refuses mixed-platform
+    fragments (None), and the assembled matrix carries the newest probe
+    step + common platform so the gate prices it like an artifact."""
+    tp = PLN.TelemetryPlane(rank=0, max_age=8)
+    tp.publish(payloads(4, edges_rank=1, edges=[(0, 12.0)]), 4)
+    view = tp.view()
+    mat = PLN.matrix_from_view(view)
+    assert mat is not None and mat.platform == "cpu" and mat.step == 4
+    assert {(e["src"], e["dst"]) for e in mat.entries} == {(1, 0)}
+    ok, _ = CP.matrix_is_usable(mat, platform="cpu", age_steps=0)
+    assert ok
+    # no live source carried a fragment -> no matrix at all
+    empty = PLN.TelemetryPlane(rank=0, max_age=8)
+    empty.publish(payloads(0), 0)
+    assert PLN.matrix_from_view(empty.view()) is None
