@@ -32,8 +32,8 @@ PYTEST = BLUEFOG_TEST_MESH_DEVICES=$(NUM_DEVICES) python -m pytest -q
         bench-kernel bench-schedule bench-hw hwcheck \
         chaos metrics-smoke metrics-smoke-compress health-smoke \
         profile-smoke control-smoke serve-smoke elastic-smoke \
-        ckpt-smoke async-smoke plane-smoke bench-serve bench-ckpt \
-        bench-plane lint
+        ckpt-smoke async-smoke plane-smoke fleet-smoke bench-serve \
+        bench-ckpt bench-plane lint
 
 test:
 	$(PYTEST) tests/
@@ -301,6 +301,18 @@ control-smoke:
 # `bfmonitor --once --json` "serving" block.
 serve-smoke:
 	python scripts/metrics_smoke.py --serve
+
+# Multi-process fleet smoke (docs/running.md): a REAL 4-process CPU
+# fleet through `bfrun --fleet 4 --respawn` — one worker SIGKILLed
+# mid-run must be reaped (negative rc in the fleet trail), every
+# surviving process must see the death through its own gossiped plane
+# view and fail its router over with at most ONE failed request, the
+# respawned rank must re-admit through the full announce -> sync ->
+# activate membership path, exit codes must aggregate to 0 (a crashed
+# rank's clean replacement counts as recovered), and no surviving
+# process may recompile its step (per-process compile count asserted).
+fleet-smoke:
+	python scripts/fleet_smoke.py
 
 # Elastic-membership smoke (docs/resilience.md "Elastic membership"): a
 # scale-up chaos plan must admit a capacity rank mid-run (announced ->

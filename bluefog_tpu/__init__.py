@@ -85,8 +85,10 @@ from . import async_train
 from . import checkpoint
 from . import compress
 from . import control
+from . import fleet
 from . import resilience
 from . import serving
+from .fleet import FleetBootstrapError, FleetSpec  # noqa: F401
 
 from .ops.ring_attention import (
     attention, ring_attention, ulysses_attention,

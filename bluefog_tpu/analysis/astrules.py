@@ -42,6 +42,15 @@ hand; each rule below is one of those classes, named and enforced:
     ``os.environ``/``os.getenv`` reads at module import time freeze
     configuration before ``bfrun``/``bf.init()`` can set it; every env
     read must happen inside a function.
+``distributed-init-outside-bootstrap``
+    ``jax.distributed.initialize`` may only be called from the fleet
+    bootstrap module (``bluefog_tpu/fleet/bootstrap.py``): it is
+    process-global, once-only, and carries retry/diagnosis semantics
+    there — a second call site reintroduces the racy double-init the
+    bootstrap path exists to kill.  All import spellings are resolved
+    (``jax.distributed.initialize(...)``, ``jd.initialize(...)`` under
+    ``import jax.distributed as jd``, bare ``initialize(...)`` under
+    ``from jax.distributed import initialize``).
 
 All rules run against a repo root (defaulting to this checkout) so the
 analyzer's own tests can run them hermetically on synthetic trees.
@@ -65,6 +74,7 @@ ALL_RULES = (
     "host-time-in-trace",
     "knob-outside-cache-key",
     "import-time-env-read",
+    "distributed-init-outside-bootstrap",
 )
 
 _ENV_NAME = re.compile(r"^BLUEFOG_[A-Z0-9_]*$")
@@ -460,6 +470,40 @@ def _rule_import_time_env_read(pkg_facts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# rule: distributed-init-outside-bootstrap
+# ---------------------------------------------------------------------------
+
+# the single allowed call site of jax.distributed.initialize
+_BOOTSTRAP_RELPATH = "bluefog_tpu/fleet/bootstrap.py"
+_DISTRIBUTED_INIT = "jax.distributed.initialize"
+
+
+def _rule_distributed_init_outside_bootstrap(pkg_facts) -> List[Finding]:
+    findings = []
+    for facts in pkg_facts:
+        if facts.relpath.replace(os.sep, "/") == _BOOTSTRAP_RELPATH:
+            continue
+        for node in ast.walk(facts.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            if not parts:
+                continue
+            head = facts.import_map.get(parts[0], parts[0])
+            dotted = ".".join([head] + parts[1:])
+            if dotted == _DISTRIBUTED_INIT:
+                findings.append(Finding(
+                    "distributed-init-outside-bootstrap", "error",
+                    facts.relpath, node.lineno,
+                    f"jax.distributed.initialize called outside "
+                    f"{_BOOTSTRAP_RELPATH} — the fleet bootstrap is the "
+                    f"single bring-up path (retry, diagnosis, once-only "
+                    f"guard); route through "
+                    f"bluefog_tpu.fleet.bootstrap.ensure_initialized"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # rule: jsonl-kind-drift
 # ---------------------------------------------------------------------------
 
@@ -785,6 +829,8 @@ def run_ast_rules(repo_root: Optional[str] = None,
         findings += _rule_env_doc_drift(root, pkg_facts, extra_facts)
     if "import-time-env-read" in selected:
         findings += _rule_import_time_env_read(pkg_facts)
+    if "distributed-init-outside-bootstrap" in selected:
+        findings += _rule_distributed_init_outside_bootstrap(pkg_facts)
     if "jsonl-kind-drift" in selected:
         findings += _rule_jsonl_kind_drift(pkg_facts)
     if "metric-name-drift" in selected:

@@ -43,8 +43,8 @@ from .snapshot import (ASYNC_ENV, DIR_ENV, EVERY_ENV,  # noqa: F401
                        REPLICAS_ENV, FleetCheckpointer, durable_manifests,
                        file_crc32, load_manifest, resolve_async,
                        resolve_every, resolve_keep, resolve_replicas,
-                       shard_name, split_shards, step_dir_name,
-                       write_shard)
+                       process_scoped_dir, shard_name, split_shards,
+                       step_dir_name, write_shard)
 from .state import (FLEET_STATE_VERSION, FleetRestore,  # noqa: F401
                     apply_controller_state, apply_serving_state,
                     async_cadence_state, controller_state,
@@ -61,7 +61,8 @@ __all__ = [
     "apply_serving_state", "async_cadence_state", "restore_async_cadence",
     # crash-consistent snapshots
     "FleetCheckpointer", "MANIFEST_NAME", "GLOBAL_SHARD", "shard_name",
-    "step_dir_name", "write_shard", "file_crc32", "durable_manifests",
+    "step_dir_name", "process_scoped_dir", "write_shard", "file_crc32",
+    "durable_manifests",
     "load_manifest", "split_shards", "DIR_ENV", "EVERY_ENV", "KEEP_ENV",
     "REPLICAS_ENV", "ASYNC_ENV", "resolve_every", "resolve_keep",
     "resolve_replicas", "resolve_async",

@@ -44,7 +44,8 @@ from ..observability import metrics as _metrics
 from . import state as _state
 
 __all__ = ["FleetCheckpointer", "MANIFEST_NAME", "GLOBAL_SHARD",
-           "shard_name", "step_dir_name", "write_shard", "file_crc32",
+           "shard_name", "step_dir_name", "process_scoped_dir",
+           "write_shard", "file_crc32",
            "durable_manifests", "load_manifest", "split_shards",
            "DIR_ENV", "EVERY_ENV", "KEEP_ENV", "REPLICAS_ENV", "ASYNC_ENV",
            "resolve_every", "resolve_keep", "resolve_replicas",
@@ -94,6 +95,26 @@ def resolve_async(value: Optional[bool] = None) -> bool:
     if value is not None:
         return bool(value)
     return os.environ.get(ASYNC_ENV, "1").lower() not in ("0", "false", "off")
+
+
+def process_scoped_dir(directory: str,
+                       process_index: Optional[int] = None) -> str:
+    """Scope a checkpoint directory to one fleet process:
+    ``<dir>/proc-<index>``.
+
+    A ``bfrun --fleet`` worker runs its own full-size virtual mesh, so
+    every process would otherwise write the SAME
+    ``<dir>/step-N/rank-R.npz`` paths and clobber its siblings on a
+    shared filesystem.  Resolution: the explicit ``process_index`` wins,
+    else ``BLUEFOG_FLEET_RANK`` (the supervisor's per-worker env); with
+    neither the directory comes back unchanged (single-process runs keep
+    the seed layout)."""
+    if process_index is None:
+        v = os.environ.get("BLUEFOG_FLEET_RANK")
+        if v is None:
+            return directory
+        process_index = int(v)
+    return os.path.join(directory, f"proc-{int(process_index)}")
 
 
 def step_dir_name(step: int) -> str:
@@ -236,7 +257,8 @@ class FleetCheckpointer:
             raise ValueError(
                 "no checkpoint directory: pass directory= or set "
                 "BLUEFOG_CKPT_DIR")
-        self.directory = os.path.abspath(directory)
+        # one fleet process must not clobber its siblings' shards
+        self.directory = os.path.abspath(process_scoped_dir(directory))
         os.makedirs(self.directory, exist_ok=True)
         self.every = resolve_every(every)
         self.keep = resolve_keep(keep)

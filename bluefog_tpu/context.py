@@ -68,6 +68,14 @@ class BlueFogContext:
                 f"{nodes_per_machine}")
         self._local_size = nodes_per_machine
 
+        # fleet identity: which OS process this controller is, and which
+        # device slots it owns (stamped for the fleet supervisor / the
+        # per-process routers; single-process runs get 0 / all slots)
+        self.process_index = int(jax.process_index())
+        self.local_device_ids = [
+            i for i, d in enumerate(self._devices)
+            if getattr(d, "process_index", 0) == self.process_index]
+
         dev_array = np.asarray(self._devices)
         self.mesh = jax.sharding.Mesh(dev_array, (_RANK_AXIS,))
         self.mesh_2d = jax.sharding.Mesh(
@@ -264,62 +272,48 @@ _context: Optional[BlueFogContext] = None
 _jax_distributed_started = False
 
 
-def _maybe_init_jax_distributed() -> None:
-    """Join the multi-host job set up by ``bfrun`` (run/run.py wires
-    BLUEFOG_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID per host; the
-    reference reaches the same point through mpirun's rank env).
+def _maybe_init_jax_distributed(fleet=None):
+    """Join the multi-host job set up by ``bfrun`` — the launcher wires
+    the coordinator env per host; the reference reaches the same point
+    through mpirun's rank env.
 
-    Must not touch any backend-initializing JAX API before
-    ``jax.distributed.initialize`` — the guard is env + module flag only,
-    and an already-initialized runtime surfaces as the RuntimeError below.
+    The actual bring-up — env resolution, retry/backoff, NIC pinning,
+    the benign already-initialized filter — lives in
+    :mod:`bluefog_tpu.fleet.bootstrap`, the package's SINGLE
+    ``jax.distributed.initialize`` call site (bflint:
+    ``distributed-init-outside-bootstrap``).  This wrapper only keeps
+    the historic env + module-flag guard semantics: a no-op with no
+    coordinator configured, idempotent across calls.  It must not touch
+    any backend-initializing JAX API first.  Returns the bootstrap's
+    structured diagnosis record (or ``None`` on the no-op path).
     """
     global _jax_distributed_started
-    coordinator = os.environ.get("BLUEFOG_COORDINATOR")
-    if not coordinator or _jax_distributed_started:
-        return
-    process_id = int(os.environ["BLUEFOG_PROCESS_ID"])
-    kwargs = {}
-    iface = os.environ.get("BLUEFOG_NETWORK_INTERFACE")
-    if iface and process_id == 0:
-        # Pin the coordinator's LISTENING socket to the chosen NIC
-        # (bfrun --network-interface; reference run.py:84-118 pins
-        # NCCL/gloo ifaces the same way).  Resolved here, on the
-        # coordinator's own machine — the launcher cannot know a remote
-        # host's addresses.
-        from .run.network_util import interface_address
-        port = coordinator.rsplit(":", 1)[1]
-        kwargs["coordinator_bind_address"] = (
-            f"{interface_address(iface)}:{port}")
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(os.environ["BLUEFOG_NUM_PROCESSES"]),
-            process_id=process_id, **kwargs)
-    except RuntimeError as e:
-        # Only "already initialized / called too late" is benign (user or a
-        # previous bf.init did it).  A coordinator connection failure must
-        # abort — proceeding would silently train each host independently.
-        msg = str(e).lower()
-        # covers "distributed.initialize should only be called once." and
-        # older "already initialized" / ordering phrasings
-        if ("only be called once" in msg or "already" in msg
-                or "must be called before" in msg):
-            logger.warning("jax.distributed.initialize skipped: %s", e)
-        else:
-            raise
-    _jax_distributed_started = True
+    from .fleet import bootstrap as _bootstrap
+    if _jax_distributed_started and fleet is None:
+        return None
+    spec = _bootstrap.resolve_fleet_spec(fleet)
+    if spec is None:
+        return None
+    diagnosis = _bootstrap.ensure_initialized(spec)
+    _jax_distributed_started = _bootstrap.started()
+    return diagnosis
 
 
 def init(topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
          is_weighted: bool = False,
          devices: Optional[Sequence] = None,
-         nodes_per_machine: Optional[int] = None) -> BlueFogContext:
+         nodes_per_machine: Optional[int] = None,
+         fleet=None) -> BlueFogContext:
     """Initialize the global context (reference ``bf.init``, basics.py:49-70).
 
     The default topology is an exponential-2 graph over all devices.
+    ``fleet`` (a :class:`~bluefog_tpu.fleet.bootstrap.FleetSpec` or
+    dict) forces the multi-process bring-up explicitly; with ``None``
+    the ``BLUEFOG_FLEET_*`` / legacy coordinator env decides, exactly
+    as before (docs/running.md "Fleet mode").
     """
     global _context
-    _maybe_init_jax_distributed()
+    _maybe_init_jax_distributed(fleet)
     _context = BlueFogContext(devices=devices, nodes_per_machine=nodes_per_machine)
     topo = topology_fn(_context.size) if topology_fn else None
     _context.set_topology(topo, is_weighted)

@@ -223,9 +223,11 @@ class Actuator:
     def __init__(self, optimizer, *,
                  schedule: Optional[SwitchableSchedule] = None,
                  mode: Optional[str] = None,
-                 initial_mode: Optional[str] = None):
+                 initial_mode: Optional[str] = None,
+                 cadence=None):
         self.opt = optimizer
         self.schedule = schedule
+        self.cadence = cadence          # CadenceScheduler (async runs)
         self.mode = _policy.control_mode(mode)
         if schedule is not None:
             name = initial_mode or schedule.mode_names[0]
@@ -280,5 +282,9 @@ class Actuator:
                                             False):
                 return False
             knobs["gamma_scale"] = float(decision.value)
+            return True
+        if decision.knob == "cadence" and self.cadence is not None:
+            rank, period = decision.value
+            self.cadence.set_period(int(rank), int(period))
             return True
         return False

@@ -68,9 +68,10 @@ class Controller(_actuate.Actuator):
                  expected_ranks: Optional[int] = None,
                  edges_artifact: Optional[str] = None,
                  health_config: Optional[H.HealthConfig] = None,
+                 cadence=None,
                  attach: bool = True):
         super().__init__(optimizer, schedule=schedule, mode=mode,
-                         initial_mode=initial_mode)
+                         initial_mode=initial_mode, cadence=cadence)
         self.cfg = config or _policy.ControlConfig.from_env()
         if prefix is None:
             from ..observability import export as _export
@@ -90,7 +91,8 @@ class Controller(_actuate.Actuator):
             self.health_cfg.window = self.cfg.health_window
         self.engine = _policy.PolicyEngine(
             self.cfg, modes=self.available_modes(),
-            initial_mode=self.mode_name, gamma=self.gamma_knob)
+            initial_mode=self.mode_name, gamma=self.gamma_knob,
+            cadence=cadence)
         self._cache = AG.TailCache()
         self._head = None               # built on the first decision
         self._platform = None           # resolved lazily (needs jax)

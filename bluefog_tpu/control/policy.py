@@ -23,6 +23,12 @@ actuation layer (``control/actuate.py``) can apply WITHOUT a recompile:
   documented γ ≫ ω instability boundary (docs/compression.md
   "γ stability"): back γ off BEFORE the divergence step; re-arm toward
   full rate once consensus contracts again.
+* ``cadence`` — a straggler-flagged rank's asynchronous gossip period
+  (``async_train/cadence.py``'s :class:`CadenceScheduler`, a host-side
+  table the traced program reads per step).  A ``straggler`` verdict
+  lowers the flagged rank's cadence toward its measured slowdown ratio
+  (never past the bounded-staleness cap); the verdict clearing restores
+  the base period.
 
 Determinism is a hard contract: decisions are a pure function of
 (engine state, config, the recorded telemetry) — the live controller and
@@ -47,6 +53,7 @@ importing this module never touches JAX.
 
 import dataclasses
 import json
+import math
 import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -164,10 +171,11 @@ class ControlConfig:
 class Decision:
     """One structured controller decision (the JSONL trail unit).
 
-    ``knob``: ``"schedule"`` or ``"gamma"``; ``action``: ``"switch"``,
-    ``"backoff"``, or ``"rearm"``.  ``value``/``prev`` carry the new and
-    previous knob values (mode NAME for schedule, γ-scale float for
-    gamma).  ``rule`` names the health verdict (or margin rule) that
+    ``knob``: ``"schedule"``, ``"gamma"``, or ``"cadence"``; ``action``:
+    ``"switch"``, ``"backoff"``, ``"throttle"``, or ``"rearm"``.
+    ``value``/``prev`` carry the new and previous knob values (mode NAME
+    for schedule, γ-scale float for gamma, ``[rank, period]`` for
+    cadence).  ``rule`` names the health verdict (or margin rule) that
     triggered it; ``mode``/``applied`` record whether this run actuated
     (``on``) or only would have (``shadow``)."""
     step: int
@@ -237,7 +245,8 @@ class PolicyEngine:
     def __init__(self, cfg: Optional[ControlConfig] = None, *,
                  modes: Sequence[str] = (),
                  initial_mode: Optional[str] = None,
-                 gamma: bool = False):
+                 gamma: bool = False,
+                 cadence=None):
         self.cfg = cfg or ControlConfig.from_env()
         self.modes = tuple(modes)
         if self.modes:
@@ -250,6 +259,26 @@ class PolicyEngine:
         self.base_mode = self.sched_mode
         self.gamma = bool(gamma)
         self.gamma_scale = 1.0
+        # cadence knob: a CadenceScheduler-like object (base_period /
+        # max_staleness / periods) or its describe-dict from a replayed
+        # trail head.  The engine MODELS the periods it has decided so
+        # shadow trails read as if throttles had landed (replay parity).
+        if cadence is not None:
+            if isinstance(cadence, dict):
+                self.cadence_base = int(cadence.get("base_period", 1))
+                self.cadence_cap = int(cadence.get("max_staleness", 4))
+                periods = cadence.get("periods", ())
+            else:
+                self.cadence_base = int(getattr(cadence, "base_period", 1))
+                self.cadence_cap = int(cadence.max_staleness)
+                periods = cadence.periods
+            self.cadence_periods: Dict[int, int] = {
+                i: int(p) for i, p in enumerate(periods)}
+        else:
+            self.cadence_base = 1
+            self.cadence_cap = 0
+            self.cadence_periods = {}
+        self.cadence = cadence is not None
         self._last_step: Dict[str, int] = {}
         self._healthy_streak = 0
         self._deviated = False          # schedule moved off base_mode
@@ -392,6 +421,43 @@ class PolicyEngine:
                     f"{cfg.residual_low:g}): re-arming toward full-rate "
                     f"gossip"))
 
+        # -- cadence knob ----------------------------------------------------
+        # the PR 16 deferral: a straggler VERDICT lowers the flagged
+        # rank's async cadence through the CadenceScheduler — bounded by
+        # its max_staleness cap, restored when the verdict clears
+        if self.cadence:
+            stragglers = [v for v in relevant if v.rule == "straggler"
+                          and getattr(v, "rank", None) is not None]
+            if stragglers and self._cool("cadence", step):
+                worst = max(stragglers, key=lambda v: float(v.value))
+                rank = int(worst.rank)
+                want = min(max(self.cadence_base,
+                               math.ceil(float(worst.value))),
+                           self.cadence_cap)
+                if want != self.cadence_periods.get(rank,
+                                                    self.cadence_base):
+                    out.append(self._decide(
+                        step, "cadence", "throttle", [rank, want],
+                        "straggler",
+                        f"rank {rank} runs {float(worst.value):.3g}x the "
+                        f"fleet median step: lowering its async cadence "
+                        f"to every {want} ticks (capped by "
+                        f"max_staleness {self.cadence_cap})"))
+            elif (not stragglers
+                    and self._healthy_streak >= cfg.rearm_after
+                    and self._cool("cadence", step)):
+                throttled = sorted(
+                    r for r, p in self.cadence_periods.items()
+                    if p != self.cadence_base)
+                if throttled:
+                    rank = throttled[0]
+                    out.append(self._decide(
+                        step, "cadence", "rearm",
+                        [rank, self.cadence_base], "rearm",
+                        f"straggler verdict cleared: restoring rank "
+                        f"{rank} to the base cadence "
+                        f"({self.cadence_base})"))
+
         # an evaluation that INTERVENED is not a healthy steady state:
         # the re-arm streak starts counting after the last correction
         if any(d.action != "rearm" for d in out):
@@ -400,11 +466,20 @@ class PolicyEngine:
         return out
 
     def _decide(self, step, knob, action, value, rule, reason) -> Decision:
-        prev = self.sched_mode if knob == "schedule" else self.gamma_scale
+        if knob == "schedule":
+            prev = self.sched_mode
+        elif knob == "cadence":
+            rank = int(value[0])
+            prev = [rank, self.cadence_periods.get(rank,
+                                                   self.cadence_base)]
+        else:
+            prev = self.gamma_scale
         d = Decision(step=int(step), knob=knob, action=action, value=value,
                      prev=prev, rule=rule, reason=reason)
         if knob == "schedule":
             self.sched_mode = value
+        elif knob == "cadence":
+            self.cadence_periods[int(value[0])] = int(value[1])
         else:
             self.gamma_scale = float(value)
         self._last_step[knob] = int(step)
@@ -420,12 +495,20 @@ class PolicyEngine:
     def describe(self) -> dict:
         """The replayable engine identity (the ``control_config`` head
         record of a decision trail)."""
-        return {
+        out = {
             "modes": list(self.modes),
             "initial_mode": self.base_mode,
             "gamma": self.gamma,
             "cfg": self.cfg.asdict(),
         }
+        if self.cadence:
+            out["cadence"] = {
+                "base_period": self.cadence_base,
+                "max_staleness": self.cadence_cap,
+                "periods": [self.cadence_periods[i]
+                            for i in sorted(self.cadence_periods)],
+            }
+        return out
 
 
 # ---------------------------------------------------------------------------

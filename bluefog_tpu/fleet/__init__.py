@@ -1,0 +1,39 @@
+"""Multi-process fleet runtime: real OS processes behind ``bf.init``.
+
+Everything below this package used to live inside ONE Python process on
+a virtual mesh.  ``bluefog_tpu.fleet`` is the jump to a supervised
+fleet of OS processes (the reference's coordinator + launcher layers,
+PAPER.md layers 2 and 6, in SPMD-native form):
+
+- :mod:`.bootstrap` — the single ``jax.distributed.initialize`` call
+  site: ``bf.init(fleet=...)`` resolves ``BLUEFOG_FLEET_*`` env or a
+  :class:`~bluefog_tpu.fleet.bootstrap.FleetSpec`, dials the
+  coordinator with bounded retry/backoff, and degrades loudly with a
+  structured diagnosis.
+- :mod:`.peers` — per-process gossip transport: each process publishes
+  its telemetry-plane row over loopback UDP and merges neighbors' with
+  the plane's own newest-version-wins rule
+  (:func:`~bluefog_tpu.observability.plane.host_merge`), yielding a
+  local :class:`~bluefog_tpu.observability.plane.FleetViewLive` that
+  per-process ``RequestRouter``\\ s consume via ``observe_plane`` — no
+  shared filesystem.
+- :mod:`.supervisor` — ``bfrun --fleet N``: spawns N workers with
+  per-process env, hears heartbeats, reaps deaths via ``waitpid``,
+  drives the elastic-membership announce→sync→activate protocol from
+  REAL process lifecycle, respawns with ``--respawn``, fans out
+  SIGTERM, aggregates exit codes, and writes the ``fleet.jsonl`` trail
+  ``bfmonitor --fleet`` renders.
+- :mod:`.worker` — the demo fleet worker ``make fleet-smoke`` runs:
+  train steps + plane gossip + a local serving router per process.
+
+See docs/running.md "Fleet mode".
+"""
+
+from .bootstrap import (FleetSpec, FleetBootstrapError,  # noqa: F401
+                        resolve_fleet_spec, ensure_initialized,
+                        last_diagnosis)
+from .peers import PlanePeer, parse_peer_map, format_peer_map  # noqa: F401
+
+__all__ = ["FleetSpec", "FleetBootstrapError", "resolve_fleet_spec",
+           "ensure_initialized", "last_diagnosis", "PlanePeer",
+           "parse_peer_map", "format_peer_map"]

@@ -1,0 +1,259 @@
+"""Fleet bring-up: THE ``jax.distributed.initialize`` call site.
+
+``bf.init(fleet=...)`` lands here.  The launcher (``bfrun`` multi-host,
+or the ``--fleet`` supervisor) wires per-process env; this module
+resolves it into a :class:`FleetSpec`, dials the coordinator with
+bounded retry/backoff, and stamps the join outcome into a structured
+diagnosis record (the bench ladder's skip-record idiom: machine-readable
+evidence of WHY a bring-up degraded, not a stack trace in a log).
+
+This is the single bring-up path by contract: bflint's
+``distributed-init-outside-bootstrap`` rule rejects any other call to
+``jax.distributed.initialize`` in the package, so there is exactly one
+place where a process can join (or fail to join) the job — retries,
+NIC pinning, and idempotence live here and nowhere else.
+
+Env resolution (``BLUEFOG_FLEET_*`` wins over the legacy names bfrun's
+multi-host path exports; docs/env_variable.md "Fleet bring-up"):
+
+=============================  ============================================
+``BLUEFOG_FLEET_COORDINATOR``  ``host:port`` (falls back to
+                               ``BLUEFOG_COORDINATOR``)
+``BLUEFOG_FLEET_NUM_PROCESSES``  job size (falls back to
+                               ``BLUEFOG_NUM_PROCESSES``)
+``BLUEFOG_FLEET_PROCESS_ID``   this process (falls back to
+                               ``BLUEFOG_PROCESS_ID``)
+``BLUEFOG_FLEET_CONNECT_RETRIES``  dial attempts (default 3)
+``BLUEFOG_FLEET_CONNECT_BACKOFF``  base seconds between attempts,
+                               doubling (default 1.0)
+``BLUEFOG_FLEET_CONNECT_TIMEOUT``  per-attempt coordinator timeout in
+                               seconds (default: the runtime's own)
+=============================  ============================================
+
+Works on the CPU backend: ``JAX_PLATFORMS=cpu`` plus
+``XLA_FLAGS=--xla_force_host_platform_device_count=K`` gives every
+process K virtual devices, so the whole fleet story is CI-testable with
+no TPU (docs/running.md "Fleet mode").
+"""
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger("bluefog_tpu")
+
+__all__ = ["FleetSpec", "FleetBootstrapError", "resolve_fleet_spec",
+           "ensure_initialized", "started", "last_diagnosis",
+           "reset_for_testing"]
+
+# set once the runtime joined (or was found already joined): the
+# double-call guard bf.init()'s re-entry rides on
+_started = False
+_last_diagnosis: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class FleetSpec:
+    """One process's view of the fleet job: everything
+    ``jax.distributed.initialize`` needs, plus the dial policy.
+
+    ``coordinator`` ``None``/empty means "no fleet" — bring-up is a
+    no-op and the process runs single-controller (the seed behavior).
+    """
+    coordinator: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    network_interface: Optional[str] = None
+    connect_retries: int = 3
+    connect_backoff_s: float = 1.0
+    connect_timeout_s: Optional[float] = None
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetBootstrapError(RuntimeError):
+    """The coordinator never answered within the retry budget.  Carries
+    the structured ``diagnosis`` record (also banked in
+    :func:`last_diagnosis`) so a supervisor or smoke harness can degrade
+    loudly instead of parsing an exception string."""
+
+    def __init__(self, diagnosis: dict):
+        super().__init__(json.dumps(diagnosis))
+        self.diagnosis = diagnosis
+
+
+def _env(name: str, legacy: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    if v is None and legacy is not None:
+        v = os.environ.get(legacy)
+    return v
+
+
+def resolve_fleet_spec(fleet=None) -> Optional[FleetSpec]:
+    """Resolve the bring-up spec: an explicit :class:`FleetSpec` (or
+    dict) wins, else the ``BLUEFOG_FLEET_*`` env family with the legacy
+    ``BLUEFOG_COORDINATOR`` / ``_NUM_PROCESSES`` / ``_PROCESS_ID``
+    names (bfrun's multi-host exports) as fallback.  Returns ``None``
+    when no coordinator is configured anywhere — single-process mode."""
+    if isinstance(fleet, FleetSpec):
+        return fleet
+    if isinstance(fleet, dict):
+        return FleetSpec(**fleet)
+    if fleet is not None:
+        raise TypeError(
+            f"fleet must be a FleetSpec, a dict, or None, got "
+            f"{type(fleet).__name__}")
+    coordinator = _env("BLUEFOG_FLEET_COORDINATOR", "BLUEFOG_COORDINATOR")
+    if not coordinator:
+        return None
+    timeout = _env("BLUEFOG_FLEET_CONNECT_TIMEOUT")
+    return FleetSpec(
+        coordinator=coordinator,
+        num_processes=int(_env("BLUEFOG_FLEET_NUM_PROCESSES",
+                               "BLUEFOG_NUM_PROCESSES") or 1),
+        process_id=int(_env("BLUEFOG_FLEET_PROCESS_ID",
+                            "BLUEFOG_PROCESS_ID") or 0),
+        network_interface=os.environ.get("BLUEFOG_NETWORK_INTERFACE"),
+        connect_retries=int(_env("BLUEFOG_FLEET_CONNECT_RETRIES") or 3),
+        connect_backoff_s=float(_env("BLUEFOG_FLEET_CONNECT_BACKOFF")
+                                or 1.0),
+        connect_timeout_s=float(timeout) if timeout else None,
+    )
+
+
+def _initialize(spec: FleetSpec) -> None:
+    """The one real call (tests monkeypatch this seam to drive the
+    guard paths without a live coordinator)."""
+    import jax
+    kwargs = {}
+    if spec.network_interface and spec.process_id == 0:
+        # Pin the coordinator's LISTENING socket to the chosen NIC
+        # (bfrun --network-interface; reference run.py:84-118 pins
+        # NCCL/gloo ifaces the same way).  Resolved here, on the
+        # coordinator's own machine — the launcher cannot know a remote
+        # host's addresses.
+        from ..run.network_util import interface_address
+        port = spec.coordinator.rsplit(":", 1)[1]
+        kwargs["coordinator_bind_address"] = (
+            f"{interface_address(spec.network_interface)}:{port}")
+    if spec.connect_timeout_s is not None:
+        kwargs["initialization_timeout"] = spec.connect_timeout_s
+    jax.distributed.initialize(
+        coordinator_address=spec.coordinator,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id, **kwargs)
+
+
+def _benign(err: RuntimeError) -> bool:
+    """Only "already initialized / called too late" is benign (the user
+    or a previous bf.init did it).  A coordinator connection failure
+    must NOT be swallowed — proceeding would silently train each host
+    independently."""
+    msg = str(err).lower()
+    # covers "distributed.initialize should only be called once." and
+    # older "already initialized" / ordering phrasings
+    return ("only be called once" in msg or "already" in msg
+            or "must be called before" in msg)
+
+
+def _retryable(err: Exception) -> bool:
+    """Coordinator-unreachable shapes worth another dial: connection
+    refusals/timeouts surface as RuntimeError/ConnectionError with
+    transport wording, depending on the jaxlib build."""
+    if isinstance(err, (ConnectionError, TimeoutError, OSError)):
+        return True
+    msg = str(err).lower()
+    return any(tok in msg for tok in (
+        "unavailable", "deadline", "timed out", "timeout",
+        "connection refused", "failed to connect", "unreachable"))
+
+
+def ensure_initialized(fleet=None) -> dict:
+    """Idempotent fleet bring-up; returns the structured diagnosis.
+
+    ``status`` values: ``"ok"`` (this call joined the job),
+    ``"noop"`` (no coordinator configured, or a previous call already
+    joined), ``"adopted"`` (the runtime was initialized by someone
+    else — the benign-RuntimeError branch, logged as a warning).  On a
+    coordinator that never answers, raises :class:`FleetBootstrapError`
+    after ``connect_retries`` dials with doubling backoff — the
+    diagnosis rides the exception AND :func:`last_diagnosis`."""
+    global _started, _last_diagnosis
+    if _started:
+        return {"kind": "fleet_bootstrap", "status": "noop",
+                "reason": "already started in this process"}
+    spec = resolve_fleet_spec(fleet)
+    if spec is None or not spec.coordinator:
+        return {"kind": "fleet_bootstrap", "status": "noop",
+                "reason": "no coordinator configured"}
+    diagnosis = {
+        "kind": "fleet_bootstrap",
+        "coordinator": spec.coordinator,
+        "num_processes": int(spec.num_processes),
+        "process_id": int(spec.process_id),
+        "attempts": 0,
+    }
+    last_err: Optional[Exception] = None
+    for attempt in range(1, max(1, int(spec.connect_retries)) + 1):
+        diagnosis["attempts"] = attempt
+        try:
+            _initialize(spec)
+        except RuntimeError as e:
+            if _benign(e):
+                logger.warning("jax.distributed.initialize skipped: %s", e)
+                _started = True
+                diagnosis.update(status="adopted", reason=str(e))
+                _last_diagnosis = diagnosis
+                return diagnosis
+            if not _retryable(e):
+                diagnosis.update(status="error", reason=str(e))
+                _last_diagnosis = diagnosis
+                raise
+            last_err = e
+        except Exception as e:
+            if not _retryable(e):
+                diagnosis.update(status="error", reason=str(e))
+                _last_diagnosis = diagnosis
+                raise
+            last_err = e
+        else:
+            _started = True
+            diagnosis.update(status="ok")
+            _last_diagnosis = diagnosis
+            return diagnosis
+        if attempt < spec.connect_retries:
+            delay = spec.connect_backoff_s * (2 ** (attempt - 1))
+            logger.warning(
+                "fleet bootstrap: coordinator %s unreachable "
+                "(attempt %d/%d): %s — retrying in %.1fs",
+                spec.coordinator, attempt, spec.connect_retries,
+                last_err, delay)
+            time.sleep(delay)
+    # degrade loudly: a structured record, not a bare traceback
+    diagnosis.update(status="unreachable", reason=str(last_err))
+    _last_diagnosis = diagnosis
+    logger.error("fleet bootstrap failed: %s", json.dumps(diagnosis))
+    raise FleetBootstrapError(diagnosis)
+
+
+def started() -> bool:
+    """Whether this process already ran the bring-up (or adopted an
+    externally-initialized runtime)."""
+    return _started
+
+
+def last_diagnosis() -> Optional[dict]:
+    """The newest bring-up diagnosis record (None before any dial)."""
+    return _last_diagnosis
+
+
+def reset_for_testing() -> None:
+    """Clear the module guard — test isolation only; resetting a live
+    process does NOT tear down the jax.distributed runtime."""
+    global _started, _last_diagnosis
+    _started = False
+    _last_diagnosis = None

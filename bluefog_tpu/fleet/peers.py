@@ -1,0 +1,263 @@
+"""Per-process plane gossip: the telemetry plane between OS processes.
+
+The PR 19 plane gossips ``[N, WIRE]`` telemetry rows *inside* one SPMD
+program via collective-permutes.  A fleet of real OS processes — each
+on its own virtual mesh — has no shared program to permute through, so
+:class:`PlanePeer` carries the SAME wire rows over loopback UDP and
+merges them with the SAME newest-version-wins rule
+(:func:`~bluefog_tpu.observability.plane.host_merge`, the exact
+``plane_exchange`` merge factored out for host transports).  Each
+process ends up holding a local
+:class:`~bluefog_tpu.observability.plane.FleetViewLive`, so its
+``RequestRouter`` consumes cross-process liveness/staleness/edge-cost
+state through the existing ``observe_plane`` — no shared filesystem,
+convergence within the gossip diameter (all-to-all datagrams here:
+diameter 1 per poll).
+
+Death detection is purely emergent: a SIGKILLed process stops
+publishing, its row's version freezes everywhere, the age
+(``step - last_heard``) passes ``BLUEFOG_PLANE_MAX_AGE`` and the row
+goes stale → ``alive_mask`` drops it fleet-wide.  A respawned process
+calls :meth:`PlanePeer.resume_clock` so its fresh rows republish at a
+HIGHER version than its dead incarnation's (the plane's elastic
+re-join rule) and win every merge.
+
+Env (docs/env_variable.md "Fleet bring-up"): ``BLUEFOG_FLEET_PEERS``
+(``rank=host:port`` comma list), ``BLUEFOG_FLEET_RANK``,
+``BLUEFOG_FLEET_SIZE`` — the supervisor exports all three.
+"""
+
+import os
+import socket
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..observability import plane as P
+from ..observability import aggregate as AG
+
+__all__ = ["PEERS_ENV", "RANK_ENV", "SIZE_ENV", "parse_peer_map",
+           "format_peer_map", "PlanePeer"]
+
+PEERS_ENV = "BLUEFOG_FLEET_PEERS"
+RANK_ENV = "BLUEFOG_FLEET_RANK"
+SIZE_ENV = "BLUEFOG_FLEET_SIZE"
+
+# datagram: magic, fleet size, effective step, then the [N, WIRE] f32
+# table — one row-set per send, merged whole on receive
+_MAGIC = 0xB1F0E7
+_HEADER = struct.Struct("<III")
+
+
+def parse_peer_map(text: str) -> Dict[int, Tuple[str, int]]:
+    """``"0=127.0.0.1:5000,1=127.0.0.1:5001"`` → rank → (host, port)."""
+    peers: Dict[int, Tuple[str, int]] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rank, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        peers[int(rank)] = (host, int(port))
+    return peers
+
+
+def format_peer_map(peers: Dict[int, Tuple[str, int]]) -> str:
+    """Inverse of :func:`parse_peer_map` (supervisor → worker env)."""
+    return ",".join(f"{r}={h}:{p}"
+                    for r, (h, p) in sorted(peers.items()))
+
+
+class PlanePeer:
+    """One process's plane endpoint: a ``[N, WIRE]`` local table over a
+    nonblocking UDP socket.
+
+    Mirrors :class:`~bluefog_tpu.observability.plane.TelemetryPlane`'s
+    publish/observe/view surface so consumers can't tell which
+    transport fed them; only the exchange differs (datagrams +
+    :func:`~bluefog_tpu.observability.plane.host_merge` instead of
+    collective-permutes)."""
+
+    def __init__(self, rank: Optional[int] = None,
+                 size: Optional[int] = None,
+                 peers: Optional[Dict[int, Tuple[str, int]]] = None, *,
+                 max_age: Optional[int] = None,
+                 window: Optional[int] = None):
+        if peers is None:
+            text = os.environ.get(PEERS_ENV, "")
+            peers = parse_peer_map(text) if text else {}
+        if rank is None:
+            rank = int(os.environ.get(RANK_ENV, "0"))
+        if size is None:
+            env_size = os.environ.get(SIZE_ENV)
+            size = int(env_size) if env_size else (
+                max(peers) + 1 if peers else 1)
+        self.rank = int(rank)
+        self.size = int(size)
+        self.peers = dict(peers)
+        self.max_age = P.resolve_max_age(max_age)
+        self.window = P.resolve_window(window)
+        self.table = np.zeros((self.size, P.WIRE), np.float32)
+        self.last_heard = np.zeros((self.size,), np.int64)
+        self.step = 0
+        self._base = 0              # resume_clock fast-forward offset
+        self._records: Dict[int, Dict[int, dict]] = {}
+        self._sock: Optional[socket.socket] = None
+        if self.rank in self.peers:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+            self._sock.bind(self.peers[self.rank])
+            self._sock.setblocking(False)
+
+    # -- clock ---------------------------------------------------------------
+
+    def _eff(self, step: int) -> int:
+        return int(step) + self._base
+
+    def eff_step(self, step: int) -> int:
+        """The effective (resume-adjusted) plane step for a local step —
+        what publishes stamp and what views/ages are measured in."""
+        return self._eff(step)
+
+    def resume_clock(self, step: int = 0) -> int:
+        """Fast-forward the effective clock past every version already
+        circulating (poll first so the table holds the fleet's view of
+        the dead incarnation).  The next publish then stamps a strictly
+        higher version, so the respawned process's rows win merges
+        everywhere — the plane's elastic re-join rule, across OS
+        processes.  Returns the new effective step."""
+        max_ver = int(self.table[:, P.LANE_VERSION].max())
+        want = max(max_ver, self.max_age + 1)
+        if self._eff(step) <= want:
+            self._base = want - int(step) + 1
+        return self._eff(step)
+
+    def chase_clock(self, step: int) -> int:
+        """Re-align the effective clock with the freshest OTHER source.
+        A one-shot :meth:`resume_clock` is not enough for a respawned
+        process: any bring-up stall between the resume and its first
+        publish (a compile, a scheduler hiccup) leaves its clock a
+        stall's worth of steps behind the fleet FOREVER, and every
+        staleness machine keyed on effective steps keeps reading it as
+        dead.  Own publishes don't count, so a process that is already
+        caught up (or alone) never ratchets itself.  No-op unless
+        strictly behind."""
+        others = np.delete(self.table[:, P.LANE_VERSION], self.rank)
+        if others.size and int(others.max()) > self._eff(step) + 1:
+            self._base = int(others.max()) - int(step)
+        return self._eff(step)
+
+    # -- exchange ------------------------------------------------------------
+
+    def publish(self, payload, step: int, *, poll: bool = True
+                ) -> np.ndarray:
+        """Stamp this process's ``[WIDTH]`` payload row (see
+        :func:`~bluefog_tpu.observability.plane.pack_payload`) into the
+        local table at ``version = step + 1``, datagram the whole table
+        to every peer, then (by default) drain + merge what arrived and
+        snapshot the view history."""
+        eff = self._eff(step)
+        row = np.zeros((P.WIRE,), np.float32)
+        row[:P.WIDTH] = np.asarray(payload, np.float32)
+        row[P.LANE_VERSION] = eff + 1
+        row[P.LANE_HOP] = 0.0
+        self.table[self.rank] = row
+        self.last_heard[self.rank] = eff
+        packet = (_HEADER.pack(_MAGIC, self.size, eff)
+                  + self.table.tobytes())
+        if self._sock is not None:
+            for r, addr in self.peers.items():
+                if r == self.rank:
+                    continue
+                try:
+                    self._sock.sendto(packet, addr)
+                except OSError:
+                    pass            # peer gone: death is detected by age
+        if poll:
+            self.poll(step)
+        self.observe(step)
+        return self.table
+
+    def poll(self, step: int) -> int:
+        """Drain the socket and :func:`host_merge` every received table
+        into the local one.  Returns the number of merged datagrams."""
+        if self._sock is None:
+            return 0
+        eff = self._eff(step)
+        want = self.size * P.WIRE * 4
+        merged = 0
+        while True:
+            try:
+                data, _ = self._sock.recvfrom(_HEADER.size + want + 64)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break
+            if len(data) != _HEADER.size + want:
+                continue
+            magic, size, _sender_step = _HEADER.unpack_from(data)
+            if magic != _MAGIC or size != self.size:
+                continue
+            received = np.frombuffer(
+                data, np.float32, count=self.size * P.WIRE,
+                offset=_HEADER.size).reshape(self.size, P.WIRE)
+            self.table, self.last_heard = P.host_merge(
+                self.table, received, self.last_heard, eff)
+            merged += 1
+        return merged
+
+    # -- observation (the TelemetryPlane surface) ----------------------------
+
+    def _state(self) -> dict:
+        # snapshot()'s [N, N, WIRE] layout with a single local row-set
+        return {"table": self.table[None],
+                "last_heard": self.last_heard[None]}
+
+    def observe(self, step: int):
+        """Snapshot the local table into the rolling per-source history
+        (window-bounded, like ``TelemetryPlane.observe``)."""
+        self.step = self._eff(step)
+        recs = P.snapshot(self._state(), self.step, rank=0,
+                          max_age=self.max_age)
+        for rec in recs:
+            by_step = self._records.setdefault(rec["rank"], {})
+            by_step[rec["step"]] = rec
+            for old in sorted(by_step)[:-self.window]:
+                del by_step[old]
+        return recs
+
+    def per_source(self) -> Dict[int, dict]:
+        meta = {}
+        for rec in P.snapshot(self._state(), self.step, rank=0,
+                              max_age=self.max_age):
+            meta[rec["rank"]] = {
+                "version": rec["plane_version"], "age": rec["plane_age"],
+                "hop": rec["plane_hop"], "stale": rec["plane_stale"],
+                "step": rec["step"],
+            }
+        return meta
+
+    def view(self, *, expected_ranks: Optional[int] = None
+             ) -> P.FleetViewLive:
+        """This process's plane-backed FleetView — hand it straight to
+        ``RequestRouter.observe_plane`` / ``health.evaluate``."""
+        series = []
+        for src in sorted(self._records):
+            recs = [self._records[src][s]
+                    for s in sorted(self._records[src])]
+            series.append(AG.RankSeries(rank=src, records=recs))
+        return P.FleetViewLive(series, [], expected_ranks or self.size,
+                               self.per_source(), self.step)
+
+    def versions(self) -> np.ndarray:
+        """[N] per-source versions in this process's view."""
+        return self.table[:, P.LANE_VERSION].copy()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

@@ -1,0 +1,347 @@
+"""``bfrun --fleet N`` — the local fleet supervisor.
+
+The reference launcher execve's ``mpirun`` and forgets its children;
+this supervisor OWNS them.  It spawns N worker OS processes with
+per-process env (fleet rank, peer map, per-rank metrics prefix), hears
+their UDP heartbeats directly, reaps deaths via ``waitpid``
+(``Popen.poll``), and drives the PR 13 elastic-membership protocol from
+REAL process lifecycle:
+
+* a worker that dies gets its ``rank_leave`` injected from an
+  actually-dead process (``ElasticMembership.leave`` on the reaped
+  exit, failure-as-departure);
+* with ``--respawn`` a replacement is launched and re-admits through
+  the full announce → sync → activate path — ``announce`` at spawn,
+  ``mark_synced`` when the worker's bootstrap sends the *synced*
+  datagram, activation when :meth:`ElasticMembership.observe_direct`
+  sees its heartbeats fresh again;
+* SIGTERM/SIGINT fan out to every child (grace period, then SIGKILL),
+  and exit codes aggregate: per rank the LAST incarnation's code wins
+  (a crashed rank whose respawn finished clean counts as recovered),
+  the fleet's code is the first nonzero by rank order.
+
+Every lifecycle action is banked as a ``fleet_event`` line in the
+:class:`~bluefog_tpu.observability.export.FleetTrail` that ``bfmonitor
+--fleet`` renders (docs/running.md "Fleet mode").
+"""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import export as _export
+from ..resilience.membership import (STATE_LEFT, ElasticMembership,
+                                     LivenessConfig)
+from . import peers as _peers
+
+__all__ = ["SUPERVISOR_ENV", "RESPAWN_COUNT_ENV", "HB_HEARTBEAT",
+           "HB_SYNCED", "send_heartbeat", "send_synced", "free_ports",
+           "FleetSupervisor", "run_fleet"]
+
+SUPERVISOR_ENV = "BLUEFOG_FLEET_SUPERVISOR"
+RESPAWN_COUNT_ENV = "BLUEFOG_FLEET_RESPAWN_COUNT"
+
+# heartbeat datagram: magic, kind, rank, step, pid
+_HB = struct.Struct("<IIIII")
+_HB_MAGIC = 0xB1F0FB
+HB_HEARTBEAT = 0
+HB_SYNCED = 1
+
+_hb_sock: Optional[socket.socket] = None
+
+
+def _heartbeat_addr() -> Optional[Tuple[str, int]]:
+    text = os.environ.get(SUPERVISOR_ENV)
+    if not text:
+        return None
+    host, port = text.rsplit(":", 1)
+    return (host, int(port))
+
+
+def send_heartbeat(step: int, *, rank: Optional[int] = None,
+                   kind: int = HB_HEARTBEAT) -> bool:
+    """Best-effort heartbeat datagram to the supervisor named by
+    ``BLUEFOG_FLEET_SUPERVISOR`` (no-op outside a fleet).  Returns
+    whether a datagram went out."""
+    global _hb_sock
+    addr = _heartbeat_addr()
+    if addr is None:
+        return False
+    if rank is None:
+        rank = int(os.environ.get(_peers.RANK_ENV, "0"))
+    if _hb_sock is None:
+        _hb_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        _hb_sock.sendto(
+            _HB.pack(_HB_MAGIC, int(kind), int(rank), int(step),
+                     os.getpid() & 0xFFFFFFFF), addr)
+        return True
+    except OSError:
+        return False
+
+
+def send_synced(step: int, *, rank: Optional[int] = None) -> bool:
+    """Report parameter-bootstrap completion (a respawned worker caught
+    up) — the supervisor maps it to ``ElasticMembership.mark_synced``,
+    the sync half of announce → sync → activate."""
+    return send_heartbeat(step, rank=rank, kind=HB_SYNCED)
+
+
+def free_ports(n: int, *, kind: int = socket.SOCK_DGRAM) -> List[int]:
+    """``n`` distinct currently-free loopback ports.  Held open until
+    all are allocated so the OS can't hand out duplicates."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, kind)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+class FleetSupervisor:
+    """Spawn, watch, respawn, and reap a fleet of worker processes.
+
+    ``env_for_rank(rank)`` supplies each worker's base environment
+    (platform flags, metrics prefix); the supervisor layers the fleet
+    family on top: ``BLUEFOG_FLEET_RANK`` / ``_SIZE`` / ``_PEERS`` /
+    ``_SUPERVISOR`` / ``_RESPAWN_COUNT``."""
+
+    def __init__(self, command: Sequence[str], size: int, *,
+                 respawn: bool = False, max_respawns: int = 1,
+                 trail_path: str = "fleet.jsonl",
+                 env_for_rank: Optional[Callable[[int], dict]] = None,
+                 cfg: Optional[LivenessConfig] = None,
+                 grace_s: float = 10.0, poll_s: float = 0.05):
+        self.command = list(command)
+        self.size = int(size)
+        self.respawn = bool(respawn)
+        self.max_respawns = int(max_respawns)
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s)
+        self._env_for_rank = env_for_rank or (lambda r: dict(os.environ))
+        self.peer_map = {r: ("127.0.0.1", p)
+                         for r, p in enumerate(free_ports(self.size))}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.setblocking(False)
+        self.addr = self._sock.getsockname()
+        # laxer-than-default staleness thresholds: the supervisor's
+        # clock spans OS processes whose effective step clocks are only
+        # loosely aligned (each paces itself), so a couple of steps of
+        # cross-process skew must not read as death
+        self.membership = ElasticMembership(
+            self.size, cfg=cfg or LivenessConfig(suspect_after=4,
+                                                 confirm_after=8))
+        self.trail = _export.FleetTrail(
+            trail_path, size=self.size, respawn=self.respawn,
+            max_respawns=self.max_respawns, command=self.command)
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.respawns = {r: 0 for r in range(self.size)}
+        self.final_rc: Dict[int, int] = {}
+        self.last_hb = np.zeros((self.size,), np.int64)
+        self._hb_logged = np.full((self.size,), -1, np.int64)
+        self._stop = False
+        self._term_sent = 0.0
+
+    # -- spawning ------------------------------------------------------------
+
+    def _worker_env(self, rank: int) -> dict:
+        env = self._env_for_rank(rank)
+        env.update({
+            _peers.RANK_ENV: str(rank),
+            _peers.SIZE_ENV: str(self.size),
+            _peers.PEERS_ENV: _peers.format_peer_map(self.peer_map),
+            SUPERVISOR_ENV: f"{self.addr[0]}:{self.addr[1]}",
+            RESPAWN_COUNT_ENV: str(self.respawns[rank]),
+        })
+        return env
+
+    def spawn(self, rank: int, *, event: str = "spawn"
+              ) -> subprocess.Popen:
+        proc = subprocess.Popen(self.command,
+                                env=self._worker_env(rank))
+        self.procs[rank] = proc
+        self.trail.write_event(event, rank=rank, pid=proc.pid,
+                               respawns=self.respawns[rank])
+        return proc
+
+    # -- liveness ------------------------------------------------------------
+
+    def _drain_heartbeats(self) -> None:
+        while True:
+            try:
+                data, _ = self._sock.recvfrom(_HB.size + 16)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if len(data) != _HB.size:
+                continue
+            magic, kind, rank, step, pid = _HB.unpack(data)
+            if magic != _HB_MAGIC or not 0 <= rank < self.size:
+                continue
+            self.last_hb[rank] = max(self.last_hb[rank], step)
+            if (self.membership.state_of(rank) == STATE_LEFT
+                    and self.procs.get(rank) is not None
+                    and self.procs[rank].poll() is None):
+                # the directory's joiner grace is measured in fleet
+                # steps, so a replacement whose interpreter boot
+                # outlasts it gets evicted before it ever speaks.  A
+                # datagram from a rank whose child process is alive is
+                # direct proof of life: re-announce it and let it walk
+                # announce -> sync -> activate again.
+                self._record(self.membership.announce(rank, step))
+            if kind == HB_SYNCED:
+                self.membership.mark_synced(rank)
+                self.trail.write_event("synced", rank=rank, pid=pid,
+                                       step=step)
+            elif step > self._hb_logged[rank]:
+                self._hb_logged[rank] = step
+                self.trail.write_event("heartbeat", rank=rank, pid=pid,
+                                       step=step)
+
+    def _observe(self) -> None:
+        clock = int(self.last_hb.max())
+        for tr_step, rank, state in self.membership.observe_direct(
+                self.last_hb, clock):
+            self.trail.write_event("membership", rank=rank,
+                                   step=tr_step, transition=state)
+
+    def _record(self, transition) -> None:
+        if transition is not None:
+            tr_step, rank, state = transition
+            self.trail.write_event("membership", rank=rank, step=tr_step,
+                                   transition=state)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _reap(self) -> None:
+        clock = int(self.last_hb.max())
+        for rank, proc in list(self.procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del self.procs[rank]
+            self.final_rc[rank] = rc
+            self.trail.write_event("exit", rank=rank, pid=proc.pid,
+                                   rc=rc)
+            if rc == 0 or self._stop:
+                # orderly departure (clean finish, or our own fan-out)
+                self._record(self.membership.leave(rank, clock))
+                continue
+            # an actually-dead process: rank_leave driven by waitpid
+            self._record(self.membership.leave(rank, clock))
+            if self.respawn and self.respawns[rank] < self.max_respawns:
+                self.respawns[rank] += 1
+                self.spawn(rank, event="respawn")
+                # replacement re-enters through announce -> sync ->
+                # activate; sync arrives as its HB_SYNCED datagram
+                self._record(self.membership.announce(rank, clock))
+
+    def terminate(self) -> None:
+        """Orderly shutdown: SIGTERM fan-out now, SIGKILL stragglers
+        after the grace period (driven by the run loop)."""
+        self._stop = True
+        if self._term_sent:
+            return
+        self._term_sent = time.monotonic()
+        for rank, proc in self.procs.items():
+            if proc.poll() is None:
+                self.trail.write_event("terminate", rank=rank,
+                                       pid=proc.pid)
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+
+    def _enforce_grace(self) -> None:
+        if (not self._term_sent
+                or time.monotonic() - self._term_sent < self.grace_s):
+            return
+        for rank, proc in self.procs.items():
+            if proc.poll() is None:
+                self.trail.write_event("kill", rank=rank, pid=proc.pid)
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+    def aggregate_rc(self) -> int:
+        """First nonzero LAST-incarnation exit code by rank order — a
+        crashed rank whose respawned replacement finished clean counts
+        as recovered."""
+        for rank in range(self.size):
+            rc = self.final_rc.get(rank, 0)
+            if rc != 0:
+                return rc
+        return 0
+
+    def run(self) -> int:
+        prev_int = signal.signal(signal.SIGINT,
+                                 lambda *_: self.terminate())
+        prev_term = signal.signal(signal.SIGTERM,
+                                  lambda *_: self.terminate())
+        try:
+            for rank in range(self.size):
+                self.spawn(rank)
+            while self.procs:
+                self._drain_heartbeats()
+                self._observe()
+                self._reap()
+                self._enforce_grace()
+                if self.procs:
+                    time.sleep(self.poll_s)
+            self._drain_heartbeats()
+            rc = self.aggregate_rc()
+            self.trail.write_event("done", rc=rc)
+            return rc
+        finally:
+            signal.signal(signal.SIGINT, prev_int)
+            signal.signal(signal.SIGTERM, prev_term)
+            self._sock.close()
+
+
+def run_fleet(args, prog: str = "bfrun") -> int:
+    """The ``bfrun --fleet N`` entry: build per-rank worker envs from
+    the common bfrun flags (each worker gets its own FULL-size virtual
+    device view — fleet workers run independent meshes and share state
+    over the plane gossip, not a gang collective) and supervise."""
+    from ..run.run import _apply_common_flags
+    size = int(args.fleet)
+    if size < 1:
+        raise SystemExit(f"{prog}: --fleet needs at least 1 process")
+    base_prefix = os.environ.get("BLUEFOG_METRICS")
+
+    def env_for_rank(rank: int) -> dict:
+        env = dict(os.environ)
+        _apply_common_flags(args, env, args.num_proc or size)
+        env["BLUEFOG_EXPECTED_SIZE"] = str(args.num_proc or size)
+        if base_prefix:
+            env["BLUEFOG_METRICS"] = f"{base_prefix}rank{rank}-"
+        return env
+
+    trail_path = (getattr(args, "fleet_trail", None)
+                  or (f"{base_prefix}{_export.FLEET_SUFFIX}"
+                      if base_prefix else _export.FLEET_SUFFIX))
+    sup = FleetSupervisor(
+        args.command, size,
+        respawn=bool(getattr(args, "respawn", False)),
+        max_respawns=int(getattr(args, "max_respawns", 1) or 1),
+        trail_path=trail_path, env_for_rank=env_for_rank)
+    if getattr(args, "verbose", False):
+        print(f"{prog}: fleet of {size} -> {trail_path} "
+              f"(heartbeats on {sup.addr[0]}:{sup.addr[1]})",
+              file=sys.stderr)
+    return sup.run()

@@ -1,0 +1,190 @@
+"""The demo fleet worker (``python -m bluefog_tpu.fleet.worker``).
+
+One OS process of the ``make fleet-smoke`` fleet: it trains (a jitted
+step whose compile count is asserted — process death elsewhere must
+never recompile a survivor), gossips its telemetry-plane row to its
+peers over :class:`~bluefog_tpu.fleet.peers.PlanePeer`, runs the FULL
+serving tier locally with a :class:`RequestRouter` whose liveness comes
+from the local gossiped view (``observe_plane`` — no shared
+filesystem), heartbeats the supervisor, and banks a per-incarnation
+result JSON the smoke harness asserts on.
+
+A respawned incarnation (``BLUEFOG_FLEET_RESPAWN_COUNT > 0``) first
+listens for the surviving fleet's gossip, fast-forwards its plane clock
+past its dead incarnation's versions (:meth:`PlanePeer.resume_clock`),
+and reports bootstrap completion with the *synced* datagram — the sync
+half of the supervisor's announce → sync → activate re-admission.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+from . import peers as _peers
+from . import supervisor as _sup
+
+__all__ = ["main"]
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="bluefog-fleet-worker",
+        description="demo worker for bfrun --fleet / make fleet-smoke")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--step-ms", type=float, default=40.0,
+                    help="wall-clock pacing per step (keeps the fleet's "
+                         "plane clocks roughly aligned)")
+    ap.add_argument("--out", default=".",
+                    help="directory for the per-incarnation result JSON")
+    ap.add_argument("--sync-steps", type=int, default=3,
+                    help="respawned incarnation: steps of fresh gossip "
+                         "to fold before reporting synced")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    rank = int(os.environ.get(_peers.RANK_ENV, "0"))
+    size = int(os.environ.get(_peers.SIZE_ENV, "1"))
+    respawns = int(os.environ.get(_sup.RESPAWN_COUNT_ENV, "0"))
+
+    import jax
+    import jax.numpy as jnp
+    import bluefog_tpu as bf
+    from ..observability import plane as P
+    from ..resilience import LivenessConfig
+    from ..serving import (NoReplicaAvailable, ReplicaDeadError,
+                           RequestRouter, ReplicaSet, StaleReplicaError,
+                           WeightPublisher)
+
+    bf.init()
+    n = bf.size()
+
+    stop = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *_: stop.update(flag=True))
+
+    peer = _peers.PlanePeer(rank, size)
+    readmitted = False
+
+    @jax.jit
+    def train_step(x, t):
+        mixed = 0.5 * (x + jnp.roll(x, 1, axis=0))
+        return mixed + 0.001 * jnp.sin(t)
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 8)), jnp.float32)
+
+    params = {"w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)}
+    # publisher/replica roles must be disjoint (serving_topology)
+    if n >= 4:
+        pubs, reps = [0, 1], [n - 2, n - 1]
+    else:
+        pubs, reps = [0], [n - 1]
+    pub = WeightPublisher(params, pubs, reps)
+    rs = ReplicaSet(pub, lambda p, b: b @ p["w"] + p["b"],
+                    max_staleness=64)
+    liveness = LivenessConfig(suspect_after=2, confirm_after=4)
+    router = RequestRouter(rs, prefix=os.environ.get("BLUEFOG_METRICS"),
+                           liveness=liveness)
+    batch = jnp.ones((1, 4), jnp.float32)
+
+    # pay the one compile BEFORE resuming the plane clock: everything
+    # between resume_clock and the first publish is wall time the
+    # surviving fleet keeps stepping through, and an effective clock
+    # that starts a compile's worth of steps behind the fleet stays
+    # behind it forever (the supervisor's staleness machine would keep
+    # evicting the replacement as a stale joiner)
+    train_step(x, jnp.float32(0)).block_until_ready()
+
+    if respawns > 0:
+        # listen for the survivors before speaking: resume_clock needs
+        # the fleet's circulating versions (including the dead
+        # incarnation's frozen row) in the table
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not stop["flag"]:
+            peer.poll(0)
+            if any(v > 0 for i, v in enumerate(peer.versions())
+                   if i != rank):
+                break
+            time.sleep(0.02)
+        peer.resume_clock(0)
+
+    ok = failed = steps_done = 0
+    served_by = {}
+    seen_alive = set()
+    dead_seen = set()
+
+    for step in range(args.steps):
+        if stop["flag"]:
+            break
+        x = train_step(x, jnp.float32(step))
+        if respawns > 0:
+            # keep the resumed clock glued to the fleet's: bring-up
+            # stalls after resume_clock would otherwise leave this
+            # incarnation permanently behind the supervisor's clock
+            peer.chase_clock(step)
+        eff = peer.eff_step(step)
+        peer.publish(P.pack_payload(eff, staleness=0.0), step)
+        view = peer.view()
+
+        mask = view.alive_mask(liveness.suspect_after)
+        for r in range(size):
+            if r == rank:
+                continue
+            if mask[r] > 0:
+                seen_alive.add(r)
+            elif r in seen_alive:
+                dead_seen.add(r)
+
+        pub.publish(params, eff)
+        rs.refresh(eff)
+        router.observe_plane(view, step=eff)
+        try:
+            _, replica = router.route(batch, eff)
+            ok += 1
+            served_by[replica] = served_by.get(replica, 0) + 1
+        except (NoReplicaAvailable, ReplicaDeadError,
+                StaleReplicaError):
+            failed += 1
+
+        if respawns > 0 and len(seen_alive) >= min(2, size - 1):
+            # re-send on a cadence, not once: an early synced datagram
+            # can land mid-flap (the directory evicted this incarnation
+            # again before its clock caught up) and eviction clears the
+            # directory's synced bit
+            if not readmitted or steps_done % 8 == 0:
+                _sup.send_synced(eff, rank=rank)
+                readmitted = True
+        _sup.send_heartbeat(eff, rank=rank)
+        steps_done += 1
+        time.sleep(args.step_ms / 1000.0)
+
+    result = {
+        "rank": rank, "pid": os.getpid(), "respawn_count": respawns,
+        "steps_done": steps_done,
+        "compiles": int(train_step._cache_size()),
+        "requests_ok": ok, "requests_failed": failed,
+        "served_by": {str(k): v for k, v in served_by.items()},
+        "failovers": [e.asdict() for e in router.failovers],
+        "dead_seen": sorted(dead_seen),
+        "readmitted": bool(readmitted),
+        "eff_base": int(peer._base),
+        "stopped_early": bool(stop["flag"]),
+    }
+    os.makedirs(args.out, exist_ok=True)
+    out_path = os.path.join(args.out,
+                            f"rank{rank}-run{respawns}.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+    peer.close()
+    bf.win_free()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

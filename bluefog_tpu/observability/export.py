@@ -36,6 +36,7 @@ __all__ = [
     "CKPT_SUFFIX", "CkptTrail", "read_ckpt_trail",
     "ASYNC_SUFFIX", "AsyncTrail", "read_async_trail",
     "PLANE_SUFFIX", "PlaneTrail", "read_plane_trail",
+    "FLEET_SUFFIX", "FleetTrail", "read_fleet_trail",
 ]
 
 METRICS_ENV = "BLUEFOG_METRICS"
@@ -324,6 +325,52 @@ def read_plane_trail(path: str):
     """Tolerant reader: ``(config_record_or_None, records)`` — the same
     contract as the other sidecar trails."""
     return read_trail(path, "plane_config")
+
+
+# -- fleet-supervisor trail (fleet/supervisor.py's sink) ---------------------
+
+FLEET_SUFFIX = "fleet.jsonl"
+
+
+class FleetTrail(Trail):
+    """Sidecar JSONL for the fleet supervisor (``<prefix>fleet.jsonl``):
+    a ``fleet_config`` head record (fleet size, respawn policy, the
+    command line), then one ``fleet_event`` line per process-lifecycle
+    event — ``spawn``/``heartbeat``/``synced``/``exit``/``respawn``/
+    ``terminate``/``done`` with the acting rank, OS pid, worker step,
+    and exit code where each applies.  This is the machine-readable
+    audit of REAL process lifecycle driving the elastic-membership
+    protocol; ``bfmonitor --fleet`` renders it and ``validate_jsonl``
+    gates it (docs/running.md "Fleet mode")."""
+
+    def __init__(self, path: str, *, size: int, respawn: bool = False,
+                 max_respawns: int = 0, command=()):
+        super().__init__(path, head_kind="fleet_config")
+        self.write({"kind": "fleet_config", "size": int(size),
+                    "respawn": bool(respawn),
+                    "max_respawns": int(max_respawns),
+                    "command": [str(c) for c in command]})
+
+    def write_event(self, event: str, *, rank: Optional[int] = None,
+                    pid: Optional[int] = None,
+                    step: Optional[int] = None,
+                    rc: Optional[int] = None,
+                    respawns: Optional[int] = None,
+                    transition: Optional[str] = None) -> dict:
+        rec = {"kind": "fleet_event", "event": str(event)}
+        for key, val in (("rank", rank), ("pid", pid), ("step", step),
+                         ("rc", rc), ("respawns", respawns)):
+            if val is not None:
+                rec[key] = int(val)
+        if transition is not None:
+            rec["transition"] = str(transition)
+        return self.write(rec)
+
+
+def read_fleet_trail(path: str):
+    """Tolerant reader: ``(config_record_or_None, records)`` — the same
+    contract as the other sidecar trails."""
+    return read_trail(path, "fleet_config")
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -632,6 +679,13 @@ _KIND_REQUIRED = {
     # this rank's gossiped fleet view
     "plane_config": ("t_us",),
     "plane": ("step", "t_us", "sources"),
+    # fleet-supervisor trail (FleetTrail above, fed by
+    # fleet/supervisor.py): a config head with the fleet size + respawn
+    # policy, then one event line per process-lifecycle action —
+    # spawn/heartbeat/synced/exit/respawn/terminate/membership/done
+    # (docs/running.md "Fleet mode")
+    "fleet_config": ("t_us",),
+    "fleet_event": ("event", "t_us"),
     # health verdict trail (observability/health.py write_verdicts): one
     # "report" summary line per evaluation window, then one "verdict"
     # line per finding.  The trail shares this module's rotation policy
@@ -820,6 +874,28 @@ def _check_plane(path, lineno, rec):
                 f"a bool")
 
 
+def _check_fleet(path, lineno, rec):
+    """Fleet-trail record shape (FleetTrail): one process-lifecycle
+    event with the acting rank/pid/step/rc where each applies.  Unknown
+    fields stay tolerated."""
+    if not isinstance(rec["event"], str):
+        raise ValueError(
+            f"{path}:{lineno}: fleet_event 'event' must be a string")
+    for field in ("rank", "pid", "step", "rc", "respawns"):
+        v = rec.get(field)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{path}:{lineno}: fleet_event field {field!r} is not "
+                f"numeric")
+    transition = rec.get("transition")
+    if transition is not None and not isinstance(transition, str):
+        raise ValueError(
+            f"{path}:{lineno}: fleet_event 'transition' must be a "
+            f"string")
+
+
 def _check_schedule(path, lineno, rec):
     """Schedule-synthesis record shape (control/synthesize.py): the
     armed schedule's identity and round structure.  Unknown fields stay
@@ -968,6 +1044,8 @@ def validate_jsonl(path: str, required=REQUIRED_JSONL_KEYS):
                 _check_async(path, lineno, rec)
             elif kind == "plane":
                 _check_plane(path, lineno, rec)
+            elif kind == "fleet_event":
+                _check_fleet(path, lineno, rec)
             elif kind == "schedule":
                 _check_schedule(path, lineno, rec)
 

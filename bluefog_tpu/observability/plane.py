@@ -86,6 +86,7 @@ __all__ = [
     "init_state", "plane_exchange", "exchange",
     "permutes_per_round", "wire_bytes_per_round", "diameter",
     "snapshot", "TelemetryPlane", "FleetViewLive", "matrix_from_view",
+    "host_merge",
 ]
 
 SCHEMA_VERSION = 1
@@ -413,6 +414,29 @@ def exchange(state: Dict[str, jnp.ndarray], payload, step,
                       jnp.asarray(active, jnp.float32),
                       jnp.asarray(link_ok, jnp.float32))
     return {"table": table, "last_heard": heard}
+
+
+def host_merge(table, received, last_heard, step):
+    """Host-side (numpy) newest-version-wins merge of a received
+    ``[N, WIRE]`` table into a local one — the EXACT rule
+    :func:`plane_exchange` applies on-device, for transports that carry
+    plane rows outside the mesh (``fleet/peers.py``'s per-process socket
+    gossip between OS processes).  Adopted source rows travel one more
+    hop; ``last_heard`` entries of adopted rows advance to ``step``.
+    Returns ``(table, last_heard)`` as fresh arrays."""
+    table = np.asarray(table, np.float32)
+    received = np.asarray(received, np.float32)
+    heard = np.asarray(last_heard, np.int64).copy()
+    if received.shape != table.shape:
+        raise ValueError(
+            f"received table shape {received.shape} != local "
+            f"{table.shape}")
+    newer = received[:, LANE_VERSION] > table[:, LANE_VERSION]
+    adopted = received.copy()
+    adopted[:, LANE_HOP] += 1.0
+    out = np.where(newer[:, None], adopted, table)
+    heard[newer] = int(step)
+    return out, heard
 
 
 # -- local fleet view --------------------------------------------------------

@@ -406,6 +406,21 @@ class ElasticMembership:
                     out.append(self._set(r, STATE_LEFT, step))
         return out
 
+    def observe_direct(self, last_heard_row, step: int
+                       ) -> List[Tuple[int, int, str]]:
+        """:meth:`observe` for a SINGLE authoritative observer: a ``[N]``
+        row of per-rank last-heard steps is broadcast to every viewer
+        seat, so quorum degenerates to that one view.  This is the fleet
+        supervisor's drive (``fleet/supervisor.py``): it hears worker
+        heartbeats directly over its socket, so the row it holds IS the
+        fleet's liveness truth — there is no second process to gossip
+        with about it."""
+        row = np.asarray(last_heard_row)
+        if row.shape != (self.size,):
+            raise ValueError(
+                f"last_heard_row must be [{self.size}], got {row.shape}")
+        return self.observe(np.tile(row, (self.size, 1)), step)
+
     # -- masks and summaries ------------------------------------------------
 
     def state_of(self, rank: int) -> str:

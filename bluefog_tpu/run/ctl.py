@@ -68,7 +68,8 @@ def _engine_from(head: Optional[dict], args) -> CTL.PolicyEngine:
     initial = args.initial_mode or (head or {}).get("initial_mode")
     gamma = bool((head or {}).get("gamma")) or args.gamma
     return CTL.PolicyEngine(cfg, modes=modes, initial_mode=initial,
-                            gamma=gamma)
+                            gamma=gamma,
+                            cadence=(head or {}).get("cadence"))
 
 
 def replay(prefix: str, *, head: Optional[dict] = None,

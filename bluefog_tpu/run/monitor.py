@@ -108,6 +108,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
                  checkpoint_path: Optional[str] = None,
                  async_path: Optional[str] = None,
                  plane_path: Optional[str] = None,
+                 fleet_path: Optional[str] = None,
                  cache: Optional[AG.TailCache] = None):
     """One monitoring pass: load the fleet view, evaluate health, and
     assemble the JSON-able report dict ``--once --json`` prints (the
@@ -143,7 +144,12 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     fleet view with per-source version/age/hop (stale sources flagged
     against ``BLUEFOG_PLANE_MAX_AGE``) becomes the ``"plane"`` block
     and the ``--plane`` panel, so the dashboard works from any single
-    rank with no shared filesystem."""
+    rank with no shared filesystem.  ``fleet_path``: the fleet
+    supervisor's trail (default discovery: ``<prefix>fleet.jsonl``,
+    ``observability/export.py::FleetTrail``) — per-rank pid, last
+    heartbeat, respawn counts, and process-lifecycle/membership events
+    become the ``"fleet"`` block and the ``--fleet`` panel
+    (docs/running.md "Fleet mode")."""
     cfg = H.HealthConfig.from_env()
     if window:
         cfg.window = window
@@ -214,6 +220,7 @@ def build_report(prefix: str, *, window: Optional[int] = None,
     out["checkpoint"] = _checkpoint_block(prefix, checkpoint_path)
     out["async"] = _async_block(prefix, async_path)
     out["plane"] = _plane_block(prefix, plane_path)
+    out["fleet"] = _fleet_block(prefix, fleet_path)
     return view, report, _strict_json(out)
 
 
@@ -435,6 +442,92 @@ def _plane_block(prefix: str, plane_path: Optional[str]) -> Optional[dict]:
         "live_series": live_series[-24:],
         "age_max_series": age_series[-24:],
     }
+
+
+def _fleet_block(prefix: str, fleet_path: Optional[str]) -> Optional[dict]:
+    """The fleet supervisor's trail as a report block: per-rank pid /
+    last-heartbeat step / respawn count / last lifecycle event, the
+    lifecycle-event tallies, and recent membership transitions — None
+    when no trail exists (a single-process run stays noise-free)."""
+    from ..observability.export import FLEET_SUFFIX, read_fleet_trail
+    path = fleet_path or prefix + FLEET_SUFFIX
+    config, records = read_fleet_trail(path)
+    if config is None and not records:
+        return None
+    events = [r for r in records if r.get("kind") == "fleet_event"]
+    size = (config or {}).get("size") or 0
+    per_rank = {}
+    counts = {}
+    transitions = []
+    done_rc = None
+    for e in events:
+        ev = e.get("event")
+        counts[ev] = counts.get(ev, 0) + 1
+        rank = e.get("rank")
+        if ev == "done":
+            done_rc = e.get("rc")
+        if ev == "membership":
+            transitions.append({"rank": rank, "step": e.get("step"),
+                                "state": e.get("transition")})
+            continue
+        if rank is None:
+            continue
+        row = per_rank.setdefault(str(rank), {
+            "pid": None, "last_heartbeat": None, "respawns": 0,
+            "last_event": None, "rc": None, "alive": False})
+        row["last_event"] = ev
+        if e.get("pid") is not None:
+            row["pid"] = e["pid"]
+        if ev == "heartbeat" and e.get("step") is not None:
+            row["last_heartbeat"] = e["step"]
+        if ev in ("spawn", "respawn"):
+            row["alive"] = True
+            row["respawns"] = e.get("respawns", row["respawns"]) or 0
+        elif ev == "exit":
+            row["alive"] = False
+            row["rc"] = e.get("rc")
+    return {
+        "path": path,
+        "size": size,
+        "respawn": (config or {}).get("respawn"),
+        "max_respawns": (config or {}).get("max_respawns"),
+        "per_rank": per_rank,
+        "events": counts,
+        "transitions": transitions[-12:],
+        "alive": sum(1 for r in per_rank.values() if r["alive"]),
+        "rc": done_rc,
+    }
+
+
+def render_fleet(block: dict, *, width: int = 12) -> str:
+    """The fleet-supervisor panel (``--fleet``): per-process pid /
+    last-heartbeat / respawn-count rows from the supervisor's trail,
+    lifecycle-event tallies, and recent membership transitions."""
+    counts = block.get("events") or {}
+    lines = [f"fleet:  alive {block.get('alive', '-')}"
+             f"/{block.get('size', '-')}  "
+             f"respawn={'on' if block.get('respawn') else 'off'}  "
+             f"spawns {counts.get('spawn', 0)}  "
+             f"exits {counts.get('exit', 0)}  "
+             f"respawns {counts.get('respawn', 0)}"
+             + (f"  rc {block['rc']}" if block.get("rc") is not None
+                else "")]
+    for rank in sorted(block.get("per_rank") or {}, key=int):
+        row = block["per_rank"][rank]
+        tag = "up" if row.get("alive") else (
+            f"rc {row.get('rc')}" if row.get("rc") is not None else "down")
+        lines.append(
+            f"  rank {rank:>3}  pid {str(row.get('pid', '-')):>7}  "
+            f"hb {str(row.get('last_heartbeat', '-')):>6}  "
+            f"respawns {row.get('respawns', 0)}  "
+            f"last {str(row.get('last_event', '-')):<10} [{tag}]")
+    if block.get("transitions"):
+        lines.append("  membership:")
+        for t in block["transitions"]:
+            lines.append(f"    step {str(t.get('step', '-')):>5}  "
+                         f"rank {str(t.get('rank', '-')):>3} -> "
+                         f"{t.get('state', '-')}")
+    return "\n".join(lines)
 
 
 def render_plane(block: dict, *, width: int = 12) -> str:
@@ -781,6 +874,14 @@ def main(argv=None) -> int:
     p.add_argument("--plane-trail", default=None, metavar="PATH",
                    help="plane trail to render (default: "
                         "<prefix>plane.jsonl when it exists)")
+    p.add_argument("--fleet", dest="fleet_panel", action="store_true",
+                   help="render the fleet-supervisor panel (per-process "
+                        "pid/rank/last-heartbeat/respawn-count, "
+                        "lifecycle events, membership transitions) from "
+                        "the <prefix>fleet.jsonl trail")
+    p.add_argument("--fleet-trail", default=None, metavar="PATH",
+                   help="fleet trail to render (default: "
+                        "<prefix>fleet.jsonl when it exists)")
     p.add_argument("--fail-on", choices=sorted(_FAIL_LEVELS),
                    default="never",
                    help="with --once: exit 1 when a verdict at or above "
@@ -799,7 +900,8 @@ def main(argv=None) -> int:
             membership_path=args.membership_trail,
             checkpoint_path=args.checkpoint_trail,
             async_path=args.async_trail,
-            plane_path=args.plane_trail, cache=cache)
+            plane_path=args.plane_trail,
+            fleet_path=args.fleet_trail, cache=cache)
         if args.json:
             print(json.dumps(out))
         else:
@@ -847,6 +949,14 @@ def main(argv=None) -> int:
                           "to the TelemetryPlane; it writes "
                           "<prefix>plane.jsonl; see "
                           "docs/observability.md)")
+            if args.fleet_panel:
+                if out.get("fleet"):
+                    print()
+                    print(render_fleet(out["fleet"]))
+                else:
+                    print("\n(no fleet trail yet — the bfrun --fleet "
+                          "supervisor writes <prefix>fleet.jsonl; see "
+                          "docs/running.md)")
             if args.edges:
                 edges = out.get("edges")
                 if edges:

@@ -57,6 +57,27 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                              "when it launches here, process 0 binds to it "
                              "(BLUEFOG_NETWORK_INTERFACE is exported to "
                              "every worker and consumed by bf.init)")
+    parser.add_argument("--fleet", type=int, default=None,
+                        help="run as a local fleet supervisor: spawn N "
+                             "worker OS processes with per-process env "
+                             "(fleet rank, peer map, metrics prefix), "
+                             "monitor heartbeats + waitpid, drive "
+                             "elastic membership from real process "
+                             "lifecycle, fan out SIGTERM, aggregate "
+                             "exit codes (docs/running.md 'Fleet mode')")
+    parser.add_argument("--respawn", action="store_true",
+                        help="with --fleet: relaunch a replacement for "
+                             "a crashed worker; it re-admits through "
+                             "the announce->sync->activate membership "
+                             "protocol")
+    parser.add_argument("--max-respawns", type=int, default=1,
+                        help="with --fleet --respawn: relaunch budget "
+                             "per rank (default 1)")
+    parser.add_argument("--fleet-trail", default=None,
+                        help="with --fleet: fleet.jsonl trail path for "
+                             "the supervisor's lifecycle events "
+                             "(default: BLUEFOG_METRICS prefix + "
+                             "fleet.jsonl, else ./fleet.jsonl)")
     parser.add_argument("--timeline-filename", default=None,
                         help="per-rank chrome-tracing output prefix "
                              "(exports BLUEFOG_TIMELINE)")
@@ -271,6 +292,13 @@ def main(argv=None) -> int:
                          "python train.py)")
     if args.command[0] == "--":
         args.command = args.command[1:]
+    if args.fleet:
+        if args.hosts or args.hostfile:
+            raise SystemExit("bfrun: --fleet supervises local OS "
+                             "processes; use -H/--hostfile without it "
+                             "for the multi-host path")
+        from ..fleet.supervisor import run_fleet
+        return run_fleet(args)
     hosts = _resolve_hosts(args)
     # A single *remote* host still needs the ssh + coordinator path; only a
     # bare or single-local-host spec runs in place.

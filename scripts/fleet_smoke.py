@@ -1,0 +1,220 @@
+"""Multi-process fleet smoke gate (``make fleet-smoke``).
+
+Boots a REAL 4-process CPU fleet through the actual supervisor entry
+point — ``python -m bluefog_tpu.run.run --fleet 4 --respawn -- <worker>``
+— and asserts the acceptance chaos path from docs/running.md end to
+end, across OS process boundaries (no shared memory, no shared JAX
+runtime; cross-process state rides the loopback gossip plane):
+
+1. all four ranks spawn, train, and heartbeat into the fleet trail;
+2. one worker is SIGKILLed mid-run *from outside the fleet* — the
+   supervisor reaps it (``exit`` with a negative rc), every SURVIVING
+   process sees the death through its own gossiped
+   ``FleetViewLive`` (``dead_seen``), and at least one survivor's
+   :class:`RequestRouter` fails over off the dead replica with at most
+   ONE failed request per process;
+3. ``--respawn`` relaunches the rank, which re-admits through the full
+   announce → sync → activate membership path (``respawn`` +
+   ``synced`` + ``membership`` transitions in the trail, ending
+   ``active``; the new incarnation reports ``readmitted``);
+4. exit codes aggregate: the crashed rank's clean replacement counts
+   as recovered, so the supervisor exits 0;
+5. zero step recompiles in every surviving process (per-process
+   compile count asserted == 1) — process death elsewhere in the
+   fleet must never invalidate a survivor's compiled step;
+6. the fleet trail round-trips ``validate_jsonl`` (``fleet_config`` +
+   ``fleet_event`` kinds).
+
+Exit 0 on success, 1 with a readable message otherwise.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bluefog_tpu.observability import export as EX    # noqa: E402
+
+SIZE = 4            # fleet size == per-process virtual mesh size
+STEPS = 200
+STEP_MS = 30.0
+KILL_RANK = 2       # the sticky replica every router starts on
+KILL_AFTER_STEP = 6
+
+
+def fail(msg):
+    print(f"fleet-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def read_events(trail):
+    try:
+        _, events = EX.read_fleet_trail(trail)
+        return events
+    except (OSError, ValueError):
+        return []
+
+
+def load_result(out, rank, run):
+    path = os.path.join(out, f"rank{rank}-run{run}.json")
+    if not os.path.exists(path):
+        fail(f"missing per-incarnation result {path}")
+    with open(path) as f:
+        return json.load(f)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="bf_fleet_smoke_")
+    out = os.path.join(tmp, "results")
+    trail = os.path.join(tmp, "fleet.jsonl")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={SIZE}")
+    env.pop("BLUEFOG_METRICS", None)       # workers must not inherit a sink
+    env["BLUEFOG_PLANE_MAX_AGE"] = "8"
+
+    cmd = [sys.executable, "-m", "bluefog_tpu.run.run",
+           "--fleet", str(SIZE), "--platform", "cpu", "--respawn",
+           "--fleet-trail", trail, "--",
+           sys.executable, "-m", "bluefog_tpu.fleet.worker",
+           "--steps", str(STEPS), "--step-ms", str(STEP_MS),
+           "--out", out]
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO)
+
+    # -- phase 1: wait for the victim to train past the kill threshold --
+    victim_pid = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            fail(f"fleet exited rc={proc.returncode} before the chaos "
+                 f"kill landed")
+        events = read_events(trail)
+        pids = {e["rank"]: e["pid"] for e in events
+                if e["event"] == "spawn"}
+        beats = [e["step"] for e in events
+                 if e["event"] == "heartbeat"
+                 and e.get("rank") == KILL_RANK]
+        if KILL_RANK in pids and beats and max(beats) >= KILL_AFTER_STEP:
+            victim_pid = pids[KILL_RANK]
+            break
+        time.sleep(0.1)
+    if victim_pid is None:
+        proc.kill()
+        fail(f"rank {KILL_RANK} never heartbeat past step "
+             f"{KILL_AFTER_STEP} within 120s")
+
+    # -- phase 2: SIGKILL the victim from outside the fleet -------------
+    os.kill(victim_pid, signal.SIGKILL)
+
+    # -- phase 3: the fleet must recover and exit clean ------------------
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        fail("supervisor did not finish within 240s after the kill")
+    if rc != 0:
+        fail(f"supervisor exited rc={rc} (crashed rank's clean "
+             f"replacement must count as recovered)")
+
+    # -- trail: crash -> respawn -> announce -> sync -> activate --------
+    events = read_events(trail)
+    if not events:
+        fail(f"fleet trail {trail} is empty or unreadable")
+    crashes = [e for e in events if e["event"] == "exit"
+               and e["rank"] == KILL_RANK and e["rc"] < 0]
+    if not crashes:
+        fail(f"no negative-rc exit for rank {KILL_RANK} in the trail")
+    respawns = [e for e in events if e["event"] == "respawn"
+                and e["rank"] == KILL_RANK]
+    if len(respawns) != 1:
+        fail(f"expected exactly one respawn of rank {KILL_RANK}, "
+             f"got {len(respawns)}")
+    if not any(e["event"] == "synced" and e["rank"] == KILL_RANK
+               for e in events):
+        fail(f"respawned rank {KILL_RANK} never reported synced")
+    states = [e["transition"] for e in events
+              if e["event"] == "membership" and e["rank"] == KILL_RANK]
+    if "left" not in states:
+        fail(f"membership never recorded rank {KILL_RANK} leaving: "
+             f"{states}")
+    # re-admission must walk the full announce -> sync -> activate path
+    # (a trailing "left" afterwards is the replacement's own orderly
+    # exit at the end of the run)
+    want = iter(["announced", "syncing", "active"])
+    need = next(want)
+    for s in states:
+        if s == need:
+            need = next(want, None)
+            if need is None:
+                break
+    if need is not None:
+        fail(f"rank {KILL_RANK} never re-admitted through announce -> "
+             f"sync -> activate: {states}")
+    done = [e for e in events if e["event"] == "done"]
+    if not done or done[-1]["rc"] != 0:
+        fail(f"trail done record missing or nonzero: {done}")
+    EX.validate_jsonl(trail)    # raises on any schema drift
+
+    # -- survivors: steps advance, death seen, failover, no recompiles --
+    survivors = [r for r in range(SIZE) if r != KILL_RANK]
+    failovers = 0
+    death_witnesses = 0
+    for rank in survivors:
+        res = load_result(out, rank, 0)
+        if res["steps_done"] != STEPS:
+            fail(f"survivor rank {rank} stopped at step "
+                 f"{res['steps_done']}/{STEPS}")
+        if res["compiles"] != 1:
+            fail(f"survivor rank {rank} recompiled its step: "
+                 f"{res['compiles']} compiles (the kill must not "
+                 f"invalidate a survivor's program)")
+        if res["requests_failed"] > 1:
+            fail(f"survivor rank {rank} failed "
+                 f"{res['requests_failed']} requests (bound is 1 "
+                 f"across the failover)")
+        if KILL_RANK in res["dead_seen"]:
+            death_witnesses += 1
+        failovers += len(res["failovers"])
+    if death_witnesses == 0:
+        fail(f"no surviving process observed rank {KILL_RANK}'s death "
+             f"through its gossiped plane view")
+    if failovers == 0:
+        fail("no surviving router failed over off the dead replica")
+
+    # -- the replacement incarnation caught up and re-admitted ----------
+    res1 = load_result(out, KILL_RANK, 1)
+    if res1["respawn_count"] != 1:
+        fail(f"replacement respawn_count {res1['respawn_count']} != 1")
+    if not res1["readmitted"]:
+        fail("replacement never saw enough live peers to report synced")
+    if res1["steps_done"] <= 0:
+        fail("replacement made no training progress")
+    if res1["compiles"] != 1:
+        fail(f"replacement recompiled: {res1['compiles']} compiles")
+
+    print(json.dumps({
+        "status": "ok",
+        "trail": trail,
+        "size": SIZE,
+        "killed_rank": KILL_RANK,
+        "killed_pid": victim_pid,
+        "crash_rc": crashes[0]["rc"],
+        "membership_states": states,
+        "death_witnesses": death_witnesses,
+        "survivor_failovers": failovers,
+        "replacement_steps": res1["steps_done"],
+        "replacement_eff_base": res1["eff_base"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -146,6 +146,70 @@ def test_import_time_env_read_in_default_arg_caught(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# distributed-init-outside-bootstrap
+# ---------------------------------------------------------------------------
+
+def test_distributed_init_outside_bootstrap_caught(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            import jax
+            def bring_up():
+                jax.distributed.initialize("127.0.0.1:9999", 2, 0)
+        """})
+    findings = _run(root, "distributed-init-outside-bootstrap")
+    assert len(findings) == 1
+    assert findings[0].severity == "error"
+    assert findings[0].path == "bluefog_tpu/mod.py"
+    assert "bluefog_tpu/fleet/bootstrap.py" in findings[0].message
+
+
+def test_distributed_init_aliased_spellings_caught(tmp_path):
+    # both the module-alias and the from-import spelling must resolve
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/alias.py": """
+            import jax.distributed as jd
+            def bring_up():
+                jd.initialize()
+        """,
+        "bluefog_tpu/bare.py": """
+            from jax.distributed import initialize
+            def bring_up():
+                initialize()
+        """})
+    findings = _run(root, "distributed-init-outside-bootstrap")
+    assert sorted(f.path for f in findings) == [
+        "bluefog_tpu/alias.py", "bluefog_tpu/bare.py"]
+
+
+def test_distributed_init_inside_bootstrap_allowed(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/fleet/bootstrap.py": """
+            import jax
+            def _initialize(spec):
+                jax.distributed.initialize(spec.coordinator)
+        """})
+    assert _run(root, "distributed-init-outside-bootstrap") == []
+
+
+def test_unrelated_initialize_not_flagged(tmp_path):
+    # someone else's `initialize` name must not trip the rule
+    root = _mini_repo(tmp_path, {
+        "bluefog_tpu/mod.py": """
+            from mylib import initialize
+            def setup():
+                initialize()
+        """})
+    assert _run(root, "distributed-init-outside-bootstrap") == []
+
+
+def test_distributed_init_rule_clean_on_this_repo():
+    # the real tree has exactly one call site: the bootstrap module
+    findings, _n = astrules.run_ast_rules(
+        rules=["distributed-init-outside-bootstrap"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # jsonl-kind-drift
 # ---------------------------------------------------------------------------
 

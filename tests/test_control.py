@@ -381,6 +381,51 @@ def _report(step, *rules):
                           ranks=1, verdicts=verdicts)
 
 
+def test_cadence_knob_throttles_straggler_and_rearms():
+    """PR 16's deferred controller hookup: a ``straggler`` verdict
+    lowers the flagged rank's async cadence (bounded by the scheduler's
+    ``max_staleness`` cap), the ``on`` actuator moves the REAL
+    scheduler, and the verdict clearing restores the base period."""
+    from bluefog_tpu.async_train import CadenceScheduler
+    from bluefog_tpu.control import actuate as ACT
+    sched = CadenceScheduler(4, max_staleness=4)
+    eng = POL.PolicyEngine(
+        POL.ControlConfig(cooldown=4, rearm_after=2), cadence=sched)
+    view = _fake_view({0: [{"step": 0, "rank": 0}]})
+    straggler = H.Verdict(rule="straggler", severity="warn",
+                          message="slow", rank=2, value=3.4)
+    rep = H.HealthReport(step_lo=0, step_hi=7, ranks=4,
+                         verdicts=[straggler])
+    d = eng.evaluate(view, rep, 7)
+    # ceil(3.4) = 4, at the max_staleness cap
+    assert [(x.knob, x.action, x.value, x.rule) for x in d] == [
+        ("cadence", "throttle", [2, 4], "straggler")]
+    assert d[0].prev == [2, 1]
+    # shadow purity: the engine MODELS the throttle, the scheduler moves
+    # only through the actuator
+    assert eng.cadence_periods[2] == 4
+    assert int(sched.periods[2]) == 1
+    act = ACT.Actuator(object(), mode="on", cadence=sched)
+    assert act.apply(d[0]) is True
+    assert int(sched.periods[2]) == 4
+    # persisting verdict inside the cooldown: no chatter
+    assert eng.evaluate(view, rep, 9) == []
+    # verdict cleared: base restored after the healthy streak
+    healthy = H.HealthReport(step_lo=8, step_hi=15, ranks=4, verdicts=[])
+    assert eng.evaluate(view, healthy, 15) == []      # streak 1 of 2
+    out = eng.evaluate(view, healthy, 23)
+    assert [(x.knob, x.action, x.value) for x in out] == [
+        ("cadence", "rearm", [2, 1])]
+    assert act.apply(out[0]) is True
+    assert int(sched.periods[2]) == 1
+    # the replay head round-trips the cadence model
+    head = eng.describe()
+    assert head["cadence"]["max_staleness"] == 4
+    eng2 = POL.PolicyEngine(POL.ControlConfig(cooldown=4, rearm_after=2),
+                            cadence=head["cadence"])
+    assert eng2.cadence_cap == 4 and eng2.cadence_base == 1
+
+
 def test_cooldown_limits_decision_rate():
     eng = POL.PolicyEngine(
         POL.ControlConfig(cooldown=16, rearm_after=2),

@@ -1,0 +1,511 @@
+"""Multi-process fleet runtime (PR 20, ``bluefog_tpu/fleet/``).
+
+Fast legs run in-process: bootstrap guard paths against a monkeypatched
+``_initialize`` seam (no live coordinator), PlanePeer gossip over real
+loopback UDP sockets, the fleet trail schema, the supervisor's
+membership/exit-code units, and the bfmonitor fleet panel.  The
+``slow``-marked legs spawn REAL worker OS processes through
+:class:`FleetSupervisor` / ``bfrun --fleet`` (the kill → failover →
+respawn chaos path lives in ``scripts/fleet_smoke.py`` / ``make
+fleet-smoke``).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import context as CX
+from bluefog_tpu.fleet import bootstrap as BS
+from bluefog_tpu.fleet import peers as FP
+from bluefog_tpu.fleet import supervisor as SUP
+from bluefog_tpu.observability import export as EX
+from bluefog_tpu.observability import plane as P
+from bluefog_tpu.resilience.membership import (ElasticMembership,
+                                               LivenessConfig,
+                                               STATE_ACTIVE, STATE_LEFT)
+from bluefog_tpu.run import monitor as MON
+
+_FLEET_ENV = (
+    "BLUEFOG_FLEET_COORDINATOR", "BLUEFOG_FLEET_NUM_PROCESSES",
+    "BLUEFOG_FLEET_PROCESS_ID", "BLUEFOG_FLEET_CONNECT_RETRIES",
+    "BLUEFOG_FLEET_CONNECT_BACKOFF", "BLUEFOG_FLEET_CONNECT_TIMEOUT",
+    "BLUEFOG_FLEET_PEERS", "BLUEFOG_FLEET_RANK", "BLUEFOG_FLEET_SIZE",
+    "BLUEFOG_FLEET_SUPERVISOR", "BLUEFOG_FLEET_RESPAWN_COUNT",
+    "BLUEFOG_COORDINATOR", "BLUEFOG_NUM_PROCESSES", "BLUEFOG_PROCESS_ID",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_fleet(monkeypatch):
+    """Isolate the bootstrap guard and the fleet env family per test."""
+    for name in _FLEET_ENV:
+        monkeypatch.delenv(name, raising=False)
+    BS.reset_for_testing()
+    yield
+    BS.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# FleetSpec resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_none_without_coordinator():
+    assert BS.resolve_fleet_spec() is None
+    assert BS.resolve_fleet_spec(None) is None
+
+
+def test_resolve_env_family_wins_over_legacy(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_COORDINATOR", "legacy:1")
+    monkeypatch.setenv("BLUEFOG_NUM_PROCESSES", "2")
+    monkeypatch.setenv("BLUEFOG_PROCESS_ID", "1")
+    spec = BS.resolve_fleet_spec()
+    assert spec.coordinator == "legacy:1"
+    assert (spec.num_processes, spec.process_id) == (2, 1)
+    monkeypatch.setenv("BLUEFOG_FLEET_COORDINATOR", "fleet:2")
+    monkeypatch.setenv("BLUEFOG_FLEET_NUM_PROCESSES", "4")
+    monkeypatch.setenv("BLUEFOG_FLEET_PROCESS_ID", "3")
+    monkeypatch.setenv("BLUEFOG_FLEET_CONNECT_RETRIES", "5")
+    monkeypatch.setenv("BLUEFOG_FLEET_CONNECT_BACKOFF", "0.25")
+    monkeypatch.setenv("BLUEFOG_FLEET_CONNECT_TIMEOUT", "7.5")
+    spec = BS.resolve_fleet_spec()
+    assert spec.coordinator == "fleet:2"
+    assert (spec.num_processes, spec.process_id) == (4, 3)
+    assert spec.connect_retries == 5
+    assert spec.connect_backoff_s == 0.25
+    assert spec.connect_timeout_s == 7.5
+
+
+def test_resolve_explicit_spec_dict_and_type_error():
+    spec = BS.FleetSpec(coordinator="x:1", num_processes=2)
+    assert BS.resolve_fleet_spec(spec) is spec
+    got = BS.resolve_fleet_spec({"coordinator": "y:2", "process_id": 1})
+    assert (got.coordinator, got.process_id) == ("y:2", 1)
+    with pytest.raises(TypeError):
+        BS.resolve_fleet_spec(42)
+
+
+# ---------------------------------------------------------------------------
+# bootstrap guard paths (the _initialize seam is monkeypatched: no
+# coordinator process exists in these tests)
+# ---------------------------------------------------------------------------
+
+def test_noop_without_coordinator(monkeypatch):
+    calls = []
+    monkeypatch.setattr(BS, "_initialize", lambda spec: calls.append(spec))
+    d = BS.ensure_initialized()
+    assert d["status"] == "noop"
+    assert calls == [] and not BS.started()
+    # the context path delegates to the same no-op
+    assert CX._maybe_init_jax_distributed() is None
+
+
+def test_ok_then_double_call_idempotent(monkeypatch):
+    calls = []
+    monkeypatch.setattr(BS, "_initialize", lambda spec: calls.append(spec))
+    spec = BS.FleetSpec(coordinator="127.0.0.1:1", num_processes=2,
+                        process_id=1)
+    d1 = BS.ensure_initialized(spec)
+    assert d1["status"] == "ok" and d1["attempts"] == 1
+    assert BS.started() and BS.last_diagnosis() == d1
+    d2 = BS.ensure_initialized(spec)
+    assert d2["status"] == "noop"
+    assert len(calls) == 1          # initialize ran exactly once
+
+
+def test_benign_already_initialized_adopted(monkeypatch, caplog):
+    def boom(spec):
+        raise RuntimeError(
+            "jax.distributed.initialize should only be called once.")
+    monkeypatch.setattr(BS, "_initialize", boom)
+    with caplog.at_level("WARNING", logger="bluefog_tpu"):
+        d = BS.ensure_initialized(BS.FleetSpec(coordinator="c:1"))
+    assert d["status"] == "adopted" and BS.started()
+    assert any("skipped" in r.message for r in caplog.records)
+
+
+def test_unreachable_retries_then_structured_failure(monkeypatch):
+    calls = []
+
+    def refuse(spec):
+        calls.append(time.monotonic())
+        raise ConnectionRefusedError("connection refused")
+    monkeypatch.setattr(BS, "_initialize", refuse)
+    spec = BS.FleetSpec(coordinator="127.0.0.1:1", num_processes=2,
+                        connect_retries=3, connect_backoff_s=0.0)
+    with pytest.raises(BS.FleetBootstrapError) as ei:
+        BS.ensure_initialized(spec)
+    d = ei.value.diagnosis
+    assert d["status"] == "unreachable" and d["attempts"] == 3
+    assert len(calls) == 3 and not BS.started()
+    assert BS.last_diagnosis() == d
+    # the record is machine-readable through the exception string too
+    assert json.loads(str(ei.value))["status"] == "unreachable"
+
+
+def test_non_retryable_error_raises_immediately(monkeypatch):
+    def bad(spec):
+        raise ValueError("num_processes must be positive")
+    monkeypatch.setattr(BS, "_initialize", bad)
+    with pytest.raises(ValueError):
+        BS.ensure_initialized(BS.FleetSpec(coordinator="c:1",
+                                           connect_retries=3))
+    assert BS.last_diagnosis()["status"] == "error"
+    assert BS.last_diagnosis()["attempts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# PlanePeer: plane gossip between processes (real loopback UDP)
+# ---------------------------------------------------------------------------
+
+def test_peer_map_round_trip():
+    peers = {0: ("127.0.0.1", 5000), 2: ("127.0.0.1", 5002)}
+    assert FP.parse_peer_map(FP.format_peer_map(peers)) == peers
+    assert FP.parse_peer_map("") == {}
+
+
+def _gossip_round(alive, step):
+    for p in alive:
+        p.publish(P.pack_payload(p.eff_step(step), staleness=0.0), step)
+    for p in alive:
+        p.poll(step)
+        p.observe(step)
+
+
+def test_plane_peer_gossip_death_and_resume(monkeypatch):
+    """The fleet-smoke liveness chain, in-process: convergence, then a
+    silenced peer goes stale fleet-wide, then its replacement re-joins
+    with winning versions after ``resume_clock``."""
+    monkeypatch.setenv(P.MAX_AGE_ENV, "3")
+    ports = SUP.free_ports(3)
+    peers = {r: ("127.0.0.1", p) for r, p in enumerate(ports)}
+    a, b, c = (FP.PlanePeer(r, 3, peers) for r in range(3))
+    try:
+        for step in range(4):
+            _gossip_round((a, b, c), step)
+            time.sleep(0.01)
+        assert list(a.view().alive_mask(2)) == [1, 1, 1]
+        assert np.all(a.versions() > 0)
+        # silence c: its version freezes, age crosses max_age, the
+        # OTHER processes' views drop it — no supervisor involved
+        for step in range(4, 10):
+            _gossip_round((a, b), step)
+            time.sleep(0.01)
+        assert list(a.view().alive_mask(2)) == [1, 1, 0]
+        assert list(b.view().alive_mask(2)) == [1, 1, 0]
+        # respawn c as a fresh process-equivalent: listen first, then
+        # fast-forward past the dead incarnation's circulating versions
+        c.close()
+        c2 = FP.PlanePeer(2, 3, peers)
+        c2.poll(0)
+        dead_ver = int(a.versions()[2])
+        c2.resume_clock(0)
+        assert c2.eff_step(0) > dead_ver
+        for step in range(3):
+            _gossip_round((a, b, c2), step + 10)
+            time.sleep(0.01)
+        assert list(a.view().alive_mask(2)) == [1, 1, 1]
+        assert int(a.versions()[2]) > dead_ver
+        c = c2
+    finally:
+        for p in (a, b, c):
+            p.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet trail: schema + validate_jsonl
+# ---------------------------------------------------------------------------
+
+def _synthetic_trail(path):
+    trail = EX.FleetTrail(path, size=2, respawn=True, max_respawns=1,
+                          command=["python", "-m", "w"])
+    trail.write_event("spawn", rank=0, pid=100, respawns=0)
+    trail.write_event("spawn", rank=1, pid=101, respawns=0)
+    trail.write_event("heartbeat", rank=0, pid=100, step=3)
+    trail.write_event("exit", rank=1, pid=101, rc=-9)
+    trail.write_event("membership", rank=1, step=3, transition="left")
+    trail.write_event("respawn", rank=1, pid=102, respawns=1)
+    trail.write_event("synced", rank=1, pid=102, step=5)
+    trail.write_event("membership", rank=1, step=5, transition="active")
+    trail.write_event("done", rc=0)
+    return trail
+
+
+def test_fleet_trail_schema_round_trip(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    _synthetic_trail(path)
+    head, events = EX.read_fleet_trail(path)
+    assert head["kind"] == "fleet_config" and head["size"] == 2
+    assert head["respawn"] is True
+    assert [e["event"] for e in events] == [
+        "spawn", "spawn", "heartbeat", "exit", "membership", "respawn",
+        "synced", "membership", "done"]
+    assert events[3]["rc"] == -9
+    assert events[4]["transition"] == "left"
+    records = EX.validate_jsonl(path)   # raises on any schema drift
+    assert [r["kind"] for r in records] == (
+        ["fleet_config"] + ["fleet_event"] * 9)
+
+
+def test_fleet_trail_validation_rejects_malformed(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    _synthetic_trail(path)
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "fleet_event",
+                            "t_us": 1}) + "\n")          # no event
+    with pytest.raises(ValueError, match="event"):
+        EX.validate_jsonl(path)
+    path2 = str(tmp_path / "fleet2.jsonl")
+    _synthetic_trail(path2)
+    with open(path2, "a") as f:
+        f.write(json.dumps({"kind": "fleet_event", "event": "exit",
+                            "rc": True, "t_us": 1}) + "\n")  # bool rc
+    with pytest.raises(ValueError, match="rc"):
+        EX.validate_jsonl(path2)
+
+
+# ---------------------------------------------------------------------------
+# bfmonitor --fleet panel
+# ---------------------------------------------------------------------------
+
+def test_monitor_fleet_block_and_render(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    _synthetic_trail(path)
+    block = MON._fleet_block(str(tmp_path / "nope-"), path)
+    assert block is not None
+    assert block["size"] == 2 and block["rc"] == 0
+    assert block["per_rank"]["1"]["respawns"] == 1
+    assert block["per_rank"]["1"]["last_event"] == "synced"
+    assert block["events"]["respawn"] == 1
+    assert block["transitions"][-1]["state"] == "active"
+    text = MON.render_fleet(block)
+    assert "fleet" in text and "rank" in text and "respawns 1" in text
+    # absent trail -> no block, monitor stays quiet
+    assert MON._fleet_block(str(tmp_path / "other-"), None) is None
+
+
+def test_build_report_includes_fleet_block(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    _synthetic_trail(path)
+    _view, _health, out = MON.build_report(str(tmp_path / "prefix-"),
+                                           fleet_path=path)
+    assert out["fleet"] is not None and out["fleet"]["size"] == 2
+
+
+# ---------------------------------------------------------------------------
+# supervisor units
+# ---------------------------------------------------------------------------
+
+def test_free_ports_distinct():
+    ports = SUP.free_ports(8)
+    assert len(set(ports)) == 8
+
+
+def test_observe_direct_drives_readmission():
+    """The supervisor's membership drive: leave on a reaped death, then
+    announce → (heartbeats fresh) → syncing → mark_synced → active."""
+    m = ElasticMembership(4, cfg=LivenessConfig(suspect_after=2,
+                                                confirm_after=4))
+    assert m.states[2] == STATE_ACTIVE
+    assert m.leave(2, 10) == (10, 2, STATE_LEFT)
+    assert m.announce(2, 10) is not None
+    row = np.full((4,), 12, np.int64)
+    transitions = []
+    for clock in (12, 13, 14):
+        transitions += m.observe_direct(row + (clock - 12), clock)
+        m.mark_synced(2)
+    states = [s for (_, r, s) in transitions if r == 2]
+    assert states[-1] == STATE_ACTIVE
+    assert m.states[2] == STATE_ACTIVE
+
+
+def test_datagram_reannounces_evicted_live_rank(tmp_path, monkeypatch):
+    """A replacement whose interpreter boot outlasts the joiner grace
+    gets evicted before it ever speaks; its first datagram — with a
+    verifiably live child process — must re-announce it so it can walk
+    announce → sync → activate again."""
+    sup = SUP.FleetSupervisor(
+        ["true"], 3, trail_path=str(tmp_path / "fleet.jsonl"))
+    try:
+        monkeypatch.setenv(SUP.SUPERVISOR_ENV,
+                           f"{sup.addr[0]}:{sup.addr[1]}")
+        sup.membership.leave(1, 5)
+        assert sup.membership.state_of(1) == STATE_LEFT
+
+        class _LiveProc:
+            pid = 12345
+
+            def poll(self):
+                return None
+
+        sup.procs[1] = _LiveProc()
+        assert SUP.send_heartbeat(7, rank=1)
+        deadline = time.monotonic() + 2.0
+        while (sup.membership.state_of(1) == STATE_LEFT
+               and time.monotonic() < deadline):
+            sup._drain_heartbeats()
+            time.sleep(0.01)
+        assert sup.membership.state_of(1) == "announced"
+        assert sup.last_hb[1] == 7
+        # a datagram from a rank with NO live child must not resurrect
+        sup.membership.leave(2, 8)
+        assert SUP.send_heartbeat(9, rank=2)
+        time.sleep(0.05)
+        sup._drain_heartbeats()
+        assert sup.membership.state_of(2) == STATE_LEFT
+    finally:
+        sup._sock.close()
+
+
+def test_chase_clock_realigns_lagging_resume():
+    """chase_clock glues a resumed clock to the freshest OTHER source —
+    and never ratchets off the process's own publishes."""
+    ports = SUP.free_ports(2)
+    peers = {r: ("127.0.0.1", p) for r, p in enumerate(ports)}
+    a = FP.PlanePeer(0, 2, peers=peers)
+    b = FP.PlanePeer(1, 2, peers=peers)
+    try:
+        # a runs far ahead; b (a respawn whose bring-up stalled after
+        # resume_clock) starts its local clock at 0
+        for step in range(60):
+            a.publish(P.pack_payload(step, staleness=0.0), step)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and b.versions()[0] == 0:
+            b.poll(0)
+            time.sleep(0.01)
+        assert b.versions()[0] == 60       # a's row: version step+1
+        assert b.chase_clock(0) == 60      # glued to a's clock
+        assert b.eff_step(1) == 61
+        # caught up: chasing again must be a no-op (own publishes and
+        # an equal peer clock never ratchet the base)
+        b.publish(P.pack_payload(1, staleness=0.0), 1)
+        base = b._base
+        b.chase_clock(2)
+        assert b._base == base
+    finally:
+        a.close()
+        b.close()
+
+
+def test_aggregate_rc_last_incarnation_wins(tmp_path):
+    sup = SUP.FleetSupervisor(
+        ["true"], 3, trail_path=str(tmp_path / "fleet.jsonl"))
+    try:
+        sup.final_rc = {0: 0, 1: 0, 2: 0}
+        assert sup.aggregate_rc() == 0
+        # rank 1 crashed but its respawn finished clean: recovered
+        sup.final_rc = {0: 0, 1: 0, 2: 0}
+        assert sup.aggregate_rc() == 0
+        sup.final_rc = {0: 0, 1: 3, 2: 5}
+        assert sup.aggregate_rc() == 3
+    finally:
+        sup._sock.close()
+
+
+def test_worker_env_layers_fleet_family(tmp_path):
+    sup = SUP.FleetSupervisor(
+        ["true"], 2, trail_path=str(tmp_path / "fleet.jsonl"),
+        env_for_rank=lambda r: {"BASE": str(r)})
+    try:
+        env = sup._worker_env(1)
+        assert env["BASE"] == "1"
+        assert env[FP.RANK_ENV] == "1" and env[FP.SIZE_ENV] == "2"
+        assert FP.parse_peer_map(env[FP.PEERS_ENV]) == sup.peer_map
+        host, port = env[SUP.SUPERVISOR_ENV].rsplit(":", 1)
+        assert (host, int(port)) == sup.addr
+        assert env[SUP.RESPAWN_COUNT_ENV] == "0"
+    finally:
+        sup._sock.close()
+
+
+def test_checkpoint_dir_is_process_scoped(tmp_path, monkeypatch):
+    """Fleet workers each run a full-size virtual mesh: without scoping
+    they would clobber each other's shards on a shared filesystem."""
+    from bluefog_tpu.checkpoint import process_scoped_dir
+    base = str(tmp_path / "ckpt")
+    assert process_scoped_dir(base) == base            # single-process
+    assert process_scoped_dir(base, 3).endswith("proc-3")
+    monkeypatch.setenv(FP.RANK_ENV, "2")
+    assert process_scoped_dir(base).endswith("proc-2")
+
+
+# ---------------------------------------------------------------------------
+# real OS processes (slow: excluded from the tier-1 quick gate; the
+# kill -> failover -> respawn path is make fleet-smoke)
+# ---------------------------------------------------------------------------
+
+def _worker_base_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("BLUEFOG_METRICS", None)
+    env["BLUEFOG_PLANE_MAX_AGE"] = "8"
+    return env
+
+
+@pytest.mark.slow
+def test_fleet_supervisor_end_to_end(tmp_path):
+    """4 real worker processes: every rank advances, heartbeats land in
+    the trail, exit codes aggregate to 0, zero recompiles anywhere."""
+    out = str(tmp_path / "results")
+    trail = str(tmp_path / "fleet.jsonl")
+    cmd = [sys.executable, "-m", "bluefog_tpu.fleet.worker",
+           "--steps", "8", "--step-ms", "20", "--out", out]
+    sup = SUP.FleetSupervisor(
+        cmd, 4, trail_path=trail,
+        env_for_rank=lambda r: _worker_base_env(tmp_path))
+    rc = sup.run()
+    assert rc == 0
+    head, events = EX.read_fleet_trail(trail)
+    kinds = {e["event"] for e in events}
+    assert {"spawn", "heartbeat", "exit", "done"} <= kinds
+    for rank in range(4):
+        with open(os.path.join(out, f"rank{rank}-run0.json")) as f:
+            res = json.load(f)
+        assert res["steps_done"] == 8
+        assert res["compiles"] == 1
+        assert res["requests_failed"] == 0
+    EX.validate_jsonl(trail)    # raises on any schema drift
+
+
+@pytest.mark.slow
+def test_bfrun_fleet_sigterm_fan_out(tmp_path):
+    """SIGTERM to bfrun fans out to every worker; the orderly stop
+    exits 0 with terminate events in the trail."""
+    out = str(tmp_path / "results")
+    trail = str(tmp_path / "fleet.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bluefog_tpu.run.run",
+         "--fleet", "2", "--platform", "cpu",
+         "--fleet-trail", trail, "--",
+         sys.executable, "-m", "bluefog_tpu.fleet.worker",
+         "--steps", "2000", "--step-ms", "20", "--out", out],
+        env=_worker_base_env(tmp_path))
+    deadline = time.monotonic() + 60
+    # wait for both workers to heartbeat before pulling the plug
+    while time.monotonic() < deadline:
+        try:
+            _, events = EX.read_fleet_trail(trail)
+        except OSError:
+            events = []
+        beats = {e.get("rank") for e in events
+                 if e.get("event") == "heartbeat"}
+        if beats >= {0, 1}:
+            break
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("workers never heartbeat")
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc == 0
+    _, events = EX.read_fleet_trail(trail)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("terminate") == 2
+    assert kinds.count("exit") == 2
+    assert events[-1]["event"] == "done" and events[-1]["rc"] == 0
